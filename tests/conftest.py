@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax import.
+
+Replaces the reference's ``TestSparkContext`` (shared local[2] Spark session,
+``utils/.../test/TestSparkContext.scala:36-80``): tests exercise distributed
+behavior on 8 virtual host devices so every sharding/collective path runs in
+CI without TPU hardware.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_uids():
+    from transmogrifai_tpu.utils import uid
+    uid.reset()
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

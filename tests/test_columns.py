@@ -1,0 +1,81 @@
+"""Columnar layer tests."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, column_from_values
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def test_numeric_column_roundtrip():
+    col = column_from_values(ft.Real, [1.0, None, 3.5])
+    assert len(col) == 3
+    assert col.to_list() == [1.0, None, 3.5]
+    assert col.mask.tolist() == [True, False, True]
+    assert col.get_boxed(1) == ft.Real(None)
+
+
+def test_integral_binary_columns():
+    col = column_from_values(ft.Integral, [1, None, 3])
+    assert col.values.dtype == np.int64
+    assert col.to_list() == [1, None, 3]
+    b = column_from_values(ft.Binary, [True, None, False])
+    assert b.to_list() == [True, None, False]
+
+
+def test_text_column():
+    col = column_from_values(ft.Text, ["a", None, "c"])
+    assert col.to_list() == ["a", None, "c"]
+    assert col.mask.tolist() == [True, False, True]
+
+
+def test_ragged_column():
+    col = column_from_values(ft.DateList, [[1, 2], [], [3]])
+    assert col.to_list() == [[1, 2], [], [3]]
+    taken = col.take(np.array([2, 0]))
+    assert taken.to_list() == [[3], [1, 2]]
+
+
+def test_geo_column():
+    col = column_from_values(ft.Geolocation, [[1.0, 2.0, 3.0], None])
+    assert col.to_list() == [[1.0, 2.0, 3.0], []]
+
+
+def test_map_column():
+    col = column_from_values(ft.RealMap, [{"a": 1.0}, {"b": 2.0}, None])
+    assert set(col.children.keys()) == {"a", "b"}
+    assert col.to_list() == [{"a": 1.0}, {"b": 2.0}, {}]
+
+
+def test_prediction_column():
+    col = column_from_values(
+        ft.Prediction,
+        [ft.Prediction(prediction=1.0, probability=[0.3, 0.7]).value,
+         ft.Prediction(prediction=0.0, probability=[0.8, 0.2]).value])
+    assert col.prediction.tolist() == [1.0, 0.0]
+    assert col.probability.shape == (2, 2)
+    raw = col.get_raw(0)
+    assert raw["prediction"] == 1.0 and raw["probability_1"] == 0.7
+
+
+def test_vector_column():
+    col = column_from_values(ft.OPVector, [[1.0, 2.0], [3.0, 4.0]])
+    assert col.width == 2
+    with pytest.raises(ValueError):
+        column_from_values(ft.OPVector, [[1.0], [1.0, 2.0]])
+
+
+def test_store_ops():
+    store = ColumnStore.from_dict({
+        "age": (ft.Real, [20.0, None, 40.0]),
+        "name": (ft.Text, ["a", "b", "c"]),
+    })
+    assert store.n_rows == 3
+    assert set(store.names()) == {"age", "name"}
+    sub = store.filter_mask(np.array([True, False, True]))
+    assert sub.n_rows == 2
+    assert sub["age"].to_list() == [20.0, 40.0]
+    assert store.row(0) == {"age": 20.0, "name": "a"}
+    sel = store.select(["age"]).drop([])
+    assert sel.names() == ["age"]
+    with pytest.raises(ValueError):
+        store.with_column("bad", column_from_values(ft.Real, [1.0]))

@@ -1,0 +1,159 @@
+"""Feature graph + stage abstraction tests (FeatureLikeTest analog)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, column_from_values
+from transmogrifai_tpu.columns import NumericColumn
+from transmogrifai_tpu.graph import compute_dag
+from transmogrifai_tpu.stages.base import (Estimator, FittedModel, FixedArity,
+                                           LambdaTransformer, Transformer)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def _add_transformer(name="plus"):
+    def fn(a, b):
+        mask = a.mask & b.mask
+        return NumericColumn(ft.Real, np.where(mask, a.values + b.values, 0.0), mask)
+    return LambdaTransformer(name, fn, [ft.Real, ft.Real], ft.Real)
+
+
+def test_feature_builder_and_raw_features():
+    age = FeatureBuilder.Real("age").extract(lambda r: r["age"]).as_predictor()
+    assert age.name == "age" and age.is_raw and not age.is_response
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    assert label.is_response and label.ftype is ft.RealNN
+
+
+def test_transform_with_builds_dag():
+    a = FeatureBuilder.Real("a").from_column().as_predictor()
+    b = FeatureBuilder.Real("b").from_column().as_predictor()
+    c = a.transform_with(_add_transformer(), b)
+    assert c.parents == (a, b)
+    assert c.ftype is ft.Real
+    assert not c.is_response
+    assert {f.name for f in c.raw_features()} == {"a", "b"}
+    d = c.transform_with(_add_transformer(), a)
+    layers = compute_dag([d])
+    assert len(layers) == 2  # two stage layers deepest-first
+    assert layers[0][0].get_output() is c
+
+
+def test_input_type_checking():
+    a = FeatureBuilder.Real("a").from_column().as_predictor()
+    t = FeatureBuilder.Text("t").from_column().as_predictor()
+    stage = _add_transformer()
+    with pytest.raises(TypeError):
+        stage.set_input(a, t)  # Text is not Real
+    with pytest.raises(TypeError):
+        stage.set_input(a)  # arity
+
+
+def test_label_leak_gate():
+    a = FeatureBuilder.Real("a").from_column().as_predictor()
+    y = FeatureBuilder.RealNN("y").from_column().as_response()
+    with pytest.raises(TypeError):
+        _add_transformer().set_input(a, y)  # mixing label without AllowLabelAsInput
+
+
+def test_response_propagation():
+    y1 = FeatureBuilder.RealNN("y1").from_column().as_response()
+    y2 = FeatureBuilder.RealNN("y2").from_column().as_response()
+    out = y1.transform_with(_add_transformer(), y2)
+    assert out.is_response  # all inputs are responses
+
+
+def test_transform_columns_and_row_agree():
+    a = FeatureBuilder.Real("a").from_column().as_predictor()
+    b = FeatureBuilder.Real("b").from_column().as_predictor()
+    stage = _add_transformer()
+    c = a.transform_with(stage, b)
+    store = ColumnStore.from_dict({
+        "a": (ft.Real, [1.0, 2.0, None]),
+        "b": (ft.Real, [10.0, 20.0, 30.0]),
+    })
+    out = stage.transform_columns(store)
+    assert out.to_list() == [11.0, 22.0, None]
+    assert stage.transform_row({"a": 2.0, "b": 3.0}) == 5.0
+    assert stage.transform_row({"a": None, "b": 3.0}) is None
+
+
+def test_cycle_detection():
+    a = FeatureBuilder.Real("a").from_column().as_predictor()
+    b = FeatureBuilder.Real("b").from_column().as_predictor()
+    stage = _add_transformer()
+    c = a.transform_with(stage, b)
+    # force a cycle: make c a parent of its own ancestor
+    object.__setattr__ if False else None
+    a.parents = (c,)  # type: ignore[misc]
+    from transmogrifai_tpu.features import FeatureCycleError
+    with pytest.raises(FeatureCycleError):
+        c.parent_stages()
+
+
+class _MeanImputeEstimator(Estimator):
+    operation_name = "meanImpute"
+    output_type = ft.RealNN
+
+    @property
+    def input_spec(self):
+        return FixedArity(ft.Real)
+
+    def fit_columns(self, store):
+        col = store[self.input_features[0].name]
+        mean = float(col.values[col.mask].mean()) if col.mask.any() else 0.0
+        return _MeanImputeModel(mean=mean)
+
+
+class _MeanImputeModel(FittedModel):
+    operation_name = "meanImpute"
+    output_type = ft.RealNN
+
+    def __init__(self, mean=0.0, uid=None):
+        super().__init__(uid=uid)
+        self.mean = mean
+
+    @property
+    def input_spec(self):
+        return FixedArity(ft.Real)
+
+    def transform_columns(self, store):
+        col = store[self.input_features[0].name]
+        vals = np.where(col.mask, col.values, self.mean)
+        return NumericColumn(ft.RealNN, vals, np.ones_like(col.mask))
+
+    def get_model_state(self):
+        return {"mean": self.mean}
+
+
+def test_estimator_fit_swaps_model():
+    a = FeatureBuilder.Real("a").from_column().as_predictor()
+    est = _MeanImputeEstimator()
+    out = a.transform_with(est)
+    store = ColumnStore.from_dict({"a": (ft.Real, [1.0, None, 3.0])})
+    model = est.fit(store)
+    assert model.uid == est.uid
+    assert model.get_output() is out
+    assert model.mean == 2.0
+    assert model.transform_columns(store).to_list() == [1.0, 2.0, 3.0]
+    assert model.transform_row({"a": None}) == 2.0
+
+
+def test_stage_copy_and_params():
+    est = _MeanImputeEstimator()
+    m = _MeanImputeModel(mean=5.0)
+    assert m.get_params()["mean"] == 5.0
+    m2 = m.copy()
+    assert m2.uid == m.uid and m2.mean == 5.0
+    m.set_params(mean=7.0)
+    assert m.mean == 7.0
+
+
+def test_from_store_inference():
+    store = ColumnStore.from_dict({
+        "y": (ft.RealNN, [1.0, 0.0]),
+        "x1": (ft.Real, [1.0, 2.0]),
+        "t": (ft.Text, ["a", "b"]),
+    })
+    resp, preds = FeatureBuilder.from_store(store, "y")
+    assert resp.is_response and resp.ftype is ft.RealNN
+    assert {p.name: p.ftype for p in preds} == {"x1": ft.Real, "t": ft.Text}

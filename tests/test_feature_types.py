@@ -1,0 +1,124 @@
+"""Feature type system tests (parity with features/.../types tests)."""
+import math
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def test_registry_has_45_types():
+    assert len(ft.FEATURE_TYPE_REGISTRY) == 52
+    assert ft.feature_type_by_name("RealNN") is ft.RealNN
+    with pytest.raises(ft.FeatureTypeError):
+        ft.feature_type_by_name("NotAType")
+
+
+def test_real_nullability():
+    assert ft.Real(None).is_empty
+    assert ft.Real(float("nan")).is_empty
+    assert ft.Real(3).value == 3.0
+    assert ft.Real(True).value == 1.0
+    with pytest.raises(ft.FeatureTypeError):
+        ft.RealNN(None)
+    assert ft.RealNN(1.5).value == 1.5
+
+
+def test_integral_and_binary():
+    assert ft.Integral("7").value == 7
+    assert ft.Integral(None).is_empty
+    assert ft.Binary("true").value is True
+    assert ft.Binary(0).value is False
+    assert ft.Binary(None).to_double() is None
+    assert ft.Binary(True).to_double() == 1.0
+    with pytest.raises(ft.FeatureTypeError):
+        ft.Binary("maybe")
+
+
+def test_equality_is_on_value_and_type():
+    assert ft.Real(1.0) == ft.Real(1.0)
+    assert ft.Real(1.0) != ft.Currency(1.0)  # distinct types
+    assert ft.Text("a") == ft.Text("a")
+    assert hash(ft.Real(2.0)) == hash(ft.Real(2.0))
+
+
+def test_subtyping_mirrors_reference():
+    assert ft.is_subtype(ft.RealNN, ft.Real)
+    assert ft.is_subtype(ft.Currency, ft.Real)
+    assert ft.is_subtype(ft.DateTime, ft.Date)
+    assert ft.is_subtype(ft.Date, ft.Integral)
+    assert ft.is_subtype(ft.Email, ft.Text)
+    assert not ft.is_subtype(ft.Real, ft.RealNN)
+    assert ft.Binary.is_categorical()
+    assert ft.PickList.is_categorical()
+    assert ft.Country.is_location()
+
+
+def test_email_parsing():
+    e = ft.Email("bob@example.com")
+    assert e.prefix == "bob"
+    assert e.domain == "example.com"
+    assert ft.Email("nonsense").prefix is None
+    assert ft.Email(None).prefix is None
+
+
+def test_url_validation():
+    assert ft.URL("http://example.com/x").is_valid()
+    assert ft.URL("https://example.com").domain == "example.com"
+    assert not ft.URL("gopher://old.net").is_valid()
+    assert not ft.URL("not a url").is_valid()
+
+
+def test_vector():
+    v = ft.OPVector([1.0, 2.0])
+    assert v.value.tolist() == [1.0, 2.0]
+    assert v.combine(ft.OPVector([3.0])).value.tolist() == [1.0, 2.0, 3.0]
+    assert ft.OPVector(None).is_empty
+    with pytest.raises(ft.FeatureTypeError):
+        ft.OPVector([[1.0], [2.0]])
+
+
+def test_geolocation():
+    g = ft.Geolocation([37.77, -122.42, 5.0])
+    assert g.lat == 37.77 and g.lon == -122.42 and g.accuracy == 5.0
+    sphere = g.to_unit_sphere()
+    assert abs(np.linalg.norm(sphere) - 1.0) < 1e-9
+    assert ft.Geolocation(None).is_empty
+    with pytest.raises(ft.FeatureTypeError):
+        ft.Geolocation([100.0, 0.0, 1.0])  # lat out of range
+    with pytest.raises(ft.FeatureTypeError):
+        ft.Geolocation([1.0, 2.0])  # wrong arity
+
+
+def test_sets_and_lists():
+    s = ft.MultiPickList(["a", "b", "a"])
+    assert s.value == {"a", "b"}
+    tl = ft.TextList(["x", "y"])
+    assert tl.value == ["x", "y"]
+    dl = ft.DateList([1, 2])
+    assert dl.value == [1, 2]
+    assert ft.MultiPickList(None).is_empty
+
+
+def test_maps():
+    m = ft.RealMap({"a": 1, "b": None})
+    assert m.value == {"a": 1.0, "b": None}
+    tm = ft.TextMap({"k": "v"})
+    assert tm.value == {"k": "v"}
+    gm = ft.GeolocationMap({"home": [1.0, 2.0, 3.0]})
+    assert gm.value["home"] == [1.0, 2.0, 3.0]
+    assert ft.BinaryMap({"x": 1}).value == {"x": True}
+    assert ft.MultiPickListMap({"x": ["a", "a"]}).value == {"x": {"a"}}
+    assert ft.TextMap.element_type is ft.Text
+
+
+def test_prediction():
+    p = ft.Prediction(prediction=1.0, raw_prediction=[0.2, 0.8],
+                      probability=[0.3, 0.7])
+    assert p.prediction == 1.0
+    assert p.raw_prediction == [0.2, 0.8]
+    assert p.probability == [0.3, 0.7]
+    with pytest.raises(ft.FeatureTypeError):
+        ft.Prediction({"probability_0": 0.3})  # missing prediction key
+    with pytest.raises(ft.FeatureTypeError):
+        ft.Prediction({"prediction": 1.0, "bogus": 2.0})

@@ -1,0 +1,16 @@
+"""TransmogrifAI-TPU: a TPU-native AutoML framework for structured data.
+
+A ground-up re-design of Salesforce TransmogrifAI's capabilities
+(type-safe feature graph, automated feature engineering, sanity checking,
+k-fold × grid model selection, model insights, portable serving) on
+JAX/XLA: feature pipelines compile layer-by-layer into fused XLA
+computations over sharded device arrays, and the model-selection grid
+``vmap``s/``shard_map``s across the TPU mesh.
+"""
+
+__version__ = "0.1.0"
+
+from . import types  # noqa: F401
+from .columns import Column, ColumnStore, column_from_values  # noqa: F401
+from .features import Feature, FeatureBuilder  # noqa: F401
+from .vector_metadata import VectorColumnMetadata, VectorMetadata  # noqa: F401

@@ -1,0 +1,458 @@
+"""Columnar data layer — the TPU-native replacement for Spark DataFrames.
+
+The reference carries rows of boxed feature values through Spark
+(``readers/.../DataReader.scala:173-197`` builds ``Row``s per record). On TPU
+that is exactly wrong: XLA wants dense, statically-shaped arrays. So the
+framework's in-memory dataset is a :class:`ColumnStore` — a dict of named
+:class:`Column` objects, each a struct of dense host numpy arrays (values +
+validity mask) or, for strings, host object arrays that only ever reach the
+device after hashing/indexing.
+
+Device transfer happens at jit boundaries in the workflow runtime; columns
+here stay numpy so readers/aggregation/tokenization run on host at full
+speed without device round-trips.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Set, Tuple, Type
+
+import numpy as np
+
+from .types import feature_types as ft
+from .types.feature_types import ColumnKind, FeatureType
+
+__all__ = [
+    "Column", "NumericColumn", "TextColumn", "TextListColumn", "TextSetColumn",
+    "RaggedColumn", "GeoColumn", "VectorColumn", "MapColumn", "PredictionColumn",
+    "ColumnStore", "column_from_values", "column_of_empty",
+]
+
+
+_KIND_TO_DTYPE = {
+    ColumnKind.REAL: np.float64,
+    ColumnKind.INTEGRAL: np.int64,
+    ColumnKind.BINARY: np.bool_,
+}
+
+
+class Column:
+    """Abstract column: ``n_rows`` values of one feature type."""
+
+    ftype: Type[FeatureType]
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def get_boxed(self, i: int) -> FeatureType:
+        """Boxed value at row i (slow path: tests/serving only)."""
+        return self.ftype(self.get_raw(i))
+
+    def get_raw(self, i: int) -> Any:
+        raise NotImplementedError
+
+    def to_list(self) -> List[Any]:
+        return [self.get_raw(i) for i in range(len(self))]
+
+    def take(self, indices: np.ndarray) -> "Column":
+        raise NotImplementedError
+
+
+@dataclass
+class NumericColumn(Column):
+    """Scalar numerics: dense values + validity mask.
+
+    ``values`` is f64/i64/bool [n]; ``mask`` is bool[n], True = present.
+    Missing slots hold 0 — compute must always combine with the mask.
+    """
+
+    ftype: Type[FeatureType]
+    values: np.ndarray
+    mask: np.ndarray
+
+    def __post_init__(self):
+        assert self.values.shape == self.mask.shape, (self.values.shape, self.mask.shape)
+
+    def __len__(self) -> int:
+        return self.values.shape[0]
+
+    def get_raw(self, i: int):
+        if not self.mask[i]:
+            return None
+        v = self.values[i]
+        return v.item() if isinstance(v, np.generic) else v
+
+    def take(self, indices: np.ndarray) -> "NumericColumn":
+        return NumericColumn(self.ftype, self.values[indices], self.mask[indices])
+
+    def astype_float(self) -> np.ndarray:
+        return self.values.astype(np.float64)
+
+
+@dataclass
+class TextColumn(Column):
+    """Host strings: object[n] of Optional[str]. Never shipped to device raw."""
+
+    ftype: Type[FeatureType]
+    values: np.ndarray  # dtype=object
+
+    def __len__(self) -> int:
+        return self.values.shape[0]
+
+    def get_raw(self, i: int):
+        return self.values[i]
+
+    def take(self, indices: np.ndarray) -> "TextColumn":
+        return TextColumn(self.ftype, self.values[indices])
+
+    @property
+    def mask(self) -> np.ndarray:
+        return np.array([v is not None for v in self.values], dtype=bool)
+
+
+@dataclass
+class TextListColumn(Column):
+    ftype: Type[FeatureType]
+    values: List[List[str]]
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def get_raw(self, i: int):
+        return self.values[i]
+
+    def take(self, indices: np.ndarray) -> "TextListColumn":
+        return TextListColumn(self.ftype, [self.values[int(i)] for i in indices])
+
+
+@dataclass
+class TextSetColumn(Column):
+    ftype: Type[FeatureType]
+    values: List[Set[str]]
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def get_raw(self, i: int):
+        return self.values[i]
+
+    def take(self, indices: np.ndarray) -> "TextSetColumn":
+        return TextSetColumn(self.ftype, [self.values[int(i)] for i in indices])
+
+
+@dataclass
+class RaggedColumn(Column):
+    """Ragged numeric lists in CSR layout: flat values + row offsets.
+
+    offsets has n+1 entries; row i is flat[offsets[i]:offsets[i+1]].
+    This is the device-friendly encoding of DateList / DateTimeList.
+    """
+
+    ftype: Type[FeatureType]
+    flat: np.ndarray
+    offsets: np.ndarray  # i64[n + 1]
+
+    def __len__(self) -> int:
+        return self.offsets.shape[0] - 1
+
+    def get_raw(self, i: int):
+        return self.flat[self.offsets[i]:self.offsets[i + 1]].tolist()
+
+    def take(self, indices: np.ndarray) -> "RaggedColumn":
+        rows = [self.flat[self.offsets[int(i)]:self.offsets[int(i) + 1]] for i in indices]
+        lengths = np.array([len(r) for r in rows], dtype=np.int64)
+        offsets = np.concatenate([[0], np.cumsum(lengths)])
+        flat = np.concatenate(rows) if rows else np.zeros((0,), self.flat.dtype)
+        return RaggedColumn(self.ftype, flat, offsets)
+
+
+@dataclass
+class GeoColumn(Column):
+    """Geolocation: f64[n, 3] (lat, lon, accuracy) + mask."""
+
+    ftype: Type[FeatureType]
+    values: np.ndarray  # f64[n, 3]
+    mask: np.ndarray    # bool[n]
+
+    def __len__(self) -> int:
+        return self.values.shape[0]
+
+    def get_raw(self, i: int):
+        return self.values[i].tolist() if self.mask[i] else []
+
+    def take(self, indices: np.ndarray) -> "GeoColumn":
+        return GeoColumn(self.ftype, self.values[indices], self.mask[indices])
+
+
+@dataclass
+class VectorColumn(Column):
+    """Dense feature matrix f64[n, d] + per-column provenance metadata.
+
+    ``metadata`` is an ``OpVectorMetadata`` (see vector_metadata.py) — the
+    contract consumed by SanityChecker and ModelInsights.
+    """
+
+    ftype: Type[FeatureType]
+    values: np.ndarray  # f64[n, d]
+    metadata: Any = None  # OpVectorMetadata | None
+
+    def __len__(self) -> int:
+        return self.values.shape[0]
+
+    @property
+    def width(self) -> int:
+        return self.values.shape[1]
+
+    def get_raw(self, i: int):
+        return self.values[i]
+
+    def take(self, indices: np.ndarray) -> "VectorColumn":
+        return VectorColumn(self.ftype, self.values[indices], self.metadata)
+
+
+@dataclass
+class MapColumn(Column):
+    """String-keyed map column: struct of per-key subcolumns.
+
+    The key set is discovered from the data (host side); each key's values
+    form a child column of the map's element kind. This is the columnar
+    answer to the reference's 23 ``OPMap`` types.
+    """
+
+    ftype: Type[FeatureType]
+    children: Dict[str, Column]
+    n_rows: int
+
+    def __len__(self) -> int:
+        return self.n_rows
+
+    def get_raw(self, i: int):
+        out = {}
+        for k, child in self.children.items():
+            v = child.get_raw(i)
+            if v is None:
+                continue
+            if isinstance(v, (list, set)) and len(v) == 0:
+                continue
+            out[k] = v
+        return out
+
+    def take(self, indices: np.ndarray) -> "MapColumn":
+        return MapColumn(self.ftype,
+                         {k: c.take(indices) for k, c in self.children.items()},
+                         int(len(indices)))
+
+
+@dataclass
+class PredictionColumn(Column):
+    """Model output struct-of-arrays: prediction f64[n], raw/prob f64[n, k]."""
+
+    prediction: np.ndarray       # f64[n]
+    raw_prediction: np.ndarray   # f64[n, k] (k may be 0)
+    probability: np.ndarray      # f64[n, k]
+    ftype: Type[FeatureType] = ft.Prediction
+
+    def __len__(self) -> int:
+        return self.prediction.shape[0]
+
+    def get_raw(self, i: int):
+        out = {ft.Prediction.PREDICTION_KEY: float(self.prediction[i])}
+        for j in range(self.raw_prediction.shape[1]):
+            out[f"{ft.Prediction.RAW_PREFIX}{j}"] = float(self.raw_prediction[i, j])
+        for j in range(self.probability.shape[1]):
+            out[f"{ft.Prediction.PROB_PREFIX}{j}"] = float(self.probability[i, j])
+        return out
+
+    def take(self, indices: np.ndarray) -> "PredictionColumn":
+        return PredictionColumn(self.prediction[indices],
+                                self.raw_prediction[indices],
+                                self.probability[indices])
+
+
+# ---------------------------------------------------------------------------
+# Construction from boxed / python values
+# ---------------------------------------------------------------------------
+
+def column_from_values(ftype: Type[FeatureType], values: Sequence[Any]) -> Column:
+    """Build a column from raw python values (None = missing).
+
+    Values may be raw payloads or boxed ``FeatureType`` instances.
+    """
+    unboxed = [v.value if isinstance(v, FeatureType) else v for v in values]
+    kind = ftype.column_kind
+    n = len(unboxed)
+
+    if kind in (ColumnKind.REAL, ColumnKind.INTEGRAL, ColumnKind.BINARY):
+        dtype = _KIND_TO_DTYPE[kind]
+        vals = np.zeros((n,), dtype=dtype)
+        mask = np.zeros((n,), dtype=bool)
+        for i, v in enumerate(unboxed):
+            bv = ftype._convert(v)
+            if bv is not None:
+                vals[i] = bv
+                mask[i] = True
+        return NumericColumn(ftype, vals, mask)
+
+    if kind == ColumnKind.TEXT:
+        arr = np.empty((n,), dtype=object)
+        for i, v in enumerate(unboxed):
+            arr[i] = ftype._convert(v)
+        return TextColumn(ftype, arr)
+
+    if kind == ColumnKind.TEXT_LIST:
+        return TextListColumn(ftype, [ftype._convert(v) for v in unboxed])
+
+    if kind == ColumnKind.TEXT_SET:
+        return TextSetColumn(ftype, [ftype._convert(v) for v in unboxed])
+
+    if kind == ColumnKind.INTEGRAL_LIST:
+        rows = [ftype._convert(v) for v in unboxed]
+        lengths = np.array([len(r) for r in rows], dtype=np.int64)
+        offsets = np.concatenate([[0], np.cumsum(lengths)])
+        flat = (np.concatenate([np.asarray(r, dtype=np.int64) for r in rows])
+                if any(lengths) else np.zeros((0,), np.int64))
+        return RaggedColumn(ftype, flat, offsets)
+
+    if kind == ColumnKind.GEO:
+        vals = np.zeros((n, 3), dtype=np.float64)
+        mask = np.zeros((n,), dtype=bool)
+        for i, v in enumerate(unboxed):
+            gv = ftype._convert(v)
+            if gv:
+                vals[i] = gv
+                mask[i] = True
+        return GeoColumn(ftype, vals, mask)
+
+    if kind == ColumnKind.VECTOR:
+        rows = [ftype._convert(v) for v in unboxed]
+        widths = {r.shape[0] for r in rows}
+        if len(widths) > 1:
+            raise ValueError(f"OPVector column with ragged widths {widths}")
+        return VectorColumn(ftype, np.stack(rows) if rows else np.zeros((0, 0)))
+
+    if kind == ColumnKind.PREDICTION:
+        preds = np.zeros((n,), dtype=np.float64)
+        raw_rows, prob_rows = [], []
+        for i, v in enumerate(unboxed):
+            p = v if isinstance(v, ft.Prediction) else ft.Prediction(v)
+            preds[i] = p.prediction
+            raw_rows.append(p.raw_prediction)
+            prob_rows.append(p.probability)
+        k_raw = max((len(r) for r in raw_rows), default=0)
+        k_prob = max((len(r) for r in prob_rows), default=0)
+        raw = np.zeros((n, k_raw))
+        prob = np.zeros((n, k_prob))
+        for i in range(n):
+            raw[i, :len(raw_rows[i])] = raw_rows[i]
+            prob[i, :len(prob_rows[i])] = prob_rows[i]
+        return PredictionColumn(preds, raw, prob)
+
+    if kind == ColumnKind.MAP:
+        elem_kind = ftype.map_element_kind
+        dicts = [ftype._convert(v) for v in unboxed]
+        keys = sorted({k for d in dicts for k in d})
+        children: Dict[str, Column] = {}
+        elem_ftype = ftype.element_type
+        for k in keys:
+            children[k] = column_from_values(
+                elem_ftype, [d.get(k) for d in dicts])
+        return MapColumn(ftype, children, n)
+
+    raise NotImplementedError(f"column kind {kind}")
+
+
+def column_of_empty(ftype: Type[FeatureType], n: int) -> Column:
+    return column_from_values(ftype, [None] * n)
+
+
+# ---------------------------------------------------------------------------
+# ColumnStore — the "DataFrame"
+# ---------------------------------------------------------------------------
+
+class ColumnStore:
+    """Named columns with a shared row count. The framework's dataset object."""
+
+    def __init__(self, columns: Optional[Mapping[str, Column]] = None,
+                 n_rows: Optional[int] = None):
+        self._columns: Dict[str, Column] = dict(columns or {})
+        if n_rows is None:
+            lengths = {len(c) for c in self._columns.values()}
+            if len(lengths) > 1:
+                raise ValueError(f"Mismatched column lengths: {lengths}")
+            n_rows = lengths.pop() if lengths else 0
+        self.n_rows = n_rows
+        for name, c in self._columns.items():
+            if len(c) != self.n_rows:
+                raise ValueError(
+                    f"Column {name!r} has {len(c)} rows, expected {self.n_rows}")
+
+    # -- dict-ish API ------------------------------------------------------
+    def __contains__(self, name: str) -> bool:
+        return name in self._columns
+
+    def __getitem__(self, name: str) -> Column:
+        return self._columns[name]
+
+    def get(self, name: str) -> Optional[Column]:
+        return self._columns.get(name)
+
+    def names(self) -> List[str]:
+        return list(self._columns)
+
+    def items(self):
+        return self._columns.items()
+
+    def __len__(self) -> int:
+        return self.n_rows
+
+    # -- functional updates ------------------------------------------------
+    def with_column(self, name: str, column: Column) -> "ColumnStore":
+        if len(column) != self.n_rows and self._columns:
+            raise ValueError(
+                f"Column {name!r} has {len(column)} rows, store has {self.n_rows}")
+        cols = dict(self._columns)
+        cols[name] = column
+        return ColumnStore(cols, self.n_rows if self._columns else len(column))
+
+    def with_columns(self, new: Mapping[str, Column]) -> "ColumnStore":
+        store = self
+        for k, v in new.items():
+            store = store.with_column(k, v)
+        return store
+
+    def select(self, names: Iterable[str]) -> "ColumnStore":
+        return ColumnStore({n: self._columns[n] for n in names}, self.n_rows)
+
+    def drop(self, names: Iterable[str]) -> "ColumnStore":
+        dropset = set(names)
+        return ColumnStore(
+            {n: c for n, c in self._columns.items() if n not in dropset},
+            self.n_rows)
+
+    def take(self, indices: np.ndarray) -> "ColumnStore":
+        indices = np.asarray(indices)
+        return ColumnStore({n: c.take(indices) for n, c in self._columns.items()},
+                          int(indices.shape[0]))
+
+    def filter_mask(self, mask: np.ndarray) -> "ColumnStore":
+        return self.take(np.nonzero(np.asarray(mask))[0])
+
+    # -- row access (slow path: serving/tests) -----------------------------
+    def row(self, i: int) -> Dict[str, Any]:
+        return {n: c.get_raw(i) for n, c in self._columns.items()}
+
+    def rows(self) -> List[Dict[str, Any]]:
+        return [self.row(i) for i in range(self.n_rows)]
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def from_dict(data: Mapping[str, Tuple[Type[FeatureType], Sequence[Any]]]
+                  ) -> "ColumnStore":
+        """Build from {name: (ftype, values)}."""
+        cols = {name: column_from_values(ftype, values)
+                for name, (ftype, values) in data.items()}
+        return ColumnStore(cols)
+
+    def __repr__(self) -> str:
+        cols = ", ".join(f"{n}: {c.ftype.__name__}" for n, c in self._columns.items())
+        return f"ColumnStore(n_rows={self.n_rows}, [{cols}])"

@@ -1,0 +1,260 @@
+"""Feature DAG nodes + FeatureBuilder.
+
+Re-designs ``FeatureLike``/``Feature``/``FeatureBuilder``
+(``features/.../FeatureLike.scala:48-466``, ``Feature.scala``,
+``FeatureBuilder.scala:47-341``) as plain Python objects:
+
+* A :class:`Feature` is a symbolic node — ``name``, ``uid``, feature type,
+  ``is_response``, ``origin_stage``, ``parents``. Raw features have a
+  :class:`FeatureGeneratorStage`-like origin with an ``extract_fn``;
+  derived features point at the transformer/estimator that computes them.
+* ``transform_with`` (FeatureLike.scala:210-279) wires a stage to inputs and
+  returns its output feature.
+* ``parent_stages`` (FeatureLike.scala:363-425) is a DFS + Kahn toposort to
+  stage → max-distance, with cycle detection (FeatureCycleException :405).
+"""
+from __future__ import annotations
+
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple, Type,
+                    TYPE_CHECKING)
+
+from .types.feature_types import FeatureType, FeatureTypeError
+from .utils import uid as uid_mod
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .stages.base import OpPipelineStage
+
+__all__ = ["Feature", "FeatureCycleError", "FeatureBuilder", "FeatureHistory"]
+
+
+class FeatureCycleError(Exception):
+    """Cycle detected in the feature DAG (FeatureLike.scala:405)."""
+
+
+class FeatureHistory:
+    """Origin raw features + stage lineage (utils/.../FeatureHistory.scala)."""
+
+    def __init__(self, origin_features: Sequence[str], stages: Sequence[str]):
+        self.origin_features = list(origin_features)
+        self.stages = list(stages)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"originFeatures": self.origin_features, "stages": self.stages}
+
+    def __repr__(self) -> str:
+        return f"FeatureHistory(origins={self.origin_features}, stages={self.stages})"
+
+
+class Feature:
+    """Symbolic DAG node typed by a FeatureType subclass."""
+
+    __slots__ = ("name", "ftype", "is_response", "origin_stage", "parents",
+                 "uid", "is_raw")
+
+    def __init__(self, name: str, ftype: Type[FeatureType], is_response: bool,
+                 origin_stage: Optional["OpPipelineStage"],
+                 parents: Sequence["Feature"] = (),
+                 uid: Optional[str] = None):
+        self.name = name
+        self.ftype = ftype
+        self.is_response = is_response
+        self.origin_stage = origin_stage
+        self.parents: Tuple[Feature, ...] = tuple(parents)
+        self.uid = uid or uid_mod.make_uid("Feature")
+        self.is_raw = origin_stage is None or len(self.parents) == 0
+
+    # -- graph wiring ------------------------------------------------------
+    def transform_with(self, stage: "OpPipelineStage",
+                       *others: "Feature") -> "Feature":
+        """Set (self, *others) as the stage's inputs; return its output feature."""
+        stage.set_input(self, *others)
+        return stage.get_output()
+
+    # -- traversal ---------------------------------------------------------
+    def traverse(self, visit: Callable[["Feature"], None]) -> None:
+        """Depth-first traversal over ancestors, self first."""
+        seen = set()
+        stack: List[Feature] = [self]
+        while stack:
+            f = stack.pop()
+            if f.uid in seen:
+                continue
+            seen.add(f.uid)
+            visit(f)
+            stack.extend(f.parents)
+
+    def raw_features(self) -> List["Feature"]:
+        out: List[Feature] = []
+
+        def visit(f: Feature) -> None:
+            if f.is_raw:
+                out.append(f)
+
+        self.traverse(visit)
+        return sorted(out, key=lambda f: f.name)
+
+    def parent_stages(self) -> Dict["OpPipelineStage", int]:
+        """All ancestor stages mapped to max distance from this feature.
+
+        Distance = longest path in stage hops; used for DAG layering
+        (FitStagesUtil.computeDAG). Raises FeatureCycleError on cycles.
+        """
+        dist: Dict[str, int] = {}
+        stages: Dict[str, "OpPipelineStage"] = {}
+        on_path: set = set()
+        done: set = set()
+
+        def visit(f: Feature, d: int) -> None:
+            if f.uid in on_path:
+                raise FeatureCycleError(
+                    f"Cycle detected in feature graph at {f.name!r}")
+            st = f.origin_stage
+            if st is None:
+                return
+            key = st.uid
+            stages[key] = st
+            if dist.get(key, -1) < d:
+                dist[key] = d
+            elif f.uid in done:
+                return
+            on_path.add(f.uid)
+            for p in f.parents:
+                visit(p, d + 1)
+            on_path.discard(f.uid)
+            done.add(f.uid)
+
+        visit(self, 0)
+        return {stages[k]: v for k, v in dist.items()}
+
+    def history(self) -> FeatureHistory:
+        origins = [f.name for f in self.raw_features() if f is not self]
+        stage_names = sorted(
+            {s.stage_name() for s in self.parent_stages()
+             if not getattr(s, "is_raw_generator", False)})
+        return FeatureHistory(origins, stage_names)
+
+    # -- identity ----------------------------------------------------------
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Feature) and other.uid == self.uid
+
+    def __hash__(self) -> int:
+        return hash(self.uid)
+
+    def __repr__(self) -> str:
+        kind = "response" if self.is_response else "predictor"
+        return (f"Feature({self.name!r}, {self.ftype.__name__}, {kind}, "
+                f"uid={self.uid})")
+
+
+class _FeatureBuilderWithExtract:
+    """Second step of FeatureBuilder: has an extract function, can set
+    aggregation, then finalize as predictor/response
+    (FeatureBuilder.scala:268-341)."""
+
+    def __init__(self, name: str, ftype: Type[FeatureType],
+                 extract_fn: Callable[[Any], Any],
+                 extract_source: Optional[str] = None):
+        self.name = name
+        self.ftype = ftype
+        self.extract_fn = extract_fn
+        self.extract_source = extract_source
+        self.aggregator = None
+        self.window_ms: Optional[int] = None
+
+    def aggregate(self, aggregator) -> "_FeatureBuilderWithExtract":
+        """Attach a monoid aggregator for event-grouped readers."""
+        self.aggregator = aggregator
+        return self
+
+    def window(self, window_ms: int) -> "_FeatureBuilderWithExtract":
+        self.window_ms = int(window_ms)
+        return self
+
+    def _build(self, is_response: bool) -> Feature:
+        from .stages.generator import FeatureGeneratorStage
+        stage = FeatureGeneratorStage(
+            name=self.name, ftype=self.ftype, extract_fn=self.extract_fn,
+            is_response=is_response, aggregator=self.aggregator,
+            window_ms=self.window_ms, extract_source=self.extract_source)
+        return stage.get_output()
+
+    def as_predictor(self) -> Feature:
+        return self._build(is_response=False)
+
+    def as_response(self) -> Feature:
+        return self._build(is_response=True)
+
+
+class _FeatureBuilderOfType:
+    def __init__(self, name: str, ftype: Type[FeatureType]):
+        self.name = name
+        self.ftype = ftype
+
+    def extract(self, fn: Callable[[Any], Any],
+                source: Optional[str] = None) -> _FeatureBuilderWithExtract:
+        """Attach a record → value extractor.
+
+        The reference captures the source text with a macro
+        (FeatureBuilderMacros.scala); here pass ``source`` explicitly or we
+        best-effort introspect the function.
+        """
+        if source is None:
+            source = getattr(fn, "__name__", None)
+            if source == "<lambda>":
+                source = None
+        return _FeatureBuilderWithExtract(self.name, self.ftype, fn, source)
+
+    def from_column(self) -> _FeatureBuilderWithExtract:
+        """Extract by record key == feature name (dict-record readers)."""
+        name = self.name
+        return _FeatureBuilderWithExtract(
+            name, self.ftype, lambda rec: rec.get(name), f"record[{name!r}]")
+
+
+class _FeatureBuilderMeta(type):
+    """``FeatureBuilder.Real["age"]`` / ``FeatureBuilder.of(Real, "age")``."""
+
+    def __getattr__(cls, type_name: str):
+        from .types.feature_types import FEATURE_TYPE_REGISTRY
+        if type_name in FEATURE_TYPE_REGISTRY:
+            ftype = FEATURE_TYPE_REGISTRY[type_name]
+            return lambda name: _FeatureBuilderOfType(name, ftype)
+        raise AttributeError(type_name)
+
+
+class FeatureBuilder(metaclass=_FeatureBuilderMeta):
+    """Entry point for declaring raw features (FeatureBuilder.scala:47).
+
+    Usage::
+
+        age = FeatureBuilder.Real("age").extract(lambda r: r["age"]).as_predictor()
+        survived = FeatureBuilder.RealNN("survived").from_column().as_response()
+    """
+
+    @staticmethod
+    def of(ftype: Type[FeatureType], name: str) -> _FeatureBuilderOfType:
+        return _FeatureBuilderOfType(name, ftype)
+
+    @staticmethod
+    def from_store(store, response: str,
+                   response_type: Type[FeatureType] = None,
+                   ignore: Sequence[str] = ()) -> Tuple[Feature, List[Feature]]:
+        """Infer raw features from an existing ColumnStore's column types
+        (FeatureBuilder.fromDataFrame, FeatureBuilder.scala:190-217).
+
+        Returns (response_feature, predictor_features).
+        """
+        from .types.feature_types import RealNN
+        response_type = response_type or RealNN
+        if response not in store:
+            raise ValueError(f"Response column {response!r} not in store")
+        resp = (FeatureBuilder.of(response_type, response)
+                .from_column().as_response())
+        predictors = []
+        skip = set(ignore) | {response}
+        for name, col in store.items():
+            if name in skip:
+                continue
+            predictors.append(
+                FeatureBuilder.of(col.ftype, name).from_column().as_predictor())
+        return resp, predictors

@@ -1,0 +1,58 @@
+"""DAG computation — layering stages for staged fit/transform.
+
+Mirrors ``FitStagesUtil.computeDAG`` (``core/.../utils/stages/FitStagesUtil.scala:173-198``):
+collect all ancestor stages of the result features, group them into layers by
+**max distance from the results** (deepest layer first), dedup stages that
+feed multiple results. Each layer's stages are independent given previous
+layers' outputs — the workflow runtime fits a layer's estimators together
+and fuses its transforms into one pass.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from .features import Feature
+from .stages.base import OpPipelineStage
+from .stages.generator import FeatureGeneratorStage
+
+__all__ = ["compute_dag", "StagesDAG"]
+
+StagesDAG = List[List[OpPipelineStage]]
+
+
+def compute_dag(result_features: Sequence[Feature],
+                include_generators: bool = False) -> StagesDAG:
+    """Layers of stages, deepest (closest to raw data) first."""
+    distances: Dict[str, int] = {}
+    stages: Dict[str, OpPipelineStage] = {}
+    for f in result_features:
+        for stage, d in f.parent_stages().items():
+            key = stage.uid
+            stages[key] = stage
+            if distances.get(key, -1) < d:
+                distances[key] = d
+
+    if not include_generators:
+        for key in [k for k, s in stages.items()
+                    if isinstance(s, FeatureGeneratorStage)]:
+            del stages[key]
+            del distances[key]
+
+    if not stages:
+        return []
+
+    max_d = max(distances.values())
+    layers: StagesDAG = [[] for _ in range(max_d + 1)]
+    # deepest first: distance max_d → layer 0
+    for key, stage in stages.items():
+        layers[max_d - distances[key]].append(stage)
+    # deterministic order within layer
+    for layer in layers:
+        layer.sort(key=lambda s: s.uid)
+    return [l for l in layers if l]
+
+
+def all_stages(result_features: Sequence[Feature],
+               include_generators: bool = False) -> List[OpPipelineStage]:
+    return [s for layer in compute_dag(result_features, include_generators)
+            for s in layer]

@@ -1,0 +1,4 @@
+from .base import (Estimator, FittedModel, FixedArity, InputSpec,  # noqa: F401
+                   LambdaTransformer, OpPipelineStage, Transformer, VarArity,
+                   AllowLabelAsInput, STAGE_REGISTRY, register_stage)
+from .generator import FeatureGeneratorStage  # noqa: F401

@@ -1,0 +1,322 @@
+"""Stage abstraction — transformers, estimators, fitted models.
+
+Re-designs ``OpPipelineStages.scala:56-553`` and the per-arity base classes
+(``features/.../stages/base/{unary,binary,ternary,quaternary,sequence}``)
+for columnar TPU execution:
+
+* A stage's bulk operation is **columnar**: ``transform_columns`` consumes a
+  :class:`~transmogrifai_tpu.columns.ColumnStore` and produces one output
+  Column. There is no per-row UDF path on the hot loop — row fusion is
+  achieved by the workflow runtime jitting each DAG layer's device work as
+  one XLA computation.
+* ``transform_row`` (the reference's ``OpTransformer.transformRow``,
+  ``features/.../stages/package.scala``) survives as the slow row-level API
+  for Spark-free local serving and contract tests; its default implementation
+  routes through a 1-row ColumnStore so columnar and row semantics can never
+  diverge.
+* Estimators ``fit`` on a ColumnStore and return a fitted model transformer
+  carrying device-ready state (numpy/jax arrays).
+* Arity typing (``OpPipelineStage1..4, N``) becomes an ``input_spec`` the
+  base class checks in ``set_input`` (the reference's ``transformSchema``
+  type check, OpPipelineStages.scala:113-142).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple, Type,
+                    Union)
+
+import numpy as np
+
+from ..columns import Column, ColumnStore, column_from_values
+from ..features import Feature
+from ..types.feature_types import FeatureType, OPVector, Prediction, RealNN
+from ..utils import uid as uid_mod
+
+__all__ = [
+    "InputSpec", "FixedArity", "VarArity", "OpPipelineStage", "Transformer",
+    "Estimator", "FittedModel", "LambdaTransformer", "AllowLabelAsInput",
+    "STAGE_REGISTRY", "register_stage",
+]
+
+
+STAGE_REGISTRY: Dict[str, type] = {}
+
+
+def register_stage(cls):
+    """Register a stage class for serialization lookup."""
+    STAGE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class InputSpec:
+    """Input arity/type contract for a stage."""
+
+    def check(self, features: Sequence[Feature]) -> None:
+        raise NotImplementedError
+
+
+class FixedArity(InputSpec):
+    """Exactly len(types) inputs, positionally typed (OpPipelineStage1..4)."""
+
+    def __init__(self, *types: Type[FeatureType]):
+        self.types = types
+
+    def check(self, features: Sequence[Feature]) -> None:
+        if len(features) != len(self.types):
+            raise TypeError(
+                f"Expected {len(self.types)} input features, got {len(features)}")
+        for i, (f, t) in enumerate(zip(features, self.types)):
+            if not issubclass(f.ftype, t):
+                raise TypeError(
+                    f"Input {i} ({f.name!r}) has type {f.ftype.__name__}, "
+                    f"expected {t.__name__}")
+
+
+class VarArity(InputSpec):
+    """N same-typed inputs, optionally with fixed positional heads
+    (SequenceEstimator / BinarySequenceEstimator)."""
+
+    def __init__(self, seq_type: Type[FeatureType],
+                 head_types: Sequence[Type[FeatureType]] = (), min_seq: int = 1):
+        self.seq_type = seq_type
+        self.head_types = tuple(head_types)
+        self.min_seq = min_seq
+
+    def check(self, features: Sequence[Feature]) -> None:
+        n_head = len(self.head_types)
+        if len(features) < n_head + self.min_seq:
+            raise TypeError(
+                f"Expected at least {n_head + self.min_seq} inputs, "
+                f"got {len(features)}")
+        for i, t in enumerate(self.head_types):
+            if not issubclass(features[i].ftype, t):
+                raise TypeError(
+                    f"Input {i} ({features[i].name!r}) has type "
+                    f"{features[i].ftype.__name__}, expected {t.__name__}")
+        for f in features[n_head:]:
+            if not issubclass(f.ftype, self.seq_type):
+                raise TypeError(
+                    f"Sequence input {f.name!r} has type {f.ftype.__name__}, "
+                    f"expected {self.seq_type.__name__}")
+
+
+class AllowLabelAsInput:
+    """Marker mixin: stage may consume response features without its output
+    becoming a response (OpPipelineStages.scala:204-211)."""
+
+
+class OpPipelineStage:
+    """Base pipeline stage: named operation over input features.
+
+    Subclass ``__init__`` kwargs are captured automatically for JSON
+    round-trip (the reference's ctor-args serialization,
+    ``OpPipelineStageWriter.scala``).
+    """
+
+    #: override in subclasses
+    operation_name: str = "stage"
+    output_type: Type[FeatureType] = OPVector
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        orig = cls.__init__
+        if getattr(orig, "_captures_params", False):
+            return
+        try:
+            sig = inspect.signature(orig)
+        except (TypeError, ValueError):  # pragma: no cover
+            return
+
+        @functools.wraps(orig)
+        def wrapper(self, *args, **kwargs):
+            if not hasattr(self, "_ctor_params"):
+                try:
+                    bound = sig.bind(self, *args, **kwargs)
+                    bound.apply_defaults()
+                    self._ctor_params = {
+                        k: v for k, v in bound.arguments.items()
+                        if k not in ("self",) and not k.startswith("_")
+                        and k != "kwargs"}
+                    self._ctor_params.update(bound.arguments.get("kwargs") or {})
+                except TypeError:
+                    self._ctor_params = {}
+            orig(self, *args, **kwargs)
+
+        wrapper._captures_params = True
+        cls.__init__ = wrapper
+
+    def __init__(self, uid: Optional[str] = None):
+        self.uid = uid or uid_mod.make_uid(type(self))
+        self.input_features: Tuple[Feature, ...] = ()
+        self._output_feature: Optional[Feature] = None
+
+    # -- contract ----------------------------------------------------------
+    @property
+    def input_spec(self) -> InputSpec:
+        raise NotImplementedError
+
+    def stage_name(self) -> str:
+        return f"{type(self).__name__}_{self.operation_name}"
+
+    # -- wiring ------------------------------------------------------------
+    def set_input(self, *features: Feature) -> "OpPipelineStage":
+        self.input_spec.check(features)
+        for f in features:
+            if f.is_response and not isinstance(self, AllowLabelAsInput) \
+                    and not all(x.is_response for x in features):
+                raise TypeError(
+                    f"Stage {self.stage_name()} mixes response feature "
+                    f"{f.name!r} with predictors; only AllowLabelAsInput "
+                    "stages may do that (label-leakage gate)")
+        self.input_features = tuple(features)
+        self._output_feature = None
+        return self
+
+    def get_output(self) -> Feature:
+        if self._output_feature is None:
+            if not self.input_features:
+                raise ValueError(f"{self.stage_name()}: inputs not set")
+            self._output_feature = Feature(
+                name=self.make_output_name(),
+                ftype=self.output_type,
+                is_response=all(f.is_response for f in self.input_features),
+                origin_stage=self,
+                parents=self.input_features)
+        return self._output_feature
+
+    def make_output_name(self) -> str:
+        ins = "-".join(f.name for f in self.input_features[:4])
+        _, uid_hex = uid_mod.parse_uid(self.uid)
+        return f"{ins}_{self.operation_name}_{uid_hex[-6:]}"
+
+    @property
+    def output_name(self) -> str:
+        return self.get_output().name
+
+    # -- params / serialization -------------------------------------------
+    def get_params(self) -> Dict[str, Any]:
+        return dict(getattr(self, "_ctor_params", {}))
+
+    def set_params(self, **params) -> "OpPipelineStage":
+        """Reflectively update ctor params + matching attributes
+        (OpWorkflow.setStageParameters analog)."""
+        for k, v in params.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+            self._ctor_params[k] = v
+        return self
+
+    def copy(self) -> "OpPipelineStage":
+        """Fresh instance with same ctor params + uid (ReflectionUtils.copy)."""
+        params = self.get_params()
+        params["uid"] = self.uid
+        new = type(self)(**params)
+        if self.input_features:
+            new.input_features = self.input_features
+        return new
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(uid={self.uid})"
+
+
+class Transformer(OpPipelineStage):
+    """Stage whose output is a pure function of its inputs."""
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        """Bulk columnar transform: compute the output column."""
+        raise NotImplementedError
+
+    def transform(self, store: ColumnStore) -> ColumnStore:
+        return store.with_column(self.output_name, self.transform_columns(store))
+
+    # -- row-level path (local serving / contract tests) -------------------
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        """Compute the output value for one row dict {feature name: raw value}.
+
+        Default routes through a 1-row ColumnStore so the row path can never
+        diverge from the columnar path. Stages may override for speed.
+        """
+        cols = {}
+        for f in self.input_features:
+            cols[f.name] = column_from_values(f.ftype, [row.get(f.name)])
+        out = self.transform_columns(ColumnStore(cols, 1))
+        return out.get_raw(0)
+
+    def transform_key_value(self, get: Callable[[str], Any]) -> Any:
+        row = {f.name: get(f.name) for f in self.input_features}
+        return self.transform_row(row)
+
+
+class FittedModel(Transformer):
+    """A fitted estimator: transformer + serializable numeric state.
+
+    Shares the estimator's uid and output feature so the workflow swaps it
+    into the DAG in place of the estimator after fitting.
+    """
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.parent_estimator_uid: Optional[str] = None
+
+    def get_model_state(self) -> Dict[str, Any]:
+        """JSON-able dict; numpy arrays allowed (stored via npz)."""
+        raise NotImplementedError
+
+    @classmethod
+    def from_model_state(cls, state: Dict[str, Any], **ctor) -> "FittedModel":
+        raise NotImplementedError
+
+    def has_test_eval(self) -> bool:
+        """True for models that evaluate on holdout during workflow fit
+        (HasTestEval, used by ModelSelector)."""
+        return False
+
+    def evaluate_model(self, test: ColumnStore) -> None:  # pragma: no cover
+        pass
+
+
+class Estimator(OpPipelineStage):
+    """Stage that must be fit on data, producing a :class:`FittedModel`."""
+
+    def fit(self, store: ColumnStore) -> FittedModel:
+        model = self.fit_columns(store)
+        model.uid = self.uid
+        model.parent_estimator_uid = self.uid
+        model.input_features = self.input_features
+        model._output_feature = self.get_output()
+        if not hasattr(model, "_ctor_params"):
+            model._ctor_params = {}
+        return model
+
+    def fit_columns(self, store: ColumnStore) -> FittedModel:
+        raise NotImplementedError
+
+
+class LambdaTransformer(Transformer):
+    """Transformer from a columnar function — the workhorse for math ops,
+    aliasing, and the DSL's cheap derived features.
+
+    ``fn(*input_columns, store) -> Column`` or ``fn(*input_columns) -> Column``.
+    Not JSON-serializable unless ``fn_name`` refers to a registered function.
+    """
+
+    def __init__(self, operation_name: str,
+                 fn: Callable[..., Column],
+                 input_types: Sequence[Type[FeatureType]],
+                 output_type: Type[FeatureType],
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.operation_name = operation_name
+        self.fn = fn
+        self._input_types = tuple(input_types)
+        self.output_type = output_type
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(*self._input_types)
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        cols = [store[f.name] for f in self.input_features]
+        return self.fn(*cols)

@@ -1,0 +1,77 @@
+"""FeatureGeneratorStage — origin of raw features.
+
+Mirrors ``features/.../stages/FeatureGeneratorStage.scala:45-108``: holds the
+record → value ``extract_fn``, an optional monoid aggregator and event-time
+window used by aggregating readers, and produces the raw Feature node.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Type
+
+from ..columns import Column, ColumnStore, column_from_values
+from ..features import Feature
+from ..types.feature_types import FeatureType
+from .base import InputSpec, OpPipelineStage, Transformer, register_stage
+
+
+class _NoInputs(InputSpec):
+    def check(self, features):
+        if features:
+            raise TypeError("FeatureGeneratorStage takes no input features")
+
+
+@register_stage
+class FeatureGeneratorStage(Transformer):
+    """Origin stage: extracts one raw feature from source records."""
+
+    operation_name = "gen"
+    is_raw_generator = True
+
+    def __init__(self, name: str, ftype: Type[FeatureType],
+                 extract_fn: Optional[Callable[[Any], Any]] = None,
+                 is_response: bool = False,
+                 aggregator=None, window_ms: Optional[int] = None,
+                 extract_source: Optional[str] = None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.name = name
+        self.ftype = ftype
+        self.extract_fn = extract_fn or (lambda rec: rec.get(name))
+        self.is_response = is_response
+        self.aggregator = aggregator
+        self.window_ms = window_ms
+        self.extract_source = extract_source
+        self.output_type = ftype
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return _NoInputs()
+
+    def get_output(self) -> Feature:
+        if self._output_feature is None:
+            self._output_feature = Feature(
+                name=self.name, ftype=self.ftype,
+                is_response=self.is_response, origin_stage=self, parents=())
+        return self._output_feature
+
+    def make_output_name(self) -> str:
+        return self.name
+
+    # raw features are materialized by readers; transform just passes through
+    # an existing column (used when scoring a store that already has the data)
+    def transform_columns(self, store: ColumnStore) -> Column:
+        if self.name in store:
+            return store[self.name]
+        raise KeyError(f"Raw feature {self.name!r} missing from input data")
+
+    def extract_column(self, records) -> Column:
+        """Run extract_fn over host records → typed column (reader path,
+        DataReader.generateDataFrame analog)."""
+        return column_from_values(self.ftype, [self.extract_fn(r) for r in records])
+
+    def get_params(self):
+        p = super().get_params()
+        p.pop("extract_fn", None)
+        p.pop("aggregator", None)
+        p["ftype"] = self.ftype.__name__
+        return p

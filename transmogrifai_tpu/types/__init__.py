@@ -1,0 +1,4 @@
+from .feature_types import *  # noqa: F401,F403
+from .feature_types import __all__ as _ft_all
+
+__all__ = list(_ft_all)

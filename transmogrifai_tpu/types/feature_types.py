@@ -1,0 +1,716 @@
+"""Feature type system — the typed value layer of the framework.
+
+Re-designs the reference's 45-class ``FeatureType`` hierarchy (52 concrete types)
+(``features/src/main/scala/com/salesforce/op/features/types/FeatureType.scala:44-324``)
+for a columnar, TPU-first world:
+
+* Each type is a lightweight Python class that *boxes a single row value*
+  (used for row-level serving, tests, and semantics) and carries static
+  metadata describing its **columnar physical layout** (``ColumnKind``) so
+  bulk data lives in dense device arrays + null masks, never in per-row
+  boxes.
+* ``Option[T]`` nullability becomes ``None`` at the boxed level and a
+  ``bool`` validity mask at the columnar level.
+* The reference's runtime ``TypeTag`` registry
+  (``FeatureType.scala:265-324``) becomes ``FEATURE_TYPE_REGISTRY``.
+
+The hierarchy mirrors the reference exactly in names and subtyping:
+
+    FeatureType
+    ├── Numerics: Real (RealNN, Percent, Currency), Integral (Date, DateTime), Binary
+    ├── Text: Text (Email, Base64, Phone, ID, URL, TextArea, PickList,
+    │          ComboBox, Country, State, City, PostalCode, Street)
+    ├── Vector: OPVector
+    ├── Lists: TextList, DateList (DateTimeList), Geolocation
+    ├── Sets: MultiPickList
+    └── Maps: 23 map types + Prediction
+
+Traits (``NonNullable``, ``SingleResponse``, ``Categorical``, ``Location``)
+are mixin classes, as in ``FeatureType.scala:173-263``.
+"""
+from __future__ import annotations
+
+import math
+from enum import Enum
+from typing import Any, ClassVar, Dict, Iterable, List, Mapping, Optional, Sequence, Set, Tuple, Type
+
+import numpy as np
+
+__all__ = [
+    "ColumnKind", "FeatureType", "FeatureTypeError",
+    # traits
+    "NonNullable", "SingleResponse", "MultiResponse", "Categorical", "Location",
+    # numerics
+    "OPNumeric", "Real", "RealNN", "Binary", "Integral", "Percent", "Currency",
+    "Date", "DateTime",
+    # text
+    "Text", "Email", "Base64", "Phone", "ID", "URL", "TextArea", "PickList",
+    "ComboBox", "Country", "State", "City", "PostalCode", "Street",
+    # collections
+    "OPVector", "OPList", "TextList", "DateList", "DateTimeList", "OPSet",
+    "MultiPickList", "Geolocation",
+    # maps
+    "OPMap", "Base64Map", "BinaryMap", "ComboBoxMap", "CurrencyMap", "DateMap",
+    "DateTimeMap", "EmailMap", "IDMap", "IntegralMap", "MultiPickListMap",
+    "PercentMap", "PhoneMap", "PickListMap", "RealMap", "TextAreaMap", "TextMap",
+    "URLMap", "CountryMap", "StateMap", "CityMap", "PostalCodeMap", "StreetMap",
+    "GeolocationMap", "Prediction",
+    # registry + helpers
+    "FEATURE_TYPE_REGISTRY", "feature_type_by_name", "is_subtype",
+]
+
+
+class FeatureTypeError(TypeError):
+    """Raised on invalid feature values (e.g. NaN in a RealNN, bad Prediction keys)."""
+
+
+class ColumnKind(Enum):
+    """Physical columnar layout of a feature type on host/device.
+
+    The TPU compute path only ever sees dense arrays + masks; this enum is
+    the single source of truth for how each logical type is stored.
+    """
+
+    REAL = "real"            # f32[n] values + bool[n] mask
+    INTEGRAL = "integral"    # i64[n] values + bool[n] mask
+    BINARY = "binary"        # bool[n] values + bool[n] mask
+    TEXT = "text"            # host object[n] of Optional[str]
+    TEXT_LIST = "text_list"  # host list[list[str]]
+    REAL_LIST = "real_list"  # ragged f64 via offsets (Geolocation is fixed 3)
+    INTEGRAL_LIST = "integral_list"  # ragged i64 via offsets (DateList etc.)
+    TEXT_SET = "text_set"    # host list[set[str]]
+    VECTOR = "vector"        # f32[n, d] dense + OpVectorMetadata
+    GEO = "geo"              # f32[n, 3] (lat, lon, accuracy) + bool[n] mask
+    MAP = "map"              # dict[key -> subcolumn of element kind]
+    PREDICTION = "prediction"  # fixed struct-of-arrays (pred, raw, prob)
+
+
+class FeatureType:
+    """Base boxed value. ``value`` is the payload; emptiness == ``None``/empty.
+
+    Mirrors ``FeatureType.scala:44-171``: equality is on value, ``is_empty``
+    tests emptiness, ``non_nullable`` marks types that forbid emptiness.
+    """
+
+    __slots__ = ("_value",)
+
+    #: physical layout for bulk storage
+    column_kind: ClassVar[ColumnKind] = ColumnKind.REAL
+    #: element kind for MAP types
+    map_element_kind: ClassVar[Optional[ColumnKind]] = None
+
+    def __init__(self, value: Any = None):
+        self._value = self._convert(value)
+        # NonNullable forbids a null payload; an empty collection (e.g. a
+        # zero-size OPVector) is still legal, matching the reference.
+        if self.non_nullable() and self._value is None:
+            raise FeatureTypeError(
+                f"{type(self).__name__} cannot be empty (NonNullable)")
+
+    # -- value semantics ---------------------------------------------------
+    @property
+    def value(self) -> Any:
+        return self._value
+
+    @property
+    def is_empty(self) -> bool:
+        v = self._value
+        if v is None:
+            return True
+        if isinstance(v, (list, tuple, set, dict, str)):
+            return len(v) == 0
+        if isinstance(v, np.ndarray):
+            return v.size == 0
+        return False
+
+    @property
+    def is_non_empty(self) -> bool:
+        return not self.is_empty
+
+    @classmethod
+    def non_nullable(cls) -> bool:
+        return issubclass(cls, NonNullable)
+
+    @classmethod
+    def is_categorical(cls) -> bool:
+        return issubclass(cls, Categorical)
+
+    @classmethod
+    def is_location(cls) -> bool:
+        return issubclass(cls, Location)
+
+    @classmethod
+    def type_name(cls) -> str:
+        return cls.__name__
+
+    @classmethod
+    def empty(cls) -> "FeatureType":
+        return cls(None)
+
+    def exists(self, pred) -> bool:
+        return self.is_non_empty and pred(self._value)
+
+    # -- conversion hook ---------------------------------------------------
+    @classmethod
+    def _convert(cls, value: Any) -> Any:
+        return value
+
+    # -- dunder ------------------------------------------------------------
+    def __eq__(self, other: Any) -> bool:
+        if not isinstance(other, FeatureType):
+            return NotImplemented
+        if type(self) is not type(other):
+            return False
+        return self._comparable() == other._comparable()
+
+    def _comparable(self) -> Any:
+        return self._value
+
+    def __hash__(self) -> int:
+        c = self._comparable()
+        if isinstance(c, (list, np.ndarray)):
+            c = tuple(np.asarray(c).tolist())
+        elif isinstance(c, set):
+            c = frozenset(c)
+        elif isinstance(c, dict):
+            c = tuple(sorted(c.items()))
+        return hash((type(self).__name__, c))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self._value!r})"
+
+
+# ---------------------------------------------------------------------------
+# Traits (FeatureType.scala:173-263)
+# ---------------------------------------------------------------------------
+
+class NonNullable:
+    """Marker: the value may never be empty."""
+
+
+class SingleResponse:
+    """Marker: valid single-response label type (RealNN, Binary, ...)."""
+
+
+class MultiResponse:
+    """Marker: valid multi-response label type."""
+
+
+class Categorical:
+    """Marker: categorical-valued (Binary, PickList, ComboBox, MultiPickList, ...)."""
+
+
+class Location:
+    """Marker: geographic types (Geolocation, Country, State, City, ...)."""
+
+
+# ---------------------------------------------------------------------------
+# Numerics (features/.../types/Numerics.scala)
+# ---------------------------------------------------------------------------
+
+class OPNumeric(FeatureType):
+    """Base for numeric scalars: value is ``Optional[number]``."""
+
+    def to_double(self) -> Optional[float]:
+        return None if self._value is None else float(self._value)
+
+
+class Real(OPNumeric):
+    column_kind = ColumnKind.REAL
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return None
+        if isinstance(value, (bool, np.bool_)):
+            return 1.0 if value else 0.0
+        v = float(value)
+        if math.isnan(v):
+            return None
+        return v
+
+
+class RealNN(Real, NonNullable, SingleResponse):
+    """Non-nullable real — the canonical label type."""
+
+
+class Percent(Real):
+    pass
+
+
+class Currency(Real):
+    pass
+
+
+class Integral(OPNumeric):
+    column_kind = ColumnKind.INTEGRAL
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return None
+        if isinstance(value, float) and math.isnan(value):
+            return None
+        return int(value)
+
+
+class Date(Integral):
+    """Milliseconds-since-epoch timestamp (day precision by convention)."""
+
+
+class DateTime(Date):
+    pass
+
+
+class Binary(OPNumeric, SingleResponse, Categorical):
+    column_kind = ColumnKind.BINARY
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return None
+        if isinstance(value, str):
+            lowered = value.strip().lower()
+            if lowered in ("true", "1", "yes"):
+                return True
+            if lowered in ("false", "0", "no"):
+                return False
+            raise FeatureTypeError(f"Cannot parse {value!r} as Binary")
+        return bool(value)
+
+    def to_double(self) -> Optional[float]:
+        return None if self._value is None else (1.0 if self._value else 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Text hierarchy (features/.../types/Text.scala)
+# ---------------------------------------------------------------------------
+
+class Text(FeatureType):
+    column_kind = ColumnKind.TEXT
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return None
+        return str(value)
+
+
+class Email(Text):
+    @property
+    def prefix(self) -> Optional[str]:
+        parts = self._split()
+        return parts[0] if parts else None
+
+    @property
+    def domain(self) -> Optional[str]:
+        parts = self._split()
+        return parts[1] if parts else None
+
+    def _split(self) -> Optional[Tuple[str, str]]:
+        if self.is_empty or "@" not in self._value:
+            return None
+        prefix, _, domain = self._value.partition("@")
+        if not prefix or not domain:
+            return None
+        return (prefix, domain)
+
+
+class Base64(Text):
+    def as_bytes(self) -> Optional[bytes]:
+        if self.is_empty:
+            return None
+        import base64 as _b64
+        try:
+            return _b64.b64decode(self._value)
+        except Exception:
+            return None
+
+
+class Phone(Text):
+    pass
+
+
+class ID(Text):
+    pass
+
+
+class URL(Text):
+    def is_valid(self) -> bool:
+        """Protocol must be http/https/ftp and host non-empty (RichTextFeature semantics)."""
+        if self.is_empty:
+            return False
+        from urllib.parse import urlparse
+        try:
+            p = urlparse(self._value)
+        except ValueError:
+            return False
+        return p.scheme in ("http", "https", "ftp") and bool(p.netloc)
+
+    @property
+    def domain(self) -> Optional[str]:
+        if not self.is_valid():
+            return None
+        from urllib.parse import urlparse
+        return urlparse(self._value).netloc
+
+
+class TextArea(Text):
+    pass
+
+
+class PickList(Text, SingleResponse, Categorical):
+    pass
+
+
+class ComboBox(Text, Categorical):
+    pass
+
+
+class Country(Text, Location):
+    pass
+
+
+class State(Text, Location):
+    pass
+
+
+class City(Text, Location):
+    pass
+
+
+class PostalCode(Text, Location):
+    pass
+
+
+class Street(Text, Location):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Vector (features/.../types/OPVector.scala)
+# ---------------------------------------------------------------------------
+
+class OPVector(FeatureType, NonNullable):
+    """Dense feature vector. Value is a float64 numpy array (never None).
+
+    The reference wraps ``ml.linalg.Vector`` (sparse or dense); on TPU we are
+    always dense — XLA prefers dense bf16/f32 tiles, and d <= 16384 fits.
+    """
+
+    column_kind = ColumnKind.VECTOR
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return np.zeros((0,), dtype=np.float64)
+        arr = np.asarray(value, dtype=np.float64)
+        if arr.ndim != 1:
+            raise FeatureTypeError(f"OPVector must be rank-1, got shape {arr.shape}")
+        return arr
+
+    @property
+    def is_empty(self) -> bool:
+        return self._value.size == 0
+
+    def _comparable(self):
+        return tuple(self._value.tolist())
+
+    def combine(self, *others: "OPVector") -> "OPVector":
+        arrays = [self._value] + [o.value for o in others]
+        return OPVector(np.concatenate(arrays))
+
+
+# ---------------------------------------------------------------------------
+# Lists & sets (features/.../types/Lists.scala, Sets.scala)
+# ---------------------------------------------------------------------------
+
+class OPList(FeatureType):
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return []
+        return list(value)
+
+    def _comparable(self):
+        return tuple(self._value)
+
+
+class TextList(OPList):
+    column_kind = ColumnKind.TEXT_LIST
+
+    @classmethod
+    def _convert(cls, value):
+        return [str(v) for v in (value or [])]
+
+
+class DateList(OPList):
+    column_kind = ColumnKind.INTEGRAL_LIST
+
+    @classmethod
+    def _convert(cls, value):
+        return [int(v) for v in (value or [])]
+
+
+class DateTimeList(DateList):
+    pass
+
+
+class OPSet(FeatureType, Categorical):
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return set()
+        return set(value)
+
+    def _comparable(self):
+        return frozenset(self._value)
+
+
+class MultiPickList(OPSet, MultiResponse):
+    column_kind = ColumnKind.TEXT_SET
+
+    @classmethod
+    def _convert(cls, value):
+        return {str(v) for v in (value or ())}
+
+
+class Geolocation(OPList, Location):
+    """(lat, lon, accuracy) triple; empty list when absent.
+
+    Accuracy is an integer rank as in ``GeolocationAccuracy``
+    (``features/.../types/Geolocation.scala:206``).
+    """
+
+    column_kind = ColumnKind.GEO
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return []
+        vals = [float(v) for v in value]
+        if vals and len(vals) != 3:
+            raise FeatureTypeError(
+                f"Geolocation must be empty or [lat, lon, accuracy], got {vals}")
+        if vals:
+            lat, lon = vals[0], vals[1]
+            if math.isnan(lat) or math.isnan(lon):
+                raise FeatureTypeError("Geolocation lat/lon cannot be NaN")
+            if not (-90.0 <= lat <= 90.0):
+                raise FeatureTypeError(f"Latitude {lat} out of range [-90, 90]")
+            if not (-180.0 <= lon <= 180.0):
+                raise FeatureTypeError(f"Longitude {lon} out of range [-180, 180]")
+        return vals
+
+    @property
+    def lat(self) -> Optional[float]:
+        return self._value[0] if self._value else None
+
+    @property
+    def lon(self) -> Optional[float]:
+        return self._value[1] if self._value else None
+
+    @property
+    def accuracy(self) -> Optional[float]:
+        return self._value[2] if self._value else None
+
+    def to_unit_sphere(self) -> Optional[np.ndarray]:
+        """3-D unit-sphere embedding, the TPU-friendly geo representation."""
+        if self.is_empty:
+            return None
+        lat, lon = math.radians(self._value[0]), math.radians(self._value[1])
+        return np.array([
+            math.cos(lat) * math.cos(lon),
+            math.cos(lat) * math.sin(lon),
+            math.sin(lat),
+        ])
+
+
+# ---------------------------------------------------------------------------
+# Maps (features/.../types/Maps.scala — 23 types + Prediction)
+# ---------------------------------------------------------------------------
+
+class OPMap(FeatureType):
+    """String-keyed map. Subclasses fix the element type/kind."""
+
+    column_kind = ColumnKind.MAP
+    element_type: ClassVar[Type[FeatureType]] = FeatureType
+
+    @classmethod
+    def _convert(cls, value):
+        if value is None:
+            return {}
+        return {str(k): cls._convert_element(v) for k, v in dict(value).items()}
+
+    @classmethod
+    def _convert_element(cls, v):
+        return v
+
+    def _comparable(self):
+        return tuple(sorted(
+            (k, tuple(v) if isinstance(v, (list, set)) else v)
+            for k, v in self._value.items()))
+
+
+def _make_map_type(name: str, element: Type[FeatureType], elem_kind: ColumnKind,
+                   convert_element, bases: Tuple[type, ...] = ()) -> Type[OPMap]:
+    cls = type(name, (OPMap,) + bases, {
+        "element_type": element,
+        "map_element_kind": elem_kind,
+        "_convert_element": classmethod(lambda c, v: convert_element(v)),
+        "__doc__": f"Map[str, {element.__name__}] (Maps.scala).",
+    })
+    return cls
+
+
+def _real_elem(v):
+    return None if v is None else float(v)
+
+
+def _int_elem(v):
+    return None if v is None else int(v)
+
+
+def _bool_elem(v):
+    return None if v is None else bool(v)
+
+
+def _str_elem(v):
+    return None if v is None else str(v)
+
+
+def _set_elem(v):
+    return {str(x) for x in (v or ())}
+
+
+def _geo_elem(v):
+    return Geolocation._convert(v)
+
+
+TextMap = _make_map_type("TextMap", Text, ColumnKind.TEXT, _str_elem)
+EmailMap = _make_map_type("EmailMap", Email, ColumnKind.TEXT, _str_elem)
+Base64Map = _make_map_type("Base64Map", Base64, ColumnKind.TEXT, _str_elem)
+PhoneMap = _make_map_type("PhoneMap", Phone, ColumnKind.TEXT, _str_elem)
+IDMap = _make_map_type("IDMap", ID, ColumnKind.TEXT, _str_elem)
+URLMap = _make_map_type("URLMap", URL, ColumnKind.TEXT, _str_elem)
+TextAreaMap = _make_map_type("TextAreaMap", TextArea, ColumnKind.TEXT, _str_elem)
+PickListMap = _make_map_type("PickListMap", PickList, ColumnKind.TEXT, _str_elem,
+                             bases=(Categorical,))
+ComboBoxMap = _make_map_type("ComboBoxMap", ComboBox, ColumnKind.TEXT, _str_elem,
+                             bases=(Categorical,))
+CountryMap = _make_map_type("CountryMap", Country, ColumnKind.TEXT, _str_elem,
+                            bases=(Location,))
+StateMap = _make_map_type("StateMap", State, ColumnKind.TEXT, _str_elem,
+                          bases=(Location,))
+CityMap = _make_map_type("CityMap", City, ColumnKind.TEXT, _str_elem,
+                         bases=(Location,))
+PostalCodeMap = _make_map_type("PostalCodeMap", PostalCode, ColumnKind.TEXT,
+                               _str_elem, bases=(Location,))
+StreetMap = _make_map_type("StreetMap", Street, ColumnKind.TEXT, _str_elem,
+                           bases=(Location,))
+RealMap = _make_map_type("RealMap", Real, ColumnKind.REAL, _real_elem)
+PercentMap = _make_map_type("PercentMap", Percent, ColumnKind.REAL, _real_elem)
+CurrencyMap = _make_map_type("CurrencyMap", Currency, ColumnKind.REAL, _real_elem)
+IntegralMap = _make_map_type("IntegralMap", Integral, ColumnKind.INTEGRAL, _int_elem)
+DateMap = _make_map_type("DateMap", Date, ColumnKind.INTEGRAL, _int_elem)
+DateTimeMap = _make_map_type("DateTimeMap", DateTime, ColumnKind.INTEGRAL, _int_elem)
+BinaryMap = _make_map_type("BinaryMap", Binary, ColumnKind.BINARY, _bool_elem,
+                           bases=(Categorical,))
+MultiPickListMap = _make_map_type("MultiPickListMap", MultiPickList,
+                                  ColumnKind.TEXT_SET, _set_elem,
+                                  bases=(Categorical, MultiResponse))
+GeolocationMap = _make_map_type("GeolocationMap", Geolocation, ColumnKind.GEO,
+                                _geo_elem, bases=(Location,))
+
+
+class Prediction(RealMap):  # type: ignore[misc, valid-type]
+    """Model output: RealMap with reserved keys (Maps.scala ``Prediction``).
+
+    Keys: ``prediction`` (required), ``rawPrediction_<i>``, ``probability_<i>``.
+    Columnar layout is a fixed struct-of-arrays (``ColumnKind.PREDICTION``):
+    ``prediction: f32[n]``, ``rawPrediction: f32[n, k]``, ``probability: f32[n, k]``.
+    """
+
+    column_kind = ColumnKind.PREDICTION
+
+    PREDICTION_KEY = "prediction"
+    RAW_PREFIX = "rawPrediction_"
+    PROB_PREFIX = "probability_"
+
+    def __init__(self, value=None, *, prediction: Optional[float] = None,
+                 raw_prediction: Optional[Sequence[float]] = None,
+                 probability: Optional[Sequence[float]] = None):
+        if value is None:
+            value = {}
+            if prediction is not None:
+                value[self.PREDICTION_KEY] = float(prediction)
+            for i, v in enumerate(raw_prediction or ()):
+                value[f"{self.RAW_PREFIX}{i}"] = float(v)
+            for i, v in enumerate(probability or ()):
+                value[f"{self.PROB_PREFIX}{i}"] = float(v)
+        super().__init__(value)
+        if self.PREDICTION_KEY not in self._value:
+            raise FeatureTypeError(
+                "Prediction must contain a 'prediction' key "
+                f"(got keys {sorted(self._value)})")
+        for k in self._value:
+            if k != self.PREDICTION_KEY and not (
+                    k.startswith(self.RAW_PREFIX) or k.startswith(self.PROB_PREFIX)):
+                raise FeatureTypeError(f"Invalid Prediction key {k!r}")
+
+    @property
+    def prediction(self) -> float:
+        return self._value[self.PREDICTION_KEY]
+
+    @property
+    def raw_prediction(self) -> List[float]:
+        return self._sorted_prefixed(self.RAW_PREFIX)
+
+    @property
+    def probability(self) -> List[float]:
+        return self._sorted_prefixed(self.PROB_PREFIX)
+
+    def _sorted_prefixed(self, prefix: str) -> List[float]:
+        items = [(int(k[len(prefix):]), v) for k, v in self._value.items()
+                 if k.startswith(prefix)]
+        return [v for _, v in sorted(items)]
+
+
+# ---------------------------------------------------------------------------
+# Registry (FeatureType.scala:265-324)
+# ---------------------------------------------------------------------------
+
+FEATURE_TYPE_REGISTRY: Dict[str, Type[FeatureType]] = {
+    cls.__name__: cls for cls in [
+        # Vector
+        OPVector,
+        # Lists
+        TextList, DateList, DateTimeList, Geolocation,
+        # Maps
+        Base64Map, BinaryMap, ComboBoxMap, CurrencyMap, DateMap, DateTimeMap,
+        EmailMap, IDMap, IntegralMap, MultiPickListMap, PercentMap, PhoneMap,
+        PickListMap, RealMap, TextAreaMap, TextMap, URLMap, CountryMap,
+        StateMap, CityMap, PostalCodeMap, StreetMap, GeolocationMap, Prediction,
+        # Numerics
+        Binary, Currency, Date, DateTime, Integral, Percent, Real, RealNN,
+        # Sets
+        MultiPickList,
+        # Text
+        Base64, ComboBox, Email, ID, Phone, PickList, Text, TextArea, URL,
+        Country, State, City, PostalCode, Street,
+    ]
+}
+
+assert len(FEATURE_TYPE_REGISTRY) == 52, len(FEATURE_TYPE_REGISTRY)
+
+
+def feature_type_by_name(name: str) -> Type[FeatureType]:
+    try:
+        return FEATURE_TYPE_REGISTRY[name]
+    except KeyError:
+        raise FeatureTypeError(f"Unknown feature type {name!r}") from None
+
+
+def is_subtype(a: Type[FeatureType], b: Type[FeatureType]) -> bool:
+    """True when feature type ``a`` can be used where ``b`` is expected."""
+    return issubclass(a, b)

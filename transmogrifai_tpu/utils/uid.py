@@ -1,0 +1,45 @@
+"""Sequential UID generation, mirroring ``utils/.../UID.scala:41-95``.
+
+UIDs look like ``ClassName_000000000001`` — sequential per process with a
+global counter, resettable for deterministic tests and model round-trips
+(the reference's ``UID.reset`` is load-bearing for warm-start by uid).
+"""
+from __future__ import annotations
+
+import itertools
+import re
+import threading
+from typing import Optional, Tuple
+
+_COUNTER = itertools.count(1)
+_LOCK = threading.Lock()
+_UID_RE = re.compile(r"^(\w+)_(\w{12})$")
+
+
+def make_uid(cls_or_name) -> str:
+    name = cls_or_name if isinstance(cls_or_name, str) else cls_or_name.__name__
+    with _LOCK:
+        count = next(_COUNTER)
+    return f"{name}_{count:012x}"
+
+
+def reset(start: int = 1) -> None:
+    """Reset the counter — deterministic uids for tests/golden models."""
+    global _COUNTER
+    with _LOCK:
+        _COUNTER = itertools.count(start)
+
+
+def parse_uid(uid: str) -> Tuple[str, str]:
+    """Split ``Name_%012x`` into (name, hex) or raise ValueError."""
+    m = _UID_RE.match(uid)
+    if not m:
+        raise ValueError(f"Invalid uid {uid!r}")
+    return m.group(1), m.group(2)
+
+
+def uid_prefix(uid: str) -> Optional[str]:
+    try:
+        return parse_uid(uid)[0]
+    except ValueError:
+        return None
