@@ -1,0 +1,52 @@
+"""Benchmark entry — run by the driver on real TPU hardware.
+
+Runs the reference's headline workload: the Titanic
+BinaryClassificationModelSelector CV sweep (README.md:62-64: LR + RF grids,
+3 folds, AuPR selection) end-to-end — feature engineering, sanity checking,
+the batched CV grid, final refit, holdout evaluation.
+
+Prints ONE JSON line:
+  metric      titanic_holdout_AuPR — parity metric against the only
+              published reference number (README.md:89 AuPR = 0.8225)
+  value       our holdout AuPR
+  vs_baseline value / 0.8225  (>1 = better than reference)
+  extras      cv_wallclock_s (the CV-grid fit wall-clock), backend
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+REFERENCE_AUPR = 0.8225  # /root/reference/README.md:89
+
+
+def main() -> None:
+    import jax
+
+    backend = jax.default_backend()
+    sys.path.insert(0, "examples")
+    from titanic import run
+
+    t0 = time.time()
+    out = run(num_folds=3, seed=42)
+    total_s = time.time() - t0
+
+    summary = out["summary"]
+    holdout = summary.holdout_evaluation or {}
+    aupr = float(holdout.get("AuPR", 0.0))
+
+    print(json.dumps({
+        "metric": "titanic_holdout_AuPR",
+        "value": round(aupr, 4),
+        "unit": "AuPR",
+        "vs_baseline": round(aupr / REFERENCE_AUPR, 4),
+        "cv_wallclock_s": round(out["train_time_s"], 2),
+        "total_wallclock_s": round(total_s, 2),
+        "best_model": summary.best_model_name,
+        "backend": backend,
+    }))
+
+
+if __name__ == "__main__":
+    main()

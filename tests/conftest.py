@@ -1,18 +1,25 @@
-"""Test harness: force an 8-device virtual CPU mesh before jax import.
+"""Test harness: force an 8-device virtual CPU mesh.
 
 Replaces the reference's ``TestSparkContext`` (shared local[2] Spark session,
 ``utils/.../test/TestSparkContext.scala:36-80``): tests exercise distributed
 behavior on 8 virtual host devices so every sharding/collective path runs in
 CI without TPU hardware.
+
+NOTE the axon TPU shim (sitecustomize) registers itself at interpreter start
+and pins ``jax_platforms``; the env var alone is NOT enough — we must
+override via ``jax.config.update`` before any backend is initialized.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,118 @@
+"""Map vectorizers, DateList vectorizer, fn serialization tests."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, Workflow
+from transmogrifai_tpu.ops.maps import MapVectorizer
+from transmogrifai_tpu.ops.date_list import DateListVectorizer
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils.fn_io import (FunctionSerializationError,
+                                           decode_fn, encode_fn)
+
+_MS_PER_DAY = 24 * 3600 * 1000
+
+
+def test_real_map_vectorizer():
+    m = FeatureBuilder.RealMap("m").from_column().as_predictor()
+    store = ColumnStore.from_dict({
+        "m": (ft.RealMap, [{"a": 1.0, "b": 10.0}, {"a": 3.0}, {}])})
+    est = MapVectorizer()
+    m.transform_with(est)
+    model = est.fit(store)
+    out = model.transform_columns(store)
+    # keys a, b -> [a, a_null, b, b_null]
+    np.testing.assert_allclose(out.values, [
+        [1.0, 0, 10.0, 0], [3.0, 0, 10.0, 1], [2.0, 1, 10.0, 1]])
+    assert out.metadata.columns[0].grouping == "a"
+    assert out.metadata.columns[0].parent_feature_name == "m"
+
+
+def test_text_map_pivot():
+    m = FeatureBuilder.TextMap("m").from_column().as_predictor()
+    store = ColumnStore.from_dict({
+        "m": (ft.TextMap, [{"k": "x"}, {"k": "y"}, {"k": "x"}, {}])})
+    est = MapVectorizer(top_k=5, min_support=1)
+    m.transform_with(est)
+    model = est.fit(store)
+    out = model.transform_columns(store)
+    # key k -> [x, y, OTHER, null]
+    assert out.values.shape == (4, 4)
+    np.testing.assert_allclose(out.values[0], [1, 0, 0, 0])
+    np.testing.assert_allclose(out.values[3], [0, 0, 0, 1])
+
+
+def test_multipicklist_map():
+    m = FeatureBuilder.MultiPickListMap("m").from_column().as_predictor()
+    store = ColumnStore.from_dict({
+        "m": (ft.MultiPickListMap, [{"k": ["a", "b"]}, {"k": ["a"]}, {}])})
+    est = MapVectorizer(min_support=1)
+    m.transform_with(est)
+    model = est.fit(store)
+    out = model.transform_columns(store)
+    assert out.values[0][:2].sum() == 2.0  # multi-hot
+
+
+def test_binary_map_and_geo_map():
+    b = FeatureBuilder.BinaryMap("b").from_column().as_predictor()
+    g = FeatureBuilder.GeolocationMap("g").from_column().as_predictor()
+    store = ColumnStore.from_dict({
+        "b": (ft.BinaryMap, [{"x": True}, {"x": False}, {}]),
+        "g": (ft.GeolocationMap, [{"home": [10.0, 20.0, 1.0]}, {}, {}]),
+    })
+    for feat, name in ((b, "b"), (g, "g")):
+        est = MapVectorizer()
+        feat.transform_with(est)
+        model = est.fit(store)
+        out = model.transform_columns(store)
+        assert out.values.shape[0] == 3
+        assert out.metadata.size == out.values.shape[1]
+
+
+def test_transmogrify_with_maps_and_datelist():
+    m = FeatureBuilder.RealMap("m").from_column().as_predictor()
+    dl = FeatureBuilder.DateList("dl").from_column().as_predictor()
+    age = FeatureBuilder.Real("age").from_column().as_predictor()
+    vec = transmogrify([m, dl, age])
+    store = ColumnStore.from_dict({
+        "m": (ft.RealMap, [{"a": 1.0}, {}]),
+        "dl": (ft.DateList, [[_MS_PER_DAY, 3 * _MS_PER_DAY], []]),
+        "age": (ft.Real, [30.0, None]),
+    })
+    model = Workflow().set_input_store(store).set_result_features(vec).train()
+    out = model.score(store, keep_intermediate=True)[vec.name]
+    assert out.values.shape[0] == 2
+    assert out.metadata is not None and out.metadata.size == out.values.shape[1]
+    assert {"m", "dl", "age"} <= set(out.metadata.parent_features())
+
+
+def test_date_list_vectorizer_since_last():
+    dl = FeatureBuilder.DateList("dl").from_column().as_predictor()
+    model = DateListVectorizer(reference_date_ms=10 * _MS_PER_DAY,
+                               input_names=["dl"])
+    dl.transform_with(model)
+    store = ColumnStore.from_dict({
+        "dl": (ft.DateList, [[2 * _MS_PER_DAY, 7 * _MS_PER_DAY], []])})
+    out = model.transform_columns(store)
+    np.testing.assert_allclose(out.values, [[3.0, 0.0], [0.0, 1.0]])
+
+
+def test_fn_roundtrip_lambda():
+    fn = decode_fn(encode_fn(lambda v: v * 2 if v is not None else None))
+    assert fn(3) == 6 and fn(None) is None
+
+
+def test_fn_roundtrip_with_math_module():
+    fn = decode_fn(encode_fn(lambda v: math.floor(v)))  # noqa: F821
+    assert fn(3.7) == 3
+
+
+def test_fn_rejects_unknown_global_at_save():
+    with pytest.raises(FunctionSerializationError):
+        encode_fn(lambda v: some_unknown_helper(v))  # noqa: F821
+
+
+def test_fn_named_function():
+    spec = encode_fn(np.sqrt)
+    assert spec["kind"] == "named"
+    assert decode_fn(spec) is np.sqrt

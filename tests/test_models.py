@@ -1,0 +1,140 @@
+"""Linear model + evaluator tests."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, column_from_values
+from transmogrifai_tpu.columns import VectorColumn
+from transmogrifai_tpu.evaluators import (BinaryClassificationEvaluator,
+                                          Evaluators, metrics as M)
+from transmogrifai_tpu.models import (OpLinearRegression,
+                                      OpLogisticRegression, OpNaiveBayes)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def _make_clf_store(rng, n=400, d=5, n_classes=2):
+    X = rng.normal(size=(n, d))
+    w_true = rng.normal(size=(d, n_classes))
+    logits = X @ w_true
+    y = np.argmax(logits + rng.normal(scale=0.3, size=logits.shape), axis=1)
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y.astype(float)),
+        "features": VectorColumn(ft.OPVector, X),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+    return store, label, feats, X, y
+
+
+def test_logistic_regression_binary(rng):
+    store, label, feats, X, y = _make_clf_store(rng, n_classes=2)
+    est = OpLogisticRegression()
+    label.transform_with(est, feats)
+    model = est.fit(store)
+    pred, raw, prob = model.predict_arrays(X)
+    acc = (pred == y).mean()
+    assert acc > 0.9
+    assert prob.shape == (len(y), 2)
+    np.testing.assert_allclose(prob.sum(1), 1.0, atol=1e-6)
+    # row path
+    row = model.transform_row({"label": 1.0, "features": X[0]})
+    assert row["prediction"] == pred[0]
+
+
+def test_logistic_regression_regularization_shrinks(rng):
+    store, label, feats, X, y = _make_clf_store(rng, n_classes=2)
+    e0 = OpLogisticRegression(reg_param=0.0)
+    label.transform_with(e0, feats)
+    m0 = e0.fit(store)
+    e1 = OpLogisticRegression(reg_param=1.0, elastic_net_param=0.5)
+    label.transform_with(e1, feats)
+    m1 = e1.fit(store)
+    assert np.abs(m1.coefficients).sum() < np.abs(m0.coefficients).sum()
+
+
+def test_logistic_regression_multiclass(rng):
+    store, label, feats, X, y = _make_clf_store(rng, n_classes=3)
+    est = OpLogisticRegression()
+    label.transform_with(est, feats)
+    model = est.fit(store)
+    pred, raw, prob = model.predict_arrays(X)
+    assert prob.shape == (len(y), 3)
+    assert (pred == y).mean() > 0.85
+
+
+def test_linear_regression(rng):
+    n, d = 300, 4
+    X = rng.normal(size=(n, d))
+    coef = np.array([1.0, -2.0, 0.5, 3.0])
+    y = X @ coef + 0.7 + rng.normal(scale=0.01, size=n)
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "features": VectorColumn(ft.OPVector, X),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+    est = OpLinearRegression()
+    label.transform_with(est, feats)
+    model = est.fit(store)
+    np.testing.assert_allclose(model.coefficients, coef, atol=0.02)
+    assert abs(model.intercept - 0.7) < 0.02
+
+
+def test_naive_bayes(rng):
+    n = 300
+    y = rng.integers(0, 2, size=n)
+    # multinomial NB discriminates on feature *proportions*: give each class
+    # a different profile over the 3 count features
+    lam = np.where(y[:, None] == 1, [5.0, 1.0, 1.0], [1.0, 1.0, 5.0])
+    X = rng.poisson(lam=lam).astype(float)
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y.astype(float)),
+        "features": VectorColumn(ft.OPVector, X),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+    est = OpNaiveBayes()
+    label.transform_with(est, feats)
+    model = est.fit(store)
+    pred, _, prob = model.predict_arrays(X)
+    assert (pred == y).mean() > 0.8
+
+
+def test_binary_metrics_known_values():
+    y = np.array([1, 1, 0, 0])
+    scores = np.array([0.9, 0.6, 0.4, 0.1])
+    pred = (scores > 0.5).astype(float)
+    m = M.binary_metrics(y, pred, scores)
+    assert m["AuROC"] == 1.0  # perfect ranking
+    assert m["Precision"] == 1.0 and m["Recall"] == 1.0 and m["Error"] == 0.0
+    # worst ranking
+    m2 = M.binary_metrics(y, 1 - pred, 1 - scores)
+    assert m2["AuROC"] == 0.0
+
+
+def test_auroc_matches_sklearn_formula(rng):
+    # rank-statistic cross-check on random data
+    y = rng.integers(0, 2, size=200).astype(float)
+    s = rng.random(200)
+    pos = s[y == 1]
+    neg = s[y == 0]
+    # Mann-Whitney U
+    expected = np.mean([(p > q) + 0.5 * (p == q) for p in pos for q in neg])
+    assert abs(M.auroc(y, s) - expected) < 1e-9
+
+
+def test_multiclass_and_regression_metrics():
+    y = np.array([0, 1, 2, 1])
+    p = np.array([0, 1, 1, 1])
+    m = M.multiclass_metrics(y, p)
+    assert m["Error"] == 0.25
+    r = M.regression_metrics(np.array([1.0, 2.0]), np.array([1.5, 2.5]))
+    assert abs(r["RootMeanSquaredError"] - 0.5) < 1e-12
+    assert abs(r["MeanAbsoluteError"] - 0.5) < 1e-12
+
+
+def test_evaluator_factory():
+    ev = Evaluators.BinaryClassification.auPR()
+    assert ev.metric_name == "AuPR" and ev.is_larger_better
+    ev2 = Evaluators.Regression.rmse()
+    assert ev2.metric_name == "RootMeanSquaredError"
+    assert not ev2.is_larger_better

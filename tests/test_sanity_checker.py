@@ -1,0 +1,98 @@
+"""SanityChecker tests (SanityCheckerTest analog)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, column_from_values
+from transmogrifai_tpu.columns import VectorColumn
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.vector_metadata import (VectorColumnMetadata,
+                                               VectorMetadata, NULL_INDICATOR)
+
+
+def _store_with_meta(rng, n=200):
+    y = rng.integers(0, 2, size=n).astype(float)
+    x_good = rng.normal(size=n) + 0.5 * y
+    x_const = np.full(n, 3.0)            # zero variance
+    x_leak = y * 2.0 - 1.0               # perfect correlation with label
+    x_noise = rng.normal(size=n)
+    X = np.stack([x_good, x_const, x_leak, x_noise], axis=1)
+    meta = VectorMetadata("features", [
+        VectorColumnMetadata("good", "Real"),
+        VectorColumnMetadata("const", "Real"),
+        VectorColumnMetadata("leak", "Real"),
+        VectorColumnMetadata("noise", "Real"),
+    ])
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "features": VectorColumn(ft.OPVector, X, meta),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+    return store, label, feats
+
+
+def test_drops_zero_variance_and_leaky(rng):
+    store, label, feats = _store_with_meta(rng)
+    checker = SanityChecker(remove_bad_features=True,
+                            remove_feature_group=False)
+    label.transform_with(checker, feats)
+    model = checker.fit(store)
+    kept_names = [model.summary_.names[i] for i in model.keep_indices]
+    assert "good_0" in kept_names and "noise_3" in kept_names
+    assert "const_1" not in kept_names  # zero variance
+    assert "leak_2" not in kept_names   # |corr| > 0.95
+    dropped = {d["name"]: d["reasons"] for d in model.summary_.dropped}
+    assert any("variance" in r for r in dropped["const_1"])
+    assert any("corr" in r for r in dropped["leak_2"])
+    out = model.transform_columns(store)
+    assert out.values.shape[1] == len(model.keep_indices)
+    assert out.metadata.size == len(model.keep_indices)
+
+
+def test_keeps_all_when_removal_off(rng):
+    store, label, feats = _store_with_meta(rng)
+    checker = SanityChecker(remove_bad_features=False)
+    label.transform_with(checker, feats)
+    model = checker.fit(store)
+    assert len(model.keep_indices) == 4
+    assert len(model.summary_.dropped) > 0  # still reported
+
+
+def test_cramers_v_flags_leaky_categorical(rng):
+    n = 300
+    y = rng.integers(0, 2, size=n).astype(float)
+    # categorical perfectly aligned with label, one-hot into 2 slots
+    cat = np.stack([y, 1 - y], axis=1)
+    noise = rng.normal(size=(n, 1))
+    X = np.concatenate([cat, noise], axis=1)
+    meta = VectorMetadata("features", [
+        VectorColumnMetadata("cat", "PickList", grouping="cat",
+                             indicator_value="a"),
+        VectorColumnMetadata("cat", "PickList", grouping="cat",
+                             indicator_value="b"),
+        VectorColumnMetadata("noise", "Real"),
+    ])
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "features": VectorColumn(ft.OPVector, X, meta),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+    checker = SanityChecker(remove_bad_features=True)
+    label.transform_with(checker, feats)
+    model = checker.fit(store)
+    kept = [model.summary_.names[i] for i in model.keep_indices]
+    assert kept == ["noise_2"]
+    stats = model.summary_.categorical_stats
+    assert stats and stats[0]["cramersV"] > 0.95
+
+
+def test_summary_json(rng):
+    store, label, feats = _store_with_meta(rng)
+    checker = SanityChecker()
+    label.transform_with(checker, feats)
+    model = checker.fit(store)
+    js = model.summary()
+    assert "columnStats" in js and len(js["columnStats"]) == 4
+    assert "correlationsWithLabel" in js

@@ -1,0 +1,134 @@
+"""ModelSelector + tuning tests (ModelSelectorTest / OpCrossValidationTest analogs)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, column_from_values
+from transmogrifai_tpu.columns import VectorColumn
+from transmogrifai_tpu.models import (BinaryClassificationModelSelector,
+                                      CrossValidation, DataBalancer,
+                                      DataCutter, LogisticRegressionFamily,
+                                      MultiClassificationModelSelector,
+                                      NaiveBayesFamily,
+                                      RegressionModelSelector,
+                                      LinearRegressionFamily)
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _clf_store(rng, n=300, d=4, n_classes=2):
+    X = rng.normal(size=(n, d))
+    w = rng.normal(size=(d, n_classes))
+    y = np.argmax(X @ w + rng.normal(scale=0.5, size=(n, n_classes)), axis=1)
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y.astype(float)),
+        "features": VectorColumn(ft.OPVector, X),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+    return store, label, feats, y
+
+
+def test_binary_selector_cv(rng):
+    store, label, feats, y = _clf_store(rng)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, families=[LogisticRegressionFamily(
+            grid=[{"regParam": 0.01, "elasticNetParam": 0.0},
+                  {"regParam": 0.1, "elasticNetParam": 0.5}])])
+    pred = label.transform_with(sel, feats)
+    model = sel.fit(store)
+    summ = model.selector_summary
+    assert summ.best_model_name == "OpLogisticRegression"
+    assert len(summ.validator_summary.results) == 2
+    for r in summ.validator_summary.results:
+        assert len(r.metric_values) == 3  # 3 folds
+    assert summ.train_evaluation["AuROC"] > 0.8
+    out = model.transform_columns(store)
+    assert out.prediction.shape == (300,)
+
+
+def test_multiclass_selector(rng):
+    store, label, feats, y = _clf_store(rng, n_classes=3)
+    sel = MultiClassificationModelSelector.with_cross_validation(
+        num_folds=2,
+        families=[LogisticRegressionFamily(grid=[{"regParam": 0.01,
+                                                  "elasticNetParam": 0.0}]),
+                  NaiveBayesFamily()])
+    pred = label.transform_with(sel, feats)
+    model = sel.fit(store)
+    assert model.selector_summary.train_evaluation["F1"] > 0.6
+    out = model.transform_columns(store)
+    assert out.probability.shape == (300, 3)
+
+
+def test_regression_selector(rng):
+    n = 200
+    X = rng.normal(size=(n, 3))
+    y = X @ np.array([1.0, 2.0, -1.0]) + 0.5
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "features": VectorColumn(ft.OPVector, X),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+    sel = RegressionModelSelector.with_train_validation_split(
+        families=[LinearRegressionFamily(
+            grid=[{"regParam": 0.0, "elasticNetParam": 0.0}])])
+    pred = label.transform_with(sel, feats)
+    model = sel.fit(store)
+    assert model.selector_summary.train_evaluation["RootMeanSquaredError"] < 0.1
+
+
+def test_balancer_weights():
+    b = DataBalancer(sample_fraction=0.2)
+    y = np.array([1.0] * 5 + [0.0] * 95)
+    b.pre_validation_prepare(y)
+    w = b.sample_weights(y)
+    # weighted positive fraction should hit the target
+    frac = w[y == 1].sum() / w.sum()
+    assert abs(frac - 0.2) < 1e-9
+    assert b.summary["positiveLabels"] == 5
+
+
+def test_balancer_no_op_when_balanced():
+    b = DataBalancer(sample_fraction=0.1)
+    y = np.array([1.0] * 50 + [0.0] * 50)
+    b.pre_validation_prepare(y)
+    assert np.all(b.sample_weights(y) == 1.0)
+
+
+def test_cutter_drops_rare_labels():
+    c = DataCutter(min_label_fraction=0.2)
+    y = np.array([0.0] * 50 + [1.0] * 45 + [2.0] * 5)
+    c.pre_validation_prepare(y)
+    keep = c.keep_mask(y)
+    assert keep.sum() == 95
+    assert c.summary["labelsDropped"] == [2.0]
+
+
+def test_cv_fold_masks_partition():
+    cv = CrossValidation(num_folds=3, task="binary")
+    y = np.zeros(10)
+    splits = cv._splits(y)
+    assert len(splits) == 3
+    val_total = sum(v for _, v in splits)
+    np.testing.assert_allclose(val_total, np.ones(10))  # each row in 1 fold
+    for tr, v in splits:
+        np.testing.assert_allclose(tr + v, np.ones(10))
+
+
+def test_selector_in_workflow_with_holdout(rng):
+    store, label, feats, y = _clf_store(rng)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2,
+        families=[LogisticRegressionFamily(grid=[{"regParam": 0.01,
+                                                  "elasticNetParam": 0.0}])],
+        splitter=DataBalancer(reserve_test_fraction=0.2))
+    pred = label.transform_with(sel, feats)
+    wf = (Workflow().set_input_store(store).set_result_features(pred)
+          .set_splitter(sel.splitter))
+    model = wf.train()
+    selected = model.fitted_stages[sel.uid]
+    assert selected.selector_summary.holdout_evaluation is not None
+    assert "AuPR" in selected.selector_summary.holdout_evaluation
+    scored = model.score(store)
+    assert pred.name in scored.names()

@@ -1,0 +1,190 @@
+"""Vectorizer tests (parity with core/.../impl/feature tests)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder
+from transmogrifai_tpu.ops import (BinaryVectorizer, IntegralVectorizer,
+                                   OneHotVectorizer, RealVectorizer,
+                                   SetVectorizer, SmartTextVectorizer,
+                                   TextTokenizer, transmogrify)
+from transmogrifai_tpu.ops.hashing import HashingVectorizerModel, murmur3_32
+from transmogrifai_tpu.ops.dates import DateToUnitCircleVectorizer, TimePeriod
+from transmogrifai_tpu.ops.geo import GeolocationVectorizer
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _fit_transform(stage, store, *features):
+    features[0].transform_with(stage, *features[1:])
+    model = stage.fit(store) if hasattr(stage, "fit_columns") and not \
+        hasattr(stage, "vocabs") else stage
+    from transmogrifai_tpu.stages.base import Estimator
+    if isinstance(stage, Estimator):
+        model = stage.fit(store)
+    else:
+        model = stage
+    return model, model.transform_columns(store)
+
+
+def test_real_vectorizer_mean_impute():
+    a = FeatureBuilder.Real("a").from_column().as_predictor()
+    b = FeatureBuilder.Real("b").from_column().as_predictor()
+    store = ColumnStore.from_dict({
+        "a": (ft.Real, [1.0, None, 3.0]),
+        "b": (ft.Real, [10.0, 20.0, None]),
+    })
+    est = RealVectorizer()
+    model, out = _fit_transform(est, store, a, b)
+    # layout: [a, a_null, b, b_null]
+    np.testing.assert_allclose(out.values, [
+        [1.0, 0.0, 10.0, 0.0],
+        [2.0, 1.0, 20.0, 0.0],
+        [3.0, 0.0, 15.0, 1.0],
+    ])
+    meta = out.metadata
+    assert meta.size == 4
+    assert meta.columns[1].is_null_indicator()
+    assert meta.columns[2].parent_feature_name == "b"
+
+
+def test_integral_mode_impute():
+    a = FeatureBuilder.Integral("a").from_column().as_predictor()
+    store = ColumnStore.from_dict({"a": (ft.Integral, [5, 5, 7, None])})
+    model, out = _fit_transform(IntegralVectorizer(), store, a)
+    np.testing.assert_allclose(out.values[:, 0], [5, 5, 7, 5])
+    assert out.values[3, 1] == 1.0  # null tracked
+
+
+def test_binary_vectorizer():
+    a = FeatureBuilder.Binary("a").from_column().as_predictor()
+    store = ColumnStore.from_dict({"a": (ft.Binary, [True, None, False])})
+    model, out = _fit_transform(BinaryVectorizer(), store, a)
+    np.testing.assert_allclose(out.values, [[1, 0], [0, 1], [0, 0]])
+
+
+def test_onehot_topk_other_null():
+    a = FeatureBuilder.PickList("color").from_column().as_predictor()
+    values = ["red"] * 5 + ["blue"] * 3 + ["green"] * 1 + [None]
+    store = ColumnStore.from_dict({"color": (ft.PickList, values)})
+    est = OneHotVectorizer(top_k=2, min_support=2)
+    model, out = _fit_transform(est, store, a)
+    assert model.vocabs == [["red", "blue"]]  # green below min_support
+    # columns: red, blue, OTHER, null
+    assert out.values.shape == (10, 4)
+    np.testing.assert_allclose(out.values[0], [1, 0, 0, 0])
+    np.testing.assert_allclose(out.values[5], [0, 1, 0, 0])
+    np.testing.assert_allclose(out.values[8], [0, 0, 1, 0])  # green -> OTHER
+    np.testing.assert_allclose(out.values[9], [0, 0, 0, 1])  # null
+    assert out.metadata.columns[2].is_other_indicator()
+
+
+def test_set_vectorizer():
+    a = FeatureBuilder.MultiPickList("tags").from_column().as_predictor()
+    store = ColumnStore.from_dict({
+        "tags": (ft.MultiPickList, [["a", "b"], ["a"], [], ["c"]])})
+    est = SetVectorizer(top_k=2, min_support=1)
+    model, out = _fit_transform(est, store, a)
+    # vocab: a (2), b (1), c (1) -> ties by value: [a, b]
+    assert model.vocabs == [["a", "b"]]
+    np.testing.assert_allclose(out.values[0][:2], [1, 1])
+    assert out.values[2][3] == 1.0  # null slot
+    assert out.values[3][2] == 1.0  # c -> OTHER
+
+
+def test_murmur3_known_values():
+    # standard murmur3_x86_32 test vectors (public algorithm)
+    assert murmur3_32(b"", 0) == 0
+    assert murmur3_32(b"", 1) == 0x514E28B7
+    assert murmur3_32(b"hello", 0) == 0x248BFA47
+    assert murmur3_32(b"hello, world", 0) == 0x149BBB7F
+
+
+def test_hashing_vectorizer():
+    a = FeatureBuilder.TextList("toks").from_column().as_predictor()
+    store = ColumnStore.from_dict({
+        "toks": (ft.TextList, [["x", "y", "x"], [], ["z"]])})
+    model = HashingVectorizerModel(num_features=16, input_names=["toks"])
+    a.transform_with(model)
+    out = model.transform_columns(store)
+    assert out.values.shape == (3, 17)  # 16 + null
+    assert out.values[0].sum() == 3.0  # token counts
+    assert out.values[1, 16] == 1.0  # null tracked
+    assert out.metadata.size == 17
+
+
+def test_smart_text_routes_by_cardinality():
+    cat = FeatureBuilder.Text("cat").from_column().as_predictor()
+    free = FeatureBuilder.Text("free").from_column().as_predictor()
+    n = 30
+    store = ColumnStore.from_dict({
+        "cat": (ft.Text, ["a" if i % 2 else "b" for i in range(n)]),
+        "free": (ft.Text, [f"unique text number {i}" for i in range(n)]),
+    })
+    est = SmartTextVectorizer(max_cardinality=5, top_k=3, min_support=1,
+                              num_features=32)
+    model, out = _fit_transform(est, store, cat, free)
+    assert model.is_categorical == [True, False]
+    # cat: 3+1+1 = top3 is only 2 values -> 2+1+1=4 cols; free: 32 + null
+    assert out.values.shape[1] == model.vector_metadata().size
+    assert out.metadata.columns[0].indicator_value in ("a", "b")
+
+
+def test_date_unit_circle():
+    d = FeatureBuilder.Date("d").from_column().as_predictor()
+    ms_noon = 12 * 3600 * 1000  # epoch day 0 at noon
+    store = ColumnStore.from_dict({"d": (ft.Date, [ms_noon, None])})
+    model = DateToUnitCircleVectorizer(periods=[TimePeriod.HOUR_OF_DAY],
+                                       input_names=["d"])
+    d.transform_with(model)
+    out = model.transform_columns(store)
+    # noon -> theta = pi -> sin=0, cos=-1
+    np.testing.assert_allclose(out.values[0, :2], [0.0, -1.0], atol=1e-9)
+    assert out.values[1, 2] == 1.0  # null
+
+
+def test_geo_vectorizer_fill_geo_mean():
+    g = FeatureBuilder.Geolocation("loc").from_column().as_predictor()
+    store = ColumnStore.from_dict({
+        "loc": (ft.Geolocation, [[10.0, 20.0, 1.0], [20.0, 30.0, 3.0], None])})
+    est = GeolocationVectorizer()
+    model, out = _fit_transform(est, store, g)
+    assert out.values.shape == (3, 4)
+    filled = out.values[2]
+    assert 10.0 < filled[0] < 20.0 and 20.0 < filled[1] < 30.0
+    assert filled[3] == 1.0
+
+
+def test_text_tokenizer():
+    t = FeatureBuilder.Text("t").from_column().as_predictor()
+    tok = TextTokenizer()
+    out_feat = t.transform_with(tok)
+    assert out_feat.ftype is ft.TextList
+    store = ColumnStore.from_dict({"t": (ft.Text, ["Hello, World!", None])})
+    out = tok.transform_columns(store)
+    assert out.values[0] == ["hello", "world"]
+    assert out.values[1] == []
+
+
+def test_transmogrify_end_to_end_workflow():
+    age = FeatureBuilder.Real("age").from_column().as_predictor()
+    cls = FeatureBuilder.Integral("cls").from_column().as_predictor()
+    sex = FeatureBuilder.PickList("sex").from_column().as_predictor()
+    vec = transmogrify([age, cls, sex])
+    store = ColumnStore.from_dict({
+        "age": (ft.Real, [22.0, None, 30.0, 41.0]),
+        "cls": (ft.Integral, [1, 2, 3, None]),
+        "sex": (ft.PickList, ["m", "f", "m", None]),
+    })
+    wf = Workflow().set_input_store(store).set_result_features(vec)
+    model = wf.train()
+    scored = model.score(store, keep_intermediate=True)
+    out = scored[vec.name]
+    assert out.values.shape[0] == 4
+    assert out.metadata is not None
+    assert out.values.shape[1] == out.metadata.size
+    # every parent feature is represented in provenance
+    assert set(out.metadata.parent_features()) >= {"age", "cls", "sex"}
+    # score_fn row path agrees with columnar path
+    fn = model.score_fn()
+    row_out = fn({"age": 22.0, "cls": 1, "sex": "m"})
+    np.testing.assert_allclose(np.asarray(row_out[vec.name]), out.values[0])

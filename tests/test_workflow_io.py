@@ -1,0 +1,80 @@
+"""Workflow persistence round-trip (OpWorkflowModelReaderWriterTest analog)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, Workflow
+from transmogrifai_tpu.models import (BinaryClassificationModelSelector,
+                                      LogisticRegressionFamily)
+from transmogrifai_tpu.ops import transmogrify
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import WorkflowModel
+
+
+def _make_store(n=120, seed=3):
+    rng = np.random.default_rng(seed)
+    age = rng.normal(40, 10, size=n)
+    age[rng.random(n) < 0.2] = np.nan
+    cls = rng.integers(1, 4, size=n).astype(float)
+    sex = rng.choice(["m", "f"], size=n)
+    y = ((sex == "f") | (rng.random(n) < 0.2)).astype(float)
+    return ColumnStore.from_dict({
+        "age": (ft.Real, [None if np.isnan(a) else a for a in age]),
+        "cls": (ft.Integral, cls.tolist()),
+        "sex": (ft.PickList, sex.tolist()),
+        "y": (ft.RealNN, y.tolist()),
+    })
+
+
+def test_save_load_roundtrip(tmp_path):
+    store = _make_store()
+    y = FeatureBuilder.RealNN("y").from_column().as_response()
+    age = FeatureBuilder.Real("age").from_column().as_predictor()
+    cls = FeatureBuilder.Integral("cls").from_column().as_predictor()
+    sex = FeatureBuilder.PickList("sex").from_column().as_predictor()
+    vec = transmogrify([age, cls, sex])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily(
+            grid=[{"regParam": 0.01, "elasticNetParam": 0.0}])])
+    pred = y.transform_with(sel, vec)
+    model = Workflow().set_input_store(store).set_result_features(pred).train()
+
+    scored1 = model.score(store)
+    path = str(tmp_path / "model")
+    model.save(path)
+
+    loaded = WorkflowModel.load(path)
+    scored2 = loaded.score(store)
+    np.testing.assert_allclose(scored1[pred.name].prediction,
+                               scored2[pred.name].prediction)
+    np.testing.assert_allclose(scored1[pred.name].probability,
+                               scored2[pred.name].probability, atol=1e-12)
+
+    # row-level serving from the loaded model
+    fn = loaded.score_fn()
+    row = store.row(0)
+    out = fn(row)
+    assert abs(out[pred.name]["prediction"]
+               - scored1[pred.name].prediction[0]) < 1e-9
+
+    # overwrite protection
+    with pytest.raises(FileExistsError):
+        model.save(path)
+    model.save(path, overwrite=True)
+
+
+def test_loaded_model_summary(tmp_path):
+    store = _make_store()
+    y = FeatureBuilder.RealNN("y").from_column().as_response()
+    age = FeatureBuilder.Real("age").from_column().as_predictor()
+    vec = transmogrify([age])
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        families=[LogisticRegressionFamily(
+            grid=[{"regParam": 0.01, "elasticNetParam": 0.0}])])
+    pred = y.transform_with(sel, vec)
+    model = Workflow().set_input_store(store).set_result_features(pred).train()
+    path = str(tmp_path / "m")
+    model.save(path)
+    loaded = WorkflowModel.load(path)
+    assert loaded.uid == model.uid
+    assert {f.name for f in loaded.result_features} == \
+        {f.name for f in model.result_features}

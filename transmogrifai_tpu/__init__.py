@@ -14,3 +14,5 @@ from . import types  # noqa: F401
 from .columns import Column, ColumnStore, column_from_values  # noqa: F401
 from .features import Feature, FeatureBuilder  # noqa: F401
 from .vector_metadata import VectorColumnMetadata, VectorMetadata  # noqa: F401
+from . import dsl  # noqa: F401  (attaches Feature operators)
+from .workflow import Workflow, WorkflowModel  # noqa: F401

@@ -1,0 +1,120 @@
+"""Metric computations — pure array math (numpy or jax.numpy).
+
+Parity: Spark MLlib's ``BinaryClassificationMetrics`` /
+``MulticlassMetrics`` / ``RegressionMetrics`` as consumed by the reference's
+evaluators (``core/.../evaluators/OpBinaryClassificationEvaluator.scala:180-203``
+etc.). AuROC/AuPR follow MLlib's threshold-curve construction: thresholds at
+every distinct score, ROC prepended with (0,0) and appended with (1,1),
+PR prepended with (0, p@first-threshold); areas by trapezoid.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["binary_metrics", "multiclass_metrics", "regression_metrics",
+           "auroc", "aupr", "confusion_binary"]
+
+
+def _curve_points(labels: np.ndarray, scores: np.ndarray
+                  ) -> Tuple[np.ndarray, np.ndarray, float, float]:
+    """Cumulative TP/FP at each distinct score threshold (descending)."""
+    order = np.argsort(-scores, kind="stable")
+    s = scores[order]
+    y = labels[order]
+    # group equal scores: take cumulative counts at last index of each group
+    boundaries = np.nonzero(np.diff(s))[0]
+    idx = np.concatenate([boundaries, [len(s) - 1]])
+    tp_cum = np.cumsum(y)[idx].astype(np.float64)
+    fp_cum = np.cumsum(1 - y)[idx].astype(np.float64)
+    p = float(labels.sum())
+    n = float(len(labels) - p)
+    return tp_cum, fp_cum, p, n
+
+
+def auroc(labels: np.ndarray, scores: np.ndarray) -> float:
+    tp, fp, p, n = _curve_points(labels, scores)
+    if p == 0 or n == 0:
+        return 0.0
+    tpr = np.concatenate([[0.0], tp / p, [1.0]])
+    fpr = np.concatenate([[0.0], fp / n, [1.0]])
+    return float(np.trapezoid(tpr, fpr))
+
+
+def aupr(labels: np.ndarray, scores: np.ndarray) -> float:
+    tp, fp, p, _ = _curve_points(labels, scores)
+    if p == 0:
+        return 0.0
+    precision = tp / np.maximum(tp + fp, 1e-12)
+    recall = tp / p
+    # MLlib prepends (0, precision@first)
+    precision = np.concatenate([[precision[0]], precision])
+    recall = np.concatenate([[0.0], recall])
+    return float(np.trapezoid(precision, recall))
+
+
+def confusion_binary(labels: np.ndarray, predictions: np.ndarray
+                     ) -> Tuple[float, float, float, float]:
+    tp = float(np.sum((predictions == 1) & (labels == 1)))
+    tn = float(np.sum((predictions == 0) & (labels == 0)))
+    fp = float(np.sum((predictions == 1) & (labels == 0)))
+    fn = float(np.sum((predictions == 0) & (labels == 1)))
+    return tp, tn, fp, fn
+
+
+def binary_metrics(labels: np.ndarray, predictions: np.ndarray,
+                   scores: Optional[np.ndarray] = None) -> Dict[str, float]:
+    """Precision/Recall/F1/Error/AuROC/AuPR/TP/TN/FP/FN
+    (OpBinaryClassificationEvaluator.scala:180-203)."""
+    labels = np.asarray(labels, dtype=np.float64)
+    predictions = np.asarray(predictions, dtype=np.float64)
+    tp, tn, fp, fn = confusion_binary(labels, predictions)
+    precision = tp / (tp + fp) if tp + fp > 0 else 0.0
+    recall = tp / (tp + fn) if tp + fn > 0 else 0.0
+    f1 = (2 * precision * recall / (precision + recall)
+          if precision + recall > 0 else 0.0)
+    err = (fp + fn) / max(len(labels), 1)
+    out = {"Precision": precision, "Recall": recall, "F1": f1, "Error": err,
+           "TP": tp, "TN": tn, "FP": fp, "FN": fn}
+    if scores is not None:
+        scores = np.asarray(scores, dtype=np.float64)
+        out["AuROC"] = auroc(labels, scores)
+        out["AuPR"] = aupr(labels, scores)
+    return out
+
+
+def multiclass_metrics(labels: np.ndarray, predictions: np.ndarray
+                       ) -> Dict[str, float]:
+    """Weighted Precision/Recall/F1 + Error (MulticlassMetrics parity)."""
+    labels = np.asarray(labels).astype(np.int64)
+    predictions = np.asarray(predictions).astype(np.int64)
+    classes = np.unique(np.concatenate([labels, predictions]))
+    n = max(len(labels), 1)
+    w_prec = w_rec = w_f1 = 0.0
+    for c in classes:
+        tp = float(np.sum((predictions == c) & (labels == c)))
+        fp = float(np.sum((predictions == c) & (labels != c)))
+        fn = float(np.sum((predictions != c) & (labels == c)))
+        weight = float(np.sum(labels == c)) / n
+        prec = tp / (tp + fp) if tp + fp > 0 else 0.0
+        rec = tp / (tp + fn) if tp + fn > 0 else 0.0
+        f1 = 2 * prec * rec / (prec + rec) if prec + rec > 0 else 0.0
+        w_prec += weight * prec
+        w_rec += weight * rec
+        w_f1 += weight * f1
+    error = float(np.mean(labels != predictions)) if len(labels) else 0.0
+    return {"Precision": w_prec, "Recall": w_rec, "F1": w_f1, "Error": error}
+
+
+def regression_metrics(labels: np.ndarray, predictions: np.ndarray
+                       ) -> Dict[str, float]:
+    labels = np.asarray(labels, dtype=np.float64)
+    predictions = np.asarray(predictions, dtype=np.float64)
+    resid = labels - predictions
+    mse = float(np.mean(resid ** 2)) if len(labels) else 0.0
+    mae = float(np.mean(np.abs(resid))) if len(labels) else 0.0
+    var = float(np.mean((labels - labels.mean()) ** 2)) if len(labels) else 0.0
+    r2 = 1.0 - mse / var if var > 0 else 0.0
+    return {"RootMeanSquaredError": float(np.sqrt(mse)),
+            "MeanSquaredError": mse, "MeanAbsoluteError": mae, "R2": r2}

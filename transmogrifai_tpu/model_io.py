@@ -1,0 +1,207 @@
+"""Model persistence — the ``op-model.json`` analog.
+
+Mirrors ``OpWorkflowModelWriter``/``Reader``
+(``core/.../OpWorkflowModelWriter.scala:75-146``, ``OpWorkflowModelReader.scala``):
+one ``model.json`` holding the workflow uid, result-feature uids, the
+topologically-sorted feature graph and stage descriptors (class + ctor
+params + JSON state), plus one ``weights.npz`` holding every stage's numeric
+arrays. Stages are reconstructed from ``STAGE_REGISTRY`` by class name, the
+feature graph is rebuilt topologically, and fitted models are rebound by uid
+— which is also what powers warm-starting (``OpWorkflow.withModelStages``).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .columns import ColumnStore
+from .features import Feature
+from .graph import compute_dag
+from .stages.base import FittedModel, OpPipelineStage, STAGE_REGISTRY, Transformer
+from .stages.generator import FeatureGeneratorStage
+from .types.feature_types import FeatureType, feature_type_by_name
+from .vector_metadata import VectorMetadata
+
+MODEL_JSON = "model.json"
+WEIGHTS_NPZ = "weights.npz"
+FORMAT_VERSION = 1
+
+
+def _encode_param(v: Any, arrays: Dict[str, np.ndarray], prefix: str) -> Any:
+    if isinstance(v, type) and issubclass(v, FeatureType):
+        return {"__ftype__": v.__name__}
+    if isinstance(v, np.ndarray):
+        key = f"{prefix}::{len(arrays)}"
+        arrays[key] = v
+        return {"__array__": key}
+    if isinstance(v, VectorMetadata):
+        return {"__vecmeta__": v.to_json()}
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (list, tuple)):
+        return [_encode_param(x, arrays, prefix) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _encode_param(x, arrays, prefix) for k, x in v.items()}
+    if callable(v):
+        return {"__dropped_callable__": getattr(v, "__name__", "fn")}
+    return v
+
+
+def _decode_param(v: Any, arrays: Dict[str, np.ndarray]) -> Any:
+    if isinstance(v, dict):
+        if "__ftype__" in v:
+            return feature_type_by_name(v["__ftype__"])
+        if "__array__" in v:
+            return arrays[v["__array__"]]
+        if "__vecmeta__" in v:
+            return VectorMetadata.from_json(v["__vecmeta__"])
+        if "__dropped_callable__" in v:
+            return None
+        return {k: _decode_param(x, arrays) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode_param(x, arrays) for x in v]
+    return v
+
+
+def _stage_record(stage: OpPipelineStage, arrays: Dict[str, np.ndarray]
+                  ) -> Dict[str, Any]:
+    params = _encode_param(stage.get_params(), arrays, stage.uid)
+    rec: Dict[str, Any] = {
+        "className": type(stage).__name__,
+        "uid": stage.uid,
+        "params": params,
+        "inputFeatureUids": [f.uid for f in stage.input_features],
+    }
+    if isinstance(stage, FittedModel):
+        rec["isModel"] = True
+        state = _encode_param(stage.get_model_state(), arrays, stage.uid + "#s")
+        rec["modelState"] = state
+    return rec
+
+
+def _feature_record(f: Feature) -> Dict[str, Any]:
+    return {
+        "uid": f.uid,
+        "name": f.name,
+        "typeName": f.ftype.__name__,
+        "isResponse": f.is_response,
+        "originStageUid": f.origin_stage.uid if f.origin_stage else None,
+        "parentUids": [p.uid for p in f.parents],
+    }
+
+
+def _topo_features(result_features) -> List[Feature]:
+    """All features reachable from results, parents before children."""
+    order: List[Feature] = []
+    seen = set()
+
+    def visit(f: Feature) -> None:
+        if f.uid in seen:
+            return
+        seen.add(f.uid)
+        for p in f.parents:
+            visit(p)
+        order.append(f)
+
+    for f in result_features:
+        visit(f)
+    return order
+
+
+def save_workflow_model(model, path: str, overwrite: bool = False) -> None:
+    if os.path.exists(os.path.join(path, MODEL_JSON)) and not overwrite:
+        raise FileExistsError(f"Model already exists at {path}")
+    os.makedirs(path, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+
+    features = _topo_features(model.result_features)
+    stage_records: List[Dict[str, Any]] = []
+    recorded = set()
+    for f in features:
+        st = f.origin_stage
+        if st is None or st.uid in recorded:
+            continue
+        recorded.add(st.uid)
+        fitted = model.fitted_stages.get(st.uid, st)
+        stage_records.append(_stage_record(fitted, arrays))
+
+    doc = {
+        "formatVersion": FORMAT_VERSION,
+        "uid": model.uid,
+        "resultFeatureUids": [f.uid for f in model.result_features],
+        "blacklistedFeatureUids": [f.uid for f in model.blacklisted_features],
+        "features": [_feature_record(f) for f in features],
+        "stages": stage_records,
+        "parameters": model.parameters,
+        "trainTimeSeconds": model.train_time_s,
+        "rawFeatureFilterResults": (model.rff_results.to_json()
+                                    if model.rff_results is not None else None),
+    }
+    with open(os.path.join(path, MODEL_JSON), "w") as fh:
+        json.dump(doc, fh, indent=1, default=str)
+    np.savez(os.path.join(path, WEIGHTS_NPZ), **arrays)
+
+
+def load_workflow_model(path: str):
+    from .workflow import WorkflowModel
+
+    with open(os.path.join(path, MODEL_JSON)) as fh:
+        doc = json.load(fh)
+    npz_path = os.path.join(path, WEIGHTS_NPZ)
+    arrays: Dict[str, np.ndarray] = {}
+    if os.path.exists(npz_path):
+        with np.load(npz_path, allow_pickle=False) as npz:
+            arrays = {k: npz[k] for k in npz.files}
+
+    stage_by_uid: Dict[str, OpPipelineStage] = {}
+    for rec in doc["stages"]:
+        cls = STAGE_REGISTRY.get(rec["className"])
+        if cls is None:
+            raise ValueError(
+                f"Stage class {rec['className']!r} is not registered; "
+                "import its module before loading")
+        params = _decode_param(rec["params"], arrays)
+        params.pop("uid", None)
+        stage = cls(uid=rec["uid"], **params)
+        if rec.get("isModel"):
+            state = _decode_param(rec.get("modelState", {}), arrays)
+            if hasattr(stage, "apply_model_state"):
+                stage.apply_model_state(state)
+            else:
+                for k, v in state.items():
+                    setattr(stage, k, v)
+        stage_by_uid[rec["uid"]] = stage
+
+    feat_by_uid: Dict[str, Feature] = {}
+    for frec in doc["features"]:
+        stage = stage_by_uid.get(frec["originStageUid"])
+        if stage is None:
+            raise ValueError(f"Feature {frec['name']!r} has unknown origin stage")
+        if frec["parentUids"]:
+            parents = [feat_by_uid[u] for u in frec["parentUids"]]
+            if tuple(stage.input_features) != tuple(parents):
+                stage.set_input(*parents)
+            feat = stage.get_output()
+        else:
+            feat = stage.get_output()
+        feat.uid = frec["uid"]
+        feat.name = frec["name"]
+        feat.is_response = frec["isResponse"]
+        feat_by_uid[frec["uid"]] = feat
+
+    result_features = [feat_by_uid[u] for u in doc["resultFeatureUids"]]
+    fitted = {uid: st for uid, st in stage_by_uid.items()
+              if isinstance(st, FittedModel)}
+    model = WorkflowModel(
+        result_features=result_features,
+        fitted_stages=fitted,
+        parameters=doc.get("parameters") or {},
+        train_time_s=doc.get("trainTimeSeconds", 0.0),
+    )
+    model.uid = doc["uid"]
+    return model

@@ -1,0 +1,11 @@
+from .base import ModelFamily, PredictorEstimator, PredictorModel  # noqa: F401
+from .linear import (OpLogisticRegression, LogisticRegressionModel,  # noqa: F401
+                     LogisticRegressionFamily, OpLinearRegression,
+                     LinearRegressionModel, LinearRegressionFamily,
+                     OpNaiveBayes, NaiveBayesModel, NaiveBayesFamily)
+from .tuning import (CrossValidation, TrainValidationSplit, DataSplitter,  # noqa: F401
+                     DataBalancer, DataCutter, Splitter)
+from .selector import (ModelSelector, SelectedModel, ModelSelectorSummary,  # noqa: F401
+                       BinaryClassificationModelSelector,
+                       MultiClassificationModelSelector,
+                       RegressionModelSelector)

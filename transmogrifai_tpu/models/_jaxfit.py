@@ -1,0 +1,260 @@
+"""Pure-JAX model fitting kernels — static shapes, vmappable, mesh-shardable.
+
+These are the TPU replacement for Spark MLlib's LBFGS/OWLQN solvers: every
+fit is a fixed-iteration FISTA (accelerated proximal gradient) loop expressed
+with ``lax.fori_loop`` so XLA compiles one program for the entire
+(fold × hyperparameter) batch under ``vmap``. Elastic-net matches MLlib's
+objective: ``1/n Σ w_i ℓ_i + reg * (α ||β||₁ + (1-α)/2 ||β||²)`` with
+internal feature standardization and an unpenalized intercept
+(Spark ``LogisticRegression`` semantics).
+
+Sample weights ``w`` double as fold masks: the CV engine passes 0/1 vectors
+so one compiled computation serves every fold.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["fit_binary_logistic", "fit_multinomial_logistic", "fit_linear",
+           "fit_naive_bayes", "predict_binary_logistic",
+           "predict_multinomial_logistic", "predict_linear",
+           "predict_naive_bayes", "standardize_stats"]
+
+
+def standardize_stats(X, w):
+    """Weighted per-feature mean/std (std>=eps to keep constants harmless)."""
+    wsum = jnp.maximum(w.sum(), 1e-12)
+    mean = (X * w[:, None]).sum(0) / wsum
+    var = ((X - mean) ** 2 * w[:, None]).sum(0) / wsum
+    std = jnp.sqrt(jnp.maximum(var, 1e-12))
+    return mean, std
+
+
+def _power_iter_sq_norm(Xs, w, iters: int = 16):
+    """Largest eigenvalue of (1/n) Xᵀ W X via power iteration (static iters)."""
+    d = Xs.shape[1]
+    v = jnp.full((d,), 1.0 / jnp.sqrt(d), dtype=Xs.dtype)
+    wsum = jnp.maximum(w.sum(), 1e-12)
+
+    def body(_, v):
+        u = (Xs.T @ (w * (Xs @ v))) / wsum
+        return u / jnp.maximum(jnp.linalg.norm(u), 1e-12)
+
+    v = lax.fori_loop(0, iters, body, v)
+    return jnp.maximum((v @ (Xs.T @ (w * (Xs @ v))) / wsum), 1e-12)
+
+
+def _soft_threshold(x, t):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
+
+
+def _cg_solve(matvec, b, n_iter: int):
+    """Conjugate gradient for SPD systems, fixed iteration count.
+
+    Pure matmuls — the TPU-native replacement for LAPACK ``solve`` (which
+    XLA lowers to host custom calls that neither map to the MXU nor vmap).
+    """
+    x = jnp.zeros_like(b)
+    r = b
+    p = r
+    rs = r @ r
+
+    def body(i, state):
+        x, r, p, rs = state
+        Ap = matvec(p)
+        alpha = rs / jnp.maximum(p @ Ap, 1e-30)
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rs_new = r @ r
+        beta = rs_new / jnp.maximum(rs, 1e-30)
+        p = r + beta * p
+        return x, r, p, rs_new
+
+    x, _, _, _ = lax.fori_loop(0, n_iter, body, (x, r, p, rs))
+    return x
+
+
+def _fista(grad_fn, prox_fn, beta0, step, n_iter: int):
+    """Accelerated proximal gradient with fixed iteration count."""
+
+    def body(i, state):
+        beta, z, t = state
+        g = grad_fn(z)
+        beta_next = prox_fn(z - step * g, step)
+        t_next = (1.0 + jnp.sqrt(1.0 + 4.0 * t * t)) / 2.0
+        z_next = beta_next + ((t - 1.0) / t_next) * (beta_next - beta)
+        return beta_next, z_next, t_next
+
+    beta, _, _ = lax.fori_loop(0, n_iter, body,
+                               (beta0, beta0, jnp.asarray(1.0, beta0.dtype)))
+    return beta
+
+
+# ---------------------------------------------------------------------------
+# Binary logistic regression (binomial, sigmoid link)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("max_iter",))
+def fit_binary_logistic(X, y, w, reg_param, elastic_net, max_iter: int = 128):
+    """→ (coef [d], intercept). Objective matches Spark LogisticRegression."""
+    mean, std = standardize_stats(X, w)
+    Xs = (X - mean) / std
+    wsum = jnp.maximum(w.sum(), 1e-12)
+    l1 = reg_param * elastic_net
+    l2 = reg_param * (1.0 - elastic_net)
+
+    def grad(params):
+        beta, b = params[:-1], params[-1]
+        z = Xs @ beta + b
+        p = jax.nn.sigmoid(z)
+        r = w * (p - y)
+        g_beta = Xs.T @ r / wsum + l2 * beta
+        g_b = r.sum() / wsum
+        return jnp.concatenate([g_beta, g_b[None]])
+
+    def prox(params, step):
+        beta = _soft_threshold(params[:-1], step * l1)
+        return jnp.concatenate([beta, params[-1:]])
+
+    lip = 0.25 * _power_iter_sq_norm(Xs, w) + l2 + 0.25  # +intercept row
+    step = 1.0 / lip
+    params0 = jnp.zeros((X.shape[1] + 1,), dtype=X.dtype)
+    params = _fista(grad, prox, params0, step, max_iter)
+    coef_s, b = params[:-1], params[-1]
+    coef = coef_s / std
+    intercept = b - (coef * mean).sum()
+    return coef, intercept
+
+
+def predict_binary_logistic(coef, intercept, X):
+    """→ (prediction, raw [n,2], prob [n,2])."""
+    margin = X @ coef + intercept
+    p1 = jax.nn.sigmoid(margin)
+    prob = jnp.stack([1.0 - p1, p1], axis=1)
+    raw = jnp.stack([-margin, margin], axis=1)
+    pred = (p1 > 0.5).astype(X.dtype)
+    return pred, raw, prob
+
+
+# ---------------------------------------------------------------------------
+# Multinomial (softmax) logistic regression
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("n_classes", "max_iter"))
+def fit_multinomial_logistic(X, y, w, reg_param, elastic_net,
+                             n_classes: int, max_iter: int = 128):
+    """→ (coef [K, d], intercept [K])."""
+    mean, std = standardize_stats(X, w)
+    Xs = (X - mean) / std
+    wsum = jnp.maximum(w.sum(), 1e-12)
+    d = X.shape[1]
+    k = n_classes
+    y_onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=X.dtype)
+    l1 = reg_param * elastic_net
+    l2 = reg_param * (1.0 - elastic_net)
+
+    def grad(params):
+        W = params[:, :d]
+        b = params[:, d]
+        logits = Xs @ W.T + b
+        p = jax.nn.softmax(logits, axis=1)
+        r = (p - y_onehot) * w[:, None]
+        gW = r.T @ Xs / wsum + l2 * W
+        gb = r.sum(0) / wsum
+        return jnp.concatenate([gW, gb[:, None]], axis=1)
+
+    def prox(params, step):
+        W = _soft_threshold(params[:, :d], step * l1)
+        return jnp.concatenate([W, params[:, d:]], axis=1)
+
+    lip = 0.5 * _power_iter_sq_norm(Xs, w) + l2 + 0.5
+    params0 = jnp.zeros((k, d + 1), dtype=X.dtype)
+    params = _fista(grad, prox, params0, 1.0 / lip, max_iter)
+    W_s, b = params[:, :d], params[:, d]
+    W = W_s / std[None, :]
+    intercept = b - W @ mean
+    return W, intercept
+
+
+def predict_multinomial_logistic(coef, intercept, X):
+    logits = X @ coef.T + intercept
+    prob = jax.nn.softmax(logits, axis=-1)
+    pred = jnp.argmax(prob, axis=-1).astype(X.dtype)
+    return pred, logits, prob
+
+
+# ---------------------------------------------------------------------------
+# Linear regression (elastic net; ridge closed form blended via select)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("max_iter",))
+def fit_linear(X, y, w, reg_param, elastic_net, max_iter: int = 128):
+    """→ (coef [d], intercept). Ridge/OLS solved in closed form; any L1
+    component switches to FISTA (lax.cond keeps it one compiled program)."""
+    mean, std = standardize_stats(X, w)
+    Xs = (X - mean) / std
+    wsum = jnp.maximum(w.sum(), 1e-12)
+    ybar = (y * w).sum() / wsum
+    yc = y - ybar
+    d = X.shape[1]
+    l1 = reg_param * elastic_net
+    l2 = reg_param * (1.0 - elastic_net)
+
+    def closed_form(_):
+        def matvec(v):
+            return Xs.T @ (w * (Xs @ v)) / wsum + (l2 + 1e-10) * v
+        rhs = (Xs.T @ (w * yc)) / wsum
+        return _cg_solve(matvec, rhs, n_iter=min(max(d, 16), 128))
+
+    def fista_path(_):
+        def grad(beta):
+            r = w * (Xs @ beta - yc)
+            return Xs.T @ r / wsum + l2 * beta
+
+        def prox(beta, step):
+            return _soft_threshold(beta, step * l1)
+
+        lip = _power_iter_sq_norm(Xs, w) + l2
+        return _fista(grad, prox, jnp.zeros((d,), X.dtype), 1.0 / lip, max_iter)
+
+    coef_s = lax.cond(l1 > 0.0, fista_path, closed_form, operand=None)
+    coef = coef_s / std
+    intercept = ybar - (coef * mean).sum()
+    return coef, intercept
+
+
+def predict_linear(coef, intercept, X):
+    pred = X @ coef + intercept
+    empty = jnp.zeros((X.shape[0], 0), dtype=X.dtype)
+    return pred, empty, empty
+
+
+# ---------------------------------------------------------------------------
+# Multinomial naive Bayes (Spark NaiveBayes default, smoothing λ)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("n_classes",))
+def fit_naive_bayes(X, y, w, smoothing, n_classes: int):
+    """→ (log_prior [K], log_likelihood [K, d]). Features must be >= 0."""
+    k = n_classes
+    y_onehot = jax.nn.one_hot(y.astype(jnp.int32), k, dtype=X.dtype)
+    yw = y_onehot * w[:, None]
+    class_count = yw.sum(0)
+    feat_sum = yw.T @ jnp.maximum(X, 0.0)
+    log_prior = jnp.log(class_count + smoothing) - jnp.log(
+        class_count.sum() + smoothing * k)
+    log_like = jnp.log(feat_sum + smoothing) - jnp.log(
+        feat_sum.sum(1, keepdims=True) + smoothing * X.shape[1])
+    return log_prior, log_like
+
+
+def predict_naive_bayes(log_prior, log_like, X):
+    logits = jnp.maximum(X, 0.0) @ log_like.T + log_prior
+    prob = jax.nn.softmax(logits, axis=-1)
+    pred = jnp.argmax(logits, axis=-1).astype(X.dtype)
+    return pred, logits, prob

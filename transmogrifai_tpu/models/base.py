@@ -1,0 +1,148 @@
+"""Predictor base classes + the ModelFamily protocol for vmapped CV grids.
+
+The reference wraps Spark estimators one JVM fit at a time and parallelizes
+with a thread pool (``OpValidator.scala:270-312``). The TPU design instead
+treats **the whole (fold × hyperparameter) grid as one batched computation**:
+
+* a :class:`ModelFamily` exposes ``fit_batch(X, y, w, grid)`` /
+  ``predict_batch(params, X)`` written in pure JAX with static shapes, so
+  the CV engine can ``vmap`` over folds and grid points and ``shard_map``
+  the batch over the device mesh (SURVEY §2.10 north star);
+* :class:`PredictorEstimator` / :class:`PredictorModel` are the stage-level
+  wrappers: Estimator(RealNN label, OPVector features) → Prediction, the
+  same contract as ``OpPredictorWrapper``
+  (``core/.../sparkwrappers/specific/OpPredictorWrapper.scala:88-106``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..columns import (Column, ColumnStore, NumericColumn, PredictionColumn,
+                       VectorColumn)
+from ..stages.base import (AllowLabelAsInput, Estimator, FittedModel,
+                           FixedArity, InputSpec)
+from ..types.feature_types import OPVector, Prediction, RealNN
+
+__all__ = ["PredictorEstimator", "PredictorModel", "ModelFamily",
+           "extract_xy"]
+
+
+def extract_xy(store: ColumnStore, label_name: str, features_name: str
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    ycol = store[label_name]
+    xcol = store[features_name]
+    assert isinstance(xcol, VectorColumn), f"{features_name} must be a vector"
+    y = np.asarray(ycol.values, dtype=np.float64)
+    X = np.asarray(xcol.values, dtype=np.float64)
+    return X, y
+
+
+class PredictorModel(FittedModel, AllowLabelAsInput):
+    """Fitted predictor: OPVector → Prediction struct column.
+
+    Keeps the estimator's (label, features) input slots — the label is only
+    read by holdout evaluation, never by transform."""
+
+    output_type = Prediction
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(RealNN, OPVector)
+
+    def predict_arrays(self, X: np.ndarray
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(prediction [n], raw [n,k], prob [n,k])."""
+        raise NotImplementedError
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        xcol = store[self.input_features[1].name]
+        assert isinstance(xcol, VectorColumn)
+        pred, raw, prob = self.predict_arrays(
+            np.asarray(xcol.values, dtype=np.float64))
+        return PredictionColumn(np.asarray(pred, dtype=np.float64),
+                                np.asarray(raw, dtype=np.float64),
+                                np.asarray(prob, dtype=np.float64))
+
+    def transform_row(self, row: Dict[str, Any]) -> Any:
+        x = np.asarray(row[self.input_features[1].name], dtype=np.float64)
+        pred, raw, prob = self.predict_arrays(x[None, :])
+        out = {"prediction": float(pred[0])}
+        for i in range(raw.shape[1]):
+            out[f"rawPrediction_{i}"] = float(raw[0, i])
+        for i in range(prob.shape[1]):
+            out[f"probability_{i}"] = float(prob[0, i])
+        return out
+
+
+class PredictorEstimator(Estimator, AllowLabelAsInput):
+    """Estimator(label: RealNN, features: OPVector) → Prediction."""
+
+    output_type = Prediction
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(RealNN, OPVector)
+
+    @property
+    def label_name(self) -> str:
+        return self.input_features[0].name
+
+    @property
+    def features_name(self) -> str:
+        return self.input_features[1].name
+
+
+class ModelFamily:
+    """Batched pure-JAX fit/predict over a hyperparameter grid.
+
+    Static-shape contract (everything vmappable):
+      * ``stack_grid(grid)`` → pytree of arrays with leading dim G
+      * ``fit_batch(X, y, w, stacked)`` → params pytree, leading dims [..., G]
+        (callers vmap over the sample-weight axis for folds)
+      * ``predict_batch(params, X)`` → scores for metric computation
+      * ``realize(params_i, hparams_i, est)`` → a FittedModel stage
+    """
+
+    name: str = "family"
+    #: hyperparameter grid: list of dicts
+    default_grid: List[Dict[str, Any]] = []
+
+    def __init__(self, grid: Optional[List[Dict[str, Any]]] = None, **fixed):
+        self.grid = list(grid) if grid is not None else list(self.default_grid)
+        self.fixed = fixed
+        if not self.grid:
+            self.grid = [{}]
+
+    def grid_size(self) -> int:
+        return len(self.grid)
+
+    # -- jax side ----------------------------------------------------------
+    def stack_grid(self) -> Dict[str, np.ndarray]:
+        keys = sorted({k for g in self.grid for k in g})
+        out = {}
+        for k in keys:
+            out[k] = np.asarray([g.get(k, self._grid_default(k))
+                                 for g in self.grid])
+        return out
+
+    def _grid_default(self, key: str):
+        defaults = self.param_defaults()
+        return defaults[key]
+
+    def param_defaults(self) -> Dict[str, Any]:
+        return {}
+
+    def fit_batch(self, X, y, w, stacked_grid):
+        raise NotImplementedError
+
+    def predict_batch(self, params, X):
+        """→ (prediction, raw, prob) with grid-leading batch dims."""
+        raise NotImplementedError
+
+    def realize(self, params, hparams: Dict[str, Any]) -> PredictorModel:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(grid={len(self.grid)})"

@@ -1,0 +1,292 @@
+"""Linear model stages: logistic regression, linear regression, naive Bayes.
+
+Parity: ``OpLogisticRegression`` (``core/.../impl/classification/
+OpLogisticRegression.scala``), ``OpLinearRegression``, ``OpNaiveBayes`` —
+but fit natively in JAX (models/_jaxfit.py) instead of wrapping MLlib.
+Each estimator also exposes a :class:`ModelFamily` so ModelSelector can
+vmap its hyperparameter grid.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..stages.base import register_stage
+from . import _jaxfit as JF
+from .base import (ModelFamily, PredictorEstimator, PredictorModel,
+                   extract_xy)
+
+__all__ = [
+    "OpLogisticRegression", "LogisticRegressionModel", "LogisticRegressionFamily",
+    "OpLinearRegression", "LinearRegressionModel", "LinearRegressionFamily",
+    "OpNaiveBayes", "NaiveBayesModel", "NaiveBayesFamily",
+]
+
+
+def _f(x) -> np.ndarray:
+    return np.asarray(x, dtype=np.float64)
+
+
+# ---------------------------------------------------------------------------
+# Logistic regression
+# ---------------------------------------------------------------------------
+
+@register_stage
+class LogisticRegressionModel(PredictorModel):
+    operation_name = "logreg"
+
+    def __init__(self, coefficients=None, intercept=None, n_classes: int = 2,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.coefficients = _f(coefficients) if coefficients is not None else None
+        self.intercept = _f(intercept) if intercept is not None else None
+        self.n_classes = int(n_classes)
+
+    def predict_arrays(self, X):
+        if self.n_classes == 2 and self.coefficients.ndim == 1:
+            pred, raw, prob = JF.predict_binary_logistic(
+                jnp.asarray(self.coefficients), jnp.asarray(self.intercept),
+                jnp.asarray(X))
+        else:
+            pred, raw, prob = JF.predict_multinomial_logistic(
+                jnp.asarray(self.coefficients), jnp.asarray(self.intercept),
+                jnp.asarray(X))
+        return _f(pred), _f(raw), _f(prob)
+
+    def get_model_state(self):
+        return {"coefficients": self.coefficients, "intercept": self.intercept,
+                "n_classes": self.n_classes}
+
+    def summary(self):
+        return {"model": "LogisticRegression", "numClasses": self.n_classes,
+                "numFeatures": int(np.atleast_2d(self.coefficients).shape[-1])}
+
+
+@register_stage
+class OpLogisticRegression(PredictorEstimator):
+    """LogisticRegression estimator (binomial or multinomial by label arity)."""
+
+    operation_name = "logreg"
+
+    def __init__(self, reg_param: float = 0.0, elastic_net_param: float = 0.0,
+                 max_iter: int = 128, family: str = "auto",
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.reg_param = reg_param
+        self.elastic_net_param = elastic_net_param
+        self.max_iter = max_iter
+        self.family = family
+
+    def fit_columns(self, store) -> LogisticRegressionModel:
+        X, y = extract_xy(store, self.label_name, self.features_name)
+        n_classes = int(y.max()) + 1 if len(y) else 2
+        n_classes = max(n_classes, 2)
+        w = jnp.ones((X.shape[0],))
+        if n_classes == 2 and self.family != "multinomial":
+            coef, b = JF.fit_binary_logistic(
+                jnp.asarray(X), jnp.asarray(y), w,
+                self.reg_param, self.elastic_net_param, max_iter=self.max_iter)
+            return LogisticRegressionModel(coef, b, 2)
+        coef, b = JF.fit_multinomial_logistic(
+            jnp.asarray(X), jnp.asarray(y), w,
+            self.reg_param, self.elastic_net_param,
+            n_classes=n_classes, max_iter=self.max_iter)
+        return LogisticRegressionModel(coef, b, n_classes)
+
+
+class LogisticRegressionFamily(ModelFamily):
+    """Batched LR grid (DefaultSelectorParams.scala:35-60: reg × elasticNet)."""
+
+    name = "OpLogisticRegression"
+    default_grid = [
+        {"regParam": r, "elasticNetParam": e}
+        for r in (0.001, 0.01, 0.1, 0.2) for e in (0.1, 0.5)
+    ]
+
+    def __init__(self, grid=None, n_classes: int = 2, max_iter: int = 128,
+                 **fixed):
+        super().__init__(grid, **fixed)
+        self.n_classes = n_classes
+        self.max_iter = max_iter
+
+    def param_defaults(self):
+        return {"regParam": 0.0, "elasticNetParam": 0.0}
+
+    def fit_batch(self, X, y, w, stacked):
+        reg = jnp.asarray(stacked["regParam"], dtype=X.dtype)
+        enet = jnp.asarray(stacked["elasticNetParam"], dtype=X.dtype)
+        if self.n_classes == 2:
+            fit = lambda r, e: JF.fit_binary_logistic(
+                X, y, w, r, e, max_iter=self.max_iter)
+        else:
+            fit = lambda r, e: JF.fit_multinomial_logistic(
+                X, y, w, r, e, n_classes=self.n_classes,
+                max_iter=self.max_iter)
+        return jax.vmap(fit)(reg, enet)
+
+    def predict_batch(self, params, X):
+        coef, intercept = params
+        if self.n_classes == 2:
+            return jax.vmap(JF.predict_binary_logistic,
+                            in_axes=(0, 0, None))(coef, intercept, X)
+        return jax.vmap(JF.predict_multinomial_logistic,
+                        in_axes=(0, 0, None))(coef, intercept, X)
+
+    def realize(self, params, hparams) -> LogisticRegressionModel:
+        coef, intercept = params
+        return LogisticRegressionModel(coef, intercept, self.n_classes)
+
+
+# ---------------------------------------------------------------------------
+# Linear regression
+# ---------------------------------------------------------------------------
+
+@register_stage
+class LinearRegressionModel(PredictorModel):
+    operation_name = "linreg"
+
+    def __init__(self, coefficients=None, intercept: float = 0.0,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.coefficients = _f(coefficients) if coefficients is not None else None
+        self.intercept = float(intercept) if intercept is not None else 0.0
+
+    def predict_arrays(self, X):
+        pred, raw, prob = JF.predict_linear(
+            jnp.asarray(self.coefficients), self.intercept, jnp.asarray(X))
+        return _f(pred), _f(raw), _f(prob)
+
+    def get_model_state(self):
+        return {"coefficients": self.coefficients, "intercept": self.intercept}
+
+    def summary(self):
+        return {"model": "LinearRegression",
+                "numFeatures": int(self.coefficients.shape[0])}
+
+
+@register_stage
+class OpLinearRegression(PredictorEstimator):
+    operation_name = "linreg"
+
+    def __init__(self, reg_param: float = 0.0, elastic_net_param: float = 0.0,
+                 max_iter: int = 128, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.reg_param = reg_param
+        self.elastic_net_param = elastic_net_param
+        self.max_iter = max_iter
+
+    def fit_columns(self, store) -> LinearRegressionModel:
+        X, y = extract_xy(store, self.label_name, self.features_name)
+        w = jnp.ones((X.shape[0],))
+        coef, b = JF.fit_linear(jnp.asarray(X), jnp.asarray(y), w,
+                                self.reg_param, self.elastic_net_param,
+                                max_iter=self.max_iter)
+        return LinearRegressionModel(coef, float(b))
+
+
+class LinearRegressionFamily(ModelFamily):
+    name = "OpLinearRegression"
+    default_grid = [
+        {"regParam": r, "elasticNetParam": e}
+        for r in (0.001, 0.01, 0.1, 0.2) for e in (0.1, 0.5)
+    ]
+
+    def __init__(self, grid=None, max_iter: int = 128, **fixed):
+        super().__init__(grid, **fixed)
+        self.max_iter = max_iter
+
+    def param_defaults(self):
+        return {"regParam": 0.0, "elasticNetParam": 0.0}
+
+    def fit_batch(self, X, y, w, stacked):
+        reg = jnp.asarray(stacked["regParam"], dtype=X.dtype)
+        enet = jnp.asarray(stacked["elasticNetParam"], dtype=X.dtype)
+        return jax.vmap(lambda r, e: JF.fit_linear(
+            X, y, w, r, e, max_iter=self.max_iter))(reg, enet)
+
+    def predict_batch(self, params, X):
+        coef, intercept = params
+        return jax.vmap(JF.predict_linear, in_axes=(0, 0, None))(
+            coef, intercept, X)
+
+    def realize(self, params, hparams) -> LinearRegressionModel:
+        coef, intercept = params
+        return LinearRegressionModel(coef, float(intercept))
+
+
+# ---------------------------------------------------------------------------
+# Naive Bayes
+# ---------------------------------------------------------------------------
+
+@register_stage
+class NaiveBayesModel(PredictorModel):
+    operation_name = "naiveBayes"
+
+    def __init__(self, log_prior=None, log_likelihood=None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.log_prior = _f(log_prior) if log_prior is not None else None
+        self.log_likelihood = (_f(log_likelihood)
+                               if log_likelihood is not None else None)
+
+    def predict_arrays(self, X):
+        pred, raw, prob = JF.predict_naive_bayes(
+            jnp.asarray(self.log_prior), jnp.asarray(self.log_likelihood),
+            jnp.asarray(X))
+        return _f(pred), _f(raw), _f(prob)
+
+    def get_model_state(self):
+        return {"log_prior": self.log_prior,
+                "log_likelihood": self.log_likelihood}
+
+    def summary(self):
+        return {"model": "NaiveBayes",
+                "numClasses": int(self.log_prior.shape[0])}
+
+
+@register_stage
+class OpNaiveBayes(PredictorEstimator):
+    """Multinomial NB with Laplace smoothing (OpNaiveBayes.scala)."""
+
+    operation_name = "naiveBayes"
+
+    def __init__(self, smoothing: float = 1.0, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.smoothing = smoothing
+
+    def fit_columns(self, store) -> NaiveBayesModel:
+        X, y = extract_xy(store, self.label_name, self.features_name)
+        n_classes = max(int(y.max()) + 1 if len(y) else 2, 2)
+        w = jnp.ones((X.shape[0],))
+        lp, ll = JF.fit_naive_bayes(jnp.asarray(X), jnp.asarray(y), w,
+                                    self.smoothing, n_classes=n_classes)
+        return NaiveBayesModel(lp, ll)
+
+
+class NaiveBayesFamily(ModelFamily):
+    name = "OpNaiveBayes"
+    default_grid = [{"smoothing": s} for s in (1.0,)]
+
+    def __init__(self, grid=None, n_classes: int = 2, **fixed):
+        super().__init__(grid, **fixed)
+        self.n_classes = n_classes
+
+    def param_defaults(self):
+        return {"smoothing": 1.0}
+
+    def fit_batch(self, X, y, w, stacked):
+        sm = jnp.asarray(stacked["smoothing"], dtype=X.dtype)
+        return jax.vmap(lambda s: JF.fit_naive_bayes(
+            X, y, w, s, n_classes=self.n_classes))(sm)
+
+    def predict_batch(self, params, X):
+        lp, ll = params
+        return jax.vmap(JF.predict_naive_bayes, in_axes=(0, 0, None))(
+            lp, ll, X)
+
+    def realize(self, params, hparams) -> NaiveBayesModel:
+        lp, ll = params
+        return NaiveBayesModel(lp, ll)

@@ -1,0 +1,332 @@
+"""ModelSelector — AutoML model selection over a batched CV grid.
+
+Parity: ``core/.../impl/selector/ModelSelector.scala:135-196`` and the
+factories ``BinaryClassificationModelSelector`` /
+``MultiClassificationModelSelector`` / ``RegressionModelSelector``
+(``core/.../impl/classification/BinaryClassificationModelSelector.scala:47-245``).
+
+``fit``: splitter prepares → validator sweeps every (family × grid × fold)
+as one batched JAX computation per family → best estimator refit on the full
+prepared train → train (and holdout, via ``has_test_eval``) evaluation →
+``SelectedModel`` + ``ModelSelectorSummary``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columns import ColumnStore, PredictionColumn
+from ..evaluators import metrics as M
+from ..stages.base import register_stage
+from .base import (ModelFamily, PredictorEstimator, PredictorModel,
+                   extract_xy)
+from .linear import (LinearRegressionFamily, LogisticRegressionFamily,
+                     NaiveBayesFamily)
+from .tuning import (CrossValidation, DataBalancer, DataCutter, DataSplitter,
+                     Splitter, TrainValidationSplit, ValidatorSummary)
+
+__all__ = ["ModelSelector", "SelectedModel", "ModelSelectorSummary",
+           "BinaryClassificationModelSelector",
+           "MultiClassificationModelSelector", "RegressionModelSelector"]
+
+
+class ModelSelectorSummary:
+    """Validation results + data prep + evals (ModelSelectorSummary.scala)."""
+
+    def __init__(self, validator_summary: ValidatorSummary,
+                 splitter_summary: Dict[str, Any],
+                 train_evaluation: Dict[str, float],
+                 holdout_evaluation: Optional[Dict[str, float]] = None,
+                 best_model_name: str = "", best_model_params: Dict = None):
+        self.validator_summary = validator_summary
+        self.splitter_summary = splitter_summary
+        self.train_evaluation = train_evaluation
+        self.holdout_evaluation = holdout_evaluation
+        self.best_model_name = best_model_name
+        self.best_model_params = best_model_params or {}
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "bestModelName": self.best_model_name,
+            "bestModelParams": self.best_model_params,
+            "validationResults": self.validator_summary.to_json(),
+            "dataPrepSummary": self.splitter_summary,
+            "trainEvaluation": self.train_evaluation,
+            "holdoutEvaluation": self.holdout_evaluation,
+        }
+
+    def pretty(self) -> str:
+        import json
+        return json.dumps(self.to_json(), indent=2, default=str)
+
+
+@register_stage
+class SelectedModel(PredictorModel):
+    """The winning fitted model wrapped with selection metadata
+    (ModelSelector.scala:216-255)."""
+
+    operation_name = "modelSelector"
+
+    def __init__(self, inner: Optional[PredictorModel] = None,
+                 task: str = "binary",
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.inner = inner
+        self.task = task
+        self.selector_summary: Optional[ModelSelectorSummary] = None
+
+    def predict_arrays(self, X):
+        return self.inner.predict_arrays(X)
+
+    def has_test_eval(self) -> bool:
+        return True
+
+    def evaluate_model(self, test: ColumnStore) -> None:
+        """Holdout evaluation during workflow fit (HasTestEval)."""
+        X, y = extract_xy(test, self.input_features[0].name,
+                          self.input_features[1].name)
+        pred, _raw, prob = self.predict_arrays(X)
+        metrics = _task_metrics(self.task, y, pred, prob)
+        if self.selector_summary is not None:
+            self.selector_summary.holdout_evaluation = metrics
+
+    def get_params(self):
+        p = super().get_params()
+        p.pop("inner", None)  # reconstructed from model state
+        return p
+
+    def get_model_state(self):
+        inner_state = self.inner.get_model_state()
+        inner_params = self.inner.get_params()
+        inner_params.pop("uid", None)
+        return {
+            "inner_class": type(self.inner).__name__,
+            "inner_params": inner_params,
+            "inner_state": inner_state,
+        }
+
+    def apply_model_state(self, state) -> None:
+        from ..stages.base import STAGE_REGISTRY
+        cls = STAGE_REGISTRY[state["inner_class"]]
+        self.inner = cls(**state["inner_params"])
+        for k, v in state["inner_state"].items():
+            setattr(self.inner, k, v)
+
+    def summary(self):
+        out = {"model": "SelectedModel", "task": self.task}
+        if self.selector_summary is not None:
+            out.update(self.selector_summary.to_json())
+        return out
+
+
+def _task_metrics(task: str, y, pred, prob) -> Dict[str, float]:
+    if task == "binary":
+        scores = prob[:, 1] if prob.ndim == 2 and prob.shape[1] >= 2 else pred
+        return M.binary_metrics(y, pred, scores)
+    if task == "multiclass":
+        return M.multiclass_metrics(y, pred)
+    return M.regression_metrics(y, pred)
+
+
+@register_stage
+class ModelSelector(PredictorEstimator):
+    """Estimator(label, features) → Prediction via validated model selection."""
+
+    operation_name = "modelSelector"
+
+    def __init__(self, validator: Optional[Any] = None,
+                 splitter: Optional[Splitter] = None,
+                 families: Optional[Sequence[ModelFamily]] = None,
+                 task: str = "binary",
+                 mesh=None,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.validator = validator
+        self.splitter = splitter
+        self.families = list(families or [])
+        self.task = task
+        self.mesh = mesh
+        self.best_estimator_: Optional[Tuple[ModelFamily, Dict]] = None
+
+    # workflow-level CV hook (ModelSelector.findBestEstimator :112-121)
+    def find_best_estimator(self, store: ColumnStore
+                            ) -> Tuple[ModelFamily, Dict, ValidatorSummary]:
+        X, y = extract_xy(store, self.label_name, self.features_name)
+        keep = self.splitter.keep_mask(y) if self.splitter else \
+            np.ones_like(y, dtype=bool)
+        X, y = X[keep], y[keep]
+        if self.splitter is not None:
+            self.splitter.pre_validation_prepare(y)
+            base_w = self.splitter.sample_weights(y)
+        else:
+            base_w = None
+        self._maybe_set_classes(y)
+        best_family, best_hparams, vsummary = self.validator.validate(
+            self.families, X, y, base_weights=base_w, mesh=self.mesh)
+        self.best_estimator_ = (best_family, best_hparams)
+        return best_family, best_hparams, vsummary
+
+    def _maybe_set_classes(self, y: np.ndarray) -> None:
+        n_classes = max(int(y.max()) + 1 if len(y) else 2, 2)
+        for fam in self.families:
+            if hasattr(fam, "n_classes"):
+                fam.n_classes = n_classes
+
+    def fit_columns(self, store: ColumnStore) -> SelectedModel:
+        best_family, best_hparams, vsummary = self.find_best_estimator(store)
+
+        # final refit on the full prepared train (ModelSelector.scala:158-159)
+        X, y = extract_xy(store, self.label_name, self.features_name)
+        keep = self.splitter.keep_mask(y) if self.splitter else \
+            np.ones_like(y, dtype=bool)
+        Xk, yk = X[keep], y[keep]
+        w = (self.splitter.sample_weights(yk) if self.splitter
+             else np.ones_like(yk))
+        single = type(best_family)(grid=[best_hparams])
+        for attr in ("n_classes", "max_iter"):
+            if hasattr(best_family, attr) and hasattr(single, attr):
+                setattr(single, attr, getattr(best_family, attr))
+        params = single.fit_batch(jnp.asarray(Xk), jnp.asarray(yk),
+                                  jnp.asarray(w), single.stack_grid())
+        inner = single.realize(_index_pytree(params, 0), best_hparams)
+
+        # train evaluation over the rows the model was actually trained on
+        # (DataCutter-dropped labels are out of scope for the model)
+        pred, _raw, prob = map(np.asarray,
+                               single.predict_batch(params, jnp.asarray(Xk)))
+        train_eval = _task_metrics(self.task, yk, pred[0], prob[0])
+
+        model = SelectedModel(inner=inner, task=self.task)
+        model.selector_summary = ModelSelectorSummary(
+            validator_summary=vsummary,
+            splitter_summary=self.splitter.summary if self.splitter else {},
+            train_evaluation=train_eval,
+            best_model_name=best_family.name,
+            best_model_params=best_hparams)
+        return model
+
+
+def _index_pytree(tree, i: int):
+    return jax.tree_util.tree_map(lambda a: np.asarray(a)[i], tree)
+
+
+# ---------------------------------------------------------------------------
+# Factories (BinaryClassificationModelSelector.scala etc.)
+# ---------------------------------------------------------------------------
+
+class _SelectorFactory:
+    task = "binary"
+    default_metric = "AuPR"
+
+    @classmethod
+    def default_families(cls) -> List[ModelFamily]:
+        raise NotImplementedError
+
+    @classmethod
+    def default_splitter(cls) -> Optional[Splitter]:
+        return None
+
+    @classmethod
+    def with_cross_validation(cls, num_folds: int = 3,
+                              validation_metric: Optional[str] = None,
+                              families: Optional[Sequence[ModelFamily]] = None,
+                              splitter: Optional[Splitter] = None,
+                              seed: int = 42, stratify: bool = False,
+                              mesh=None) -> ModelSelector:
+        metric = validation_metric or cls.default_metric
+        return ModelSelector(
+            validator=CrossValidation(num_folds=num_folds, metric_name=metric,
+                                      task=cls.task, seed=seed,
+                                      stratify=stratify),
+            splitter=splitter if splitter is not None else cls.default_splitter(),
+            families=families if families is not None else cls.default_families(),
+            task=cls.task, mesh=mesh)
+
+    @classmethod
+    def with_train_validation_split(cls, train_ratio: float = 0.75,
+                                    validation_metric: Optional[str] = None,
+                                    families: Optional[Sequence[ModelFamily]] = None,
+                                    splitter: Optional[Splitter] = None,
+                                    seed: int = 42,
+                                    mesh=None) -> ModelSelector:
+        metric = validation_metric or cls.default_metric
+        return ModelSelector(
+            validator=TrainValidationSplit(train_ratio=train_ratio,
+                                           metric_name=metric, task=cls.task,
+                                           seed=seed),
+            splitter=splitter if splitter is not None else cls.default_splitter(),
+            families=families if families is not None else cls.default_families(),
+            task=cls.task, mesh=mesh)
+
+
+class BinaryClassificationModelSelector(_SelectorFactory):
+    """Defaults: LR + RF + GBT + LinearSVC on (:52-128); metric auPR."""
+
+    task = "binary"
+    default_metric = "AuPR"
+
+    @classmethod
+    def default_families(cls) -> List[ModelFamily]:
+        fams: List[ModelFamily] = [LogisticRegressionFamily()]
+        try:
+            from .trees import RandomForestFamily, GBTFamily
+            fams += [RandomForestFamily(), GBTFamily()]
+        except ImportError:
+            pass
+        try:
+            from .svm import LinearSVCFamily
+            fams.append(LinearSVCFamily())
+        except ImportError:
+            pass
+        return fams
+
+    @classmethod
+    def default_splitter(cls) -> Splitter:
+        return DataBalancer()
+
+
+class MultiClassificationModelSelector(_SelectorFactory):
+    """Defaults: LR / RF / NB / DT; metric F1."""
+
+    task = "multiclass"
+    default_metric = "F1"
+
+    @classmethod
+    def default_families(cls) -> List[ModelFamily]:
+        fams: List[ModelFamily] = [LogisticRegressionFamily(),
+                                   NaiveBayesFamily()]
+        try:
+            from .trees import RandomForestFamily
+            fams.append(RandomForestFamily())
+        except ImportError:
+            pass
+        return fams
+
+    @classmethod
+    def default_splitter(cls) -> Splitter:
+        return DataCutter()
+
+
+class RegressionModelSelector(_SelectorFactory):
+    """Defaults: LinReg / RF / GBT / GLM; metric RMSE."""
+
+    task = "regression"
+    default_metric = "RootMeanSquaredError"
+
+    @classmethod
+    def default_families(cls) -> List[ModelFamily]:
+        fams: List[ModelFamily] = [LinearRegressionFamily()]
+        try:
+            from .trees import RandomForestFamily, GBTFamily
+            fams += [RandomForestFamily(task="regression"),
+                     GBTFamily(task="regression")]
+        except ImportError:
+            pass
+        return fams
+
+    @classmethod
+    def default_splitter(cls) -> Splitter:
+        return DataSplitter()

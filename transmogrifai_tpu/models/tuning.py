@@ -1,0 +1,329 @@
+"""Tuning: splitters, balancing, and the batched cross-validation engine.
+
+Parity: ``core/.../impl/tuning/*`` — ``Splitter``/``DataSplitter``/
+``DataBalancer``/``DataCutter`` (:30-178) and ``OpCrossValidation``/
+``OpTrainValidationSplit``.
+
+TPU re-design highlights:
+
+* **Folds are masks, not copies.** ``OpCrossValidation`` materializes k
+  train/val datasets (``MLUtils.kFold``); here a fold is a 0/1 weight
+  vector, so all k folds share one device-resident (X, y) and one compiled
+  program evaluates every fold.
+* **The grid is one batched computation.** The reference fans out
+  ``estimator.fit`` calls on an 8-thread pool (``OpValidator.scala:318-326``);
+  here ``vmap(fold) ∘ vmap(grid)`` over a ModelFamily's pure-JAX fit gives
+  XLA the whole sweep at once, and a mesh shards the batch across chips.
+* **Balancing is deterministic reweighting.** ``DataBalancer`` up/down-samples
+  rows stochastically (``DataBalancer.scala:84-178``); resampling breaks
+  static shapes, so we hit the same target positive fraction with per-row
+  weights — equivalent in expectation for every weighted-loss model here.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columns import ColumnStore
+from ..evaluators import metrics as M
+from .base import ModelFamily
+
+__all__ = ["Splitter", "DataSplitter", "DataBalancer", "DataCutter",
+           "CrossValidation", "TrainValidationSplit", "ValidationResult",
+           "ValidatorSummary"]
+
+
+# ---------------------------------------------------------------------------
+# Splitters (impl/tuning/DataSplitter.scala, DataBalancer.scala, DataCutter.scala)
+# ---------------------------------------------------------------------------
+
+class Splitter:
+    """Base: holdout reservation + per-task train preparation."""
+
+    def __init__(self, seed: int = 42, reserve_test_fraction: float = 0.0):
+        self.seed = seed
+        self.reserve_test_fraction = reserve_test_fraction
+        self.summary: Dict[str, Any] = {}
+
+    def reserve_split(self, store: ColumnStore
+                      ) -> Tuple[ColumnStore, Optional[ColumnStore]]:
+        if self.reserve_test_fraction <= 0.0:
+            return store, None
+        rng = np.random.default_rng(self.seed)
+        n = store.n_rows
+        perm = rng.permutation(n)
+        n_test = int(round(n * self.reserve_test_fraction))
+        test_idx, train_idx = perm[:n_test], perm[n_test:]
+        return store.take(np.sort(train_idx)), store.take(np.sort(test_idx))
+
+    def pre_validation_prepare(self, y: np.ndarray) -> None:
+        """Estimate preparation parameters (DataBalancer.estimate)."""
+
+    def sample_weights(self, y: np.ndarray) -> np.ndarray:
+        """Per-row training weights implementing the preparation."""
+        return np.ones_like(y, dtype=np.float64)
+
+    def keep_mask(self, y: np.ndarray) -> np.ndarray:
+        """Rows admitted to training at all (DataCutter label dropping)."""
+        return np.ones_like(y, dtype=bool)
+
+
+class DataSplitter(Splitter):
+    """Plain splitter — regression (DataSplitter.scala:30-100)."""
+
+
+class DataBalancer(Splitter):
+    """Binary-label balancer (DataBalancer.scala): if the positive fraction
+    is below ``sample_fraction``, reweight so positives carry that share."""
+
+    def __init__(self, sample_fraction: float = 0.1, seed: int = 42,
+                 reserve_test_fraction: float = 0.0,
+                 max_training_sample: int = 1_000_000):
+        super().__init__(seed=seed, reserve_test_fraction=reserve_test_fraction)
+        self.sample_fraction = sample_fraction
+        self.max_training_sample = max_training_sample
+        self._pos_weight = 1.0
+        self._neg_weight = 1.0
+
+    def pre_validation_prepare(self, y: np.ndarray) -> None:
+        n = len(y)
+        n_pos = float((y == 1).sum())
+        n_neg = float(n - n_pos)
+        minority, majority = (n_pos, n_neg) if n_pos <= n_neg else (n_neg, n_pos)
+        frac = minority / max(n, 1)
+        self.summary = {"positiveLabels": n_pos, "negativeLabels": n_neg,
+                        "desiredFraction": self.sample_fraction,
+                        "upSamplingFraction": 1.0, "downSamplingFraction": 1.0}
+        if frac >= self.sample_fraction or minority == 0:
+            return
+        # reweight minority up to the target fraction
+        f = self.sample_fraction
+        target_ratio = f / (1.0 - f) * (majority / minority)
+        if n_pos <= n_neg:
+            self._pos_weight = target_ratio
+            self.summary["upSamplingFraction"] = target_ratio
+        else:
+            self._neg_weight = target_ratio
+            self.summary["upSamplingFraction"] = target_ratio
+
+    def sample_weights(self, y: np.ndarray) -> np.ndarray:
+        return np.where(y == 1, self._pos_weight, self._neg_weight).astype(
+            np.float64)
+
+
+class DataCutter(Splitter):
+    """Multiclass label cutter (DataCutter.scala:30-120): drop labels beyond
+    ``max_label_categories`` or below ``min_label_fraction``."""
+
+    def __init__(self, max_label_categories: int = 100,
+                 min_label_fraction: float = 0.0, seed: int = 42,
+                 reserve_test_fraction: float = 0.0):
+        super().__init__(seed=seed, reserve_test_fraction=reserve_test_fraction)
+        self.max_label_categories = max_label_categories
+        self.min_label_fraction = min_label_fraction
+        self._kept_labels: Optional[np.ndarray] = None
+
+    def pre_validation_prepare(self, y: np.ndarray) -> None:
+        labels, counts = np.unique(y, return_counts=True)
+        frac = counts / max(len(y), 1)
+        order = np.argsort(-counts, kind="stable")
+        kept = [labels[i] for i in order[:self.max_label_categories]
+                if frac[i] >= self.min_label_fraction]
+        self._kept_labels = np.asarray(sorted(kept))
+        self.summary = {"labelsKept": self._kept_labels.tolist(),
+                        "labelsDropped": sorted(
+                            set(labels.tolist()) - set(kept))}
+
+    def keep_mask(self, y: np.ndarray) -> np.ndarray:
+        if self._kept_labels is None:
+            return np.ones_like(y, dtype=bool)
+        return np.isin(y, self._kept_labels)
+
+
+# ---------------------------------------------------------------------------
+# Validators (OpCrossValidation / OpTrainValidationSplit)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ValidationResult:
+    family_name: str
+    hparams: Dict[str, Any]
+    grid_index: int
+    metric_values: List[float]          # per fold/split
+    mean_metric: float
+
+
+@dataclass
+class ValidatorSummary:
+    validation_type: str
+    evaluation_metric: str
+    results: List[ValidationResult] = field(default_factory=list)
+    best: Optional[ValidationResult] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "validationType": self.validation_type,
+            "evaluationMetric": self.evaluation_metric,
+            "bestModelName": self.best.family_name if self.best else None,
+            "bestModelParams": self.best.hparams if self.best else None,
+            "results": [
+                {"model": r.family_name, "params": r.hparams,
+                 "metricPerFold": r.metric_values, "mean": r.mean_metric}
+                for r in self.results],
+        }
+
+
+def _metric_value(metric_name: str, task: str, y: np.ndarray,
+                  pred: np.ndarray, prob: np.ndarray) -> float:
+    if task == "binary":
+        scores = prob[:, 1] if prob.ndim == 2 and prob.shape[1] >= 2 else pred
+        m = M.binary_metrics(y, pred, scores)
+    elif task == "multiclass":
+        m = M.multiclass_metrics(y, pred)
+    else:
+        m = M.regression_metrics(y, pred)
+    return m[metric_name]
+
+
+_LARGER_BETTER = frozenset({"AuROC", "AuPR", "Precision", "Recall", "F1", "R2"})
+
+
+class _ValidatorBase:
+    """Shared fold-mask validation engine."""
+
+    validation_type = "validator"
+
+    def __init__(self, metric_name: str, task: str, seed: int = 42,
+                 stratify: bool = False, max_iter_folds: int = 0):
+        self.metric_name = metric_name
+        self.task = task
+        self.seed = seed
+        self.stratify = stratify
+        self.is_larger_better = metric_name in _LARGER_BETTER
+
+    def _splits(self, y: np.ndarray) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """(train_mask, val_mask) pairs as 0/1 float arrays."""
+        raise NotImplementedError
+
+    def validate(self, families: Sequence[ModelFamily], X: np.ndarray,
+                 y: np.ndarray, base_weights: Optional[np.ndarray] = None,
+                 mesh=None) -> Tuple[ModelFamily, Dict[str, Any], ValidatorSummary]:
+        """Run the full (family × grid × fold) sweep; return winner.
+
+        The per-family computation is one jitted nested-vmap: folds on the
+        outer axis, grid points inner. With a mesh, X/y are device_put with a
+        row sharding so XLA partitions the batch over chips (GSPMD).
+        """
+        splits = self._splits(y)
+        base_w = (np.ones_like(y, dtype=np.float64)
+                  if base_weights is None else base_weights)
+        train_w = np.stack([m * base_w for m, _ in splits])   # [K, n]
+        val_masks = np.stack([v for _, v in splits]).astype(bool)
+
+        n_orig = len(y)
+        if mesh is not None:
+            from ..parallel.mesh import shard_cv_inputs
+            Xd, yd, wd, n_orig = shard_cv_inputs(mesh, X, y, train_w)
+        else:
+            Xd, yd = jnp.asarray(X), jnp.asarray(y)
+            wd = jnp.asarray(train_w)
+
+        summary = ValidatorSummary(self.validation_type, self.metric_name)
+        best: Optional[ValidationResult] = None
+        best_family: Optional[ModelFamily] = None
+        sign = 1.0 if self.is_larger_better else -1.0
+
+        for family in families:
+            stacked = family.stack_grid()
+
+            def fit_all(w_folds):
+                return jax.vmap(lambda w: family.fit_batch(Xd, yd, w, stacked)
+                                )(w_folds)
+
+            params = jax.jit(fit_all)(wd)    # leading dims [K, G, ...]
+            k, g = len(splits), family.grid_size()
+
+            def predict_all(p):
+                return jax.vmap(lambda pk: family.predict_batch(pk, Xd))(p)
+
+            pred, _raw, prob = jax.jit(predict_all)(params)
+            # slice off any zero-weight sharding padding rows
+            pred = np.asarray(pred)[..., :n_orig]
+            prob = np.asarray(prob)[:, :, :n_orig] if np.asarray(prob).ndim == 4 \
+                else np.asarray(prob)
+
+            per_grid_metrics = np.zeros((g, k))
+            for gi in range(g):
+                for ki in range(k):
+                    vm = val_masks[ki]
+                    per_grid_metrics[gi, ki] = _metric_value(
+                        self.metric_name, self.task, y[vm],
+                        pred[ki, gi][vm],
+                        prob[ki, gi][vm] if prob.ndim == 4 else prob[ki, gi])
+            means = per_grid_metrics.mean(axis=1)
+            for gi in range(g):
+                r = ValidationResult(
+                    family_name=family.name, hparams=family.grid[gi],
+                    grid_index=gi,
+                    metric_values=per_grid_metrics[gi].tolist(),
+                    mean_metric=float(means[gi]))
+                summary.results.append(r)
+                if best is None or sign * r.mean_metric > sign * best.mean_metric:
+                    best = r
+                    best_family = family
+        summary.best = best
+        assert best is not None and best_family is not None
+        return best_family, best.hparams, summary
+
+
+class CrossValidation(_ValidatorBase):
+    """k-fold CV over fold masks (OpCrossValidation.scala)."""
+
+    validation_type = "CrossValidation"
+
+    def __init__(self, num_folds: int = 3, metric_name: str = "AuROC",
+                 task: str = "binary", seed: int = 42, stratify: bool = False):
+        super().__init__(metric_name, task, seed, stratify)
+        self.num_folds = num_folds
+
+    def _splits(self, y: np.ndarray):
+        n = len(y)
+        rng = np.random.default_rng(self.seed)
+        fold_of = np.zeros(n, dtype=np.int64)
+        if self.stratify and self.task in ("binary", "multiclass"):
+            for c in np.unique(y):
+                idx = np.nonzero(y == c)[0]
+                idx = rng.permutation(idx)
+                fold_of[idx] = np.arange(len(idx)) % self.num_folds
+        else:
+            fold_of = rng.permutation(n) % self.num_folds
+        out = []
+        for kf in range(self.num_folds):
+            val = (fold_of == kf)
+            out.append(((~val).astype(np.float64), val.astype(np.float64)))
+        return out
+
+
+class TrainValidationSplit(_ValidatorBase):
+    """Single split (OpTrainValidationSplit.scala)."""
+
+    validation_type = "TrainValidationSplit"
+
+    def __init__(self, train_ratio: float = 0.75, metric_name: str = "AuROC",
+                 task: str = "binary", seed: int = 42, stratify: bool = False):
+        super().__init__(metric_name, task, seed, stratify)
+        self.train_ratio = train_ratio
+
+    def _splits(self, y: np.ndarray):
+        n = len(y)
+        rng = np.random.default_rng(self.seed)
+        perm = rng.permutation(n)
+        n_train = int(round(n * self.train_ratio))
+        train = np.zeros(n, dtype=np.float64)
+        train[perm[:n_train]] = 1.0
+        return [(train, 1.0 - train)]

@@ -1,0 +1,10 @@
+from .vectorizer_base import TransmogrifierDefaults, VectorizerEstimator, VectorizerModel  # noqa: F401
+from .numeric import RealVectorizer, IntegralVectorizer, BinaryVectorizer, NumericBucketizer  # noqa: F401
+from .onehot import OneHotVectorizer, SetVectorizer, OneHotModel  # noqa: F401
+from .hashing import HashingVectorizerModel, murmur3_32, hash_tokens  # noqa: F401
+from .smart_text import SmartTextVectorizer, SmartTextVectorizerModel  # noqa: F401
+from .text import TextTokenizer, tokenize_simple  # noqa: F401
+from .dates import DateToUnitCircleVectorizer, TimePeriod  # noqa: F401
+from .geo import GeolocationVectorizer  # noqa: F401
+from .vectors import VectorsCombiner, StandardScalerEstimator  # noqa: F401
+from .transmogrifier import Transmogrifier, transmogrify  # noqa: F401

@@ -1,0 +1,97 @@
+"""DateList vectorization — event-list time pivots.
+
+Parity: ``DateListVectorizer`` (``core/.../impl/feature/DateListVectorizer.scala``):
+pivots a list of event timestamps into ``SinceLast`` / ``SinceFirst`` /
+``ModeDay`` style summaries. Default pivot is SinceLast (days since the most
+recent event, relative to a reference date) + null tracking.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..columns import ColumnStore, RaggedColumn
+from ..stages.base import register_stage
+from ..types.feature_types import DateList
+from ..vector_metadata import VectorColumnMetadata, VectorMetadata
+from .vectorizer_base import (TransmogrifierDefaults, VectorizerModel,
+                              null_indicator_meta)
+
+__all__ = ["DateListVectorizer", "DateListPivot"]
+
+_MS_PER_DAY = 24 * 3600 * 1000
+
+
+class DateListPivot:
+    SINCE_LAST = "SinceLast"
+    SINCE_FIRST = "SinceFirst"
+
+
+@register_stage
+class DateListVectorizer(VectorizerModel):
+    """[days since last/first event, (null)] per feature. Pure transformer
+    (reference date is a param, no fit state)."""
+
+    operation_name = "vecDateList"
+    seq_type = DateList
+
+    def __init__(self, pivot: str = DateListPivot.SINCE_LAST,
+                 reference_date_ms: Optional[int] = None,
+                 track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
+                 input_names: Sequence[str] = (),
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.pivot = pivot
+        self.reference_date_ms = reference_date_ms
+        self.track_nulls = track_nulls
+        self.input_names_saved = list(input_names)
+
+    def _names(self) -> List[str]:
+        if self.input_features:
+            return [f.name for f in self.input_features]
+        return self.input_names_saved
+
+    def host_prepare(self, store: ColumnStore) -> Dict[str, np.ndarray]:
+        names = self._names()
+        n = store.n_rows
+        k = len(names)
+        anchor = np.zeros((n, k), dtype=np.float64)
+        mask = np.zeros((n, k), dtype=bool)
+        ref = self.reference_date_ms
+        for j, name in enumerate(names):
+            col = store[name]
+            assert isinstance(col, RaggedColumn)
+            for r in range(n):
+                row = col.flat[col.offsets[r]:col.offsets[r + 1]]
+                if row.size == 0:
+                    continue
+                mask[r, j] = True
+                anchor[r, j] = (row.max() if self.pivot == DateListPivot.SINCE_LAST
+                                else row.min())
+        if ref is None:
+            present = anchor[mask]
+            ref = float(present.max()) if present.size else 0.0
+        return {"anchor": anchor, "mask": mask,
+                "ref": np.asarray(float(ref))}
+
+    def device_compute(self, xp, prepared):
+        anchor, mask = prepared["anchor"], prepared["mask"]
+        ref = prepared["ref"]
+        days = (ref - anchor) / _MS_PER_DAY
+        days = xp.where(mask, days, 0.0)
+        if not self.track_nulls:
+            return days
+        n, k = anchor.shape
+        nulls = (~mask).astype(days.dtype)
+        return xp.stack([days, nulls], axis=2).reshape(n, 2 * k)
+
+    def vector_metadata(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for name in self._names():
+            cols.append(VectorColumnMetadata(
+                parent_feature_name=name, parent_feature_type="DateList",
+                descriptor_value=self.pivot))
+            if self.track_nulls:
+                cols.append(null_indicator_meta(name, "DateList"))
+        return VectorMetadata(self.meta_name, cols)

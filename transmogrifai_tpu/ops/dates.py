@@ -1,0 +1,135 @@
+"""Date/time vectorization — unit-circle embedding.
+
+Parity: ``DateToUnitCircleTransformer`` (``core/.../impl/feature/
+DateToUnitCircleTransformer.scala:78``): a timestamp's periodic component
+(HourOfDay / DayOfWeek / DayOfMonth / DayOfYear) maps to (sin θ, cos θ) on
+the unit circle — the TPU-friendly continuous encoding of cyclic time.
+
+Timestamps are epoch milliseconds (reference convention, joda-free).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..columns import ColumnStore
+from ..stages.base import register_stage
+from ..types.feature_types import Date
+from ..vector_metadata import VectorColumnMetadata, VectorMetadata
+from .vectorizer_base import (TransmogrifierDefaults, VectorizerEstimator,
+                              VectorizerModel, null_indicator_meta)
+
+__all__ = ["DateToUnitCircleVectorizer", "TimePeriod", "period_radians"]
+
+_MS_PER_HOUR = 3600 * 1000
+_MS_PER_DAY = 24 * _MS_PER_HOUR
+
+
+class TimePeriod:
+    HOUR_OF_DAY = "HourOfDay"
+    DAY_OF_WEEK = "DayOfWeek"
+    DAY_OF_MONTH = "DayOfMonth"
+    DAY_OF_YEAR = "DayOfYear"
+    WEEK_OF_YEAR = "WeekOfYear"
+    MONTH_OF_YEAR = "MonthOfYear"
+
+    ALL = [HOUR_OF_DAY, DAY_OF_WEEK, DAY_OF_MONTH, DAY_OF_YEAR]
+
+
+def period_radians(xp, millis, period: str):
+    """θ in [0, 2π) for the given period of an epoch-ms timestamp.
+
+    Pure array math (no calendar library) so it jits: day-of-week uses the
+    epoch anchor (1970-01-01 = Thursday); month/day-of-year use the mean
+    month/year length — adequate for a cyclic embedding.
+    """
+    two_pi = 2.0 * np.pi
+    if period == TimePeriod.HOUR_OF_DAY:
+        frac = (millis % _MS_PER_DAY) / _MS_PER_DAY
+    elif period == TimePeriod.DAY_OF_WEEK:
+        days = millis // _MS_PER_DAY
+        frac = ((days + 4) % 7) / 7.0  # epoch was Thursday (index 4 of Mon=0)
+    elif period == TimePeriod.DAY_OF_MONTH:
+        days = (millis / _MS_PER_DAY) % 30.4375
+        frac = days / 30.4375
+    elif period == TimePeriod.DAY_OF_YEAR:
+        days = (millis / _MS_PER_DAY) % 365.2425
+        frac = days / 365.2425
+    elif period == TimePeriod.WEEK_OF_YEAR:
+        weeks = xp.floor(((millis / _MS_PER_DAY) % 365.2425) / 7.0)
+        frac = weeks / 52.1775
+    elif period == TimePeriod.MONTH_OF_YEAR:
+        months = xp.floor(((millis / _MS_PER_DAY) % 365.2425) / 30.4375)
+        frac = months / 12.0
+    else:
+        raise ValueError(f"Unknown time period {period!r}")
+    return frac * two_pi
+
+
+@register_stage
+class DateToUnitCircleVectorizer(VectorizerModel):
+    """Date(s) → [sin θ, cos θ] per period per feature (+ null tracking).
+
+    A pure transformer (no fit state), but exposed with the vectorizer
+    protocol so it fuses into layer compilation like the others.
+    """
+
+    operation_name = "dateToUnitCircle"
+    seq_type = Date
+
+    def __init__(self, periods: Sequence[str] = (TimePeriod.HOUR_OF_DAY,),
+                 track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
+                 input_names: Sequence[str] = (),
+                 ftype_name: str = "Date",
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.periods = list(periods)
+        self.track_nulls = track_nulls
+        self.input_names_saved = list(input_names)
+        self.ftype_name = ftype_name
+
+    def _names(self) -> List[str]:
+        if self.input_features:
+            return [f.name for f in self.input_features]
+        return self.input_names_saved
+
+    def host_prepare(self, store: ColumnStore) -> Dict[str, np.ndarray]:
+        vals, masks = [], []
+        for name in self._names():
+            col = store[name]
+            vals.append(col.values.astype(np.float64))
+            masks.append(col.mask)
+        return {"millis": np.stack(vals, axis=1),
+                "mask": np.stack(masks, axis=1)}
+
+    def device_compute(self, xp, prepared):
+        millis, mask = prepared["millis"], prepared["mask"]
+        n, k = millis.shape
+        outs = []
+        for j in range(k):
+            m = mask[:, j]
+            for period in self.periods:
+                theta = period_radians(xp, millis[:, j], period)
+                outs.append(xp.where(m, xp.sin(theta), 0.0)[:, None])
+                outs.append(xp.where(m, xp.cos(theta), 0.0)[:, None])
+            if self.track_nulls:
+                outs.append((~m).astype(millis.dtype)[:, None])
+        return xp.concatenate(outs, axis=1)
+
+    def vector_metadata(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for name in self._names():
+            for period in self.periods:
+                for d in ("x", "y"):
+                    cols.append(VectorColumnMetadata(
+                        parent_feature_name=name,
+                        parent_feature_type=self.ftype_name,
+                        descriptor_value=f"{period}_{d}"))
+            if self.track_nulls:
+                cols.append(null_indicator_meta(name, self.ftype_name))
+        return VectorMetadata(self.meta_name, cols)
+
+    # transformer with no fit: estimator interface for Transmogrifier
+    def fit_columns(self, store):  # pragma: no cover - unused
+        return self

@@ -1,0 +1,215 @@
+"""Feature hashing — MurmurHash3-based hashing trick.
+
+Parity: ``OPCollectionHashingVectorizer`` + ``HashingFun``
+(``core/.../impl/feature/OPCollectionHashingVectorizer.scala``): MurmurHash3
+x86 32-bit of each token, bucketed modulo ``num_features``, with a shared or
+per-feature hash space (``HashSpaceStrategy``).
+
+Hashing runs on host (strings live there); the scattered count matrix is the
+device input. A C++ batch hasher (native/fasthash.cc) accelerates the hot
+loop when built; the pure-Python murmur3 below is the always-available
+fallback and the reference implementation for tests.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from ..columns import ColumnStore, TextColumn, TextListColumn, TextSetColumn
+from ..stages.base import register_stage
+from ..types.feature_types import MultiPickList, Text, TextList
+from ..vector_metadata import VectorColumnMetadata, VectorMetadata
+from .vectorizer_base import (TransmogrifierDefaults, VectorizerEstimator,
+                              VectorizerModel, null_indicator_meta)
+
+__all__ = ["murmur3_32", "hash_tokens", "HashingVectorizerModel",
+           "HashSpaceStrategy"]
+
+
+class HashSpaceStrategy:
+    SHARED = "Shared"
+    SEPARATE = "Separate"
+    AUTO = "Auto"
+
+
+# ---------------------------------------------------------------------------
+# MurmurHash3 x86 32-bit
+# ---------------------------------------------------------------------------
+
+_native_lib = None
+
+
+def _load_native():
+    global _native_lib
+    if _native_lib is not None:
+        return _native_lib
+    path = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                        "native", "libtmogtpu.so")
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        try:
+            lib = ctypes.CDLL(path)
+            lib.murmur3_batch.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_uint32)]
+            _native_lib = lib
+            return lib
+        except OSError:
+            pass
+    _native_lib = False
+    return False
+
+
+def murmur3_32(data: bytes, seed: int = 42) -> int:
+    """MurmurHash3 x86 32-bit (public algorithm, Austin Appleby)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    length = len(data)
+    n_blocks = length // 4
+    for i in range(n_blocks):
+        k = int.from_bytes(data[4 * i:4 * i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    tail = data[4 * n_blocks:]
+    k = 0
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= length
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def hash_tokens(tokens: Sequence[str], seed: int = 42) -> np.ndarray:
+    """uint32 murmur3 hash per token; uses the C++ batch hasher if built."""
+    if not tokens:
+        return np.zeros((0,), dtype=np.uint32)
+    lib = _load_native()
+    if lib:
+        encoded = [t.encode("utf-8") for t in tokens]
+        blob = b"".join(encoded)
+        offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+        np.cumsum([len(e) for e in encoded], out=offsets[1:])
+        out = np.zeros(len(encoded), dtype=np.uint32)
+        lib.murmur3_batch(
+            blob, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(encoded), seed,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+        return out
+    return np.array([murmur3_32(t.encode("utf-8"), seed) for t in tokens],
+                    dtype=np.uint32)
+
+
+def _tokens_of(col, row: int) -> List[str]:
+    if isinstance(col, TextColumn):
+        v = col.values[row]
+        return [v] if v is not None else []
+    if isinstance(col, (TextListColumn, TextSetColumn)):
+        return list(col.values[row])
+    raise TypeError(f"Cannot hash column {type(col).__name__}")
+
+
+@register_stage
+class HashingVectorizerModel(VectorizerModel):
+    """Hashing-trick transform: token counts scattered into hash buckets.
+
+    ``shared_hash_space=True`` → all features share one ``num_features``-wide
+    space; else each feature gets its own block.
+    """
+
+    operation_name = "hash"
+    seq_type = (Text, TextList, MultiPickList)  # hashable collection types
+
+    def __init__(self, num_features: int = TransmogrifierDefaults.HASH_SIZE,
+                 shared_hash_space: bool = False,
+                 track_nulls: bool = True,
+                 binary_freq: bool = False,
+                 seed: int = 42,
+                 input_names: Sequence[str] = (),
+                 ftype_name: str = "Text",
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.num_features = num_features
+        self.shared_hash_space = shared_hash_space
+        self.track_nulls = track_nulls
+        self.binary_freq = binary_freq
+        self.seed = seed
+        self.input_names_saved = list(input_names)
+        self.ftype_name = ftype_name
+
+    def _names(self) -> List[str]:
+        if self.input_features:
+            return [f.name for f in self.input_features]
+        return self.input_names_saved
+
+    def host_prepare(self, store: ColumnStore) -> Dict[str, np.ndarray]:
+        names = self._names()
+        n = store.n_rows
+        k = len(names)
+        width = self.num_features if self.shared_hash_space \
+            else self.num_features * k
+        counts = np.zeros((n, width), dtype=np.float64)
+        nulls = np.zeros((n, k), dtype=np.float64)
+        for j, name in enumerate(names):
+            col = store[name]
+            base = 0 if self.shared_hash_space else j * self.num_features
+            for r in range(n):
+                toks = _tokens_of(col, r)
+                if not toks:
+                    nulls[r, j] = 1.0
+                    continue
+                hashed = hash_tokens(toks, self.seed) % self.num_features
+                if self.binary_freq:
+                    counts[r, base + hashed] = 1.0
+                else:
+                    np.add.at(counts[r], base + hashed, 1.0)
+        return {"counts": counts, "nulls": nulls}
+
+    def device_compute(self, xp, prepared):
+        counts = xp.asarray(prepared["counts"])
+        if not self.track_nulls:
+            return counts
+        return xp.concatenate([counts, xp.asarray(prepared["nulls"])], axis=1)
+
+    def vector_metadata(self) -> VectorMetadata:
+        names = self._names()
+        cols: List[VectorColumnMetadata] = []
+        if self.shared_hash_space:
+            for i in range(self.num_features):
+                cols.append(VectorColumnMetadata(
+                    parent_feature_name=names[0] if len(names) == 1 else "shared",
+                    parent_feature_type=self.ftype_name,
+                    grouping=None, descriptor_value=f"hash_{i}"))
+        else:
+            for name in names:
+                for i in range(self.num_features):
+                    cols.append(VectorColumnMetadata(
+                        parent_feature_name=name,
+                        parent_feature_type=self.ftype_name,
+                        descriptor_value=f"hash_{i}"))
+        if self.track_nulls:
+            for name in names:
+                cols.append(null_indicator_meta(name, self.ftype_name))
+        return VectorMetadata(self.meta_name, cols)
+
+    def get_model_state(self):
+        return {"input_names_saved": self._names()}

@@ -1,0 +1,228 @@
+"""Map vectorizers — expand map keys into columns, delegate per element kind.
+
+Parity: ``OPMapVectorizer`` family (``core/.../impl/feature/OPMapVectorizer.scala``,
+``TextMapPivotVectorizer``, ``MultiPickListMapVectorizer``,
+``SmartTextMapVectorizer``, ``GeolocationMapVectorizer``,
+``DateMapToUnitCircleVectorizer``).
+
+Design: a fitted map vectorizer records the key set discovered at fit time,
+explodes each map feature into per-key child columns named
+``{feature}::{key}``, and delegates to the matching scalar vectorizer model
+— so every element kind reuses the exact impute/pivot/hash/unit-circle logic
+and metadata layout of its scalar counterpart, with ``grouping`` set to the
+map key (OpVectorColumnMetadata semantics).
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from ..columns import (Column, ColumnStore, GeoColumn, MapColumn,
+                       NumericColumn, TextColumn, TextSetColumn,
+                       column_of_empty)
+from ..features import Feature
+from ..stages.base import VarArity, register_stage
+from ..types import feature_types as ft
+from ..vector_metadata import VectorColumnMetadata, VectorMetadata
+from .dates import DateToUnitCircleVectorizer
+from .geo import GeolocationVectorizerModel, geo_mean
+from .numeric import NumericVectorizerModel
+from .onehot import OneHotModel, _sorted_topk
+from .vectorizer_base import (TransmogrifierDefaults, VectorizerEstimator,
+                              VectorizerModel)
+
+__all__ = ["MapVectorizer", "MapVectorizerModel", "vectorize_maps"]
+
+
+def _exploded_name(feature: str, key: str) -> str:
+    return f"{feature}::{key}"
+
+
+def _child_or_empty(col: MapColumn, key: str, elem_ftype) -> Column:
+    child = col.children.get(key)
+    if child is not None:
+        return child
+    return column_of_empty(elem_ftype, len(col))
+
+
+def _explode(store: ColumnStore, names: Sequence[str],
+             keys_per_feature: Sequence[Sequence[str]]) -> ColumnStore:
+    cols = {}
+    for name, keys in zip(names, keys_per_feature):
+        col = store[name]
+        assert isinstance(col, MapColumn), f"{name} is not a map column"
+        for k in keys:
+            cols[_exploded_name(name, k)] = _child_or_empty(
+                col, k, col.ftype.element_type)
+    return ColumnStore(cols, store.n_rows)
+
+
+@register_stage
+class MapVectorizerModel(VectorizerModel):
+    """Fitted map vectorizer: keys + a delegate scalar vectorizer model."""
+
+    operation_name = "vecMap"
+    seq_type = ft.OPMap
+
+    def __init__(self, keys_per_feature: Sequence[Sequence[str]] = (),
+                 delegate_class: str = "NumericVectorizerModel",
+                 delegate_params: Optional[dict] = None,
+                 input_names: Sequence[str] = (),
+                 ftype_name: str = "RealMap",
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.keys_per_feature = [list(k) for k in keys_per_feature]
+        self.delegate_class = delegate_class
+        self.delegate_params = dict(delegate_params or {})
+        self.input_names_saved = list(input_names)
+        self.ftype_name = ftype_name
+        self._delegate = None
+
+    def _names(self) -> List[str]:
+        if self.input_features:
+            return [f.name for f in self.input_features]
+        return self.input_names_saved
+
+    @property
+    def delegate(self):
+        if self._delegate is None:
+            from ..stages.base import STAGE_REGISTRY
+            cls = STAGE_REGISTRY[self.delegate_class]
+            exploded = [_exploded_name(n, k)
+                        for n, keys in zip(self._names(), self.keys_per_feature)
+                        for k in keys]
+            self._delegate = cls(input_names=exploded, **self.delegate_params)
+        return self._delegate
+
+    def host_prepare(self, store: ColumnStore):
+        exploded = _explode(store, self._names(), self.keys_per_feature)
+        return self.delegate.host_prepare(exploded)
+
+    def device_compute(self, xp, prepared):
+        return self.delegate.device_compute(xp, prepared)
+
+    def vector_metadata(self) -> VectorMetadata:
+        meta = self.delegate.vector_metadata()
+        cols = []
+        for cm in meta.columns:
+            feat, _, key = cm.parent_feature_name.partition("::")
+            cols.append(VectorColumnMetadata(
+                parent_feature_name=feat,
+                parent_feature_type=self.ftype_name,
+                grouping=key or cm.grouping,
+                indicator_value=cm.indicator_value,
+                descriptor_value=cm.descriptor_value))
+        return VectorMetadata(self.meta_name, cols)
+
+    def get_model_state(self):
+        return {"keys_per_feature": self.keys_per_feature,
+                "delegate_params": self.delegate_params,
+                "input_names_saved": self._names()}
+
+
+@register_stage
+class MapVectorizer(VectorizerEstimator):
+    """Estimator: discover keys, fit the per-kind delegate
+    (OPMapVectorizer.scala)."""
+
+    operation_name = "vecMap"
+    seq_type = ft.OPMap
+
+    def __init__(self, top_k: int = TransmogrifierDefaults.TOP_K,
+                 min_support: int = TransmogrifierDefaults.MIN_SUPPORT,
+                 track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+
+    def _discover_keys(self, store: ColumnStore) -> List[List[str]]:
+        out = []
+        for name in self.input_names:
+            col = store[name]
+            assert isinstance(col, MapColumn)
+            out.append(sorted(col.children.keys()))
+        return out
+
+    def fit_columns(self, store: ColumnStore) -> MapVectorizerModel:
+        elem = self.input_features[0].ftype.map_element_kind
+        ftype = self.input_features[0].ftype
+        keys = self._discover_keys(store)
+        exploded = _explode(store, self.input_names, keys)
+        exploded_names = list(exploded.names())
+
+        if elem in (ft.ColumnKind.REAL, ft.ColumnKind.INTEGRAL,
+                    ft.ColumnKind.BINARY):
+            if issubclass(ftype.element_type, ft.Date):
+                delegate_cls, params = "DateToUnitCircleVectorizer", {
+                    "periods": TransmogrifierDefaults.CIRCULAR_DATE_REPRESENTATIONS,
+                    "track_nulls": self.track_nulls}
+            else:
+                fills = []
+                for n in exploded_names:
+                    col = exploded[n]
+                    if elem == ft.ColumnKind.REAL and col.mask.any():
+                        fills.append(float(
+                            col.values[col.mask].astype(np.float64).mean()))
+                    elif elem == ft.ColumnKind.INTEGRAL and col.mask.any():
+                        vals, counts = np.unique(col.values[col.mask],
+                                                 return_counts=True)
+                        fills.append(float(vals[np.argmax(counts)]))
+                    else:
+                        fills.append(0.0)
+                delegate_cls, params = "NumericVectorizerModel", {
+                    "fill_values": fills, "track_nulls": self.track_nulls,
+                    "ftype_name": ftype.__name__}
+        elif elem in (ft.ColumnKind.TEXT, ft.ColumnKind.TEXT_SET):
+            vocabs = []
+            for n in exploded_names:
+                col = exploded[n]
+                c: Counter = Counter()
+                if isinstance(col, TextSetColumn):
+                    for values in col.values:
+                        for v in values:
+                            c[v] += 1
+                else:
+                    for v in col.values:
+                        if v is not None:
+                            c[v] += 1
+                vocabs.append(_sorted_topk(c, self.top_k, self.min_support))
+            delegate_cls, params = "OneHotModel", {
+                "vocabs": vocabs, "track_nulls": self.track_nulls,
+                "ftype_name": ftype.__name__,
+                "is_set": elem == ft.ColumnKind.TEXT_SET}
+        elif elem == ft.ColumnKind.GEO:
+            fills = []
+            for n in exploded_names:
+                col = exploded[n]
+                assert isinstance(col, GeoColumn)
+                fills.append(geo_mean(col.values, col.mask))
+            delegate_cls, params = "GeolocationVectorizerModel", {
+                "fill_values": fills, "track_nulls": self.track_nulls}
+        else:
+            raise TypeError(
+                f"No map vectorizer for element kind {elem} ({ftype.__name__})")
+
+        return MapVectorizerModel(
+            keys_per_feature=keys, delegate_class=delegate_cls,
+            delegate_params=params, input_names=self.input_names,
+            ftype_name=ftype.__name__)
+
+
+def vectorize_maps(features: Sequence[Feature],
+                   defaults: Type[TransmogrifierDefaults]
+                   ) -> List[Feature]:
+    """Group map features by concrete type; one MapVectorizer per type."""
+    by_type: Dict[Type, List[Feature]] = {}
+    for f in features:
+        by_type.setdefault(f.ftype, []).append(f)
+    out = []
+    for ftype, feats in sorted(by_type.items(), key=lambda kv: kv[0].__name__):
+        stage = MapVectorizer(top_k=defaults.TOP_K,
+                              min_support=defaults.MIN_SUPPORT,
+                              track_nulls=defaults.TRACK_NULLS)
+        out.append(feats[0].transform_with(stage, *feats[1:]))
+    return out

@@ -1,0 +1,276 @@
+"""Numeric vectorizers: mean/mode impute + null tracking, bucketizers.
+
+Parity targets: ``RealVectorizer`` (mean impute, ``core/.../impl/feature/
+RealVectorizer.scala:121``), ``IntegralVectorizer`` (mode impute),
+``BinaryVectorizer``, ``NumericBucketizer``.
+
+Layout per input feature: ``[imputed value, (null indicator)]`` — one slot
+plus an optional tracked-null slot, concatenated over the N inputs.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Type
+
+import numpy as np
+
+from ..columns import ColumnStore, NumericColumn
+from ..stages.base import register_stage
+from ..types.feature_types import (Binary, FeatureType, Integral, OPNumeric,
+                                   Real)
+from ..vector_metadata import VectorColumnMetadata, VectorMetadata
+from .vectorizer_base import (TransmogrifierDefaults, VectorizerEstimator,
+                              VectorizerModel, null_indicator_meta)
+
+__all__ = ["RealVectorizer", "IntegralVectorizer", "BinaryVectorizer",
+           "NumericBucketizer", "NumericVectorizerModel"]
+
+
+@register_stage
+class NumericVectorizerModel(VectorizerModel):
+    """Shared fitted model: per-feature fill value + null tracking."""
+
+    operation_name = "vecNumeric"
+    seq_type = OPNumeric
+
+    def __init__(self, fill_values: Sequence[float] = (),
+                 track_nulls: bool = True,
+                 input_names: Sequence[str] = (),
+                 ftype_name: str = "Real",
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.fill_values = list(fill_values)
+        self.track_nulls = track_nulls
+        self.input_names_saved = list(input_names)
+        self.ftype_name = ftype_name
+
+    def _names(self) -> List[str]:
+        if self.input_features:
+            return [f.name for f in self.input_features]
+        return self.input_names_saved
+
+    def host_prepare(self, store: ColumnStore) -> Dict[str, np.ndarray]:
+        vals, masks = [], []
+        for name in self._names():
+            col = store[name]
+            vals.append(col.values.astype(np.float64))
+            masks.append(col.mask)
+        return {"values": np.stack(vals, axis=1),
+                "mask": np.stack(masks, axis=1)}
+
+    def device_compute(self, xp, prepared):
+        values, mask = prepared["values"], prepared["mask"]
+        fill = xp.asarray(np.array(self.fill_values, dtype=np.float64))
+        imputed = xp.where(mask, values, fill[None, :])
+        if not self.track_nulls:
+            return imputed
+        nulls = (~mask).astype(imputed.dtype)
+        # interleave [value_i, null_i] to match reference column order
+        n, k = imputed.shape
+        out = xp.stack([imputed, nulls], axis=2).reshape(n, 2 * k)
+        return out
+
+    def vector_metadata(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for name in self._names():
+            cols.append(VectorColumnMetadata(
+                parent_feature_name=name, parent_feature_type=self.ftype_name))
+            if self.track_nulls:
+                cols.append(null_indicator_meta(name, self.ftype_name))
+        return VectorMetadata(self.meta_name, cols)
+
+    def get_model_state(self):
+        return {"fill_values": self.fill_values,
+                "input_names_saved": self._names()}
+
+
+class _NumericVectorizerBase(VectorizerEstimator):
+    def __init__(self, track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
+                 fill_value: float = TransmogrifierDefaults.FILL_VALUE,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.track_nulls = track_nulls
+        self.fill_value = fill_value
+
+    def _fill_for(self, col) -> float:
+        raise NotImplementedError
+
+    def fit_columns(self, store: ColumnStore) -> NumericVectorizerModel:
+        fills = [self._fill_for(store[n]) for n in self.input_names]
+        return NumericVectorizerModel(
+            fill_values=fills, track_nulls=self.track_nulls,
+            input_names=self.input_names,
+            ftype_name=self.seq_type.__name__)
+
+
+@register_stage
+class RealVectorizer(_NumericVectorizerBase):
+    """Real → [mean-imputed value, null indicator] (RealVectorizer.scala:121)."""
+
+    operation_name = "vecReal"
+    seq_type = Real
+
+    def __init__(self, fill_with_mean: bool = TransmogrifierDefaults.FILL_WITH_MEAN,
+                 track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
+                 fill_value: float = TransmogrifierDefaults.FILL_VALUE,
+                 uid: Optional[str] = None):
+        super().__init__(track_nulls=track_nulls, fill_value=fill_value, uid=uid)
+        self.fill_with_mean = fill_with_mean
+
+    def _fill_for(self, col) -> float:
+        if self.fill_with_mean and col.mask.any():
+            return float(col.values[col.mask].astype(np.float64).mean())
+        return float(self.fill_value)
+
+
+@register_stage
+class IntegralVectorizer(_NumericVectorizerBase):
+    """Integral → [mode-imputed value, null indicator]. Mode = most frequent
+    value, ties → smallest (SequenceAggregators.ModeSeqNullInt semantics)."""
+
+    operation_name = "vecIntegral"
+    seq_type = Integral
+
+    def __init__(self, fill_with_mode: bool = TransmogrifierDefaults.FILL_WITH_MODE,
+                 track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
+                 fill_value: float = TransmogrifierDefaults.FILL_VALUE,
+                 uid: Optional[str] = None):
+        super().__init__(track_nulls=track_nulls, fill_value=fill_value, uid=uid)
+        self.fill_with_mode = fill_with_mode
+
+    def _fill_for(self, col) -> float:
+        if self.fill_with_mode and col.mask.any():
+            vals, counts = np.unique(col.values[col.mask], return_counts=True)
+            return float(vals[np.argmax(counts)])  # unique is sorted → ties to min
+        return float(self.fill_value)
+
+
+@register_stage
+class BinaryVectorizer(_NumericVectorizerBase):
+    """Binary → [0/1 with fill, null indicator]."""
+
+    operation_name = "vecBinary"
+    seq_type = Binary
+
+    def __init__(self, track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
+                 fill_value: float = TransmogrifierDefaults.BINARY_FILL_VALUE,
+                 uid: Optional[str] = None):
+        super().__init__(track_nulls=track_nulls, fill_value=fill_value, uid=uid)
+
+    def _fill_for(self, col) -> float:
+        return float(self.fill_value)
+
+
+@register_stage
+class NumericBucketizerModel(VectorizerModel):
+    """One-hot of value buckets + optional null slot per feature."""
+
+    operation_name = "bucketize"
+    seq_type = OPNumeric
+
+    def __init__(self, splits: Sequence[Sequence[float]] = (),
+                 track_nulls: bool = True,
+                 track_invalid: bool = False,
+                 input_names: Sequence[str] = (),
+                 ftype_name: str = "Real",
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.splits = [list(map(float, s)) for s in splits]
+        self.track_nulls = track_nulls
+        self.track_invalid = track_invalid
+        self.input_names_saved = list(input_names)
+        self.ftype_name = ftype_name
+
+    def _names(self) -> List[str]:
+        if self.input_features:
+            return [f.name for f in self.input_features]
+        return self.input_names_saved
+
+    def host_prepare(self, store: ColumnStore) -> Dict[str, np.ndarray]:
+        vals, masks = [], []
+        for name in self._names():
+            col = store[name]
+            vals.append(col.values.astype(np.float64))
+            masks.append(col.mask)
+        return {"values": np.stack(vals, axis=1),
+                "mask": np.stack(masks, axis=1)}
+
+    def device_compute(self, xp, prepared):
+        values, mask = prepared["values"], prepared["mask"]
+        outs = []
+        for j, splits in enumerate(self.splits):
+            edges = xp.asarray(np.array(splits, dtype=np.float64))
+            v = values[:, j]
+            m = mask[:, j]
+            # bucket b: edges[b] <= v < edges[b+1]; last bucket right-closed
+            idx = xp.clip(xp.searchsorted(edges, v, side="right") - 1,
+                          0, len(splits) - 2)
+            in_range = (v >= edges[0]) & (v <= edges[-1])
+            valid = m & in_range
+            onehot = (idx[:, None] == xp.arange(len(splits) - 1)[None, :])
+            onehot = onehot & valid[:, None]
+            outs.append(onehot.astype(values.dtype))
+            if self.track_invalid:
+                outs.append((m & ~in_range).astype(values.dtype)[:, None])
+            if self.track_nulls:
+                outs.append((~m).astype(values.dtype)[:, None])
+        return xp.concatenate(outs, axis=1)
+
+    def vector_metadata(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for name, splits in zip(self._names(), self.splits):
+            for b in range(len(splits) - 1):
+                cols.append(VectorColumnMetadata(
+                    parent_feature_name=name,
+                    parent_feature_type=self.ftype_name,
+                    indicator_value=f"{splits[b]}-{splits[b + 1]}"))
+            if self.track_invalid:
+                cols.append(VectorColumnMetadata(
+                    parent_feature_name=name,
+                    parent_feature_type=self.ftype_name,
+                    indicator_value="OutOfBounds"))
+            if self.track_nulls:
+                cols.append(null_indicator_meta(name, self.ftype_name))
+        return VectorMetadata(self.meta_name, cols)
+
+    def get_model_state(self):
+        return {"splits": self.splits, "input_names_saved": self._names()}
+
+
+@register_stage
+class NumericBucketizer(VectorizerEstimator):
+    """Fixed or quantile splits → one-hot buckets (NumericBucketizer)."""
+
+    operation_name = "bucketize"
+    seq_type = OPNumeric
+
+    def __init__(self, splits: Optional[Sequence[float]] = None,
+                 num_buckets: int = 4,
+                 track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
+                 track_invalid: bool = TransmogrifierDefaults.TRACK_INVALID,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.splits = list(splits) if splits is not None else None
+        self.num_buckets = num_buckets
+        self.track_nulls = track_nulls
+        self.track_invalid = track_invalid
+
+    def fit_columns(self, store: ColumnStore) -> NumericBucketizerModel:
+        per_feature = []
+        for name in self.input_names:
+            if self.splits is not None:
+                per_feature.append(self.splits)
+                continue
+            col = store[name]
+            present = col.values[col.mask].astype(np.float64)
+            if present.size == 0:
+                per_feature.append([0.0, 1.0])
+                continue
+            qs = np.quantile(present, np.linspace(0, 1, self.num_buckets + 1))
+            qs = np.unique(qs)
+            if qs.size < 2:
+                qs = np.array([qs[0], qs[0] + 1.0])
+            per_feature.append(qs.tolist())
+        return NumericBucketizerModel(
+            splits=per_feature, track_nulls=self.track_nulls,
+            track_invalid=self.track_invalid, input_names=self.input_names,
+            ftype_name=self.seq_type.__name__)

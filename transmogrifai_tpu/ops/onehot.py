@@ -1,0 +1,185 @@
+"""One-hot pivot vectorizers for categorical text and sets.
+
+Parity: ``OpOneHotVectorizer``/``OpSetVectorizer``/``OpTextPivotVectorizer``
+(``core/.../impl/feature/OpOneHotVectorizer.scala``): per feature, count
+values, keep top-K with count >= min_support, emit
+``[cat_1 .. cat_K, OTHER, NullIndicator]``.
+
+Fit is a host-side value count (strings never reach the device); transform
+is host vocab lookup → device one-hot scatter.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Type
+
+import numpy as np
+
+from ..columns import ColumnStore, TextColumn, TextSetColumn
+from ..stages.base import register_stage
+from ..types.feature_types import MultiPickList, OPSet, Text
+from ..vector_metadata import (NULL_INDICATOR, OTHER_INDICATOR,
+                               VectorColumnMetadata, VectorMetadata)
+from .vectorizer_base import (TransmogrifierDefaults, VectorizerEstimator,
+                              VectorizerModel)
+
+__all__ = ["OneHotVectorizer", "SetVectorizer", "OneHotModel"]
+
+
+def _sorted_topk(counts: Counter, top_k: int, min_support: int) -> List[str]:
+    """Top-K by count desc, ties by value asc (deterministic)."""
+    items = [(v, c) for v, c in counts.items() if c >= min_support]
+    items.sort(key=lambda vc: (-vc[1], vc[0]))
+    return [v for v, _ in items[:top_k]]
+
+
+@register_stage
+class OneHotModel(VectorizerModel):
+    """Fitted pivot: per-feature vocab → [cats..., OTHER, null]."""
+
+    operation_name = "pivot"
+    seq_type = Text
+
+    def __init__(self, vocabs: Sequence[Sequence[str]] = (),
+                 track_nulls: bool = True,
+                 input_names: Sequence[str] = (),
+                 ftype_name: str = "Text",
+                 is_set: bool = False,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.vocabs = [list(v) for v in vocabs]
+        self.track_nulls = track_nulls
+        self.input_names_saved = list(input_names)
+        self.ftype_name = ftype_name
+        self.is_set = is_set
+
+    @property
+    def input_spec(self):
+        from ..stages.base import VarArity
+        return VarArity(OPSet if self.is_set else Text)
+
+    def _names(self) -> List[str]:
+        if self.input_features:
+            return [f.name for f in self.input_features]
+        return self.input_names_saved
+
+    def host_prepare(self, store: ColumnStore) -> Dict[str, np.ndarray]:
+        """Strings → per-feature one-hot blocks, built on host.
+
+        Output per feature: f64[n, K+1(+1)] already scattered — the one-hot
+        scatter is host work because the vocab lookup is; device_compute is
+        then a pure concat (fusable into the layer's XLA computation).
+        """
+        blocks = []
+        for name, vocab in zip(self._names(), self.vocabs):
+            col = store[name]
+            index = {v: i for i, v in enumerate(vocab)}
+            k = len(vocab)
+            width = k + 1 + (1 if self.track_nulls else 0)
+            block = np.zeros((len(col), width), dtype=np.float64)
+            if isinstance(col, TextSetColumn):
+                for r, values in enumerate(col.values):
+                    if not values:
+                        if self.track_nulls:
+                            block[r, k + 1] = 1.0
+                        continue
+                    for v in values:
+                        i = index.get(v)
+                        if i is None:
+                            block[r, k] = 1.0
+                        else:
+                            block[r, i] = 1.0
+            else:
+                for r, v in enumerate(col.values):
+                    if v is None:
+                        if self.track_nulls:
+                            block[r, k + 1] = 1.0
+                        continue
+                    i = index.get(v)
+                    if i is None:
+                        block[r, k] = 1.0
+                    else:
+                        block[r, i] = 1.0
+            blocks.append(block)
+        return {f"block{i}": b for i, b in enumerate(blocks)}
+
+    def device_compute(self, xp, prepared):
+        blocks = [prepared[f"block{i}"] for i in range(len(self.vocabs))]
+        return xp.concatenate([xp.asarray(b) for b in blocks], axis=1)
+
+    def vector_metadata(self) -> VectorMetadata:
+        cols: List[VectorColumnMetadata] = []
+        for name, vocab in zip(self._names(), self.vocabs):
+            for v in vocab:
+                cols.append(VectorColumnMetadata(
+                    parent_feature_name=name, parent_feature_type=self.ftype_name,
+                    grouping=name, indicator_value=v))
+            cols.append(VectorColumnMetadata(
+                parent_feature_name=name, parent_feature_type=self.ftype_name,
+                grouping=name, indicator_value=OTHER_INDICATOR))
+            if self.track_nulls:
+                cols.append(VectorColumnMetadata(
+                    parent_feature_name=name, parent_feature_type=self.ftype_name,
+                    grouping=name, indicator_value=NULL_INDICATOR))
+        return VectorMetadata(self.meta_name, cols)
+
+    def get_model_state(self):
+        return {"vocabs": self.vocabs, "input_names_saved": self._names()}
+
+
+@register_stage
+class OneHotVectorizer(VectorizerEstimator):
+    """Categorical text pivot estimator (OpOneHotVectorizer.scala)."""
+
+    operation_name = "pivot"
+    seq_type = Text
+
+    def __init__(self, top_k: int = TransmogrifierDefaults.TOP_K,
+                 min_support: int = TransmogrifierDefaults.MIN_SUPPORT,
+                 track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.top_k = top_k
+        self.min_support = min_support
+        self.track_nulls = track_nulls
+
+    def _count(self, col) -> Counter:
+        c: Counter = Counter()
+        for v in col.values:
+            if v is not None:
+                c[v] += 1
+        return c
+
+    def fit_columns(self, store: ColumnStore) -> OneHotModel:
+        vocabs = [_sorted_topk(self._count(store[n]), self.top_k,
+                               self.min_support)
+                  for n in self.input_names]
+        return OneHotModel(
+            vocabs=vocabs, track_nulls=self.track_nulls,
+            input_names=self.input_names,
+            ftype_name=self.seq_type.__name__)
+
+
+@register_stage
+class SetVectorizer(OneHotVectorizer):
+    """MultiPickList pivot (OpSetVectorizer): multi-hot over top-K values."""
+
+    operation_name = "pivotSet"
+    seq_type = OPSet
+
+    def _count(self, col) -> Counter:
+        c: Counter = Counter()
+        for values in col.values:
+            for v in values:
+                c[v] += 1
+        return c
+
+    def fit_columns(self, store: ColumnStore) -> OneHotModel:
+        vocabs = [_sorted_topk(self._count(store[n]), self.top_k,
+                               self.min_support)
+                  for n in self.input_names]
+        # is_set/ftype_name must ride the ctor so save/load preserves them
+        return OneHotModel(
+            vocabs=vocabs, track_nulls=self.track_nulls,
+            input_names=self.input_names,
+            ftype_name=self.seq_type.__name__, is_set=True)

@@ -1,0 +1,308 @@
+"""SanityChecker — automated feature validation and bad-feature removal.
+
+Parity: ``core/.../impl/preparators/SanityChecker.scala`` (fitFn :535-693,
+``ColumnStatistics.reasonsToRemove`` :783-832, defaults :720-739).
+
+TPU re-design: the reference runs ``Statistics.colStats`` + a corr matrix +
+a ``reduceByKey`` contingency sweep as separate Spark jobs; here the whole
+fit is **two fused device matmuls**:
+
+* moments + correlations: append the label to the feature matrix and compute
+  one ``Zᵀ Z`` gram (means/variances/Pearson all fall out of it);
+* categorical stats: one ``Yᵀ X`` contingency matmul over the one-hot label
+  against every categorical indicator block → χ² / Cramér's V / PMI /
+  rule support+confidence per group (``OpStatistics.contingencyStats``,
+  ``utils/.../stats/OpStatistics.scala:300``).
+
+The fitted model drops flagged vector slots and re-indexes the metadata.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columns import Column, ColumnStore, VectorColumn
+from ..stages.base import (AllowLabelAsInput, Estimator, FittedModel,
+                           FixedArity, InputSpec, register_stage)
+from ..types.feature_types import OPVector, RealNN
+from ..vector_metadata import VectorMetadata
+from .vectorizer_base import VectorizerModel
+
+__all__ = ["SanityChecker", "SanityCheckerModel", "SanityCheckerSummary"]
+
+# defaults (SanityChecker.scala:720-739)
+CHECK_SAMPLE = 1.0
+SAMPLE_LOWER_LIMIT = 1_000
+SAMPLE_UPPER_LIMIT = 1_000_000
+MAX_CORRELATION = 0.95
+MIN_CORRELATION = 0.0
+MIN_VARIANCE = 1e-5
+MAX_CRAMERS_V = 0.95
+MAX_RULE_CONFIDENCE = 1.0
+MIN_REQUIRED_RULE_SUPPORT = 1.0
+
+
+@functools.partial(jax.jit, static_argnames=("label_corr_only",))
+def _moments_kernel(X, y, label_corr_only: bool):
+    """One fused pass: means, variances, label correlation (+ full corr)."""
+    n = X.shape[0]
+    Z = jnp.concatenate([X, y[:, None]], axis=1)
+    mean = Z.mean(axis=0)
+    Zc = Z - mean
+    cov = Zc.T @ Zc / jnp.maximum(n - 1, 1)
+    var = jnp.diagonal(cov)
+    std = jnp.sqrt(jnp.maximum(var, 0.0))
+    denom = jnp.maximum(jnp.outer(std, std), 1e-30)
+    if label_corr_only:
+        corr_label = cov[:-1, -1] / denom[:-1, -1]
+        corr = None
+    else:
+        corr = cov / denom
+        corr_label = corr[:-1, -1]
+    zmin = Z.min(axis=0)
+    zmax = Z.max(axis=0)
+    return mean, var, corr_label, corr, zmin, zmax
+
+
+@jax.jit
+def _contingency_kernel(Y_onehot, Xg):
+    """Contingency counts: [n_classes, n_categories]."""
+    return Y_onehot.T @ Xg
+
+
+def _cramers_v(cont: np.ndarray) -> Tuple[float, np.ndarray, np.ndarray]:
+    """Cramér's V (bias-uncorrected, MLlib chi2 semantics) + per-category
+    support and max rule confidence (OpStatistics.scala:71-346)."""
+    total = cont.sum()
+    if total <= 0:
+        return 0.0, np.zeros(cont.shape[1]), np.zeros(cont.shape[1])
+    row = cont.sum(axis=1, keepdims=True)
+    col = cont.sum(axis=0, keepdims=True)
+    expected = row @ col / total
+    with np.errstate(divide="ignore", invalid="ignore"):
+        chi2 = np.where(expected > 0, (cont - expected) ** 2 / expected, 0.0).sum()
+    r, c = cont.shape
+    dof_dim = min(r - 1, c - 1)
+    v = float(np.sqrt(chi2 / (total * dof_dim))) if dof_dim > 0 else 0.0
+    support = (col / total).ravel()
+    with np.errstate(divide="ignore", invalid="ignore"):
+        confidence = np.where(col > 0, cont.max(axis=0) / col.ravel(), 0.0).ravel()
+    return v, support, confidence
+
+
+class SanityCheckerSummary:
+    """Per-column stats + dropped columns with reasons
+    (SanityCheckerMetadata.scala)."""
+
+    def __init__(self):
+        self.column_stats: List[Dict[str, Any]] = []
+        self.categorical_stats: List[Dict[str, Any]] = []
+        self.dropped: List[Dict[str, Any]] = []
+        self.names: List[str] = []
+        self.correlations_with_label: List[float] = []
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"columnStats": self.column_stats,
+                "categoricalStats": self.categorical_stats,
+                "droppedColumns": self.dropped,
+                "correlationsWithLabel": dict(
+                    zip(self.names, self.correlations_with_label))}
+
+
+@register_stage
+class SanityCheckerModel(FittedModel, AllowLabelAsInput):
+    """Drops flagged slots; output vector = kept columns."""
+
+    operation_name = "sanityCheck"
+    output_type = OPVector
+
+    def __init__(self, keep_indices: Sequence[int] = (),
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.keep_indices = list(map(int, keep_indices))
+        self.summary_: Optional[SanityCheckerSummary] = None
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(RealNN, OPVector)
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        col = store[self.input_features[1].name]
+        assert isinstance(col, VectorColumn)
+        idx = np.asarray(self.keep_indices, dtype=np.int64)
+        meta = col.metadata.select(self.keep_indices) if col.metadata else None
+        if meta is not None:
+            meta.name = self.output_name
+        return VectorColumn(OPVector, col.values[:, idx], meta)
+
+    def get_model_state(self):
+        return {"keep_indices": self.keep_indices}
+
+    def summary(self):
+        return self.summary_.to_json() if self.summary_ else {}
+
+
+@register_stage
+class SanityChecker(Estimator, AllowLabelAsInput):
+    """Estimator(label, features) → cleaned OPVector."""
+
+    operation_name = "sanityCheck"
+    output_type = OPVector
+
+    def __init__(self, max_correlation: float = MAX_CORRELATION,
+                 min_correlation: float = MIN_CORRELATION,
+                 min_variance: float = MIN_VARIANCE,
+                 max_cramers_v: float = MAX_CRAMERS_V,
+                 remove_bad_features: bool = False,
+                 remove_feature_group: bool = True,
+                 protect_text_shared_hash: bool = False,
+                 max_rule_confidence: float = MAX_RULE_CONFIDENCE,
+                 min_required_rule_support: float = MIN_REQUIRED_RULE_SUPPORT,
+                 feature_label_corr_only: bool = False,
+                 check_sample: float = CHECK_SAMPLE,
+                 sample_seed: int = 42,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.max_correlation = max_correlation
+        self.min_correlation = min_correlation
+        self.min_variance = min_variance
+        self.max_cramers_v = max_cramers_v
+        self.remove_bad_features = remove_bad_features
+        self.remove_feature_group = remove_feature_group
+        self.protect_text_shared_hash = protect_text_shared_hash
+        self.max_rule_confidence = max_rule_confidence
+        self.min_required_rule_support = min_required_rule_support
+        self.feature_label_corr_only = feature_label_corr_only
+        self.check_sample = check_sample
+        self.sample_seed = sample_seed
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(RealNN, OPVector)
+
+    def fit_columns(self, store: ColumnStore) -> SanityCheckerModel:
+        label_name = self.input_features[0].name
+        feat_name = self.input_features[1].name
+        ycol = store[label_name]
+        xcol = store[feat_name]
+        assert isinstance(xcol, VectorColumn)
+        X = np.asarray(xcol.values, dtype=np.float64)
+        y = np.asarray(ycol.values, dtype=np.float64)
+        n, d = X.shape
+        meta = xcol.metadata or VectorMetadata(feat_name, [])
+
+        # sampling (SanityChecker.scala:552-560): bounded row sample
+        if n > SAMPLE_UPPER_LIMIT or self.check_sample < 1.0:
+            rng = np.random.default_rng(self.sample_seed)
+            target = int(min(max(n * self.check_sample, SAMPLE_LOWER_LIMIT),
+                             SAMPLE_UPPER_LIMIT))
+            if target < n:
+                idx = rng.choice(n, size=target, replace=False)
+                X, y = X[idx], y[idx]
+                n = target
+
+        mean, var, corr_label, corr, zmin, zmax = (
+            np.asarray(r) if r is not None else None
+            for r in _moments_kernel(jnp.asarray(X), jnp.asarray(y),
+                                     self.feature_label_corr_only))
+
+        names = meta.column_names() if meta.size == d else \
+            [f"{feat_name}_{i}" for i in range(d)]
+        is_hash = [meta.size == d and
+                   (meta.columns[i].descriptor_value or "").startswith("hash_")
+                   for i in range(d)]
+
+        summary = SanityCheckerSummary()
+        summary.names = names
+        summary.correlations_with_label = [float(c) for c in corr_label]
+
+        reasons: Dict[int, List[str]] = {i: [] for i in range(d)}
+        for i in range(d):
+            summary.column_stats.append({
+                "name": names[i], "mean": float(mean[i]),
+                "variance": float(var[i]), "min": float(zmin[i]),
+                "max": float(zmax[i]),
+                "corrWithLabel": float(corr_label[i])})
+            if var[i] < self.min_variance:
+                reasons[i].append(
+                    f"variance {var[i]:.3g} below min {self.min_variance}")
+            c = abs(float(corr_label[i]))
+            if not (self.protect_text_shared_hash and is_hash[i]):
+                if np.isnan(corr_label[i]):
+                    pass  # zero-variance already flagged
+                elif c > self.max_correlation:
+                    reasons[i].append(
+                        f"|corr with label| {c:.3f} above max "
+                        f"{self.max_correlation}")
+                elif c < self.min_correlation:
+                    reasons[i].append(
+                        f"|corr with label| {c:.3f} below min "
+                        f"{self.min_correlation}")
+
+        # categorical stats per indicator group (grouping + indicator cols)
+        if meta.size == d:
+            groups: Dict[Tuple[str, str], List[int]] = {}
+            for i, cm in enumerate(meta.columns):
+                if cm.indicator_value is not None and cm.grouping is not None:
+                    groups.setdefault((cm.parent_feature_name, cm.grouping),
+                                      []).append(i)
+            if groups:
+                classes = np.unique(y)
+                Y1 = (y[:, None] == classes[None, :]).astype(np.float64)
+                for (parent, grouping), idxs in sorted(groups.items()):
+                    cont = np.asarray(_contingency_kernel(
+                        jnp.asarray(Y1), jnp.asarray(X[:, idxs])))
+                    v, support, confidence = _cramers_v(cont)
+                    summary.categorical_stats.append({
+                        "group": f"{parent}_{grouping}",
+                        "cramersV": v,
+                        "support": support.tolist(),
+                        "maxRuleConfidence": confidence.tolist()})
+                    for j, i in enumerate(idxs):
+                        if v > self.max_cramers_v:
+                            reasons[i].append(
+                                f"group Cramér's V {v:.3f} above max "
+                                f"{self.max_cramers_v}")
+                        if (confidence[j] >= self.max_rule_confidence and
+                                support[j] >= self.min_required_rule_support):
+                            reasons[i].append(
+                                f"association rule confidence "
+                                f"{confidence[j]:.3f} with support "
+                                f"{support[j]:.3f}")
+
+                # feature-group removal (reasonsToRemove :812-822): if any
+                # slot of a parent is label-leaky, drop the parent's group
+                if self.remove_feature_group:
+                    leaky_parents = {
+                        meta.columns[i].parent_feature_name
+                        for i in range(d)
+                        if any("corr with label" in r and "above" in r
+                               for r in reasons[i])
+                        or any("association rule" in r for r in reasons[i])}
+                    for i, cm in enumerate(meta.columns):
+                        if (cm.parent_feature_name in leaky_parents
+                                and not reasons[i]
+                                and not cm.is_null_indicator()):
+                            reasons[i].append(
+                                f"feature group {cm.parent_feature_name} "
+                                "flagged for label leakage")
+
+        keep = [i for i in range(d) if not reasons[i]]
+        if not self.remove_bad_features:
+            keep = list(range(d))
+        for i in range(d):
+            if reasons[i]:
+                summary.dropped.append({"name": names[i],
+                                        "reasons": reasons[i],
+                                        "removed": self.remove_bad_features})
+
+        if not keep:  # never output an empty vector
+            keep = list(range(d))
+
+        model = SanityCheckerModel(keep_indices=keep)
+        model.summary_ = summary
+        return model

@@ -1,0 +1,207 @@
+"""SmartTextVectorizer — cardinality-adaptive text vectorization.
+
+Parity: ``core/.../impl/feature/SmartTextVectorizer.scala:60-231``: fit
+computes a per-feature ``TextStats`` value-count semigroup capped at
+``max_cardinality`` (=100, :170-182). Features with cardinality <=
+max_cardinality are pivoted (one-hot top-K + OTHER + null); the rest get the
+hashing trick (+ optional length column) + null indicator.
+
+The fitted model delegates to OneHotModel / HashingVectorizerModel blocks so
+both paths share the host/device split.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..columns import ColumnStore
+from ..stages.base import register_stage
+from ..types.feature_types import Text
+from ..vector_metadata import VectorColumnMetadata, VectorMetadata
+from .hashing import HashingVectorizerModel, hash_tokens
+from .onehot import OneHotModel, _sorted_topk
+from .text import tokenize_simple
+from .vectorizer_base import (TransmogrifierDefaults, VectorizerEstimator,
+                              VectorizerModel, null_indicator_meta)
+
+__all__ = ["SmartTextVectorizer", "SmartTextVectorizerModel"]
+
+
+class TextStats:
+    """Value-count semigroup with cardinality cap (SmartTextVectorizer.scala:170)."""
+
+    def __init__(self, max_cardinality: int):
+        self.max_cardinality = max_cardinality
+        self.counts: Counter = Counter()
+        self.capped = False
+
+    def add(self, value: Optional[str]) -> None:
+        if value is None or self.capped:
+            return
+        self.counts[value] += 1
+        if len(self.counts) > self.max_cardinality:
+            self.capped = True
+
+    @property
+    def cardinality(self) -> int:
+        return len(self.counts)
+
+
+@register_stage
+class SmartTextVectorizerModel(VectorizerModel):
+    """Per-feature routing: categorical → pivot block, text → hash block."""
+
+    operation_name = "smartTextVec"
+    seq_type = Text
+
+    def __init__(self, is_categorical: Sequence[bool] = (),
+                 vocabs: Sequence[Sequence[str]] = (),
+                 num_features: int = TransmogrifierDefaults.HASH_SIZE,
+                 track_nulls: bool = True,
+                 track_text_len: bool = False,
+                 seed: int = 42,
+                 input_names: Sequence[str] = (),
+                 ftype_name: str = "Text",
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.is_categorical = list(is_categorical)
+        self.vocabs = [list(v) for v in vocabs]
+        self.num_features = num_features
+        self.track_nulls = track_nulls
+        self.track_text_len = track_text_len
+        self.seed = seed
+        self.input_names_saved = list(input_names)
+        self.ftype_name = ftype_name
+
+    def _names(self) -> List[str]:
+        if self.input_features:
+            return [f.name for f in self.input_features]
+        return self.input_names_saved
+
+    def host_prepare(self, store: ColumnStore) -> Dict[str, np.ndarray]:
+        names = self._names()
+        n = store.n_rows
+        blocks: Dict[str, np.ndarray] = {}
+        vocab_iter = iter(self.vocabs)
+        for j, name in enumerate(names):
+            col = store[name]
+            if self.is_categorical[j]:
+                vocab = next(vocab_iter)
+                index = {v: i for i, v in enumerate(vocab)}
+                k = len(vocab)
+                width = k + 1 + (1 if self.track_nulls else 0)
+                block = np.zeros((n, width), dtype=np.float64)
+                for r, v in enumerate(col.values):
+                    if v is None:
+                        if self.track_nulls:
+                            block[r, k + 1] = 1.0
+                    elif v in index:
+                        block[r, index[v]] = 1.0
+                    else:
+                        block[r, k] = 1.0
+            else:
+                extra = (1 if self.track_text_len else 0) + \
+                    (1 if self.track_nulls else 0)
+                block = np.zeros((n, self.num_features + extra),
+                                 dtype=np.float64)
+                for r, v in enumerate(col.values):
+                    if v is None:
+                        if self.track_nulls:
+                            block[r, -1] = 1.0
+                        continue
+                    toks = tokenize_simple(v)
+                    if toks:
+                        hashed = hash_tokens(toks, self.seed) % self.num_features
+                        np.add.at(block[r], hashed, 1.0)
+                    if self.track_text_len:
+                        block[r, self.num_features] = float(len(v))
+            blocks[f"block{j}"] = block
+        return blocks
+
+    def device_compute(self, xp, prepared):
+        blocks = [xp.asarray(prepared[f"block{j}"])
+                  for j in range(len(self._names()))]
+        return xp.concatenate(blocks, axis=1)
+
+    def vector_metadata(self) -> VectorMetadata:
+        from ..vector_metadata import OTHER_INDICATOR
+        names = self._names()
+        cols: List[VectorColumnMetadata] = []
+        vocab_iter = iter(self.vocabs)
+        for j, name in enumerate(names):
+            if self.is_categorical[j]:
+                vocab = next(vocab_iter)
+                for v in vocab:
+                    cols.append(VectorColumnMetadata(
+                        parent_feature_name=name,
+                        parent_feature_type=self.ftype_name,
+                        grouping=name, indicator_value=v))
+                cols.append(VectorColumnMetadata(
+                    parent_feature_name=name,
+                    parent_feature_type=self.ftype_name,
+                    grouping=name, indicator_value=OTHER_INDICATOR))
+                if self.track_nulls:
+                    cols.append(null_indicator_meta(name, self.ftype_name, name))
+            else:
+                for i in range(self.num_features):
+                    cols.append(VectorColumnMetadata(
+                        parent_feature_name=name,
+                        parent_feature_type=self.ftype_name,
+                        descriptor_value=f"hash_{i}"))
+                if self.track_text_len:
+                    cols.append(VectorColumnMetadata(
+                        parent_feature_name=name,
+                        parent_feature_type=self.ftype_name,
+                        descriptor_value="TextLen"))
+                if self.track_nulls:
+                    cols.append(null_indicator_meta(name, self.ftype_name))
+        return VectorMetadata(self.meta_name, cols)
+
+    def get_model_state(self):
+        return {"is_categorical": self.is_categorical, "vocabs": self.vocabs,
+                "input_names_saved": self._names()}
+
+
+@register_stage
+class SmartTextVectorizer(VectorizerEstimator):
+    """Estimator: probe cardinality, route each feature (SmartTextVectorizer)."""
+
+    operation_name = "smartTextVec"
+    seq_type = Text
+
+    def __init__(self, max_cardinality: int = 100,
+                 top_k: int = TransmogrifierDefaults.TOP_K,
+                 min_support: int = TransmogrifierDefaults.MIN_SUPPORT,
+                 num_features: int = TransmogrifierDefaults.HASH_SIZE,
+                 track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
+                 track_text_len: bool = False,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.max_cardinality = max_cardinality
+        self.top_k = top_k
+        self.min_support = min_support
+        self.num_features = num_features
+        self.track_nulls = track_nulls
+        self.track_text_len = track_text_len
+
+    def fit_columns(self, store: ColumnStore) -> SmartTextVectorizerModel:
+        is_cat: List[bool] = []
+        vocabs: List[List[str]] = []
+        for name in self.input_names:
+            stats = TextStats(self.max_cardinality)
+            col = store[name]
+            for v in col.values:
+                stats.add(v)
+            if not stats.capped:
+                is_cat.append(True)
+                vocabs.append(_sorted_topk(stats.counts, self.top_k,
+                                           self.min_support))
+            else:
+                is_cat.append(False)
+        return SmartTextVectorizerModel(
+            is_categorical=is_cat, vocabs=vocabs,
+            num_features=self.num_features, track_nulls=self.track_nulls,
+            track_text_len=self.track_text_len,
+            input_names=self.input_names, ftype_name=self.seq_type.__name__)

@@ -1,0 +1,56 @@
+"""Text processing — tokenization and text transformers (host-side).
+
+The reference uses Lucene analyzers + Optimaize language detection
+(``core/.../impl/feature/TextTokenizer.scala``); on TPU all tokenization is
+host work feeding hashed/indexed device arrays, so the implementation is a
+fast table-driven tokenizer with the same interface.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..columns import Column, ColumnStore, TextColumn, TextListColumn
+from ..stages.base import FixedArity, InputSpec, Transformer, register_stage
+from ..types.feature_types import Text, TextList
+
+__all__ = ["tokenize_simple", "TextTokenizer"]
+
+_TOKEN_RE = re.compile(r"[\w']+", re.UNICODE)
+_MIN_TOKEN_LENGTH = 1
+
+
+def tokenize_simple(text: str, to_lowercase: bool = True,
+                    min_token_length: int = _MIN_TOKEN_LENGTH) -> List[str]:
+    """Unicode word tokenizer (Lucene SimpleAnalyzer analog)."""
+    if to_lowercase:
+        text = text.lower()
+    return [t for t in _TOKEN_RE.findall(text) if len(t) >= min_token_length]
+
+
+@register_stage
+class TextTokenizer(Transformer):
+    """Text → TextList of tokens (TextTokenizer.scala)."""
+
+    operation_name = "tokenize"
+    output_type = TextList
+
+    def __init__(self, to_lowercase: bool = True, min_token_length: int = 1,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.to_lowercase = to_lowercase
+        self.min_token_length = min_token_length
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(Text)
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        col = store[self.input_features[0].name]
+        assert isinstance(col, TextColumn)
+        out = [tokenize_simple(v, self.to_lowercase, self.min_token_length)
+               if v is not None else []
+               for v in col.values]
+        return TextListColumn(TextList, out)

@@ -1,0 +1,157 @@
+"""Transmogrifier — automatic feature engineering dispatch.
+
+Parity: ``core/.../impl/feature/Transmogrifier.scala:92-348``: groups raw
+features by type and applies each type's default vectorizer, then combines
+all blocks into one OPVector. ``transmogrify(features)`` is the one-call
+automated feature engineering entry (RichFeaturesCollection.transmogrify).
+
+Type dispatch (mirroring the reference's match):
+
+=================================  =======================================
+Real/RealNN/Percent/Currency       RealVectorizer (mean impute + null)
+Integral                           IntegralVectorizer (mode impute + null)
+Binary                             BinaryVectorizer
+Date/DateTime                      DateToUnitCircleVectorizer
+PickList/ComboBox/Country/State/
+City/PostalCode/Street/ID          OneHotVectorizer (topK + OTHER + null)
+Text/TextArea/Email/URL/Phone/
+Base64                             SmartTextVectorizer (pivot|hash by card.)
+MultiPickList                      SetVectorizer
+Geolocation                        GeolocationVectorizer
+TextList                           HashingVectorizerModel
+OPVector                           passthrough
+maps                               OPMapVectorizer family (ops.maps)
+=================================  =======================================
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Type
+
+from ..features import Feature
+from ..types import feature_types as ft
+from .dates import DateToUnitCircleVectorizer, TimePeriod
+from .geo import GeolocationVectorizer
+from .hashing import HashingVectorizerModel
+from .numeric import BinaryVectorizer, IntegralVectorizer, RealVectorizer
+from .onehot import OneHotVectorizer, SetVectorizer
+from .smart_text import SmartTextVectorizer
+from .vectorizer_base import TransmogrifierDefaults
+from .vectors import VectorsCombiner
+
+__all__ = ["transmogrify", "Transmogrifier"]
+
+# pivot (one-hot) text subtypes: closed-ish vocabularies
+_PIVOT_TEXT = (ft.PickList, ft.ComboBox, ft.Country, ft.State, ft.City,
+               ft.PostalCode, ft.Street, ft.ID)
+# free text → smart vectorization
+_SMART_TEXT = (ft.Text,)
+
+
+def _group_features(features: Sequence[Feature]) -> Dict[str, List[Feature]]:
+    groups: Dict[str, List[Feature]] = {}
+
+    def add(key: str, f: Feature) -> None:
+        groups.setdefault(key, []).append(f)
+
+    for f in features:
+        t = f.ftype
+        if issubclass(t, ft.Binary):
+            add("binary", f)
+        elif issubclass(t, ft.Date):  # Date/DateTime before Integral
+            add("date", f)
+        elif issubclass(t, ft.Integral):
+            add("integral", f)
+        elif issubclass(t, ft.Real):  # Real, RealNN, Percent, Currency
+            add("real", f)
+        elif issubclass(t, ft.MultiPickList):
+            add("set", f)
+        elif issubclass(t, _PIVOT_TEXT):
+            add("pivot_text", f)
+        elif issubclass(t, ft.Text):
+            add("smart_text", f)
+        elif issubclass(t, ft.Geolocation):
+            add("geo", f)
+        elif issubclass(t, ft.TextList):
+            add("text_list", f)
+        elif issubclass(t, ft.OPVector):
+            add("vector", f)
+        elif issubclass(t, (ft.DateList,)):
+            add("date_list", f)
+        elif issubclass(t, ft.OPMap):
+            add("map", f)
+        else:
+            raise TypeError(
+                f"Transmogrifier has no default vectorizer for {t.__name__}")
+    return groups
+
+
+class Transmogrifier:
+    """Type-dispatch table (Transmogrifier.scala:92)."""
+
+    @staticmethod
+    def vectorize(features: Sequence[Feature],
+                  defaults: Type[TransmogrifierDefaults] = TransmogrifierDefaults
+                  ) -> Feature:
+        if not features:
+            raise ValueError("transmogrify needs at least one feature")
+        groups = _group_features(features)
+        blocks: List[Feature] = []
+
+        def wire(stage, feats) -> None:
+            blocks.append(feats[0].transform_with(stage, *feats[1:]))
+
+        if "real" in groups:
+            wire(RealVectorizer(track_nulls=defaults.TRACK_NULLS), groups["real"])
+        if "integral" in groups:
+            wire(IntegralVectorizer(track_nulls=defaults.TRACK_NULLS),
+                 groups["integral"])
+        if "binary" in groups:
+            wire(BinaryVectorizer(track_nulls=defaults.TRACK_NULLS),
+                 groups["binary"])
+        if "date" in groups:
+            wire(DateToUnitCircleVectorizer(
+                periods=defaults.CIRCULAR_DATE_REPRESENTATIONS,
+                track_nulls=defaults.TRACK_NULLS,
+                input_names=[f.name for f in groups["date"]]), groups["date"])
+        if "pivot_text" in groups:
+            wire(OneHotVectorizer(top_k=defaults.TOP_K,
+                                  min_support=defaults.MIN_SUPPORT,
+                                  track_nulls=defaults.TRACK_NULLS),
+                 groups["pivot_text"])
+        if "smart_text" in groups:
+            wire(SmartTextVectorizer(top_k=defaults.TOP_K,
+                                     min_support=defaults.MIN_SUPPORT,
+                                     num_features=defaults.HASH_SIZE,
+                                     track_nulls=defaults.TRACK_NULLS),
+                 groups["smart_text"])
+        if "set" in groups:
+            wire(SetVectorizer(top_k=defaults.TOP_K,
+                               min_support=defaults.MIN_SUPPORT,
+                               track_nulls=defaults.TRACK_NULLS), groups["set"])
+        if "geo" in groups:
+            wire(GeolocationVectorizer(track_nulls=defaults.TRACK_NULLS),
+                 groups["geo"])
+        if "text_list" in groups:
+            wire(HashingVectorizerModel(
+                num_features=defaults.HASH_SIZE,
+                track_nulls=defaults.TRACK_NULLS,
+                input_names=[f.name for f in groups["text_list"]]),
+                groups["text_list"])
+        if "map" in groups:
+            from .maps import vectorize_maps
+            blocks.extend(vectorize_maps(groups["map"], defaults))
+        if "date_list" in groups:
+            from .date_list import DateListVectorizer
+            wire(DateListVectorizer(track_nulls=defaults.TRACK_NULLS),
+                 groups["date_list"])
+        blocks.extend(groups.get("vector", []))
+
+        if len(blocks) == 1:
+            return blocks[0]
+        combiner = VectorsCombiner()
+        return blocks[0].transform_with(combiner, *blocks[1:])
+
+
+def transmogrify(features: Sequence[Feature]) -> Feature:
+    """One-call automated feature engineering: features → single OPVector."""
+    return Transmogrifier.vectorize(features)

@@ -1,0 +1,123 @@
+"""Vectorizer protocol — host/device split for fusable transforms.
+
+Every vectorizer model separates its transform into:
+
+* ``host_prepare(store) -> {name: np.ndarray}`` — string lookups, vocab
+  indexing, hashing: anything that must touch host objects. Produces only
+  dense numeric arrays (+ masks).
+* ``device_compute(xp, prepared) -> xp.ndarray [n, d]`` — pure array math,
+  written against the ``xp`` namespace so the same code runs as numpy on
+  host or inside a jitted XLA computation (``xp = jax.numpy``).
+
+This is the TPU answer to ``FitStagesUtil.applyOpTransformations``'s row
+fusion (``core/.../utils/stages/FitStagesUtil.scala:96-119``): the workflow
+can jit ONE function per DAG layer that runs every vectorizer's
+``device_compute`` and concatenates the results — a single fused XLA
+computation per layer instead of a per-row RDD map.
+
+All vectorizers are sequence stages (N same-typed inputs → one OPVector),
+mirroring the reference's ``SequenceEstimator`` vectorizers.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from ..columns import Column, ColumnStore, VectorColumn
+from ..stages.base import (Estimator, FittedModel, InputSpec, Transformer,
+                           VarArity)
+from ..types.feature_types import FeatureType, OPVector
+from ..vector_metadata import VectorColumnMetadata, VectorMetadata
+
+__all__ = ["VectorizerModel", "VectorizerEstimator", "TransmogrifierDefaults"]
+
+
+class TransmogrifierDefaults:
+    """Default knobs (core/.../impl/feature/Transmogrifier.scala:52-88)."""
+
+    TOP_K = 20
+    MIN_SUPPORT = 10
+    FILL_VALUE = 0.0
+    BINARY_FILL_VALUE = 0.0
+    HASH_SIZE = 512  # DefaultNumOfFeatures
+    MAX_NUM_FEATURES = 16384
+    FILL_WITH_MEAN = True
+    FILL_WITH_MODE = True
+    TRACK_NULLS = True
+    TRACK_INVALID = False
+    MIN_DOC_FREQUENCY = 0
+    OTHER_STRING = "OTHER"
+    NULL_STRING = "NullIndicatorValue"
+    CIRCULAR_DATE_REPRESENTATIONS = ["HourOfDay", "DayOfWeek", "DayOfMonth",
+                                     "DayOfYear"]
+
+
+class VectorizerModel(FittedModel):
+    """Fitted vectorizer: N typed inputs → OPVector via host/device split."""
+
+    output_type = OPVector
+    seq_type: Type[FeatureType] = FeatureType
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return VarArity(self.seq_type)
+
+    # -- protocol ----------------------------------------------------------
+    def host_prepare(self, store: ColumnStore) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def device_compute(self, xp, prepared: Dict[str, Any]):
+        raise NotImplementedError
+
+    def vector_metadata(self) -> VectorMetadata:
+        raise NotImplementedError
+
+    # -- Transformer impl --------------------------------------------------
+    def transform_columns(self, store: ColumnStore) -> Column:
+        prepared = self.host_prepare(store)
+        mat = self.device_compute(np, prepared)
+        mat = np.asarray(mat, dtype=np.float64)
+        meta = self.vector_metadata()
+        assert mat.ndim == 2 and mat.shape[1] == meta.size, \
+            (type(self).__name__, mat.shape, meta.size)
+        return VectorColumn(OPVector, mat, meta)
+
+    @property
+    def width(self) -> int:
+        return self.vector_metadata().size
+
+    @property
+    def meta_name(self) -> str:
+        """Vector metadata name; falls back to the operation when the model
+        is used as an unwired delegate (map vectorizers)."""
+        try:
+            return self.output_name
+        except ValueError:
+            return self.operation_name
+
+    def get_model_state(self) -> Dict[str, Any]:
+        return {}
+
+
+class VectorizerEstimator(Estimator):
+    """Base sequence estimator for vectorizers."""
+
+    output_type = OPVector
+    seq_type: Type[FeatureType] = FeatureType
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return VarArity(self.seq_type)
+
+    @property
+    def input_names(self) -> List[str]:
+        return [f.name for f in self.input_features]
+
+
+def null_indicator_meta(feature_name: str, ftype_name: str,
+                        grouping: Optional[str] = None) -> VectorColumnMetadata:
+    from ..vector_metadata import NULL_INDICATOR
+    return VectorColumnMetadata(
+        parent_feature_name=feature_name, parent_feature_type=ftype_name,
+        grouping=grouping, indicator_value=NULL_INDICATOR)

@@ -1,0 +1,96 @@
+"""Vector combination + scaling ops.
+
+Parity: ``VectorsCombiner`` (``core/.../impl/feature/VectorsCombiner.scala``),
+``OpScalarStandardScaler`` (``OpScalarStandardScaler.scala``).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..columns import Column, ColumnStore, VectorColumn
+from ..stages.base import (Estimator, FittedModel, InputSpec, Transformer,
+                           VarArity, FixedArity, register_stage)
+from ..types.feature_types import OPVector, Real, RealNN
+from ..vector_metadata import VectorMetadata
+from .vectorizer_base import VectorizerModel
+
+__all__ = ["VectorsCombiner", "StandardScalerEstimator", "StandardScalerModel"]
+
+
+@register_stage
+class VectorsCombiner(Transformer):
+    """Concatenate N OPVector features into one, merging metadata."""
+
+    operation_name = "combineVec"
+    output_type = OPVector
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return VarArity(OPVector)
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        cols = [store[f.name] for f in self.input_features]
+        mats, metas = [], []
+        for f, c in zip(self.input_features, cols):
+            assert isinstance(c, VectorColumn), f"{f.name} is not a vector"
+            mats.append(c.values)
+            if c.metadata is not None:
+                metas.append(c.metadata)
+            else:
+                metas.append(VectorMetadata(f.name, []))
+        mat = np.concatenate(mats, axis=1) if mats else np.zeros((store.n_rows, 0))
+        meta = VectorMetadata.flatten(self.output_name, metas)
+        if meta.size != mat.shape[1]:
+            meta = None  # provenance lost for some inputs; keep data correct
+        return VectorColumn(OPVector, mat, meta)
+
+
+@register_stage
+class StandardScalerModel(FittedModel):
+    """(x - mean) / std per vector slot (OpScalarStandardScaler analog)."""
+
+    operation_name = "zNormalize"
+    output_type = OPVector
+
+    def __init__(self, mean=None, std=None, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.mean = np.asarray(mean) if mean is not None else None
+        self.std = np.asarray(std) if std is not None else None
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(OPVector)
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        col = store[self.input_features[0].name]
+        assert isinstance(col, VectorColumn)
+        vals = (col.values - self.mean[None, :]) / self.std[None, :]
+        return VectorColumn(OPVector, vals, col.metadata)
+
+    def get_model_state(self):
+        return {"mean": self.mean, "std": self.std}
+
+
+@register_stage
+class StandardScalerEstimator(Estimator):
+    operation_name = "zNormalize"
+    output_type = OPVector
+
+    def __init__(self, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(OPVector)
+
+    def fit_columns(self, store: ColumnStore) -> StandardScalerModel:
+        col = store[self.input_features[0].name]
+        mean = col.values.mean(axis=0)
+        std = col.values.std(axis=0)
+        std = np.where(std < 1e-12, 1.0, std)
+        return StandardScalerModel(mean=mean, std=std)

@@ -1,0 +1,1 @@
+from .mesh import make_mesh, shard_cv_inputs, data_sharding  # noqa: F401

@@ -1,0 +1,95 @@
+"""Device mesh + sharding helpers — the framework's distributed substrate.
+
+The reference's distribution substrate is Spark's executor fleet (netty
+shuffle, driver-coordinated jobs). The TPU-native substrate is a
+``jax.sharding.Mesh`` with named axes and GSPMD: inputs carry
+``NamedSharding`` annotations, ``jit`` partitions the computation, and XLA
+inserts the collectives (psum for fit reductions) over ICI — no explicit
+communication layer to maintain (SURVEY §2.10).
+
+Axes:
+* ``data``  — rows (batch). Fit reductions (gram matrices, gradient sums)
+  become per-shard partials + psum, riding ICI.
+* ``grid``  — (fold × hyperparameter) batch of the CV sweep. Embarrassingly
+  parallel; sharding it multiplies model-selection throughput.
+
+``make_mesh`` splits available devices between the two axes; for CV the grid
+axis gets as many devices as it can fill, the data axis the rest.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_mesh", "data_sharding", "shard_cv_inputs"]
+
+
+def make_mesh(n_devices: Optional[int] = None, grid_size: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """2-D ``(data, grid)`` mesh over the available devices.
+
+    ``grid_size`` is the total (fold × hyperparam) batch the caller wants to
+    parallelize; the grid axis is sized to the largest power-of-two divisor
+    of the device count that does not exceed it.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    grid_axis = 1
+    while (n % (grid_axis * 2) == 0 and grid_axis * 2 <= max(grid_size, 1)
+           and grid_axis * 2 <= n):
+        grid_axis *= 2
+    data_axis = n // grid_axis
+    mesh_devs = np.asarray(devs).reshape(data_axis, grid_axis)
+    return Mesh(mesh_devs, axis_names=("data", "grid"))
+
+
+def data_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def pad_rows(X, y, w_folds, multiple: int):
+    """Pad the row dimension to a multiple with ZERO-WEIGHT rows.
+
+    Every fit reduction is sample-weighted, so w=0 padding rows are inert —
+    this is how ragged row counts meet GSPMD's even-sharding requirement
+    without changing any result. Returns (X, y, w_folds, n_original).
+    """
+    n = X.shape[0]
+    pad = (-n) % multiple
+    if pad == 0:
+        return X, y, w_folds, n
+    X = np.concatenate([X, np.zeros((pad, X.shape[1]), dtype=X.dtype)])
+    y = np.concatenate([y, np.zeros((pad,), dtype=y.dtype)])
+    w_folds = np.concatenate(
+        [w_folds, np.zeros((w_folds.shape[0], pad), dtype=w_folds.dtype)],
+        axis=1)
+    return X, y, w_folds, n
+
+
+def shard_cv_inputs(mesh: Mesh, X, y, w_folds):
+    """Place CV inputs: rows over ``data``, fold/grid batches over ``grid``.
+
+    X: [n, d] → P('data', None); y: [n] → P('data');
+    w_folds: [K, n] → P('grid', 'data') so each grid-axis shard owns a
+    subset of folds and each data-axis shard a subset of rows.
+    Rows are zero-weight padded to the data-axis size; the returned
+    ``n_orig`` tells callers where to slice device outputs.
+    """
+    import jax.numpy as jnp
+    X = np.asarray(X)
+    y = np.asarray(y)
+    w_folds = np.asarray(w_folds)
+    X, y, w_folds, n_orig = pad_rows(X, y, w_folds, mesh.shape["data"])
+    Xs = jax.device_put(jnp.asarray(X), NamedSharding(mesh, P("data", None)))
+    ys = jax.device_put(jnp.asarray(y), NamedSharding(mesh, P("data")))
+    k = w_folds.shape[0]
+    grid_n = mesh.shape["grid"]
+    spec = P("grid", "data") if k % grid_n == 0 else P(None, "data")
+    ws = jax.device_put(jnp.asarray(w_folds), NamedSharding(mesh, spec))
+    return Xs, ys, ws, n_orig

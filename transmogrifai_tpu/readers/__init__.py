@@ -1,0 +1,3 @@
+from .data_readers import (DataReader, CSVReader, CSVAutoReader,  # noqa: F401
+                           AggregateReader, ConditionalReader, DataReaders,
+                           JoinedDataReader, CutOffTime)

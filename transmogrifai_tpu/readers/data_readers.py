@@ -1,0 +1,275 @@
+"""Data readers — host-side ingestion into record dicts / ColumnStores.
+
+Parity: ``readers/`` module (``DataReader.scala:57-230``,
+``DataReaders.scala:43-278``, ``JoinedDataReader.scala:54-418``). Spark's
+distributed read is replaced by host ingestion (readers run on CPU; only
+dense arrays reach the device), keeping the same API shape:
+
+* ``DataReader.read_records()`` → list of record dicts
+* ``AggregateReader`` — group records by key, fold each feature's values
+  through its monoid aggregator with event-time cutoff filtering
+  (``FeatureAggregator.extract``: responses AFTER cutoff, predictors
+  BEFORE — leak prevention, ``FeatureAggregator.scala:99-119``)
+* ``ConditionalReader`` — per-key cutoff fixed by an event predicate
+  (``ConditionalParams``)
+* ``JoinedDataReader`` — typed left-outer/inner joins on keys
+* ``DataReaders.simple/aggregate/conditional`` factories
+"""
+from __future__ import annotations
+
+import csv as _csv
+from dataclasses import dataclass
+from typing import (Any, Callable, Dict, Iterable, List, Mapping, Optional,
+                    Sequence, Tuple)
+
+from ..columns import ColumnStore, column_from_values
+from ..features import Feature
+from ..stages.generator import FeatureGeneratorStage
+
+__all__ = ["DataReader", "CSVReader", "CSVAutoReader", "AggregateReader",
+           "ConditionalReader", "JoinedDataReader", "DataReaders",
+           "CutOffTime"]
+
+
+@dataclass
+class CutOffTime:
+    """Event-time cutoff for aggregation (readers ``CutOffTime``)."""
+
+    timestamp_ms: Optional[int] = None
+
+    @staticmethod
+    def no_cutoff() -> "CutOffTime":
+        return CutOffTime(None)
+
+
+class DataReader:
+    """Base reader: produces record dicts; generates raw feature columns."""
+
+    def __init__(self, key_fn: Optional[Callable[[Dict], str]] = None):
+        self.key_fn = key_fn or (lambda r: str(r.get("id", "")))
+
+    def read_records(self) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def generate_store(self, raw_features: Sequence[Feature]) -> ColumnStore:
+        """Run every raw feature's extract_fn per record
+        (DataReader.generateDataFrame, DataReader.scala:173-197)."""
+        records = self.read_records()
+        cols = {}
+        for f in raw_features:
+            gen = f.origin_stage
+            assert isinstance(gen, FeatureGeneratorStage)
+            cols[f.name] = gen.extract_column(records)
+        return ColumnStore(cols, len(records))
+
+
+class _InMemoryReader(DataReader):
+    def __init__(self, records: Sequence[Mapping[str, Any]],
+                 key_fn: Optional[Callable[[Dict], str]] = None):
+        super().__init__(key_fn)
+        self._records = [dict(r) for r in records]
+
+    def read_records(self) -> List[Dict[str, Any]]:
+        return self._records
+
+
+class CSVReader(DataReader):
+    """CSV with an explicit schema: column names in order
+    (the avro-schema ``CSVReader`` analog)."""
+
+    def __init__(self, path: str, schema: Sequence[str],
+                 key_fn: Optional[Callable[[Dict], str]] = None,
+                 delimiter: str = ","):
+        super().__init__(key_fn)
+        self.path = path
+        self.schema = list(schema)
+        self.delimiter = delimiter
+
+    def read_records(self) -> List[Dict[str, Any]]:
+        out = []
+        with open(self.path, newline="") as fh:
+            for row in _csv.reader(fh, delimiter=self.delimiter):
+                rec = {name: (v if v != "" else None)
+                       for name, v in zip(self.schema, row)}
+                out.append(rec)
+        return out
+
+
+class CSVAutoReader(CSVReader):
+    """Header-inferring CSV reader (CSVAutoReaders.scala:142)."""
+
+    def __init__(self, path: str,
+                 key_fn: Optional[Callable[[Dict], str]] = None,
+                 delimiter: str = ","):
+        with open(path, newline="") as fh:
+            header = next(_csv.reader(fh, delimiter=delimiter))
+        super().__init__(path, header, key_fn, delimiter)
+        self._skip_header = True
+
+    def read_records(self) -> List[Dict[str, Any]]:
+        return super().read_records()[1:]
+
+
+class AggregateReader(DataReader):
+    """Group-by-key + monoid aggregation with cutoff-time leak prevention
+    (AggregatedReader, DataReader.scala:206-230)."""
+
+    def __init__(self, base: DataReader,
+                 timestamp_fn: Callable[[Dict], int],
+                 cutoff: CutOffTime = CutOffTime.no_cutoff(),
+                 key_fn: Optional[Callable[[Dict], str]] = None):
+        super().__init__(key_fn or base.key_fn)
+        self.base = base
+        self.timestamp_fn = timestamp_fn
+        self.cutoff = cutoff
+
+    def read_records(self) -> List[Dict[str, Any]]:
+        return self.base.read_records()
+
+    def _cutoff_for_key(self, records: List[Dict[str, Any]]) -> Optional[int]:
+        return self.cutoff.timestamp_ms
+
+    def generate_store(self, raw_features: Sequence[Feature]) -> ColumnStore:
+        from collections import defaultdict
+        groups: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
+        for rec in self.read_records():
+            groups[self.key_fn(rec)].append(rec)
+        keys = sorted(groups)
+        cols: Dict[str, Any] = {}
+        for f in raw_features:
+            gen = f.origin_stage
+            assert isinstance(gen, FeatureGeneratorStage)
+            agg = gen.aggregator
+            values = []
+            for k in keys:
+                recs = groups[k]
+                cutoff = self._cutoff_for_key(recs)
+                window = gen.window_ms
+                vals = []
+                for r in recs:
+                    ts = self.timestamp_fn(r)
+                    if cutoff is not None:
+                        if f.is_response:
+                            # responses strictly AFTER cutoff
+                            if ts < cutoff:
+                                continue
+                        else:
+                            # predictors BEFORE cutoff, within window
+                            if ts >= cutoff:
+                                continue
+                            if window is not None and ts < cutoff - window:
+                                continue
+                    v = gen.extract_fn(r)
+                    if v is not None:
+                        vals.append(v)
+                if agg is None:
+                    values.append(vals[-1] if vals else None)
+                else:
+                    values.append(agg.fold(vals))
+            cols[f.name] = column_from_values(f.ftype, values)
+        return ColumnStore(cols, len(keys))
+
+
+class ConditionalReader(AggregateReader):
+    """Cutoff per key = timestamp of first record matching the predicate
+    (conditional readers, DataReaders.scala:196-278)."""
+
+    def __init__(self, base: DataReader,
+                 timestamp_fn: Callable[[Dict], int],
+                 condition_fn: Callable[[Dict], bool],
+                 drop_if_no_condition: bool = True,
+                 key_fn: Optional[Callable[[Dict], str]] = None):
+        super().__init__(base, timestamp_fn, CutOffTime.no_cutoff(), key_fn)
+        self.condition_fn = condition_fn
+        self.drop_if_no_condition = drop_if_no_condition
+
+    def _cutoff_for_key(self, records: List[Dict[str, Any]]) -> Optional[int]:
+        times = [self.timestamp_fn(r) for r in records if self.condition_fn(r)]
+        return min(times) if times else None
+
+    def generate_store(self, raw_features: Sequence[Feature]) -> ColumnStore:
+        if self.drop_if_no_condition:
+            from collections import defaultdict
+            groups: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
+            for rec in self.read_records():
+                groups[self.key_fn(rec)].append(rec)
+            keep = {k for k, recs in groups.items()
+                    if any(self.condition_fn(r) for r in recs)}
+            filtered = [r for k, recs in groups.items() if k in keep
+                        for r in recs]
+            inner = _InMemoryReader(filtered, self.key_fn)
+            tmp = ConditionalReader(inner, self.timestamp_fn,
+                                    self.condition_fn,
+                                    drop_if_no_condition=False,
+                                    key_fn=self.key_fn)
+            return tmp.generate_store(raw_features)
+        return super().generate_store(raw_features)
+
+
+class JoinedDataReader(DataReader):
+    """Left-outer/inner join of two readers on their keys
+    (JoinedDataReader.scala:54-418)."""
+
+    def __init__(self, left: DataReader, right: DataReader,
+                 join_type: str = "left_outer"):
+        super().__init__(left.key_fn)
+        self.left = left
+        self.right = right
+        self.join_type = join_type
+
+    def read_records(self) -> List[Dict[str, Any]]:
+        right_by_key: Dict[str, Dict[str, Any]] = {}
+        for r in self.right.read_records():
+            right_by_key.setdefault(self.right.key_fn(r), {}).update(r)
+        out = []
+        for l in self.left.read_records():
+            k = self.left.key_fn(l)
+            r = right_by_key.get(k)
+            if r is None:
+                if self.join_type == "inner":
+                    continue
+                out.append(dict(l))
+            else:
+                merged = dict(r)
+                merged.update(l)
+                out.append(merged)
+        return out
+
+
+class DataReaders:
+    """Factory (DataReaders.scala:43)."""
+
+    class simple:
+        @staticmethod
+        def csv(path: str, schema: Sequence[str], key_fn=None) -> CSVReader:
+            return CSVReader(path, schema, key_fn)
+
+        @staticmethod
+        def csv_auto(path: str, key_fn=None) -> CSVAutoReader:
+            return CSVAutoReader(path, key_fn)
+
+        @staticmethod
+        def records(records: Sequence[Mapping[str, Any]], key_fn=None
+                    ) -> DataReader:
+            return _InMemoryReader(records, key_fn)
+
+    class aggregate:
+        @staticmethod
+        def records(records, timestamp_fn, cutoff=CutOffTime.no_cutoff(),
+                    key_fn=None) -> AggregateReader:
+            return AggregateReader(_InMemoryReader(records, key_fn),
+                                   timestamp_fn, cutoff, key_fn)
+
+        @staticmethod
+        def csv(path, schema, timestamp_fn, cutoff=CutOffTime.no_cutoff(),
+                key_fn=None) -> AggregateReader:
+            return AggregateReader(CSVReader(path, schema, key_fn),
+                                   timestamp_fn, cutoff, key_fn)
+
+    class conditional:
+        @staticmethod
+        def records(records, timestamp_fn, condition_fn, key_fn=None,
+                    drop_if_no_condition: bool = True) -> ConditionalReader:
+            return ConditionalReader(_InMemoryReader(records, key_fn),
+                                     timestamp_fn, condition_fn,
+                                     drop_if_no_condition, key_fn)

@@ -97,9 +97,12 @@ class VarArity(InputSpec):
                     f"{features[i].ftype.__name__}, expected {t.__name__}")
         for f in features[n_head:]:
             if not issubclass(f.ftype, self.seq_type):
+                expected = (self.seq_type.__name__
+                            if isinstance(self.seq_type, type) else
+                            "|".join(t.__name__ for t in self.seq_type))
                 raise TypeError(
                     f"Sequence input {f.name!r} has type {f.ftype.__name__}, "
-                    f"expected {self.seq_type.__name__}")
+                    f"expected {expected}")
 
 
 class AllowLabelAsInput:

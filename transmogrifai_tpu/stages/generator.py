@@ -71,7 +71,9 @@ class FeatureGeneratorStage(Transformer):
 
     def get_params(self):
         p = super().get_params()
+        # extract fns/aggregators are code, not data — like the reference,
+        # only their source hint survives serialization
         p.pop("extract_fn", None)
         p.pop("aggregator", None)
-        p["ftype"] = self.ftype.__name__
+        p["ftype"] = self.ftype  # class; model_io encodes as {"__ftype__"}
         return p

@@ -1,0 +1,308 @@
+"""Workflow runtime — DAG fitting and scoring.
+
+Re-designs ``OpWorkflow`` / ``OpWorkflowModel`` / ``FitStagesUtil``
+(``core/.../OpWorkflow.scala:332-357``, ``core/.../OpWorkflowModel.scala``,
+``core/.../utils/stages/FitStagesUtil.scala:173-293``) without Spark:
+
+* ``Workflow.set_result_features(...)`` reconstructs the stage DAG from the
+  requested outputs and validates it (distinct uids, max distances).
+* ``train()`` folds over DAG layers deepest-first: fit each layer's
+  estimators on the train split, evaluate ``has_test_eval`` models on the
+  holdout, then transform train+test with the fitted layer
+  (``FitStagesUtil.fitAndTransformLayer`` :254-293). Where the reference
+  fuses a layer's row transformers into one RDD map (:96-119), here each
+  stage's columnar transform is already one vectorized pass and any device
+  work inside it is jit-compiled; layers share a single ColumnStore so XLA
+  sees batched dense ops, not per-row UDFs.
+* ``WorkflowModel`` holds fitted stages keyed by estimator uid and scores by
+  replaying transform layers; ``save``/``load`` round-trip the whole model
+  as ``model.json`` + ``weights.npz`` (the ``op-model.json`` analog,
+  ``OpWorkflowModelWriter.scala:75-117``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .columns import Column, ColumnStore
+from .features import Feature
+from .graph import StagesDAG, compute_dag
+from .stages.base import Estimator, FittedModel, OpPipelineStage, Transformer
+from .stages.generator import FeatureGeneratorStage
+from .utils import uid as uid_mod
+
+__all__ = ["Workflow", "WorkflowModel", "WorkflowError"]
+
+
+class WorkflowError(Exception):
+    pass
+
+
+def _raw_features_of(result_features: Sequence[Feature]) -> List[Feature]:
+    seen: Dict[str, Feature] = {}
+    for f in result_features:
+        for raw in f.raw_features():
+            seen.setdefault(raw.uid, raw)
+    return sorted(seen.values(), key=lambda f: f.name)
+
+
+def _generate_raw_store(data, raw_features: Sequence[Feature]) -> ColumnStore:
+    """Materialize raw feature columns from input data.
+
+    ``data`` is either a ColumnStore keyed by raw feature names, or a
+    sequence of record dicts run through each feature's extract_fn
+    (``DataReader.generateDataFrame``, readers/.../DataReader.scala:173-197).
+    """
+    if isinstance(data, ColumnStore):
+        missing = [f.name for f in raw_features if f.name not in data]
+        if missing:
+            raise WorkflowError(f"Input store is missing raw features {missing}")
+        return data.select([f.name for f in raw_features])
+    records = list(data)
+    cols = {}
+    for f in raw_features:
+        gen = f.origin_stage
+        if not isinstance(gen, FeatureGeneratorStage):
+            raise WorkflowError(f"Raw feature {f.name!r} has no generator stage")
+        cols[f.name] = gen.extract_column(records)
+    return ColumnStore(cols, len(records))
+
+
+class Workflow:
+    """Untrained pipeline: raw data + result features → fitted model."""
+
+    def __init__(self):
+        self.uid = uid_mod.make_uid("Workflow")
+        self.result_features: Tuple[Feature, ...] = ()
+        self._input_data = None
+        self._reader = None
+        self.splitter = None          # tuning.Splitter for holdout reservation
+        self.raw_feature_filter = None
+        self.parameters: Dict[str, Any] = {}
+        self.blacklisted_features: List[Feature] = []
+
+    # -- config ------------------------------------------------------------
+    def set_result_features(self, *features: Feature) -> "Workflow":
+        if not features:
+            raise WorkflowError("Must provide at least one result feature")
+        self.result_features = tuple(features)
+        self._validate_dag()
+        return self
+
+    def set_input_store(self, store: ColumnStore) -> "Workflow":
+        self._input_data = store
+        return self
+
+    def set_input_records(self, records: Sequence[Mapping[str, Any]]) -> "Workflow":
+        self._input_data = list(records)
+        return self
+
+    def set_reader(self, reader) -> "Workflow":
+        self._reader = reader
+        return self
+
+    def set_splitter(self, splitter) -> "Workflow":
+        self.splitter = splitter
+        return self
+
+    def with_raw_feature_filter(self, rff) -> "Workflow":
+        """Attach a RawFeatureFilter data-quality gate
+        (OpWorkflow.withRawFeatureFilter, OpWorkflow.scala:521-563)."""
+        self.raw_feature_filter = rff
+        return self
+
+    def set_parameters(self, params: Dict[str, Any]) -> "Workflow":
+        self.parameters = dict(params)
+        return self
+
+    # -- validation (OpWorkflow.scala:265-323) -----------------------------
+    def _validate_dag(self) -> None:
+        stages = [s for layer in compute_dag(self.result_features, True)
+                  for s in layer]
+        uids = [s.uid for s in stages]
+        if len(uids) != len(set(uids)):
+            dupes = sorted({u for u in uids if uids.count(u) > 1})
+            raise WorkflowError(f"Duplicate stage uids in DAG: {dupes}")
+
+    # -- training ----------------------------------------------------------
+    def train(self) -> "WorkflowModel":
+        raw_features = _raw_features_of(self.result_features)
+        data = self._input_data
+        if data is None and self._reader is not None:
+            data = self._reader.read_records()
+        if data is None:
+            raise WorkflowError("No input data: call set_input_store/records/reader")
+        store = _generate_raw_store(data, raw_features)
+
+        rff_results = None
+        if self.raw_feature_filter is not None:
+            filtered = self.raw_feature_filter.filter_raw(
+                store, raw_features)
+            store = filtered.clean_store
+            self.blacklisted_features = filtered.blacklisted_features
+            rff_results = filtered.results
+            keep = {f.uid for f in raw_features} - {
+                f.uid for f in self.blacklisted_features}
+            raw_features = [f for f in raw_features if f.uid in keep]
+
+        train_store, test_store = store, None
+        if self.splitter is not None:
+            train_store, test_store = self.splitter.reserve_split(store)
+
+        dag = compute_dag(self.result_features)
+        fitted, train_time = self._fit_dag(dag, train_store, test_store)
+        return WorkflowModel(
+            result_features=self.result_features,
+            fitted_stages=fitted,
+            dag=dag,
+            parameters=self.parameters,
+            blacklisted_features=self.blacklisted_features,
+            rff_results=rff_results,
+            train_time_s=train_time,
+        )
+
+    def _fit_dag(self, dag: StagesDAG, train: ColumnStore,
+                 test: Optional[ColumnStore]
+                 ) -> Tuple[Dict[str, FittedModel], float]:
+        """Fold layers: fit estimators, holdout-eval, transform both splits
+        (FitStagesUtil.fitAndTransformDAG/Layer)."""
+        t0 = time.time()
+        fitted: Dict[str, FittedModel] = {}
+        for layer in dag:
+            models: List[Transformer] = []
+            for stage in layer:
+                if isinstance(stage, Estimator):
+                    model = stage.fit(train)
+                    fitted[stage.uid] = model
+                    if model.has_test_eval() and test is not None:
+                        model.evaluate_model(test)
+                    models.append(model)
+                elif isinstance(stage, Transformer):
+                    models.append(stage)
+                else:
+                    raise WorkflowError(f"Unfittable stage {stage!r}")
+            # transform both splits with the fully fitted layer
+            for m in models:
+                train = m.transform(train)
+                if test is not None:
+                    test = m.transform(test)
+        return fitted, time.time() - t0
+
+
+class WorkflowModel:
+    """Fitted pipeline (OpWorkflowModel): score / evaluate / save."""
+
+    def __init__(self, result_features: Sequence[Feature],
+                 fitted_stages: Dict[str, FittedModel],
+                 dag: Optional[StagesDAG] = None,
+                 parameters: Optional[Dict[str, Any]] = None,
+                 blacklisted_features: Sequence[Feature] = (),
+                 rff_results=None,
+                 train_time_s: float = 0.0):
+        self.uid = uid_mod.make_uid("WorkflowModel")
+        self.result_features = tuple(result_features)
+        self.fitted_stages = dict(fitted_stages)
+        self.dag = dag if dag is not None else compute_dag(result_features)
+        self.parameters = parameters or {}
+        self.blacklisted_features = list(blacklisted_features)
+        self.rff_results = rff_results
+        self.train_time_s = train_time_s
+
+    # -- stage access (OpWorkflowModel.getOriginStageOf analog) ------------
+    def _resolved_dag(self) -> List[List[Transformer]]:
+        out: List[List[Transformer]] = []
+        for layer in self.dag:
+            row: List[Transformer] = []
+            for stage in layer:
+                model = self.fitted_stages.get(stage.uid)
+                if model is not None:
+                    row.append(model)
+                elif isinstance(stage, Transformer):
+                    row.append(stage)
+                else:
+                    raise WorkflowError(
+                        f"Estimator {stage.uid} has no fitted model")
+            out.append(row)
+        return out
+
+    def stage_of(self, feature: Feature) -> Transformer:
+        st = feature.origin_stage
+        if st is None:
+            raise WorkflowError(f"{feature.name!r} is a raw feature")
+        return self.fitted_stages.get(st.uid, st)
+
+    # -- scoring -----------------------------------------------------------
+    def transform(self, data, up_to: Optional[Feature] = None) -> ColumnStore:
+        """Apply the fitted DAG (optionally only ancestors of ``up_to`` —
+        computeDataUpTo, OpWorkflowModel.scala:106)."""
+        targets = (up_to,) if up_to is not None else self.result_features
+        raw_features = _raw_features_of(targets)
+        store = _generate_raw_store(data, raw_features)
+        needed = (None if up_to is None else
+                  {s.uid for s in up_to.parent_stages()})
+        for layer in self._resolved_dag():
+            for m in layer:
+                if needed is None or m.uid in needed:
+                    store = m.transform(store)
+        return store
+
+    def score(self, data, keep_intermediate: bool = False) -> ColumnStore:
+        """Score: returns result feature columns (+ key columns)
+        (OpWorkflowModel.score, :254-268)."""
+        store = self.transform(data)
+        if keep_intermediate:
+            return store
+        return store.select([f.name for f in self.result_features
+                             if f.name in store])
+
+    def score_and_evaluate(self, data, evaluator) -> Tuple[ColumnStore, Dict[str, Any]]:
+        store = self.transform(data)
+        metrics = evaluator.evaluate_all(store)
+        return store.select(
+            [f.name for f in self.result_features if f.name in store]), metrics
+
+    def evaluate(self, data, evaluator) -> Dict[str, Any]:
+        return self.score_and_evaluate(data, evaluator)[1]
+
+    def score_fn(self) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+        """Row-level scoring closure for serving (scoreFn / local module):
+        Map[name, raw value] → Map[result name, raw value]. No bulk data."""
+        layers = self._resolved_dag()
+        result_names = [f.name for f in self.result_features]
+
+        def score_row(row: Dict[str, Any]) -> Dict[str, Any]:
+            acc = dict(row)
+            for layer in layers:
+                for m in layer:
+                    acc[m.output_name] = m.transform_row(acc)
+            return {n: acc[n] for n in result_names if n in acc}
+
+        return score_row
+
+    # -- persistence (OpWorkflowModelWriter/Reader) ------------------------
+    def save(self, path: str, overwrite: bool = False) -> None:
+        from .model_io import save_workflow_model
+        save_workflow_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str) -> "WorkflowModel":
+        from .model_io import load_workflow_model
+        return load_workflow_model(path)
+
+    # -- summaries ---------------------------------------------------------
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"uid": self.uid,
+                               "trainTimeSeconds": self.train_time_s,
+                               "stages": {}}
+        for uid, model in self.fitted_stages.items():
+            s = getattr(model, "summary", None)
+            if s is not None:
+                out["stages"][uid] = s() if callable(s) else s
+        return out
+
+    def summary_pretty(self) -> str:
+        return json.dumps(self.summary(), indent=2, default=str)
