@@ -1,0 +1,126 @@
+"""LinearSVC + MLP stages and families (OpLinearSVC.scala,
+OpMultilayerPerceptronClassifier.scala parity)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.columns import (ColumnStore, VectorColumn,
+                                       column_from_values)
+from transmogrifai_tpu.features import FeatureBuilder
+from transmogrifai_tpu.models.svm import (LinearSVCFamily, LinearSVCModel,
+                                          MLPFamily, MLPModel, OpLinearSVC,
+                                          OpMultilayerPerceptronClassifier)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+@pytest.fixture(scope="module")
+def linear_xy():
+    rng = np.random.default_rng(5)
+    n, d = 300, 4
+    X = rng.normal(size=(n, d))
+    y = (X[:, 0] - 0.5 * X[:, 1] + 0.3 > 0).astype(float)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def xor_xy():
+    rng = np.random.default_rng(6)
+    n = 400
+    X = rng.normal(size=(n, 2))
+    y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(float)
+    return X, y
+
+
+def _store(X, y):
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "features": VectorColumn(ft.OPVector, X)})
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+    return store, label, feats
+
+
+def test_linear_svc_stage(linear_xy):
+    X, y = linear_xy
+    store, label, feats = _store(X, y)
+    model = OpLinearSVC(reg_param=0.01).set_input(label, feats).fit(store)
+    pred, raw, prob = model.predict_arrays(X)
+    assert float((pred == y).mean()) > 0.93
+    np.testing.assert_allclose(raw[:, 0], -raw[:, 1], atol=1e-9)
+
+    state = model.get_model_state()
+    m2 = LinearSVCModel()
+    for k, v in state.items():
+        setattr(m2, k, v)
+    pred2, _, _ = m2.predict_arrays(X)
+    np.testing.assert_array_equal(pred, pred2)
+
+
+def test_linear_svc_family_grid(linear_xy):
+    X, y = linear_xy
+    fam = LinearSVCFamily(grid=[{"regParam": 0.001}, {"regParam": 0.1}])
+    params = fam.fit_batch(jnp.asarray(X), jnp.asarray(y),
+                           jnp.ones(len(y)), fam.stack_grid())
+    pred, _, prob = fam.predict_batch(params, jnp.asarray(X))
+    assert np.asarray(pred).shape == (2, len(y))
+    for g in range(2):
+        assert float((np.asarray(pred)[g] == y).mean()) > 0.9
+
+
+def test_mlp_learns_xor(xor_xy):
+    X, y = xor_xy
+    store, label, feats = _store(X, y)
+    est = OpMultilayerPerceptronClassifier(
+        hidden_layers=[16], step_size=0.05, max_iter=300).set_input(
+        label, feats)
+    model = est.fit(store)
+    pred, _, prob = model.predict_arrays(X)
+    assert float((pred == y).mean()) > 0.9     # XOR needs the hidden layer
+    np.testing.assert_allclose(prob.sum(-1), 1.0, atol=1e-6)
+
+    state = model.get_model_state()
+    m2 = MLPModel()
+    m2.apply_model_state(state)
+    pred2, _, _ = m2.predict_arrays(X)
+    np.testing.assert_array_equal(pred, pred2)
+
+
+def test_mlp_family(xor_xy):
+    X, y = xor_xy
+    fam = MLPFamily(grid=[{"stepSize": 0.05, "layers": (16,)},
+                          {"stepSize": 0.01, "layers": (16,)}],
+                    max_iter=200)
+    params = fam.fit_batch(jnp.asarray(X), jnp.asarray(y),
+                           jnp.ones(len(y)), fam.stack_grid())
+    pred, _, _ = fam.predict_batch(params, jnp.asarray(X))
+    assert np.asarray(pred).shape == (2, len(y))
+    model = fam.realize(
+        __import__("jax").tree_util.tree_map(
+            lambda a: np.asarray(a)[0], params),
+        fam.grid[0])
+    p1, _, _ = model.predict_arrays(X)
+    np.testing.assert_array_equal(p1, np.asarray(pred)[0])
+
+
+def test_selected_model_tree_roundtrip(linear_xy):
+    """Regression: SelectedModel state round-trip must restore tree arrays
+    through inner.apply_model_state (not raw setattr)."""
+    from transmogrifai_tpu.models.selector import SelectedModel
+    from transmogrifai_tpu.models.trees import (OpRandomForestClassifier,
+                                                RandomForestFamily)
+
+    X, y = linear_xy
+    store, label, feats = _store(X, y)
+    est = OpRandomForestClassifier(num_trees=3, max_depth=3,
+                                   min_instances_per_node=5).set_input(
+        label, feats)
+    inner = est.fit(store)
+    sel = SelectedModel(inner=inner, task="binary")
+    sel.input_features = (label, feats)
+    state = sel.get_model_state()
+
+    sel2 = SelectedModel(task="binary")
+    sel2.apply_model_state(state)
+    p1, _, _ = sel.predict_arrays(X)
+    p2, _, _ = sel2.predict_arrays(X)
+    np.testing.assert_array_equal(p1, p2)

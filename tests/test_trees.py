@@ -1,0 +1,226 @@
+"""Tree ensembles: histogram engine, families, stages, selector wiring.
+
+Mirrors the reference's tree model tests (OpRandomForestClassifierTest,
+OpGBTClassifierTest, OpXGBoostClassifierTest) at the contract level:
+fit → sensible predictions, grid batching, serialization round-trip.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models import _treefit as TF
+from transmogrifai_tpu.models.trees import (
+    DecisionTreeFamily, GBTFamily, OpDecisionTreeClassifier,
+    OpGBTRegressor, OpRandomForestClassifier, RandomForestFamily,
+    TreeEnsembleModel, XGBoostFamily)
+
+
+@pytest.fixture(scope="module")
+def xy_cls():
+    rng = np.random.default_rng(0)
+    n, d = 300, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = ((X[:, 0] > 0.2) ^ (X[:, 2] < -0.1)).astype(np.float32)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def xy_reg():
+    rng = np.random.default_rng(1)
+    n, d = 300, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (2.0 * X[:, 0] - X[:, 1] + 0.05 * rng.normal(size=n)).astype(
+        np.float32)
+    return X, y
+
+
+def test_binning_roundtrip():
+    rng = np.random.default_rng(2)
+    X = jnp.asarray(rng.normal(size=(100, 3)).astype(np.float32))
+    edges = TF.quantile_bin_edges(X, 8)
+    assert edges.shape == (3, 7)
+    Xb = TF.binarize(X, edges)
+    assert Xb.shape == (100, 3)
+    assert int(Xb.min()) >= 0 and int(Xb.max()) <= 7
+    # split semantics: bin <= t  ⟺  x <= edges[f, t]
+    t = 3
+    lhs = np.asarray(Xb[:, 0] <= t)
+    rhs = np.asarray(X[:, 0] <= edges[0, t])
+    np.testing.assert_array_equal(lhs, rhs)
+
+
+def test_single_tree_learns_split(xy_cls):
+    X, y = xy_cls
+    fam = DecisionTreeFamily(
+        grid=[{"maxDepth": 4, "minInstancesPerNode": 5,
+               "minInfoGain": 0.001}])
+    params = jax.jit(
+        lambda X, y, w: fam.fit_batch(X, y, w, fam.stack_grid()))(
+        jnp.asarray(X), jnp.asarray(y), jnp.ones(len(y), jnp.float32))
+    pred, raw, prob = fam.predict_batch(params, jnp.asarray(X))
+    acc = float((np.asarray(pred)[0] == y).mean())
+    assert acc > 0.9
+    # probabilities normalized
+    np.testing.assert_allclose(np.asarray(prob)[0].sum(-1), 1.0, atol=1e-4)
+
+
+def test_depth_grouped_grid(xy_cls):
+    """Grid with mixed maxDepth → depth groups padded + reassembled in
+    grid order."""
+    X, y = xy_cls
+    fam = RandomForestFamily(
+        grid=[{"maxDepth": 2, "minInstancesPerNode": 5, "minInfoGain": 1e-3},
+              {"maxDepth": 4, "minInstancesPerNode": 5, "minInfoGain": 1e-3},
+              {"maxDepth": 2, "minInstancesPerNode": 50, "minInfoGain": 1e-3}],
+        num_trees=5)
+    params = jax.jit(
+        lambda X, y, w: fam.fit_batch(X, y, w, fam.stack_grid()))(
+        jnp.asarray(X), jnp.asarray(y), jnp.ones(len(y), jnp.float32))
+    # global depth 4: feat length 2^4-1, leaf 2^4
+    assert params["feat"].shape == (3, 5, 15)
+    assert params["leaf"].shape == (3, 5, 16, 2)
+    pred, _, prob = fam.predict_batch(params, jnp.asarray(X))
+    accs = [float((np.asarray(pred)[g] == y).mean()) for g in range(3)]
+    # deeper trees fit better than depth-2 with min 50 instances per node
+    assert accs[1] >= accs[2] - 0.02
+
+
+def test_fold_vmap_grid(xy_cls):
+    """fit_batch under an outer fold-vmap (the CV engine's usage)."""
+    X, y = xy_cls
+    fam = GBTFamily(grid=[{"maxDepth": 3, "minInstancesPerNode": 5,
+                           "minInfoGain": 1e-3}], max_iter=5)
+    w_folds = jnp.asarray(
+        np.stack([np.arange(len(y)) % 3 != k for k in range(3)]
+                 ).astype(np.float32))
+    stacked = fam.stack_grid()
+    params = jax.jit(lambda w: jax.vmap(
+        lambda wk: fam.fit_batch(jnp.asarray(X), jnp.asarray(y), wk,
+                                 stacked))(w))(w_folds)
+    assert params["feat"].shape[:2] == (3, 1)
+    pred, _, _ = jax.vmap(lambda p: fam.predict_batch(p, jnp.asarray(X)))(
+        params)
+    assert np.asarray(pred).shape == (3, 1, len(y))
+
+
+def test_gbt_improves_with_rounds(xy_reg):
+    X, y = xy_reg
+    r2 = {}
+    for rounds in (2, 20):
+        fam = GBTFamily(task="regression",
+                        grid=[{"maxDepth": 3, "minInstancesPerNode": 5,
+                               "minInfoGain": 0.0}], max_iter=rounds)
+        params = jax.jit(
+            lambda X, y, w: fam.fit_batch(X, y, w, fam.stack_grid()))(
+            jnp.asarray(X), jnp.asarray(y), jnp.ones(len(y), jnp.float32))
+        pred, _, _ = fam.predict_batch(params, jnp.asarray(X))
+        resid = y - np.asarray(pred)[0]
+        r2[rounds] = 1.0 - resid.var() / y.var()
+    assert r2[20] > r2[2] + 0.1
+    assert r2[20] > 0.7
+
+
+def test_xgb_binary(xy_cls):
+    X, y = xy_cls
+    fam = XGBoostFamily(grid=[{"maxDepth": 3, "eta": 0.3,
+                               "minChildWeight": 1.0, "numRound": 10}])
+    params = jax.jit(
+        lambda X, y, w: fam.fit_batch(X, y, w, fam.stack_grid()))(
+        jnp.asarray(X), jnp.asarray(y), jnp.ones(len(y), jnp.float32))
+    pred, raw, prob = fam.predict_batch(params, jnp.asarray(X))
+    assert float((np.asarray(pred)[0] == y).mean()) > 0.9
+    p = np.asarray(prob)[0]
+    np.testing.assert_allclose(p.sum(-1), 1.0, atol=1e-5)
+    # raw margins symmetric
+    r = np.asarray(raw)[0]
+    np.testing.assert_allclose(r[:, 0], -r[:, 1], atol=1e-5)
+
+
+def test_stage_fit_and_roundtrip(xy_cls, tmp_path):
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.columns import ColumnStore, VectorColumn, \
+        column_from_values
+    from transmogrifai_tpu.types import feature_types as ft
+
+    X, y = xy_cls
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("feats").from_column().as_predictor()
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y.astype(np.float64)),
+        "feats": VectorColumn(ft.OPVector, X.astype(np.float64))})
+    est = OpRandomForestClassifier(num_trees=5, max_depth=4,
+                                   min_instances_per_node=5).set_input(
+        label, feats)
+    model = est.fit(store)
+    pred1, _, prob1 = model.predict_arrays(X.astype(np.float64))
+    assert float((pred1 == y).mean()) > 0.8
+
+    # state round-trip
+    state = model.get_model_state()
+    m2 = TreeEnsembleModel(kind=model.kind, n_classes=model.n_classes,
+                           max_depth=model.max_depth)
+    m2.apply_model_state(state)
+    pred2, _, prob2 = m2.predict_arrays(X.astype(np.float64))
+    np.testing.assert_allclose(prob1, prob2, atol=1e-7)
+
+    # row-level transform matches batch transform (OpTransformerSpec idea)
+    row = {model.input_features[1].name: X[0].astype(np.float64)}
+    out = model.transform_row(row)
+    assert abs(out["prediction"] - pred1[0]) < 1e-9
+
+
+def test_regressor_stage(xy_reg):
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.columns import ColumnStore, VectorColumn, \
+        column_from_values
+    from transmogrifai_tpu.types import feature_types as ft
+
+    X, y = xy_reg
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("feats").from_column().as_predictor()
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y.astype(np.float64)),
+        "feats": VectorColumn(ft.OPVector, X.astype(np.float64))})
+    est = OpGBTRegressor(max_iter=10, max_depth=3,
+                         min_instances_per_node=5).set_input(label, feats)
+    model = est.fit(store)
+    pred, _, _ = model.predict_arrays(X.astype(np.float64))
+    assert 1.0 - (y - pred).var() / y.var() > 0.6
+
+
+def test_selector_with_trees(xy_cls):
+    """ModelSelector CV over an LR + RF + GBT mix picks a strong model."""
+    from transmogrifai_tpu.features import FeatureBuilder
+    from transmogrifai_tpu.columns import ColumnStore, VectorColumn, \
+        column_from_values
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import \
+        BinaryClassificationModelSelector
+
+    X, y = xy_cls
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("feats").from_column().as_predictor()
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y.astype(np.float64)),
+        "feats": VectorColumn(ft.OPVector, X.astype(np.float64))})
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2,
+        families=[
+            LogisticRegressionFamily(grid=[{"regParam": 0.01,
+                                            "elasticNetParam": 0.0}],
+                                     max_iter=16),
+            RandomForestFamily(grid=[{"maxDepth": 4,
+                                      "minInstancesPerNode": 5,
+                                      "minInfoGain": 1e-3}], num_trees=5),
+            GBTFamily(grid=[{"maxDepth": 3, "minInstancesPerNode": 5,
+                             "minInfoGain": 1e-3}], max_iter=5),
+        ]).set_input(label, feats)
+    model = selector.fit(store)
+    summ = model.selector_summary
+    # XOR-ish label: trees must beat logistic regression
+    assert summ.best_model_name in ("OpRandomForestClassifier",
+                                    "OpGBTClassifier")
+    assert summ.train_evaluation["AuROC"] > 0.9
+    assert len(summ.validator_summary.results) == 3

@@ -9,3 +9,12 @@ from .selector import (ModelSelector, SelectedModel, ModelSelectorSummary,  # no
                        BinaryClassificationModelSelector,
                        MultiClassificationModelSelector,
                        RegressionModelSelector)
+from .trees import (TreeEnsembleModel,  # noqa: F401
+                    RandomForestFamily, DecisionTreeFamily, GBTFamily,
+                    XGBoostFamily,
+                    OpDecisionTreeClassifier, OpDecisionTreeRegressor,
+                    OpRandomForestClassifier, OpRandomForestRegressor,
+                    OpGBTClassifier, OpGBTRegressor,
+                    OpXGBoostClassifier, OpXGBoostRegressor)
+from .svm import (OpLinearSVC, LinearSVCModel, LinearSVCFamily,  # noqa: F401
+                  OpMultilayerPerceptronClassifier, MLPModel, MLPFamily)

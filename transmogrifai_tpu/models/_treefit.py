@@ -1,0 +1,381 @@
+"""Histogram-based decision-tree learning in pure JAX — the TPU-native
+replacement for Spark MLlib trees and xgboost4j's C++/JNI core
+(reference: ``OpRandomForestClassifier.scala``, ``OpGBTClassifier.scala``,
+``OpXGBoostClassifier.scala:46``; Rabit allreduce ``:74-90``).
+
+Design (SURVEY §7 step 8): **static shapes everywhere** so the whole
+(fold × hyperparameter) grid vmaps onto the mesh.
+
+* Features are quantile-binned once per fit (``n_bins=32``, Spark's
+  ``maxBins`` default) — binning depends only on X, so under a fold-vmap
+  XLA computes it once.
+* A tree is grown **level-wise** to a static ``max_depth``: every sample
+  carries a node index in [0, 2^d); per level one ``segment_sum`` builds the
+  [nodes, features, bins, channels] histogram (Rabit's allreduce becomes a
+  ``psum`` when the batch axis is sharded), a cumulative sum over bins
+  scores every (feature, threshold) candidate, and an argmax picks the
+  split. Nodes that stop splitting route all samples left via a dummy
+  (+inf threshold) split, so the fixed-depth routing stays correct.
+* Hyperparameters that only gate values (minInstancesPerNode, minInfoGain,
+  eta, minChildWeight, numTrees/numRound, subsample rate) are *traced*
+  scalars → they can vary inside one vmapped grid. Only ``maxDepth`` is
+  structural; families group grid points by it (models/trees.py).
+* Ensembles run under ``lax.scan`` (bounded memory; XLA pipelines the
+  per-tree work); RF bootstraps with Poisson(subsample) weights.
+
+Tree layout: level-order arrays ``feat``/``thr`` of length 2^D − 1 and
+``leaf`` of shape [2^D, K]; routing is ``node = 2*node + (x[feat] > thr)``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_EPS = 1e-12
+_NEG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Binning
+# ---------------------------------------------------------------------------
+
+def quantile_bin_edges(X: jnp.ndarray, n_bins: int) -> jnp.ndarray:
+    """Per-feature interior quantile edges → [F, n_bins - 1]."""
+    qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+    return jnp.quantile(X, qs, axis=0).T
+
+
+def binarize(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
+    """bin[i, f] = #{edges[f] < x[i, f]} ∈ [0, n_bins-1]; bin ≤ t ⟺
+    x ≤ edges[f, t], matching the stored split threshold."""
+    def per_feature(col, e):
+        return jnp.searchsorted(e, col, side="left")
+    return jax.vmap(per_feature, in_axes=(1, 0), out_axes=1)(
+        X, edges).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Split criteria: (total, left, right) [-1 channel is raw count] → gain
+# ---------------------------------------------------------------------------
+
+def variance_split(total, left, right):
+    """Spark Variance impurity gain: imp(P) − wL/W·imp(L) − wR/W·imp(R).
+    Channels: (w, w·y, w·y², count)."""
+    def imp(s):
+        w = jnp.maximum(s[..., 0], _EPS)
+        return s[..., 2] / w - (s[..., 1] / w) ** 2
+    W = jnp.maximum(total[..., 0], _EPS)
+    return imp(total) - (left[..., 0] / W) * imp(left) \
+        - (right[..., 0] / W) * imp(right)
+
+
+def variance_leaf(s):
+    """Weighted mean target → [1]."""
+    return (s[..., 1] / jnp.maximum(s[..., 0], _EPS))[..., None]
+
+
+def gini_split(total, left, right):
+    """Spark Gini gain. Channels: (per-class weight … , count)."""
+    def imp(s):
+        cls = s[..., :-1]
+        w = jnp.maximum(cls.sum(-1), _EPS)
+        p = cls / w[..., None]
+        return 1.0 - (p * p).sum(-1)
+    W = jnp.maximum(total[..., :-1].sum(-1), _EPS)
+    wl = left[..., :-1].sum(-1)
+    wr = right[..., :-1].sum(-1)
+    return imp(total) - (wl / W) * imp(left) - (wr / W) * imp(right)
+
+
+def gini_leaf(s):
+    """Per-class probabilities → [C]."""
+    cls = s[..., :-1]
+    return cls / jnp.maximum(cls.sum(-1, keepdims=True), _EPS)
+
+
+def make_xgb_split(lam, min_child_weight):
+    """XGBoost gain: ½(G_L²/(H_L+λ) + G_R²/(H_R+λ) − G²/(H+λ)).
+    Channels: (g, h, count). min_child_weight masks on hessian mass."""
+    def split(total, left, right):
+        def score(s):
+            return s[..., 0] ** 2 / (s[..., 1] + lam + _EPS)
+        gain = 0.5 * (score(left) + score(right) - score(total))
+        ok = (left[..., 1] >= min_child_weight) & \
+             (right[..., 1] >= min_child_weight)
+        return jnp.where(ok, gain, _NEG)
+    return split
+
+
+def make_xgb_leaf(lam):
+    def leaf(s):
+        return (-s[..., 0] / (s[..., 1] + lam + _EPS))[..., None]
+    return leaf
+
+
+# ---------------------------------------------------------------------------
+# Level-wise tree growing
+# ---------------------------------------------------------------------------
+
+def _level_hist(stats, node, Xb, n_nodes, n_bins):
+    """[n, C] sample stats → [n_nodes, F, n_bins, C] histograms."""
+    def per_feature(bins):
+        seg = node * n_bins + bins
+        return jax.ops.segment_sum(stats, seg,
+                                   num_segments=n_nodes * n_bins)
+    hist = jax.vmap(per_feature, in_axes=1)(Xb)      # [F, n_nodes*B, C]
+    F, _, C = hist.shape
+    return hist.reshape(F, n_nodes, n_bins, C).transpose(1, 0, 2, 3)
+
+
+def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
+              split_fn: Callable, leaf_fn: Callable, max_depth: int,
+              n_bins: int, min_instances, min_info_gain,
+              feat_mask=None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                                       jnp.ndarray]:
+    """Grow one tree; returns (feat [2^D−1], thr [2^D−1], leaf [2^D, K],
+    node [n] final sample→leaf assignment).
+
+    ``min_instances`` / ``min_info_gain`` may be traced scalars.
+    ``feat_mask`` [F] bool restricts candidate features (RF column
+    subsampling)."""
+    n, F = Xb.shape
+    B = n_bins
+    node = jnp.zeros((n,), jnp.int32)
+    feats, thrs = [], []
+    for d in range(max_depth):
+        n_nodes = 1 << d
+        hist = _level_hist(stats, node, Xb, n_nodes, B)
+        cum = jnp.cumsum(hist, axis=2)
+        total = cum[:, :, -1, :][:, :, None, :]
+        left = cum[:, :, :-1, :]                      # split: bins ≤ t
+        right = total - left
+        gain = split_fn(total, left, right)           # [nodes, F, B-1]
+        ok = (left[..., -1] >= min_instances) & \
+             (right[..., -1] >= min_instances)
+        if feat_mask is not None:
+            ok = ok & feat_mask[None, :, None]
+        gain = jnp.where(ok, gain, _NEG)
+        flat = gain.reshape(n_nodes, F * (B - 1))
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        do_split = best_gain >= jnp.maximum(min_info_gain, 1e-10)
+        f_idx = jnp.where(do_split, best // (B - 1), 0).astype(jnp.int32)
+        t_idx = jnp.where(do_split, best % (B - 1), 0).astype(jnp.int32)
+        thr = jnp.where(do_split, edges[f_idx, t_idx], jnp.inf)
+        feats.append(f_idx)
+        thrs.append(thr)
+        xb = jnp.take_along_axis(Xb, f_idx[node][:, None], axis=1)[:, 0]
+        go_right = jnp.where(do_split[node], xb > t_idx[node], False)
+        node = 2 * node + go_right.astype(jnp.int32)
+    leaf_stats = jax.ops.segment_sum(stats, node,
+                                     num_segments=1 << max_depth)
+    leaf = leaf_fn(leaf_stats)
+    return jnp.concatenate(feats), jnp.concatenate(thrs), leaf, node
+
+
+def predict_tree(feat, thr, leaf, X, max_depth: int) -> jnp.ndarray:
+    """Route [n, F] rows through one tree → [n, K] leaf values."""
+    n = X.shape[0]
+    node = jnp.zeros((n,), jnp.int32)
+    off = 0
+    for d in range(max_depth):
+        f = feat[off + node]
+        t = thr[off + node]
+        x = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
+        node = 2 * node + (x > t).astype(jnp.int32)
+        off += 1 << d
+    return leaf[node]
+
+
+def predict_ensemble(feat, thr, leaf, tree_w, X, max_depth: int
+                     ) -> jnp.ndarray:
+    """Weighted sum over [T, …] stacked trees → [n, K]."""
+    def body(acc, tree):
+        f, t, l, w = tree
+        return acc + w * predict_tree(f, t, l, X, max_depth), None
+    init = jnp.zeros((X.shape[0], leaf.shape[-1]), leaf.dtype)
+    out, _ = lax.scan(body, init, (feat, thr, leaf, tree_w))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Random forest
+# ---------------------------------------------------------------------------
+
+def _feature_masks(key, n_trees: int, n_feat: int, k: int) -> jnp.ndarray:
+    """[T, F] bool, exactly-k random features per tree (featureSubsetStrategy
+    'auto' — per-tree rather than Spark's per-node, same spirit)."""
+    if k >= n_feat:
+        return jnp.ones((n_trees, n_feat), bool)
+    u = jax.random.uniform(key, (n_trees, n_feat))
+    kth = jnp.sort(u, axis=1)[:, k - 1][:, None]
+    return u <= kth
+
+
+def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
+               max_depth: int, n_bins: int, min_instances, min_info_gain,
+               num_trees_used, subsample_rate, seed: int = 7):
+    """Random forest via scanned bootstrap trees.
+
+    Traced: min_instances, min_info_gain, num_trees_used (≤ n_trees,
+    masks extra trees), subsample_rate. Returns params dict."""
+    key = jax.random.PRNGKey(seed)
+    k_boot, k_feat = jax.random.split(key)
+    n, F = X.shape
+    edges = quantile_bin_edges(X, n_bins)
+    Xb = binarize(X, edges)
+    boot = jax.random.poisson(
+        k_boot, jnp.broadcast_to(jnp.asarray(subsample_rate, jnp.float32),
+                                 ()), (n_trees, n)).astype(X.dtype)
+    if n_trees == 1:
+        boot = jnp.ones((1, n), X.dtype)          # single DT: no bootstrap
+        fmask = jnp.ones((1, F), bool)
+    else:
+        k = max(1, int(round(np.sqrt(F))) if task == "classification"
+                else max(1, F // 3))
+        fmask = _feature_masks(k_feat, n_trees, F, k)
+
+    if task == "classification":
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), n_classes, dtype=X.dtype)
+        def make_stats(wt):
+            return jnp.concatenate(
+                [onehot * wt[:, None], (wt > 0).astype(X.dtype)[:, None]], 1)
+        split_fn, leaf_fn = gini_split, gini_leaf
+    else:
+        def make_stats(wt):
+            return jnp.stack(
+                [wt, wt * y, wt * y * y, (wt > 0).astype(X.dtype)], axis=1)
+        split_fn, leaf_fn = variance_split, variance_leaf
+
+    def body(_, per_tree):
+        bw, fm = per_tree
+        wt = w * bw
+        feat, thr, leaf, _node = grow_tree(
+            Xb, edges, make_stats(wt), split_fn, leaf_fn, max_depth,
+            n_bins, min_instances, min_info_gain, feat_mask=fm)
+        return None, (feat, thr, leaf)
+    _, (feat, thr, leaf) = lax.scan(body, None, (boot, fmask))
+    tree_w = (jnp.arange(n_trees) < num_trees_used).astype(X.dtype)
+    tree_w = tree_w / jnp.maximum(tree_w.sum(), 1.0)
+    return {"feat": feat, "thr": thr, "leaf": leaf, "tree_w": tree_w}
+
+
+# ---------------------------------------------------------------------------
+# Gradient boosting (Spark GBT: first-order, variance splits on residuals)
+# ---------------------------------------------------------------------------
+
+def fit_gbt(X, y, w, *, task: str, n_rounds: int, max_depth: int,
+            n_bins: int, min_instances, min_info_gain, step_size,
+            num_rounds_used):
+    """Spark-style GBT: each round fits a weighted regression tree to the
+    pseudo-residuals; classification uses logloss on y' ∈ {−1,+1} with
+    margin F, prob = σ(2F) (GBTClassificationModel semantics)."""
+    edges = quantile_bin_edges(X, n_bins)
+    Xb = binarize(X, edges)
+    n = X.shape[0]
+    ypm = 2.0 * y - 1.0
+
+    def residual(Fm):
+        if task == "classification":
+            return 2.0 * ypm / (1.0 + jnp.exp(2.0 * ypm * Fm))
+        return y - Fm
+
+    def body(Fm, t):
+        r = residual(Fm)
+        stats = jnp.stack([w, w * r, w * r * r,
+                           (w > 0).astype(X.dtype)], axis=1)
+        feat, thr, leaf, node = grow_tree(
+            Xb, edges, stats, variance_split, variance_leaf, max_depth,
+            n_bins, min_instances, min_info_gain)
+        use = (t < num_rounds_used).astype(X.dtype)
+        scale = use * step_size
+        Fm = Fm + scale * leaf[node][:, 0]
+        return Fm, (feat, thr, leaf * scale)
+    F0 = jnp.zeros((n,), X.dtype)
+    _, (feat, thr, leaf) = lax.scan(body, F0, jnp.arange(n_rounds))
+    return {"feat": feat, "thr": thr, "leaf": leaf,
+            "tree_w": jnp.ones((n_rounds,), X.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# XGBoost-equivalent (second-order, L2 leaf regularization)
+# ---------------------------------------------------------------------------
+
+def fit_xgb(X, y, w, *, task: str, n_rounds: int, max_depth: int,
+            n_bins: int, eta, lam, min_child_weight, num_rounds_used):
+    """Second-order boosting: g/h from logistic (classification) or squared
+    (regression) loss; leaf = −G/(H+λ) (xgboost4j replacement — Rabit's
+    histogram allreduce becomes psum under a sharded batch axis)."""
+    edges = quantile_bin_edges(X, n_bins)
+    Xb = binarize(X, edges)
+    n = X.shape[0]
+    split_fn = make_xgb_split(lam, min_child_weight)
+    leaf_fn = make_xgb_leaf(lam)
+
+    def grads(Fm):
+        if task == "classification":
+            p = jax.nn.sigmoid(Fm)
+            return w * (p - y), w * jnp.maximum(p * (1.0 - p), 1e-6)
+        return w * (Fm - y), w
+
+    def body(Fm, t):
+        g, h = grads(Fm)
+        stats = jnp.stack([g, h, (w > 0).astype(X.dtype)], axis=1)
+        feat, thr, leaf, node = grow_tree(
+            Xb, edges, stats, split_fn, leaf_fn, max_depth, n_bins,
+            jnp.asarray(0.0, X.dtype), jnp.asarray(-1e29, X.dtype))
+        use = (t < num_rounds_used).astype(X.dtype)
+        scale = use * eta
+        Fm = Fm + scale * leaf[node][:, 0]
+        return Fm, (feat, thr, leaf * scale)
+    F0 = jnp.zeros((n,), X.dtype)
+    _, (feat, thr, leaf) = lax.scan(body, F0, jnp.arange(n_rounds))
+    return {"feat": feat, "thr": thr, "leaf": leaf,
+            "tree_w": jnp.ones((n_rounds,), X.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# Ensemble → Prediction triple (pred, raw, prob)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "n_classes"))
+def predict_rf_classification(params, X, max_depth: int, n_classes: int):
+    probs = predict_ensemble(params["feat"], params["thr"], params["leaf"],
+                             params["tree_w"], X, max_depth)
+    probs = probs / jnp.maximum(probs.sum(-1, keepdims=True), _EPS)
+    pred = jnp.argmax(probs, axis=-1).astype(X.dtype)
+    return pred, probs, probs
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_rf_regression(params, X, max_depth: int):
+    out = predict_ensemble(params["feat"], params["thr"], params["leaf"],
+                           params["tree_w"], X, max_depth)[:, 0]
+    empty = jnp.zeros((X.shape[0], 0), X.dtype)
+    return out, empty, empty
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "margin_scale"))
+def predict_margin_classification(params, X, max_depth: int,
+                                  margin_scale: float = 1.0):
+    """GBT (margin_scale=2: prob = σ(2F)) and XGB (=1) binary heads."""
+    m = predict_ensemble(params["feat"], params["thr"], params["leaf"],
+                         params["tree_w"], X, max_depth)[:, 0]
+    p1 = jax.nn.sigmoid(margin_scale * m)
+    prob = jnp.stack([1.0 - p1, p1], axis=1)
+    raw = jnp.stack([-m, m], axis=1)
+    pred = (p1 > 0.5).astype(X.dtype)
+    return pred, raw, prob
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_margin_regression(params, X, max_depth: int):
+    out = predict_ensemble(params["feat"], params["thr"], params["leaf"],
+                           params["tree_w"], X, max_depth)[:, 0]
+    empty = jnp.zeros((X.shape[0], 0), X.dtype)
+    return out, empty, empty

@@ -144,5 +144,15 @@ class ModelFamily:
     def realize(self, params, hparams: Dict[str, Any]) -> PredictorModel:
         raise NotImplementedError
 
+    def clone_single(self, hparams: Dict[str, Any]) -> "ModelFamily":
+        """Same family configured with a one-point grid (final refit).
+
+        Copies every instance attribute except the grid, so subclass
+        configuration (n_classes, task, seeds, …) survives the clone."""
+        new = type(self)(grid=[dict(hparams)])
+        new.__dict__.update({k: v for k, v in self.__dict__.items()
+                             if k != "grid"})
+        return new
+
     def __repr__(self) -> str:
         return f"{type(self).__name__}(grid={len(self.grid)})"

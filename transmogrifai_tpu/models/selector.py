@@ -110,10 +110,19 @@ class SelectedModel(PredictorModel):
 
     def apply_model_state(self, state) -> None:
         from ..stages.base import STAGE_REGISTRY
-        cls = STAGE_REGISTRY[state["inner_class"]]
+        name = state["inner_class"]
+        if name not in STAGE_REGISTRY:
+            raise KeyError(
+                f"Model class {name!r} is not registered — import its "
+                "module before loading the workflow model")
+        cls = STAGE_REGISTRY[name]
         self.inner = cls(**state["inner_params"])
-        for k, v in state["inner_state"].items():
-            setattr(self.inner, k, v)
+        inner_state = state["inner_state"]
+        if hasattr(self.inner, "apply_model_state"):
+            self.inner.apply_model_state(inner_state)
+        else:
+            for k, v in inner_state.items():
+                setattr(self.inner, k, v)
 
     def summary(self):
         out = {"model": "SelectedModel", "task": self.task}
@@ -185,12 +194,10 @@ class ModelSelector(PredictorEstimator):
         Xk, yk = X[keep], y[keep]
         w = (self.splitter.sample_weights(yk) if self.splitter
              else np.ones_like(yk))
-        single = type(best_family)(grid=[best_hparams])
-        for attr in ("n_classes", "max_iter"):
-            if hasattr(best_family, attr) and hasattr(single, attr):
-                setattr(single, attr, getattr(best_family, attr))
-        params = single.fit_batch(jnp.asarray(Xk), jnp.asarray(yk),
-                                  jnp.asarray(w), single.stack_grid())
+        single = best_family.clone_single(best_hparams)
+        grid = single.stack_grid()
+        params = jax.jit(lambda X, y, w: single.fit_batch(X, y, w, grid))(
+            jnp.asarray(Xk), jnp.asarray(yk), jnp.asarray(w))
         inner = single.realize(_index_pytree(params, 0), best_hparams)
 
         # train evaluation over the rows the model was actually trained on
@@ -299,8 +306,8 @@ class MultiClassificationModelSelector(_SelectorFactory):
         fams: List[ModelFamily] = [LogisticRegressionFamily(),
                                    NaiveBayesFamily()]
         try:
-            from .trees import RandomForestFamily
-            fams.append(RandomForestFamily())
+            from .trees import DecisionTreeFamily, RandomForestFamily
+            fams += [RandomForestFamily(), DecisionTreeFamily()]
         except ImportError:
             pass
         return fams

@@ -1,0 +1,496 @@
+"""Tree-ensemble stages: decision tree, random forest, GBT, XGBoost-style.
+
+Parity targets (reference): ``OpDecisionTreeClassifier/Regressor``,
+``OpRandomForestClassifier/Regressor`` (``core/.../impl/classification/
+OpRandomForestClassifier.scala``), ``OpGBTClassifier/Regressor``,
+``OpXGBoostClassifier/Regressor`` (``OpXGBoostClassifier.scala:46``) —
+all fit natively with the JAX histogram engine (models/_treefit.py)
+instead of wrapping MLlib / xgboost4j-JNI.
+
+Grid batching: value-gating hyperparameters (minInstancesPerNode,
+minInfoGain, eta, minChildWeight, numTrees/numRound, subsample) are traced
+and vmapped; ``maxDepth`` is structural, so ``fit_batch`` groups grid
+points by depth at trace time (the stacked grid is concrete), fits each
+group with true static shapes, pads trees to the global depth, and
+reassembles grid order — one compiled program per distinct depth instead
+of worst-case memory for every grid point.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..stages.base import register_stage
+from . import _treefit as TF
+from .base import (ModelFamily, PredictorEstimator, PredictorModel,
+                   extract_xy)
+
+__all__ = [
+    "TreeEnsembleModel",
+    "RandomForestFamily", "DecisionTreeFamily", "GBTFamily", "XGBoostFamily",
+    "OpDecisionTreeClassifier", "OpDecisionTreeRegressor",
+    "OpRandomForestClassifier", "OpRandomForestRegressor",
+    "OpGBTClassifier", "OpGBTRegressor",
+    "OpXGBoostClassifier", "OpXGBoostRegressor",
+]
+
+_MAX_DEPTH_DEFAULT = (3, 6, 12)        # DefaultSelectorParams.MaxDepth
+_MIN_INST_DEFAULT = (10, 100)          # .MinInstancesPerNode
+_MIN_GAIN_DEFAULT = (0.001, 0.01, 0.1)  # .MinInfoGain
+
+
+# ---------------------------------------------------------------------------
+# Fitted model
+# ---------------------------------------------------------------------------
+
+@register_stage
+class TreeEnsembleModel(PredictorModel):
+    """Stacked level-order trees + per-tree weights; kind selects the head."""
+
+    operation_name = "trees"
+
+    def __init__(self, kind: str = "rf_classification", n_classes: int = 2,
+                 max_depth: int = 6, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.kind = kind
+        self.n_classes = int(n_classes)
+        self.max_depth = int(max_depth)
+        self.trees: Dict[str, np.ndarray] = {}
+
+    def predict_arrays(self, X):
+        p = {k: jnp.asarray(v) for k, v in self.trees.items()}
+        Xd = jnp.asarray(X)
+        if self.kind == "rf_classification":
+            out = TF.predict_rf_classification(p, Xd, self.max_depth,
+                                               self.n_classes)
+        elif self.kind == "rf_regression":
+            out = TF.predict_rf_regression(p, Xd, self.max_depth)
+        elif self.kind == "gbt_classification":
+            out = TF.predict_margin_classification(p, Xd, self.max_depth,
+                                                   margin_scale=2.0)
+        elif self.kind == "xgb_classification":
+            out = TF.predict_margin_classification(p, Xd, self.max_depth,
+                                                   margin_scale=1.0)
+        else:   # gbt_regression / xgb_regression
+            out = TF.predict_margin_regression(p, Xd, self.max_depth)
+        return tuple(np.asarray(o, dtype=np.float64) for o in out)
+
+    def get_model_state(self):
+        state = {f"tree_{k}": np.asarray(v) for k, v in self.trees.items()}
+        state["kind"] = self.kind
+        return state
+
+    def apply_model_state(self, state) -> None:
+        self.trees = {k[5:]: np.asarray(v) for k, v in state.items()
+                      if k.startswith("tree_")}
+        if "kind" in state:
+            self.kind = str(state["kind"])
+
+    def summary(self):
+        t = self.trees.get("tree_w")
+        return {"model": "TreeEnsemble", "kind": self.kind,
+                "numTrees": int(t.shape[0]) if t is not None else 0,
+                "maxDepth": self.max_depth}
+
+
+# ---------------------------------------------------------------------------
+# Families
+# ---------------------------------------------------------------------------
+
+class _TreeFamilyBase(ModelFamily):
+    """Shared depth-grouped grid batching."""
+
+    task = "classification"
+    n_bins = 32                      # DefaultSelectorParams.MaxBin
+
+    def __init__(self, grid=None, task: Optional[str] = None,
+                 n_classes: int = 2, seed: int = 7, **fixed):
+        super().__init__(grid, **fixed)
+        if task is not None:
+            self.task = task
+        self.n_classes = n_classes
+        self.seed = seed
+
+    #: keys whose stacked values are traced & vmapped
+    traced_keys: List[str] = []
+
+    def _fit_single(self, X, y, w, depth: int, n_trees: int,
+                    traced: Dict[str, Any]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def _static_trees(self) -> int:
+        raise NotImplementedError
+
+    def _stacked_col(self, stacked, key) -> np.ndarray:
+        if key in stacked:
+            return np.asarray(stacked[key])
+        return np.full((self.grid_size(),), self.param_defaults()[key])
+
+    def _depth_of(self, stacked) -> np.ndarray:
+        return self._stacked_col(stacked, "maxDepth").astype(np.int64)
+
+    def global_depth(self) -> int:
+        return int(max(int(g.get("maxDepth",
+                                 self.param_defaults()["maxDepth"]))
+                       for g in self.grid))
+
+    def fit_batch(self, X, y, w, stacked):
+        depths = self._depth_of(stacked)
+        D = int(depths.max())
+        n_trees = self._static_trees()
+        order: List[int] = []
+        outs = []
+        for d in sorted(set(depths.tolist())):
+            idxs = [i for i, dd in enumerate(depths.tolist()) if dd == d]
+            order += idxs
+            traced = {k: jnp.asarray(self._stacked_col(stacked, k)[idxs],
+                                     dtype=X.dtype)
+                      for k in self.traced_keys}
+
+            def fit_one(tr, _d=d):
+                p = self._fit_single(X, y, w, _d, n_trees, tr)
+                return self._pad(p, _d, D, n_trees)
+            outs.append(jax.vmap(fit_one)(traced))
+        cat = jax.tree_util.tree_map(
+            lambda *a: jnp.concatenate(a, axis=0), *outs)
+        inv = jnp.argsort(jnp.asarray(order))
+        return jax.tree_util.tree_map(lambda a: jnp.take(a, inv, axis=0), cat)
+
+    @staticmethod
+    def _pad(p: Dict[str, Any], d: int, D: int, n_trees: int):
+        if d == D:
+            return p
+        extra = (1 << D) - (1 << d)
+        feat = jnp.concatenate(
+            [p["feat"], jnp.zeros((n_trees, extra), p["feat"].dtype)], axis=1)
+        thr = jnp.concatenate(
+            [p["thr"], jnp.full((n_trees, extra), jnp.inf, p["thr"].dtype)],
+            axis=1)
+        leaf = jnp.repeat(p["leaf"], 1 << (D - d), axis=1)
+        return {"feat": feat, "thr": thr, "leaf": leaf,
+                "tree_w": p["tree_w"]}
+
+    def predict_batch(self, params, X):
+        D = self.global_depth()
+        if self.task == "classification":
+            if self._head() == "rf":
+                fn = lambda p: TF.predict_rf_classification(
+                    p, X, D, self.n_classes)
+            else:
+                scale = 2.0 if self._head() == "gbt" else 1.0
+                fn = lambda p: TF.predict_margin_classification(
+                    p, X, D, margin_scale=scale)
+        else:
+            if self._head() == "rf":
+                fn = lambda p: TF.predict_rf_regression(p, X, D)
+            else:
+                fn = lambda p: TF.predict_margin_regression(p, X, D)
+        return jax.vmap(fn)(params)
+
+    def _head(self) -> str:
+        return "rf"
+
+    def realize(self, params, hparams) -> TreeEnsembleModel:
+        kind = f"{self._head()}_{self.task}"
+        model = TreeEnsembleModel(kind=kind, n_classes=self.n_classes,
+                                  max_depth=self.global_depth())
+        model.trees = {k: np.asarray(v) for k, v in params.items()}
+        return model
+
+
+class RandomForestFamily(_TreeFamilyBase):
+    """RF grid = MaxDepth × MinInstancesPerNode × MinInfoGain
+    (BinaryClassificationModelSelector.scala:52-128), numTrees = 50."""
+
+    name = "OpRandomForestClassifier"
+    default_grid = [
+        {"maxDepth": d, "minInstancesPerNode": mi, "minInfoGain": mg}
+        for d in _MAX_DEPTH_DEFAULT for mi in _MIN_INST_DEFAULT
+        for mg in _MIN_GAIN_DEFAULT
+    ]
+    traced_keys = ["minInstancesPerNode", "minInfoGain", "numTrees",
+                   "subsamplingRate"]
+
+    def __init__(self, grid=None, task: Optional[str] = None,
+                 n_classes: int = 2, num_trees: int = 50, seed: int = 7,
+                 **fixed):
+        super().__init__(grid, task=task, n_classes=n_classes, seed=seed,
+                         **fixed)
+        self.num_trees = num_trees
+        if task == "regression":
+            self.name = "OpRandomForestRegressor"
+            self.task = "regression"
+
+    def param_defaults(self):
+        return {"maxDepth": 6, "minInstancesPerNode": 10,
+                "minInfoGain": 0.001, "numTrees": self.num_trees,
+                "subsamplingRate": 1.0}
+
+    def _static_trees(self) -> int:
+        return int(max(int(g.get("numTrees", self.num_trees))
+                       for g in self.grid))
+
+    def _fit_single(self, X, y, w, depth, n_trees, tr):
+        return TF.fit_forest(
+            X, y, w, task=self.task, n_classes=self.n_classes,
+            n_trees=n_trees, max_depth=depth, n_bins=self.n_bins,
+            min_instances=tr["minInstancesPerNode"],
+            min_info_gain=tr["minInfoGain"],
+            num_trees_used=tr["numTrees"],
+            subsample_rate=tr["subsamplingRate"], seed=self.seed)
+
+
+class DecisionTreeFamily(RandomForestFamily):
+    """Single unbagged tree, all features (OpDecisionTreeClassifier);
+    inherits the RF MaxDepth × MinInstancesPerNode × MinInfoGain grid."""
+
+    name = "OpDecisionTreeClassifier"
+
+    def __init__(self, grid=None, task: Optional[str] = None,
+                 n_classes: int = 2, seed: int = 7, **fixed):
+        super().__init__(grid, task=task, n_classes=n_classes, num_trees=1,
+                         seed=seed, **fixed)
+        self.name = ("OpDecisionTreeRegressor" if self.task == "regression"
+                     else "OpDecisionTreeClassifier")
+
+    def param_defaults(self):
+        d = super().param_defaults()
+        d["numTrees"] = 1
+        return d
+
+    def _static_trees(self) -> int:
+        return 1
+
+
+class GBTFamily(_TreeFamilyBase):
+    """GBT grid = MaxDepth × MinInstancesPerNode × MinInfoGain,
+    maxIter=20 rounds, stepSize=0.1 (DefaultSelectorParams)."""
+
+    name = "OpGBTClassifier"
+    default_grid = [
+        {"maxDepth": d, "minInstancesPerNode": mi, "minInfoGain": mg}
+        for d in _MAX_DEPTH_DEFAULT for mi in _MIN_INST_DEFAULT
+        for mg in _MIN_GAIN_DEFAULT
+    ]
+    traced_keys = ["minInstancesPerNode", "minInfoGain", "maxIter",
+                   "stepSize"]
+
+    def __init__(self, grid=None, task: Optional[str] = None,
+                 n_classes: int = 2, max_iter: int = 20, seed: int = 7,
+                 **fixed):
+        super().__init__(grid, task=task, n_classes=n_classes, seed=seed,
+                         **fixed)
+        self.max_iter = max_iter
+        if task == "regression":
+            self.name = "OpGBTRegressor"
+            self.task = "regression"
+
+    def param_defaults(self):
+        return {"maxDepth": 6, "minInstancesPerNode": 10,
+                "minInfoGain": 0.001, "maxIter": self.max_iter,
+                "stepSize": 0.1}
+
+    def _head(self) -> str:
+        return "gbt"
+
+    def _static_trees(self) -> int:
+        return int(max(int(g.get("maxIter", self.max_iter))
+                       for g in self.grid))
+
+    def _fit_single(self, X, y, w, depth, n_trees, tr):
+        return TF.fit_gbt(
+            X, y, w, task=self.task, n_rounds=n_trees, max_depth=depth,
+            n_bins=self.n_bins, min_instances=tr["minInstancesPerNode"],
+            min_info_gain=tr["minInfoGain"], step_size=tr["stepSize"],
+            num_rounds_used=tr["maxIter"])
+
+
+class XGBoostFamily(_TreeFamilyBase):
+    """XGB grid = NumRound × Eta × MaxDepth × MinChildWeight
+    (BinaryClassificationModelSelector.scala:119-124)."""
+
+    name = "OpXGBoostClassifier"
+    default_grid = [
+        {"maxDepth": d, "eta": e, "minChildWeight": mc, "numRound": 100}
+        for d in _MAX_DEPTH_DEFAULT for e in (0.1, 0.3)
+        for mc in (1.0, 5.0, 10.0)
+    ]
+    traced_keys = ["eta", "minChildWeight", "numRound"]
+
+    def __init__(self, grid=None, task: Optional[str] = None,
+                 n_classes: int = 2, reg_lambda: float = 1.0, seed: int = 7,
+                 **fixed):
+        super().__init__(grid, task=task, n_classes=n_classes, seed=seed,
+                         **fixed)
+        self.reg_lambda = reg_lambda
+        if task == "regression":
+            self.name = "OpXGBoostRegressor"
+            self.task = "regression"
+
+    def param_defaults(self):
+        return {"maxDepth": 6, "eta": 0.3, "minChildWeight": 1.0,
+                "numRound": 100}
+
+    def _head(self) -> str:
+        return "xgb"
+
+    def _static_trees(self) -> int:
+        return int(max(int(g.get("numRound", 100)) for g in self.grid))
+
+    def _fit_single(self, X, y, w, depth, n_trees, tr):
+        return TF.fit_xgb(
+            X, y, w, task=self.task, n_rounds=n_trees, max_depth=depth,
+            n_bins=self.n_bins, eta=tr["eta"], lam=self.reg_lambda,
+            min_child_weight=tr["minChildWeight"],
+            num_rounds_used=tr["numRound"])
+
+
+# ---------------------------------------------------------------------------
+# Standalone estimator stages
+# ---------------------------------------------------------------------------
+
+class _TreeEstimatorBase(PredictorEstimator):
+    family_cls = RandomForestFamily
+    task = "classification"
+
+    def _family(self, n_classes: int) -> _TreeFamilyBase:
+        raise NotImplementedError
+
+    def fit_columns(self, store) -> TreeEnsembleModel:
+        X, y = extract_xy(store, self.label_name, self.features_name)
+        n_classes = max(int(y.max()) + 1 if len(y) else 2, 2) \
+            if self.task == "classification" else 2
+        fam = self._family(n_classes)
+        Xd = jnp.asarray(X, jnp.float32)
+        grid = fam.stack_grid()
+        params = jax.jit(lambda X, y, w: fam.fit_batch(X, y, w, grid))(
+            Xd, jnp.asarray(y, jnp.float32),
+            jnp.ones((X.shape[0],), jnp.float32))
+        single = jax.tree_util.tree_map(lambda a: np.asarray(a)[0], params)
+        return fam.realize(single, fam.grid[0])
+
+
+@register_stage
+class OpRandomForestClassifier(_TreeEstimatorBase):
+    operation_name = "randomForest"
+
+    def __init__(self, num_trees: int = 20, max_depth: int = 5,
+                 min_instances_per_node: int = 1,
+                 min_info_gain: float = 0.0, subsampling_rate: float = 1.0,
+                 seed: int = 7, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.num_trees = num_trees
+        self.max_depth = max_depth
+        self.min_instances_per_node = min_instances_per_node
+        self.min_info_gain = min_info_gain
+        self.subsampling_rate = subsampling_rate
+        self.seed = seed
+
+    def _family(self, n_classes):
+        return RandomForestFamily(
+            grid=[{"maxDepth": self.max_depth,
+                   "minInstancesPerNode": self.min_instances_per_node,
+                   "minInfoGain": self.min_info_gain,
+                   "numTrees": self.num_trees,
+                   "subsamplingRate": self.subsampling_rate}],
+            task=self.task, n_classes=n_classes, num_trees=self.num_trees,
+            seed=self.seed)
+
+
+@register_stage
+class OpRandomForestRegressor(OpRandomForestClassifier):
+    operation_name = "randomForestReg"
+    task = "regression"
+
+
+@register_stage
+class OpDecisionTreeClassifier(_TreeEstimatorBase):
+    operation_name = "decisionTree"
+
+    def __init__(self, max_depth: int = 5, min_instances_per_node: int = 1,
+                 min_info_gain: float = 0.0, seed: int = 7,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.max_depth = max_depth
+        self.min_instances_per_node = min_instances_per_node
+        self.min_info_gain = min_info_gain
+        self.seed = seed
+
+    def _family(self, n_classes):
+        return DecisionTreeFamily(
+            grid=[{"maxDepth": self.max_depth,
+                   "minInstancesPerNode": self.min_instances_per_node,
+                   "minInfoGain": self.min_info_gain}],
+            task=self.task, n_classes=n_classes, seed=self.seed)
+
+
+@register_stage
+class OpDecisionTreeRegressor(OpDecisionTreeClassifier):
+    operation_name = "decisionTreeReg"
+    task = "regression"
+
+
+@register_stage
+class OpGBTClassifier(_TreeEstimatorBase):
+    operation_name = "gbtClassifier"
+
+    def __init__(self, max_iter: int = 20, max_depth: int = 5,
+                 min_instances_per_node: int = 1, min_info_gain: float = 0.0,
+                 step_size: float = 0.1, seed: int = 7,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.max_iter = max_iter
+        self.max_depth = max_depth
+        self.min_instances_per_node = min_instances_per_node
+        self.min_info_gain = min_info_gain
+        self.step_size = step_size
+        self.seed = seed
+
+    def _family(self, n_classes):
+        return GBTFamily(
+            grid=[{"maxDepth": self.max_depth,
+                   "minInstancesPerNode": self.min_instances_per_node,
+                   "minInfoGain": self.min_info_gain,
+                   "maxIter": self.max_iter, "stepSize": self.step_size}],
+            task=self.task, n_classes=n_classes, max_iter=self.max_iter,
+            seed=self.seed)
+
+
+@register_stage
+class OpGBTRegressor(OpGBTClassifier):
+    operation_name = "gbtRegressor"
+    task = "regression"
+
+
+@register_stage
+class OpXGBoostClassifier(_TreeEstimatorBase):
+    operation_name = "xgbClassifier"
+
+    def __init__(self, num_round: int = 100, max_depth: int = 6,
+                 eta: float = 0.3, min_child_weight: float = 1.0,
+                 reg_lambda: float = 1.0, seed: int = 7,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.num_round = num_round
+        self.max_depth = max_depth
+        self.eta = eta
+        self.min_child_weight = min_child_weight
+        self.reg_lambda = reg_lambda
+        self.seed = seed
+
+    def _family(self, n_classes):
+        return XGBoostFamily(
+            grid=[{"maxDepth": self.max_depth, "eta": self.eta,
+                   "minChildWeight": self.min_child_weight,
+                   "numRound": self.num_round}],
+            task=self.task, n_classes=n_classes,
+            reg_lambda=self.reg_lambda, seed=self.seed)
+
+
+@register_stage
+class OpXGBoostRegressor(OpXGBoostClassifier):
+    operation_name = "xgbRegressor"
+    task = "regression"
