@@ -1,0 +1,182 @@
+"""RawFeatureFilter tests (RawFeatureFilterTest analog)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, Workflow, column_from_values
+from transmogrifai_tpu.filters import (FeatureDistribution, RawFeatureFilter,
+                                       RawFeatureFilterResults, Summary)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def _features(names, response="label"):
+    label = FeatureBuilder.RealNN(response).from_column().as_response()
+    feats = {}
+    for name, kind in names.items():
+        builder = getattr(FeatureBuilder, kind)(name)
+        feats[name] = builder.from_column().as_predictor()
+    return label, feats
+
+
+def _basic_store(rng, n=400):
+    y = rng.integers(0, 2, size=n).astype(float)
+    age = rng.normal(40, 10, size=n)
+    mostly_null = np.where(rng.random(n) < 0.999, np.nan, 1.0)
+    leaky_null = np.where(y > 0, 1.0, np.nan)  # null iff label=0
+    text = np.array([rng.choice(["a", "b", "c"]) for _ in range(n)],
+                    dtype=object)
+    return ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "age": column_from_values(ft.Real, [None if np.isnan(v) else v for v in age]),
+        "mostly_null": column_from_values(
+            ft.Real, [None if np.isnan(v) else v for v in mostly_null]),
+        "leaky_null": column_from_values(
+            ft.Real, [None if np.isnan(v) else v for v in leaky_null]),
+        "word": column_from_values(ft.Text, list(text)),
+    })
+
+
+def test_distribution_monoid_and_metrics(rng):
+    vals = rng.normal(size=200)
+    col = column_from_values(ft.Real, list(vals))
+    from transmogrifai_tpu.filters.distribution import (
+        distributions_of_column, summaries_of_column)
+    summ = summaries_of_column("x", col)
+    (d,) = distributions_of_column("x", col, bins=20, summaries=summ)
+    assert d.count == 200 and d.nulls == 0
+    assert d.distribution.sum() == pytest.approx(200)
+    combined = d + d
+    assert combined.count == 400
+    assert combined.distribution.sum() == pytest.approx(400)
+    assert d.fill_rate() == 1.0
+    assert d.js_divergence(d) == pytest.approx(0.0, abs=1e-12)
+
+
+def test_summary_monoid():
+    s = Summary.of_values(np.array([1.0, 5.0])) + Summary.of_values(
+        np.array([-2.0]))
+    assert s.min == -2.0 and s.max == 5.0 and s.count == 3
+
+
+def test_filters_unfilled_and_leaky_nulls(rng):
+    store = _basic_store(rng)
+    label, feats = _features(
+        {"age": "Real", "mostly_null": "Real", "leaky_null": "Real",
+         "word": "Text"})
+    raw = [label] + list(feats.values())
+    rff = RawFeatureFilter(min_fill=0.10, max_correlation=0.9)
+    out = rff.filter_raw(store, raw)
+    bad = {f.name for f in out.blacklisted_features}
+    assert "mostly_null" in bad      # fill rate ~0.001 < 0.10
+    assert "leaky_null" in bad       # null indicator == 1 - label
+    assert "age" not in bad and "word" not in bad
+    assert "mostly_null" not in out.clean_store.names()
+    reasons = {(r.name): r for r in out.results.exclusion_reasons}
+    assert reasons["mostly_null"].training_unfilled_state
+    assert reasons["leaky_null"].training_null_label_leaker
+
+
+def test_js_divergence_detects_distribution_shift(rng):
+    n = 500
+    y = rng.integers(0, 2, size=n).astype(float)
+    train = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "x": column_from_values(ft.Real, list(rng.normal(0, 1, n))),
+        "shifted": column_from_values(ft.Real, list(rng.normal(0, 1, n))),
+    })
+    score = ColumnStore({
+        "x": column_from_values(ft.Real, list(rng.normal(0, 1, n))),
+        "shifted": column_from_values(ft.Real, list(rng.normal(50, 0.1, n))),
+    })
+    label, feats = _features({"x": "Real", "shifted": "Real"})
+    raw = [label] + list(feats.values())
+    rff = RawFeatureFilter(max_js_divergence=0.5)
+    out = rff.filter_raw(train, raw, scoring_data=score)
+    bad = {f.name for f in out.blacklisted_features}
+    assert "shifted" in bad and "x" not in bad
+    m = {r.name: r for r in out.results.metrics}
+    assert m["shifted"].js_divergence > 0.5
+    assert m["x"].js_divergence < 0.5
+
+
+def test_protected_features_never_removed(rng):
+    store = _basic_store(rng)
+    label, feats = _features(
+        {"age": "Real", "mostly_null": "Real", "leaky_null": "Real",
+         "word": "Text"})
+    raw = [label] + list(feats.values())
+    rff = RawFeatureFilter(min_fill=0.10, max_correlation=0.9,
+                           protected_features=["mostly_null", "leaky_null"])
+    out = rff.filter_raw(store, raw)
+    assert out.blacklisted_features == []
+
+
+def test_map_keys_filtered_individually(rng):
+    n = 300
+    y = rng.integers(0, 2, size=n).astype(float)
+    maps = []
+    for i in range(n):
+        d = {"good": float(rng.normal())}
+        if rng.random() < 0.02:
+            d["rare"] = 1.0
+        maps.append(d)
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "m": column_from_values(ft.RealMap, maps),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    m = FeatureBuilder.RealMap("m").from_column().as_predictor()
+    rff = RawFeatureFilter(min_fill=0.10)
+    out = rff.filter_raw(store, [label, m])
+    assert out.blacklisted_features == []          # map itself survives
+    assert out.blacklisted_map_keys.get("m") == ["rare"]
+    kept = out.clean_store["m"]
+    assert set(kept.children) == {"good"}
+
+
+def test_results_json_roundtrip(rng):
+    store = _basic_store(rng)
+    label, feats = _features(
+        {"age": "Real", "mostly_null": "Real", "word": "Text"})
+    raw = [label] + list(feats.values())
+    out = RawFeatureFilter(min_fill=0.10).filter_raw(store, raw)
+    d = out.results.to_json()
+    back = RawFeatureFilterResults.from_json(d)
+    assert back.config == out.results.config
+    assert len(back.metrics) == len(out.results.metrics)
+    assert back.exclusion_reasons[0].name == out.results.exclusion_reasons[0].name
+    assert np.allclose(back.training_distributions[0].distribution,
+                       out.results.training_distributions[0].distribution)
+
+
+def test_workflow_integration(rng):
+    """Workflow.with_raw_feature_filter drops blacklisted raw features before
+    fitting (OpWorkflow.scala:112-154 DAG rewiring analog)."""
+    n = 300
+    y = rng.integers(0, 2, size=n).astype(float)
+    x = rng.normal(size=n) + y
+    dead = [None] * n
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "x": column_from_values(ft.Real, list(x)),
+        "dead": column_from_values(ft.Real, dead),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    fdead = FeatureBuilder.Real("dead").from_column().as_predictor()
+
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import BinaryClassificationModelSelector
+    vec = transmogrify([fx, fdead])
+    pred = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()]) \
+        .set_input(label, vec).get_output()
+
+    wf = (Workflow()
+          .set_result_features(pred)
+          .set_input_store(store)
+          .with_raw_feature_filter(RawFeatureFilter(min_fill=0.10)))
+    model = wf.train()
+    assert {f.name for f in model.blacklisted_features} == {"dead"}
+    scores = model.score(store)
+    assert pred.name in scores.names()

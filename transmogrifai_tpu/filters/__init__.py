@@ -1,0 +1,10 @@
+"""Raw feature filtering — pre-DAG data-quality gate.
+
+Parity target: ``core/src/main/scala/com/salesforce/op/filters/``
+(``RawFeatureFilter.scala``, ``FeatureDistribution.scala``,
+``PreparedFeatures.scala``, ``RawFeatureFilterResults.scala``).
+"""
+from .distribution import FeatureDistribution, Summary  # noqa: F401
+from .raw_feature_filter import (  # noqa: F401
+    ExclusionReasons, FilteredRawData, RawFeatureFilter,
+    RawFeatureFilterMetrics, RawFeatureFilterResults)

@@ -148,6 +148,7 @@ class Workflow:
             keep = {f.uid for f in raw_features} - {
                 f.uid for f in self.blacklisted_features}
             raw_features = [f for f in raw_features if f.uid in keep]
+            self._rewire_blacklisted({f.uid for f in self.blacklisted_features})
 
         train_store, test_store = store, None
         if self.splitter is not None:
@@ -164,6 +165,34 @@ class Workflow:
             rff_results=rff_results,
             train_time_s=train_time,
         )
+
+    def _rewire_blacklisted(self, blacklisted_uids) -> None:
+        """Remove blacklisted raw features from downstream stage inputs
+        (OpWorkflow.scala:112-154). Variable-arity stages simply lose the
+        input; a fixed-arity stage that needs a blacklisted feature is an
+        error — the filter removed something essential."""
+        if not blacklisted_uids:
+            return
+        for f in self.result_features:
+            if any(r.uid in blacklisted_uids for r in (f,)):
+                raise WorkflowError(
+                    f"Result feature {f.name!r} was blacklisted by the "
+                    "RawFeatureFilter")
+        for layer in compute_dag(self.result_features, include_generators=True):
+            for stage in layer:
+                ins = stage.input_features
+                if not any(x.uid in blacklisted_uids for x in ins):
+                    continue
+                kept = tuple(x for x in ins if x.uid not in blacklisted_uids)
+                try:
+                    stage.input_spec.check(kept)
+                except TypeError as e:
+                    raise WorkflowError(
+                        f"Stage {stage.stage_name()} depends on blacklisted "
+                        f"feature(s) it cannot drop: "
+                        f"{[x.name for x in ins if x.uid in blacklisted_uids]}"
+                    ) from e
+                stage.input_features = kept  # keep output feature identity
 
     def _fit_dag(self, dag: StagesDAG, train: ColumnStore,
                  test: Optional[ColumnStore]
