@@ -1,0 +1,166 @@
+"""ModelInsights + RecordInsightsLOCO tests (ModelInsightsTest /
+RecordInsightsLOCOTest analogs)."""
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, Workflow, column_from_values
+from transmogrifai_tpu.columns import VectorColumn
+from transmogrifai_tpu.insights import (ModelInsights, RecordInsightsLOCO,
+                                        parse_insights)
+from transmogrifai_tpu.models.linear import (LogisticRegressionFamily,
+                                             LogisticRegressionModel)
+from transmogrifai_tpu.models.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.vector_metadata import (VectorColumnMetadata,
+                                               VectorMetadata)
+
+
+def _fitted_workflow(rng, n=300):
+    y = rng.integers(0, 2, size=n).astype(float)
+    strong = rng.normal(size=n) + 2.0 * y       # predictive
+    weak = rng.normal(size=n)                   # noise
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "strong": column_from_values(ft.Real, list(strong)),
+        "weak": column_from_values(ft.Real, list(weak)),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fs = FeatureBuilder.Real("strong").from_column().as_predictor()
+    fw = FeatureBuilder.Real("weak").from_column().as_predictor()
+    vec = transmogrify([fs, fw])
+    checker = SanityChecker(remove_bad_features=False)
+    checked = label.transform_with(checker, vec)
+    pred = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()]) \
+        .set_input(label, checked).get_output()
+    wf = Workflow().set_result_features(pred).set_input_store(store)
+    return wf.train(), store, pred
+
+
+def test_model_insights_extraction(rng):
+    model, store, pred = _fitted_workflow(rng)
+    ins = model.model_insights(pred, store=store)
+    assert ins.problem_type == "binary"
+    assert ins.label.name == "label"
+    assert ins.label.is_categorical and ins.label.sample_size == 300
+    assert ins.selected_model_info.get("bestModelName")
+    # derived columns grouped under raw parents, with corr + contribution
+    parents = {f.feature_name for f in ins.features}
+    assert {"strong", "weak"} <= parents
+    strong_cols = next(f for f in ins.features if f.feature_name == "strong")
+    d = strong_cols.derived[0]
+    assert d.corr_with_label is not None and abs(d.corr_with_label) > 0.3
+    assert d.contribution is not None and d.contribution > 0
+    # json + pretty render
+    j = ins.to_json()
+    assert json.dumps(j)  # serializable
+    text = ins.pretty()
+    assert "Best model" in text and "strong" in text
+
+
+def test_model_insights_without_store(rng):
+    model, store, pred = _fitted_workflow(rng)
+    ins = model.model_insights(pred)
+    assert ins.selected_model_info.get("bestModelName")
+    # stats harvested from the sanity checker even without data
+    all_derived = [d for f in ins.features for d in f.derived]
+    assert any(d.corr_with_label is not None for d in all_derived)
+
+
+def test_loco_identifies_important_column(rng):
+    n, d = 50, 4
+    X = rng.normal(size=(n, d))
+    coef = np.array([5.0, 0.0, 0.0, 0.1])
+    model = LogisticRegressionModel(coef, 0.0, 2)
+    meta = VectorMetadata("features", [
+        VectorColumnMetadata(f"x{i}", "Real") for i in range(d)])
+    store = ColumnStore({"features": VectorColumn(ft.OPVector, X, meta)})
+    feat = FeatureBuilder.OPVector("features").from_column().as_predictor()
+
+    loco = RecordInsightsLOCO(model=model, top_k=2)
+    loco.set_input(feat)
+    out = loco.transform_columns(store)
+    for i in range(n):
+        row = parse_insights(out.get_raw(i))
+        assert len(row) <= 2
+        top_name = max(row, key=lambda k: abs(row[k]))
+        assert top_name.startswith("x0")   # dominant coefficient wins
+        # sign consistency: diff = base - zeroed ⇒ matches x*coef sign
+        assert np.sign(row[top_name]) == np.sign(X[i, 0] * 5.0) or X[i, 0] == 0
+
+
+def test_loco_diffs_shape_and_zero_noop(rng):
+    n, d = 8, 3
+    X = np.zeros((n, d))
+    model = LogisticRegressionModel(np.ones(d), 0.0, 2)
+    loco = RecordInsightsLOCO(model=model)
+    diffs = loco.loco_diffs(X)
+    assert diffs.shape == (d, n)
+    assert np.allclose(diffs, 0.0)  # zeroing a zero column changes nothing
+
+
+def test_loco_end_to_end_on_workflow(rng):
+    model, store, pred = _fitted_workflow(rng)
+    selected = model.stage_of(pred)
+    vec_feature = selected.input_features[1]
+    scored = model.transform(store)
+    loco = RecordInsightsLOCO(model=selected, top_k=3)
+    loco.set_input(vec_feature)
+    out = loco.transform_columns(scored)
+    row = parse_insights(out.get_raw(0))
+    assert 0 < len(row) <= 3
+
+
+def test_insights_report_dropped_columns_with_meta(rng):
+    """Columns removed by SanityChecker(remove_bad_features=True) must still
+    appear in the report with their drop reasons."""
+    n = 300
+    y = rng.integers(0, 2, size=n).astype(float)
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "good": column_from_values(ft.Real, list(rng.normal(size=n) + y)),
+        "const": column_from_values(ft.Real, [3.0] * n),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fg = FeatureBuilder.Real("good").from_column().as_predictor()
+    fc = FeatureBuilder.Real("const").from_column().as_predictor()
+    vec = transmogrify([fg, fc])
+    checked = label.transform_with(
+        SanityChecker(remove_bad_features=True, remove_feature_group=False), vec)
+    pred = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()]) \
+        .set_input(label, checked).get_output()
+    model = Workflow().set_result_features(pred).set_input_store(store).train()
+    ins = model.model_insights(pred, store=store)
+    all_derived = [d for f in ins.features for d in f.derived]
+    dropped = [d for d in all_derived if d.dropped]
+    assert dropped, "dropped columns must appear in the report"
+    assert any("variance" in r for d in dropped for r in d.drop_reasons)
+
+
+def test_tree_contributions_use_real_splits(rng):
+    """Tree importances must count only real splits (finite thr), not the
+    feat=0 filler of non-split nodes."""
+    from transmogrifai_tpu.models.trees import OpDecisionTreeClassifier
+    n, d = 400, 4
+    X = rng.normal(size=(n, d))
+    y = (X[:, 3] > 0).astype(float)   # only feature 3 matters
+    from transmogrifai_tpu.vector_metadata import VectorColumnMetadata, VectorMetadata
+    meta = VectorMetadata("features", [
+        VectorColumnMetadata(f"x{i}", "Real") for i in range(d)])
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "features": VectorColumn(ft.OPVector, X, meta),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+    est = OpDecisionTreeClassifier(max_depth=4)
+    est.set_input(label, feats)
+    model = est.fit(store)
+    imp = ModelInsights._contributions(model)
+    assert imp is not None
+    assert int(np.argmax(imp)) == 3

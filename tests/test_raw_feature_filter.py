@@ -180,3 +180,24 @@ def test_workflow_integration(rng):
     assert {f.name for f in model.blacklisted_features} == {"dead"}
     scores = model.score(store)
     assert pred.name in scores.names()
+
+
+def test_predictor_missing_from_scoring_store_is_excluded(rng):
+    n = 200
+    y = rng.integers(0, 2, size=n).astype(float)
+    train = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "x": column_from_values(ft.Real, list(rng.normal(size=n))),
+        "gone": column_from_values(ft.Real, list(rng.normal(size=n))),
+    })
+    score = ColumnStore({  # 'gone' entirely absent at scoring time
+        "x": column_from_values(ft.Real, list(rng.normal(size=n))),
+    })
+    label, feats = _features({"x": "Real", "gone": "Real"})
+    raw = [label] + list(feats.values())
+    out = RawFeatureFilter(min_fill=0.10).filter_raw(
+        train, raw, scoring_data=score)
+    bad = {f.name for f in out.blacklisted_features}
+    assert "gone" in bad and "x" not in bad
+    r = {x.name: x for x in out.results.exclusion_reasons}
+    assert r["gone"].scoring_unfilled_state

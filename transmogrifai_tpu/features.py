@@ -24,7 +24,8 @@ from .utils import uid as uid_mod
 if TYPE_CHECKING:  # pragma: no cover
     from .stages.base import OpPipelineStage
 
-__all__ = ["Feature", "FeatureCycleError", "FeatureBuilder", "FeatureHistory"]
+__all__ = ["Feature", "FeatureCycleError", "FeatureBuilder", "FeatureHistory",
+           "copy_dag"]
 
 
 class FeatureCycleError(Exception):
@@ -258,3 +259,38 @@ class FeatureBuilder(metaclass=_FeatureBuilderMeta):
             predictors.append(
                 FeatureBuilder.of(col.ftype, name).from_column().as_predictor())
         return resp, predictors
+
+
+def copy_dag(result_features: Sequence[Feature],
+             drop_raw_uids: frozenset = frozenset()) -> List[Feature]:
+    """Deep-copy the derived part of a feature DAG
+    (``FeatureLike.copyWithNewStages``, FeatureLike.scala:456).
+
+    Raw features (and their generator stages) are shared, every derived
+    feature and its origin stage are copied, so rewiring the copy — e.g.
+    dropping blacklisted raw features from variable-arity stage inputs via
+    ``drop_raw_uids`` — never mutates the user-owned graph. Copies keep the
+    original uids, so fitted-stage lookup by uid still works.
+
+    Raises TypeError if a dropped feature is required by a fixed-arity stage.
+    """
+    memo: Dict[str, Feature] = {}
+
+    def go(f: Feature) -> Feature:
+        if f.uid in memo:
+            return memo[f.uid]
+        if f.is_raw:
+            memo[f.uid] = f
+            return f
+        new_parents = tuple(go(p) for p in f.parents
+                            if p.uid not in drop_raw_uids)
+        stage = f.origin_stage.copy()
+        stage.input_spec.check(new_parents)
+        stage.input_features = new_parents
+        nf = Feature(name=f.name, ftype=f.ftype, is_response=f.is_response,
+                     origin_stage=stage, parents=new_parents, uid=f.uid)
+        stage._output_feature = nf
+        memo[f.uid] = nf
+        return nf
+
+    return [go(f) for f in result_features]

@@ -13,7 +13,6 @@ reference uses so train/score text can be compared without a vocabulary).
 """
 from __future__ import annotations
 
-import zlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -22,18 +21,10 @@ import numpy as np
 from ..columns import (Column, GeoColumn, MapColumn, NumericColumn,
                        RaggedColumn, TextColumn, TextListColumn,
                        TextSetColumn, VectorColumn)
+from ..ops.hashing import hash_tokens
 
-__all__ = ["Summary", "FeatureDistribution", "text_hash_bin",
+__all__ = ["Summary", "FeatureDistribution",
            "summaries_of_column", "distributions_of_column"]
-
-
-def text_hash_bin(token: str, bins: int) -> int:
-    """Deterministic hash of a token into [0, bins).
-
-    crc32 here; the native murmur3 path (C++ data plane) can be swapped in —
-    determinism across processes is what matters for train/score comparison.
-    """
-    return zlib.crc32(token.encode("utf-8")) % bins
 
 
 @dataclass
@@ -235,8 +226,7 @@ def distributions_of_column(
     if toks is not None:
         hist = np.zeros(bins, dtype=np.float64)
         if toks:
-            idx = np.fromiter((text_hash_bin(t, bins) for t in toks),
-                              dtype=np.int64, count=len(toks))
+            idx = hash_tokens(toks).astype(np.int64) % bins
             np.add.at(hist, idx, 1.0)
         return [FeatureDistribution(name, key, n, int(nulls.sum()), hist,
                                     [float(bins)])]

@@ -182,16 +182,29 @@ class RawFeatureFilter:
         return out
 
     @staticmethod
-    def _label_vector(store: ColumnStore,
-                      responses: List[Feature]) -> Optional[np.ndarray]:
+    def _with_missing_as_null(store: ColumnStore,
+                              predictors: List[Feature]) -> ColumnStore:
+        """A predictor absent from a store counts as 100% null — missing at
+        scoring time must trip the unfilled/fill-diff gates, not bypass
+        them."""
+        from ..columns import column_of_empty
+        missing = {f.name: column_of_empty(f.ftype, store.n_rows)
+                   for f in predictors if f.name not in store}
+        return store.with_columns(missing) if missing else store
+
+    @staticmethod
+    def _label_vector(store: ColumnStore, responses: List[Feature]
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """(label values, present mask) — rows with null labels must be
+        excluded from the leakage correlation, not treated as label 0."""
         for f in responses:
             col = store.get(f.name)
             if isinstance(col, NumericColumn):
-                return col.values.astype(np.float64)
+                return col.values.astype(np.float64), col.mask.copy()
         return None
 
     def _null_label_corrs(self, store: ColumnStore, predictors: List[Feature],
-                          label: Optional[np.ndarray]
+                          label: Optional[Tuple[np.ndarray, np.ndarray]]
                           ) -> Dict[Tuple[str, Optional[str]], float]:
         """|corr(is-null, label)| for every (feature, key) — one matmul.
 
@@ -200,6 +213,7 @@ class RawFeatureFilter:
         """
         if label is None:
             return {}
+        label, label_mask = label
         keys: List[Tuple[str, Optional[str]]] = []
         indicators: List[np.ndarray] = []
         for f in predictors:
@@ -213,8 +227,11 @@ class RawFeatureFilter:
                 indicators.append(_null_mask(col).astype(np.float64))
         if not indicators:
             return {}
-        M = np.stack(indicators)                      # [d, n]
-        y = label - label.mean()
+        M = np.stack(indicators)[:, label_mask]       # [d, n_labeled]
+        labeled = label[label_mask]
+        if labeled.size == 0:
+            return {}
+        y = labeled - labeled.mean()
         Mc = M - M.mean(axis=1, keepdims=True)
         num = Mc @ y
         denom = np.sqrt((Mc * Mc).sum(axis=1) * (y * y).sum())
@@ -228,7 +245,10 @@ class RawFeatureFilter:
         predictors = [f for f in raw_features if not f.is_response]
         responses = [f for f in raw_features if f.is_response]
 
+        store = self._with_missing_as_null(store, predictors)
         score_store = self._scoring_store(scoring_data, raw_features, predictors)
+        if score_store is not None:
+            score_store = self._with_missing_as_null(score_store, predictors)
 
         # combined numeric summaries → shared bin edges for both splits
         summaries: Dict[Tuple[str, Optional[str]], Summary] = {}
@@ -237,10 +257,9 @@ class RawFeatureFilter:
                 summaries[k] = summaries.get(k, Summary()) + s
         if score_store is not None:
             for f in predictors:
-                if f.name in score_store:
-                    for k, s in summaries_of_column(
-                            f.name, score_store[f.name]).items():
-                        summaries[k] = summaries.get(k, Summary()) + s
+                for k, s in summaries_of_column(
+                        f.name, score_store[f.name]).items():
+                    summaries[k] = summaries.get(k, Summary()) + s
 
         train_dists = self._distributions(store, predictors, summaries)
         score_dists = (self._distributions(score_store, predictors, summaries)

@@ -1,0 +1,8 @@
+"""Model interpretability — workflow-level and per-record insights.
+
+Parity targets: ``core/.../ModelInsights.scala`` and
+``core/.../impl/insights/RecordInsightsLOCO.scala``.
+"""
+from .loco import RecordInsightsLOCO, parse_insights  # noqa: F401
+from .model_insights import (DerivedFeatureInsight, FeatureInsights,  # noqa: F401
+                             LabelSummary, ModelInsights)

@@ -29,7 +29,7 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 import numpy as np
 
 from .columns import Column, ColumnStore
-from .features import Feature
+from .features import Feature, copy_dag
 from .graph import StagesDAG, compute_dag
 from .stages.base import Estimator, FittedModel, OpPipelineStage, Transformer
 from .stages.generator import FeatureGeneratorStage
@@ -138,6 +138,7 @@ class Workflow:
             raise WorkflowError("No input data: call set_input_store/records/reader")
         store = _generate_raw_store(data, raw_features)
 
+        result_features = self.result_features
         rff_results = None
         if self.raw_feature_filter is not None:
             filtered = self.raw_feature_filter.filter_raw(
@@ -145,19 +146,33 @@ class Workflow:
             store = filtered.clean_store
             self.blacklisted_features = filtered.blacklisted_features
             rff_results = filtered.results
-            keep = {f.uid for f in raw_features} - {
-                f.uid for f in self.blacklisted_features}
-            raw_features = [f for f in raw_features if f.uid in keep]
-            self._rewire_blacklisted({f.uid for f in self.blacklisted_features})
+            blacklisted = {f.uid for f in self.blacklisted_features}
+            if blacklisted:
+                # Rebuild the DAG without blacklisted raw features on a COPY
+                # (copyWithNewStages) so the user-owned graph is untouched
+                # (OpWorkflow.scala:112-154).
+                for f in result_features:
+                    if f.uid in blacklisted:
+                        raise WorkflowError(
+                            f"Result feature {f.name!r} was blacklisted by "
+                            "the RawFeatureFilter")
+                try:
+                    result_features = tuple(copy_dag(
+                        result_features, frozenset(blacklisted)))
+                except TypeError as e:
+                    raise WorkflowError(
+                        "A fixed-arity stage depends on blacklisted "
+                        f"feature(s): {e}") from e
+            raw_features = [f for f in raw_features if f.uid not in blacklisted]
 
         train_store, test_store = store, None
         if self.splitter is not None:
             train_store, test_store = self.splitter.reserve_split(store)
 
-        dag = compute_dag(self.result_features)
+        dag = compute_dag(result_features)
         fitted, train_time = self._fit_dag(dag, train_store, test_store)
         return WorkflowModel(
-            result_features=self.result_features,
+            result_features=result_features,
             fitted_stages=fitted,
             dag=dag,
             parameters=self.parameters,
@@ -165,34 +180,6 @@ class Workflow:
             rff_results=rff_results,
             train_time_s=train_time,
         )
-
-    def _rewire_blacklisted(self, blacklisted_uids) -> None:
-        """Remove blacklisted raw features from downstream stage inputs
-        (OpWorkflow.scala:112-154). Variable-arity stages simply lose the
-        input; a fixed-arity stage that needs a blacklisted feature is an
-        error — the filter removed something essential."""
-        if not blacklisted_uids:
-            return
-        for f in self.result_features:
-            if any(r.uid in blacklisted_uids for r in (f,)):
-                raise WorkflowError(
-                    f"Result feature {f.name!r} was blacklisted by the "
-                    "RawFeatureFilter")
-        for layer in compute_dag(self.result_features, include_generators=True):
-            for stage in layer:
-                ins = stage.input_features
-                if not any(x.uid in blacklisted_uids for x in ins):
-                    continue
-                kept = tuple(x for x in ins if x.uid not in blacklisted_uids)
-                try:
-                    stage.input_spec.check(kept)
-                except TypeError as e:
-                    raise WorkflowError(
-                        f"Stage {stage.stage_name()} depends on blacklisted "
-                        f"feature(s) it cannot drop: "
-                        f"{[x.name for x in ins if x.uid in blacklisted_uids]}"
-                    ) from e
-                stage.input_features = kept  # keep output feature identity
 
     def _fit_dag(self, dag: StagesDAG, train: ColumnStore,
                  test: Optional[ColumnStore]
@@ -311,6 +298,12 @@ class WorkflowModel:
             return {n: acc[n] for n in result_names if n in acc}
 
         return score_row
+
+    def model_insights(self, pred_feature: Optional[Feature] = None,
+                       store: Optional[ColumnStore] = None):
+        """Interpretability report (OpWorkflowModel.modelInsights :163-176)."""
+        from .insights import ModelInsights
+        return ModelInsights.extract(self, pred_feature, store)
 
     # -- persistence (OpWorkflowModelWriter/Reader) ------------------------
     def save(self, path: str, overwrite: bool = False) -> None:
