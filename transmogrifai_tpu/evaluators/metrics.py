@@ -118,3 +118,71 @@ def regression_metrics(labels: np.ndarray, predictions: np.ndarray
     r2 = 1.0 - mse / var if var > 0 else 0.0
     return {"RootMeanSquaredError": float(np.sqrt(mse)),
             "MeanSquaredError": mse, "MeanAbsoluteError": mae, "R2": r2}
+
+
+def binary_threshold_curves(labels: np.ndarray, scores: np.ndarray,
+                            max_points: int = 200) -> Dict[str, list]:
+    """Threshold curves (BinaryClassificationMetrics parity): thresholds +
+    precision/recall/TPR/FPR by threshold, downsampled to ≤ max_points."""
+    labels = np.asarray(labels, dtype=np.float64)
+    scores = np.asarray(scores, dtype=np.float64)
+    if len(labels) == 0:
+        return {"thresholds": [], "precisionByThreshold": [],
+                "recallByThreshold": [], "falsePositiveRateByThreshold": []}
+    tp, fp, p, n = _curve_points(labels, scores)
+    order = np.argsort(-scores, kind="stable")
+    s = scores[order]
+    idx = np.concatenate([np.nonzero(np.diff(s))[0], [len(s) - 1]])
+    thresholds = s[idx]
+    precision = tp / np.maximum(tp + fp, 1e-12)
+    recall = tp / max(p, 1e-12)
+    fpr = fp / max(n, 1e-12)
+    if thresholds.size > max_points:
+        pick = np.linspace(0, thresholds.size - 1, max_points).astype(int)
+        thresholds, precision, recall, fpr = (
+            thresholds[pick], precision[pick], recall[pick], fpr[pick])
+    return {"thresholds": thresholds.tolist(),
+            "precisionByThreshold": precision.tolist(),
+            "recallByThreshold": recall.tolist(),
+            "falsePositiveRateByThreshold": fpr.tolist()}
+
+
+def multiclass_threshold_metrics(labels: np.ndarray, probabilities: np.ndarray,
+                                 top_ns: Tuple[int, ...] = (1, 3),
+                                 n_thresholds: int = 100) -> Dict[str, object]:
+    """Top-N threshold metrics (OpMultiClassificationEvaluator
+    ``calculateThresholdMetrics`` :154): for each topN and confidence
+    threshold t, counts of rows whose max prob ≥ t that are correct
+    (true label within the top-N scored classes), incorrect, and rows
+    below t (no prediction). Vectorized: one argsort + histogram per topN."""
+    labels = np.asarray(labels).astype(np.int64)
+    probs = np.asarray(probabilities, dtype=np.float64)
+    thresholds = np.linspace(0.0, 1.0, n_thresholds + 1)
+    out: Dict[str, object] = {"topNs": list(top_ns),
+                              "thresholds": thresholds.tolist(),
+                              "correctCounts": {}, "incorrectCounts": {},
+                              "noPredictionCounts": {}}
+    if probs.size == 0:
+        for k in top_ns:
+            out["correctCounts"][k] = [0] * (n_thresholds + 1)
+            out["incorrectCounts"][k] = [0] * (n_thresholds + 1)
+            out["noPredictionCounts"][k] = [0] * (n_thresholds + 1)
+        return out
+    max_prob = probs.max(axis=1)
+    rank_order = np.argsort(-probs, axis=1)           # [n, K]
+    n_rows = len(labels)
+    # bin index of each row's max prob: row predicted for thresholds ≤ bin
+    bins = np.clip(np.searchsorted(thresholds, max_prob, side="right") - 1,
+                   0, n_thresholds)
+    for k in top_ns:
+        in_topk = (rank_order[:, :min(k, probs.shape[1])]
+                   == labels[:, None]).any(axis=1)
+        cor = np.bincount(bins[in_topk], minlength=n_thresholds + 1)
+        inc = np.bincount(bins[~in_topk], minlength=n_thresholds + 1)
+        # cumulative from the top: predicted at threshold t ⇔ bin ≥ t
+        cor_at = np.cumsum(cor[::-1])[::-1]
+        inc_at = np.cumsum(inc[::-1])[::-1]
+        out["correctCounts"][k] = cor_at.tolist()
+        out["incorrectCounts"][k] = inc_at.tolist()
+        out["noPredictionCounts"][k] = (n_rows - cor_at - inc_at).tolist()
+    return out
