@@ -1,0 +1,113 @@
+"""Minimal XSpace (jax.profiler xplane.pb) parser: prints top TPU ops by
+self-time. No tensorflow/tensorboard dependency — raw protobuf wire decode.
+
+Usage: python tools/xplane_top_ops.py /tmp/jaxtrace [N]
+"""
+import glob
+import sys
+
+
+def _varint(buf, i):
+    r = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, i
+        s += 7
+
+
+def fields(buf):
+    """Yield (field_no, wire_type, value) over a protobuf message."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        else:
+            raise ValueError(f"wire type {wt}")
+        yield fno, wt, v
+
+
+def parse(path, topn=20):
+    xs = open(path, "rb").read()
+    for fno, _wt, plane in fields(xs):
+        if fno != 1:
+            continue
+        name = b""
+        lines = []
+        emeta = {}
+        for pf, _, pv in fields(plane):
+            if pf == 2:
+                name = pv
+            elif pf == 3:
+                lines.append(pv)
+            elif pf == 4:   # map entry: key=1 varint, value=2 XEventMetadata
+                k = None
+                v = b""
+                for mf, _, mv in fields(pv):
+                    if mf == 1:
+                        k = mv
+                    elif mf == 2:
+                        v = mv
+                mname = b""
+                for ef, _, ev in fields(v):
+                    if ef == 2:
+                        mname = ev
+                emeta[k] = mname.decode(errors="replace")
+        nm = name.decode(errors="replace")
+        if "TPU" not in nm and "/device" not in nm:
+            continue
+        agg = {}
+        total = 0
+        for line in lines:
+            lname = b""
+            events = []
+            for lf, _, lv in fields(line):
+                if lf == 2:
+                    lname = lv
+                elif lf == 6:
+                    events.append(lv)
+            if b"XLA Ops" not in lname:
+                continue
+            for ev in events:
+                mid = dur = occ = 0
+                for ef, _, evv in fields(ev):
+                    if ef == 1:
+                        mid = evv
+                    elif ef == 3:
+                        dur = evv
+                    elif ef == 5:
+                        occ = evv
+                d = dur * max(occ, 1)
+                agg[emeta.get(mid, str(mid))] = \
+                    agg.get(emeta.get(mid, str(mid)), 0) + d
+                total += d
+        if not agg:
+            continue
+        print(f"== plane {nm}  total {total/1e9:.1f} ms (XLA Ops self-time)")
+        for op, t in sorted(agg.items(), key=lambda kv: -kv[1])[:topn]:
+            print(f"  {t/total*100:5.1f}%  {t/1e9:9.2f}ms  {op[:95]}")
+
+
+if __name__ == "__main__":
+    root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/jaxtrace"
+    topn = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    paths = sorted(glob.glob(root + "/plugins/profile/*/*.xplane.pb"))
+    if not paths:
+        sys.exit(f"no xplane.pb under {root}")
+    parse(paths[-1], topn)

@@ -1,0 +1,183 @@
+"""On-device (JAX) metric kernels for the CV sweep.
+
+The round-1 validator pulled every (fold × grid) prediction back to host
+and ran numpy AuPR per cell (O(grid × folds) host sorts —
+``models/tuning.py`` r1). Here the selection metric rides the device: one
+jitted program per family computes fit → predict → metric and returns just
+a [folds, grid] metric matrix, so predictions never leave HBM.
+
+Semantics match ``evaluators/metrics.py`` (MLlib threshold curves): ties
+are grouped per distinct score, ROC gets (0,0)/(1,1) endpoints, PR is
+prepended with (0, p@first). Validation rows are selected by a 0/1 weight
+vector instead of boolean indexing (static shapes): zero-weight rows
+contribute nothing to the cumulative TP/FP counts — they only add
+duplicate curve points, which have zero trapezoid width.
+
+Reference: ``core/.../evaluators/OpBinaryClassificationEvaluator.scala:180-203``,
+``OpCrossValidation.scala:56-69`` (fold-metric averaging).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["device_metric_fn", "DEVICE_METRICS"]
+
+_EPS = 1e-12
+
+
+def _curve(y, s, w):
+    """Weighted cumulative (tp, fp) at each sorted position, tie-grouped.
+
+    Returns (tp, fp, P, N) where tp/fp are [n] cumulative counts evaluated
+    at each position's tie-group END (MLlib's distinct-threshold curve,
+    with harmless duplicate points inside tie groups).
+    """
+    order = jnp.argsort(-s)
+    ys = y[order] * w[order]
+    ws = w[order]
+    ss = s[order]
+    tp = jnp.cumsum(ys)
+    fp = jnp.cumsum(ws - ys)
+    # group end: index of the last element equal to ss[i] in the sort
+    end_idx = jnp.searchsorted(-ss, -ss, side="right") - 1
+    tp = tp[end_idx]
+    fp = fp[end_idx]
+    P = jnp.sum(y * w)
+    N = jnp.sum(w) - P
+    return tp, fp, P, N
+
+
+def _trapz(yv, xv):
+    return 0.5 * jnp.sum((xv[1:] - xv[:-1]) * (yv[1:] + yv[:-1]))
+
+
+def auroc(y, s, w):
+    tp, fp, P, N = _curve(y, s, w)
+    tpr = jnp.concatenate([jnp.zeros((1,)), tp / jnp.maximum(P, _EPS),
+                           jnp.ones((1,))])
+    fpr = jnp.concatenate([jnp.zeros((1,)), fp / jnp.maximum(N, _EPS),
+                           jnp.ones((1,))])
+    return jnp.where((P > 0) & (N > 0), _trapz(tpr, fpr), 0.0)
+
+
+def aupr(y, s, w):
+    tp, fp, P, _ = _curve(y, s, w)
+    precision = tp / jnp.maximum(tp + fp, _EPS)
+    recall = tp / jnp.maximum(P, _EPS)
+    precision = jnp.concatenate([precision[:1], precision])
+    recall = jnp.concatenate([jnp.zeros((1,)), recall])
+    return jnp.where(P > 0, _trapz(precision, recall), 0.0)
+
+
+def _binary_confusion(y, pred, w):
+    tp = jnp.sum(w * ((pred == 1) & (y == 1)))
+    tn = jnp.sum(w * ((pred == 0) & (y == 0)))
+    fp = jnp.sum(w * ((pred == 1) & (y == 0)))
+    fn = jnp.sum(w * ((pred == 0) & (y == 1)))
+    return tp, tn, fp, fn
+
+
+def binary_precision(y, pred, w):
+    tp, _, fp, _ = _binary_confusion(y, pred, w)
+    return tp / jnp.maximum(tp + fp, _EPS)
+
+
+def binary_recall(y, pred, w):
+    tp, _, _, fn = _binary_confusion(y, pred, w)
+    return tp / jnp.maximum(tp + fn, _EPS)
+
+
+def binary_f1(y, pred, w):
+    p = binary_precision(y, pred, w)
+    r = binary_recall(y, pred, w)
+    return 2.0 * p * r / jnp.maximum(p + r, _EPS)
+
+
+def binary_error(y, pred, w):
+    return jnp.sum(w * (pred != y)) / jnp.maximum(jnp.sum(w), _EPS)
+
+
+def multiclass_weighted(y, pred, w, n_classes: int, which: str):
+    """Weighted Precision/Recall/F1 over ``n_classes`` (MulticlassMetrics)."""
+    yi = y.astype(jnp.int32)
+    pi = pred.astype(jnp.int32)
+    oh_y = (jnp.arange(n_classes)[None, :] == yi[:, None]) * w[:, None]
+    oh_p = (jnp.arange(n_classes)[None, :] == pi[:, None]) * w[:, None]
+    conf = oh_y.T @ (jnp.arange(n_classes)[None, :]
+                     == pi[:, None]).astype(w.dtype)   # [true, pred]
+    tp = jnp.diagonal(conf)
+    per_true = oh_y.sum(0)            # class weight numerators
+    per_pred = oh_p.sum(0)
+    prec = tp / jnp.maximum(per_pred, _EPS)
+    rec = tp / jnp.maximum(per_true, _EPS)
+    f1 = 2.0 * prec * rec / jnp.maximum(prec + rec, _EPS)
+    cw = per_true / jnp.maximum(jnp.sum(w), _EPS)
+    vals = {"Precision": prec, "Recall": rec, "F1": f1}[which]
+    return jnp.sum(cw * vals)
+
+
+def multiclass_error(y, pred, w):
+    return jnp.sum(w * (pred != y)) / jnp.maximum(jnp.sum(w), _EPS)
+
+
+def rmse(y, pred, w):
+    W = jnp.maximum(jnp.sum(w), _EPS)
+    return jnp.sqrt(jnp.sum(w * (y - pred) ** 2) / W)
+
+
+def mse(y, pred, w):
+    return jnp.sum(w * (y - pred) ** 2) / jnp.maximum(jnp.sum(w), _EPS)
+
+
+def mae(y, pred, w):
+    return jnp.sum(w * jnp.abs(y - pred)) / jnp.maximum(jnp.sum(w), _EPS)
+
+
+def r2(y, pred, w):
+    W = jnp.maximum(jnp.sum(w), _EPS)
+    mean = jnp.sum(w * y) / W
+    var = jnp.sum(w * (y - mean) ** 2) / W
+    m = jnp.sum(w * (y - pred) ** 2) / W
+    return jnp.where(var > 0, 1.0 - m / var, 0.0)
+
+
+#: (task, metric name) → callable; signature depends on the metric kind
+DEVICE_METRICS = {
+    ("binary", "AuROC"): ("score", auroc),
+    ("binary", "AuPR"): ("score", aupr),
+    ("binary", "Precision"): ("pred", binary_precision),
+    ("binary", "Recall"): ("pred", binary_recall),
+    ("binary", "F1"): ("pred", binary_f1),
+    ("binary", "Error"): ("pred", binary_error),
+    ("multiclass", "Error"): ("pred", multiclass_error),
+    ("regression", "RootMeanSquaredError"): ("pred", rmse),
+    ("regression", "MeanSquaredError"): ("pred", mse),
+    ("regression", "MeanAbsoluteError"): ("pred", mae),
+    ("regression", "R2"): ("pred", r2),
+}
+
+
+def device_metric_fn(task: str, metric_name: str, n_classes: int = 2):
+    """→ fn(y, pred, prob, w) → scalar, or None if not device-supported.
+
+    ``prob`` may be [n, k] class probabilities or an empty [n, 0] array
+    (regression); binary score metrics use prob[:, 1] when available,
+    falling back to ``pred``.
+    """
+    if task == "multiclass" and metric_name in ("Precision", "Recall", "F1"):
+        def mc(y, pred, prob, w):
+            return multiclass_weighted(y, pred, w, n_classes, metric_name)
+        return mc
+    entry = DEVICE_METRICS.get((task, metric_name))
+    if entry is None:
+        return None
+    kind, fn = entry
+    if kind == "score":
+        def scored(y, pred, prob, w):
+            s = prob[:, 1] if (prob.ndim == 2 and prob.shape[1] >= 2) else pred
+            return fn(y, s, w)
+        return scored
+
+    def predded(y, pred, prob, w):
+        return fn(y, pred, w)
+    return predded

@@ -9,17 +9,22 @@ Design (SURVEY §7 step 8): **static shapes everywhere** so the whole
 * Features are quantile-binned once per fit (``n_bins=32``, Spark's
   ``maxBins`` default) — binning depends only on X, so under a fold-vmap
   XLA computes it once.
-* A tree is grown **level-wise** to a static ``max_depth``: every sample
-  carries a node index in [0, 2^d); per level one ``segment_sum`` builds the
-  [nodes, features, bins, channels] histogram (Rabit's allreduce becomes a
-  ``psum`` when the batch axis is sharded), a cumulative sum over bins
-  scores every (feature, threshold) candidate, and an argmax picks the
+* A tree is grown **level-wise** under one ``lax.scan`` over levels: every
+  sample carries a node index in [0, 2^d); per level one batched matmul
+  builds the [slots, features, bins, channels] histogram (Rabit's allreduce
+  becomes a ``psum`` when the batch axis is sharded), a cumulative sum over
+  bins scores every (feature, threshold) candidate, and an argmax picks the
   split. Nodes that stop splitting route all samples left via a dummy
   (+inf threshold) split, so the fixed-depth routing stays correct.
+* The scan keeps a **constant active-slot count** per level, so the level
+  body has one shape and is traced/compiled once — the round-1 design
+  unrolled the level loop in Python, which made XLA compile minutes of HLO
+  per tree family (the round-1 bench spent 100+s compiling).
 * Hyperparameters that only gate values (minInstancesPerNode, minInfoGain,
-  eta, minChildWeight, numTrees/numRound, subsample rate) are *traced*
-  scalars → they can vary inside one vmapped grid. Only ``maxDepth`` is
-  structural; families group grid points by it (models/trees.py).
+  eta, minChildWeight, numTrees/numRound, subsample rate, **and maxDepth**)
+  are *traced* scalars → the whole grid vmaps into ONE program per family.
+  ``maxDepth`` gates splitting per level (``level < depth_limit``); the
+  static scan length is the grid's max depth.
 * Ensembles run under ``lax.scan`` (bounded memory; XLA pipelines the
   per-tree work); RF bootstraps with Poisson(subsample) weights.
 
@@ -60,18 +65,58 @@ def binarize(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# Split criteria: (total, left, right) [-1 channel is raw count] → gain
+# Split criteria.
+#
+# A criterion exposes two views of its impurity gain:
+#
+# * ``score(cum)`` — a cheap per-candidate statistic over the CUMULATIVE
+#   histogram [A, C, bins, F] (channel axis 1) that is MONOTONE in the true
+#   gain within a node, used only for the argmax. Because the node's own
+#   impurity and total mass are constant across the node's (feature, bin)
+#   candidates, the expensive normalization terms drop out — the full-gain
+#   formula took ~10 elementwise passes over [A, B-1, F] tensors per level
+#   (~half the CV sweep's device time); the score takes ~2.
+# * ``gain(l, t)`` — the EXACT reference gain, evaluated only at the
+#   winning candidate's [A, C] left/total stats (for the minInfoGain stop
+#   rule, Spark/XGBoost parity).
+#
+# Channel-major layout note: with row-major (minor = last) layouts a
+# channels-last [A, F, B, C] tensor puts C=3..5 in the TPU lane dimension,
+# which the (8, 128) tiling pads to 128 lanes — a ~30-40× physical blowup.
+# Channel-major keeps F (≥100) minor, so tensors stay dense. Leaf fns take
+# channels-LAST [nodes, C] summaries (tiny, built by one matmul).
 # ---------------------------------------------------------------------------
 
-def variance_split(total, left, right):
-    """Spark Variance impurity gain: imp(P) − wL/W·imp(L) − wR/W·imp(R).
-    Channels: (w, w·y, w·y², count)."""
-    def imp(s):
-        w = jnp.maximum(s[..., 0], _EPS)
-        return s[..., 2] / w - (s[..., 1] / w) ** 2
-    W = jnp.maximum(total[..., 0], _EPS)
-    return imp(total) - (left[..., 0] / W) * imp(left) \
-        - (right[..., 0] / W) * imp(right)
+class VarianceCriterion:
+    """Spark Variance impurity. Channels: (w, w·y, w·y², count).
+
+    gain = imp(P) − wL/W·imp(L) − wR/W·imp(R) with imp = E[y²] − E[y]²
+         = imp(P) − Σ(w·y²)/W + [sL²/wL + sR²/wR]/W,
+    so argmax(gain) = argmax(sL²/wL + sR²/wR) within a node.
+    """
+
+    def score(self, cum):
+        sL = cum[:, 1, :-1, :]
+        wL = cum[:, 0, :-1, :]
+        sT = cum[:, 1, -1:, :]
+        wT = cum[:, 0, -1:, :]
+        sR = sT - sL
+        wR = wT - wL
+        return sL * sL / jnp.maximum(wL, _EPS) \
+            + sR * sR / jnp.maximum(wR, _EPS)
+
+    def extra_ok(self, cum):
+        return None
+
+    def gain(self, l, t):
+        def imp(w, s1, s2):
+            w = jnp.maximum(w, _EPS)
+            return s2 / w - (s1 / w) ** 2
+        W = jnp.maximum(t[:, 0], _EPS)
+        wL, wR = l[:, 0], t[:, 0] - l[:, 0]
+        return imp(t[:, 0], t[:, 1], t[:, 2]) \
+            - (wL / W) * imp(wL, l[:, 1], l[:, 2]) \
+            - (wR / W) * imp(wR, t[:, 1] - l[:, 1], t[:, 2] - l[:, 2])
 
 
 def variance_leaf(s):
@@ -79,17 +124,39 @@ def variance_leaf(s):
     return (s[..., 1] / jnp.maximum(s[..., 0], _EPS))[..., None]
 
 
-def gini_split(total, left, right):
-    """Spark Gini gain. Channels: (per-class weight … , count)."""
-    def imp(s):
-        cls = s[..., :-1]
-        w = jnp.maximum(cls.sum(-1), _EPS)
-        p = cls / w[..., None]
-        return 1.0 - (p * p).sum(-1)
-    W = jnp.maximum(total[..., :-1].sum(-1), _EPS)
-    wl = left[..., :-1].sum(-1)
-    wr = right[..., :-1].sum(-1)
-    return imp(total) - (wl / W) * imp(left) - (wr / W) * imp(right)
+class GiniCriterion:
+    """Spark Gini impurity. Channels: (per-class weight …, count).
+
+    gain = imp(P) − wL/W·imp(L) − wR/W·imp(R) with imp = 1 − Σ p²
+         = imp(P) − 1 + [Σc lc²/wL + Σc rc²/wR]/W,
+    so argmax(gain) = argmax(Σ lc²/wL + Σ rc²/wR) within a node.
+    """
+
+    def score(self, cum):
+        cls_l = cum[:, :-1, :-1, :]                   # [A, K, B-1, F]
+        cls_t = cum[:, :-1, -1:, :]
+        cls_r = cls_t - cls_l
+        wL = cls_l.sum(1)
+        wR = cls_r.sum(1)
+        return (cls_l * cls_l).sum(1) / jnp.maximum(wL, _EPS) \
+            + (cls_r * cls_r).sum(1) / jnp.maximum(wR, _EPS)
+
+    def extra_ok(self, cum):
+        return None
+
+    def gain(self, l, t):
+        cls_l = l[:, :-1]
+        cls_t = t[:, :-1]
+        cls_r = cls_t - cls_l
+
+        def imp(cls):
+            w = jnp.maximum(cls.sum(1), _EPS)
+            return 1.0 - (cls * cls).sum(1) / (w * w), w
+        iT, W = imp(cls_t)
+        iL, wL = imp(cls_l)
+        iR, wR = imp(cls_r)
+        W = jnp.maximum(W, _EPS)
+        return iT - (wL / W) * iL - (wR / W) * iR
 
 
 def gini_leaf(s):
@@ -98,17 +165,36 @@ def gini_leaf(s):
     return cls / jnp.maximum(cls.sum(-1, keepdims=True), _EPS)
 
 
-def make_xgb_split(lam, min_child_weight):
+class XGBCriterion:
     """XGBoost gain: ½(G_L²/(H_L+λ) + G_R²/(H_R+λ) − G²/(H+λ)).
     Channels: (g, h, count). min_child_weight masks on hessian mass."""
-    def split(total, left, right):
-        def score(s):
-            return s[..., 0] ** 2 / (s[..., 1] + lam + _EPS)
-        gain = 0.5 * (score(left) + score(right) - score(total))
-        ok = (left[..., 1] >= min_child_weight) & \
-             (right[..., 1] >= min_child_weight)
-        return jnp.where(ok, gain, _NEG)
-    return split
+
+    def __init__(self, lam, min_child_weight):
+        self.lam = lam
+        self.min_child_weight = min_child_weight
+
+    def score(self, cum):
+        gL = cum[:, 0, :-1, :]
+        hL = cum[:, 1, :-1, :]
+        gT = cum[:, 0, -1:, :]
+        hT = cum[:, 1, -1:, :]
+        gR = gT - gL
+        hR = hT - hL
+        return gL * gL / (hL + self.lam + _EPS) \
+            + gR * gR / (hR + self.lam + _EPS)
+
+    def extra_ok(self, cum):
+        hL = cum[:, 1, :-1, :]
+        hT = cum[:, 1, -1:, :]
+        return (hL >= self.min_child_weight) & \
+            (hT - hL >= self.min_child_weight)
+
+    def gain(self, l, t):
+        def s(g, h):
+            return g * g / (h + self.lam + _EPS)
+        return 0.5 * (s(l[:, 0], l[:, 1])
+                      + s(t[:, 0] - l[:, 0], t[:, 1] - l[:, 1])
+                      - s(t[:, 0], t[:, 1]))
 
 
 def make_xgb_leaf(lam):
@@ -121,154 +207,259 @@ def make_xgb_leaf(lam):
 # Level-wise tree growing
 # ---------------------------------------------------------------------------
 
-def _level_hist(stats, node, Xb, n_nodes, n_bins, feature_chunk: int = 128):
-    """[n, C] sample stats → [n_nodes, F, n_bins, C] histograms.
+def _level_cumhist(stats, node, Xb, n_nodes, n_bins,
+                   feature_chunk: int = 512):
+    """[n, C] sample stats → [n_nodes, C, n_bins, F] CUMULATIVE histograms.
 
-    hist[s,f,b,c] = Σ_i 1[node_i=s]·1[Xb_if=b]·stats_ic, computed as one
-    MXU matmul per feature chunk: (one_hot(node) ⊗ stats)ᵀ @ one_hot(bins).
-    A vmapped segment_sum here would materialize the full [F, n, S] one-hot
-    scatter in HBM (28 GB at Titanic scale under the fold×grid vmaps);
-    chunking bounds the peak at n·chunk·B floats, and the chunk loop is a
-    lax.map, which stays sequential under outer vmaps.
+    cum[s,c,t,f] = Σ_i 1[node_i=s]·1[Xb_if ≤ t]·stats_ic, computed as one
+    MXU matmul per feature chunk: (one_hot(node) ⊗ stats)ᵀ @ tri(bins) —
+    the bins operand is the lower-triangular "bin ≤ t" indicator, so the
+    matmul emits left-cumulative sums directly and no separate cumsum pass
+    over the [A, C, B, F] tensor is needed (that pass was ~8% of the CV
+    sweep). A vmapped segment_sum would materialize a [F, n, S] one-hot
+    scatter in HBM; chunking bounds the peak at n·chunk·B floats. Output is
+    channel-major (see split-criteria note) so the feature axis stays in
+    the TPU lane dimension, and the (t, f)-major column order means the
+    matmul output reshapes straight to [A, C, B, Fc] with no transpose.
     """
     n, F = Xb.shape
     C = stats.shape[1]
+    # f32 matmuls run at a fraction of MXU bf16 throughput; bf16 operands
+    # with f32 accumulation keep COUNT channels exact (sums of exact 1.0s
+    # in an f32 accumulator) and only add ~1e-3 relative rounding to the
+    # weighted stat channels. The f64 (CPU test) path stays exact.
+    mm_dtype = jnp.bfloat16 if stats.dtype == jnp.float32 else stats.dtype
     NS = (jax.nn.one_hot(node, n_nodes, dtype=stats.dtype)[:, :, None]
-          * stats[:, None, :]).reshape(n, n_nodes * C)
-    Fc = min(feature_chunk, F)
-    n_chunks = -(-F // Fc)
-    pad = n_chunks * Fc - F
-    Xp = jnp.pad(Xb, ((0, 0), (0, pad)))
-    chunks = Xp.reshape(n, n_chunks, Fc).transpose(1, 0, 2)   # [nc, n, Fc]
-
-    def chunk_hist(Xc):
-        Bh = jax.nn.one_hot(Xc, n_bins,
-                            dtype=stats.dtype).reshape(n, Fc * n_bins)
-        h = NS.T @ Bh                                  # [nodes*C, Fc*B]
-        return h.reshape(n_nodes, C, Fc, n_bins).transpose(0, 2, 3, 1)
-
-    hist = jax.lax.map(chunk_hist, chunks)             # [nc, nodes, Fc, B, C]
-    hist = hist.transpose(1, 0, 2, 3, 4).reshape(
-        n_nodes, n_chunks * Fc, n_bins, C)
-    return hist[:, :F]
+          * stats[:, None, :]).reshape(n, n_nodes * C).astype(mm_dtype)
+    bins_iota = jnp.arange(n_bins, dtype=Xb.dtype)
+    outs = []
+    for f0 in range(0, F, feature_chunk):
+        f1 = min(f0 + feature_chunk, F)
+        Bc = (Xb[:, None, f0:f1] <= bins_iota[None, :, None]
+              ).astype(mm_dtype).reshape(n, n_bins * (f1 - f0))
+        h = jnp.matmul(NS.T, Bc,
+                       preferred_element_type=stats.dtype)
+        outs.append(h.reshape(n_nodes, C, n_bins, f1 - f0))
+    if len(outs) == 1:
+        return outs[0]
+    return jnp.concatenate(outs, axis=3)               # [A, C, B, F]
 
 
 def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
-              split_fn: Callable, leaf_fn: Callable, max_depth: int,
+              crit, leaf_fn: Callable, max_depth: int,
               n_bins: int, min_instances, min_info_gain,
-              feat_mask=None, max_active_nodes: int = 128
+              depth_limit=None, feat_mask=None, max_active_nodes: int = 128,
+              col_blocks=None
               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Grow one tree level-wise; returns (feat [2^D−1], thr [2^D−1],
     leaf [2^D, K], node [n] final sample→leaf assignment).
 
-    ``min_instances`` / ``min_info_gain`` may be traced scalars.
-    ``feat_mask`` [F] bool restricts candidate features (RF column
+    ``min_instances`` / ``min_info_gain`` / ``depth_limit`` may be traced
+    scalars — ``depth_limit`` stops splitting past that level while the
+    static scan runs to ``max_depth`` (nodes that stop route all samples
+    left through +inf thresholds, so routing to depth ``max_depth`` is
+    exact). ``feat_mask`` [F] bool restricts candidate features (RF column
     subsampling).
+
+    ``col_blocks`` — static list of (column-index ndarray, bins, thr_fn)
+    partitioning the features into histogram blocks with different bin
+    counts. AutoML feature matrices are dominated by one-hot indicator
+    columns (Titanic: 470 of 498); giving those a 2-bin block instead of
+    the full 32 quantile bins cuts the histogram/score tensors ~8×. The
+    candidate axis is the concatenation of every block's (bins−1)·F_b
+    (feature, threshold) pairs; ``thr_fn(f_local, t) -> real threshold``
+    recovers the stored split value per block. None → one full-width block.
 
     Active-node compaction: a dense level-wise build would need a
     [2^d, F, B, C] histogram per level — 1.5 GB per grid instance at depth
     12 — even though most of those nodes are empty. Instead each level keeps
-    at most ``max_active_nodes`` live nodes in a compact slot space (ranked
-    by parent split gain; the histogram/gain tensors stay [A, F, B, C]
-    regardless of depth). With min-instances ≥ n/A this is exact; beyond
-    that the lowest-gain subtrees are truncated, which matches leaf-wise
-    growers' behavior under a node budget.
+    at most ``A = min(max_active_nodes, 2^(max_depth-1))`` live nodes in a
+    compact slot space (ranked by parent split gain). With min-instances ≥
+    n/A this is exact; beyond that the lowest-gain subtrees are truncated,
+    which matches leaf-wise growers' behavior under a node budget.
+
+    The level loop is a ``lax.scan`` with a CONSTANT slot count, so the
+    body is traced and compiled once regardless of depth; per-level split
+    records are scattered into the dense level-order arrays after the scan.
     """
     n, F = Xb.shape
     B = n_bins
-    g = jnp.zeros((n,), jnp.int32)          # per-level node id ∈ [0, 2^d)
-    slot = jnp.zeros((n,), jnp.int32)       # compact active slot; ==A → idle
-    gpos = jnp.zeros((1,), jnp.int32)       # slot → per-level node id
-    alive = jnp.ones((1,), bool)
-    feats, thrs = [], []
-    for d in range(max_depth):
-        W = 1 << d                          # dense level width
-        A = min(W, max_active_nodes)        # compact slot count
-        # histogram over slots; idle samples (slot ≥ A) one-hot to zero
-        hist = _level_hist(stats, slot, Xb, A, B)     # [A, F, B, C]
-        cum = jnp.cumsum(hist, axis=2)
-        total = cum[:, :, -1, :][:, :, None, :]
-        left = cum[:, :, :-1, :]                      # split: bins ≤ t
-        right = total - left
-        gain = split_fn(total, left, right)           # [A, F, B-1]
-        ok = (left[..., -1] >= min_instances) & \
-             (right[..., -1] >= min_instances)
-        if feat_mask is not None:
-            ok = ok & feat_mask[None, :, None]
-        gain = jnp.where(ok, gain, _NEG)
-        flat = gain.reshape(A, F * (B - 1))
+    C = stats.shape[1]
+    A = max(2, min(max_active_nodes, 1 << max(max_depth - 1, 1)))
+    mmd = jnp.bfloat16 if stats.dtype == jnp.float32 else stats.dtype
+    if depth_limit is None:
+        depth_limit = jnp.asarray(max_depth, jnp.int32)
+    if col_blocks is None:
+        col_blocks = [(np.arange(F), B,
+                       lambda fl, tl: edges[fl, tl])]
+    blocks = [(np.asarray(cols), nb, thr_fn, Xb[:, np.asarray(cols)])
+              for cols, nb, thr_fn in col_blocks]
+
+    def level(carry, d):
+        slot, g, gpos, alive = carry
+        # per-block cumulative histograms over slots; idle (slot == A) → 0.
+        # Candidate axis = concat of every block's (bins−1)·F_b pairs.
+        flats, oks, cums = [], [], []
+        for cols, nb, _thr_fn, Xblk in blocks:
+            cumb = _level_cumhist(stats, slot, Xblk, A, nb)  # [A,C,nb,Fb]
+            sb = crit.score(cumb)                     # [A, nb-1, Fb]
+            lcb = cumb[:, -1, :-1, :]
+            tcb = cumb[:, -1, -1:, :]
+            okb = (lcb >= min_instances) & (tcb - lcb >= min_instances)
+            extra = crit.extra_ok(cumb)
+            if extra is not None:
+                okb = okb & extra
+            if feat_mask is not None:
+                okb = okb & feat_mask[jnp.asarray(cols)][None, None, :]
+            flats.append(jnp.where(okb, sb, _NEG).reshape(A, -1))
+            oks.append(okb.reshape(A, -1))
+            cums.append(cumb)
+        flat = jnp.concatenate(flats, axis=1) if len(flats) > 1 else flats[0]
+        ok_flat = jnp.concatenate(oks, axis=1) if len(oks) > 1 else oks[0]
         best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        do_split = alive & (best_gain >= jnp.maximum(min_info_gain, 1e-10))
-        f_idx = jnp.where(do_split, best // (B - 1), 0).astype(jnp.int32)
-        t_idx = jnp.where(do_split, best % (B - 1), 0).astype(jnp.int32)
-        thr = jnp.where(do_split, edges[f_idx, t_idx], jnp.inf)
-
-        # record into the dense level arrays (idle node ids scatter-drop)
-        pos = jnp.where(alive, gpos, W)
-        feat_lvl = jnp.zeros((W,), jnp.int32).at[pos].set(f_idx, mode="drop")
-        thr_lvl = jnp.full((W,), jnp.inf).at[pos].set(thr, mode="drop")
-        feats.append(feat_lvl)
-        thrs.append(thr_lvl)
-
-        # route samples (idle samples keep going left: thr = +inf)
-        slot_c = jnp.minimum(slot, A)                 # clamp for gathers
-        f_s = jnp.concatenate([f_idx, jnp.zeros((1,), jnp.int32)])[slot_c]
-        t_s = jnp.concatenate([t_idx, jnp.zeros((1,), jnp.int32)])[slot_c]
-        s_s = jnp.concatenate([do_split, jnp.zeros((1,), bool)])[slot_c]
-        xb = jnp.take_along_axis(Xb, f_s[:, None], axis=1)[:, 0]
-        go_right = jnp.where(s_s, xb > t_s, False)
-        g = 2 * g + go_right.astype(jnp.int32)
+        valid = jnp.take_along_axis(ok_flat, best[:, None], axis=1)[:, 0]
+        # decode the winning candidate per block; exact reference gain is
+        # evaluated only at the winner ([A, C] stats)
+        f_idx = jnp.zeros((A,), jnp.int32)
+        t_idx = jnp.zeros((A,), jnp.int32)
+        thr_v = jnp.zeros((A,), edges.dtype)
+        lstats = jnp.zeros((A, C), stats.dtype)
+        off = 0
+        for (cols, nb, thr_fn, _Xblk), cumb in zip(blocks, cums):
+            fb_n = len(cols)
+            size = (nb - 1) * fb_n
+            inb = (best >= off) & (best < off + size)
+            local = jnp.clip(best - off, 0, max(size - 1, 0))
+            fb = (local % fb_n).astype(jnp.int32)
+            tb = (local // fb_n).astype(jnp.int32)
+            f_idx = jnp.where(inb, jnp.asarray(cols, jnp.int32)[fb], f_idx)
+            t_idx = jnp.where(inb, tb, t_idx)
+            thr_v = jnp.where(inb, thr_fn(jnp.asarray(cols)[fb], tb), thr_v)
+            lb = jnp.take_along_axis(
+                cumb[:, :, :-1, :].reshape(A, C, size),
+                local[:, None, None], axis=2)[:, :, 0]
+            lstats = jnp.where(inb[:, None], lb, lstats)
+            off += size
+        tstats = cums[0][:, :, -1, 0]
+        best_gain = crit.gain(lstats, tstats)
+        do_split = alive & valid \
+            & (best_gain >= jnp.maximum(min_info_gain, 1e-10)) \
+            & (d < depth_limit)
+        f_idx = jnp.where(do_split, f_idx, 0)
+        thr = jnp.where(do_split, thr_v, jnp.inf)
 
         # next level: rank splitting slots by gain, allocate child slots
-        A2 = min(2 * W, max_active_nodes)
         rank = jnp.argsort(jnp.where(do_split, -best_gain, jnp.inf))
         inv = jnp.zeros((A,), jnp.int32).at[rank].set(
             jnp.arange(A, dtype=jnp.int32))
-        parent_ok = do_split & (inv < A2 // 2)
-        lchild = jnp.where(parent_ok, 2 * inv, A2)
-        child_slot = jnp.concatenate(
-            [jnp.stack([lchild, lchild + 1], axis=1),
-             jnp.full((1, 2), A2, jnp.int32)])        # idle row
-        slot = child_slot[slot_c, go_right.astype(jnp.int32)]
-        gpos = (jnp.full((A2,), 0, jnp.int32)
-                .at[lchild].set(2 * gpos, mode="drop")
-                .at[jnp.where(parent_ok, lchild + 1, A2)]
-                .set(2 * gpos + 1, mode="drop"))
-        alive = (jnp.zeros((A2,), bool)
-                 .at[lchild].set(parent_ok, mode="drop")
-                 .at[jnp.where(parent_ok, lchild + 1, A2)]
-                 .set(parent_ok, mode="drop"))
+        parent_ok = do_split & (inv < A // 2)
+        lchild = jnp.where(parent_ok, 2 * inv, A)
+        rchild = jnp.where(parent_ok, 2 * inv + 1, A)
+
+        # gather-free sample routing: per-sample table lookups run on the
+        # TPU scalar core and were ~15% of the sweep; instead select each
+        # sample's split feature with a one-hot matmul (MXU) and its
+        # slot-table values with masked [n, A] reductions (VPU).
+        oh = jax.nn.one_hot(slot, A, dtype=mmd)       # [n, A]; idle → 0-row
+        sel = jax.nn.one_hot(f_idx, F, dtype=mmd)     # [A, F]
+        xf = jnp.matmul(Xb.astype(mmd), sel.T,
+                        preferred_element_type=stats.dtype)   # [n, A]
+        Q = (xf > t_idx[None, :].astype(xf.dtype)) \
+            & do_split[None, :]                       # [n, A]
+        ohb = oh > 0
+        go_right = jnp.any(ohb & Q, axis=1)
+        g2 = 2 * g + go_right.astype(jnp.int32)
+        child = jnp.where(Q, rchild[None, :], lchild[None, :])
+        slot2 = jnp.where(slot == A, A,
+                          jnp.sum(jnp.where(ohb, child, 0), axis=1,
+                                  dtype=jnp.int32))
+        gpos2 = (jnp.zeros((A,), jnp.int32)
+                 .at[lchild].set(2 * gpos, mode="drop")
+                 .at[rchild].set(2 * gpos + 1, mode="drop"))
+        alive2 = (jnp.zeros((A,), bool)
+                  .at[lchild].set(parent_ok, mode="drop")
+                  .at[rchild].set(parent_ok, mode="drop"))
+        # record (compact): dense node id per slot, sentinel 2^D if dead
+        rec_pos = jnp.where(alive, gpos, jnp.int32(1 << max_depth))
+        return (slot2, g2, gpos2, alive2), (f_idx, thr, rec_pos)
+
+    slot0 = jnp.zeros((n,), jnp.int32)
+    g0 = jnp.zeros((n,), jnp.int32)
+    gpos0 = jnp.zeros((A,), jnp.int32)
+    alive0 = jnp.arange(A) == 0
+    (_, g, _, _), (f_rec, t_rec, pos_rec) = lax.scan(
+        level, (slot0, g0, gpos0, alive0),
+        jnp.arange(max_depth, dtype=jnp.int32))
+
+    # scatter compact per-level records into dense level-order arrays:
+    # node (d, j) lives at flat index (2^d - 1) + j
+    total_nodes = (1 << max_depth) - 1
+    offsets = (jnp.left_shift(1, jnp.arange(max_depth, dtype=jnp.int32))
+               - 1)[:, None]                          # [D, 1]
+    idx = (offsets + pos_rec).ravel()                 # dead slots → ≥ total
+    feat = jnp.zeros((total_nodes,), jnp.int32).at[idx].set(
+        f_rec.ravel(), mode="drop")
+    thr = jnp.full((total_nodes,), jnp.inf, t_rec.dtype).at[idx].set(
+        t_rec.ravel(), mode="drop")
 
     # leaf values: one MXU matmul instead of a vmapped scatter
-    onehot_leaf = jax.nn.one_hot(g, 1 << max_depth, dtype=stats.dtype)
-    leaf_stats = onehot_leaf.T @ stats
+    mm_dtype = jnp.bfloat16 if stats.dtype == jnp.float32 else stats.dtype
+    onehot_leaf = jax.nn.one_hot(g, 1 << max_depth, dtype=mm_dtype)
+    leaf_stats = jnp.matmul(onehot_leaf.T, stats.astype(mm_dtype),
+                            preferred_element_type=stats.dtype)
     leaf = leaf_fn(leaf_stats)
-    return jnp.concatenate(feats), jnp.concatenate(thrs), leaf, g
+    return feat, thr, leaf, g
 
 
 def predict_tree(feat, thr, leaf, X, max_depth: int) -> jnp.ndarray:
     """Route [n, F] rows through one tree → [n, K] leaf values."""
     n = X.shape[0]
-    node = jnp.zeros((n,), jnp.int32)
-    off = 0
-    for d in range(max_depth):
+
+    def body(d, node):
+        off = jnp.left_shift(jnp.int32(1), d) - 1
         f = feat[off + node]
         t = thr[off + node]
         x = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
-        node = 2 * node + (x > t).astype(jnp.int32)
-        off += 1 << d
+        return 2 * node + (x > t).astype(jnp.int32)
+
+    node = lax.fori_loop(0, max_depth, body, jnp.zeros((n,), jnp.int32))
     return leaf[node]
 
 
-def predict_ensemble(feat, thr, leaf, tree_w, X, max_depth: int
-                     ) -> jnp.ndarray:
-    """Weighted sum over [T, …] stacked trees → [n, K]."""
+def predict_ensemble(feat, thr, leaf, tree_w, X, max_depth: int,
+                     tree_chunk: int = 16) -> jnp.ndarray:
+    """Weighted sum over [T, …] stacked trees → [n, K].
+
+    Trees are routed in vmapped chunks (one batched fori_loop routes
+    ``tree_chunk`` trees at once) under a scan that bounds the [chunk, n, K]
+    intermediate — a per-tree scan would serialize T × max_depth tiny
+    gather steps."""
+    T = feat.shape[0]
+    c = max(1, min(tree_chunk, T))
+    pad = (-T) % c
+    if pad:
+        feat = jnp.concatenate([feat, jnp.zeros((pad,) + feat.shape[1:],
+                                                feat.dtype)])
+        thr = jnp.concatenate([thr, jnp.full((pad,) + thr.shape[1:],
+                                             jnp.inf, thr.dtype)])
+        leaf = jnp.concatenate([leaf, jnp.zeros((pad,) + leaf.shape[1:],
+                                                leaf.dtype)])
+        tree_w = jnp.concatenate([tree_w, jnp.zeros((pad,), tree_w.dtype)])
+    nc = (T + pad) // c
+
+    def chunked(a):
+        return a.reshape((nc, c) + a.shape[1:])
+
     def body(acc, tree):
         f, t, l, w = tree
-        return acc + w * predict_tree(f, t, l, X, max_depth), None
+        vals = jax.vmap(
+            lambda fi, ti, li: predict_tree(fi, ti, li, X, max_depth)
+        )(f, t, l)                                     # [c, n, K]
+        return acc + jnp.einsum("t,tnk->nk", w, vals), None
+
     init = jnp.zeros((X.shape[0], leaf.shape[-1]), leaf.dtype)
-    out, _ = lax.scan(body, init, (feat, thr, leaf, tree_w))
+    out, _ = lax.scan(body, init, (chunked(feat), chunked(thr),
+                                   chunked(leaf), chunked(tree_w)))
     return out
 
 
@@ -286,18 +477,55 @@ def _feature_masks(key, n_trees: int, n_feat: int, k: int) -> jnp.ndarray:
     return u <= kth
 
 
-def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
-               max_depth: int, n_bins: int, min_instances, min_info_gain,
-               num_trees_used, subsample_rate, seed: int = 7):
-    """Random forest via scanned bootstrap trees.
+def prepare_bins(X, n_bins, binary_mask=None):
+    """Quantile-bin X; binary indicator columns get a 2-bin block.
 
-    Traced: min_instances, min_info_gain, num_trees_used (≤ n_trees,
-    masks extra trees), subsample_rate. Returns params dict."""
-    key = jax.random.PRNGKey(seed)
-    k_boot, k_feat = jax.random.split(key)
+    Returns (Xb, edges, col_blocks): ``Xb`` [n, F] int bins (binary columns
+    re-binned to {0, 1} so the routing compare ``bin > t_idx`` works with
+    the block-local threshold index 0), ``col_blocks`` for
+    :func:`grow_tree` — or None when there is no binary column worth
+    splitting off. ``binary_mask`` is a STATIC host-side [F] bool (the
+    caller detects indicator columns on the host; data-dependent shapes
+    are not jittable).
+    """
     n, F = X.shape
     edges = quantile_bin_edges(X, n_bins)
     Xb = binarize(X, edges)
+    if binary_mask is None or not np.asarray(binary_mask).any():
+        return Xb, edges, None
+    bmask = np.asarray(binary_mask, bool)
+    bin_cols = np.nonzero(bmask)[0]
+    cont_cols = np.nonzero(~bmask)[0]
+    Xb = jnp.where(jnp.asarray(bmask)[None, :],
+                   (X > 0.5).astype(jnp.int32), Xb)
+    blocks = []
+    if len(cont_cols):
+        blocks.append((cont_cols, n_bins,
+                       lambda fl, tl: edges[fl, tl]))
+    blocks.append((bin_cols, 2,
+                   lambda fl, tl: jnp.full(fl.shape, 0.5, edges.dtype)))
+    return Xb, edges, blocks
+
+
+def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
+               max_depth: int, n_bins: int, min_instances, min_info_gain,
+               num_trees_used, subsample_rate, depth_limit=None,
+               max_active_nodes: int = 128, tree_chunk: int = 1,
+               binary_mask=None, seed: int = 7):
+    """Random forest via scanned bootstrap trees.
+
+    Traced: min_instances, min_info_gain, num_trees_used (≤ n_trees,
+    masks extra trees), subsample_rate, depth_limit. Returns params dict.
+
+    Bootstrap trees are independent, so they are grown ``tree_chunk`` at a
+    time (vmap inside the scan): fewer, larger device steps — per-step
+    histogram work is batched onto the MXU instead of serializing
+    T × depth small steps. ``tree_chunk`` bounds the transient
+    [chunk, A, F, B, C] histogram memory."""
+    key = jax.random.PRNGKey(seed)
+    k_boot, k_feat = jax.random.split(key)
+    n, F = X.shape
+    Xb, edges, col_blocks = prepare_bins(X, n_bins, binary_mask)
     boot = jax.random.poisson(
         k_boot, jnp.broadcast_to(jnp.asarray(subsample_rate, jnp.float32),
                                  ()), (n_trees, n)).astype(X.dtype)
@@ -314,24 +542,45 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
         def make_stats(wt):
             return jnp.concatenate(
                 [onehot * wt[:, None], (wt > 0).astype(X.dtype)[:, None]], 1)
-        split_fn, leaf_fn = gini_split, gini_leaf
+        crit, leaf_fn = GiniCriterion(), gini_leaf
     else:
         def make_stats(wt):
             return jnp.stack(
                 [wt, wt * y, wt * y * y, (wt > 0).astype(X.dtype)], axis=1)
-        split_fn, leaf_fn = variance_split, variance_leaf
+        crit, leaf_fn = VarianceCriterion(), variance_leaf
 
-    def body(_, per_tree):
-        bw, fm = per_tree
+    def fit_one(bw, fm):
         wt = w * bw
-        feat, thr, leaf, _node = grow_tree(
-            Xb, edges, make_stats(wt), split_fn, leaf_fn, max_depth,
-            n_bins, min_instances, min_info_gain, feat_mask=fm)
-        return None, (feat, thr, leaf)
-    _, (feat, thr, leaf) = lax.scan(body, None, (boot, fmask))
+        feat, thr, leaf, node = grow_tree(
+            Xb, edges, make_stats(wt), crit, leaf_fn, max_depth,
+            n_bins, min_instances, min_info_gain, depth_limit=depth_limit,
+            feat_mask=fm, max_active_nodes=max_active_nodes,
+            col_blocks=col_blocks)
+        return feat, thr, leaf, node
+
+    c = max(1, min(tree_chunk, n_trees))
+    pad = (-n_trees) % c
+    if pad:
+        boot = jnp.concatenate([boot, jnp.zeros((pad, n), boot.dtype)])
+        fmask = jnp.concatenate([fmask, jnp.ones((pad, F), bool)])
+    nc = (n_trees + pad) // c
+
+    def body(_, per_chunk):
+        bw, fm = per_chunk                             # [c, n], [c, F]
+        return None, jax.vmap(fit_one)(bw, fm)
+    _, (feat, thr, leaf, node) = lax.scan(
+        body, None, (boot.reshape(nc, c, n), fmask.reshape(nc, c, F)))
+    feat = feat.reshape((nc * c,) + feat.shape[2:])[:n_trees]
+    thr = thr.reshape((nc * c,) + thr.shape[2:])[:n_trees]
+    leaf = leaf.reshape((nc * c,) + leaf.shape[2:])[:n_trees]
+    node = node.reshape((nc * c,) + node.shape[2:])[:n_trees]
     tree_w = (jnp.arange(n_trees) < num_trees_used).astype(X.dtype)
     tree_w = tree_w / jnp.maximum(tree_w.sum(), 1.0)
-    return {"feat": feat, "thr": thr, "leaf": leaf, "tree_w": tree_w}
+    # train_node caches the fit-time sample→leaf routing: predicting the
+    # TRAINING matrix (the CV sweep's case) is then leaf gathers only — no
+    # per-level tree routing (which runs on the slow scalar core).
+    return {"feat": feat, "thr": thr, "leaf": leaf, "tree_w": tree_w,
+            "train_node": node}
 
 
 # ---------------------------------------------------------------------------
@@ -340,13 +589,13 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
 
 def fit_gbt(X, y, w, *, task: str, n_rounds: int, max_depth: int,
             n_bins: int, min_instances, min_info_gain, step_size,
-            num_rounds_used):
+            num_rounds_used, depth_limit=None, max_active_nodes: int = 128,
+            binary_mask=None):
     """Spark-style GBT: each round fits a weighted regression tree to the
     pseudo-residuals; classification uses logloss on y' ∈ {−1,+1} with
     margin F, prob = σ(2F) (GBTClassificationModel semantics)."""
-    edges = quantile_bin_edges(X, n_bins)
-    Xb = binarize(X, edges)
-    n = X.shape[0]
+    Xb, edges, col_blocks = prepare_bins(X, n_bins, binary_mask)
+    n, F = X.shape
     ypm = 2.0 * y - 1.0
 
     def residual(Fm):
@@ -359,16 +608,19 @@ def fit_gbt(X, y, w, *, task: str, n_rounds: int, max_depth: int,
         stats = jnp.stack([w, w * r, w * r * r,
                            (w > 0).astype(X.dtype)], axis=1)
         feat, thr, leaf, node = grow_tree(
-            Xb, edges, stats, variance_split, variance_leaf, max_depth,
-            n_bins, min_instances, min_info_gain)
+            Xb, edges, stats, VarianceCriterion(), variance_leaf, max_depth,
+            n_bins, min_instances, min_info_gain, depth_limit=depth_limit,
+            max_active_nodes=max_active_nodes, col_blocks=col_blocks)
         use = (t < num_rounds_used).astype(X.dtype)
         scale = use * step_size
         Fm = Fm + scale * leaf[node][:, 0]
         return Fm, (feat, thr, leaf * scale)
     F0 = jnp.zeros((n,), X.dtype)
-    _, (feat, thr, leaf) = lax.scan(body, F0, jnp.arange(n_rounds))
+    Fm, (feat, thr, leaf) = lax.scan(body, F0, jnp.arange(n_rounds))
+    # train_margin caches the final boosted margin on the training matrix
+    # (see fit_forest.train_node) — CV predict needs no routing at all.
     return {"feat": feat, "thr": thr, "leaf": leaf,
-            "tree_w": jnp.ones((n_rounds,), X.dtype)}
+            "tree_w": jnp.ones((n_rounds,), X.dtype), "train_margin": Fm}
 
 
 # ---------------------------------------------------------------------------
@@ -376,14 +628,15 @@ def fit_gbt(X, y, w, *, task: str, n_rounds: int, max_depth: int,
 # ---------------------------------------------------------------------------
 
 def fit_xgb(X, y, w, *, task: str, n_rounds: int, max_depth: int,
-            n_bins: int, eta, lam, min_child_weight, num_rounds_used):
+            n_bins: int, eta, lam, min_child_weight, num_rounds_used,
+            depth_limit=None, max_active_nodes: int = 128,
+            binary_mask=None):
     """Second-order boosting: g/h from logistic (classification) or squared
     (regression) loss; leaf = −G/(H+λ) (xgboost4j replacement — Rabit's
     histogram allreduce becomes psum under a sharded batch axis)."""
-    edges = quantile_bin_edges(X, n_bins)
-    Xb = binarize(X, edges)
-    n = X.shape[0]
-    split_fn = make_xgb_split(lam, min_child_weight)
+    Xb, edges, col_blocks = prepare_bins(X, n_bins, binary_mask)
+    n, F = X.shape
+    crit = XGBCriterion(lam, min_child_weight)
     leaf_fn = make_xgb_leaf(lam)
 
     def grads(Fm):
@@ -396,37 +649,60 @@ def fit_xgb(X, y, w, *, task: str, n_rounds: int, max_depth: int,
         g, h = grads(Fm)
         stats = jnp.stack([g, h, (w > 0).astype(X.dtype)], axis=1)
         feat, thr, leaf, node = grow_tree(
-            Xb, edges, stats, split_fn, leaf_fn, max_depth, n_bins,
-            jnp.asarray(0.0, X.dtype), jnp.asarray(-1e29, X.dtype))
+            Xb, edges, stats, crit, leaf_fn, max_depth, n_bins,
+            jnp.asarray(0.0, X.dtype), jnp.asarray(-1e29, X.dtype),
+            depth_limit=depth_limit, max_active_nodes=max_active_nodes,
+            col_blocks=col_blocks)
         use = (t < num_rounds_used).astype(X.dtype)
         scale = use * eta
         Fm = Fm + scale * leaf[node][:, 0]
         return Fm, (feat, thr, leaf * scale)
     F0 = jnp.zeros((n,), X.dtype)
-    _, (feat, thr, leaf) = lax.scan(body, F0, jnp.arange(n_rounds))
+    Fm, (feat, thr, leaf) = lax.scan(body, F0, jnp.arange(n_rounds))
     return {"feat": feat, "thr": thr, "leaf": leaf,
-            "tree_w": jnp.ones((n_rounds,), X.dtype)}
+            "tree_w": jnp.ones((n_rounds,), X.dtype), "train_margin": Fm}
 
 
 # ---------------------------------------------------------------------------
 # Ensemble → Prediction triple (pred, raw, prob)
 # ---------------------------------------------------------------------------
 
+def rf_head(out, X, task: str):
+    """[n, K] weighted leaf aggregate → Prediction triple (shared by the
+    routed predict path and the CV train-cache path)."""
+    if task == "classification":
+        probs = out / jnp.maximum(out.sum(-1, keepdims=True), _EPS)
+        pred = jnp.argmax(probs, axis=-1).astype(X.dtype)
+        return pred, probs, probs
+    empty = jnp.zeros((X.shape[0], 0), X.dtype)
+    return out[:, 0], empty, empty
+
+
+def margin_head(m, margin_scale, X, task: str):
+    """[n] boosted margin → Prediction triple. GBT uses prob = σ(2F),
+    XGB σ(F) (shared by routed and train-cache paths)."""
+    if task == "classification":
+        p1 = jax.nn.sigmoid(margin_scale * m)
+        prob = jnp.stack([1.0 - p1, p1], axis=1)
+        raw = jnp.stack([-m, m], axis=1)
+        pred = (p1 > 0.5).astype(X.dtype)
+        return pred, raw, prob
+    empty = jnp.zeros((X.shape[0], 0), X.dtype)
+    return m, empty, empty
+
+
 @functools.partial(jax.jit, static_argnames=("max_depth", "n_classes"))
 def predict_rf_classification(params, X, max_depth: int, n_classes: int):
     probs = predict_ensemble(params["feat"], params["thr"], params["leaf"],
                              params["tree_w"], X, max_depth)
-    probs = probs / jnp.maximum(probs.sum(-1, keepdims=True), _EPS)
-    pred = jnp.argmax(probs, axis=-1).astype(X.dtype)
-    return pred, probs, probs
+    return rf_head(probs, X, "classification")
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def predict_rf_regression(params, X, max_depth: int):
     out = predict_ensemble(params["feat"], params["thr"], params["leaf"],
-                           params["tree_w"], X, max_depth)[:, 0]
-    empty = jnp.zeros((X.shape[0], 0), X.dtype)
-    return out, empty, empty
+                           params["tree_w"], X, max_depth)
+    return rf_head(out, X, "regression")
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "margin_scale"))
@@ -435,16 +711,11 @@ def predict_margin_classification(params, X, max_depth: int,
     """GBT (margin_scale=2: prob = σ(2F)) and XGB (=1) binary heads."""
     m = predict_ensemble(params["feat"], params["thr"], params["leaf"],
                          params["tree_w"], X, max_depth)[:, 0]
-    p1 = jax.nn.sigmoid(margin_scale * m)
-    prob = jnp.stack([1.0 - p1, p1], axis=1)
-    raw = jnp.stack([-m, m], axis=1)
-    pred = (p1 > 0.5).astype(X.dtype)
-    return pred, raw, prob
+    return margin_head(m, margin_scale, X, "classification")
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def predict_margin_regression(params, X, max_depth: int):
-    out = predict_ensemble(params["feat"], params["thr"], params["leaf"],
-                           params["tree_w"], X, max_depth)[:, 0]
-    empty = jnp.zeros((X.shape[0], 0), X.dtype)
-    return out, empty, empty
+    m = predict_ensemble(params["feat"], params["thr"], params["leaf"],
+                         params["tree_w"], X, max_depth)[:, 0]
+    return margin_head(m, 1.0, X, "regression")

@@ -137,12 +137,39 @@ class ModelFamily:
     def fit_batch(self, X, y, w, stacked_grid):
         raise NotImplementedError
 
-    def predict_batch(self, params, X):
-        """→ (prediction, raw, prob) with grid-leading batch dims."""
+    def predict_batch(self, params, X, on_train: bool = False):
+        """→ (prediction, raw, prob) with grid-leading batch dims.
+
+        ``on_train=True`` asserts X is the exact matrix ``fit_batch`` saw,
+        allowing families to answer from fit-time caches (tree families
+        skip routing entirely); it must never be set for fresh data."""
         raise NotImplementedError
 
     def realize(self, params, hparams: Dict[str, Any]) -> PredictorModel:
         raise NotImplementedError
+
+    def trace_signature(self) -> Tuple:
+        """Hashable digest of everything that shapes this family's traced
+        program besides the runtime array arguments — the CV engine caches
+        compiled (fit+predict+metric) executables across validate() calls
+        keyed on this, so repeated sweeps (benchmarks, warm services,
+        workflow-level CV folds) skip tracing entirely."""
+        items = []
+        for k, v in sorted(self.__dict__.items()):
+            if k == "grid":
+                items.append((k, tuple(tuple(sorted(g.items()))
+                                       for g in self.grid)))
+            elif isinstance(v, np.ndarray):
+                items.append((k, (v.shape, str(v.dtype),
+                                  hash(v.tobytes()))))
+            elif isinstance(v, (int, float, str, bool, type(None))):
+                items.append((k, v))
+            elif isinstance(v, dict):
+                items.append((k, tuple(sorted(
+                    (kk, repr(vv)) for kk, vv in v.items()))))
+            else:
+                items.append((k, repr(v)))
+        return (type(self).__module__, type(self).__name__, tuple(items))
 
     def clone_single(self, hparams: Dict[str, Any]) -> "ModelFamily":
         """Same family configured with a one-point grid (final refit).

@@ -181,7 +181,7 @@ class GLMRegressionFamily(ModelFamily):
             return fit_glm(X, y, w, fam, reg, max_iter=self.max_iter)
         return jax.vmap(fit_one)(stacked["familyId"], stacked["regParam"])
 
-    def predict_batch(self, params, X):
+    def predict_batch(self, params, X, on_train: bool = False):
         coef, intercept = params
         G = coef.shape[0]
         fams = jnp.asarray([FAMILY_IDS[g.get("family", "gaussian")]

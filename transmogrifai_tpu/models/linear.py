@@ -127,7 +127,7 @@ class LogisticRegressionFamily(ModelFamily):
                 max_iter=self.max_iter)
         return jax.vmap(fit)(reg, enet)
 
-    def predict_batch(self, params, X):
+    def predict_batch(self, params, X, on_train: bool = False):
         coef, intercept = params
         if self.n_classes == 2:
             return jax.vmap(JF.predict_binary_logistic,
@@ -207,7 +207,7 @@ class LinearRegressionFamily(ModelFamily):
         return jax.vmap(lambda r, e: JF.fit_linear(
             X, y, w, r, e, max_iter=self.max_iter))(reg, enet)
 
-    def predict_batch(self, params, X):
+    def predict_batch(self, params, X, on_train: bool = False):
         coef, intercept = params
         return jax.vmap(JF.predict_linear, in_axes=(0, 0, None))(
             coef, intercept, X)
@@ -282,7 +282,7 @@ class NaiveBayesFamily(ModelFamily):
         return jax.vmap(lambda s: JF.fit_naive_bayes(
             X, y, w, s, n_classes=self.n_classes))(sm)
 
-    def predict_batch(self, params, X):
+    def predict_batch(self, params, X, on_train: bool = False):
         lp, ll = params
         return jax.vmap(JF.predict_naive_bayes, in_axes=(0, 0, None))(
             lp, ll, X)

@@ -173,6 +173,11 @@ class ModelSelector(PredictorEstimator):
         else:
             base_w = None
         self._maybe_set_classes(y)
+        from .trees import detect_binary_columns
+        bmask = detect_binary_columns(X)
+        for fam in self.families:
+            if hasattr(fam, "binary_mask"):
+                fam.binary_mask = bmask
         best_family, best_hparams, vsummary = self.validator.validate(
             self.families, X, y, base_weights=base_w, mesh=self.mesh)
         self.best_estimator_ = (best_family, best_hparams)

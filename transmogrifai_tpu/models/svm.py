@@ -135,7 +135,7 @@ class LinearSVCFamily(ModelFamily):
         return jax.vmap(lambda r: fit_linear_svc(
             X, y, w, r, self.max_iter))(reg)
 
-    def predict_batch(self, params, X):
+    def predict_batch(self, params, X, on_train: bool = False):
         coef, b = params
         return jax.vmap(predict_linear_svc, in_axes=(0, 0, None))(coef, b, X)
 
@@ -307,7 +307,7 @@ class MLPFamily(ModelFamily):
         inv = jnp.argsort(jnp.asarray(order))
         return jax.tree_util.tree_map(lambda a: jnp.take(a, inv, axis=0), cat)
 
-    def predict_batch(self, params, X):
+    def predict_batch(self, params, X, on_train: bool = False):
         return jax.vmap(lambda p: predict_mlp(p, X))(params)
 
     def realize(self, params, hparams) -> MLPModel:

@@ -7,13 +7,14 @@ OpRandomForestClassifier.scala``), ``OpGBTClassifier/Regressor``,
 all fit natively with the JAX histogram engine (models/_treefit.py)
 instead of wrapping MLlib / xgboost4j-JNI.
 
-Grid batching: value-gating hyperparameters (minInstancesPerNode,
-minInfoGain, eta, minChildWeight, numTrees/numRound, subsample) are traced
-and vmapped; ``maxDepth`` is structural, so ``fit_batch`` groups grid
-points by depth at trace time (the stacked grid is concrete), fits each
-group with true static shapes, pads trees to the global depth, and
-reassembles grid order — one compiled program per distinct depth instead
-of worst-case memory for every grid point.
+Grid batching: ALL grid hyperparameters (minInstancesPerNode, minInfoGain,
+eta, minChildWeight, numTrees/numRound, subsample, maxDepth) are traced and
+vmapped, so the whole (fold × grid) sweep is ONE compiled program per
+family. ``maxDepth`` gates splitting per level inside the constant-shape
+level scan (models/_treefit.py); the static scan length is the grid's max
+depth, and shallower grid points route left through +inf thresholds below
+their depth limit — exactly the tree the grouped-by-depth build produced,
+at a small extra compute cost and a ~10× compile-time saving.
 """
 from __future__ import annotations
 
@@ -27,6 +28,21 @@ from ..stages.base import register_stage
 from . import _treefit as TF
 from .base import (ModelFamily, PredictorEstimator, PredictorModel,
                    extract_xy)
+
+
+def _strip_caches(p: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: v for k, v in p.items()
+            if k not in ("train_node", "train_margin")}
+
+
+def detect_binary_columns(X: np.ndarray) -> Optional[np.ndarray]:
+    """Host-side [F] bool: columns whose values are all in {0, 1}.
+
+    AutoML feature matrices are dominated by one-hot/indicator columns
+    (Titanic: 470 of 498); the tree engine gives those a 2-bin histogram
+    block instead of 32 quantile bins (~8× less histogram work)."""
+    mask = np.all((X == 0.0) | (X == 1.0), axis=0)
+    return mask if mask.any() else None
 
 __all__ = [
     "TreeEnsembleModel",
@@ -101,18 +117,25 @@ class TreeEnsembleModel(PredictorModel):
 # ---------------------------------------------------------------------------
 
 class _TreeFamilyBase(ModelFamily):
-    """Shared depth-grouped grid batching."""
+    """Shared single-program grid batching (maxDepth traced)."""
 
     task = "classification"
     n_bins = 32                      # DefaultSelectorParams.MaxBin
 
     def __init__(self, grid=None, task: Optional[str] = None,
-                 n_classes: int = 2, seed: int = 7, **fixed):
+                 n_classes: int = 2, seed: int = 7,
+                 max_active_nodes: int = 128, **fixed):
         super().__init__(grid, **fixed)
         if task is not None:
             self.task = task
         self.n_classes = n_classes
         self.seed = seed
+        self.max_active_nodes = max_active_nodes
+        #: STATIC host-side [F] bool marking one-hot indicator columns;
+        #: set by the caller (ModelSelector / estimator) before fit so the
+        #: histogram engine gives those columns a 2-bin block (see
+        #: _treefit.prepare_bins). None → single full-width bin block.
+        self.binary_mask = None
 
     #: keys whose stacked values are traced & vmapped
     traced_keys: List[str] = []
@@ -124,13 +147,10 @@ class _TreeFamilyBase(ModelFamily):
     def _static_trees(self) -> int:
         raise NotImplementedError
 
-    def _stacked_col(self, stacked, key) -> np.ndarray:
+    def _stacked_col(self, stacked, key):
         if key in stacked:
-            return np.asarray(stacked[key])
+            return stacked[key]          # may be a tracer (jit argument)
         return np.full((self.grid_size(),), self.param_defaults()[key])
-
-    def _depth_of(self, stacked) -> np.ndarray:
-        return self._stacked_col(stacked, "maxDepth").astype(np.int64)
 
     def global_depth(self) -> int:
         return int(max(int(g.get("maxDepth",
@@ -138,56 +158,57 @@ class _TreeFamilyBase(ModelFamily):
                        for g in self.grid))
 
     def fit_batch(self, X, y, w, stacked):
-        depths = self._depth_of(stacked)
-        D = int(depths.max())
-        n_trees = self._static_trees()
-        order: List[int] = []
-        outs = []
-        for d in sorted(set(depths.tolist())):
-            idxs = [i for i, dd in enumerate(depths.tolist()) if dd == d]
-            order += idxs
-            traced = {k: jnp.asarray(self._stacked_col(stacked, k)[idxs],
-                                     dtype=X.dtype)
-                      for k in self.traced_keys}
-
-            def fit_one(tr, _d=d):
-                p = self._fit_single(X, y, w, _d, n_trees, tr)
-                return self._pad(p, _d, D, n_trees)
-            outs.append(jax.vmap(fit_one)(traced))
-        cat = jax.tree_util.tree_map(
-            lambda *a: jnp.concatenate(a, axis=0), *outs)
-        inv = jnp.argsort(jnp.asarray(order))
-        return jax.tree_util.tree_map(lambda a: jnp.take(a, inv, axis=0), cat)
-
-    @staticmethod
-    def _pad(p: Dict[str, Any], d: int, D: int, n_trees: int):
-        if d == D:
-            return p
-        extra = (1 << D) - (1 << d)
-        feat = jnp.concatenate(
-            [p["feat"], jnp.zeros((n_trees, extra), p["feat"].dtype)], axis=1)
-        thr = jnp.concatenate(
-            [p["thr"], jnp.full((n_trees, extra), jnp.inf, p["thr"].dtype)],
-            axis=1)
-        leaf = jnp.repeat(p["leaf"], 1 << (D - d), axis=1)
-        return {"feat": feat, "thr": thr, "leaf": leaf,
-                "tree_w": p["tree_w"]}
-
-    def predict_batch(self, params, X):
         D = self.global_depth()
+        n_trees = self._static_trees()
+        traced = {k: jnp.asarray(self._stacked_col(stacked, k), dtype=X.dtype)
+                  for k in self.traced_keys}
+        traced["maxDepth"] = jnp.asarray(
+            self._stacked_col(stacked, "maxDepth"), jnp.int32)
+
+        def fit_one(tr):
+            return self._fit_single(X, y, w, D, n_trees, tr)
+        return jax.vmap(fit_one)(traced)
+
+    def predict_batch(self, params, X, on_train: bool = False):
+        """Batched Prediction triple for the grid.
+
+        With ``on_train=True`` (the CV engine's path, asserting ``X`` IS
+        the training matrix the fit saw) predictions come straight from
+        the fit-time caches — leaf gathers over ``train_node`` (RF) or a
+        sigmoid over ``train_margin`` (boosting) — skipping per-level tree
+        routing, which runs on the TPU scalar core and dominated the CV
+        sweep. Otherwise full routing. Both paths share the head math in
+        ``_treefit`` (rf_head / margin_head).
+        """
+        D = self.global_depth()
+        head = self._head()
+        if on_train and head == "rf" and "train_node" in params:
+            def fn(p):
+                vals = jax.vmap(lambda l, nd: l[nd])(
+                    p["leaf"], p["train_node"])        # [T, n, K]
+                out = jnp.einsum("t,tnk->nk", p["tree_w"], vals)
+                return TF.rf_head(out, X, self.task)
+            return jax.vmap(fn)(params)
+        if on_train and head in ("gbt", "xgb") and "train_margin" in params:
+            scale = 2.0 if head == "gbt" else 1.0
+
+            def fn(p):
+                return TF.margin_head(p["train_margin"], scale, X, self.task)
+            return jax.vmap(fn)(params)
         if self.task == "classification":
-            if self._head() == "rf":
+            if head == "rf":
                 fn = lambda p: TF.predict_rf_classification(
-                    p, X, D, self.n_classes)
+                    _strip_caches(p), X, D, self.n_classes)
             else:
-                scale = 2.0 if self._head() == "gbt" else 1.0
+                scale = 2.0 if head == "gbt" else 1.0
                 fn = lambda p: TF.predict_margin_classification(
-                    p, X, D, margin_scale=scale)
+                    _strip_caches(p), X, D, margin_scale=scale)
         else:
-            if self._head() == "rf":
-                fn = lambda p: TF.predict_rf_regression(p, X, D)
+            if head == "rf":
+                fn = lambda p: TF.predict_rf_regression(_strip_caches(p), X, D)
             else:
-                fn = lambda p: TF.predict_margin_regression(p, X, D)
+                fn = lambda p: TF.predict_margin_regression(
+                    _strip_caches(p), X, D)
         return jax.vmap(fn)(params)
 
     def _head(self) -> str:
@@ -197,7 +218,8 @@ class _TreeFamilyBase(ModelFamily):
         kind = f"{self._head()}_{self.task}"
         model = TreeEnsembleModel(kind=kind, n_classes=self.n_classes,
                                   max_depth=self.global_depth())
-        model.trees = {k: np.asarray(v) for k, v in params.items()}
+        model.trees = {k: np.asarray(v) for k, v in params.items()
+                       if k not in ("train_node", "train_margin")}
         return model
 
 
@@ -240,7 +262,10 @@ class RandomForestFamily(_TreeFamilyBase):
             min_instances=tr["minInstancesPerNode"],
             min_info_gain=tr["minInfoGain"],
             num_trees_used=tr["numTrees"],
-            subsample_rate=tr["subsamplingRate"], seed=self.seed)
+            subsample_rate=tr["subsamplingRate"],
+            depth_limit=tr["maxDepth"],
+            max_active_nodes=self.max_active_nodes,
+            binary_mask=self.binary_mask, seed=self.seed)
 
 
 class DecisionTreeFamily(RandomForestFamily):
@@ -305,7 +330,9 @@ class GBTFamily(_TreeFamilyBase):
             X, y, w, task=self.task, n_rounds=n_trees, max_depth=depth,
             n_bins=self.n_bins, min_instances=tr["minInstancesPerNode"],
             min_info_gain=tr["minInfoGain"], step_size=tr["stepSize"],
-            num_rounds_used=tr["maxIter"])
+            num_rounds_used=tr["maxIter"], depth_limit=tr["maxDepth"],
+            max_active_nodes=self.max_active_nodes,
+            binary_mask=self.binary_mask)
 
 
 class XGBoostFamily(_TreeFamilyBase):
@@ -345,7 +372,9 @@ class XGBoostFamily(_TreeFamilyBase):
             X, y, w, task=self.task, n_rounds=n_trees, max_depth=depth,
             n_bins=self.n_bins, eta=tr["eta"], lam=self.reg_lambda,
             min_child_weight=tr["minChildWeight"],
-            num_rounds_used=tr["numRound"])
+            num_rounds_used=tr["numRound"], depth_limit=tr["maxDepth"],
+            max_active_nodes=self.max_active_nodes,
+            binary_mask=self.binary_mask)
 
 
 # ---------------------------------------------------------------------------
@@ -364,6 +393,7 @@ class _TreeEstimatorBase(PredictorEstimator):
         n_classes = max(int(y.max()) + 1 if len(y) else 2, 2) \
             if self.task == "classification" else 2
         fam = self._family(n_classes)
+        fam.binary_mask = detect_binary_columns(X)
         Xd = jnp.asarray(X, jnp.float32)
         grid = fam.stack_grid()
         params = jax.jit(lambda X, y, w: fam.fit_batch(X, y, w, grid))(
@@ -380,6 +410,7 @@ class OpRandomForestClassifier(_TreeEstimatorBase):
     def __init__(self, num_trees: int = 20, max_depth: int = 5,
                  min_instances_per_node: int = 1,
                  min_info_gain: float = 0.0, subsampling_rate: float = 1.0,
+                 max_active_nodes: int = 128,
                  seed: int = 7, uid: Optional[str] = None):
         super().__init__(uid=uid)
         self.num_trees = num_trees
@@ -387,6 +418,7 @@ class OpRandomForestClassifier(_TreeEstimatorBase):
         self.min_instances_per_node = min_instances_per_node
         self.min_info_gain = min_info_gain
         self.subsampling_rate = subsampling_rate
+        self.max_active_nodes = max_active_nodes
         self.seed = seed
 
     def _family(self, n_classes):
@@ -397,7 +429,7 @@ class OpRandomForestClassifier(_TreeEstimatorBase):
                    "numTrees": self.num_trees,
                    "subsamplingRate": self.subsampling_rate}],
             task=self.task, n_classes=n_classes, num_trees=self.num_trees,
-            seed=self.seed)
+            max_active_nodes=self.max_active_nodes, seed=self.seed)
 
 
 @register_stage
@@ -411,12 +443,13 @@ class OpDecisionTreeClassifier(_TreeEstimatorBase):
     operation_name = "decisionTree"
 
     def __init__(self, max_depth: int = 5, min_instances_per_node: int = 1,
-                 min_info_gain: float = 0.0, seed: int = 7,
-                 uid: Optional[str] = None):
+                 min_info_gain: float = 0.0, max_active_nodes: int = 128,
+                 seed: int = 7, uid: Optional[str] = None):
         super().__init__(uid=uid)
         self.max_depth = max_depth
         self.min_instances_per_node = min_instances_per_node
         self.min_info_gain = min_info_gain
+        self.max_active_nodes = max_active_nodes
         self.seed = seed
 
     def _family(self, n_classes):
@@ -424,7 +457,8 @@ class OpDecisionTreeClassifier(_TreeEstimatorBase):
             grid=[{"maxDepth": self.max_depth,
                    "minInstancesPerNode": self.min_instances_per_node,
                    "minInfoGain": self.min_info_gain}],
-            task=self.task, n_classes=n_classes, seed=self.seed)
+            task=self.task, n_classes=n_classes,
+            max_active_nodes=self.max_active_nodes, seed=self.seed)
 
 
 @register_stage
@@ -439,14 +473,15 @@ class OpGBTClassifier(_TreeEstimatorBase):
 
     def __init__(self, max_iter: int = 20, max_depth: int = 5,
                  min_instances_per_node: int = 1, min_info_gain: float = 0.0,
-                 step_size: float = 0.1, seed: int = 7,
-                 uid: Optional[str] = None):
+                 step_size: float = 0.1, max_active_nodes: int = 128,
+                 seed: int = 7, uid: Optional[str] = None):
         super().__init__(uid=uid)
         self.max_iter = max_iter
         self.max_depth = max_depth
         self.min_instances_per_node = min_instances_per_node
         self.min_info_gain = min_info_gain
         self.step_size = step_size
+        self.max_active_nodes = max_active_nodes
         self.seed = seed
 
     def _family(self, n_classes):
@@ -456,7 +491,7 @@ class OpGBTClassifier(_TreeEstimatorBase):
                    "minInfoGain": self.min_info_gain,
                    "maxIter": self.max_iter, "stepSize": self.step_size}],
             task=self.task, n_classes=n_classes, max_iter=self.max_iter,
-            seed=self.seed)
+            max_active_nodes=self.max_active_nodes, seed=self.seed)
 
 
 @register_stage
@@ -471,14 +506,15 @@ class OpXGBoostClassifier(_TreeEstimatorBase):
 
     def __init__(self, num_round: int = 100, max_depth: int = 6,
                  eta: float = 0.3, min_child_weight: float = 1.0,
-                 reg_lambda: float = 1.0, seed: int = 7,
-                 uid: Optional[str] = None):
+                 reg_lambda: float = 1.0, max_active_nodes: int = 128,
+                 seed: int = 7, uid: Optional[str] = None):
         super().__init__(uid=uid)
         self.num_round = num_round
         self.max_depth = max_depth
         self.eta = eta
         self.min_child_weight = min_child_weight
         self.reg_lambda = reg_lambda
+        self.max_active_nodes = max_active_nodes
         self.seed = seed
 
     def _family(self, n_classes):
@@ -487,7 +523,8 @@ class OpXGBoostClassifier(_TreeEstimatorBase):
                    "minChildWeight": self.min_child_weight,
                    "numRound": self.num_round}],
             task=self.task, n_classes=n_classes,
-            reg_lambda=self.reg_lambda, seed=self.seed)
+            reg_lambda=self.reg_lambda,
+            max_active_nodes=self.max_active_nodes, seed=self.seed)
 
 
 @register_stage

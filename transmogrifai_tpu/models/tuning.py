@@ -192,6 +192,10 @@ def _metric_value(metric_name: str, task: str, y: np.ndarray,
 
 _LARGER_BETTER = frozenset({"AuROC", "AuPR", "Precision", "Recall", "F1", "R2"})
 
+#: compiled (fit+predict+metric) executables, keyed by (family trace
+#: signature, task, metric, mesh, arg shapes) — see validate()
+_FUSED_EXE_CACHE: Dict[Any, Any] = {}
+
 
 class _ValidatorBase:
     """Shared fold-mask validation engine."""
@@ -215,56 +219,134 @@ class _ValidatorBase:
                  mesh=None) -> Tuple[ModelFamily, Dict[str, Any], ValidatorSummary]:
         """Run the full (family × grid × fold) sweep; return winner.
 
-        The per-family computation is one jitted nested-vmap: folds on the
-        outer axis, grid points inner. With a mesh, X/y are device_put with a
-        row sharding so XLA partitions the batch over chips (GSPMD).
+        The per-family computation is ONE jitted program — fit, predict and
+        the selection metric fused, folds vmapped on the outer axis, grid
+        points inner — returning only a [folds, grid] metric matrix, so
+        predictions never leave the device. With a mesh, X/y are device_put
+        with a row sharding so XLA partitions the batch over chips (GSPMD).
+        Metrics without a device kernel fall back to host numpy.
         """
+        from ..evaluators.device_metrics import device_metric_fn
+
         splits = self._splits(y)
         base_w = (np.ones_like(y, dtype=np.float64)
                   if base_weights is None else base_weights)
         train_w = np.stack([m * base_w for m, _ in splits])   # [K, n]
-        val_masks = np.stack([v for _, v in splits]).astype(bool)
+        val_w = np.stack([v for _, v in splits])              # [K, n] 0/1
+        val_masks = val_w.astype(bool)
 
         n_orig = len(y)
         if mesh is not None:
             from ..parallel.mesh import shard_cv_inputs
-            Xd, yd, wd, n_orig = shard_cv_inputs(mesh, X, y, train_w)
+            Xd, yd, wd, vwd, n_orig = shard_cv_inputs(mesh, X, y, train_w,
+                                                      extra=val_w)
         else:
             Xd, yd = jnp.asarray(X), jnp.asarray(y)
             wd = jnp.asarray(train_w)
+            vwd = jnp.asarray(val_w)
 
         summary = ValidatorSummary(self.validation_type, self.metric_name)
         best: Optional[ValidationResult] = None
         best_family: Optional[ModelFamily] = None
         sign = 1.0 if self.is_larger_better else -1.0
 
-        for family in families:
-            stacked = family.stack_grid()
+        # Phase 1: compile every family's fused fit+predict+metric program
+        # CONCURRENTLY — XLA compilation is C++ and releases the GIL, so the
+        # cold-start cost is max(compile) across families, not the sum.
+        # Compiled executables are cached across validate() calls keyed by
+        # (family trace signature, metric, arg shapes): data, fold weights
+        # and the stacked hyperparameter grid are jit ARGUMENTS, so repeat
+        # sweeps skip tracing AND compilation entirely.
+        def make_fit_eval(family, metric_fn):
+            def fit_eval(X, y, w_folds, v_folds, stacked):
+                def per_fold(w, v):
+                    params = family.fit_batch(X, y, w, stacked)
+                    pred, _raw, prob = family.predict_batch(params, X,
+                                                            on_train=True)
+                    return jax.vmap(
+                        lambda pg, prg: metric_fn(y, pg, prg, v)
+                    )(pred, prob)
+                return jax.vmap(per_fold)(w_folds, v_folds)
+            return fit_eval
 
-            def fit_all(w_folds):
-                return jax.vmap(lambda w: family.fit_batch(Xd, yd, w, stacked)
-                                )(w_folds)
+        mesh_key = tuple(sorted(mesh.shape.items())) if mesh is not None \
+            else None
 
-            params = jax.jit(fit_all)(wd)    # leading dims [K, G, ...]
+        def shapes_of(tree):
+            return tuple((tuple(a.shape), str(jnp.asarray(a).dtype))
+                         for a in jax.tree_util.tree_leaves(tree))
+
+        fused: Dict[int, Any] = {}
+        stacked_devs: Dict[int, Any] = {}
+        to_compile = []
+        for fi, family in enumerate(families):
+            metric_fn = device_metric_fn(
+                self.task, self.metric_name,
+                n_classes=getattr(family, "n_classes", 2))
+            if metric_fn is None:
+                continue
+            stacked = {k2: jnp.asarray(v) for k2, v in
+                       family.stack_grid().items()}
+            stacked_devs[fi] = stacked
+            key = (family.trace_signature(), self.task, self.metric_name,
+                   mesh_key, shapes_of((Xd, yd, wd, vwd, stacked)))
+            exe = _FUSED_EXE_CACHE.get(key)
+            if exe is not None:
+                fused[fi] = exe
+            else:
+                to_compile.append(
+                    (fi, key, jax.jit(make_fit_eval(family, metric_fn))))
+
+        if to_compile:
+            import concurrent.futures as cf
+            with cf.ThreadPoolExecutor(len(to_compile)) as ex:
+                futs = [(fi, key, ex.submit(
+                    lambda jf=jf, st=stacked_devs[fi]:
+                    jf.lower(Xd, yd, wd, vwd, st).compile()))
+                    for fi, key, jf in to_compile]
+                for fi, key, fut in futs:
+                    exe = fut.result()
+                    fused[fi] = exe
+                    while len(_FUSED_EXE_CACHE) > 64:
+                        _FUSED_EXE_CACHE.pop(
+                            next(iter(_FUSED_EXE_CACHE)))   # FIFO evict
+                    _FUSED_EXE_CACHE[key] = exe
+
+        for fi, family in enumerate(families):
             k, g = len(splits), family.grid_size()
 
-            def predict_all(p):
-                return jax.vmap(lambda pk: family.predict_batch(pk, Xd))(p)
+            if fi in fused:
+                per_fold_metrics = np.asarray(
+                    fused[fi](Xd, yd, wd, vwd, stacked_devs[fi]))   # [K, G]
+                per_grid_metrics = np.asarray(per_fold_metrics).T
+            else:
+                stacked = family.stack_grid()
+                def fit_all(w_folds):
+                    return jax.vmap(
+                        lambda w: family.fit_batch(Xd, yd, w, stacked)
+                    )(w_folds)
 
-            pred, _raw, prob = jax.jit(predict_all)(params)
-            # slice off any zero-weight sharding padding rows
-            pred = np.asarray(pred)[..., :n_orig]
-            prob = np.asarray(prob)[:, :, :n_orig] if np.asarray(prob).ndim == 4 \
-                else np.asarray(prob)
+                params = jax.jit(fit_all)(wd)    # leading dims [K, G, ...]
 
-            per_grid_metrics = np.zeros((g, k))
-            for gi in range(g):
-                for ki in range(k):
-                    vm = val_masks[ki]
-                    per_grid_metrics[gi, ki] = _metric_value(
-                        self.metric_name, self.task, y[vm],
-                        pred[ki, gi][vm],
-                        prob[ki, gi][vm] if prob.ndim == 4 else prob[ki, gi])
+                def predict_all(p):
+                    return jax.vmap(
+                        lambda pk: family.predict_batch(pk, Xd))(p)
+
+                pred, _raw, prob = jax.jit(predict_all)(params)
+                # slice off any zero-weight sharding padding rows
+                pred = np.asarray(pred)[..., :n_orig]
+                prob = np.asarray(prob)[:, :, :n_orig] \
+                    if np.asarray(prob).ndim == 4 else np.asarray(prob)
+
+                per_grid_metrics = np.zeros((g, k))
+                for gi in range(g):
+                    for ki in range(k):
+                        vm = val_masks[ki]
+                        per_grid_metrics[gi, ki] = _metric_value(
+                            self.metric_name, self.task, y[vm],
+                            pred[ki, gi][vm],
+                            prob[ki, gi][vm] if prob.ndim == 4
+                            else prob[ki, gi])
             means = per_grid_metrics.mean(axis=1)
             for gi in range(g):
                 r = ValidationResult(

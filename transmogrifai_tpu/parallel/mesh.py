@@ -72,7 +72,7 @@ def pad_rows(X, y, w_folds, multiple: int):
     return X, y, w_folds, n
 
 
-def shard_cv_inputs(mesh: Mesh, X, y, w_folds):
+def shard_cv_inputs(mesh: Mesh, X, y, w_folds, extra=None):
     """Place CV inputs: rows over ``data``, fold/grid batches over ``grid``.
 
     X: [n, d] → P('data', None); y: [n] → P('data');
@@ -80,11 +80,16 @@ def shard_cv_inputs(mesh: Mesh, X, y, w_folds):
     subset of folds and each data-axis shard a subset of rows.
     Rows are zero-weight padded to the data-axis size; the returned
     ``n_orig`` tells callers where to slice device outputs.
+
+    ``extra`` — optional additional [K, n] per-fold mask/weight array
+    (e.g. validation-row weights) padded with zeros and sharded like
+    ``w_folds``; when given the return is (X, y, w, extra, n_orig).
     """
     import jax.numpy as jnp
     X = np.asarray(X)
     y = np.asarray(y)
     w_folds = np.asarray(w_folds)
+    n = X.shape[0]
     X, y, w_folds, n_orig = pad_rows(X, y, w_folds, mesh.shape["data"])
     Xs = jax.device_put(jnp.asarray(X), NamedSharding(mesh, P("data", None)))
     ys = jax.device_put(jnp.asarray(y), NamedSharding(mesh, P("data")))
@@ -92,4 +97,13 @@ def shard_cv_inputs(mesh: Mesh, X, y, w_folds):
     grid_n = mesh.shape["grid"]
     spec = P("grid", "data") if k % grid_n == 0 else P(None, "data")
     ws = jax.device_put(jnp.asarray(w_folds), NamedSharding(mesh, spec))
-    return Xs, ys, ws, n_orig
+    if extra is None:
+        return Xs, ys, ws, n_orig
+    extra = np.asarray(extra)
+    pad = w_folds.shape[1] - n
+    if pad:
+        extra = np.concatenate(
+            [extra, np.zeros((extra.shape[0], pad), dtype=extra.dtype)],
+            axis=1)
+    es = jax.device_put(jnp.asarray(extra), NamedSharding(mesh, spec))
+    return Xs, ys, ws, es, n_orig
