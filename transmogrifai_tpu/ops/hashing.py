@@ -119,12 +119,12 @@ def hash_tokens(tokens: Sequence[str], seed: int = 42) -> np.ndarray:
                     dtype=np.uint32)
 
 
-def _tokens_of(col, row: int) -> List[str]:
+def _column_tokens(col) -> List[List[str]]:
+    """Per-row token lists for a whole column."""
     if isinstance(col, TextColumn):
-        v = col.values[row]
-        return [v] if v is not None else []
+        return [[v] if v is not None else [] for v in col.values]
     if isinstance(col, (TextListColumn, TextSetColumn)):
-        return list(col.values[row])
+        return [list(v) for v in col.values]
     raise TypeError(f"Cannot hash column {type(col).__name__}")
 
 
@@ -162,33 +162,39 @@ class HashingVectorizerModel(VectorizerModel):
         return self.input_names_saved
 
     def host_prepare(self, store: ColumnStore) -> Dict[str, np.ndarray]:
+        from ._hostvec import hashed_count_block, hashed_count_flat
         names = self._names()
         n = store.n_rows
         k = len(names)
         width = self.num_features if self.shared_hash_space \
             else self.num_features * k
-        counts = np.zeros((n, width), dtype=np.float64)
-        nulls = np.zeros((n, k), dtype=np.float64)
+        # counts and null indicators live in ONE matrix (nulls in the tail
+        # columns) so no concat copy is needed downstream
+        mat = np.zeros((n, width + (k if self.track_nulls else 0)),
+                       dtype=np.float64)
         for j, name in enumerate(names):
             col = store[name]
             base = 0 if self.shared_hash_space else j * self.num_features
-            for r in range(n):
-                toks = _tokens_of(col, r)
-                if not toks:
-                    nulls[r, j] = 1.0
-                    continue
-                hashed = hash_tokens(toks, self.seed) % self.num_features
-                if self.binary_freq:
-                    counts[r, base + hashed] = 1.0
-                else:
-                    np.add.at(counts[r], base + hashed, 1.0)
-        return {"counts": counts, "nulls": nulls}
+            if isinstance(col, TextColumn):
+                # flat fast-path: a Text column's tokens ARE its non-null
+                # values — no per-row singleton lists
+                null_mask = np.fromiter((v is None for v in col.values),
+                                        bool, count=n)
+                rows = np.nonzero(~null_mask)[0]
+                flat = [col.values[r] for r in rows]
+                _, null_j = hashed_count_flat(
+                    flat, rows, null_mask, n, self.num_features, self.seed,
+                    self.binary_freq, out=mat, col_offset=base)
+            else:
+                _, null_j = hashed_count_block(
+                    _column_tokens(col), self.num_features, self.seed,
+                    self.binary_freq, out=mat, col_offset=base)
+            if self.track_nulls:
+                mat[:, width + j] = null_j
+        return {"mat": mat}
 
     def device_compute(self, xp, prepared):
-        counts = xp.asarray(prepared["counts"])
-        if not self.track_nulls:
-            return counts
-        return xp.concatenate([counts, xp.asarray(prepared["nulls"])], axis=1)
+        return xp.asarray(prepared["mat"])
 
     def vector_metadata(self) -> VectorMetadata:
         names = self._names()

@@ -69,43 +69,28 @@ class OneHotModel(VectorizerModel):
         Output per feature: f64[n, K+1(+1)] already scattered — the one-hot
         scatter is host work because the vocab lookup is; device_compute is
         then a pure concat (fusable into the layer's XLA computation).
+        Vocab lookup + scatter are vectorized (ops/_hostvec.py): one dict
+        probe per UNIQUE value, one fancy-index per feature.
         """
-        blocks = []
-        for name, vocab in zip(self._names(), self.vocabs):
+        from ._hostvec import multihot_block, onehot_block
+        names = self._names()
+        n = store.n_rows
+        nul = 1 if self.track_nulls else 0
+        widths = [len(v) + 1 + nul for v in self.vocabs]
+        mat = np.zeros((n, sum(widths)), dtype=np.float64)
+        off = 0
+        for name, vocab, w in zip(names, self.vocabs, widths):
             col = store[name]
-            index = {v: i for i, v in enumerate(vocab)}
-            k = len(vocab)
-            width = k + 1 + (1 if self.track_nulls else 0)
-            block = np.zeros((len(col), width), dtype=np.float64)
+            sect = mat[:, off:off + w]
             if isinstance(col, TextSetColumn):
-                for r, values in enumerate(col.values):
-                    if not values:
-                        if self.track_nulls:
-                            block[r, k + 1] = 1.0
-                        continue
-                    for v in values:
-                        i = index.get(v)
-                        if i is None:
-                            block[r, k] = 1.0
-                        else:
-                            block[r, i] = 1.0
+                multihot_block(col.values, vocab, self.track_nulls, out=sect)
             else:
-                for r, v in enumerate(col.values):
-                    if v is None:
-                        if self.track_nulls:
-                            block[r, k + 1] = 1.0
-                        continue
-                    i = index.get(v)
-                    if i is None:
-                        block[r, k] = 1.0
-                    else:
-                        block[r, i] = 1.0
-            blocks.append(block)
-        return {f"block{i}": b for i, b in enumerate(blocks)}
+                onehot_block(col.values, vocab, self.track_nulls, out=sect)
+            off += w
+        return {"mat": mat}
 
     def device_compute(self, xp, prepared):
-        blocks = [prepared[f"block{i}"] for i in range(len(self.vocabs))]
-        return xp.concatenate([xp.asarray(b) for b in blocks], axis=1)
+        return xp.asarray(prepared["mat"])
 
     def vector_metadata(self) -> VectorMetadata:
         cols: List[VectorColumnMetadata] = []
@@ -144,11 +129,8 @@ class OneHotVectorizer(VectorizerEstimator):
         self.track_nulls = track_nulls
 
     def _count(self, col) -> Counter:
-        c: Counter = Counter()
-        for v in col.values:
-            if v is not None:
-                c[v] += 1
-        return c
+        from ._hostvec import value_counts
+        return value_counts(col.values)
 
     def fit_columns(self, store: ColumnStore) -> OneHotModel:
         vocabs = [_sorted_topk(self._count(store[n]), self.top_k,
@@ -168,11 +150,9 @@ class SetVectorizer(OneHotVectorizer):
     seq_type = OPSet
 
     def _count(self, col) -> Counter:
-        c: Counter = Counter()
-        for values in col.values:
-            for v in values:
-                c[v] += 1
-        return c
+        from ._hostvec import flatten_ragged, value_counts
+        flat, _rows, _lengths = flatten_ragged(col.values)
+        return value_counts(flat)
 
     def fit_columns(self, store: ColumnStore) -> OneHotModel:
         vocabs = [_sorted_topk(self._count(store[n]), self.top_k,

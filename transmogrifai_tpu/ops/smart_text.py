@@ -80,50 +80,63 @@ class SmartTextVectorizerModel(VectorizerModel):
             return [f.name for f in self.input_features]
         return self.input_names_saved
 
+    def _widths(self) -> List[int]:
+        widths = []
+        vocab_iter = iter(self.vocabs)
+        nul = 1 if self.track_nulls else 0
+        for cat in self.is_categorical:
+            if cat:
+                widths.append(len(next(vocab_iter)) + 1 + nul)
+            else:
+                widths.append(self.num_features
+                              + (1 if self.track_text_len else 0) + nul)
+        return widths
+
     def host_prepare(self, store: ColumnStore) -> Dict[str, np.ndarray]:
+        """One full-width matrix written in place — per-feature sections are
+        views, so no concat copy ever happens (a full copy of a 512-wide
+        hash block costs seconds on one host core)."""
+        from ._hostvec import hashed_count_block, onehot_block
         names = self._names()
         n = store.n_rows
-        blocks: Dict[str, np.ndarray] = {}
+        widths = self._widths()
+        mat = np.zeros((n, sum(widths)), dtype=np.float64)
         vocab_iter = iter(self.vocabs)
+        off = 0
         for j, name in enumerate(names):
             col = store[name]
+            sect = mat[:, off:off + widths[j]]
             if self.is_categorical[j]:
                 vocab = next(vocab_iter)
-                index = {v: i for i, v in enumerate(vocab)}
-                k = len(vocab)
-                width = k + 1 + (1 if self.track_nulls else 0)
-                block = np.zeros((n, width), dtype=np.float64)
-                for r, v in enumerate(col.values):
-                    if v is None:
-                        if self.track_nulls:
-                            block[r, k + 1] = 1.0
-                    elif v in index:
-                        block[r, index[v]] = 1.0
-                    else:
-                        block[r, k] = 1.0
+                onehot_block(col.values, vocab, self.track_nulls, out=sect)
             else:
-                extra = (1 if self.track_text_len else 0) + \
-                    (1 if self.track_nulls else 0)
-                block = np.zeros((n, self.num_features + extra),
-                                 dtype=np.float64)
-                for r, v in enumerate(col.values):
-                    if v is None:
-                        if self.track_nulls:
-                            block[r, -1] = 1.0
-                        continue
-                    toks = tokenize_simple(v)
-                    if toks:
-                        hashed = hash_tokens(toks, self.seed) % self.num_features
-                        np.add.at(block[r], hashed, 1.0)
-                    if self.track_text_len:
-                        block[r, self.num_features] = float(len(v))
-            blocks[f"block{j}"] = block
-        return blocks
+                # tokenize per UNIQUE text (free-form text repeats less than
+                # categoricals, but short fields repeat plenty), then one
+                # bulk hashed scatter
+                vals = np.array([v if v is not None else "" for v in
+                                 col.values], dtype=object)
+                null_mask = np.fromiter((v is None for v in col.values),
+                                        bool, count=n)
+                uniq, inv = np.unique(vals, return_inverse=True)
+                toks = [tokenize_simple(u) for u in uniq.tolist()]
+                row_tokens = [
+                    [] if null_mask[r] else toks[i]
+                    for r, i in enumerate(inv)]
+                hashed_count_block(
+                    row_tokens, self.num_features, self.seed, False,
+                    out=mat, col_offset=off)
+                if self.track_text_len:
+                    lens = np.fromiter((len(v) for v in vals), np.float64,
+                                       count=n)
+                    sect[:, self.num_features] = np.where(null_mask, 0.0,
+                                                          lens)
+                if self.track_nulls:
+                    sect[null_mask, -1] = 1.0
+            off += widths[j]
+        return {"mat": mat}
 
     def device_compute(self, xp, prepared):
-        blocks = [xp.asarray(prepared[f"block{j}"])
-                  for j in range(len(self._names()))]
-        return xp.concatenate(blocks, axis=1)
+        return xp.asarray(prepared["mat"])
 
     def vector_metadata(self) -> VectorMetadata:
         from ..vector_metadata import OTHER_INDICATOR

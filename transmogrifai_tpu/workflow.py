@@ -72,6 +72,116 @@ def _generate_raw_store(data, raw_features: Sequence[Feature]) -> ColumnStore:
     return ColumnStore(cols, len(records))
 
 
+#: row count from which the layer's vectorizer transforms run as ONE jitted
+#: XLA computation (below it, numpy wins: compile cost > compute)
+FUSE_MIN_ROWS = 20_000
+
+#: minimum measured host↔device round-trip bandwidth (MB/s) for layer
+#: fusion to pay off. A transform layer's device work is memory-bound
+#: (scatter/concat), so pushing the prepared blocks through a slow link —
+#: e.g. a network-tunnelled TPU at ~10MB/s — costs far more than numpy
+#: computes them. Local CPU backends (memcpy) and PCIe/ICI-attached chips
+#: clear this easily; remote tunnels do not.
+FUSE_MIN_BANDWIDTH_MBPS = 500.0
+
+_DEVICE_BW_MBPS: Optional[float] = None
+
+#: jitted per-layer programs keyed by (model ids, prepared shapes)
+_LAYER_JIT_CACHE: Dict[Any, Any] = {}
+
+
+def device_roundtrip_mbps() -> float:
+    """Measured host→device→host bandwidth (MB/s); probed once per process
+    with a 4MB buffer and cached."""
+    global _DEVICE_BW_MBPS
+    if _DEVICE_BW_MBPS is None:
+        import jax
+
+        buf = np.zeros((1 << 20,), np.float32)  # 4 MB
+        best = 0.0
+        for _ in range(2):  # first pass absorbs backend/dispatch warm-up
+            t0 = time.time()
+            np.asarray(jax.block_until_ready(jax.device_put(buf)))
+            dt = max(time.time() - t0, 1e-9)
+            best = max(best, (2 * buf.nbytes / 1e6) / dt)
+        _DEVICE_BW_MBPS = best
+    return _DEVICE_BW_MBPS
+
+
+def apply_layer_vectorized(models: Sequence[Transformer], store: ColumnStore,
+                           fuse_min_rows: Optional[int] = None) -> ColumnStore:
+    """Transform a DAG layer, fusing its vectorizers into one XLA program.
+
+    The reference fuses a layer's row transformers into one RDD map
+    (``FitStagesUtil.applyOpTransformations`` :96-119). Here every
+    VectorizerModel in the layer contributes its ``device_compute`` to ONE
+    jitted function: host_prepare runs per model on the host, then a single
+    compiled XLA computation produces every output matrix — XLA fuses the
+    elementwise work across stages and the data crosses host↔device once
+    per layer. Non-vectorizer transformers apply as usual.
+
+    The fused path engages only when ALL of these hold; otherwise the
+    numerically identical numpy path runs:
+
+    * ``store.n_rows >= fuse_min_rows`` — below it, compile cost dominates;
+    * ``jax_enable_x64`` is on — otherwise jit would silently round the f64
+      blocks to f32 and drift from the numpy path (train/serve skew);
+    * measured host↔device bandwidth clears ``FUSE_MIN_BANDWIDTH_MBPS`` —
+      a transform layer is memory-bound, so on a slow link (e.g. a
+      network-tunnelled TPU) the round-trip costs more than the compute.
+
+    In the production TPU configuration (x64 off) transforms therefore run
+    on host by design — the device is reserved for the model math, where
+    the FLOPs are. A planned f32 end-to-end migration of the vector
+    pipeline will let the fused path run on TPU natively.
+    """
+    from .columns import VectorColumn
+    from .ops.vectorizer_base import VectorizerModel
+    from .types.feature_types import OPVector
+
+    import jax
+
+    threshold = FUSE_MIN_ROWS if fuse_min_rows is None else fuse_min_rows
+    vecs = [m for m in models if isinstance(m, VectorizerModel)]
+    rest = [m for m in models if not isinstance(m, VectorizerModel)]
+    # x64 gate: without jax_enable_x64 the jit would silently canonicalize
+    # the f64 prepared blocks to f32 and fused results would drift from the
+    # numpy path (e.g. bucket edges within f32 eps) — train/serve skew.
+    if (len(vecs) >= 1 and store.n_rows >= threshold
+            and jax.config.jax_enable_x64
+            and device_roundtrip_mbps() >= FUSE_MIN_BANDWIDTH_MBPS):
+        import jax.numpy as jnp
+
+        preps = [m.host_prepare(store) for m in vecs]
+        key = (tuple(id(m) for m in vecs),
+               tuple((k, tuple(np.shape(v)), str(np.asarray(v).dtype))
+                     for p in preps for k, v in sorted(p.items())))
+        jitted = _LAYER_JIT_CACHE.pop(key, None)
+        if jitted is None:
+            def layer_fn(prepared_list):
+                return tuple(m.device_compute(jnp, p)
+                             for m, p in zip(vecs, prepared_list))
+            jitted = jax.jit(layer_fn)
+        # LRU: re-insert on use, evict oldest beyond cap (stale entries pin
+        # their model objects + compiled executables otherwise)
+        _LAYER_JIT_CACHE[key] = jitted
+        while len(_LAYER_JIT_CACHE) > 32:
+            _LAYER_JIT_CACHE.pop(next(iter(_LAYER_JIT_CACHE)))
+        outs = jitted(preps)
+        for m, mat in zip(vecs, outs):
+            mat = np.asarray(mat, dtype=np.float64)
+            meta = m.vector_metadata()
+            assert mat.ndim == 2 and mat.shape[1] == meta.size, \
+                (type(m).__name__, mat.shape, meta.size)
+            store = store.with_column(m.output_name,
+                                      VectorColumn(OPVector, mat, meta))
+    else:
+        rest = list(models)
+    for m in rest:
+        store = m.transform(store)
+    return store
+
+
 class Workflow:
     """Untrained pipeline: raw data + result features → fitted model."""
 
@@ -201,11 +311,11 @@ class Workflow:
                     models.append(stage)
                 else:
                     raise WorkflowError(f"Unfittable stage {stage!r}")
-            # transform both splits with the fully fitted layer
-            for m in models:
-                train = m.transform(train)
-                if test is not None:
-                    test = m.transform(test)
+            # transform both splits with the fully fitted layer — the
+            # layer's vectorizers fuse into one XLA program per split
+            train = apply_layer_vectorized(models, train)
+            if test is not None:
+                test = apply_layer_vectorized(models, test)
         return fitted, time.time() - t0
 
 
@@ -261,9 +371,9 @@ class WorkflowModel:
         needed = (None if up_to is None else
                   {s.uid for s in up_to.parent_stages()})
         for layer in self._resolved_dag():
-            for m in layer:
-                if needed is None or m.uid in needed:
-                    store = m.transform(store)
+            wanted = [m for m in layer
+                      if needed is None or m.uid in needed]
+            store = apply_layer_vectorized(wanted, store)
         return store
 
     def score(self, data, keep_intermediate: bool = False) -> ColumnStore:
