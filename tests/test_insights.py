@@ -164,3 +164,73 @@ def test_tree_contributions_use_real_splits(rng):
     imp = ModelInsights._contributions(model)
     assert imp is not None
     assert int(np.argmax(imp)) == 3
+
+
+def test_loco_model_rebinds_after_save_load(rng, tmp_path):
+    """get_params drops the live model object; save/load must re-attach it
+    by uid so a loaded workflow's LOCO stage still scores (ADVICE r1)."""
+    model, store, pred = _fitted_workflow(rng)
+    selected = model.stage_of(pred)
+    vec_feature = selected.input_features[1]
+    loco = RecordInsightsLOCO(model=selected, top_k=3)
+    loco.set_input(vec_feature)
+    insights_f = loco.get_output()
+
+    from transmogrifai_tpu.workflow import WorkflowModel
+    wm = WorkflowModel(
+        result_features=[pred, insights_f],
+        fitted_stages={**model.fitted_stages, loco.uid: loco})
+    path = str(tmp_path / "m")
+    wm.save(path)
+
+    from transmogrifai_tpu.model_io import load_workflow_model
+    loaded = load_workflow_model(path)
+    insights_loaded = next(f for f in loaded.result_features
+                           if f.name == insights_f.name)
+    loco2 = insights_loaded.origin_stage
+    assert isinstance(loco2, RecordInsightsLOCO)
+    assert loco2.model is not None and loco2.model.uid == selected.uid
+    out = loaded.transform(store)
+    row = parse_insights(out[insights_f.name].get_raw(0))
+    assert 0 < len(row) <= 3
+
+
+def test_loco_copy_carries_model(rng):
+    model = LogisticRegressionModel(np.ones(3), 0.0, 2)
+    loco = RecordInsightsLOCO(model=model, top_k=2)
+    c = loco.copy()
+    assert c.model is model
+
+    unbound = RecordInsightsLOCO(model=None, model_uid="X_0")
+    meta = VectorMetadata("features", [
+        VectorColumnMetadata(f"x{i}", "Real") for i in range(3)])
+    store = ColumnStore({"features": VectorColumn(
+        ft.OPVector, np.zeros((2, 3)), meta)})
+    feat = FeatureBuilder.OPVector("features").from_column().as_predictor()
+    unbound.set_input(feat)
+    with pytest.raises(RuntimeError, match="unbound"):
+        unbound.transform_columns(store)
+
+
+def test_tree_contributions_gain_weighted(rng):
+    """A high-gain feature must outrank a correlated low-gain one even when
+    both split equally often (gain weighting, reference featureImportances)."""
+    from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+    n, d = 500, 3
+    X = rng.normal(size=(n, d))
+    y = (X[:, 1] + 0.2 * X[:, 2] > 0).astype(float)
+    meta = VectorMetadata("features", [
+        VectorColumnMetadata(f"x{i}", "Real") for i in range(d)])
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "features": VectorColumn(ft.OPVector, X, meta),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+    est = OpRandomForestClassifier(num_trees=10, max_depth=4)
+    est.set_input(label, feats)
+    fitted = est.fit(store)
+    assert "gain" in fitted.trees
+    imp = ModelInsights._contributions(fitted)
+    assert imp is not None and abs(imp.sum() - 1.0) < 1e-6
+    assert int(np.argmax(imp)) == 1

@@ -201,3 +201,40 @@ def test_predictor_missing_from_scoring_store_is_excluded(rng):
     assert "gone" in bad and "x" not in bad
     r = {x.name: x for x in out.results.exclusion_reasons}
     assert r["gone"].scoring_unfilled_state
+
+
+def test_map_key_missing_from_scoring_store_is_excluded(rng):
+    """A map key present in training but absent from the scoring store must
+    face the scoring-side gates via a synthesized all-null distribution
+    (ADVICE r1; ref: empty scoring FeatureDistribution → fill rate 0)."""
+    n = 200
+    y = rng.integers(0, 2, size=n).astype(float)
+    train_maps = [{"stays": float(rng.normal()),
+                   "vanishes": float(rng.normal())} for _ in range(n)]
+    score_maps = [{"stays": float(rng.normal())} for _ in range(n)]
+    train = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "m": column_from_values(ft.RealMap, train_maps),
+    })
+    score = ColumnStore({"m": column_from_values(ft.RealMap, score_maps)})
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    m = FeatureBuilder.RealMap("m").from_column().as_predictor()
+    out = RawFeatureFilter(min_fill=0.10).filter_raw(
+        train, [label, m], scoring_data=score)
+    assert out.blacklisted_map_keys.get("m") == ["vanishes"]
+    r = {(x.name, x.key): x for x in out.results.exclusion_reasons}
+    assert r[("m", "vanishes")].scoring_unfilled_state
+    assert not r[("m", "stays")].excluded
+
+
+def test_distribution_monoid_is_total(rng):
+    """Adding a populated distribution to an empty-histogram accumulator
+    must work from BOTH sides (ADVICE r1)."""
+    from transmogrifai_tpu.filters.distribution import FeatureDistribution
+    full = FeatureDistribution("f", None, 10, 2, np.array([1.0, 2.0, 3.0]),
+                               [0.0, 1.0, 2.0, 3.0])
+    empty = FeatureDistribution("f")
+    for a, b in ((full, empty), (empty, full)):
+        s = a + b
+        assert s.count == 10 and s.nulls == 2
+        assert np.allclose(s.distribution, full.distribution)

@@ -78,8 +78,14 @@ class FeatureDistribution:
 
     def __add__(self, other: "FeatureDistribution") -> "FeatureDistribution":
         assert self.name == other.name and self.key == other.key
-        dist = (self.distribution + other.distribution
-                if self.distribution.size else other.distribution.copy())
+        # total monoid: either side may carry an empty histogram (e.g. a
+        # default-constructed accumulator)
+        if not self.distribution.size:
+            dist = other.distribution.copy()
+        elif not other.distribution.size:
+            dist = self.distribution.copy()
+        else:
+            dist = self.distribution + other.distribution
         return FeatureDistribution(self.name, self.key,
                                    self.count + other.count,
                                    self.nulls + other.nulls, dist,

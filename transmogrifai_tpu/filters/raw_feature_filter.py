@@ -264,6 +264,18 @@ class RawFeatureFilter:
         train_dists = self._distributions(store, predictors, summaries)
         score_dists = (self._distributions(score_store, predictors, summaries)
                        if score_store is not None else {})
+        if score_store is not None:
+            # a map key seen in training but entirely absent from the scoring
+            # store must still face the scoring-side gates: synthesize an
+            # all-null distribution (fill rate 0), as the reference's empty
+            # scoring distribution does (FeatureDistribution.scala)
+            n_score = score_store.n_rows
+            for (name, key), td in train_dists.items():
+                if (name, key) not in score_dists:
+                    score_dists[(name, key)] = FeatureDistribution(
+                        name=name, key=key, count=n_score, nulls=n_score,
+                        distribution=np.zeros_like(td.distribution),
+                        summary_info=list(td.summary_info))
         corrs = self._null_label_corrs(
             store, predictors, self._label_vector(store, responses))
 

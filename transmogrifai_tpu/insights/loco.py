@@ -39,9 +39,11 @@ class RecordInsightsLOCO(Transformer):
     output_type = TextMap
 
     def __init__(self, model: Optional[Any] = None, top_k: int = 20,
-                 column_chunk: int = 128, uid: Optional[str] = None):
+                 column_chunk: int = 128, model_uid: Optional[str] = None,
+                 uid: Optional[str] = None):
         super().__init__(uid=uid)
         self.model = model
+        self.model_uid = model_uid or getattr(model, "uid", None)
         self.top_k = top_k
         self.column_chunk = column_chunk
 
@@ -79,6 +81,12 @@ class RecordInsightsLOCO(Transformer):
 
     # -- stage API ---------------------------------------------------------
     def transform_columns(self, store: ColumnStore) -> Column:
+        if self.model is None:
+            raise RuntimeError(
+                f"{self.stage_name()}: model is unbound. The model reference "
+                "is serialized by uid (model_uid="
+                f"{self.model_uid!r}); load via WorkflowModel (which rebinds "
+                "it) or pass model= explicitly.")
         col = store[self.input_features[0].name]
         assert isinstance(col, VectorColumn)
         X = np.asarray(col.values, dtype=np.float64)
@@ -100,8 +108,19 @@ class RecordInsightsLOCO(Transformer):
 
     def get_params(self) -> Dict[str, Any]:
         p = super().get_params()
-        p.pop("model", None)  # resolved from the workflow's fitted stages
+        p.pop("model", None)  # re-bound by uid: see rebind_stages
+        p["model_uid"] = self.model_uid
         return p
+
+    def copy(self):
+        new = super().copy()
+        new.model = self.model  # carry the live reference through copy_dag
+        return new
+
+    def rebind_stages(self, stage_by_uid: Dict[str, Any]) -> None:
+        """Re-attach the scored model after load (called by model_io)."""
+        if self.model is None and self.model_uid:
+            self.model = stage_by_uid.get(self.model_uid)
 
 
 def parse_insights(value: str) -> Dict[str, float]:

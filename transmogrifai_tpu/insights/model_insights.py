@@ -196,11 +196,25 @@ class ModelInsights:
         if trees and "feat" in trees and "thr" in trees:
             feat = np.asarray(trees["feat"])      # [n_trees, n_nodes]
             thr = np.asarray(trees["thr"])
-            used = feat[np.isfinite(thr)].astype(np.int64)  # real splits only
+            mask = np.isfinite(thr)               # real splits only
+            used = feat[mask].astype(np.int64)
             if used.size:
+                gain = trees.get("gain")
+                if gain is not None:
+                    # gain-weighted impurity reduction — the reference's
+                    # featureImportances semantics (treeinterpreter style);
+                    # XGB gains can be negative under its -inf split floor
+                    w = np.maximum(
+                        np.asarray(gain, dtype=np.float64)[mask], 0.0)
+                else:  # older saved models: split-frequency fallback
+                    w = np.ones(used.shape, dtype=np.float64)
                 d = int(used.max()) + 1
-                imp = np.bincount(used, minlength=d).astype(np.float64)
-                return imp / imp.sum()
+                imp = np.bincount(used, weights=w, minlength=d)
+                tot = imp.sum()
+                if tot <= 0:   # e.g. XGB where every gain clipped to 0
+                    imp = np.bincount(used, minlength=d).astype(np.float64)
+                    tot = imp.sum()
+                return imp / tot if tot > 0 else imp
         return None
 
     @staticmethod

@@ -177,6 +177,12 @@ def load_workflow_model(path: str):
                     setattr(stage, k, v)
         stage_by_uid[rec["uid"]] = stage
 
+    # stages that reference other stages (e.g. RecordInsightsLOCO's scored
+    # model) re-attach them by uid now that every stage exists
+    for stage in stage_by_uid.values():
+        if hasattr(stage, "rebind_stages"):
+            stage.rebind_stages(stage_by_uid)
+
     feat_by_uid: Dict[str, Feature] = {}
     for frec in doc["features"]:
         stage = stage_by_uid.get(frec["originStageUid"])

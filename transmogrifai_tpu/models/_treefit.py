@@ -381,13 +381,14 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
                   .at[rchild].set(parent_ok, mode="drop"))
         # record (compact): dense node id per slot, sentinel 2^D if dead
         rec_pos = jnp.where(alive, gpos, jnp.int32(1 << max_depth))
-        return (slot2, g2, gpos2, alive2), (f_idx, thr, rec_pos)
+        g_rec = jnp.where(do_split, best_gain, 0).astype(stats.dtype)
+        return (slot2, g2, gpos2, alive2), (f_idx, thr, rec_pos, g_rec)
 
     slot0 = jnp.zeros((n,), jnp.int32)
     g0 = jnp.zeros((n,), jnp.int32)
     gpos0 = jnp.zeros((A,), jnp.int32)
     alive0 = jnp.arange(A) == 0
-    (_, g, _, _), (f_rec, t_rec, pos_rec) = lax.scan(
+    (_, g, _, _), (f_rec, t_rec, pos_rec, gain_rec) = lax.scan(
         level, (slot0, g0, gpos0, alive0),
         jnp.arange(max_depth, dtype=jnp.int32))
 
@@ -401,6 +402,8 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
         f_rec.ravel(), mode="drop")
     thr = jnp.full((total_nodes,), jnp.inf, t_rec.dtype).at[idx].set(
         t_rec.ravel(), mode="drop")
+    gain = jnp.zeros((total_nodes,), stats.dtype).at[idx].set(
+        gain_rec.ravel(), mode="drop")
 
     # leaf values: one MXU matmul instead of a vmapped scatter
     mm_dtype = jnp.bfloat16 if stats.dtype == jnp.float32 else stats.dtype
@@ -408,7 +411,7 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
     leaf_stats = jnp.matmul(onehot_leaf.T, stats.astype(mm_dtype),
                             preferred_element_type=stats.dtype)
     leaf = leaf_fn(leaf_stats)
-    return feat, thr, leaf, g
+    return feat, thr, leaf, g, gain
 
 
 def predict_tree(feat, thr, leaf, X, max_depth: int) -> jnp.ndarray:
@@ -433,9 +436,12 @@ def predict_ensemble(feat, thr, leaf, tree_w, X, max_depth: int,
     Trees are routed in vmapped chunks (one batched fori_loop routes
     ``tree_chunk`` trees at once) under a scan that bounds the [chunk, n, K]
     intermediate — a per-tree scan would serialize T × max_depth tiny
-    gather steps."""
+    gather steps. The chunk also shrinks with n: the [c, n, K] leaf tensor
+    tile-pads K→128 on TPU, so c is capped at ~1GB of padded transient."""
     T = feat.shape[0]
-    c = max(1, min(tree_chunk, T))
+    n = X.shape[0]
+    byte_cap = max(1, int(1e9 // (max(n, 1) * 128 * 4)))
+    c = max(1, min(tree_chunk, T, byte_cap))
     pad = (-T) % c
     if pad:
         feat = jnp.concatenate([feat, jnp.zeros((pad,) + feat.shape[1:],
@@ -551,12 +557,12 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
 
     def fit_one(bw, fm):
         wt = w * bw
-        feat, thr, leaf, node = grow_tree(
+        feat, thr, leaf, node, gain = grow_tree(
             Xb, edges, make_stats(wt), crit, leaf_fn, max_depth,
             n_bins, min_instances, min_info_gain, depth_limit=depth_limit,
             feat_mask=fm, max_active_nodes=max_active_nodes,
             col_blocks=col_blocks)
-        return feat, thr, leaf, node
+        return feat, thr, leaf, node, gain
 
     c = max(1, min(tree_chunk, n_trees))
     pad = (-n_trees) % c
@@ -568,19 +574,20 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
     def body(_, per_chunk):
         bw, fm = per_chunk                             # [c, n], [c, F]
         return None, jax.vmap(fit_one)(bw, fm)
-    _, (feat, thr, leaf, node) = lax.scan(
+    _, (feat, thr, leaf, node, gain) = lax.scan(
         body, None, (boot.reshape(nc, c, n), fmask.reshape(nc, c, F)))
     feat = feat.reshape((nc * c,) + feat.shape[2:])[:n_trees]
     thr = thr.reshape((nc * c,) + thr.shape[2:])[:n_trees]
     leaf = leaf.reshape((nc * c,) + leaf.shape[2:])[:n_trees]
     node = node.reshape((nc * c,) + node.shape[2:])[:n_trees]
+    gain = gain.reshape((nc * c,) + gain.shape[2:])[:n_trees]
     tree_w = (jnp.arange(n_trees) < num_trees_used).astype(X.dtype)
     tree_w = tree_w / jnp.maximum(tree_w.sum(), 1.0)
     # train_node caches the fit-time sample→leaf routing: predicting the
     # TRAINING matrix (the CV sweep's case) is then leaf gathers only — no
     # per-level tree routing (which runs on the slow scalar core).
     return {"feat": feat, "thr": thr, "leaf": leaf, "tree_w": tree_w,
-            "train_node": node}
+            "train_node": node, "gain": gain * tree_w[:, None]}
 
 
 # ---------------------------------------------------------------------------
@@ -607,20 +614,21 @@ def fit_gbt(X, y, w, *, task: str, n_rounds: int, max_depth: int,
         r = residual(Fm)
         stats = jnp.stack([w, w * r, w * r * r,
                            (w > 0).astype(X.dtype)], axis=1)
-        feat, thr, leaf, node = grow_tree(
+        feat, thr, leaf, node, gain = grow_tree(
             Xb, edges, stats, VarianceCriterion(), variance_leaf, max_depth,
             n_bins, min_instances, min_info_gain, depth_limit=depth_limit,
             max_active_nodes=max_active_nodes, col_blocks=col_blocks)
         use = (t < num_rounds_used).astype(X.dtype)
         scale = use * step_size
         Fm = Fm + scale * leaf[node][:, 0]
-        return Fm, (feat, thr, leaf * scale)
+        return Fm, (feat, thr, leaf * scale, gain * use)
     F0 = jnp.zeros((n,), X.dtype)
-    Fm, (feat, thr, leaf) = lax.scan(body, F0, jnp.arange(n_rounds))
+    Fm, (feat, thr, leaf, gain) = lax.scan(body, F0, jnp.arange(n_rounds))
     # train_margin caches the final boosted margin on the training matrix
     # (see fit_forest.train_node) — CV predict needs no routing at all.
     return {"feat": feat, "thr": thr, "leaf": leaf,
-            "tree_w": jnp.ones((n_rounds,), X.dtype), "train_margin": Fm}
+            "tree_w": jnp.ones((n_rounds,), X.dtype), "train_margin": Fm,
+            "gain": gain}
 
 
 # ---------------------------------------------------------------------------
@@ -648,7 +656,7 @@ def fit_xgb(X, y, w, *, task: str, n_rounds: int, max_depth: int,
     def body(Fm, t):
         g, h = grads(Fm)
         stats = jnp.stack([g, h, (w > 0).astype(X.dtype)], axis=1)
-        feat, thr, leaf, node = grow_tree(
+        feat, thr, leaf, node, gain = grow_tree(
             Xb, edges, stats, crit, leaf_fn, max_depth, n_bins,
             jnp.asarray(0.0, X.dtype), jnp.asarray(-1e29, X.dtype),
             depth_limit=depth_limit, max_active_nodes=max_active_nodes,
@@ -656,11 +664,12 @@ def fit_xgb(X, y, w, *, task: str, n_rounds: int, max_depth: int,
         use = (t < num_rounds_used).astype(X.dtype)
         scale = use * eta
         Fm = Fm + scale * leaf[node][:, 0]
-        return Fm, (feat, thr, leaf * scale)
+        return Fm, (feat, thr, leaf * scale, gain * use)
     F0 = jnp.zeros((n,), X.dtype)
-    Fm, (feat, thr, leaf) = lax.scan(body, F0, jnp.arange(n_rounds))
+    Fm, (feat, thr, leaf, gain) = lax.scan(body, F0, jnp.arange(n_rounds))
     return {"feat": feat, "thr": thr, "leaf": leaf,
-            "tree_w": jnp.ones((n_rounds,), X.dtype), "train_margin": Fm}
+            "tree_w": jnp.ones((n_rounds,), X.dtype), "train_margin": Fm,
+            "gain": gain}
 
 
 # ---------------------------------------------------------------------------
