@@ -2,7 +2,7 @@
 import numpy as np
 import pytest
 
-from transmogrifai_tpu import ColumnStore, FeatureBuilder
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, column_from_values
 from transmogrifai_tpu.ops import (BinaryVectorizer, IntegralVectorizer,
                                    OneHotVectorizer, RealVectorizer,
                                    SetVectorizer, SmartTextVectorizer,
@@ -188,3 +188,43 @@ def test_transmogrify_end_to_end_workflow():
     fn = model.score_fn()
     row_out = fn({"age": 22.0, "cls": 1, "sex": "m"})
     np.testing.assert_allclose(np.asarray(row_out[vec.name]), out.values[0])
+
+
+def test_string_indexer_roundtrip(rng):
+    """OpStringIndexerNoFilter → PredictionDeIndexer label round-trip
+    (OpStringIndexerNoFilter.scala:48-74, PredictionDeIndexer.scala:52-88)."""
+    from transmogrifai_tpu.columns import PredictionColumn
+    from transmogrifai_tpu.ops.indexers import (OpIndexToStringNoFilter,
+                                                OpStringIndexerNoFilter,
+                                                PredictionDeIndexer)
+
+    vals = ["b", "a", "b", None, "c", "b", "a"]
+    store = ColumnStore({"lbl": column_from_values(ft.Text, vals)})
+    f = FeatureBuilder.Text("lbl").from_column().as_response()
+    est = OpStringIndexerNoFilter()
+    est.set_input(f)
+    model = est.fit(store)
+    # frequency desc: b(3), a(2), then c/null(1 each, label asc)
+    assert model.labels == ["b", "a", "c", "null"]
+    out = model.transform(store)
+    col = out[model.output_name]
+    assert col.values.tolist() == [0.0, 1.0, 0.0, 3.0, 2.0, 0.0, 1.0]
+    assert col.labels[-1] == "UnseenLabel"
+
+    # idx2str
+    i2s = OpIndexToStringNoFilter(labels=model.labels)
+    i2s.set_input(model.get_output())
+    back = i2s.transform_columns(out)
+    assert back.values.tolist() == ["b", "a", "b", "null", "c", "b", "a"]
+
+    # deindex a Prediction column via the response metadata
+    pred_col = PredictionColumn(np.array([1.0, 0.0, 9.0]),
+                                np.zeros((3, 0)), np.zeros((3, 0)))
+    st2 = ColumnStore({model.output_name: col.take(np.array([0, 1, 2])),
+                       "pred": pred_col})
+    pf = FeatureBuilder.Prediction("pred").from_column().as_predictor()
+    de = PredictionDeIndexer()
+    de.set_input(model.get_output(), pf)
+    dm = de.fit(st2)
+    got = dm.transform_columns(st2)
+    assert got.values.tolist() == ["a", "b", "UnseenLabel"]

@@ -69,6 +69,10 @@ class NumericColumn(Column):
     ftype: Type[FeatureType]
     values: np.ndarray
     mask: np.ndarray
+    #: index → label mapping when this column holds string-indexed values
+    #: (Spark's NominalAttribute metadata analog; consumed by
+    #: PredictionDeIndexer and DataCutter)
+    labels: Optional[List[str]] = None
 
     def __post_init__(self):
         assert self.values.shape == self.mask.shape, (self.values.shape, self.mask.shape)
@@ -83,7 +87,8 @@ class NumericColumn(Column):
         return v.item() if isinstance(v, np.generic) else v
 
     def take(self, indices: np.ndarray) -> "NumericColumn":
-        return NumericColumn(self.ftype, self.values[indices], self.mask[indices])
+        return NumericColumn(self.ftype, self.values[indices],
+                             self.mask[indices], self.labels)
 
     def astype_float(self) -> np.ndarray:
         return self.values.astype(np.float64)

@@ -8,3 +8,9 @@ from .dates import DateToUnitCircleVectorizer, TimePeriod  # noqa: F401
 from .geo import GeolocationVectorizer  # noqa: F401
 from .vectors import VectorsCombiner, StandardScalerEstimator  # noqa: F401
 from .transmogrifier import Transmogrifier, transmogrify  # noqa: F401
+from .indexers import (OpStringIndexerNoFilter, OpStringIndexerModel,  # noqa: F401
+                       OpIndexToStringNoFilter, PredictionDeIndexer,
+                       PredictionDeIndexerModel)
+from .text_suite import (OpCountVectorizer, CountVectorizerModel,  # noqa: F401
+                         NGramSimilarity, EmailParser, PhoneNumberParser,
+                         UrlParser, MimeTypeDetector)

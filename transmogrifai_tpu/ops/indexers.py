@@ -1,0 +1,190 @@
+"""String indexing — text labels ⇄ numeric indices.
+
+Parity:
+
+* ``OpStringIndexerNoFilter`` (``core/.../impl/feature/OpStringIndexerNoFilter.scala:48-74``):
+  fit orders labels by descending frequency (Spark StringIndexer default),
+  null maps to the literal label ``"null"``, and unseen values at transform
+  time take index ``len(labels)`` under the ``unseen_name`` label.
+* ``OpIndexToStringNoFilter`` (``OpIndexToString.scala``): index → label,
+  out-of-range → ``unseen_name``.
+* ``PredictionDeIndexer`` (``core/.../impl/preparators/PredictionDeIndexer.scala:52-88``):
+  estimator over (indexed response, prediction) that reads the label mapping
+  from the response column's metadata — here the ``labels`` attribute of
+  :class:`~transmogrifai_tpu.columns.NumericColumn`, the NominalAttribute
+  analog — and deindexes predictions back to label strings.
+
+TPU note: indexing itself is host work (strings live on host); the indexed
+output is a dense f64 column + labels metadata, ready for the device.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..columns import (Column, ColumnStore, NumericColumn, PredictionColumn,
+                       TextColumn)
+from ..stages.base import (AllowLabelAsInput, Estimator, FittedModel,
+                           FixedArity, InputSpec, Transformer, register_stage)
+from ..types.feature_types import Prediction, RealNN, Text
+
+__all__ = ["OpStringIndexerNoFilter", "OpStringIndexerModel",
+           "OpIndexToStringNoFilter", "PredictionDeIndexer",
+           "PredictionDeIndexerModel"]
+
+UNSEEN_DEFAULT = "UnseenLabel"
+NULL_LABEL = "null"   # reference maps None to the literal "null"
+
+
+@register_stage
+class OpStringIndexerModel(FittedModel):
+    """Fitted indexer: label list ordered by training frequency desc."""
+
+    operation_name = "strIdx"
+    output_type = RealNN
+
+    def __init__(self, labels: Sequence[str] = (),
+                 unseen_name: str = UNSEEN_DEFAULT,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.labels = list(labels)
+        self.unseen_name = unseen_name
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(Text)
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        from ._hostvec import string_codes
+        col = store[self.input_features[0].name]
+        values = [NULL_LABEL if v is None else v for v in col.values]
+        codes, _ = string_codes(values, self.labels)   # unseen → len(labels)
+        vals = codes.astype(np.float64)
+        return NumericColumn(RealNN, vals, np.ones(len(vals), bool),
+                             labels=self.labels + [self.unseen_name])
+
+    def get_model_state(self) -> Dict[str, Any]:
+        return {"labels": self.labels}
+
+
+@register_stage
+class OpStringIndexerNoFilter(Estimator):
+    """Estimator(Text) → RealNN indices, keeping unseen values (NoFilter)."""
+
+    operation_name = "strIdx"
+    output_type = RealNN
+
+    def __init__(self, unseen_name: str = UNSEEN_DEFAULT,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.unseen_name = unseen_name
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(Text)
+
+    def fit_columns(self, store: ColumnStore) -> OpStringIndexerModel:
+        from ._hostvec import value_counts
+        col = store[self.input_features[0].name]
+        counts = value_counts(
+            [NULL_LABEL if v is None else v for v in col.values])
+        # frequency desc, label asc tiebreak (Spark frequencyDesc order)
+        labels = [lbl for lbl, _ in
+                  sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))]
+        return OpStringIndexerModel(labels=labels,
+                                    unseen_name=self.unseen_name)
+
+
+@register_stage
+class OpIndexToStringNoFilter(Transformer):
+    """Transformer(RealNN) → Text via a fixed label list."""
+
+    operation_name = "idx2str"
+    output_type = Text
+
+    def __init__(self, labels: Sequence[str] = (),
+                 unseen_name: str = UNSEEN_DEFAULT,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.labels = list(labels)
+        self.unseen_name = unseen_name
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(RealNN)
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        col = store[self.input_features[0].name]
+        idx = np.asarray(col.values).astype(np.int64)
+        out = np.empty(len(col), dtype=object)
+        k = len(self.labels)
+        for i, j in enumerate(idx):
+            out[i] = self.labels[j] if 0 <= j < k else self.unseen_name
+        return TextColumn(Text, out)
+
+
+@register_stage
+class PredictionDeIndexerModel(FittedModel, AllowLabelAsInput):
+    """Fitted deindexer: prediction index → response label string."""
+
+    operation_name = "idx2str"
+    output_type = Text
+
+    def __init__(self, labels: Sequence[str] = (),
+                 unseen_name: str = UNSEEN_DEFAULT,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.labels = list(labels)
+        self.unseen_name = unseen_name
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(RealNN, Prediction)
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        col = store[self.input_features[1].name]
+        assert isinstance(col, PredictionColumn)
+        idx = col.prediction.astype(np.int64)
+        out = np.empty(len(col), dtype=object)
+        k = len(self.labels)
+        for i, j in enumerate(idx):
+            out[i] = self.labels[j] if 0 <= j < k else self.unseen_name
+        return TextColumn(Text, out)
+
+    def get_model_state(self) -> Dict[str, Any]:
+        return {"labels": self.labels}
+
+
+@register_stage
+class PredictionDeIndexer(Estimator, AllowLabelAsInput):
+    """Estimator(indexed response, Prediction) → Text.
+
+    Reads the label mapping from the response column's ``labels`` metadata
+    (attached by :class:`OpStringIndexerModel`), exactly as the reference
+    reads the NominalAttribute from the response schema
+    (``PredictionDeIndexer.scala:61-68``)."""
+
+    operation_name = "idx2str"
+    output_type = Text
+
+    def __init__(self, unseen_name: str = UNSEEN_DEFAULT,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.unseen_name = unseen_name
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(RealNN, Prediction)
+
+    def fit_columns(self, store: ColumnStore) -> PredictionDeIndexerModel:
+        resp = self.input_features[0]
+        col = store[resp.name]
+        labels = getattr(col, "labels", None)
+        if not labels:
+            raise ValueError(
+                f"The feature {resp.name!r} does not contain any label/index "
+                "mapping in its metadata — index it with "
+                "OpStringIndexerNoFilter first")
+        return PredictionDeIndexerModel(labels=labels,
+                                        unseen_name=self.unseen_name)
