@@ -159,6 +159,10 @@ class ModelSelector(PredictorEstimator):
         self.task = task
         self.mesh = mesh
         self.best_estimator_: Optional[Tuple[ModelFamily, Dict]] = None
+        #: set alongside best_estimator_ by the workflow-CV path so
+        #: fit_columns can skip re-validation (ModelSelector.scala:135-156
+        #: bestEstimator.getOrElse)
+        self.precomputed_summary_: Optional[ValidatorSummary] = None
 
     # workflow-level CV hook (ModelSelector.findBestEstimator :112-121)
     def find_best_estimator(self, store: ColumnStore
@@ -190,10 +194,30 @@ class ModelSelector(PredictorEstimator):
                 fam.n_classes = n_classes
 
     def fit_columns(self, store: ColumnStore) -> SelectedModel:
-        best_family, best_hparams, vsummary = self.find_best_estimator(store)
+        X, y = extract_xy(store, self.label_name, self.features_name)
+        if self.best_estimator_ is not None \
+                and self.precomputed_summary_ is not None:
+            # workflow-level CV already found the winner with in-fold
+            # feature engineering — skip selector-level validation but
+            # replay the prepare side effects (splitter state, class count,
+            # binary-column mask) that find_best_estimator would have set
+            best_family, best_hparams = self.best_estimator_
+            vsummary = self.precomputed_summary_
+            keep = self.splitter.keep_mask(y) if self.splitter else \
+                np.ones_like(y, dtype=bool)
+            if self.splitter is not None:
+                self.splitter.pre_validation_prepare(y[keep])
+            self._maybe_set_classes(y[keep])
+            from .trees import detect_binary_columns
+            bmask = detect_binary_columns(X)
+            for fam in self.families:
+                if hasattr(fam, "binary_mask"):
+                    fam.binary_mask = bmask
+        else:
+            best_family, best_hparams, vsummary = \
+                self.find_best_estimator(store)
 
         # final refit on the full prepared train (ModelSelector.scala:158-159)
-        X, y = extract_xy(store, self.label_name, self.features_name)
         keep = self.splitter.keep_mask(y) if self.splitter else \
             np.ones_like(y, dtype=bool)
         Xk, yk = X[keep], y[keep]

@@ -131,6 +131,13 @@ class _TreeFamilyBase(ModelFamily):
         self.n_classes = n_classes
         self.seed = seed
         self.max_active_nodes = max_active_nodes
+        #: grid points fitted concurrently (None = whole grid vmapped).
+        #: The CV engine sets this from its HBM budget at large row counts:
+        #: each in-flight grid instance carries ~rows × max_active_nodes
+        #: of routing transients, so the (fold × grid) product must shrink
+        #: as rows grow. lax.map(batch_size) serializes chunks while still
+        #: vmapping within one.
+        self.grid_chunk: Optional[int] = None
         #: STATIC host-side [F] bool marking one-hot indicator columns;
         #: set by the caller (ModelSelector / estimator) before fit so the
         #: histogram engine gives those columns a 2-bin block (see
@@ -167,6 +174,10 @@ class _TreeFamilyBase(ModelFamily):
 
         def fit_one(tr):
             return self._fit_single(X, y, w, D, n_trees, tr)
+        if self.grid_chunk and self.grid_chunk < self.grid_size():
+            from jax import lax
+            return lax.map(fit_one, traced,
+                           batch_size=int(self.grid_chunk))
         return jax.vmap(fit_one)(traced)
 
     def predict_batch(self, params, X, on_train: bool = False):
@@ -183,11 +194,37 @@ class _TreeFamilyBase(ModelFamily):
         D = self.global_depth()
         head = self._head()
         if on_train and head == "rf" and "train_node" in params:
+            from jax import lax
+
+            n = X.shape[0]
+
             def fn(p):
-                vals = jax.vmap(lambda l, nd: l[nd])(
-                    p["leaf"], p["train_node"])        # [T, n, K]
-                out = jnp.einsum("t,tnk->nk", p["tree_w"], vals)
-                return TF.rf_head(out, X, self.task)
+                # trees accumulate in byte-capped chunks: one [T, n, K]
+                # gather tensor would tile-pad K→128 on TPU (grid × T × n
+                # × 128 × 4B ≈ 69GB at 1M rows), so scan chunks of c trees
+                # with a [c, n, K] transient ≤ ~1GB padded
+                leaf, node, tw = p["leaf"], p["train_node"], p["tree_w"]
+                T_, L, K = leaf.shape
+                c = max(1, min(T_, int(1e9 // max(n * 128 * 4, 1))))
+                pad = (-T_) % c
+                if pad:
+                    leaf = jnp.concatenate(
+                        [leaf, jnp.zeros((pad, L, K), leaf.dtype)])
+                    node = jnp.concatenate(
+                        [node, jnp.zeros((pad, n), node.dtype)])
+                    tw = jnp.concatenate(
+                        [tw, jnp.zeros((pad,), tw.dtype)])
+                nc = (T_ + pad) // c
+
+                def body(acc, tl):
+                    lf, nd, w_t = tl           # [c, L, K], [c, n], [c]
+                    vals = jax.vmap(lambda l, m: l[m])(lf, nd)  # [c, n, K]
+                    return acc + jnp.einsum("t,tnk->nk", w_t, vals), None
+                acc, _ = lax.scan(
+                    body, jnp.zeros((n, K), leaf.dtype),
+                    (leaf.reshape(nc, c, L, K), node.reshape(nc, c, n),
+                     tw.reshape(nc, c)))
+                return TF.rf_head(acc, X, self.task)
             return jax.vmap(fn)(params)
         if on_train and head in ("gbt", "xgb") and "train_margin" in params:
             scale = 2.0 if head == "gbt" else 1.0

@@ -197,6 +197,40 @@ _LARGER_BETTER = frozenset({"AuROC", "AuPR", "Precision", "Recall", "F1", "R2"})
 _FUSED_EXE_CACHE: Dict[Any, Any] = {}
 
 
+#: HBM transient budget for one family's in-flight (fold × grid) instances.
+#: v5e has 16G; the data + fold weights + compiled program take the rest.
+CHUNK_MEM_BUDGET_BYTES = 6e9
+
+
+def _auto_chunks(family, n_rows: int, n_shards: int, n_folds: int
+                 ) -> Optional[int]:
+    """Bound the (fold × grid) product so tree-routing transients fit HBM.
+
+    Each in-flight tree-grid instance materializes ~3 × [rows_per_shard,
+    max_active_nodes] f32 routing tensors (one-hot slot matmul in
+    _treefit.grow_tree). At small n the whole (fold × grid) sweep runs as
+    one vmap (fastest); as n grows we first serialize folds (lax.map), then
+    grid points within a fold (family.grid_chunk). Non-tree families are
+    cheap — never chunked. Returns fold_chunk (None = no fold chunking);
+    sets family.grid_chunk as a side effect.
+    """
+    A = getattr(family, "max_active_nodes", None)
+    if not A:
+        return None
+    rows = n_rows / max(n_shards, 1)
+    per_instance = rows * max(A, 64) * 4 * 3
+    max_instances = max(int(CHUNK_MEM_BUDGET_BYTES // per_instance), 1)
+    g = family.grid_size()
+    if max_instances >= g * n_folds:
+        family.grid_chunk = None
+        return None
+    if max_instances >= g:
+        family.grid_chunk = None
+        return max(max_instances // g, 1)
+    family.grid_chunk = max_instances
+    return 1
+
+
 class _ValidatorBase:
     """Shared fold-mask validation engine."""
 
@@ -257,7 +291,7 @@ class _ValidatorBase:
         # (family trace signature, metric, arg shapes): data, fold weights
         # and the stacked hyperparameter grid are jit ARGUMENTS, so repeat
         # sweeps skip tracing AND compilation entirely.
-        def make_fit_eval(family, metric_fn):
+        def make_fit_eval(family, metric_fn, fold_chunk=None):
             def fit_eval(X, y, w_folds, v_folds, stacked):
                 def per_fold(w, v):
                     params = family.fit_batch(X, y, w, stacked)
@@ -266,6 +300,11 @@ class _ValidatorBase:
                     return jax.vmap(
                         lambda pg, prg: metric_fn(y, pg, prg, v)
                     )(pred, prob)
+                if fold_chunk and fold_chunk < w_folds.shape[0]:
+                    from jax import lax
+                    return lax.map(lambda wv: per_fold(*wv),
+                                   (w_folds, v_folds),
+                                   batch_size=int(fold_chunk))
                 return jax.vmap(per_fold)(w_folds, v_folds)
             return fit_eval
 
@@ -276,6 +315,9 @@ class _ValidatorBase:
             return tuple((tuple(a.shape), str(jnp.asarray(a).dtype))
                          for a in jax.tree_util.tree_leaves(tree))
 
+        n_shards = (mesh.shape.get("data", 1) if mesh is not None else 1)
+        k_folds = len(splits)
+
         fused: Dict[int, Any] = {}
         stacked_devs: Dict[int, Any] = {}
         to_compile = []
@@ -285,17 +327,20 @@ class _ValidatorBase:
                 n_classes=getattr(family, "n_classes", 2))
             if metric_fn is None:
                 continue
+            fold_chunk = _auto_chunks(family, len(y), n_shards, k_folds)
             stacked = {k2: jnp.asarray(v) for k2, v in
                        family.stack_grid().items()}
             stacked_devs[fi] = stacked
             key = (family.trace_signature(), self.task, self.metric_name,
-                   mesh_key, shapes_of((Xd, yd, wd, vwd, stacked)))
+                   mesh_key, fold_chunk,
+                   shapes_of((Xd, yd, wd, vwd, stacked)))
             exe = _FUSED_EXE_CACHE.get(key)
             if exe is not None:
                 fused[fi] = exe
             else:
                 to_compile.append(
-                    (fi, key, jax.jit(make_fit_eval(family, metric_fn))))
+                    (fi, key, jax.jit(make_fit_eval(family, metric_fn,
+                                                    fold_chunk))))
 
         if to_compile:
             import concurrent.futures as cf
@@ -353,6 +398,111 @@ class _ValidatorBase:
                     family_name=family.name, hparams=family.grid[gi],
                     grid_index=gi,
                     metric_values=per_grid_metrics[gi].tolist(),
+                    mean_metric=float(means[gi]))
+                summary.results.append(r)
+                if best is None or sign * r.mean_metric > sign * best.mean_metric:
+                    best = r
+                    best_family = family
+        summary.best = best
+        assert best is not None and best_family is not None
+        return best_family, best.hparams, summary
+
+    def validate_per_fold(self, families: Sequence[ModelFamily],
+                          fold_data: Sequence[Tuple[np.ndarray, np.ndarray,
+                                                    np.ndarray, np.ndarray]],
+                          mesh=None
+                          ) -> Tuple[ModelFamily, Dict[str, Any],
+                                     ValidatorSummary]:
+        """Workflow-level CV engine: each fold carries its OWN feature
+        matrix (in-fold feature engineering — cutDAG's *during* stages are
+        re-fit per fold upstream of this call), so the fold axis cannot be
+        vmapped over one X. Folds run sequentially; within a fold the
+        (grid × metric) computation is the same fused jitted program as
+        :meth:`validate` (cache-keyed per fold shape — folds of equal
+        width share one compile).
+
+        ``fold_data``: per fold ``(X, y, w_train, w_val)`` full-length
+        arrays, optionally ``(…, binary_mask)`` with the fold matrix's
+        one-hot column mask (each fold's engineered X has its own).
+        Ref: ``OpCrossValidation.scala:89-116`` (per-fold dagCopy).
+        """
+        from ..evaluators.device_metrics import device_metric_fn
+
+        summary = ValidatorSummary("WorkflowCV:" + self.validation_type,
+                                   self.metric_name)
+        best: Optional[ValidationResult] = None
+        best_family: Optional[ModelFamily] = None
+        sign = 1.0 if self.is_larger_better else -1.0
+        mesh_key = tuple(sorted(mesh.shape.items())) if mesh is not None \
+            else None
+        n_shards = (mesh.shape.get("data", 1) if mesh is not None else 1)
+
+        for family in families:
+            metric_fn = device_metric_fn(
+                self.task, self.metric_name,
+                n_classes=getattr(family, "n_classes", 2))
+            g = family.grid_size()
+            per_grid = np.zeros((g, len(fold_data)))
+            fit_host = None   # compiled once per family (host-metric path)
+            for ki, fold in enumerate(fold_data):
+                X, y, w_tr, w_val = fold[:4]
+                if len(fold) > 4 and hasattr(family, "binary_mask"):
+                    family.binary_mask = fold[4]
+                stacked = {k2: jnp.asarray(v) for k2, v in
+                           family.stack_grid().items()}
+                if mesh is not None:
+                    from ..parallel.mesh import shard_cv_inputs
+                    Xd, yd, wd, vwd, _n0 = shard_cv_inputs(
+                        mesh, X, y, w_tr[None, :], extra=w_val[None, :])
+                else:
+                    Xd, yd = jnp.asarray(X), jnp.asarray(y)
+                    wd = jnp.asarray(w_tr[None, :])
+                    vwd = jnp.asarray(w_val[None, :])
+                if metric_fn is None:   # host-metric fallback
+                    if fit_host is None:
+                        def fit_host(Xa, ya, wa, st, _f=family):
+                            return _f.fit_batch(Xa, ya, wa, st)
+                        fit_host = jax.jit(fit_host)
+                    params = fit_host(Xd, yd, wd[0], stacked)
+                    pred, _raw, prob = family.predict_batch(params, Xd)
+                    pred, prob = np.asarray(pred), np.asarray(prob)
+                    vm = w_val > 0
+                    for gi in range(g):
+                        per_grid[gi, ki] = _metric_value(
+                            self.metric_name, self.task, y[vm],
+                            pred[gi][:len(y)][vm],
+                            prob[gi][:len(y)][vm] if prob.ndim == 3
+                            else prob[gi])
+                    continue
+                fold_chunk = _auto_chunks(family, len(y), n_shards, 1)
+                key = (family.trace_signature(), self.task, self.metric_name,
+                       mesh_key, fold_chunk, "per_fold",
+                       tuple((tuple(a.shape), str(a.dtype)) for a in
+                             (Xd, yd, wd, vwd)))
+                exe = _FUSED_EXE_CACHE.get(key)
+                if exe is None:
+
+                    def fit_eval(X, y, w_folds, v_folds, stacked):
+                        def per_fold(w, v):
+                            params = family.fit_batch(X, y, w, stacked)
+                            pred, _raw, prob = family.predict_batch(
+                                params, X, on_train=True)
+                            return jax.vmap(
+                                lambda pg, prg: metric_fn(y, pg, prg, v)
+                            )(pred, prob)
+                        return jax.vmap(per_fold)(w_folds, v_folds)
+                    exe = jax.jit(fit_eval).lower(
+                        Xd, yd, wd, vwd, stacked).compile()
+                    while len(_FUSED_EXE_CACHE) > 64:
+                        _FUSED_EXE_CACHE.pop(next(iter(_FUSED_EXE_CACHE)))
+                    _FUSED_EXE_CACHE[key] = exe
+                per_grid[:, ki] = np.asarray(
+                    exe(Xd, yd, wd, vwd, stacked))[0]
+            means = per_grid.mean(axis=1)
+            for gi in range(g):
+                r = ValidationResult(
+                    family_name=family.name, hparams=family.grid[gi],
+                    grid_index=gi, metric_values=per_grid[gi].tolist(),
                     mean_metric=float(means[gi]))
                 summary.results.append(r)
                 if best is None or sign * r.mean_metric > sign * best.mean_metric:
