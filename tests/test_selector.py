@@ -132,3 +132,33 @@ def test_selector_in_workflow_with_holdout(rng):
     assert "AuPR" in selected.selector_summary.holdout_evaluation
     scored = model.score(store)
     assert pred.name in scored.names()
+
+
+def test_chunked_sweep_matches_unchunked(rng):
+    """fold/grid chunking (lax.map) must not change CV metrics — it only
+    bounds HBM transients at large row counts."""
+    import transmogrifai_tpu.models.tuning as tuning
+    from transmogrifai_tpu.models.trees import RandomForestFamily
+
+    n = 400
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    grid = [{"maxDepth": d, "minInstancesPerNode": 10, "minInfoGain": 0.001}
+            for d in (3, 5)]
+
+    def sweep():
+        fam = RandomForestFamily(grid=[dict(g) for g in grid])
+        cv = tuning.CrossValidation(num_folds=3, metric_name="AuROC",
+                                    task="binary", seed=3)
+        _, _, summ = cv.validate([fam], X, y)
+        return np.array([r.mean_metric for r in summ.results])
+
+    saved = tuning.CHUNK_MEM_BUDGET_BYTES
+    try:
+        tuning.CHUNK_MEM_BUDGET_BYTES = 1e18     # no chunking
+        full = sweep()
+        tuning.CHUNK_MEM_BUDGET_BYTES = 1        # fold_chunk=1, grid_chunk=1
+        chunked = sweep()
+    finally:
+        tuning.CHUNK_MEM_BUDGET_BYTES = saved
+    np.testing.assert_allclose(full, chunked, rtol=1e-5)

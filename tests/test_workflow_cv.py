@@ -1,0 +1,91 @@
+"""Workflow-level CV (cutDAG): leak-free in-fold feature engineering
+(FitStagesUtil.cutDAG :305-358, OpWorkflow.scala:388-443)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, Workflow, column_from_values
+from transmogrifai_tpu.graph import cut_dag
+from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+from transmogrifai_tpu.models.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.ops.dt_bucketizer import DecisionTreeNumericBucketizer
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.workflow import WorkflowError
+
+
+def _leaky_flow(rng, n=150, workflow_cv=False):
+    """Label-aware bucketizer over pure noise: fitting it on ALL rows leaks
+    validation labels into the bucket edges (deep tree + fine candidate
+    grid makes the buckets nearly label-pure)."""
+    y = rng.integers(0, 2, size=n).astype(float)
+    noise = rng.normal(size=n)
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "noise": column_from_values(ft.Real, list(noise)),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("noise").from_column().as_predictor()
+    bucketized = label.transform_with(
+        DecisionTreeNumericBucketizer(max_depth=12, max_bins=256,
+                                      min_info_gain=1e-9), fx)
+    vec = transmogrify([bucketized])
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, validation_metric="AuROC",
+        families=[LogisticRegressionFamily(grid=[
+            {"regParam": 0.001, "elasticNetParam": 0.0}])],
+        splitter=None, seed=7)
+    pred = label.transform_with(selector, vec)
+    wf = Workflow().set_result_features(pred).set_input_store(store)
+    if workflow_cv:
+        wf = wf.with_workflow_cv()
+    model = wf.train()
+    selected = model.fitted_stages[selector.uid]
+    return selected.selector_summary.validator_summary.best.mean_metric
+
+
+def test_workflow_cv_is_more_honest_than_selector_cv(rng):
+    leaky = _leaky_flow(np.random.default_rng(1), workflow_cv=False)
+    honest = _leaky_flow(np.random.default_rng(1), workflow_cv=True)
+    # leakage inflates the fold AuROC on noise (~0.82 measured); in-fold
+    # feature engineering must not
+    assert leaky > 0.7, f"expected inflated leaky metric, got {leaky}"
+    assert honest < leaky - 0.1, (leaky, honest)
+    assert honest < 0.65, f"workflow CV still leaking: {honest}"
+
+
+def test_cut_dag_splits_around_selector(rng):
+    y = rng.integers(0, 2, 50).astype(float)
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "a": column_from_values(ft.Real, list(rng.normal(size=50))),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fa = FeatureBuilder.Real("a").from_column().as_predictor()
+    bucketized = label.transform_with(DecisionTreeNumericBucketizer(), fa)
+    vec = transmogrify([bucketized])
+    checked = label.sanity_check(vec)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None)
+    pred = label.transform_with(selector, checked)
+
+    ms, before, during, after = cut_dag([pred])
+    assert ms is selector or ms.uid == selector.uid
+    during_names = {type(s).__name__ for layer in during for s in layer}
+    assert "DecisionTreeNumericBucketizer" in during_names
+    assert "SanityChecker" in during_names
+    assert after == []
+    before_names = {type(s).__name__ for layer in before for s in layer}
+    assert "DecisionTreeNumericBucketizer" not in before_names
+
+
+def test_at_most_one_selector_enforced(rng):
+    y = rng.integers(0, 2, 40).astype(float)
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fa = FeatureBuilder.Real("a").from_column().as_predictor()
+    vec = transmogrify([fa])
+    mk = lambda: BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None)
+    p1 = label.transform_with(mk(), vec)
+    p2 = label.transform_with(mk(), vec)
+    with pytest.raises(WorkflowError, match="at most 1 ModelSelector"):
+        Workflow().set_result_features(p1, p2)

@@ -56,3 +56,71 @@ def all_stages(result_features: Sequence[Feature],
                include_generators: bool = False) -> List[OpPipelineStage]:
     return [s for layer in compute_dag(result_features, include_generators)
             for s in layer]
+
+
+def cut_dag(result_features: Sequence[Feature]):
+    """Split the DAG around the ModelSelector for leak-free workflow CV.
+
+    Mirrors ``FitStagesUtil.cutDAG`` (:305-358): find the single
+    ModelSelector (max 1 enforced, :313), then split into
+
+    * ``before`` — stages safe to fit ONCE on the full training split:
+      everything not downstream of a label-aware ("mixing": consumes both
+      response and predictor inputs) ancestor of the selector;
+    * ``during`` — the selector's ancestor layers from the first mixing
+      layer onward (SanityChecker, DecisionTreeBucketizer, …): these see
+      the label, so each CV fold must re-fit them on in-fold data;
+    * ``after`` — layers shallower than the selector.
+
+    Returns ``(selector, before, during, after)``; selector is None when
+    the DAG has no ModelSelector (during/after empty).
+    """
+    from .models.selector import ModelSelector
+
+    dag = compute_dag(result_features)
+    selectors = [s for layer in dag for s in layer
+                 if isinstance(s, ModelSelector)]
+    if len(selectors) > 1:
+        raise ValueError(
+            f"Workflow can contain at most 1 ModelSelector, found "
+            f"{len(selectors)}: {[s.uid for s in selectors]}")
+    if not selectors:
+        return None, dag, [], []
+    ms = selectors[0]
+    ms_layer = next(i for i, layer in enumerate(dag) if ms in layer)
+    after = dag[ms_layer + 1:]
+
+    # selector's ancestor DAG (deepest first), selector's own layer dropped
+    ms_dag = compute_dag(list(ms.input_features))
+
+    def mixes(stage) -> bool:
+        ins = stage.input_features
+        return (any(f.is_response for f in ins)
+                and any(not f.is_response for f in ins))
+
+    first = next((i for i, layer in enumerate(ms_dag)
+                  if any(mixes(s) for s in layer)), None)
+    during_uids = (set() if first is None else
+                   {s.uid for layer in ms_dag[first:] for s in layer})
+
+    def depends_on_during(stage) -> bool:
+        if not during_uids:
+            return False
+        try:
+            out = stage.get_output()
+        except ValueError:
+            return False
+        return any(p.uid in during_uids for p in out.parent_stages())
+
+    before: StagesDAG = []
+    during: StagesDAG = []
+    for layer in dag[:ms_layer + 1]:
+        b = [s for s in layer if s is not ms and s.uid not in during_uids
+             and not depends_on_during(s)]
+        d = [s for s in layer if s is not ms and
+             (s.uid in during_uids or depends_on_during(s))]
+        if b:
+            before.append(b)
+        if d:
+            during.append(d)
+    return ms, before, during, after

@@ -194,6 +194,7 @@ class Workflow:
         self.raw_feature_filter = None
         self.parameters: Dict[str, Any] = {}
         self.blacklisted_features: List[Feature] = []
+        self._workflow_cv = False
 
     # -- config ------------------------------------------------------------
     def set_result_features(self, *features: Feature) -> "Workflow":
@@ -229,14 +230,29 @@ class Workflow:
         self.parameters = dict(params)
         return self
 
+    def with_workflow_cv(self, enabled: bool = True) -> "Workflow":
+        """Leak-free workflow-level cross-validation
+        (OpWorkflowCore.withWorkflowCV :104): the DAG's label-aware feature
+        stages (cutDAG's *during* set) are re-fit inside every CV fold so
+        validation metrics never see label leakage from feature
+        engineering."""
+        self._workflow_cv = enabled
+        return self
+
     # -- validation (OpWorkflow.scala:265-323) -----------------------------
     def _validate_dag(self) -> None:
+        from .models.selector import ModelSelector
         stages = [s for layer in compute_dag(self.result_features, True)
                   for s in layer]
         uids = [s.uid for s in stages]
         if len(uids) != len(set(uids)):
             dupes = sorted({u for u in uids if uids.count(u) > 1})
             raise WorkflowError(f"Duplicate stage uids in DAG: {dupes}")
+        selectors = [s for s in stages if isinstance(s, ModelSelector)]
+        if len(selectors) > 1:
+            raise WorkflowError(
+                f"Workflow can contain at most 1 ModelSelector "
+                f"(FitStagesUtil.scala:313), found {len(selectors)}")
 
     # -- training ----------------------------------------------------------
     def train(self) -> "WorkflowModel":
@@ -280,7 +296,12 @@ class Workflow:
             train_store, test_store = self.splitter.reserve_split(store)
 
         dag = compute_dag(result_features)
-        fitted, train_time = self._fit_dag(dag, train_store, test_store)
+        if self._workflow_cv:
+            fitted, train_time = self._fit_dag_workflow_cv(
+                result_features, dag, train_store, test_store)
+        else:
+            fitted, train_time, _, _ = self._fit_dag(
+                dag, train_store, test_store)
         return WorkflowModel(
             result_features=result_features,
             fitted_stages=fitted,
@@ -292,12 +313,14 @@ class Workflow:
         )
 
     def _fit_dag(self, dag: StagesDAG, train: ColumnStore,
-                 test: Optional[ColumnStore]
-                 ) -> Tuple[Dict[str, FittedModel], float]:
+                 test: Optional[ColumnStore],
+                 fitted: Optional[Dict[str, FittedModel]] = None
+                 ) -> Tuple[Dict[str, FittedModel], float,
+                            ColumnStore, Optional[ColumnStore]]:
         """Fold layers: fit estimators, holdout-eval, transform both splits
         (FitStagesUtil.fitAndTransformDAG/Layer)."""
         t0 = time.time()
-        fitted: Dict[str, FittedModel] = {}
+        fitted = {} if fitted is None else fitted
         for layer in dag:
             models: List[Transformer] = []
             for stage in layer:
@@ -316,6 +339,80 @@ class Workflow:
             train = apply_layer_vectorized(models, train)
             if test is not None:
                 test = apply_layer_vectorized(models, test)
+        return fitted, time.time() - t0, train, test
+
+    def _fit_dag_workflow_cv(self, result_features, dag: StagesDAG,
+                             train: ColumnStore,
+                             test: Optional[ColumnStore]
+                             ) -> Tuple[Dict[str, FittedModel], float]:
+        """Leak-free workflow CV (OpWorkflow.scala:388-443 + cutDAG).
+
+        1. Fit the *before* DAG once on the training split.
+        2. Per CV fold: re-fit the *during* (label-aware) stages on in-fold
+           training rows only, transform the full split, and score the
+           (family × grid) sweep on that fold's matrix
+           (OpCrossValidation.scala:89-116 dagCopy semantics).
+        3. Hand the winner to the ModelSelector, then fit during + selector
+           + after layers normally on the full training split.
+        """
+        from .graph import cut_dag
+
+        t0 = time.time()
+        ms, before, during, after = cut_dag(result_features)
+        if ms is None or not during:
+            fitted, _, _, _ = self._fit_dag(dag, train, test)
+            return fitted, time.time() - t0
+
+        fitted: Dict[str, FittedModel] = {}
+        _, _, train_b, test_b = self._fit_dag(before, train, test, fitted)
+
+        label_name = ms.input_features[0].name
+        feats_f = ms.input_features[1]
+        y = np.asarray(train_b[label_name].values, dtype=np.float64)
+        keep = ms.splitter.keep_mask(y) if ms.splitter else \
+            np.ones_like(y, dtype=bool)
+        store_kept = train_b.take(np.nonzero(keep)[0]) if not keep.all() \
+            else train_b
+        y_kept = y[keep]
+        if ms.splitter is not None:
+            ms.splitter.pre_validation_prepare(y_kept)
+            base_w = ms.splitter.sample_weights(y_kept)
+        else:
+            base_w = np.ones_like(y_kept)
+        ms._maybe_set_classes(y_kept)
+
+        from .models.trees import detect_binary_columns
+
+        fold_data = []
+        for train_mask, val_mask in ms.validator._splits(y_kept):
+            tr_idx = np.nonzero(train_mask > 0)[0]
+            fold_fit: Dict[str, FittedModel] = {}
+            _, _, _, _ = self._fit_dag(during, store_kept.take(tr_idx),
+                                       None, fold_fit)
+            # transform the FULL kept split with fold-fitted during stages
+            fold_store = store_kept
+            for layer in during:
+                fold_models = [fold_fit.get(s.uid, s) for s in layer]
+                fold_store = apply_layer_vectorized(fold_models, fold_store)
+            X_f = np.asarray(fold_store[feats_f.name].values,
+                             dtype=np.float64)
+            fold_data.append((X_f, y_kept, train_mask * base_w, val_mask,
+                              detect_binary_columns(X_f)))
+
+        best_family, best_hparams, vsummary = \
+            ms.validator.validate_per_fold(ms.families, fold_data,
+                                           mesh=ms.mesh)
+        ms.best_estimator_ = (best_family, best_hparams)
+        ms.precomputed_summary_ = vsummary
+
+        # final fit: during + selector layer + after on the full split
+        remaining: StagesDAG = []
+        done = {s.uid for layer in before for s in layer}
+        for layer in dag:
+            rest = [s for s in layer if s.uid not in done]
+            if rest:
+                remaining.append(rest)
+        fitted, _, _, _ = self._fit_dag(remaining, train_b, test_b, fitted)
         return fitted, time.time() - t0
 
 
