@@ -1,0 +1,137 @@
+"""Text processing suite tests (TextTokenizer / OpCountVectorizer /
+NGramSimilarity / parser analogs)."""
+import base64
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, column_from_values
+from transmogrifai_tpu.ops.text import (STOPWORDS, TextTokenizer,
+                                        detect_language, stem, tokenize)
+from transmogrifai_tpu.ops.text_suite import (EmailParser, MimeTypeDetector,
+                                              NGramSimilarity,
+                                              OpCountVectorizer,
+                                              PhoneNumberParser, UrlParser,
+                                              detect_mime, parse_email,
+                                              parse_phone, parse_url)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def test_tokenizer_pipeline():
+    toks = tokenize("The Quick brown foxes были Jumping!",
+                    remove_stopwords=True, stemming=True)
+    assert "the" not in toks            # stopword removed
+    assert "fox" in toks                # plural stemmed
+    assert "jump" in toks               # -ing stripped
+
+
+def test_language_detection():
+    assert detect_language("the cat is on the mat and it is happy") == "en"
+    assert detect_language("el gato esta en la casa y no quiere salir") == "es"
+    assert detect_language("der Hund ist in dem Haus und die Katze auch") == "de"
+    assert detect_language("le chien est dans la maison avec les chats") == "fr"
+    assert detect_language("xyzzy plugh") == "en"   # no signal → default
+
+
+def test_stopword_removal_per_language():
+    toks = tokenize("der schnelle braune Fuchs", remove_stopwords=True,
+                    auto_detect_language=True)
+    assert "der" not in toks
+
+
+def test_count_vectorizer(rng):
+    docs = [["a", "b", "a"], ["b", "c"], ["a"], []]
+    store = ColumnStore({"t": column_from_values(ft.TextList, docs)})
+    f = FeatureBuilder.TextList("t").from_column().as_predictor()
+    est = OpCountVectorizer(vocab_size=2, min_df=1)
+    est.set_input(f)
+    model = est.fit(store)
+    # doc freq: a=3? no — per-doc unique: a in docs 0,2 → 2; b in 0,1 → 2;
+    # c → 1. vocab_size=2 keeps [a, b] (count desc, token asc)
+    assert model.vocabs == [["a", "b"]]
+    out = model.transform(store)
+    mat = np.asarray(out[model.output_name].values)
+    np.testing.assert_allclose(mat, [[2, 1], [0, 1], [1, 0], [0, 0]])
+
+
+def test_ngram_similarity():
+    store = ColumnStore({
+        "a": column_from_values(ft.Text, ["hello world", "abc", None]),
+        "b": column_from_values(ft.Text, ["hello world", "xyz", "q"]),
+    })
+    fa = FeatureBuilder.Text("a").from_column().as_predictor()
+    fb = FeatureBuilder.Text("b").from_column().as_predictor()
+    sim = NGramSimilarity(n=3)
+    sim.set_input(fa, fb)
+    col = sim.transform_columns(store)
+    assert col.values[0] == pytest.approx(1.0)
+    assert col.values[1] < 0.3
+    assert not col.mask[2]              # null input → null output
+
+
+def test_email_parsing():
+    assert parse_email("jane.doe@example.com") == ("jane.doe", "example.com")
+    assert parse_email("not-an-email") == (None, None)
+    assert parse_email(None) == (None, None)
+
+    store = ColumnStore({"e": column_from_values(
+        ft.Email, ["a@b.com", "bad", None])})
+    f = FeatureBuilder.Email("e").from_column().as_predictor()
+    p = EmailParser(part="domain")
+    p.set_input(f)
+    out = p.transform_columns(store)
+    assert out.values.tolist() == ["b.com", None, None]
+
+
+def test_phone_parsing():
+    assert parse_phone("+1 (650) 555-1234") == (True, "6505551234")
+    assert parse_phone("650-555-1234", "US") == (True, "6505551234")
+    assert parse_phone("+44 20 7946 0958") == (True, "2079460958")
+    assert parse_phone("12345", "US") == (False, "12345")
+    assert parse_phone("+999 123") == (False, None)
+    assert parse_phone(None) == (False, None)
+
+    store = ColumnStore({"p": column_from_values(
+        ft.Phone, ["+16505551234", "123", None])})
+    f = FeatureBuilder.Phone("p").from_column().as_predictor()
+    v = PhoneNumberParser(output="valid")
+    v.set_input(f)
+    col = v.transform_columns(store)
+    assert col.values[:2].tolist() == [True, False]
+    assert not col.mask[2]
+
+
+def test_url_parsing():
+    assert parse_url("https://docs.example.org/a?b=1") == \
+        ("https", "docs.example.org")
+    assert parse_url("ftp://files.example.com") == ("ftp", "files.example.com")
+    assert parse_url("nonsense") == (None, None)
+
+
+def test_mime_detection():
+    pdf = base64.b64encode(b"%PDF-1.4 rest").decode()
+    png = base64.b64encode(b"\x89PNG\r\n\x1a\n....").decode()
+    txt = base64.b64encode(b"just plain text here").decode()
+    assert detect_mime(pdf) == "application/pdf"
+    assert detect_mime(png) == "image/png"
+    assert detect_mime(txt) == "text/plain"
+    assert detect_mime("!!!not base64!!!") is None
+    assert detect_mime(None) is None
+
+
+def test_dsl_text_methods(rng):
+    store = ColumnStore({
+        "email": column_from_values(ft.Email, ["x@y.com", "z@w.org"]),
+        "desc": column_from_values(ft.Text, ["big red dog", "small red cat"]),
+    })
+    email = FeatureBuilder.Email("email").from_column().as_predictor()
+    desc = FeatureBuilder.Text("desc").from_column().as_predictor()
+    dom = email.to_email_domain()
+    toks = desc.tokenize()
+    counted = toks.count_vectorize(vocab_size=8)
+    from transmogrifai_tpu import Workflow
+    wf = Workflow().set_input_store(store).set_result_features(dom, counted)
+    model = wf.train()
+    out = model.transform(store)
+    assert out[dom.name].values.tolist() == ["y.com", "w.org"]
+    assert np.asarray(out[counted.name].values).sum() == 6.0

@@ -315,6 +315,57 @@ def _vectorize_collection(features: Sequence[Feature]):
     return _tm(features)
 
 
+def _to_email_prefix(self: Feature):
+    from .ops.text_suite import EmailParser
+    return self.transform_with(EmailParser(part="prefix"))
+
+
+def _to_email_domain(self: Feature):
+    from .ops.text_suite import EmailParser
+    return self.transform_with(EmailParser(part="domain"))
+
+
+def _to_url_protocol(self: Feature):
+    from .ops.text_suite import UrlParser
+    return self.transform_with(UrlParser(part="protocol"))
+
+
+def _to_url_domain(self: Feature):
+    from .ops.text_suite import UrlParser
+    return self.transform_with(UrlParser(part="domain"))
+
+
+def _is_valid_phone(self: Feature, default_region: str = "US"):
+    from .ops.text_suite import PhoneNumberParser
+    return self.transform_with(
+        PhoneNumberParser(default_region=default_region, output="valid"))
+
+
+def _detect_mime_types(self: Feature):
+    from .ops.text_suite import MimeTypeDetector
+    return self.transform_with(MimeTypeDetector())
+
+
+def _ngram_similarity(self: Feature, other: Feature, n: int = 3):
+    from .ops.text_suite import NGramSimilarity
+    return self.transform_with(NGramSimilarity(n=n), other)
+
+
+def _count_vectorize(self: Feature, *others: Feature, **kw):
+    from .ops.text_suite import OpCountVectorizer
+    return self.transform_with(OpCountVectorizer(**kw), *others)
+
+
+def _indexed(self: Feature, **kw):
+    from .ops.indexers import OpStringIndexerNoFilter
+    return self.transform_with(OpStringIndexerNoFilter(**kw))
+
+
+def _deindexed(self: Feature, prediction: Feature, **kw):
+    from .ops.indexers import PredictionDeIndexer
+    return self.transform_with(PredictionDeIndexer(**kw), prediction)
+
+
 def _sanity_check(self: Feature, features: Feature,
                   remove_bad_features: bool = True, **kw):
     from .ops.sanity_checker import SanityChecker
@@ -338,5 +389,15 @@ Feature.map_to = _map_to
 Feature.alias = _alias
 Feature.tokenize = _tokenize
 Feature.sanity_check = _sanity_check
+Feature.to_email_prefix = _to_email_prefix
+Feature.to_email_domain = _to_email_domain
+Feature.to_url_protocol = _to_url_protocol
+Feature.to_url_domain = _to_url_domain
+Feature.is_valid_phone = _is_valid_phone
+Feature.detect_mime_types = _detect_mime_types
+Feature.ngram_similarity = _ngram_similarity
+Feature.count_vectorize = _count_vectorize
+Feature.indexed = _indexed
+Feature.deindexed = _deindexed
 
 transmogrify = _vectorize_collection
