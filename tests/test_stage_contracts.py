@@ -1,0 +1,490 @@
+"""Stage contract spec — the reference's single best testing idea.
+
+For EVERY registered stage reachable through a case below, assert that
+
+1. fit (estimators) produces a model whose columnar ``transform_columns``,
+2. per-row ``transform_row`` (the serving path), and
+3. serialize → reconstruct → ``transform_columns``
+
+all agree (``OpTransformerSpec.scala:59-84``, ``OpEstimatorSpec.scala:55-120``).
+A completeness check asserts no registered stage silently escapes the
+contract: each class is either exercised by a case, produced as a fitted
+model by one, or explicitly exempted with a reason.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, column_from_values
+from transmogrifai_tpu import model_io
+from transmogrifai_tpu.columns import VectorColumn
+from transmogrifai_tpu.stages.base import Estimator, STAGE_REGISTRY
+from transmogrifai_tpu.testkit import RandomData
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.vector_metadata import (VectorColumnMetadata,
+                                               VectorMetadata)
+
+N = 60
+
+
+def _f(name, ftype, response=False):
+    b = getattr(FeatureBuilder, ftype.__name__)(name).from_column()
+    return b.as_response() if response else b.as_predictor()
+
+
+def _label_store(seed=3):
+    y = RandomData.binaries().take(N, seed)
+    return column_from_values(ft.RealNN, [1.0 if v else 0.0 for v in y])
+
+
+def _vec_store(seed=5, dim=4):
+    X = np.stack(RandomData.vectors(dim).take(N, seed))
+    meta = VectorMetadata("features", [
+        VectorColumnMetadata(f"x{i}", "Real") for i in range(dim)])
+    return VectorColumn(ft.OPVector, X, meta)
+
+
+# --------------------------------------------------------------------------
+# Case table: class name → () → (stage, [input features], store)
+# --------------------------------------------------------------------------
+
+def _numeric_case(cls, **kw):
+    def build():
+        stage = cls(**kw)
+        feats = [_f("a", ft.Real), _f("b", ft.Real)]
+        store = ColumnStore({
+            "a": RandomData.reals().with_prob_empty(0.2).column(ft.Real, N),
+            "b": RandomData.reals(2.0).column(ft.Real, N)})
+        return stage, feats, store
+    return build
+
+
+def _unary_real(cls, **kw):
+    def build():
+        stage = cls(**kw)
+        feats = [_f("a", ft.Real)]
+        store = ColumnStore({
+            "a": RandomData.reals().with_prob_empty(0.1).column(ft.Real, N)})
+        return stage, feats, store
+    return build
+
+
+def _labelled(cls, xtype=ft.Real, xgen=None, **kw):
+    def build():
+        stage = cls(**kw)
+        feats = [_f("label", ft.RealNN, response=True), _f("x", xtype)]
+        xcol = (xgen or RandomData.reals()).column(xtype, N)
+        store = ColumnStore({"label": _label_store(), "x": xcol})
+        return stage, feats, store
+    return build
+
+
+def _predictor(cls, **kw):
+    def build():
+        stage = cls(**kw)
+        feats = [_f("label", ft.RealNN, response=True),
+                 _f("features", ft.OPVector)]
+        store = ColumnStore({"label": _label_store(),
+                             "features": _vec_store()})
+        return stage, feats, store
+    return build
+
+
+def _cases():
+    from transmogrifai_tpu.dsl import (AliasTransformer, FillMissingWithMean,
+                                       MathBinaryTransformer,
+                                       MathScalarTransformer, ScalarNormalizer)
+    from transmogrifai_tpu.models.linear import (OpLinearRegression,
+                                                 OpLogisticRegression,
+                                                 OpNaiveBayes)
+    from transmogrifai_tpu.models.svm import (OpLinearSVC,
+                                              OpMultilayerPerceptronClassifier)
+    from transmogrifai_tpu.models.trees import (OpDecisionTreeClassifier,
+                                                OpDecisionTreeRegressor,
+                                                OpGBTClassifier,
+                                                OpGBTRegressor,
+                                                OpRandomForestClassifier,
+                                                OpRandomForestRegressor,
+                                                OpXGBoostClassifier,
+                                                OpXGBoostRegressor)
+    from transmogrifai_tpu.ops import (BinaryVectorizer, IntegralVectorizer,
+                                       OneHotVectorizer, RealVectorizer,
+                                       SetVectorizer, SmartTextVectorizer,
+                                       TextTokenizer, VectorsCombiner,
+                                       StandardScalerEstimator)
+    from transmogrifai_tpu.ops.calibrators import (IsotonicRegressionCalibrator,
+                                                   PercentileCalibrator)
+    from transmogrifai_tpu.ops.date_list import DateListVectorizer
+    from transmogrifai_tpu.ops.dates import DateToUnitCircleVectorizer
+    from transmogrifai_tpu.ops.dt_bucketizer import (
+        DecisionTreeNumericBucketizer, DecisionTreeNumericMapBucketizer)
+    from transmogrifai_tpu.ops.geo import GeolocationVectorizer
+    from transmogrifai_tpu.ops.hashing import HashingVectorizerModel
+    from transmogrifai_tpu.ops.indexers import (OpIndexToStringNoFilter,
+                                                OpStringIndexerNoFilter)
+    from transmogrifai_tpu.ops.maps import MapVectorizer
+    from transmogrifai_tpu.ops.numeric import NumericBucketizer
+    from transmogrifai_tpu.ops.scalers import (DescalerTransformer,
+                                               OpScalarStandardScaler,
+                                               ScalerTransformer)
+
+    cases = {}
+
+    # vectorizers -----------------------------------------------------------
+    cases["RealVectorizer"] = _numeric_case(RealVectorizer)
+
+    def integral_case():
+        stage = IntegralVectorizer()
+        feats = [_f("a", ft.Integral)]
+        store = ColumnStore({"a": RandomData.integrals().with_prob_empty(0.2)
+                             .column(ft.Integral, N)})
+        return stage, feats, store
+    cases["IntegralVectorizer"] = integral_case
+
+    def binary_case():
+        stage = BinaryVectorizer()
+        feats = [_f("a", ft.Binary)]
+        store = ColumnStore({"a": RandomData.binaries().with_prob_empty(0.2)
+                             .column(ft.Binary, N)})
+        return stage, feats, store
+    cases["BinaryVectorizer"] = binary_case
+
+    def onehot_case():
+        stage = OneHotVectorizer(top_k=3, min_support=1)
+        feats = [_f("a", ft.PickList)]
+        store = ColumnStore({"a": RandomData.picklists().with_prob_empty(0.1)
+                             .column(ft.PickList, N)})
+        return stage, feats, store
+    cases["OneHotVectorizer"] = onehot_case
+
+    def set_case():
+        stage = SetVectorizer(top_k=3, min_support=1)
+        feats = [_f("a", ft.MultiPickList)]
+        store = ColumnStore({"a": RandomData.multi_picklists()
+                             .column(ft.MultiPickList, N)})
+        return stage, feats, store
+    cases["SetVectorizer"] = set_case
+
+    def smart_text_case():
+        stage = SmartTextVectorizer(max_cardinality=10, num_features=32,
+                                    min_support=1)
+        feats = [_f("a", ft.Text), _f("b", ft.Text)]
+        store = ColumnStore({
+            "a": RandomData.unique_texts().with_prob_empty(0.1)
+            .column(ft.Text, N),                       # high card → hashed
+            "b": RandomData.picklists().column(ft.Text, N)})  # low → pivot
+        return stage, feats, store
+    cases["SmartTextVectorizer"] = smart_text_case
+
+    def hashing_case():
+        stage = HashingVectorizerModel(num_features=16,
+                                       input_names=["a"])
+        feats = [_f("a", ft.TextList)]
+        store = ColumnStore({"a": RandomData.text_lists()
+                             .column(ft.TextList, N)})
+        return stage, feats, store
+    cases["HashingVectorizerModel"] = hashing_case
+
+    def date_case():
+        stage = DateToUnitCircleVectorizer()
+        feats = [_f("a", ft.Date)]
+        store = ColumnStore({"a": RandomData.dates().with_prob_empty(0.1)
+                             .column(ft.Date, N)})
+        return stage, feats, store
+    cases["DateToUnitCircleVectorizer"] = date_case
+
+    def date_list_case():
+        stage = DateListVectorizer(reference_date_ms=1_500_000_000_000)
+        feats = [_f("a", ft.DateList)]
+        store = ColumnStore({"a": RandomData.date_lists()
+                             .column(ft.DateList, N)})
+        return stage, feats, store
+    cases["DateListVectorizer"] = date_list_case
+
+    def geo_case():
+        stage = GeolocationVectorizer()
+        feats = [_f("a", ft.Geolocation)]
+        store = ColumnStore({"a": RandomData.geolocations()
+                             .with_prob_empty(0.1)
+                             .column(ft.Geolocation, N)})
+        return stage, feats, store
+    cases["GeolocationVectorizer"] = geo_case
+
+    def map_case():
+        stage = MapVectorizer(top_k=3, min_support=1)
+        feats = [_f("a", ft.RealMap)]
+        store = ColumnStore({"a": RandomData.real_maps()
+                             .column(ft.RealMap, N)})
+        return stage, feats, store
+    cases["MapVectorizer"] = map_case
+
+    def bucketizer_case():
+        stage = NumericBucketizer(splits=[-1.0, 0.0, 1.0],
+                                  track_invalid=True)
+        feats = [_f("a", ft.Real)]
+        store = ColumnStore({"a": RandomData.reals().with_prob_empty(0.1)
+                             .column(ft.Real, N)})
+        return stage, feats, store
+    cases["NumericBucketizer"] = bucketizer_case
+
+    cases["DecisionTreeNumericBucketizer"] = _labelled(
+        DecisionTreeNumericBucketizer, min_info_gain=1e-6)
+    cases["DecisionTreeNumericMapBucketizer"] = _labelled(
+        DecisionTreeNumericMapBucketizer, xtype=ft.RealMap,
+        xgen=RandomData.real_maps(), min_info_gain=1e-6)
+
+    # scalers / calibrators / DSL ------------------------------------------
+    cases["OpScalarStandardScaler"] = _unary_real(OpScalarStandardScaler)
+    cases["ScalerTransformer"] = _unary_real(
+        ScalerTransformer, scaling_type="logarithmic")
+
+    def descaler_case():
+        stage = DescalerTransformer()
+        scaled = ScalerTransformer(scaling_type="linear", slope=2.0,
+                                   intercept=1.0)
+        f = _f("a", ft.Real)
+        scaled.set_input(f)
+        # input 0: value to descale; input 1: feature with a
+        # ScalerTransformer ancestor whose scaling gets inverted
+        feats = [scaled.get_output(), scaled.get_output()]
+        base = ColumnStore({"a": RandomData.reals().column(ft.Real, N)})
+        store = scaled.transform(base)
+        return stage, feats, store
+    cases["DescalerTransformer"] = descaler_case
+
+    cases["FillMissingWithMean"] = _unary_real(FillMissingWithMean)
+    cases["ScalarNormalizer"] = _unary_real(ScalarNormalizer)
+    cases["PercentileCalibrator"] = _unary_real(PercentileCalibrator,
+                                                num_buckets=10)
+    cases["IsotonicRegressionCalibrator"] = _labelled(
+        IsotonicRegressionCalibrator)
+    cases["MathBinaryTransformer"] = _numeric_case(
+        MathBinaryTransformer, op="multiply")
+    cases["MathScalarTransformer"] = _unary_real(
+        MathScalarTransformer, op="add", scalar=3.0)
+    cases["AliasTransformer"] = _unary_real(AliasTransformer, name="renamed")
+
+    def tokenizer_case():
+        stage = TextTokenizer()
+        feats = [_f("a", ft.Text)]
+        store = ColumnStore({"a": RandomData.texts().with_prob_empty(0.1)
+                             .column(ft.Text, N)})
+        return stage, feats, store
+    cases["TextTokenizer"] = tokenizer_case
+
+    def combine_case():
+        stage = VectorsCombiner()
+        feats = [_f("u", ft.OPVector), _f("v", ft.OPVector)]
+        store = ColumnStore({"u": _vec_store(seed=1, dim=2),
+                             "v": _vec_store(seed=2, dim=3)})
+        return stage, feats, store
+    cases["VectorsCombiner"] = combine_case
+
+    def std_scaler_case():
+        stage = StandardScalerEstimator()
+        feats = [_f("u", ft.OPVector)]
+        store = ColumnStore({"u": _vec_store(seed=1, dim=3)})
+        return stage, feats, store
+    cases["StandardScalerEstimator"] = std_scaler_case
+
+    # text suite ------------------------------------------------------------
+    from transmogrifai_tpu.ops.text_suite import (EmailParser,
+                                                  MimeTypeDetector,
+                                                  NGramSimilarity,
+                                                  OpCountVectorizer,
+                                                  PhoneNumberParser,
+                                                  UrlParser)
+
+    def email_case():
+        stage = EmailParser(part="domain")
+        feats = [_f("a", ft.Email)]
+        vals = ["u@d.com", "bad", None, "x@y.org"] * (N // 4)
+        store = ColumnStore({"a": column_from_values(ft.Email, vals)})
+        return stage, feats, store
+    cases["EmailParser"] = email_case
+
+    def url_case():
+        stage = UrlParser(part="protocol")
+        feats = [_f("a", ft.URL)]
+        vals = ["https://a.com", "junk", None, "ftp://f.org"] * (N // 4)
+        store = ColumnStore({"a": column_from_values(ft.URL, vals)})
+        return stage, feats, store
+    cases["UrlParser"] = url_case
+
+    def phone_case():
+        stage = PhoneNumberParser(output="valid")
+        feats = [_f("a", ft.Phone)]
+        vals = ["+16505551234", "123", None, "6505551234"] * (N // 4)
+        store = ColumnStore({"a": column_from_values(ft.Phone, vals)})
+        return stage, feats, store
+    cases["PhoneNumberParser"] = phone_case
+
+    def mime_case():
+        import base64 as b64
+        stage = MimeTypeDetector()
+        feats = [_f("a", ft.Base64)]
+        vals = [b64.b64encode(b"%PDF-1.4").decode(),
+                b64.b64encode(b"plain text").decode(), None,
+                b64.b64encode(b"\x89PNG1234").decode()] * (N // 4)
+        store = ColumnStore({"a": column_from_values(ft.Base64, vals)})
+        return stage, feats, store
+    cases["MimeTypeDetector"] = mime_case
+
+    def ngram_case():
+        stage = NGramSimilarity(n=3)
+        feats = [_f("a", ft.Text), _f("b", ft.Text)]
+        store = ColumnStore({
+            "a": RandomData.texts().with_prob_empty(0.1).column(ft.Text, N),
+            "b": RandomData.texts().column(ft.Text, N)})
+        return stage, feats, store
+    cases["NGramSimilarity"] = ngram_case
+
+    def countvec_case():
+        stage = OpCountVectorizer(vocab_size=8, min_df=1)
+        feats = [_f("a", ft.TextList)]
+        store = ColumnStore({"a": RandomData.text_lists()
+                             .column(ft.TextList, N)})
+        return stage, feats, store
+    cases["OpCountVectorizer"] = countvec_case
+
+    # indexers --------------------------------------------------------------
+    def indexer_case():
+        stage = OpStringIndexerNoFilter()
+        feats = [_f("a", ft.Text, response=True)]
+        store = ColumnStore({"a": RandomData.picklists()
+                             .with_prob_empty(0.1).column(ft.Text, N)})
+        return stage, feats, store
+    cases["OpStringIndexerNoFilter"] = indexer_case
+
+    def idx2str_case():
+        stage = OpIndexToStringNoFilter(labels=["x", "y", "z"])
+        feats = [_f("a", ft.RealNN)]
+        store = ColumnStore({"a": column_from_values(
+            ft.RealNN, [float(i % 4) for i in range(N)])})
+        return stage, feats, store
+    cases["OpIndexToStringNoFilter"] = idx2str_case
+
+    # model wrappers --------------------------------------------------------
+    cases["OpLogisticRegression"] = _predictor(OpLogisticRegression)
+    cases["OpLinearRegression"] = _predictor(OpLinearRegression)
+    cases["OpNaiveBayes"] = _predictor(OpNaiveBayes)
+    cases["OpLinearSVC"] = _predictor(OpLinearSVC, max_iter=8)
+    cases["OpMultilayerPerceptronClassifier"] = _predictor(
+        OpMultilayerPerceptronClassifier, max_iter=8)
+    cases["OpDecisionTreeClassifier"] = _predictor(
+        OpDecisionTreeClassifier, max_depth=3)
+    cases["OpDecisionTreeRegressor"] = _predictor(
+        OpDecisionTreeRegressor, max_depth=3)
+    cases["OpRandomForestClassifier"] = _predictor(
+        OpRandomForestClassifier, num_trees=4, max_depth=3)
+    cases["OpRandomForestRegressor"] = _predictor(
+        OpRandomForestRegressor, num_trees=4, max_depth=3)
+    cases["OpGBTClassifier"] = _predictor(OpGBTClassifier, max_iter=4,
+                                          max_depth=3)
+    cases["OpGBTRegressor"] = _predictor(OpGBTRegressor, max_iter=4,
+                                         max_depth=3)
+    cases["OpXGBoostClassifier"] = _predictor(OpXGBoostClassifier,
+                                              num_round=4, max_depth=3)
+    cases["OpXGBoostRegressor"] = _predictor(OpXGBoostRegressor,
+                                             num_round=4, max_depth=3)
+    from transmogrifai_tpu.models.glm import OpGeneralizedLinearRegression
+    cases["OpGeneralizedLinearRegression"] = _predictor(
+        OpGeneralizedLinearRegression)
+    return cases
+
+
+CASES = _cases()
+
+#: registered classes NOT exercised directly, with the reason
+EXEMPT = {
+    "FeatureGeneratorStage": "origin stage; exercised by reader tests",
+    "ModelSelector": "exercised end-to-end in test_selector/test_workflow_cv",
+    "SelectedModel": "fitted product of ModelSelector (test_selector)",
+    "RecordInsightsLOCO": "needs a live model ref; tested in test_insights",
+    "PredictionDeIndexer": "needs labelled metadata; test_vectorizers",
+    "PredictionDeIndexerModel": "fitted product of PredictionDeIndexer",
+    "MapTransformer": "lambda-carrying; covered in test_workflow_io",
+    "SanityChecker": "label-aware column selection; test_sanity_checker",
+    "SanityCheckerModel": "fitted product of SanityChecker",
+}
+
+#: fitted-model classes produced by a covered estimator (contract reaches
+#: them through fit)
+_PRODUCED = {
+    "NumericVectorizerModel", "OneHotModel", "SmartTextVectorizerModel",
+    "MapVectorizerModel", "NumericBucketizerModel", "_MapBucketizerModel",
+    "GeolocationVectorizerModel", "ScalarStandardScalerModel",
+    "PercentileCalibratorModel", "IsotonicRegressionModel",
+    "FillMissingWithMeanModel", "ScalarNormalizerModel",
+    "StandardScalerModel", "LogisticRegressionModel", "LinearRegressionModel",
+    "NaiveBayesModel", "LinearSVCModel", "MLPModel", "TreeEnsembleModel",
+    "OpStringIndexerModel", "CountVectorizerModel", "GLMRegressionModel",
+}
+
+
+def test_registry_is_fully_covered():
+    missing = [name for name in STAGE_REGISTRY
+               if name not in CASES and name not in EXEMPT
+               and name not in _PRODUCED]
+    assert not missing, (
+        f"Stages without a contract case or exemption: {missing} — add a "
+        "case to tests/test_stage_contracts.py")
+
+
+def _roundtrip(stage):
+    """Serialize a stage exactly as model_io does and reconstruct it."""
+    arrays = {}
+    rec = model_io._stage_record(stage, arrays)
+    cls = STAGE_REGISTRY[rec["className"]]
+    params = model_io._decode_param(rec["params"], arrays)
+    params.pop("uid", None)
+    s2 = cls(uid=rec["uid"], **params)
+    if rec.get("isModel"):
+        state = model_io._decode_param(rec.get("modelState", {}), arrays)
+        if hasattr(s2, "apply_model_state"):
+            s2.apply_model_state(state)
+        else:
+            for k, v in state.items():
+                setattr(s2, k, v)
+    s2.input_features = stage.input_features
+    s2._output_feature = stage._output_feature
+    return s2
+
+
+def _assert_values_equal(a, b, context):
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float64), np.asarray(b, dtype=np.float64),
+            rtol=1e-6, atol=1e-9, err_msg=context)
+    elif isinstance(a, float) and isinstance(b, float):
+        assert a == pytest.approx(b, rel=1e-6, abs=1e-9), context
+    elif isinstance(a, dict) and isinstance(b, dict):
+        assert set(a) == set(b), context
+        for k in a:
+            _assert_values_equal(a[k], b[k], f"{context}[{k}]")
+    else:
+        assert a == b, context
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_stage_contract(name):
+    stage, feats, store = CASES[name]()
+    stage.set_input(*feats)
+    model = stage.fit(store) if isinstance(stage, Estimator) else stage
+
+    out = model.transform(store)
+    col = out[model.output_name]
+
+    # columnar vs row path on a sample of rows
+    for i in (0, 1, N // 2, N - 1):
+        row = {f.name: store[f.name].get_raw(i)
+               for f in model.input_features}
+        got = model.transform_row(row)
+        _assert_values_equal(got, col.get_raw(i),
+                             f"{name}: row {i} transform_row mismatch")
+
+    # save → load → transform equality
+    loaded = _roundtrip(model)
+    col2 = loaded.transform(store)[model.output_name]
+    for i in (0, N // 2, N - 1):
+        _assert_values_equal(col2.get_raw(i), col.get_raw(i),
+                             f"{name}: row {i} save/load mismatch")
