@@ -1,23 +1,28 @@
 """Benchmark entry — run by the driver on real TPU hardware.
 
-Runs the reference's headline workload: the Titanic
-BinaryClassificationModelSelector CV sweep (README.md:62-64: LR + RF grids,
-3 folds, AuPR selection) end-to-end — feature engineering, sanity checking,
-the batched CV grid, final refit, holdout evaluation.
+Covers the five BASELINE.json configs:
 
-The sweep runs TWICE in-process: the first (cold) run pays tracing + XLA
-compilation, the second (warm) run measures steady-state device time —
-the number that scales to repeated AutoML workloads. The persistent
-compilation cache makes later cold runs on the same host ≈ warm.
+1. ``titanic``   — Titanic BinaryClassificationModelSelector CV sweep
+                   (reference README.md:62-89; parity AuPR 0.8225)
+2. ``iris``      — Iris MultiClassificationModelSelector (string labels
+                   indexed + prediction deindexed), F1 selection
+3. ``boston``    — Boston housing RegressionModelSelector (RF + GBT), RMSE
+4. ``big_text``  — SmartTextVectorizer-heavy BigPassenger-schema workflow
+                   at 30k synthesized rows (hashing-path text + one-hot +
+                   dates), LR grid
+5. ``synthetic_trees`` — RF + GBT + XGB grid, 3-fold CV, 200k×20 synthetic
+                   rows by default (BENCH_SYNTH_ROWS overrides; the 10M
+                   BASELINE target is this config across a v5e-8 data mesh
+                   — single-chip HBM caps the joint sweep near 500k rows)
 
-Prints ONE JSON line:
-  metric      titanic_holdout_AuPR — parity metric against the only
-              published reference number (README.md:89 AuPR = 0.8225)
-  value       our holdout AuPR
-  vs_baseline value / 0.8225  (>1 = better than reference)
-  extras      cv_wallclock_s (warm steady-state train wall-clock),
-              cv_cold_s (first run incl. compile), compile_s (difference),
-              backend, n_devices
+Each config runs TWICE in-process: the first (cold) run pays tracing + XLA
+compilation, the second (warm) run is the steady-state number that scales
+to repeated AutoML workloads (compiled executables are cached across
+``validate()`` calls keyed by trace signature + shapes).
+
+Prints ONE JSON line. Headline metric stays ``titanic_holdout_AuPR``
+(the only published reference number); per-config results ride in
+``configs``.
 """
 from __future__ import annotations
 
@@ -29,6 +34,29 @@ import time
 REFERENCE_AUPR = 0.8225  # /root/reference/README.md:89
 
 
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _run_twice(fn, name: str):
+    t0 = time.time()
+    out_cold = fn()
+    cold_s = time.time() - t0
+    _log(f"[bench] {name} cold {cold_s:.1f}s")
+    t1 = time.time()
+    out_warm = fn()
+    warm_s = time.time() - t1
+    _log(f"[bench] {name} warm {warm_s:.1f}s")
+    return out_cold, out_warm, cold_s, warm_s
+
+
+def _run_once(fn, name: str):
+    t0 = time.time()
+    out = fn()
+    _log(f"[bench] {name} {time.time() - t0:.1f}s")
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -38,31 +66,79 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     backend = jax.default_backend()
-    sys.path.insert(0, "examples")
-    from titanic import run
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "examples"))
+    configs = {}
 
-    t0 = time.time()
-    out_cold = run(num_folds=3, seed=42)
-    cold_s = time.time() - t0
-
-    t1 = time.time()
-    out = run(num_folds=3, seed=42)
-    warm_s = time.time() - t1
-
-    summary = out["summary"]
-    holdout = summary.holdout_evaluation or {}
+    # 1. Titanic (headline parity config)
+    from titanic import run as run_titanic
+    cold, warm, cold_s, warm_s = _run_twice(
+        lambda: run_titanic(num_folds=3, seed=42), "titanic")
+    holdout = warm["summary"].holdout_evaluation or {}
     aupr = float(holdout.get("AuPR", 0.0))
+    configs["titanic"] = {
+        "AuPR": round(aupr, 4),
+        "vs_reference": round(aupr / REFERENCE_AUPR, 4),
+        "cv_warm_s": round(warm["train_time_s"], 2),
+        "cv_cold_s": round(cold["train_time_s"], 2),
+        "best_model": warm["summary"].best_model_name,
+    }
 
+    # 2. Iris multiclass (string labels round-trip)
+    from iris import run as run_iris
+    cold, warm, cold_s, warm_s = _run_twice(
+        lambda: run_iris(num_folds=3, seed=42), "iris")
+    configs["iris"] = {
+        "F1": round(float(warm["metrics"]["F1"]), 4),
+        "cv_warm_s": round(warm["train_time_s"], 2),
+        "cv_cold_s": round(cold["train_time_s"], 2),
+        "best_model": warm["summary"].best_model_name,
+    }
+
+    # 3. Boston regression
+    from boston import run as run_boston
+    cold, warm, cold_s, warm_s = _run_twice(
+        lambda: run_boston(num_folds=3, seed=42), "boston")
+    configs["boston"] = {
+        "RMSE": round(float(warm["metrics"]["RootMeanSquaredError"]), 4),
+        "R2": round(float(warm["metrics"]["R2"]), 4),
+        "cv_warm_s": round(warm["train_time_s"], 2),
+        "cv_cold_s": round(cold["train_time_s"], 2),
+        "best_model": warm["summary"].best_model_name,
+    }
+
+    # 4. SmartText-heavy (BigPassenger schema at scale)
+    big_rows = int(os.environ.get("BENCH_TEXT_ROWS", 30_000))
+    from big_passenger import run as run_big
+    out = _run_once(lambda: run_big(n_rows=big_rows, num_folds=3, seed=42),
+                    "big_text")
+    configs["big_text"] = {
+        "rows": big_rows,
+        "AuPR": round(float(out["metrics"]["AuPR"]), 4),
+        "cv_cold_s": round(out["train_time_s"], 2),
+    }
+
+    # 5. Synthetic tree grid at scale
+    synth_rows = int(os.environ.get("BENCH_SYNTH_ROWS", 200_000))
+    from synthetic_trees import run as run_synth
+    out = _run_once(lambda: run_synth(n_rows=synth_rows, num_folds=3,
+                                      seed=42), "synthetic_trees")
+    configs["synthetic_trees"] = {
+        "rows": synth_rows,
+        "AuPR": round(float(out["metrics"]["AuPR"]), 4),
+        "cv_cold_s": round(out["train_time_s"], 2),
+        "best_model": out["summary"].best_model_name,
+    }
+
+    t_aupr = configs["titanic"]["AuPR"]
     print(json.dumps({
         "metric": "titanic_holdout_AuPR",
-        "value": round(aupr, 4),
+        "value": t_aupr,
         "unit": "AuPR",
-        "vs_baseline": round(aupr / REFERENCE_AUPR, 4),
-        "cv_wallclock_s": round(out["train_time_s"], 2),
-        "cv_cold_s": round(out_cold["train_time_s"], 2),
-        "compile_s": round(cold_s - warm_s, 2),
-        "total_wallclock_s": round(time.time() - t0, 2),
-        "best_model": summary.best_model_name,
+        "vs_baseline": round(t_aupr / REFERENCE_AUPR, 4),
+        "cv_wallclock_s": configs["titanic"]["cv_warm_s"],
+        "cv_cold_s": configs["titanic"]["cv_cold_s"],
+        "configs": configs,
         "backend": backend,
         "n_devices": len(jax.devices()),
     }))

@@ -1,0 +1,120 @@
+"""SmartTextVectorizer-heavy workflow — the BigPassenger BASELINE config.
+
+The reference's ``test-data/BigPassengerWithHeader.csv`` fixture is 10 rows;
+its *schema* (free-text ``description`` beside numeric/categorical/date
+fields) is what makes it the smart-text stress config, so this example
+replays that schema at configurable scale with synthesized records. The
+``description`` column's cardinality exceeds ``max_cardinality``, routing it
+through the hashing path of SmartTextVectorizer
+(``SmartTextVectorizer.scala:60-163`` semantics).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+from transmogrifai_tpu import FeatureBuilder, Workflow
+from transmogrifai_tpu.dsl import transmogrify
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.models import BinaryClassificationModelSelector
+from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+from transmogrifai_tpu.models.tuning import DataBalancer
+
+_WORDS = ("travel cabin sea ocean deck luxury family crew storm rescue "
+          "ticket meal night morning harbor voyage captain steward porter "
+          "engine coal first second third class suite promenade").split()
+
+
+def synthesize_records(n: int, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    genders = np.array(["Male", "Female"], dtype=object)
+    recs = []
+    g_idx = rng.integers(0, 2, n)
+    heights = rng.normal(170, 12, n)
+    weights = rng.normal(70, 15, n)
+    ages = rng.integers(1, 90, n)
+    n_words = rng.integers(3, 12, n)
+    word_idx = rng.integers(0, len(_WORDS), (n, 12))
+    # label depends on gender + a text token ("rescue") + weight
+    has_rescue = (word_idx[:, :3] == _WORDS.index("rescue")).any(axis=1)
+    p = 0.15 + 0.4 * (g_idx == 1) + 0.25 * has_rescue \
+        - 0.1 * (weights > 85)
+    y = rng.random(n) < p
+    for i in range(n):
+        words = [_WORDS[j] for j in word_idx[i, :n_words[i]]]
+        recs.append({
+            "age": float(ages[i]) if rng.random() > 0.05 else None,
+            "gender": str(genders[g_idx[i]]),
+            "height": float(heights[i]),
+            "weight": float(weights[i]),
+            "description": " ".join(words) + f" voyage{i % 997}",
+            "boarded": 1471046600 + int(rng.integers(0, 3_000_000)),
+            "anotherFloat": float(rng.random()),
+            "survived": 1.0 if y[i] else 0.0,
+        })
+    return recs
+
+
+def build_features():
+    survived = FeatureBuilder.RealNN("survived").from_column().as_response()
+    age = FeatureBuilder.Real("age").from_column().as_predictor()
+    gender = FeatureBuilder.PickList("gender").from_column().as_predictor()
+    height = FeatureBuilder.Real("height").from_column().as_predictor()
+    weight = FeatureBuilder.Real("weight").from_column().as_predictor()
+    description = FeatureBuilder.Text("description").from_column().as_predictor()
+    boarded = FeatureBuilder.Date("boarded").from_column().as_predictor()
+    another = FeatureBuilder.Real("anotherFloat").from_column().as_predictor()
+
+    features = transmogrify([age, gender, height, weight, description,
+                             boarded, another])
+    checked = survived.sanity_check(features, remove_bad_features=True)
+    return survived, checked
+
+
+def run(n_rows: int = 30_000, num_folds: int = 3, families=None,
+        mesh=None, seed: int = 42):
+    import jax
+
+    if mesh is None and len(jax.devices()) > 1:
+        from transmogrifai_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh()
+    survived, checked = build_features()
+    if families is None:
+        families = [LogisticRegressionFamily()]
+
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=num_folds, validation_metric="AuPR", families=families,
+        splitter=DataBalancer(sample_fraction=0.1,
+                              reserve_test_fraction=0.1, seed=seed),
+        seed=seed, mesh=mesh)
+    prediction = survived.transform_with(selector, checked)
+
+    records = synthesize_records(n_rows, seed=seed)
+    wf = (Workflow()
+          .set_input_records(records)
+          .set_result_features(prediction)
+          .set_splitter(selector.splitter))
+
+    t0 = time.time()
+    model = wf.train()
+    train_time = time.time() - t0
+
+    evaluator = Evaluators.BinaryClassification.auPR().set_columns(
+        survived, prediction)
+    metrics = model.evaluate(records, evaluator)
+    selected = model.fitted_stages[selector.uid]
+    return {"model": model, "metrics": metrics,
+            "summary": selected.selector_summary,
+            "train_time_s": train_time}
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 30_000
+    out = run(n)
+    s = out["summary"]
+    print(f"train wall-clock: {out['train_time_s']:.2f}s ({n} rows)")
+    print(f"best model: {s.best_model_name} {s.best_model_params}")
+    print(f"full-data eval: { {k: round(float(v), 4) for k, v in out['metrics'].items() if isinstance(v, (int, float))} }")

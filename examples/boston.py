@@ -1,0 +1,90 @@
+"""Boston housing regression — the reference's OpBoston, TPU-native.
+
+Mirrors ``helloworld/src/main/scala/com/salesforce/hw/boston/OpBoston.scala``:
+13 numeric predictors transmogrified, RegressionModelSelector (GBT + RF, as
+the reference's ``modelTypesToUse``) with DataSplitter, RMSE selection.
+``housing.data`` is whitespace-delimited fixed-width; the loader converts it
+to records host-side (the reference's CustomReader analog).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from transmogrifai_tpu import FeatureBuilder, Workflow
+from transmogrifai_tpu.dsl import transmogrify
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.models import RegressionModelSelector
+from transmogrifai_tpu.models.tuning import DataSplitter
+
+BOSTON_SCHEMA = ["crim", "zn", "indus", "chas", "nox", "rm", "age", "dis",
+                 "rad", "tax", "ptratio", "b", "lstat", "medv"]
+DEFAULT_DATA = ("/root/reference/helloworld/src/main/resources/BostonDataset/"
+                "housing.data")
+
+
+def load_records(path: str = DEFAULT_DATA):
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            parts = line.split()
+            if len(parts) != len(BOSTON_SCHEMA):
+                continue
+            records.append({k: float(v) for k, v in zip(BOSTON_SCHEMA, parts)})
+    return records
+
+
+def build_features():
+    medv = FeatureBuilder.RealNN("medv").from_column().as_response()
+    nums = [FeatureBuilder.Real(n).from_column().as_predictor()
+            for n in BOSTON_SCHEMA[:13]]
+    features = transmogrify(nums)
+    return medv, features
+
+
+def run(data_path: str = DEFAULT_DATA, num_folds: int = 3, families=None,
+        mesh=None, seed: int = 42):
+    import jax
+
+    from transmogrifai_tpu.models.trees import GBTFamily, RandomForestFamily
+
+    if mesh is None and len(jax.devices()) > 1:
+        from transmogrifai_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh()
+    medv, features = build_features()
+    if families is None:
+        families = [RandomForestFamily(task="regression"),
+                    GBTFamily(task="regression")]
+
+    selector = RegressionModelSelector.with_cross_validation(
+        num_folds=num_folds, families=families,
+        splitter=DataSplitter(reserve_test_fraction=0.1, seed=seed),
+        seed=seed, mesh=mesh)
+    prediction = medv.transform_with(selector, features)
+
+    records = load_records(data_path)
+    wf = (Workflow()
+          .set_input_records(records)
+          .set_result_features(prediction)
+          .set_splitter(selector.splitter))
+
+    t0 = time.time()
+    model = wf.train()
+    train_time = time.time() - t0
+
+    evaluator = Evaluators.Regression().set_columns(medv, prediction)
+    metrics = model.evaluate(records, evaluator)
+    selected = model.fitted_stages[selector.uid]
+    return {"model": model, "metrics": metrics,
+            "summary": selected.selector_summary,
+            "train_time_s": train_time}
+
+
+if __name__ == "__main__":
+    path = sys.argv[1] if len(sys.argv) > 1 else DEFAULT_DATA
+    out = run(path)
+    s = out["summary"]
+    print(f"train wall-clock: {out['train_time_s']:.2f}s")
+    print(f"best model: {s.best_model_name} {s.best_model_params}")
+    print(f"full-data eval: { {k: round(float(v), 4) for k, v in out['metrics'].items() if isinstance(v, (int, float))} }")

@@ -1,0 +1,92 @@
+"""Iris multiclass — the reference's OpIris, TPU-native.
+
+Mirrors ``helloworld/src/main/scala/com/salesforce/hw/iris/OpIris.scala``:
+four numeric predictors transmogrified, the string species label indexed
+(``irisClass.indexed()`` → OpStringIndexerNoFilter), a
+MultiClassificationModelSelector with DataCutter, F1 selection, and the
+prediction deindexed back to species names (PredictionDeIndexer).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from transmogrifai_tpu import FeatureBuilder, Workflow
+from transmogrifai_tpu.dsl import transmogrify
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.models import MultiClassificationModelSelector
+from transmogrifai_tpu.models.tuning import DataCutter
+from transmogrifai_tpu.ops.indexers import (OpStringIndexerNoFilter,
+                                            PredictionDeIndexer)
+from transmogrifai_tpu.readers import DataReaders
+
+IRIS_SCHEMA = ["sepalLength", "sepalWidth", "petalLength", "petalWidth",
+               "irisClass"]
+DEFAULT_CSV = ("/root/reference/helloworld/src/main/resources/IrisDataset/"
+               "bezdekIris.data")
+
+
+def _num(field):
+    return lambda r: float(r[field]) if r.get(field) not in (None, "") else None
+
+
+def build_features():
+    iris_class = (FeatureBuilder.Text("irisClass")
+                  .from_column().as_response())
+    labels = iris_class.transform_with(OpStringIndexerNoFilter())
+
+    nums = [FeatureBuilder.Real(n).extract(_num(n), n).as_predictor()
+            for n in IRIS_SCHEMA[:4]]
+    features = transmogrify(nums)
+    return iris_class, labels, features
+
+
+def run(csv_path: str = DEFAULT_CSV, num_folds: int = 3, families=None,
+        mesh=None, seed: int = 42):
+    import jax
+
+    if mesh is None and len(jax.devices()) > 1:
+        from transmogrifai_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh()
+    iris_class, labels, features = build_features()
+
+    selector = MultiClassificationModelSelector.with_cross_validation(
+        num_folds=num_folds, families=families,
+        splitter=DataCutter(reserve_test_fraction=0.2, seed=seed),
+        seed=seed, mesh=mesh)
+    prediction = labels.transform_with(selector, features)
+    # species names round-trip: indexed prediction → label strings
+    deindexed = labels.transform_with(PredictionDeIndexer(), prediction)
+
+    reader = DataReaders.simple.csv(csv_path, IRIS_SCHEMA)
+    wf = (Workflow()
+          .set_reader(reader)
+          .set_result_features(prediction, deindexed)
+          .set_splitter(selector.splitter))
+
+    t0 = time.time()
+    model = wf.train()
+    train_time = time.time() - t0
+
+    evaluator = Evaluators.MultiClassification.f1().set_columns(
+        labels, prediction)
+    store = reader.generate_store([f for f in prediction.raw_features()])
+    metrics = model.evaluate(store, evaluator)
+    scored = model.score(store)
+    selected = model.fitted_stages[selector.uid]
+    return {"model": model, "metrics": metrics,
+            "summary": selected.selector_summary,
+            "predicted_labels": scored[deindexed.name],
+            "train_time_s": train_time}
+
+
+if __name__ == "__main__":
+    csv = sys.argv[1] if len(sys.argv) > 1 else DEFAULT_CSV
+    out = run(csv)
+    s = out["summary"]
+    print(f"train wall-clock: {out['train_time_s']:.2f}s")
+    print(f"best model: {s.best_model_name} {s.best_model_params}")
+    print(f"full-data eval: { {k: round(float(v), 4) for k, v in out['metrics'].items() if isinstance(v, (int, float))} }")
+    names = {out["predicted_labels"].get_raw(i) for i in range(10)}
+    print(f"sample deindexed predictions: {sorted(names)}")

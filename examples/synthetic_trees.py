@@ -1,0 +1,106 @@
+"""Large synthetic tree-grid sweep — the 10M-row BASELINE config.
+
+Full AutoML tree grid (RF + GBT + XGB families) with k-fold CV over a
+synthetic tabular dataset, mirroring BASELINE.json's fifth config. The
+feature matrix is generated directly as a dense device-ready array (the
+at-scale ingestion path: numeric columns need no host feature prep), so the
+benchmark isolates the tree engine's (fold × grid) sweep throughput —
+the exact workload Spark distributes over executors and we batch into one
+XLA program per family (models/_treefit.py).
+
+Row count is a parameter: the driver-facing bench uses SYNTH_ROWS (default
+2M single-chip; 10M fits a v5e-8 via the data-sharded mesh).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, Workflow, column_from_values
+from transmogrifai_tpu.columns import VectorColumn
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.models import BinaryClassificationModelSelector
+from transmogrifai_tpu.models.tuning import DataBalancer
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def synthesize_store(n_rows: int, n_features: int = 20, seed: int = 11):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n_rows, n_features)).astype(np.float32)
+    # tree-friendly target: axis-aligned interactions + noise
+    logits = (1.5 * (X[:, 0] > 0.3) * (X[:, 1] < 0.0)
+              + 1.0 * (X[:, 2] > 1.0)
+              - 1.2 * (X[:, 3] < -0.5)
+              + 0.3 * rng.normal(size=n_rows))
+    y = (logits > 0.4).astype(np.float64)
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "features": VectorColumn(ft.OPVector, X.astype(np.float64)),
+    })
+    return store
+
+
+def run(n_rows: int = 2_000_000, n_features: int = 20, num_folds: int = 5,
+        families=None, mesh=None, seed: int = 42):
+    import jax
+
+    from transmogrifai_tpu.models.trees import (GBTFamily, RandomForestFamily,
+                                                XGBoostFamily)
+
+    if mesh is None and len(jax.devices()) > 1:
+        from transmogrifai_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh()
+    if families is None:
+        # the BASELINE config's three tree families; reduced grid so the
+        # sweep is (3 + 3 + 2) × num_folds ensemble fits
+        families = [
+            RandomForestFamily(grid=[
+                {"maxDepth": d, "minInstancesPerNode": 10,
+                 "minInfoGain": 0.001} for d in (3, 6, 9)]),
+            GBTFamily(grid=[
+                {"maxDepth": d, "minInstancesPerNode": 10,
+                 "minInfoGain": 0.001} for d in (3, 6, 9)]),
+            XGBoostFamily(grid=[
+                {"maxDepth": d, "numRound": 20, "eta": 0.3,
+                 "minChildWeight": 1.0} for d in (3, 6)]),
+        ]
+
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=num_folds, validation_metric="AuPR", families=families,
+        splitter=DataBalancer(sample_fraction=0.1,
+                              reserve_test_fraction=0.1, seed=seed),
+        seed=seed, mesh=mesh)
+    prediction = label.transform_with(selector, feats)
+
+    store = synthesize_store(n_rows, n_features)
+    wf = (Workflow()
+          .set_input_store(store)
+          .set_result_features(prediction)
+          .set_splitter(selector.splitter))
+
+    t0 = time.time()
+    model = wf.train()
+    train_time = time.time() - t0
+
+    evaluator = Evaluators.BinaryClassification.auPR().set_columns(
+        label, prediction)
+    metrics = model.evaluate(store, evaluator)
+    selected = model.fitted_stages[selector.uid]
+    return {"model": model, "metrics": metrics,
+            "summary": selected.selector_summary,
+            "train_time_s": train_time}
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+    out = run(n)
+    s = out["summary"]
+    print(f"train wall-clock: {out['train_time_s']:.2f}s ({n} rows)")
+    print(f"best model: {s.best_model_name} {s.best_model_params}")
+    print(f"full-data eval: { {k: round(float(v), 4) for k, v in out['metrics'].items() if isinstance(v, (int, float))} }")
