@@ -162,3 +162,76 @@ def test_chunked_sweep_matches_unchunked(rng):
     finally:
         tuning.CHUNK_MEM_BUDGET_BYTES = saved
     np.testing.assert_allclose(full, chunked, rtol=1e-5)
+
+
+def test_balancer_exact_proportions():
+    """DataBalancer fractions port DataBalancer.getProportions exactly
+    (DataBalancer.scala:84-115) with reweighting as the mechanism."""
+    from transmogrifai_tpu.models.tuning import DataBalancer
+
+    # imbalanced, small enough to upsample: 50 pos / 950 neg, f=0.2
+    b = DataBalancer(sample_fraction=0.2, max_training_sample=10_000)
+    y = np.array([1.0] * 50 + [0.0] * 950)
+    b.pre_validation_prepare(y)
+    s = b.summary
+    # checkUpSampleSize(4): 4*50*0.8=160 < 0.2*950=190 ✓ and 2000 > 200 ✓
+    assert s["upSamplingFraction"] == 4.0
+    assert s["downSamplingFraction"] == pytest.approx(
+        (50 * 4 / 0.2 - 50 * 4) / 950)
+    w = b.sample_weights(y)
+    assert w[0] == 4.0 and w[-1] == pytest.approx(s["downSamplingFraction"])
+
+    # already balanced but too big: uniform downsample
+    b2 = DataBalancer(sample_fraction=0.1, max_training_sample=100)
+    y2 = np.array([1.0] * 100 + [0.0] * 100)
+    b2.pre_validation_prepare(y2)
+    assert b2.summary["upSamplingFraction"] == 0.0
+    assert b2.summary["downSamplingFraction"] == pytest.approx(0.5)
+    assert np.allclose(b2.sample_weights(y2), 0.5)
+
+    # too big AND imbalanced: downsample both
+    b3 = DataBalancer(sample_fraction=0.5, max_training_sample=100)
+    y3 = np.array([1.0] * 200 + [0.0] * 800)
+    b3.pre_validation_prepare(y3)
+    assert b3.summary["upSamplingFraction"] == pytest.approx(50 / 200)
+    assert b3.summary["downSamplingFraction"] == pytest.approx(
+        0.5 * 100 / 800)
+
+
+def test_cutter_relabels_and_model_maps_back(rng):
+    """DataCutter drops rare labels and re-indexes contiguously; the
+    SelectedModel translates predictions back to original labels."""
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import MultiClassificationModelSelector
+    from transmogrifai_tpu.models.tuning import DataCutter
+    from transmogrifai_tpu.columns import VectorColumn
+    from transmogrifai_tpu.vector_metadata import (VectorColumnMetadata,
+                                                   VectorMetadata)
+
+    n = 300
+    # labels 0, 2, 7 frequent; 5 rare (dropped) → model classes 0,1,2
+    base = np.array([0.0, 2.0, 7.0])
+    y = base[rng.integers(0, 3, n)]
+    y[:3] = 5.0
+    X = np.stack([(y == v).astype(float) + 0.05 * rng.normal(size=n)
+                  for v in base], axis=1)
+    meta = VectorMetadata("features", [
+        VectorColumnMetadata(f"x{i}", "Real") for i in range(3)])
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "features": VectorColumn(ft.OPVector, X, meta)})
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+    selector = MultiClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily(grid=[
+            {"regParam": 0.01, "elasticNetParam": 0.0}])],
+        splitter=DataCutter(min_label_fraction=0.05), seed=3)
+    pred = label.transform_with(selector, feats)
+    model = Workflow().set_input_store(store).set_result_features(pred).train()
+    scored = model.transform(store)
+    got = np.asarray(scored[pred.name].prediction)
+    assert set(np.unique(got)) <= {0.0, 2.0, 7.0}   # original label values
+    acc = (got[3:] == y[3:]).mean()
+    assert acc > 0.9, acc
+    sel = model.fitted_stages[selector.uid]
+    assert sel.label_mapping == [0.0, 2.0, 7.0]

@@ -72,14 +72,24 @@ class SelectedModel(PredictorModel):
 
     def __init__(self, inner: Optional[PredictorModel] = None,
                  task: str = "binary",
+                 label_mapping: Optional[Sequence[float]] = None,
                  uid: Optional[str] = None):
         super().__init__(uid=uid)
         self.inner = inner
         self.task = task
+        #: model class id → original label value, when a DataCutter dropped
+        #: rare labels and re-indexed the rest (DataCutter.scala metadata
+        #: fix-up analog)
+        self.label_mapping = list(label_mapping) if label_mapping else None
         self.selector_summary: Optional[ModelSelectorSummary] = None
 
     def predict_arrays(self, X):
-        return self.inner.predict_arrays(X)
+        pred, raw, prob = self.inner.predict_arrays(X)
+        if self.label_mapping is not None:
+            lm = np.asarray(self.label_mapping, dtype=np.float64)
+            pred = lm[np.clip(np.asarray(pred).astype(np.int64), 0,
+                              len(lm) - 1)]
+        return pred, raw, prob
 
     def has_test_eval(self) -> bool:
         return True
@@ -168,11 +178,13 @@ class ModelSelector(PredictorEstimator):
     def find_best_estimator(self, store: ColumnStore
                             ) -> Tuple[ModelFamily, Dict, ValidatorSummary]:
         X, y = extract_xy(store, self.label_name, self.features_name)
-        keep = self.splitter.keep_mask(y) if self.splitter else \
-            np.ones_like(y, dtype=bool)
-        X, y = X[keep], y[keep]
         if self.splitter is not None:
+            # estimate BEFORE dropping (DataBalancer.estimate sees full
+            # counts), then drop rare labels and re-index contiguously
             self.splitter.pre_validation_prepare(y)
+            keep = self.splitter.keep_mask(y)
+            X, y = X[keep], y[keep]
+            y = self.splitter.relabel(y)
             base_w = self.splitter.sample_weights(y)
         else:
             base_w = None
@@ -203,11 +215,12 @@ class ModelSelector(PredictorEstimator):
             # binary-column mask) that find_best_estimator would have set
             best_family, best_hparams = self.best_estimator_
             vsummary = self.precomputed_summary_
-            keep = self.splitter.keep_mask(y) if self.splitter else \
-                np.ones_like(y, dtype=bool)
             if self.splitter is not None:
-                self.splitter.pre_validation_prepare(y[keep])
-            self._maybe_set_classes(y[keep])
+                self.splitter.pre_validation_prepare(y)
+                keep = self.splitter.keep_mask(y)
+                self._maybe_set_classes(self.splitter.relabel(y[keep]))
+            else:
+                self._maybe_set_classes(y)
             from .trees import detect_binary_columns
             bmask = detect_binary_columns(X)
             for fam in self.families:
@@ -218,11 +231,14 @@ class ModelSelector(PredictorEstimator):
                 self.find_best_estimator(store)
 
         # final refit on the full prepared train (ModelSelector.scala:158-159)
-        keep = self.splitter.keep_mask(y) if self.splitter else \
-            np.ones_like(y, dtype=bool)
-        Xk, yk = X[keep], y[keep]
-        w = (self.splitter.sample_weights(yk) if self.splitter
-             else np.ones_like(yk))
+        if self.splitter is not None:
+            keep = self.splitter.keep_mask(y)
+            Xk = X[keep]
+            yk = self.splitter.relabel(y[keep])
+            w = self.splitter.sample_weights(yk)
+        else:
+            Xk, yk = X, y
+            w = np.ones_like(yk)
         single = best_family.clone_single(best_hparams)
         grid = single.stack_grid()
         params = jax.jit(lambda X, y, w: single.fit_batch(X, y, w, grid))(
@@ -235,7 +251,10 @@ class ModelSelector(PredictorEstimator):
                                single.predict_batch(params, jnp.asarray(Xk)))
         train_eval = _task_metrics(self.task, yk, pred[0], prob[0])
 
-        model = SelectedModel(inner=inner, task=self.task)
+        mapping = (self.splitter.original_labels() if self.splitter
+                   else None)
+        model = SelectedModel(inner=inner, task=self.task,
+                              label_mapping=mapping)
         model.selector_summary = ModelSelectorSummary(
             validator_summary=vsummary,
             splitter_summary=self.splitter.summary if self.splitter else {},

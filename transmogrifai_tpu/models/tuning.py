@@ -72,14 +72,28 @@ class Splitter:
         """Rows admitted to training at all (DataCutter label dropping)."""
         return np.ones_like(y, dtype=bool)
 
+    def relabel(self, y: np.ndarray) -> np.ndarray:
+        """Map kept labels to contiguous model classes (DataCutter only)."""
+        return y
+
+    def original_labels(self):
+        """new class id → original label value, or None (identity)."""
+        return None
+
 
 class DataSplitter(Splitter):
     """Plain splitter — regression (DataSplitter.scala:30-100)."""
 
 
 class DataBalancer(Splitter):
-    """Binary-label balancer (DataBalancer.scala): if the positive fraction
-    is below ``sample_fraction``, reweight so positives carry that share."""
+    """Binary-label balancer with the reference's exact sampling fractions
+    (``DataBalancer.scala:84-131`` getProportions, ``:208-253`` estimate).
+
+    TPU-first mechanism: instead of physically up-/down-sampling rows (the
+    reference's ``rebalance``), each class carries its sampling fraction as
+    a per-row TRAINING WEIGHT — identical expected class mass, but static
+    shapes so the whole (fold × grid) sweep stays one compiled program.
+    """
 
     def __init__(self, sample_fraction: float = 0.1, seed: int = 42,
                  reserve_test_fraction: float = 0.0,
@@ -90,26 +104,51 @@ class DataBalancer(Splitter):
         self._pos_weight = 1.0
         self._neg_weight = 1.0
 
+    @staticmethod
+    def get_proportions(small: float, big: float, sample_f: float,
+                        max_training_sample: int) -> Tuple[float, float]:
+        """(downSample for majority, upSample for minority) — exact port of
+        ``DataBalancer.getProportions`` (:84-115)."""
+        def check_up(mult: float) -> bool:
+            return (mult * small * (1 - sample_f) < sample_f * big
+                    and max_training_sample * sample_f > small * mult)
+
+        if small < max_training_sample * sample_f:
+            up = next((float(m) for m in (100, 50, 10, 5, 4, 3, 2)
+                       if check_up(m)), 1.0)
+            down = (small * up / sample_f - small * up) / big
+            return down, up
+        # data too big: downsample both classes
+        up = (max_training_sample * sample_f) / small
+        return (1 - sample_f) * max_training_sample / big, up
+
     def pre_validation_prepare(self, y: np.ndarray) -> None:
         n = len(y)
         n_pos = float((y == 1).sum())
         n_neg = float(n - n_pos)
-        minority, majority = (n_pos, n_neg) if n_pos <= n_neg else (n_neg, n_pos)
-        frac = minority / max(n, 1)
-        self.summary = {"positiveLabels": n_pos, "negativeLabels": n_neg,
-                        "desiredFraction": self.sample_fraction,
-                        "upSamplingFraction": 1.0, "downSamplingFraction": 1.0}
-        if frac >= self.sample_fraction or minority == 0:
-            return
-        # reweight minority up to the target fraction
         f = self.sample_fraction
-        target_ratio = f / (1.0 - f) * (majority / minority)
-        if n_pos <= n_neg:
-            self._pos_weight = target_ratio
-            self.summary["upSamplingFraction"] = target_ratio
+        mts = self.max_training_sample
+        is_pos_small = n_pos < n_neg
+        small, big = (n_pos, n_neg) if is_pos_small else (n_neg, n_pos)
+
+        if small == 0 or small / max(n, 1) >= f:
+            # already balanced; uniformly downsample only when too big
+            frac = mts / n if mts < n else 1.0
+            self._pos_weight = self._neg_weight = frac
+            self.summary = {
+                "positiveLabels": n_pos, "negativeLabels": n_neg,
+                "desiredFraction": f, "upSamplingFraction": 0.0,
+                "downSamplingFraction": frac}
+            return
+        down, up = self.get_proportions(small, big, f, mts)
+        if is_pos_small:
+            self._pos_weight, self._neg_weight = up, down
         else:
-            self._neg_weight = target_ratio
-            self.summary["upSamplingFraction"] = target_ratio
+            self._pos_weight, self._neg_weight = down, up
+        self.summary = {
+            "positiveLabels": n_pos, "negativeLabels": n_neg,
+            "desiredFraction": f, "upSamplingFraction": up,
+            "downSamplingFraction": down}
 
     def sample_weights(self, y: np.ndarray) -> np.ndarray:
         return np.where(y == 1, self._pos_weight, self._neg_weight).astype(
@@ -143,6 +182,73 @@ class DataCutter(Splitter):
         if self._kept_labels is None:
             return np.ones_like(y, dtype=bool)
         return np.isin(y, self._kept_labels)
+
+    def relabel(self, y: np.ndarray) -> np.ndarray:
+        """Kept labels → contiguous 0..k-1 model classes: the reference
+        re-indexes and fixes the NominalAttribute metadata
+        (DataCutter.scala:30-120); here the SelectedModel carries the
+        inverse mapping and translates predictions back."""
+        if self._kept_labels is None:
+            return y
+        return np.searchsorted(self._kept_labels, y).astype(np.float64)
+
+    def original_labels(self):
+        if self._kept_labels is None:
+            return None
+        # identity mapping needs no translation
+        if np.array_equal(self._kept_labels,
+                          np.arange(len(self._kept_labels))):
+            return None
+        return [float(v) for v in self._kept_labels]
+
+
+class RandomParamBuilder:
+    """Random hyperparameter grids (RandomParamBuilder.scala:1): declare a
+    distribution per param, then ``build(n)`` samples n grid points to feed
+    a ModelFamily's ``grid``.
+
+    ``uniform`` — linear range; ``exponential`` — log-uniform (the
+    reference's choice for regularization params); ``choice`` — discrete.
+    """
+
+    def __init__(self, seed: int = 42):
+        self.seed = seed
+        self._dists: List[Tuple[str, str, Any]] = []
+
+    def uniform(self, name: str, lo: float, hi: float) -> "RandomParamBuilder":
+        self._dists.append((name, "uniform", (float(lo), float(hi))))
+        return self
+
+    def exponential(self, name: str, lo: float, hi: float
+                    ) -> "RandomParamBuilder":
+        if lo <= 0 or hi <= 0:
+            raise ValueError("exponential bounds must be positive")
+        self._dists.append((name, "exponential", (float(lo), float(hi))))
+        return self
+
+    def choice(self, name: str, values: Sequence[Any]) -> "RandomParamBuilder":
+        if not values:
+            raise ValueError(f"choice({name!r}) needs at least one value")
+        self._dists.append((name, "choice", list(values)))
+        return self
+
+    def build(self, n: int) -> List[Dict[str, Any]]:
+        rng = np.random.default_rng(self.seed)
+        grid = []
+        for _ in range(n):
+            point: Dict[str, Any] = {}
+            for name, kind, spec in self._dists:
+                if kind == "uniform":
+                    lo, hi = spec
+                    point[name] = float(rng.uniform(lo, hi))
+                elif kind == "exponential":
+                    lo, hi = spec
+                    point[name] = float(np.exp(
+                        rng.uniform(np.log(lo), np.log(hi))))
+                else:
+                    point[name] = spec[int(rng.integers(len(spec)))]
+            grid.append(point)
+        return grid
 
 
 # ---------------------------------------------------------------------------

@@ -195,6 +195,10 @@ class Workflow:
         self.parameters: Dict[str, Any] = {}
         self.blacklisted_features: List[Feature] = []
         self._workflow_cv = False
+        self._warm_stages: Dict[str, FittedModel] = {}
+        #: per-stage fit/transform wall-clock collected during train
+        #: (OpSparkListener StageMetrics analog)
+        self._stage_metrics: Dict[str, Dict[str, Any]] = {}
 
     # -- config ------------------------------------------------------------
     def set_result_features(self, *features: Feature) -> "Workflow":
@@ -228,6 +232,13 @@ class Workflow:
 
     def set_parameters(self, params: Dict[str, Any]) -> "Workflow":
         self.parameters = dict(params)
+        return self
+
+    def with_model_stages(self, model: "WorkflowModel") -> "Workflow":
+        """Warm start (OpWorkflow.withModelStages :457-460): fitted stages
+        from a previous model are substituted by uid during train, skipping
+        their refit. Estimators not present in the model still fit."""
+        self._warm_stages = dict(model.fitted_stages)
         return self
 
     def with_workflow_cv(self, enabled: bool = True) -> "Workflow":
@@ -310,6 +321,7 @@ class Workflow:
             blacklisted_features=self.blacklisted_features,
             rff_results=rff_results,
             train_time_s=train_time,
+            stage_metrics=self._stage_metrics,
         )
 
     def _fit_dag(self, dag: StagesDAG, train: ColumnStore,
@@ -324,8 +336,25 @@ class Workflow:
         for layer in dag:
             models: List[Transformer] = []
             for stage in layer:
+                metrics = self._stage_metrics.setdefault(
+                    stage.uid, {"stageName": stage.stage_name()})
                 if isinstance(stage, Estimator):
-                    model = stage.fit(train)
+                    warm = self._warm_stages.get(stage.uid)
+                    if warm is not None:
+                        # warm start: substitute the previously fitted model
+                        # by uid. Shallow-copy before rebinding wiring so
+                        # the donor WorkflowModel's stages stay intact
+                        # (fitted state/arrays are shared read-only).
+                        import copy as _copy
+                        model = _copy.copy(warm)
+                        model.input_features = stage.input_features
+                        model._output_feature = stage.get_output()
+                        metrics["warmStarted"] = True
+                        metrics["fitSeconds"] = 0.0
+                    else:
+                        tf = time.time()
+                        model = stage.fit(train)
+                        metrics["fitSeconds"] = round(time.time() - tf, 4)
                     fitted[stage.uid] = model
                     if model.has_test_eval() and test is not None:
                         model.evaluate_model(test)
@@ -336,9 +365,15 @@ class Workflow:
                     raise WorkflowError(f"Unfittable stage {stage!r}")
             # transform both splits with the fully fitted layer — the
             # layer's vectorizers fuse into one XLA program per split
+            tt = time.time()
             train = apply_layer_vectorized(models, train)
             if test is not None:
                 test = apply_layer_vectorized(models, test)
+            layer_transform_s = time.time() - tt
+            for m in models:
+                self._stage_metrics.setdefault(
+                    m.uid, {"stageName": m.stage_name()})[
+                    "layerTransformSeconds"] = round(layer_transform_s, 4)
         return fitted, time.time() - t0, train, test
 
     def _fit_dag_workflow_cv(self, result_features, dag: StagesDAG,
@@ -369,13 +404,16 @@ class Workflow:
         label_name = ms.input_features[0].name
         feats_f = ms.input_features[1]
         y = np.asarray(train_b[label_name].values, dtype=np.float64)
-        keep = ms.splitter.keep_mask(y) if ms.splitter else \
-            np.ones_like(y, dtype=bool)
+        if ms.splitter is not None:
+            ms.splitter.pre_validation_prepare(y)
+            keep = ms.splitter.keep_mask(y)
+        else:
+            keep = np.ones_like(y, dtype=bool)
         store_kept = train_b.take(np.nonzero(keep)[0]) if not keep.all() \
             else train_b
         y_kept = y[keep]
         if ms.splitter is not None:
-            ms.splitter.pre_validation_prepare(y_kept)
+            y_kept = ms.splitter.relabel(y_kept)
             base_w = ms.splitter.sample_weights(y_kept)
         else:
             base_w = np.ones_like(y_kept)
@@ -425,7 +463,8 @@ class WorkflowModel:
                  parameters: Optional[Dict[str, Any]] = None,
                  blacklisted_features: Sequence[Feature] = (),
                  rff_results=None,
-                 train_time_s: float = 0.0):
+                 train_time_s: float = 0.0,
+                 stage_metrics: Optional[Dict[str, Dict[str, Any]]] = None):
         self.uid = uid_mod.make_uid("WorkflowModel")
         self.result_features = tuple(result_features)
         self.fitted_stages = dict(fitted_stages)
@@ -434,6 +473,8 @@ class WorkflowModel:
         self.blacklisted_features = list(blacklisted_features)
         self.rff_results = rff_results
         self.train_time_s = train_time_s
+        #: per-stage fit/transform timings (OpSparkListener analog)
+        self.stage_metrics = stage_metrics or {}
 
     # -- stage access (OpWorkflowModel.getOriginStageOf analog) ------------
     def _resolved_dag(self) -> List[List[Transformer]]:
@@ -526,6 +567,7 @@ class WorkflowModel:
     def summary(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"uid": self.uid,
                                "trainTimeSeconds": self.train_time_s,
+                               "stageMetrics": self.stage_metrics,
                                "stages": {}}
         for uid, model in self.fitted_stages.items():
             s = getattr(model, "summary", None)
