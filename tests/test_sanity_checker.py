@@ -96,3 +96,77 @@ def test_summary_json(rng):
     js = model.summary()
     assert "columnStats" in js and len(js["columnStats"]) == 4
     assert "correlationsWithLabel" in js
+
+
+def test_spearman_and_mutual_info(rng):
+    """Spearman rank correlation catches monotone-nonlinear label links that
+    Pearson underestimates; contingency stats expose PMI / mutual info
+    (SanityChecker.scala:634-638, OpStatistics.scala:300)."""
+    from transmogrifai_tpu.columns import VectorColumn
+    from transmogrifai_tpu.vector_metadata import (VectorColumnMetadata,
+                                                   VectorMetadata)
+    n = 400
+    y = rng.random(n)
+    x_mono = np.exp(6 * y)          # monotone in y, very non-linear
+    x_noise = rng.normal(size=n)
+    X = np.stack([x_mono, x_noise], axis=1)
+    meta = VectorMetadata("features", [
+        VectorColumnMetadata("mono", "Real"),
+        VectorColumnMetadata("noise", "Real")])
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "features": VectorColumn(ft.OPVector, X, meta)})
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+
+    checker = SanityChecker(remove_bad_features=False,
+                            correlation_type="spearman")
+    checker.set_input(label, feats)
+    model = checker.fit(store)
+    stats = {s["name"]: s for s in model.summary_.column_stats}
+    assert stats["mono_0"]["spearmanCorrWithLabel"] == pytest.approx(1.0)
+    assert abs(stats["mono_0"]["corrWithLabel"]) < 0.95   # Pearson misses it
+    assert abs(stats["noise_1"]["spearmanCorrWithLabel"]) < 0.2
+
+    # pearson-gated checker skips the rank pass (reference computes only
+    # the configured CorrelationType)
+    cp = SanityChecker(remove_bad_features=False)
+    cp.set_input(label, feats)
+    mp = cp.fit(store)
+    assert mp.summary_.column_stats[0]["spearmanCorrWithLabel"] is None
+
+    # spearman-driven gate removes the monotone leaker
+    checker2 = SanityChecker(remove_bad_features=True,
+                             correlation_type="spearman",
+                             max_correlation=0.95,
+                             remove_feature_group=False)
+    checker2.set_input(label, feats)
+    m2 = checker2.fit(store)
+    dropped = {di["name"] for di in m2.summary_.dropped}
+    assert any(d.startswith("mono") for d in dropped)
+
+
+def test_pmi_reported_for_categorical_groups(rng):
+    from transmogrifai_tpu.columns import VectorColumn
+    from transmogrifai_tpu.vector_metadata import (VectorColumnMetadata,
+                                                   VectorMetadata)
+    n = 300
+    y = rng.integers(0, 2, n).astype(float)
+    cat = np.where(y == 1, 0, 1)    # perfectly dependent 2-cat pivot
+    X = np.stack([cat == 0, cat == 1], axis=1).astype(float)
+    meta = VectorMetadata("features", [
+        VectorColumnMetadata("c", "PickList", grouping="c",
+                             indicator_value="a"),
+        VectorColumnMetadata("c", "PickList", grouping="c",
+                             indicator_value="b")])
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "features": VectorColumn(ft.OPVector, X, meta)})
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+    checker = SanityChecker(remove_bad_features=False)
+    checker.set_input(label, feats)
+    model = checker.fit(store)
+    cs = model.summary_.categorical_stats[0]
+    assert cs["mutualInfo"] > 0.9           # ~1 bit for perfect dependence
+    assert len(cs["pointwiseMutualInfo"]) == 2

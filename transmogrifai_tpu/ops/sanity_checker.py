@@ -94,6 +94,31 @@ def _cramers_v(cont: np.ndarray) -> Tuple[float, np.ndarray, np.ndarray]:
     return v, support, confidence
 
 
+def _pmi_mi(cont: np.ndarray) -> Tuple[np.ndarray, float]:
+    """Pointwise mutual information per (class, category) cell and total
+    mutual information, log base 2 (OpStatistics.contingencyStats :300)."""
+    total = cont.sum()
+    if total <= 0:
+        return np.zeros_like(cont), 0.0
+    p = cont / total
+    pr = p.sum(axis=1, keepdims=True)
+    pc = p.sum(axis=0, keepdims=True)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        pmi = np.where(p > 0, np.log2(p / np.maximum(pr @ pc, 1e-300)), 0.0)
+    mi = float((p * pmi).sum())
+    return pmi, mi
+
+
+def _average_ranks(v: np.ndarray) -> np.ndarray:
+    """Average ranks with ties (scipy.stats.rankdata 'average' semantics,
+    what MLlib's Spearman uses) — one unique pass per column."""
+    _uniq, inv, counts = np.unique(v, return_inverse=True,
+                                   return_counts=True)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    avg = starts + (counts - 1) / 2.0 + 1.0     # 1-based average rank
+    return avg[inv]
+
+
 class SanityCheckerSummary:
     """Per-column stats + dropped columns with reasons
     (SanityCheckerMetadata.scala)."""
@@ -159,10 +184,11 @@ class SanityChecker(Estimator, AllowLabelAsInput):
                  max_cramers_v: float = MAX_CRAMERS_V,
                  remove_bad_features: bool = False,
                  remove_feature_group: bool = True,
-                 protect_text_shared_hash: bool = False,
+                 protect_text_shared_hash: bool = True,
                  max_rule_confidence: float = MAX_RULE_CONFIDENCE,
                  min_required_rule_support: float = MIN_REQUIRED_RULE_SUPPORT,
                  feature_label_corr_only: bool = False,
+                 correlation_type: str = "pearson",
                  check_sample: float = CHECK_SAMPLE,
                  sample_seed: int = 42,
                  uid: Optional[str] = None):
@@ -173,10 +199,19 @@ class SanityChecker(Estimator, AllowLabelAsInput):
         self.max_cramers_v = max_cramers_v
         self.remove_bad_features = remove_bad_features
         self.remove_feature_group = remove_feature_group
+        # reference default protects hashed text columns from the corr gate
+        # (SanityChecker.scala:596-627)
         self.protect_text_shared_hash = protect_text_shared_hash
         self.max_rule_confidence = max_rule_confidence
         self.min_required_rule_support = min_required_rule_support
         self.feature_label_corr_only = feature_label_corr_only
+        if correlation_type not in ("pearson", "spearman"):
+            raise ValueError(
+                f"correlation_type must be pearson|spearman, got "
+                f"{correlation_type!r}")
+        #: which correlation drives the corr gate (SanityChecker.scala:634-638
+        #: CorrelationType); both are always reported in the summary
+        self.correlation_type = correlation_type
         self.check_sample = check_sample
         self.sample_seed = sample_seed
 
@@ -210,11 +245,32 @@ class SanityChecker(Estimator, AllowLabelAsInput):
             for r in _moments_kernel(jnp.asarray(X), jnp.asarray(y),
                                      self.feature_label_corr_only))
 
+        # Spearman = Pearson over average ranks (MLlib Statistics.corr
+        # "spearman"); ranks built per column on host, correlations in the
+        # same fused gram kernel. Only computed when it drives the gate —
+        # the reference computes just the configured CorrelationType
+        # (SanityChecker.scala:634-638) and the O(d·n log n) host ranking
+        # is real money on wide hashed-text vectors.
+        if self.correlation_type == "spearman":
+            R = np.empty_like(X)
+            for j in range(d):
+                R[:, j] = _average_ranks(X[:, j])
+            _m, _v, spearman_label, _c, _a, _b = (
+                np.asarray(r) if r is not None else None
+                for r in _moments_kernel(jnp.asarray(R),
+                                         jnp.asarray(_average_ranks(y)),
+                                         True))
+        else:
+            spearman_label = None
+
         names = meta.column_names() if meta.size == d else \
             [f"{feat_name}_{i}" for i in range(d)]
         is_hash = [meta.size == d and
                    (meta.columns[i].descriptor_value or "").startswith("hash_")
                    for i in range(d)]
+
+        gate_corr = (spearman_label if self.correlation_type == "spearman"
+                     else corr_label)
 
         summary = SanityCheckerSummary()
         summary.names = names
@@ -226,13 +282,16 @@ class SanityChecker(Estimator, AllowLabelAsInput):
                 "name": names[i], "mean": float(mean[i]),
                 "variance": float(var[i]), "min": float(zmin[i]),
                 "max": float(zmax[i]),
-                "corrWithLabel": float(corr_label[i])})
+                "corrWithLabel": float(corr_label[i]),
+                "spearmanCorrWithLabel": (
+                    float(spearman_label[i]) if spearman_label is not None
+                    else None)})
             if var[i] < self.min_variance:
                 reasons[i].append(
                     f"variance {var[i]:.3g} below min {self.min_variance}")
-            c = abs(float(corr_label[i]))
+            c = abs(float(gate_corr[i]))
             if not (self.protect_text_shared_hash and is_hash[i]):
-                if np.isnan(corr_label[i]):
+                if np.isnan(gate_corr[i]):
                     pass  # zero-variance already flagged
                 elif c > self.max_correlation:
                     reasons[i].append(
@@ -257,11 +316,14 @@ class SanityChecker(Estimator, AllowLabelAsInput):
                     cont = np.asarray(_contingency_kernel(
                         jnp.asarray(Y1), jnp.asarray(X[:, idxs])))
                     v, support, confidence = _cramers_v(cont)
+                    pmi, mi = _pmi_mi(cont)
                     summary.categorical_stats.append({
                         "group": f"{parent}_{grouping}",
                         "cramersV": v,
                         "support": support.tolist(),
-                        "maxRuleConfidence": confidence.tolist()})
+                        "maxRuleConfidence": confidence.tolist(),
+                        "pointwiseMutualInfo": pmi.tolist(),
+                        "mutualInfo": mi})
                     for j, i in enumerate(idxs):
                         if v > self.max_cramers_v:
                             reasons[i].append(
