@@ -1,0 +1,149 @@
+"""Runner / OpParams / observability / warm start / random search tests
+(OpWorkflowRunnerTest / OpParamsTest analogs)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, Workflow, column_from_values
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+from transmogrifai_tpu.models.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.models.tuning import RandomParamBuilder
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.runner import (OpApp, OpParams, OpWorkflowRunner,
+                                      RunType)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+class _ListReader:
+    def __init__(self, records):
+        self._records = records
+
+    def read_records(self):
+        return list(self._records)
+
+
+def _records(rng, n=200):
+    y = rng.integers(0, 2, n).astype(float)
+    x = rng.normal(size=n) + y
+    return [{"label": float(y[i]), "x": float(x[i])} for i in range(n)]
+
+
+def _flow(num_folds=2):
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    vec = transmogrify([fx])
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=num_folds, families=[LogisticRegressionFamily()],
+        splitter=None, seed=5)
+    pred = label.transform_with(selector, vec)
+    wf = Workflow().set_result_features(pred)
+    return wf, label, pred, selector
+
+
+def test_runner_train_score_evaluate(rng, tmp_path):
+    records = _records(rng)
+    reader = _ListReader(records)
+    wf, label, pred, _sel = _flow()
+    evaluator = Evaluators.BinaryClassification.auPR().set_columns(label, pred)
+    runner = OpWorkflowRunner(wf, training_reader=reader,
+                              scoring_reader=reader, evaluator=evaluator)
+    params = OpParams(model_location=str(tmp_path / "model"),
+                      metrics_location=str(tmp_path / "metrics.json"),
+                      write_location=str(tmp_path / "scores.csv"))
+
+    out = runner.run(RunType.TRAIN, params)
+    assert out.model_location and os.path.exists(
+        os.path.join(out.model_location, "model.json"))
+    assert os.path.exists(params.metrics_location)
+    # per-stage timers rode into the metrics sink (OpSparkListener analog)
+    sunk = json.load(open(params.metrics_location))
+    assert any("fitSeconds" in m for m in sunk["stageMetrics"].values())
+
+    out = runner.run(RunType.SCORE, params)
+    assert out.metrics["rowsScored"] == len(records)
+    assert os.path.exists(params.write_location)
+
+    out = runner.run(RunType.EVALUATE, params)
+    assert out.metrics["AuPR"] > 0.6
+
+
+def test_opparams_stage_overrides(rng, tmp_path):
+    p = tmp_path / "params.json"
+    p.write_text(json.dumps({
+        "stageParams": {"SanityChecker": {"min_variance": 0.123}},
+        "customParams": {"tag": "run1"}}))
+    params = OpParams.from_file(str(p))
+    assert params.custom_params["tag"] == "run1"
+
+    from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    vec = transmogrify([fx])
+    checked = label.transform_with(SanityChecker(), vec)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None)
+    pred = label.transform_with(selector, checked)
+    wf = Workflow().set_result_features(pred)
+    params.apply_to_workflow(wf)
+    assert checked.origin_stage.min_variance == 0.123
+
+
+def test_warm_start_skips_refit(rng):
+    records = _records(rng)
+    wf, label, pred, selector = _flow()
+    model = wf.set_input_records(records).train()
+
+    wf2, label2, pred2, selector2 = _flow()
+    # same DAG object reuse: warm start matches by uid, so rebuild the SAME
+    # features through with_model_stages on a fresh workflow over them
+    wf3 = (Workflow().set_result_features(pred)
+           .set_input_records(records).with_model_stages(model))
+    model2 = wf3.train()
+    m = model2.stage_metrics[selector.uid]
+    assert m.get("warmStarted") is True and m["fitSeconds"] == 0.0
+    # warm-started model scores identically AND the donor model's stage
+    # wiring is untouched (no in-place mutation)
+    s1 = model.score(records)
+    s2 = model2.score(records)
+    np.testing.assert_allclose(
+        np.asarray(s1[pred.name].prediction),
+        np.asarray(s2[pred.name].prediction))
+
+
+def test_random_param_builder():
+    grid = (RandomParamBuilder(seed=1)
+            .exponential("regParam", 1e-4, 1e-1)
+            .uniform("elasticNetParam", 0.0, 1.0)
+            .choice("fitIntercept", [True, False])
+            .build(25))
+    assert len(grid) == 25
+    regs = [g["regParam"] for g in grid]
+    assert all(1e-4 <= r <= 1e-1 for r in regs)
+    # log-uniform: spread over decades
+    assert min(regs) < 1e-3 and max(regs) > 1e-2
+    assert {g["fitIntercept"] for g in grid} == {True, False}
+
+
+class _App(OpApp):
+    def __init__(self, runner_obj):
+        self._runner = runner_obj
+
+    def runner(self, params):
+        return self._runner
+
+
+def test_op_app_cli(rng, tmp_path):
+    records = _records(rng)
+    reader = _ListReader(records)
+    wf, label, pred, _sel = _flow()
+    runner = OpWorkflowRunner(wf, training_reader=reader,
+                              scoring_reader=reader)
+    app = _App(runner)
+    out = app.main(["--run-type", "Train",
+                    "--model-location", str(tmp_path / "m"),
+                    "--metrics-location", str(tmp_path / "met.json")])
+    assert out.run_type == "Train"
+    assert os.path.exists(str(tmp_path / "met.json"))

@@ -1,0 +1,221 @@
+"""Workflow runner, app entry and file-driven parameters.
+
+Parity:
+
+* ``OpParams`` (``features/.../OpParams.scala:30-150``): JSON/YAML config
+  holding per-stage parameter overrides (keyed by stage class name or uid,
+  applied reflectively), reader paths, model/metrics locations and custom
+  tags.
+* ``OpWorkflowRunner`` (``core/.../OpWorkflowRunner.scala:296,358-366``):
+  run types Train / Score / Evaluate / Features wiring readers, model
+  persistence and a metrics sink.
+* ``OpApp`` (``core/.../OpApp.scala``): abstract main() parsing CLI args
+  into a runner config and invoking the runner.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+__all__ = ["OpParams", "RunType", "RunnerResult", "OpWorkflowRunner",
+           "OpApp"]
+
+
+@dataclass
+class OpParams:
+    """File-driven workflow configuration (OpParams.scala:30-150)."""
+
+    #: {stage class name or uid: {param: value}} applied via set_params
+    stage_params: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    #: {reader name: {"path": ..., ...}}
+    reader_params: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    model_location: Optional[str] = None
+    write_location: Optional[str] = None
+    metrics_location: Optional[str] = None
+    custom_params: Dict[str, Any] = field(default_factory=dict)
+
+    @staticmethod
+    def from_file(path: str) -> "OpParams":
+        with open(path) as fh:
+            if path.endswith((".yaml", ".yml")):
+                import yaml
+                doc = yaml.safe_load(fh)
+            else:
+                doc = json.load(fh)
+        return OpParams(
+            stage_params=doc.get("stageParams", {}),
+            reader_params=doc.get("readerParams", {}),
+            model_location=doc.get("modelLocation"),
+            write_location=doc.get("writeLocation"),
+            metrics_location=doc.get("metricsLocation"),
+            custom_params=doc.get("customParams", {}))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"stageParams": self.stage_params,
+                "readerParams": self.reader_params,
+                "modelLocation": self.model_location,
+                "writeLocation": self.write_location,
+                "metricsLocation": self.metrics_location,
+                "customParams": self.custom_params}
+
+    def apply_to_workflow(self, workflow) -> None:
+        """Reflectively push stage params into the workflow's DAG stages
+        (OpWorkflow.setStageParameters :166-188): keys match stage uid or
+        stage class name."""
+        from .graph import all_stages
+        if not self.stage_params:
+            return
+        for stage in all_stages(workflow.result_features):
+            for key in (stage.uid, type(stage).__name__):
+                if key in self.stage_params:
+                    stage.set_params(**self.stage_params[key])
+
+
+class RunType:
+    TRAIN = "Train"
+    SCORE = "Score"
+    EVALUATE = "Evaluate"
+    FEATURES = "Features"
+
+    ALL = (TRAIN, SCORE, EVALUATE, FEATURES)
+
+
+@dataclass
+class RunnerResult:
+    run_type: str
+    metrics: Dict[str, Any] = field(default_factory=dict)
+    model_location: Optional[str] = None
+    scores: Any = None
+
+
+class OpWorkflowRunner:
+    """Run-type entry around a Workflow (OpWorkflowRunner.scala:296).
+
+    ``training_reader`` / ``scoring_reader`` follow the readers API
+    (``generate_store`` / ``read_records``); ``evaluator`` is an
+    evaluators instance wired to (label, prediction).
+    """
+
+    def __init__(self, workflow, training_reader=None, scoring_reader=None,
+                 evaluation_reader=None, evaluator=None,
+                 features_to_compute=None):
+        self.workflow = workflow
+        self.training_reader = training_reader
+        self.scoring_reader = scoring_reader
+        self.evaluation_reader = evaluation_reader or scoring_reader
+        self.evaluator = evaluator
+        self.features_to_compute = features_to_compute
+
+    # -- metrics sink ------------------------------------------------------
+    @staticmethod
+    def _write_metrics(location: Optional[str], doc: Dict[str, Any]) -> None:
+        if not location:
+            return
+        os.makedirs(os.path.dirname(location) or ".", exist_ok=True)
+        with open(location, "w") as fh:
+            json.dump(doc, fh, indent=1, default=str)
+
+    def run(self, run_type: str, params: Optional[OpParams] = None
+            ) -> RunnerResult:
+        params = params or OpParams()
+        if run_type not in RunType.ALL:
+            raise ValueError(
+                f"Unknown run type {run_type!r}; expected one of "
+                f"{RunType.ALL}")
+        t0 = time.time()
+        if run_type == RunType.TRAIN:
+            params.apply_to_workflow(self.workflow)
+            if self.training_reader is not None:
+                self.workflow.set_reader(self.training_reader)
+            model = self.workflow.train()
+            if params.model_location:
+                model.save(params.model_location, overwrite=True)
+            metrics = model.summary()
+            metrics["appSeconds"] = round(time.time() - t0, 3)
+            self._write_metrics(params.metrics_location, metrics)
+            return RunnerResult(run_type, metrics=metrics,
+                                model_location=params.model_location)
+
+        from .workflow import WorkflowModel
+        if params.model_location is None:
+            raise ValueError(f"{run_type} requires modelLocation")
+        model = WorkflowModel.load(params.model_location)
+
+        if run_type == RunType.SCORE:
+            reader = self.scoring_reader
+            data = reader.read_records()
+            scores = model.score(data)
+            if params.write_location:
+                _write_store_csv(scores, params.write_location)
+            metrics = {"rowsScored": scores.n_rows,
+                       "appSeconds": round(time.time() - t0, 3)}
+            self._write_metrics(params.metrics_location, metrics)
+            return RunnerResult(run_type, metrics=metrics, scores=scores)
+
+        if run_type == RunType.EVALUATE:
+            reader = self.evaluation_reader
+            data = reader.read_records()
+            metrics = model.evaluate(data, self.evaluator)
+            metrics = dict(metrics)
+            metrics["appSeconds"] = round(time.time() - t0, 3)
+            self._write_metrics(params.metrics_location, metrics)
+            return RunnerResult(run_type, metrics=metrics)
+
+        # FEATURES: materialize the engineered features only.
+        # features_to_compute may be one Feature or a list; transform's
+        # up_to prunes the DAG for a single target, several targets
+        # compute the full DAG (their union).
+        reader = self.training_reader or self.scoring_reader
+        data = reader.read_records()
+        ftc = self.features_to_compute
+        if isinstance(ftc, (list, tuple)):
+            ftc = ftc[0] if len(ftc) == 1 else None
+        store = model.transform(data, up_to=ftc)
+        if params.write_location:
+            _write_store_csv(store, params.write_location)
+        metrics = {"rows": store.n_rows,
+                   "appSeconds": round(time.time() - t0, 3)}
+        self._write_metrics(params.metrics_location, metrics)
+        return RunnerResult(run_type, metrics=metrics, scores=store)
+
+
+def _write_store_csv(store, path: str) -> None:
+    """Minimal CSV sink for scores/features (saveScores analog)."""
+    import csv
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    names = store.names()
+    with open(path, "w", newline="") as fh:
+        w = csv.writer(fh)
+        w.writerow(names)
+        for i in range(store.n_rows):
+            w.writerow([store[n].get_raw(i) for n in names])
+
+
+class OpApp:
+    """Abstract application entry (OpApp.scala): subclass provides a
+    runner; ``main(argv)`` parses ``--run-type`` + ``--params`` and runs."""
+
+    def runner(self, params: OpParams) -> OpWorkflowRunner:
+        raise NotImplementedError
+
+    def main(self, argv: Optional[Sequence[str]] = None) -> RunnerResult:
+        ap = argparse.ArgumentParser(description=type(self).__name__)
+        ap.add_argument("--run-type", required=True, choices=RunType.ALL)
+        ap.add_argument("--params", help="OpParams json/yaml file")
+        ap.add_argument("--model-location")
+        ap.add_argument("--write-location")
+        ap.add_argument("--metrics-location")
+        args = ap.parse_args(argv)
+        params = (OpParams.from_file(args.params) if args.params
+                  else OpParams())
+        if args.model_location:
+            params.model_location = args.model_location
+        if args.write_location:
+            params.write_location = args.write_location
+        if args.metrics_location:
+            params.metrics_location = args.metrics_location
+        return self.runner(params).run(args.run_type, params)
