@@ -1,3 +1,7 @@
 from .data_readers import (DataReader, CSVReader, CSVAutoReader,  # noqa: F401
+                           ParquetReader, AvroReader,
                            AggregateReader, ConditionalReader, DataReaders,
-                           JoinedDataReader, CutOffTime)
+                           JoinedDataReader, JoinedAggregateDataReader,
+                           TimeBasedFilter, FilteredReader, CutOffTime,
+                           stream_score)
+from .avro import read_avro_records  # noqa: F401

@@ -1,0 +1,179 @@
+"""Avro Object Container File reader — pure Python, dependency-free.
+
+Parity: ``AvroReader`` / ``AvroInOut`` (``readers/.../DataReaders.scala``,
+``utils/.../io/avro/AvroInOut.scala``). The reference reads Avro through
+Spark; here a compact decoder of the Avro 1.x container format (spec:
+magic ``Obj\\x01``, metadata map carrying ``avro.schema``/``avro.codec``,
+sync-marker-delimited blocks of binary-encoded records; null and deflate
+codecs) feeds the host record path. Supports the schema subset AutoML
+data uses: primitives, records, enums, arrays, maps, fixed and unions.
+"""
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Any, Dict, List, Tuple
+
+__all__ = ["read_avro_records", "AvroDecodeError"]
+
+_MAGIC = b"Obj\x01"
+
+
+class AvroDecodeError(ValueError):
+    pass
+
+
+class _Cursor:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def read(self, n: int) -> bytes:
+        b = self.buf[self.pos:self.pos + n]
+        if len(b) != n:
+            raise AvroDecodeError("Truncated avro data")
+        self.pos += n
+        return b
+
+    # -- primitives (Avro binary encoding) --------------------------------
+    def zigzag_long(self) -> int:
+        shift = 0
+        acc = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)
+
+    def double(self) -> float:
+        return struct.unpack("<d", self.read(8))[0]
+
+    def float_(self) -> float:
+        return struct.unpack("<f", self.read(4))[0]
+
+    def bytes_(self) -> bytes:
+        return self.read(self.zigzag_long())
+
+    def string(self) -> str:
+        return self.bytes_().decode("utf-8")
+
+
+def _decode(cur: _Cursor, schema: Any, named: Dict[str, Any]) -> Any:
+    if isinstance(schema, str):
+        s = schema
+        if s == "null":
+            return None
+        if s == "boolean":
+            return cur.read(1) != b"\x00"
+        if s in ("int", "long"):
+            return cur.zigzag_long()
+        if s == "float":
+            return cur.float_()
+        if s == "double":
+            return cur.double()
+        if s == "bytes":
+            return cur.bytes_()
+        if s == "string":
+            return cur.string()
+        if s in named:
+            return _decode(cur, named[s], named)
+        raise AvroDecodeError(f"Unknown schema reference {s!r}")
+    if isinstance(schema, list):                  # union: branch index
+        idx = cur.zigzag_long()
+        if not (0 <= idx < len(schema)):
+            raise AvroDecodeError(f"Bad union branch {idx}")
+        return _decode(cur, schema[idx], named)
+    t = schema["type"]
+    if t == "record":
+        _register(schema, named)
+        return {f["name"]: _decode(cur, f["type"], named)
+                for f in schema["fields"]}
+    if t == "enum":
+        _register(schema, named)
+        return schema["symbols"][cur.zigzag_long()]
+    if t == "fixed":
+        _register(schema, named)
+        return cur.read(schema["size"])
+    if t == "array":
+        out: List[Any] = []
+        while True:
+            n = cur.zigzag_long()
+            if n == 0:
+                break
+            if n < 0:             # block with byte size prefix
+                n = -n
+                cur.zigzag_long()
+            for _ in range(n):
+                out.append(_decode(cur, schema["items"], named))
+        return out
+    if t == "map":
+        m: Dict[str, Any] = {}
+        while True:
+            n = cur.zigzag_long()
+            if n == 0:
+                break
+            if n < 0:
+                n = -n
+                cur.zigzag_long()
+            for _ in range(n):
+                m[cur.string()] = _decode(cur, schema["values"], named)
+        return m
+    return _decode(cur, t, named)     # e.g. {"type": "string"}
+
+
+def _register(schema: Dict[str, Any], named: Dict[str, Any]) -> None:
+    name = schema.get("name")
+    if name:
+        ns = schema.get("namespace")
+        named[name] = schema
+        if ns:
+            named[f"{ns}.{name}"] = schema
+
+
+def read_avro_records(path: str) -> List[Dict[str, Any]]:
+    """Decode every record of an Avro container file into dicts."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if data[:4] != _MAGIC:
+        raise AvroDecodeError(f"{path} is not an Avro container file")
+    cur = _Cursor(data, 4)
+
+    meta: Dict[str, bytes] = {}
+    while True:
+        n = cur.zigzag_long()
+        if n == 0:
+            break
+        if n < 0:
+            n = -n
+            cur.zigzag_long()
+        for _ in range(n):
+            k = cur.string()
+            meta[k] = cur.bytes_()
+    schema = json.loads(meta[b"avro.schema".decode()]
+                        if isinstance(meta.get("avro.schema"), str)
+                        else meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode()
+    sync = cur.read(16)
+
+    named: Dict[str, Any] = {}
+    records: List[Dict[str, Any]] = []
+    while cur.pos < len(data):
+        count = cur.zigzag_long()
+        size = cur.zigzag_long()
+        block = cur.read(size)
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec != "null":
+            raise AvroDecodeError(f"Unsupported avro codec {codec!r}")
+        bcur = _Cursor(block)
+        for _ in range(count):
+            records.append(_decode(bcur, schema, named))
+        if cur.read(16) != sync:
+            raise AvroDecodeError("Sync marker mismatch")
+    return records

@@ -26,9 +26,10 @@ from ..columns import ColumnStore, column_from_values
 from ..features import Feature
 from ..stages.generator import FeatureGeneratorStage
 
-__all__ = ["DataReader", "CSVReader", "CSVAutoReader", "AggregateReader",
-           "ConditionalReader", "JoinedDataReader", "DataReaders",
-           "CutOffTime"]
+__all__ = ["DataReader", "CSVReader", "CSVAutoReader", "ParquetReader",
+           "AvroReader", "AggregateReader", "ConditionalReader",
+           "JoinedDataReader", "JoinedAggregateDataReader", "TimeBasedFilter",
+           "FilteredReader", "DataReaders", "CutOffTime", "stream_score"]
 
 
 @dataclass
@@ -206,6 +207,72 @@ class ConditionalReader(AggregateReader):
         return super().generate_store(raw_features)
 
 
+class ParquetReader(DataReader):
+    """Parquet ingestion via the host Arrow/pandas stack
+    (ParquetProductReader analog). NaN floats from nullable columns map to
+    None."""
+
+    def __init__(self, path: str,
+                 key_fn: Optional[Callable[[Dict], str]] = None):
+        super().__init__(key_fn)
+        self.path = path
+
+    def read_records(self) -> List[Dict[str, Any]]:
+        import pandas as pd
+        df = pd.read_parquet(self.path)
+        records = df.to_dict(orient="records")
+        for rec in records:
+            for k, v in rec.items():
+                if v is None or (isinstance(v, float) and v != v):
+                    rec[k] = None
+        return records
+
+
+class AvroReader(DataReader):
+    """Avro container-file ingestion (AvroReader; pure-Python decoder in
+    readers/avro.py)."""
+
+    def __init__(self, path: str,
+                 key_fn: Optional[Callable[[Dict], str]] = None):
+        super().__init__(key_fn)
+        self.path = path
+
+    def read_records(self) -> List[Dict[str, Any]]:
+        from .avro import read_avro_records
+        return read_avro_records(self.path)
+
+
+@dataclass
+class TimeBasedFilter:
+    """Keep records whose event time falls inside [cutoff - duration,
+    cutoff) (JoinedDataReader.scala TimeBasedFilter)."""
+
+    timestamp_fn: Callable[[Dict], int]
+    cutoff_ms: int
+    duration_ms: Optional[int] = None
+
+    def keep(self, record: Dict[str, Any]) -> bool:
+        ts = self.timestamp_fn(record)
+        if ts >= self.cutoff_ms:
+            return False
+        if self.duration_ms is not None and \
+                ts < self.cutoff_ms - self.duration_ms:
+            return False
+        return True
+
+
+class FilteredReader(DataReader):
+    """Reader wrapper applying a TimeBasedFilter / predicate pre-read."""
+
+    def __init__(self, base: DataReader, keep_fn: Callable[[Dict], bool]):
+        super().__init__(base.key_fn)
+        self.base = base
+        self.keep_fn = keep_fn
+
+    def read_records(self) -> List[Dict[str, Any]]:
+        return [r for r in self.base.read_records() if self.keep_fn(r)]
+
+
 class JoinedDataReader(DataReader):
     """Left-outer/inner join of two readers on their keys
     (JoinedDataReader.scala:54-418)."""
@@ -236,6 +303,34 @@ class JoinedDataReader(DataReader):
         return out
 
 
+class JoinedAggregateDataReader(AggregateReader):
+    """Join first, then time-window aggregate the joined records —
+    ``JoinedAggregateDataReader`` (JoinedDataReader.scala:119-418): the
+    right side's events are windowed against the cutoff after the join, as
+    in the reference's dataprep examples
+    (docs/examples/Conditional-Aggregation.md)."""
+
+    def __init__(self, left: DataReader, right: DataReader,
+                 timestamp_fn: Callable[[Dict], int],
+                 cutoff: CutOffTime = CutOffTime.no_cutoff(),
+                 join_type: str = "left_outer",
+                 time_filter: Optional[TimeBasedFilter] = None):
+        joined: DataReader = JoinedDataReader(left, right, join_type)
+        if time_filter is not None:
+            joined = FilteredReader(joined, time_filter.keep)
+        super().__init__(joined, timestamp_fn, cutoff, left.key_fn)
+
+
+def stream_score(model, batches: Iterable[Sequence[Mapping[str, Any]]],
+                 keep_intermediate: bool = False):
+    """Incremental scoring over record batches (StreamingScore run type /
+    StreamingReaders.scala analog): yields one scored ColumnStore per
+    batch, reusing the fitted DAG — jitted transforms recompile only when
+    a batch size changes shape buckets."""
+    for batch in batches:
+        yield model.score(list(batch), keep_intermediate=keep_intermediate)
+
+
 class DataReaders:
     """Factory (DataReaders.scala:43)."""
 
@@ -252,6 +347,14 @@ class DataReaders:
         def records(records: Sequence[Mapping[str, Any]], key_fn=None
                     ) -> DataReader:
             return _InMemoryReader(records, key_fn)
+
+        @staticmethod
+        def parquet(path: str, key_fn=None) -> "ParquetReader":
+            return ParquetReader(path, key_fn)
+
+        @staticmethod
+        def avro(path: str, key_fn=None) -> "AvroReader":
+            return AvroReader(path, key_fn)
 
     class aggregate:
         @staticmethod
