@@ -78,3 +78,25 @@ def test_loaded_model_summary(tmp_path):
     assert loaded.uid == model.uid
     assert {f.name for f in loaded.result_features} == \
         {f.name for f in model.result_features}
+
+
+def test_golden_model_pins_format(rng):
+    """A serialized model checked into the repo must keep loading and
+    producing identical scores — pins the persistence format across
+    refactors (OpWorkflowModelReaderWriterTest OldModelVersion analog)."""
+    import json
+    import os
+
+    from transmogrifai_tpu.workflow import WorkflowModel
+
+    path = os.path.join(os.path.dirname(__file__), "resources",
+                        "golden_model_v1")
+    expected = json.load(open(os.path.join(path, "expected.json")))
+    model = WorkflowModel.load(path)
+    scored = model.score(expected["rows"])
+    pcol = scored[expected["pred_name"]]
+    np.testing.assert_allclose(
+        np.asarray(pcol.prediction), expected["expected_pred"])
+    np.testing.assert_allclose(
+        np.asarray(pcol.probability[:, 1]), expected["expected_prob1"],
+        rtol=1e-6)

@@ -6,3 +6,4 @@ Parity targets: ``core/.../ModelInsights.scala`` and
 from .loco import RecordInsightsLOCO, parse_insights  # noqa: F401
 from .model_insights import (DerivedFeatureInsight, FeatureInsights,  # noqa: F401
                              LabelSummary, ModelInsights)
+from .corr import RecordInsightsCorr, RecordInsightsCorrModel  # noqa: F401
