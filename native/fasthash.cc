@@ -1,0 +1,90 @@
+// Native host-side kernels for transmogrifai_tpu.
+//
+// The TPU owns the model math; the host's hot loops are string work —
+// hashing-trick token hashing above all (ops/hashing.py). The pure-Python
+// murmur3 fallback is ~1µs/token; this batch kernel hashes a whole token
+// column per call through one ctypes crossing.
+//
+// Build: `make -C native` (or the lazy auto-build in ops/hashing.py).
+// ABI: plain C functions, numpy arrays passed as raw pointers.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// MurmurHash3 x86 32-bit (public domain algorithm, Austin Appleby) —
+// bit-exact with ops/hashing.py murmur3_32 and the reference's
+// scala.util.hashing.MurmurHash3 usage.
+inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+uint32_t murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  const uint32_t c1 = 0xcc9e2d51u;
+  const uint32_t c2 = 0x1b873593u;
+  uint32_t h = seed;
+  const int64_t nblocks = len / 4;
+
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k;
+    std::memcpy(&k, data + 4 * i, 4);  // little-endian hosts only
+    k *= c1;
+    k = rotl32(k, 15);
+    k *= c2;
+    h ^= k;
+    h = rotl32(h, 13);
+    h = h * 5 + 0xe6546b64u;
+  }
+
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k = 0;
+  switch (len & 3) {
+    case 3: k ^= static_cast<uint32_t>(tail[2]) << 16; [[fallthrough]];
+    case 2: k ^= static_cast<uint32_t>(tail[1]) << 8;  [[fallthrough]];
+    case 1:
+      k ^= tail[0];
+      k *= c1;
+      k = rotl32(k, 15);
+      k *= c2;
+      h ^= k;
+  }
+
+  h ^= static_cast<uint32_t>(len);
+  h ^= h >> 16;
+  h *= 0x85ebca6bu;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35u;
+  h ^= h >> 16;
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Hash n strings packed into one blob: string i spans
+// blob[offsets[i]..offsets[i+1]). Writes n uint32 hashes into out.
+void murmur3_batch(const char* blob, const int64_t* offsets, int64_t n,
+                   uint32_t seed, uint32_t* out) {
+  const uint8_t* base = reinterpret_cast<const uint8_t*>(blob);
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = murmur3_32(base + offsets[i], offsets[i + 1] - offsets[i],
+                        seed);
+  }
+}
+
+// Hash n strings and fold each into a bucket in [0, num_features),
+// fusing the modulo into the same pass (saves one numpy round trip).
+void murmur3_bucket_batch(const char* blob, const int64_t* offsets,
+                          int64_t n, uint32_t seed, uint32_t num_features,
+                          int64_t* out) {
+  const uint8_t* base = reinterpret_cast<const uint8_t*>(blob);
+  for (int64_t i = 0; i < n; i++) {
+    uint32_t h = murmur3_32(base + offsets[i], offsets[i + 1] - offsets[i],
+                            seed);
+    out[i] = static_cast<int64_t>(h % num_features);
+  }
+}
+
+}  // extern "C"

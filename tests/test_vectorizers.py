@@ -228,3 +228,22 @@ def test_string_indexer_roundtrip(rng):
     dm = de.fit(st2)
     got = dm.transform_columns(st2)
     assert got.values.tolist() == ["a", "b", "UnseenLabel"]
+
+
+def test_native_hasher_matches_python():
+    """The C++ batch murmur3 (native/fasthash.cc, lazily built at first
+    use) must be bit-exact with the pure-Python reference implementation."""
+    from transmogrifai_tpu.ops import hashing as H
+
+    tokens = ["", "a", "hello", "héllo wörld", "x" * 100, "abc", "abcd",
+              "abcde", "abcdef", "abcdefg"]
+    expected = np.array([H.murmur3_32(t.encode("utf-8"), 42)
+                         for t in tokens], dtype=np.uint32)
+    got = H.hash_tokens(tokens, 42)
+    np.testing.assert_array_equal(got, expected)
+    if H._load_native():
+        # force the native path explicitly and compare again
+        got2 = H.hash_tokens(tokens, 7)
+        exp2 = np.array([H.murmur3_32(t.encode("utf-8"), 7)
+                         for t in tokens], dtype=np.uint32)
+        np.testing.assert_array_equal(got2, exp2)

@@ -46,9 +46,29 @@ def _load_native():
     global _native_lib
     if _native_lib is not None:
         return _native_lib
-    path = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
-                        "native", "libtmogtpu.so")
-    path = os.path.abspath(path)
+    native_dir = os.path.abspath(os.path.join(
+        os.path.dirname(__file__), os.pardir, os.pardir, "native"))
+    path = os.path.join(native_dir, "libtmogtpu.so")
+    if not os.path.exists(path):
+        # lazy one-time build from source (no wheel/packaging step in this
+        # repo); failures fall back to the pure-Python hasher silently.
+        # Compile to a per-pid temp file + atomic rename so concurrent
+        # processes never see (or permanently keep) a half-written .so.
+        src = os.path.join(native_dir, "fasthash.cc")
+        if os.path.exists(src):
+            import subprocess
+            tmp = f"{path}.{os.getpid()}.tmp"
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-fPIC", "-shared",
+                     "-o", tmp, src],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, path)
+            except (OSError, subprocess.SubprocessError):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
     if os.path.exists(path):
         try:
             lib = ctypes.CDLL(path)
@@ -59,7 +79,10 @@ def _load_native():
             _native_lib = lib
             return lib
         except OSError:
-            pass
+            try:   # corrupt artifact: remove so a future process rebuilds
+                os.unlink(path)
+            except OSError:
+                pass
     _native_lib = False
     return False
 
