@@ -405,6 +405,8 @@ EXEMPT = {
     "MapTransformer": "lambda-carrying; covered in test_workflow_io",
     "SanityChecker": "label-aware column selection; test_sanity_checker",
     "SanityCheckerModel": "fitted product of SanityChecker",
+    "RecordInsightsCorr": "needs a PredictionColumn input; test_insights",
+    "RecordInsightsCorrModel": "fitted product of RecordInsightsCorr",
 }
 
 #: fitted-model classes produced by a covered estimator (contract reaches
