@@ -346,6 +346,34 @@ def _cases():
         return stage, feats, store
     cases["OpCountVectorizer"] = countvec_case
 
+    from transmogrifai_tpu.ops.text_suite import NameEntityRecognizer
+    from transmogrifai_tpu.ops.topics import OpLDA, OpWord2Vec
+
+    def ner_case():
+        stage = NameEntityRecognizer()
+        feats = [_f("a", ft.Text)]
+        vals = ["Alice Smith went to Paris", "the dog barked", None,
+                "Bob Jones"] * (N // 4)
+        store = ColumnStore({"a": column_from_values(ft.Text, vals)})
+        return stage, feats, store
+    cases["NameEntityRecognizer"] = ner_case
+
+    def lda_case():
+        stage = OpLDA(n_topics=2, n_iter=15)
+        feats = [_f("a", ft.TextList)]
+        store = ColumnStore({"a": RandomData.text_lists(max_len=6)
+                             .column(ft.TextList, N)})
+        return stage, feats, store
+    cases["OpLDA"] = lda_case
+
+    def w2v_case():
+        stage = OpWord2Vec(dim=8, epochs=10, min_count=1)
+        feats = [_f("a", ft.TextList)]
+        store = ColumnStore({"a": RandomData.text_lists(max_len=6)
+                             .column(ft.TextList, N)})
+        return stage, feats, store
+    cases["OpWord2Vec"] = w2v_case
+
     # indexers --------------------------------------------------------------
     def indexer_case():
         stage = OpStringIndexerNoFilter()
@@ -420,6 +448,7 @@ _PRODUCED = {
     "StandardScalerModel", "LogisticRegressionModel", "LinearRegressionModel",
     "NaiveBayesModel", "LinearSVCModel", "MLPModel", "TreeEnsembleModel",
     "OpStringIndexerModel", "CountVectorizerModel", "GLMRegressionModel",
+    "LDAModel", "Word2VecModel",
 }
 
 

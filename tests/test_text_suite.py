@@ -135,3 +135,65 @@ def test_dsl_text_methods(rng):
     out = model.transform(store)
     assert out[dom.name].values.tolist() == ["y.com", "w.org"]
     assert np.asarray(out[counted.name].values).sum() == 6.0
+
+
+def test_ner_heuristic():
+    from transmogrifai_tpu.ops.text_suite import NameEntityRecognizer
+    store = ColumnStore({"t": column_from_values(ft.Text, [
+        "Yesterday John Smith met Maria Garcia in New York.",
+        "the quick brown fox", None])})
+    f = FeatureBuilder.Text("t").from_column().as_predictor()
+    ner = NameEntityRecognizer()
+    ner.set_input(f)
+    out = ner.transform_columns(store)
+    ents = out.values[0]
+    assert "John Smith" in ents and "Maria Garcia" in ents
+    assert "New York" in ents
+    assert out.values[1] == set() and out.values[2] == set()
+
+
+def test_lda_topics(rng):
+    """OpLDA separates two disjoint-vocabulary topics."""
+    from transmogrifai_tpu.ops.topics import OpLDA
+    sports = "game team score win player coach ball".split()
+    cooking = "recipe oven flour sugar bake taste salt".split()
+    docs = []
+    for i in range(60):
+        pool = sports if i % 2 == 0 else cooking
+        docs.append([str(rng.choice(pool)) for _ in range(12)])
+    store = ColumnStore({"t": column_from_values(ft.TextList, docs)})
+    f = FeatureBuilder.TextList("t").from_column().as_predictor()
+    est = OpLDA(n_topics=2, n_iter=80, seed=1)
+    est.set_input(f)
+    model = est.fit(store)
+    theta = np.asarray(model.transform(store)[model.output_name].values)
+    assert theta.shape == (60, 2)
+    np.testing.assert_allclose(theta.sum(axis=1), 1.0, rtol=1e-5)
+    # docs of the same class land on the same dominant topic
+    dom = theta.argmax(axis=1)
+    sports_dom = dom[::2]
+    cooking_dom = dom[1::2]
+    assert (sports_dom == sports_dom[0]).mean() > 0.9
+    assert (cooking_dom == cooking_dom[0]).mean() > 0.9
+    assert sports_dom[0] != cooking_dom[0]
+
+
+def test_word2vec_embeddings(rng):
+    """OpWord2Vec puts co-occurring tokens closer than unrelated ones."""
+    from transmogrifai_tpu.ops.topics import OpWord2Vec
+    docs = []
+    for _ in range(200):
+        docs.append(["king", "queen", "royal"])
+        docs.append(["apple", "banana", "fruit"])
+    store = ColumnStore({"t": column_from_values(ft.TextList, docs)})
+    f = FeatureBuilder.TextList("t").from_column().as_predictor()
+    est = OpWord2Vec(dim=16, epochs=100, lr=0.5, window=2, seed=0, min_count=1)
+    est.set_input(f)
+    model = est.fit(store)
+    vec = {t: model.vectors[i] for i, t in enumerate(model.vocab)}
+
+    def cos(a, b):
+        return float(np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos(vec["king"], vec["queen"]) > cos(vec["king"], vec["banana"])
+    out = model.transform(store)
+    assert np.asarray(out[model.output_name].values).shape == (400, 16)

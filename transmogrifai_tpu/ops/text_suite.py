@@ -28,13 +28,15 @@ from ..columns import (Column, ColumnStore, NumericColumn, TextColumn,
                        TextListColumn, VectorColumn)
 from ..stages.base import (Estimator, FittedModel, FixedArity, InputSpec,
                            Transformer, register_stage)
-from ..types.feature_types import (Base64, Binary, Email, OPVector, Phone,
-                                   Real, Text, TextList, URL)
+from ..types.feature_types import (Base64, Binary, Email, MultiPickList,
+                                   OPVector, Phone, Real, Text, TextList,
+                                   URL)
 from ..vector_metadata import VectorColumnMetadata, VectorMetadata
 from .vectorizer_base import VectorizerEstimator, VectorizerModel
 
 __all__ = [
     "OpCountVectorizer", "CountVectorizerModel", "NGramSimilarity",
+    "NameEntityRecognizer",
     "EmailParser", "PhoneNumberParser", "UrlParser", "MimeTypeDetector",
     "parse_email", "parse_phone", "parse_url", "detect_mime",
 ]
@@ -358,6 +360,76 @@ class MimeTypeDetector(_UnaryTextTransformer):
 
     def _parse_one(self, value):
         return detect_mime(value)
+
+
+#: sentence-leading words that look capitalized but are not names
+_NER_STOP = frozenset("""the a an this that these those he she it they we i
+    you my his her its their our your mr mrs ms dr monday tuesday wednesday
+    thursday friday saturday sunday january february march april may june
+    july august september october november december""".split())
+
+_SENT_SPLIT = re.compile(r"[.!?]\s+")
+_CAP_TOKEN = re.compile(r"^[A-Z][a-zA-Z'’-]*$")
+
+
+@register_stage
+class NameEntityRecognizer(Transformer):
+    """Text → MultiPickList of detected proper-noun spans.
+
+    The reference tags tokens with OpenNLP's pretrained NER models
+    (``NameEntityRecognizer.scala:1``, binaries under ``models/``).
+    Shipping those binaries isn't possible here, so this is a documented
+    table-driven heuristic with the same stage interface: runs of
+    capitalized tokens (ignoring sentence-initial position and a stopword
+    table) become entity spans. Swap in a real tagger by overriding
+    ``tag_sentence``.
+    """
+
+    operation_name = "ner"
+    output_type = MultiPickList
+
+    def __init__(self, min_span_tokens: int = 1, uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.min_span_tokens = min_span_tokens
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(Text)
+
+    def tag_sentence(self, tokens: List[str]) -> List[str]:
+        """→ entity spans found in one sentence's tokens. The sentence's
+        first token is always skipped: sentence-initial capitalization is
+        ambiguous, so a leading name loses its first word (documented
+        heuristic limitation)."""
+        spans: List[str] = []
+        run: List[str] = []
+        for i, tok in enumerate(tokens):
+            word = tok.strip(",;:()\"'.!?")
+            is_cap = bool(_CAP_TOKEN.match(word)) and \
+                word.lower() not in _NER_STOP
+            if is_cap and i > 0:
+                run.append(word)
+            else:
+                if len(run) >= self.min_span_tokens:
+                    spans.append(" ".join(run))
+                run = []
+        if len(run) >= self.min_span_tokens:
+            spans.append(" ".join(run))
+        return spans
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        from ..columns import TextSetColumn
+        col = store[self.input_features[0].name]
+        out = []
+        for v in col.values:
+            if not v:
+                out.append(set())
+                continue
+            ents: set = set()
+            for sent in _SENT_SPLIT.split(v):
+                ents.update(self.tag_sentence(sent.split()))
+            out.append(ents)
+        return TextSetColumn(MultiPickList, out)
 
 
 @register_stage
