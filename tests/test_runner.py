@@ -147,3 +147,15 @@ def test_op_app_cli(rng, tmp_path):
                     "--metrics-location", str(tmp_path / "met.json")])
     assert out.run_type == "Train"
     assert os.path.exists(str(tmp_path / "met.json"))
+
+
+def test_summary_pretty_renders_stage_table(rng):
+    records = _records(rng, 80)
+    wf, label, pred, _sel = _flow()
+    model = wf.set_input_records(records).train()
+    text = model.summary_pretty()
+    assert "Stage metrics" in text and "fit s" in text
+    from transmogrifai_tpu.utils.table import Table
+    t = Table(["a", "b"], [[1, 2.5], ["x", None]], name="T")
+    s = t.render()
+    assert "| a" in s and "2.5" in s

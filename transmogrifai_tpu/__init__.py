@@ -8,7 +8,7 @@ computations over sharded device arrays, and the model-selection grid
 ``vmap``s/``shard_map``s across the TPU mesh.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from . import types  # noqa: F401
 from .columns import Column, ColumnStore, column_from_values  # noqa: F401

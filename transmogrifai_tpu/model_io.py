@@ -130,8 +130,10 @@ def save_workflow_model(model, path: str, overwrite: bool = False) -> None:
         fitted = model.fitted_stages.get(st.uid, st)
         stage_records.append(_stage_record(fitted, arrays))
 
+    from .utils.version import version_info
     doc = {
         "formatVersion": FORMAT_VERSION,
+        "versionInfo": version_info(),
         "uid": model.uid,
         "resultFeatureUids": [f.uid for f in model.result_features],
         "blacklistedFeatureUids": [f.uid for f in model.blacklisted_features],

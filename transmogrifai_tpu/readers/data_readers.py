@@ -140,7 +140,16 @@ class AggregateReader(DataReader):
         for f in raw_features:
             gen = f.origin_stage
             assert isinstance(gen, FeatureGeneratorStage)
+            # no explicit aggregator → the feature type's default monoid
+            # (MonoidAggregatorDefaults.aggregatorOf, applied by the
+            # reference's FeatureAggregator the same way)
             agg = gen.aggregator
+            if agg is None:
+                from ..utils.aggregators import aggregator_of
+                try:
+                    agg = aggregator_of(f.ftype)
+                except ValueError:
+                    agg = None
             values = []
             for k in keys:
                 recs = groups[k]

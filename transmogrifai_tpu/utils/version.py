@@ -1,0 +1,41 @@
+"""Build/version stamping (utils/.../version/VersionInfo.scala analog).
+
+The reference stamps gradle build properties; here the framework version
+plus the git commit of the working tree (when available) identify what
+produced a saved model — recorded into model.json by model_io.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Dict, Optional
+
+__all__ = ["version_info"]
+
+_cache: Optional[Dict[str, str]] = None
+
+
+def _git_commit() -> Optional[str]:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5)
+        return out.stdout.strip() or None if out.returncode == 0 else None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def version_info() -> Dict[str, str]:
+    global _cache
+    if _cache is None:
+        import jax
+
+        from .. import __version__
+        _cache = {"version": __version__,
+                  "jax": jax.__version__}
+        commit = _git_commit()
+        if commit:
+            _cache["gitCommit"] = commit
+    return dict(_cache)

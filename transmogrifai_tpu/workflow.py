@@ -576,4 +576,19 @@ class WorkflowModel:
         return out
 
     def summary_pretty(self) -> str:
-        return json.dumps(self.summary(), indent=2, default=str)
+        """Human-readable summary: per-stage timing table
+        (OpSparkListener / Table.scala pretty rendering) + stage JSON."""
+        from .utils.table import Table
+        parts = []
+        if self.stage_metrics:
+            rows = [[m.get("stageName", uid), uid,
+                     m.get("fitSeconds"), m.get("layerTransformSeconds"),
+                     "yes" if m.get("warmStarted") else ""]
+                    for uid, m in sorted(self.stage_metrics.items())]
+            parts.append(Table(
+                ["stage", "uid", "fit s", "layer transform s", "warm"],
+                rows, name="Stage metrics").render())
+        doc = self.summary()
+        doc.pop("stageMetrics", None)
+        parts.append(json.dumps(doc, indent=2, default=str))
+        return "\n\n".join(parts)
