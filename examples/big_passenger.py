@@ -81,6 +81,7 @@ def run(n_rows: int = 30_000, num_folds: int = 3, families=None,
     if mesh is None and len(jax.devices()) > 1:
         from transmogrifai_tpu.parallel.mesh import make_mesh
         mesh = make_mesh()
+    mesh = mesh or None   # mesh=False forces single-device
     survived, checked = build_features()
     if families is None:
         families = [LogisticRegressionFamily()]

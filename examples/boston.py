@@ -52,6 +52,7 @@ def run(data_path: str = DEFAULT_DATA, num_folds: int = 3, families=None,
     if mesh is None and len(jax.devices()) > 1:
         from transmogrifai_tpu.parallel.mesh import make_mesh
         mesh = make_mesh()
+    mesh = mesh or None   # mesh=False forces single-device
     medv, features = build_features()
     if families is None:
         families = [RandomForestFamily(task="regression"),

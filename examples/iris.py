@@ -49,6 +49,7 @@ def run(csv_path: str = DEFAULT_CSV, num_folds: int = 3, families=None,
     if mesh is None and len(jax.devices()) > 1:
         from transmogrifai_tpu.parallel.mesh import make_mesh
         mesh = make_mesh()
+    mesh = mesh or None   # mesh=False forces single-device
     iris_class, labels, features = build_features()
 
     selector = MultiClassificationModelSelector.with_cross_validation(

@@ -53,6 +53,7 @@ def run(n_rows: int = 2_000_000, n_features: int = 20, num_folds: int = 5,
     if mesh is None and len(jax.devices()) > 1:
         from transmogrifai_tpu.parallel.mesh import make_mesh
         mesh = make_mesh()
+    mesh = mesh or None   # mesh=False forces single-device
     if families is None:
         # the BASELINE config's three tree families; reduced grid so the
         # sweep is (3 + 3 + 2) × num_folds ensemble fits

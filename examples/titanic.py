@@ -79,6 +79,7 @@ def run(csv_path: str = DEFAULT_CSV, num_folds: int = 3, families=None,
         # just tests)
         from transmogrifai_tpu.parallel.mesh import make_mesh
         mesh = make_mesh()
+    mesh = mesh or None   # mesh=False forces single-device
     survived, checked = build_features(with_sanity_check)
 
     selector = BinaryClassificationModelSelector.with_cross_validation(

