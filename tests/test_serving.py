@@ -1,0 +1,63 @@
+"""StableHLO export serving tests (OpWorkflowModelLocal / MLeap analog)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, Workflow, column_from_values
+from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+from transmogrifai_tpu.models.selector import BinaryClassificationModelSelector
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.serving import export_prediction_fn, load_prediction_fn
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def _fitted(rng, families=None, n=200):
+    y = rng.integers(0, 2, n).astype(float)
+    x1 = rng.normal(size=n) + y
+    x2 = rng.normal(size=n)
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "x1": column_from_values(ft.Real, list(x1)),
+        "x2": column_from_values(ft.Real, list(x2)),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x1").from_column().as_predictor()
+    f2 = FeatureBuilder.Real("x2").from_column().as_predictor()
+    vec = transmogrify([f1, f2])
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=families or [LogisticRegressionFamily()],
+        splitter=None, seed=9)
+    pred = label.transform_with(selector, vec)
+    model = Workflow().set_input_store(store).set_result_features(pred).train()
+    return model, store, pred
+
+
+def test_export_roundtrip_matches_predict(rng, tmp_path):
+    model, store, pred = _fitted(rng)
+    meta = export_prediction_fn(model, str(tmp_path))
+    d = meta["featureDim"]
+
+    fn = load_prediction_fn(str(tmp_path))
+    # batch-polymorphic: different request sizes, one artifact
+    for n in (1, 7, 33):
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        out = fn(X)
+        assert out["prediction"].shape == (n,)
+        assert out["probability"].shape[0] == n
+        direct = model.stage_of(pred).predict_arrays(X.astype(np.float64))
+        np.testing.assert_allclose(out["prediction"], direct[0], rtol=1e-4)
+        np.testing.assert_allclose(out["probability"], direct[2],
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_export_tree_model(rng, tmp_path):
+    from transmogrifai_tpu.models.trees import GBTFamily
+    model, store, pred = _fitted(
+        rng, families=[GBTFamily(grid=[
+            {"maxDepth": 3, "minInstancesPerNode": 10,
+             "minInfoGain": 0.001}])])
+    meta = export_prediction_fn(model, str(tmp_path))
+    fn = load_prediction_fn(str(tmp_path))
+    X = rng.normal(size=(11, meta["featureDim"])).astype(np.float32)
+    out = fn(X)
+    direct = model.stage_of(pred).predict_arrays(X.astype(np.float64))
+    np.testing.assert_allclose(out["prediction"], direct[0], rtol=1e-4)

@@ -440,7 +440,10 @@ def predict_ensemble(feat, thr, leaf, tree_w, X, max_depth: int,
     tile-pads K→128 on TPU, so c is capped at ~1GB of padded transient."""
     T = feat.shape[0]
     n = X.shape[0]
-    byte_cap = max(1, int(1e9 // (max(n, 1) * 128 * 4)))
+    if isinstance(n, int):
+        byte_cap = max(1, int(1e9 // (max(n, 1) * 128 * 4)))
+    else:   # symbolic batch dim (jax.export serving artifact): no shrink
+        byte_cap = tree_chunk
     c = max(1, min(tree_chunk, T, byte_cap))
     pad = (-T) % c
     if pad:

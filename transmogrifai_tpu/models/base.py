@@ -29,6 +29,17 @@ __all__ = ["PredictorEstimator", "PredictorModel", "ModelFamily",
            "extract_xy"]
 
 
+def pull_f64(out) -> Tuple[np.ndarray, ...]:
+    """ONE batched device→host transfer of a prediction tuple, then f64.
+
+    Per-array ``np.asarray`` pulls each pay the device link's full
+    round-trip (~200ms on a network-tunnelled TPU); ``jax.device_get`` of
+    the whole pytree ships everything in a single fetch."""
+    import jax
+    return tuple(np.asarray(o, dtype=np.float64)
+                 for o in jax.device_get(out))
+
+
 def extract_xy(store: ColumnStore, label_name: str, features_name: str
                ) -> Tuple[np.ndarray, np.ndarray]:
     ycol = store[label_name]
@@ -51,10 +62,21 @@ class PredictorModel(FittedModel, AllowLabelAsInput):
     def input_spec(self) -> InputSpec:
         return FixedArity(RealNN, OPVector)
 
+    def predict_device(self, Xd):
+        """Device-side (prediction, raw, prob) triple as jax arrays — the
+        contract the serving export (serving.py) and SelectedModel's
+        device path build on. Implement this in subclasses whose math is
+        pure jax; predict_arrays then comes for free."""
+        raise NotImplementedError(
+            f"{type(self).__name__} implements neither predict_device nor "
+            "predict_arrays")
+
     def predict_arrays(self, X: np.ndarray
                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """(prediction [n], raw [n,k], prob [n,k])."""
-        raise NotImplementedError
+        """(prediction [n], raw [n,k], prob [n,k]) as host float64 — ONE
+        batched device pull around predict_device by default."""
+        import jax.numpy as jnp
+        return pull_f64(self.predict_device(jnp.asarray(X)))
 
     def transform_columns(self, store: ColumnStore) -> Column:
         xcol = store[self.input_features[1].name]

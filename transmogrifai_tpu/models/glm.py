@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..stages.base import register_stage
-from .base import ModelFamily, PredictorEstimator, PredictorModel, extract_xy
+from .base import ModelFamily, PredictorEstimator, PredictorModel, extract_xy, pull_f64
 
 __all__ = ["OpGeneralizedLinearRegression", "GLMRegressionModel",
            "GLMRegressionFamily", "FAMILY_IDS"]
@@ -109,11 +109,12 @@ class GLMRegressionModel(PredictorModel):
         self.intercept = float(intercept) if intercept is not None else 0.0
         self.family = family
 
+    def predict_device(self, X):
+        return predict_glm(jnp.asarray(self.coefficients), self.intercept,
+                           X, jnp.asarray(FAMILY_IDS[self.family]))
+
     def predict_arrays(self, X):
-        out = predict_glm(jnp.asarray(self.coefficients), self.intercept,
-                          jnp.asarray(X),
-                          jnp.asarray(FAMILY_IDS[self.family]))
-        return tuple(np.asarray(o, dtype=np.float64) for o in out)
+        return pull_f64(self.predict_device(jnp.asarray(X)))
 
     def get_model_state(self):
         return {"coefficients": self.coefficients,

@@ -17,7 +17,7 @@ import numpy as np
 from ..stages.base import register_stage
 from . import _jaxfit as JF
 from .base import (ModelFamily, PredictorEstimator, PredictorModel,
-                   extract_xy)
+                   extract_xy, pull_f64)
 
 __all__ = [
     "OpLogisticRegression", "LogisticRegressionModel", "LogisticRegressionFamily",
@@ -45,16 +45,17 @@ class LogisticRegressionModel(PredictorModel):
         self.intercept = _f(intercept) if intercept is not None else None
         self.n_classes = int(n_classes)
 
-    def predict_arrays(self, X):
+    def predict_device(self, X):
+        """Device-side Prediction triple (pure jax; export/serving path)."""
         if self.n_classes == 2 and self.coefficients.ndim == 1:
-            pred, raw, prob = JF.predict_binary_logistic(
+            return JF.predict_binary_logistic(
                 jnp.asarray(self.coefficients), jnp.asarray(self.intercept),
-                jnp.asarray(X))
-        else:
-            pred, raw, prob = JF.predict_multinomial_logistic(
-                jnp.asarray(self.coefficients), jnp.asarray(self.intercept),
-                jnp.asarray(X))
-        return _f(pred), _f(raw), _f(prob)
+                X)
+        return JF.predict_multinomial_logistic(
+            jnp.asarray(self.coefficients), jnp.asarray(self.intercept), X)
+
+    def predict_arrays(self, X):
+        return pull_f64(self.predict_device(jnp.asarray(X)))
 
     def get_model_state(self):
         return {"coefficients": self.coefficients, "intercept": self.intercept,
@@ -154,10 +155,12 @@ class LinearRegressionModel(PredictorModel):
         self.coefficients = _f(coefficients) if coefficients is not None else None
         self.intercept = float(intercept) if intercept is not None else 0.0
 
+    def predict_device(self, X):
+        return JF.predict_linear(
+            jnp.asarray(self.coefficients), self.intercept, X)
+
     def predict_arrays(self, X):
-        pred, raw, prob = JF.predict_linear(
-            jnp.asarray(self.coefficients), self.intercept, jnp.asarray(X))
-        return _f(pred), _f(raw), _f(prob)
+        return pull_f64(self.predict_device(jnp.asarray(X)))
 
     def get_model_state(self):
         return {"coefficients": self.coefficients, "intercept": self.intercept}
@@ -232,11 +235,12 @@ class NaiveBayesModel(PredictorModel):
         self.log_likelihood = (_f(log_likelihood)
                                if log_likelihood is not None else None)
 
+    def predict_device(self, X):
+        return JF.predict_naive_bayes(
+            jnp.asarray(self.log_prior), jnp.asarray(self.log_likelihood), X)
+
     def predict_arrays(self, X):
-        pred, raw, prob = JF.predict_naive_bayes(
-            jnp.asarray(self.log_prior), jnp.asarray(self.log_likelihood),
-            jnp.asarray(X))
-        return _f(pred), _f(raw), _f(prob)
+        return pull_f64(self.predict_device(jnp.asarray(X)))
 
     def get_model_state(self):
         return {"log_prior": self.log_prior,

@@ -83,7 +83,20 @@ class SelectedModel(PredictorModel):
         self.label_mapping = list(label_mapping) if label_mapping else None
         self.selector_summary: Optional[ModelSelectorSummary] = None
 
+    def predict_device(self, Xd):
+        """Device-side Prediction triple incl. label de-mapping (pure jax;
+        export/serving path — label values round through the device dtype,
+        f32 when x64 is off)."""
+        pred, raw, prob = self.inner.predict_device(Xd)
+        if self.label_mapping is not None:
+            lm = jnp.asarray(self.label_mapping)
+            pred = lm[jnp.clip(pred.astype(jnp.int32), 0, len(lm) - 1)]
+        return pred, raw, prob
+
     def predict_arrays(self, X):
+        # host path: de-map in exact float64 (arbitrary original label
+        # values survive), and tolerate inner models that only implement
+        # predict_arrays
         pred, raw, prob = self.inner.predict_arrays(X)
         if self.label_mapping is not None:
             lm = np.asarray(self.label_mapping, dtype=np.float64)
@@ -243,13 +256,17 @@ class ModelSelector(PredictorEstimator):
         grid = single.stack_grid()
         params = jax.jit(lambda X, y, w: single.fit_batch(X, y, w, grid))(
             jnp.asarray(Xk), jnp.asarray(yk), jnp.asarray(w))
+        pred_d, _raw_d, prob_d = single.predict_batch(params,
+                                                      jnp.asarray(Xk))
+        # ONE batched pull for fitted params + train predictions (per-array
+        # pulls each pay the device link's round-trip latency)
+        params, pred, prob = jax.device_get((params, pred_d, prob_d))
         inner = single.realize(_index_pytree(params, 0), best_hparams)
 
         # train evaluation over the rows the model was actually trained on
         # (DataCutter-dropped labels are out of scope for the model)
-        pred, _raw, prob = map(np.asarray,
-                               single.predict_batch(params, jnp.asarray(Xk)))
-        train_eval = _task_metrics(self.task, yk, pred[0], prob[0])
+        train_eval = _task_metrics(self.task, yk, np.asarray(pred)[0],
+                                   np.asarray(prob)[0])
 
         mapping = (self.splitter.original_labels() if self.splitter
                    else None)

@@ -25,7 +25,7 @@ import numpy as np
 from ..stages.base import register_stage
 from ._jaxfit import _fista, _power_iter_sq_norm, standardize_stats
 from .base import (ModelFamily, PredictorEstimator, PredictorModel,
-                   extract_xy)
+                   extract_xy, pull_f64)
 
 __all__ = ["OpLinearSVC", "LinearSVCModel", "LinearSVCFamily",
            "OpMultilayerPerceptronClassifier", "MLPModel", "MLPFamily"]
@@ -83,10 +83,12 @@ class LinearSVCModel(PredictorModel):
                              if coefficients is not None else None)
         self.intercept = float(intercept) if intercept is not None else 0.0
 
+    def predict_device(self, X):
+        return predict_linear_svc(jnp.asarray(self.coefficients),
+                                  self.intercept, X)
+
     def predict_arrays(self, X):
-        out = predict_linear_svc(jnp.asarray(self.coefficients),
-                                 self.intercept, jnp.asarray(X))
-        return tuple(_f(o) for o in out)
+        return pull_f64(self.predict_device(jnp.asarray(X)))
 
     def get_model_state(self):
         return {"coefficients": self.coefficients,
@@ -218,10 +220,12 @@ class MLPModel(PredictorModel):
         self.layers = list(layers or [])
         self.weights: List[Tuple[np.ndarray, np.ndarray]] = []
 
-    def predict_arrays(self, X):
+    def predict_device(self, X):
         params = [(jnp.asarray(W), jnp.asarray(b)) for W, b in self.weights]
-        out = predict_mlp(params, jnp.asarray(X))
-        return tuple(_f(o) for o in out)
+        return predict_mlp(params, X)
+
+    def predict_arrays(self, X):
+        return pull_f64(self.predict_device(jnp.asarray(X)))
 
     def get_model_state(self):
         state: Dict[str, Any] = {"layers": np.asarray(self.layers)}

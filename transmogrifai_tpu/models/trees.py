@@ -76,9 +76,9 @@ class TreeEnsembleModel(PredictorModel):
         self.max_depth = int(max_depth)
         self.trees: Dict[str, np.ndarray] = {}
 
-    def predict_arrays(self, X):
+    def predict_device(self, Xd):
+        """Device-side Prediction triple (pure jax; export/serving path)."""
         p = {k: jnp.asarray(v) for k, v in self.trees.items()}
-        Xd = jnp.asarray(X)
         if self.kind == "rf_classification":
             out = TF.predict_rf_classification(p, Xd, self.max_depth,
                                                self.n_classes)
@@ -92,7 +92,11 @@ class TreeEnsembleModel(PredictorModel):
                                                    margin_scale=1.0)
         else:   # gbt_regression / xgb_regression
             out = TF.predict_margin_regression(p, Xd, self.max_depth)
-        return tuple(np.asarray(o, dtype=np.float64) for o in out)
+        return out
+
+    def predict_arrays(self, X):
+        from .base import pull_f64
+        return pull_f64(self.predict_device(jnp.asarray(X)))
 
     def get_model_state(self):
         state = {f"tree_{k}": np.asarray(v) for k, v in self.trees.items()}

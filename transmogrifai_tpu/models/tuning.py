@@ -463,13 +463,19 @@ class _ValidatorBase:
                             next(iter(_FUSED_EXE_CACHE)))   # FIFO evict
                     _FUSED_EXE_CACHE[key] = exe
 
+        # dispatch every fused family program FIRST (async — the device
+        # queues them back-to-back), then ONE batched metrics pull: per-
+        # family synchronous pulls would pay a full link round-trip each
+        # AND serialize device execution against host latency
+        fused_out = {fi: fused[fi](Xd, yd, wd, vwd, stacked_devs[fi])
+                     for fi in fused}
+        fused_np = jax.device_get(fused_out)
+
         for fi, family in enumerate(families):
             k, g = len(splits), family.grid_size()
 
             if fi in fused:
-                per_fold_metrics = np.asarray(
-                    fused[fi](Xd, yd, wd, vwd, stacked_devs[fi]))   # [K, G]
-                per_grid_metrics = np.asarray(per_fold_metrics).T
+                per_grid_metrics = np.asarray(fused_np[fi]).T   # [G, K]
             else:
                 stacked = family.stack_grid()
                 def fit_all(w_folds):

@@ -240,10 +240,12 @@ class SanityChecker(Estimator, AllowLabelAsInput):
                 X, y = X[idx], y[idx]
                 n = target
 
-        mean, var, corr_label, corr, zmin, zmax = (
-            np.asarray(r) if r is not None else None
-            for r in _moments_kernel(jnp.asarray(X), jnp.asarray(y),
-                                     self.feature_label_corr_only))
+        # Dispatch EVERY device computation first (moments, optional
+        # Spearman over ranks, per-group contingencies) and fetch them in
+        # ONE device_get at the end: each separate pull pays the device
+        # link's round-trip latency (~200ms on a tunnelled TPU).
+        moments_dev = _moments_kernel(jnp.asarray(X), jnp.asarray(y),
+                                      self.feature_label_corr_only)
 
         # Spearman = Pearson over average ranks (MLlib Statistics.corr
         # "spearman"); ranks built per column on host, correlations in the
@@ -251,17 +253,32 @@ class SanityChecker(Estimator, AllowLabelAsInput):
         # the reference computes just the configured CorrelationType
         # (SanityChecker.scala:634-638) and the O(d·n log n) host ranking
         # is real money on wide hashed-text vectors.
+        spearman_dev = None
         if self.correlation_type == "spearman":
             R = np.empty_like(X)
             for j in range(d):
                 R[:, j] = _average_ranks(X[:, j])
-            _m, _v, spearman_label, _c, _a, _b = (
-                np.asarray(r) if r is not None else None
-                for r in _moments_kernel(jnp.asarray(R),
-                                         jnp.asarray(_average_ranks(y)),
-                                         True))
-        else:
-            spearman_label = None
+            spearman_dev = _moments_kernel(
+                jnp.asarray(R), jnp.asarray(_average_ranks(y)), True)
+
+        groups: Dict[Tuple[str, str], List[int]] = {}
+        if meta.size == d:
+            for i, cm in enumerate(meta.columns):
+                if cm.indicator_value is not None and cm.grouping is not None:
+                    groups.setdefault((cm.parent_feature_name, cm.grouping),
+                                      []).append(i)
+        ordered = sorted(groups.items())
+        conts_dev = []
+        if ordered:
+            classes = np.unique(y)
+            Y1d = jnp.asarray(
+                (y[:, None] == classes[None, :]).astype(np.float64))
+            conts_dev = [_contingency_kernel(Y1d, jnp.asarray(X[:, idxs]))
+                         for _g, idxs in ordered]
+
+        (mean, var, corr_label, corr, zmin, zmax), spearman_out, conts = \
+            jax.device_get((moments_dev, spearman_dev, conts_dev))
+        spearman_label = spearman_out[2] if spearman_out is not None else None
 
         names = meta.column_names() if meta.size == d else \
             [f"{feat_name}_{i}" for i in range(d)]
@@ -304,17 +321,9 @@ class SanityChecker(Estimator, AllowLabelAsInput):
 
         # categorical stats per indicator group (grouping + indicator cols)
         if meta.size == d:
-            groups: Dict[Tuple[str, str], List[int]] = {}
-            for i, cm in enumerate(meta.columns):
-                if cm.indicator_value is not None and cm.grouping is not None:
-                    groups.setdefault((cm.parent_feature_name, cm.grouping),
-                                      []).append(i)
-            if groups:
-                classes = np.unique(y)
-                Y1 = (y[:, None] == classes[None, :]).astype(np.float64)
-                for (parent, grouping), idxs in sorted(groups.items()):
-                    cont = np.asarray(_contingency_kernel(
-                        jnp.asarray(Y1), jnp.asarray(X[:, idxs])))
+            if ordered:
+                for ((parent, grouping), idxs), cont in zip(ordered, conts):
+                    cont = np.asarray(cont)
                     v, support, confidence = _cramers_v(cont)
                     pmi, mi = _pmi_mi(cont)
                     summary.categorical_stats.append({

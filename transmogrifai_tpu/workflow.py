@@ -167,7 +167,7 @@ def apply_layer_vectorized(models: Sequence[Transformer], store: ColumnStore,
         _LAYER_JIT_CACHE[key] = jitted
         while len(_LAYER_JIT_CACHE) > 32:
             _LAYER_JIT_CACHE.pop(next(iter(_LAYER_JIT_CACHE)))
-        outs = jitted(preps)
+        outs = jax.device_get(jitted(preps))   # one batched pull
         for m, mat in zip(vecs, outs):
             mat = np.asarray(mat, dtype=np.float64)
             meta = m.vector_metadata()
