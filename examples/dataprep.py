@@ -1,0 +1,70 @@
+"""Event-time data prep — the reference's dataprep/conditional-aggregation
+walkthrough (``docs/examples/Conditional-Aggregation.md``,
+``helloworld/.../dataprep``), TPU-native.
+
+Visit-log records aggregate per user with a PER-KEY cutoff fixed by an
+event predicate ("first purchase"): predictor features fold events BEFORE
+each user's cutoff through their type's monoid aggregators, the response
+folds events AFTER it — the reader enforces the leak barrier, not the
+modeler.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.readers import DataReaders
+from transmogrifai_tpu.utils.aggregators import (LogicalOrAggregator,
+                                                 SumAggregator)
+
+VISITS = [
+    # user a: browses, buys at t=300, returns after
+    {"user": "a", "ts": 100, "page": "home", "minutes": 3.0, "purchase": 0},
+    {"user": "a", "ts": 200, "page": "item", "minutes": 7.0, "purchase": 0},
+    {"user": "a", "ts": 300, "page": "cart", "minutes": 2.0, "purchase": 1},
+    {"user": "a", "ts": 400, "page": "item", "minutes": 9.0, "purchase": 0},
+    # user b: browses, never buys → dropped (no condition event)
+    {"user": "b", "ts": 150, "page": "home", "minutes": 1.0, "purchase": 0},
+    # user c: buys immediately at t=50, heavy use after
+    {"user": "c", "ts": 50, "page": "cart", "minutes": 1.0, "purchase": 1},
+    {"user": "c", "ts": 90, "page": "item", "minutes": 20.0, "purchase": 1},
+]
+
+
+def build_reader():
+    return DataReaders.conditional.records(
+        VISITS,
+        timestamp_fn=lambda r: r["ts"],
+        condition_fn=lambda r: r["purchase"] == 1,
+        key_fn=lambda r: r["user"])
+
+
+def build_features():
+    # predictors: behavior BEFORE the first purchase
+    minutes_before = (FeatureBuilder.Real("minutes")
+                      .from_column().aggregate(SumAggregator())
+                      .as_predictor())
+    # response: any repeat purchase AFTER the first one
+    repeat_buyer = (FeatureBuilder.Binary("purchase")
+                    .extract(lambda r: bool(r["purchase"]), "purchase")
+                    .aggregate(LogicalOrAggregator())
+                    .as_response())
+    return minutes_before, repeat_buyer
+
+
+def run():
+    reader = build_reader()
+    minutes_before, repeat_buyer = build_features()
+    store = reader.generate_store([minutes_before, repeat_buyer])
+    rows = {}
+    for i in range(store.n_rows):
+        rows[i] = {n: store[n].get_raw(i) for n in store.names()}
+    return store, rows
+
+
+if __name__ == "__main__":
+    store, rows = run()
+    print(f"{store.n_rows} users (condition-less users dropped):")
+    for i, r in rows.items():
+        print(" ", r)

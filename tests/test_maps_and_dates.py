@@ -116,3 +116,27 @@ def test_fn_named_function():
     spec = encode_fn(np.sqrt)
     assert spec["kind"] == "named"
     assert decode_fn(spec) is np.sqrt
+
+
+def test_dsl_breadth(rng):
+    """bucketize / to_unit_circle / combine / to_percentile DSL methods."""
+    import numpy as np
+    from transmogrifai_tpu import ColumnStore, FeatureBuilder, Workflow, column_from_values
+    n = 50
+    store = ColumnStore({
+        "x": column_from_values(ft.Real, list(rng.normal(size=n))),
+        "d": column_from_values(ft.Date, [1_500_000_000_000 + int(v)
+                                          for v in rng.integers(0, 10**10, n)]),
+    })
+    x = FeatureBuilder.Real("x").from_column().as_predictor()
+    d = FeatureBuilder.Date("d").from_column().as_predictor()
+    b = x.bucketize([-1.0, 0.0, 1.0])
+    circ = d.to_unit_circle()
+    pct = x.to_percentile(num_buckets=10)
+    both = b.combine(circ)
+    model = (Workflow().set_input_store(store)
+             .set_result_features(both, pct).train())
+    out = model.transform(store)
+    assert np.asarray(out[both.name].values).shape[0] == n
+    p = np.asarray(out[pct.name].values)
+    assert p.min() >= 0.0 and p.max() <= 99.0

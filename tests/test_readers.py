@@ -147,3 +147,20 @@ def test_aggregator_defaults_cover_all_types():
     mid = aggregator_of(ft.Geolocation).fold([(0.0, 0.0, 1.0),
                                               (0.0, 90.0, 2.0)])
     assert mid[1] == pytest.approx(45.0)
+
+
+def test_conditional_dataprep_example():
+    """The conditional-aggregation walkthrough produces leak-free per-user
+    rows (Conditional-Aggregation.md flow)."""
+    import os
+    import sys
+    examples = os.path.join(os.path.dirname(__file__), os.pardir, "examples")
+    sys.path.insert(0, examples)
+    try:
+        from dataprep import run
+    finally:
+        sys.path.remove(examples)
+    store, rows = run()
+    assert store.n_rows == 2             # user b dropped (never purchased)
+    by_minutes = {r["minutes"] for r in rows.values()}
+    assert 10.0 in by_minutes            # user a: 3 + 7 before first buy

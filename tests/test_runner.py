@@ -159,3 +159,20 @@ def test_summary_pretty_renders_stage_table(rng):
     t = Table(["a", "b"], [[1, 2.5], ["x", None]], name="T")
     s = t.render()
     assert "| a" in s and "2.5" in s
+
+
+def test_streaming_score_run_type(rng, tmp_path):
+    records = _records(rng, 150)
+    reader = _ListReader(records)
+    wf, label, pred, _sel = _flow()
+    runner = OpWorkflowRunner(wf, training_reader=reader,
+                              scoring_reader=reader)
+    params = OpParams(model_location=str(tmp_path / "m"),
+                      write_location=str(tmp_path / "s.csv"),
+                      custom_params={"batchSize": 64})
+    runner.run(RunType.TRAIN, params)
+    out = runner.run(RunType.STREAMING_SCORE, params)
+    assert out.metrics["rowsScored"] == 150
+    assert out.metrics["batches"] == 3
+    assert os.path.exists(params.write_location)
+    assert sum(1 for _ in open(params.write_location)) == 151   # header + rows

@@ -356,6 +356,37 @@ def _count_vectorize(self: Feature, *others: Feature, **kw):
     return self.transform_with(OpCountVectorizer(**kw), *others)
 
 
+def _bucketize(self: Feature, splits=None, **kw):
+    from .ops.numeric import NumericBucketizer
+    return self.transform_with(NumericBucketizer(
+        splits=list(splits) if splits is not None else None, **kw))
+
+
+def _to_unit_circle(self: Feature, **kw):
+    from .ops.dates import DateToUnitCircleVectorizer
+    return self.transform_with(DateToUnitCircleVectorizer(**kw))
+
+
+def _combine(self: Feature, *others: Feature):
+    from .ops.vectors import VectorsCombiner
+    return self.transform_with(VectorsCombiner(), *others)
+
+
+def _to_percentile(self: Feature, **kw):
+    from .ops.calibrators import PercentileCalibrator
+    return self.transform_with(PercentileCalibrator(**kw))
+
+
+def _lda(self: Feature, n_topics: int = 10, **kw):
+    from .ops.topics import OpLDA
+    return self.transform_with(OpLDA(n_topics=n_topics, **kw))
+
+
+def _word2vec(self: Feature, dim: int = 32, **kw):
+    from .ops.topics import OpWord2Vec
+    return self.transform_with(OpWord2Vec(dim=dim, **kw))
+
+
 def _indexed(self: Feature, **kw):
     from .ops.indexers import OpStringIndexerNoFilter
     return self.transform_with(OpStringIndexerNoFilter(**kw))
@@ -399,5 +430,11 @@ Feature.ngram_similarity = _ngram_similarity
 Feature.count_vectorize = _count_vectorize
 Feature.indexed = _indexed
 Feature.deindexed = _deindexed
+Feature.bucketize = _bucketize
+Feature.to_unit_circle = _to_unit_circle
+Feature.combine = _combine
+Feature.to_percentile = _to_percentile
+Feature.lda = _lda
+Feature.word2vec = _word2vec
 
 transmogrify = _vectorize_collection

@@ -78,10 +78,11 @@ class OpParams:
 class RunType:
     TRAIN = "Train"
     SCORE = "Score"
+    STREAMING_SCORE = "StreamingScore"
     EVALUATE = "Evaluate"
     FEATURES = "Features"
 
-    ALL = (TRAIN, SCORE, EVALUATE, FEATURES)
+    ALL = (TRAIN, SCORE, STREAMING_SCORE, EVALUATE, FEATURES)
 
 
 @dataclass
@@ -156,6 +157,42 @@ class OpWorkflowRunner:
             self._write_metrics(params.metrics_location, metrics)
             return RunnerResult(run_type, metrics=metrics, scores=scores)
 
+        if run_type == RunType.STREAMING_SCORE:
+            # incremental batch scoring (OpWorkflowRunner StreamingScore /
+            # StreamingReaders analog): fixed-size record batches through
+            # readers.stream_score; each batch is written to the sink and
+            # DROPPED, so peak memory is one batch — not the dataset
+            from .readers import stream_score
+            reader = self.scoring_reader
+            data = reader.read_records()
+            batch = int(params.custom_params.get("batchSize", 1024))
+            if batch <= 0:
+                raise ValueError(
+                    f"customParams.batchSize must be positive, got {batch}")
+            batches = (data[i:i + batch] for i in range(0, len(data), batch))
+            rows = 0
+            n_batches = 0
+            sink = (_CsvSink(params.write_location)
+                    if params.write_location else None)
+            try:
+                for scored in stream_score(model, batches):
+                    rows += scored.n_rows
+                    n_batches += 1
+                    if sink is not None:
+                        sink.write(scored)
+                if sink is not None and n_batches == 0:
+                    # header-only output (as SCORE produces on empty input)
+                    sink.write_header(
+                        [f.name for f in model.result_features])
+            finally:
+                if sink is not None:
+                    sink.close()
+            metrics = {"rowsScored": rows, "batches": n_batches,
+                       "batchSize": batch,
+                       "appSeconds": round(time.time() - t0, 3)}
+            self._write_metrics(params.metrics_location, metrics)
+            return RunnerResult(run_type, metrics=metrics)
+
         if run_type == RunType.EVALUATE:
             reader = self.evaluation_reader
             data = reader.read_records()
@@ -183,16 +220,39 @@ class OpWorkflowRunner:
         return RunnerResult(run_type, metrics=metrics, scores=store)
 
 
-def _write_store_csv(store, path: str) -> None:
-    """Minimal CSV sink for scores/features (saveScores analog)."""
-    import csv
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    names = store.names()
-    with open(path, "w", newline="") as fh:
-        w = csv.writer(fh)
-        w.writerow(names)
+class _CsvSink:
+    """Incremental CSV sink (saveScores analog): header from the first
+    store, batches appended as they arrive."""
+
+    def __init__(self, path: str):
+        import csv
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path, "w", newline="")
+        self._writer = csv.writer(self._fh)
+        self._names = None
+
+    def write_header(self, names) -> None:
+        if self._names is None:
+            self._names = list(names)
+            self._writer.writerow(self._names)
+
+    def write(self, store) -> None:
+        self.write_header(store.names())
         for i in range(store.n_rows):
-            w.writerow([store[n].get_raw(i) for n in names])
+            self._writer.writerow([store[n].get_raw(i)
+                                   for n in self._names])
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+def _write_store_csv(store, path: str) -> None:
+    """One-shot CSV sink over a single store."""
+    sink = _CsvSink(path)
+    try:
+        sink.write(store)
+    finally:
+        sink.close()
 
 
 class OpApp:
