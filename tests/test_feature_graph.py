@@ -157,3 +157,45 @@ def test_from_store_inference():
     resp, preds = FeatureBuilder.from_store(store, "y")
     assert resp.is_response and resp.ftype is ft.RealNN
     assert {p.name: p.ftype for p in preds} == {"x1": ft.Real, "t": ft.Text}
+
+
+def test_feature_graph_json_roundtrip(rng):
+    """FeatureJsonHelper analog: an unfitted DAG round-trips through JSON
+    and the rebuilt graph trains to the same result."""
+    import json as _json
+
+    import numpy as np
+
+    from transmogrifai_tpu import ColumnStore, Workflow, column_from_values
+    from transmogrifai_tpu.feature_json import (features_from_json,
+                                                features_to_json)
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import BinaryClassificationModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    fc = FeatureBuilder.PickList("c").from_column().as_predictor()
+    vec = transmogrify([fx, fc])
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None)
+    pred = label.transform_with(selector, vec)
+
+    doc = _json.loads(_json.dumps(features_to_json([pred])))
+    (pred2,) = features_from_json(doc)
+    assert pred2.name == pred.name and pred2.uid == pred.uid
+    assert {s.uid for s in pred2.parent_stages()} == \
+        {s.uid for s in pred.parent_stages()}
+
+    n = 120
+    y = rng.integers(0, 2, n).astype(float)
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "x": column_from_values(ft.Real, list(rng.normal(size=n) + y)),
+        "c": column_from_values(ft.PickList,
+                                ["a" if v else "b" for v in y]),
+    })
+    model = (Workflow().set_input_store(store)
+             .set_result_features(pred2).train())
+    assert model.score(store).n_rows == n

@@ -2,7 +2,7 @@
 import numpy as np
 import pytest
 
-from transmogrifai_tpu import ColumnStore, FeatureBuilder, Workflow
+from transmogrifai_tpu import ColumnStore, FeatureBuilder, Workflow, column_from_values
 from transmogrifai_tpu.models import (BinaryClassificationModelSelector,
                                       LogisticRegressionFamily)
 from transmogrifai_tpu.ops import transmogrify
@@ -100,3 +100,77 @@ def test_golden_model_pins_format(rng):
     np.testing.assert_allclose(
         np.asarray(pcol.probability[:, 1]), expected["expected_prob1"],
         rtol=1e-6)
+
+
+def test_checkpoint_resume_after_crash(rng, tmp_path):
+    """Layer-granular checkpointing + warm-start resume: kill training
+    after the feature layers, resume, and the already-fitted stages are
+    not refit (failure-recovery subsystem; VERDICT r1 item 58)."""
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import BinaryClassificationModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.workflow import WorkflowModel
+
+    n = 150
+    y = rng.integers(0, 2, n).astype(float)
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "x": column_from_values(ft.Real, list(rng.normal(size=n) + y)),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    vec = transmogrify([fx])
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None)
+    pred = label.transform_with(selector, vec)
+    ckpt = str(tmp_path / "ckpt")
+
+    # crash mid-train: fail the selector's fit on the first attempt
+    calls = {"n": 0}
+    orig = selector.fit_columns
+
+    def crashing(store_):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("simulated preemption")
+        return orig(store_)
+    selector.fit_columns = crashing
+
+    wf = (Workflow().set_input_store(store).set_result_features(pred)
+          .with_checkpointing(ckpt))
+    with pytest.raises(RuntimeError, match="preemption"):
+        wf.train()
+
+    # the vectorizer layer made it into the checkpoint
+    partial = WorkflowModel.load(ckpt)
+    assert partial.fitted_stages and \
+        selector.uid not in partial.fitted_stages
+
+    # resume: warm-start from the checkpoint; only the selector refits
+    wf2 = (Workflow().set_input_store(store).set_result_features(pred)
+           .with_model_stages(partial))
+    model = wf2.train()
+    m = model.stage_metrics[vec.origin_stage.uid]
+    assert m.get("warmStarted") is True
+    assert model.score(store).n_rows == n
+
+
+def test_obj_codec_allowlist_and_var_kwargs():
+    """The structural config codec only instantiates registered config
+    base classes, and round-trips **kwargs-captured settings."""
+    from transmogrifai_tpu import model_io
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+
+    fam = LogisticRegressionFamily(grid=[{"regParam": 0.5,
+                                          "elasticNetParam": 0.0}],
+                                   some_fixed=7)
+    arrays = {}
+    enc = model_io._encode_param(fam, arrays, "t")
+    back = model_io._decode_param(enc, arrays)
+    assert type(back) is LogisticRegressionFamily
+    assert back.grid == fam.grid
+    assert back.fixed == {"some_fixed": 7}
+
+    evil = {"__obj__": "os:system", "params": {}}
+    with pytest.raises(ValueError, match="Refusing to instantiate"):
+        model_io._decode_param(evil, {})

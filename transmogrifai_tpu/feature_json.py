@@ -1,0 +1,45 @@
+"""Feature-graph JSON (de)serialization — FeatureJsonHelper analog.
+
+Parity: ``features/.../FeatureJsonHelper.scala`` (140 LoC): round-trip an
+UNFITTED feature DAG (features + origin stages + wiring) through JSON —
+e.g. to version feature definitions or ship them between services —
+independent of any trained model. Reuses model_io's stage/feature record
+format so the two serializations can never drift; numpy ctor params are
+embedded as lists (a feature graph carries no fitted weights).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+from . import model_io
+from .features import Feature
+
+__all__ = ["features_to_json", "features_from_json"]
+
+
+def features_to_json(result_features: Sequence[Feature]) -> Dict[str, Any]:
+    arrays: Dict[str, np.ndarray] = {}
+    feats = model_io._topo_features(result_features)
+    recorded = set()
+    stage_records: List[Dict[str, Any]] = []
+    for f in feats:
+        st = f.origin_stage
+        if st is not None and st.uid not in recorded:
+            recorded.add(st.uid)
+            stage_records.append(model_io._stage_record(st, arrays))
+    return {
+        "features": [model_io._feature_record(f) for f in feats],
+        "resultFeatureUids": [f.uid for f in result_features],
+        "stages": stage_records,
+        "arrays": {k: v.tolist() for k, v in arrays.items()},
+    }
+
+
+def features_from_json(doc: Dict[str, Any]) -> List[Feature]:
+    """Rebuild the result features (and their whole ancestor graph)."""
+    arrays = {k: np.asarray(v) for k, v in (doc.get("arrays") or {}).items()}
+    stage_by_uid = model_io.rebuild_stages(doc["stages"], arrays)
+    feat_by_uid = model_io.rebuild_features(doc["features"], stage_by_uid)
+    return [feat_by_uid[u] for u in doc["resultFeatureUids"]]

@@ -30,6 +30,41 @@ WEIGHTS_NPZ = "weights.npz"
 FORMAT_VERSION = 1
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _codec_bases():
+    """Config-object families encoded structurally (ctor-arg capture):
+    model families, splitters, validators (an unfitted ModelSelector's
+    params — reached by feature-graph serialization and layer
+    checkpoints). Cached: this runs per encoded leaf."""
+    from .models.base import ModelFamily
+    from .models.tuning import Splitter, _ValidatorBase
+    return (ModelFamily, Splitter, _ValidatorBase)
+
+
+def _encode_obj(v: Any, arrays: Dict[str, np.ndarray], prefix: str) -> Any:
+    import inspect
+    cls = type(v)
+    sig = inspect.signature(cls.__init__)
+    params = {}
+    for name, p in sig.parameters.items():
+        if name in ("self", "mesh") or p.kind is p.VAR_POSITIONAL:
+            continue
+        if p.kind is p.VAR_KEYWORD:
+            # **kwargs conventionally stored under the parameter's name
+            # (ModelFamily's **fixed → self.fixed)
+            kw = getattr(v, name, None)
+            if isinstance(kw, dict) and kw:
+                params["__var_kw__"] = _encode_param(kw, arrays, prefix)
+            continue
+        if hasattr(v, name):
+            params[name] = _encode_param(getattr(v, name), arrays, prefix)
+    return {"__obj__": f"{cls.__module__}:{cls.__qualname__}",
+            "params": params}
+
+
 def _encode_param(v: Any, arrays: Dict[str, np.ndarray], prefix: str) -> Any:
     if isinstance(v, type) and issubclass(v, FeatureType):
         return {"__ftype__": v.__name__}
@@ -47,6 +82,8 @@ def _encode_param(v: Any, arrays: Dict[str, np.ndarray], prefix: str) -> Any:
         return [_encode_param(x, arrays, prefix) for x in v]
     if isinstance(v, dict):
         return {str(k): _encode_param(x, arrays, prefix) for k, x in v.items()}
+    if isinstance(v, _codec_bases()):
+        return _encode_obj(v, arrays, prefix)
     if callable(v):
         return {"__dropped_callable__": getattr(v, "__name__", "fn")}
     return v
@@ -60,6 +97,24 @@ def _decode_param(v: Any, arrays: Dict[str, np.ndarray]) -> Any:
             return arrays[v["__array__"]]
         if "__vecmeta__" in v:
             return VectorMetadata.from_json(v["__vecmeta__"])
+        if "__obj__" in v:
+            import importlib
+            mod_name, _, qual = v["__obj__"].partition(":")
+            obj = importlib.import_module(mod_name)
+            for part in qual.split("."):
+                obj = getattr(obj, part)
+            # allowlist: only the codec's config base classes may be
+            # instantiated from serialized data (same discipline as
+            # STAGE_REGISTRY for stages — never arbitrary callables)
+            if not (isinstance(obj, type)
+                    and issubclass(obj, _codec_bases())):
+                raise ValueError(
+                    f"Refusing to instantiate {v['__obj__']!r}: not a "
+                    "registered config class")
+            kwargs = {k: _decode_param(x, arrays)
+                      for k, x in v["params"].items()}
+            kwargs.update(kwargs.pop("__var_kw__", None) or {})
+            return obj(**kwargs)
         if "__dropped_callable__" in v:
             return None
         return {k: _decode_param(x, arrays) for k, x in v.items()}
@@ -149,19 +204,12 @@ def save_workflow_model(model, path: str, overwrite: bool = False) -> None:
     np.savez(os.path.join(path, WEIGHTS_NPZ), **arrays)
 
 
-def load_workflow_model(path: str):
-    from .workflow import WorkflowModel
-
-    with open(os.path.join(path, MODEL_JSON)) as fh:
-        doc = json.load(fh)
-    npz_path = os.path.join(path, WEIGHTS_NPZ)
-    arrays: Dict[str, np.ndarray] = {}
-    if os.path.exists(npz_path):
-        with np.load(npz_path, allow_pickle=False) as npz:
-            arrays = {k: npz[k] for k in npz.files}
-
+def rebuild_stages(records, arrays: Dict[str, np.ndarray]
+                   ) -> Dict[str, OpPipelineStage]:
+    """Stage records → instances (registry-checked), cross-references
+    re-bound by uid. Shared by model loading and feature-graph JSON."""
     stage_by_uid: Dict[str, OpPipelineStage] = {}
-    for rec in doc["stages"]:
+    for rec in records:
         cls = STAGE_REGISTRY.get(rec["className"])
         if cls is None:
             raise ValueError(
@@ -184,24 +232,43 @@ def load_workflow_model(path: str):
     for stage in stage_by_uid.values():
         if hasattr(stage, "rebind_stages"):
             stage.rebind_stages(stage_by_uid)
+    return stage_by_uid
 
+
+def rebuild_features(records, stage_by_uid: Dict[str, OpPipelineStage]
+                     ) -> Dict[str, Feature]:
+    """Feature records (topological order) → wired Feature graph."""
     feat_by_uid: Dict[str, Feature] = {}
-    for frec in doc["features"]:
+    for frec in records:
         stage = stage_by_uid.get(frec["originStageUid"])
         if stage is None:
-            raise ValueError(f"Feature {frec['name']!r} has unknown origin stage")
+            raise ValueError(
+                f"Feature {frec['name']!r} has unknown origin stage")
         if frec["parentUids"]:
             parents = [feat_by_uid[u] for u in frec["parentUids"]]
             if tuple(stage.input_features) != tuple(parents):
                 stage.set_input(*parents)
-            feat = stage.get_output()
-        else:
-            feat = stage.get_output()
+        feat = stage.get_output()
         feat.uid = frec["uid"]
         feat.name = frec["name"]
         feat.is_response = frec["isResponse"]
         feat_by_uid[frec["uid"]] = feat
+    return feat_by_uid
 
+
+def load_workflow_model(path: str):
+    from .workflow import WorkflowModel
+
+    with open(os.path.join(path, MODEL_JSON)) as fh:
+        doc = json.load(fh)
+    npz_path = os.path.join(path, WEIGHTS_NPZ)
+    arrays: Dict[str, np.ndarray] = {}
+    if os.path.exists(npz_path):
+        with np.load(npz_path, allow_pickle=False) as npz:
+            arrays = {k: npz[k] for k in npz.files}
+
+    stage_by_uid = rebuild_stages(doc["stages"], arrays)
+    feat_by_uid = rebuild_features(doc["features"], stage_by_uid)
     result_features = [feat_by_uid[u] for u in doc["resultFeatureUids"]]
     fitted = {uid: st for uid, st in stage_by_uid.items()
               if isinstance(st, FittedModel)}

@@ -108,6 +108,22 @@ def device_roundtrip_mbps() -> float:
     return _DEVICE_BW_MBPS
 
 
+def _atomic_checkpoint(model: "WorkflowModel", directory: str) -> None:
+    """Write a checkpoint crash-consistently: save into a sibling temp dir
+    and swap it in (rename), so a preemption mid-save leaves either the
+    old or the new checkpoint, never a torn one."""
+    import shutil
+    tmp = f"{directory}.tmp.{os.getpid()}"
+    old = f"{directory}.old.{os.getpid()}"
+    shutil.rmtree(tmp, ignore_errors=True)
+    model.save(tmp, overwrite=True)
+    shutil.rmtree(old, ignore_errors=True)
+    if os.path.exists(directory):
+        os.rename(directory, old)
+    os.rename(tmp, directory)
+    shutil.rmtree(old, ignore_errors=True)
+
+
 def apply_layer_vectorized(models: Sequence[Transformer], store: ColumnStore,
                            fuse_min_rows: Optional[int] = None) -> ColumnStore:
     """Transform a DAG layer, fusing its vectorizers into one XLA program.
@@ -195,6 +211,7 @@ class Workflow:
         self.parameters: Dict[str, Any] = {}
         self.blacklisted_features: List[Feature] = []
         self._workflow_cv = False
+        self._checkpoint_dir: Optional[str] = None
         self._warm_stages: Dict[str, FittedModel] = {}
         #: per-stage fit/transform wall-clock collected during train
         #: (OpSparkListener StageMetrics analog)
@@ -239,6 +256,16 @@ class Workflow:
         from a previous model are substituted by uid during train, skipping
         their refit. Estimators not present in the model still fit."""
         self._warm_stages = dict(model.fitted_stages)
+        return self
+
+    def with_checkpointing(self, directory: str) -> "Workflow":
+        """Layer-granular failure recovery: after every fitted DAG layer
+        the partial model is persisted to ``directory``; a crashed train
+        resumes via ``Workflow.with_model_stages(WorkflowModel.load(dir))``
+        which skips the already-fitted estimators. The framework analog of
+        the reference's persist-every-K robustness thinking
+        (FitStagesUtil.scala:134-165) with actual resume."""
+        self._checkpoint_dir = directory
         return self
 
     def with_workflow_cv(self, enabled: bool = True) -> "Workflow":
@@ -306,6 +333,9 @@ class Workflow:
         if self.splitter is not None:
             train_store, test_store = self.splitter.reserve_split(store)
 
+        # the graph actually being fitted (RFF pruning may have copied it);
+        # layer checkpoints must record THIS graph, not the original
+        self._active_result_features = result_features
         dag = compute_dag(result_features)
         if self._workflow_cv:
             fitted, train_time = self._fit_dag_workflow_cv(
@@ -326,7 +356,8 @@ class Workflow:
 
     def _fit_dag(self, dag: StagesDAG, train: ColumnStore,
                  test: Optional[ColumnStore],
-                 fitted: Optional[Dict[str, FittedModel]] = None
+                 fitted: Optional[Dict[str, FittedModel]] = None,
+                 checkpoint: bool = True
                  ) -> Tuple[Dict[str, FittedModel], float,
                             ColumnStore, Optional[ColumnStore]]:
         """Fold layers: fit estimators, holdout-eval, transform both splits
@@ -374,6 +405,16 @@ class Workflow:
                 self._stage_metrics.setdefault(
                     m.uid, {"stageName": m.stage_name()})[
                     "layerTransformSeconds"] = round(layer_transform_s, 4)
+            if checkpoint and self._checkpoint_dir:
+                # the ACTIVE graph (post-RawFeatureFilter pruning), written
+                # crash-consistently: a preemption mid-save must not
+                # destroy the previous good checkpoint
+                feats = getattr(self, "_active_result_features",
+                                self.result_features)
+                if feats:
+                    _atomic_checkpoint(WorkflowModel(
+                        result_features=feats, fitted_stages=fitted),
+                        self._checkpoint_dir)
         return fitted, time.time() - t0, train, test
 
     def _fit_dag_workflow_cv(self, result_features, dag: StagesDAG,
@@ -426,7 +467,7 @@ class Workflow:
             tr_idx = np.nonzero(train_mask > 0)[0]
             fold_fit: Dict[str, FittedModel] = {}
             _, _, _, _ = self._fit_dag(during, store_kept.take(tr_idx),
-                                       None, fold_fit)
+                                       None, fold_fit, checkpoint=False)
             # transform the FULL kept split with fold-fitted during stages
             fold_store = store_kept
             for layer in during:
