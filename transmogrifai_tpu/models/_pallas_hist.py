@@ -1,0 +1,175 @@
+"""Pallas TPU kernel for the level-wise tree histogram build.
+
+The tree engine's hot op (``_treefit._level_cumhist``) computes, per level,
+
+    cum[s, c, t, f] = Σ_i 1[node_i = s] · 1[Xb_if ≤ t] · stats_ic
+
+as one MXU matmul ``NSᵀ @ Bc`` with ``NS = one_hot(node) ⊗ stats`` and
+``Bc = (bin ≤ t)``. The pure-XLA path must *materialize* NS ([n, A·C]) and
+Bc ([n, B·F]) in HBM before the dot — at the 10M-row BASELINE config that
+write+read traffic (n · (A·C + B·F) elements per level per tree) dominates
+the histogram build, which is exactly the bandwidth problem SURVEY §2.9
+assigns to a Pallas kernel (the xgboost4j/Rabit replacement: "Pallas
+histogram-build & split kernels").
+
+This kernel fuses operand construction into the matmul: row blocks of
+``Xb``/``node``/``stats`` stream HBM→VMEM once (n · (F + C + 1) elements),
+the one-hot expansion and the bin-threshold indicator are built in VMEM,
+and the [A·C, B, Fc] output block stays resident in VMEM across the row
+grid (TPU grids execute sequentially; the row axis is the fastest-varying
+grid dim, so the accumulator is revisited, zero-initialised at row step 0).
+Features are tiled over the slower grid axis to bound VMEM.
+
+Numerics match the XLA path: bf16 operands (counts are sums of exact bf16
+1.0s) with f32 accumulation when stats are f32; f64 (CPU tests) stays f64.
+
+Measured on a v5e-1 at the synthetic-trees bench shape (n=200k, F=20,
+B=32, A=128, C=3): 6.2 ms per histogram vs 13.4 ms for the XLA path
+(2.2× — amortized over a scanned jit; single-call timings only measure
+dispatch latency). End-to-end the 200k-row CV sweep is warm-neutral
+(the sweep is dominated by the level scan's routing/score work, not the
+histogram build) while Mosaic compilation adds ~50 s of cold time, so
+the kernel ships **opt-in**: set ``TMOG_PALLAS=1`` to enable it
+(compiled on TPU, interpret mode elsewhere), ``TMOG_PALLAS=auto`` to
+enable it on TPU after a compile probe, ``TMOG_PALLAS=0``/unset for the
+XLA path. The opt-in is the at-scale configuration: histogram HBM
+traffic grows linearly in rows while the fixed-shape level overheads do
+not, so the kernel's share rises with the row count.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+__all__ = ["cumhist", "pallas_histograms_enabled"]
+
+_PROBE: Optional[bool] = None
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _kernel(xb_ref, node_ref, stats_ref, o_ref, *, n_nodes, n_bins,
+            mm_dtype):
+    """Everything stays rank-2: Mosaic's vector layouts reject
+    shape-changing reshapes whose minor dim is not 128-aligned, so the
+    [bn, B, Fc] bin indicator is built flat ([bn, B·Fc] with threshold
+    j // Fc and a B-fold column tile of Xb) and the channel axis is a
+    static Python loop over C per-channel dots writing row slices."""
+    rb = pl.program_id(1)
+
+    @pl.when(rb == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    bn, Fc = xb_ref.shape
+    C = stats_ref.shape[1]
+    A, B = n_nodes, n_bins
+    node = node_ref[:, 0]                                  # [bn]
+    # one_hot(node): padded rows carry node = A → all-false → zero rows.
+    oh = (node[:, None] == lax.broadcasted_iota(jnp.int32, (bn, A), 1)
+          ).astype(jnp.float32).astype(stats_ref.dtype)
+    # Bc = lower-triangular bin indicator (bin ≤ t) → left-cumulative sums
+    # fall straight out of the dot; column j = t·Fc + f.
+    xb_tile = jnp.concatenate([xb_ref[:]] * B, axis=1)     # [bn, B·Fc]
+    thr = lax.broadcasted_iota(jnp.int32, (bn, B * Fc), 1) // Fc
+    bc = (xb_tile <= thr).astype(jnp.float32).astype(mm_dtype)
+    for c in range(C):
+        ohc = (oh * stats_ref[:, c:c + 1]).astype(mm_dtype)
+        o_ref[c * A:(c + 1) * A, :] += lax.dot_general(
+            ohc, bc, (((0,), (0,)), ((), ())),
+            preferred_element_type=o_ref.dtype)
+
+
+def cumhist(stats: jnp.ndarray, node: jnp.ndarray, Xb: jnp.ndarray,
+            n_nodes: int, n_bins: int, *, block_rows: int = 256,
+            max_cols: int = 2048, interpret: Optional[bool] = None
+            ) -> jnp.ndarray:
+    """[n, C] stats + [n] node slots + [n, F] bins → [A, C, B, F] cumulative
+    histograms. Drop-in replacement for the XLA matmul path in
+    ``_treefit._level_cumhist`` (idle rows: node == n_nodes → zero)."""
+    n, F = Xb.shape
+    C = stats.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bn = min(block_rows, _round_up(n, 8))
+    Fc = max(1, min(F, max_cols // n_bins))
+    n_pad = _round_up(n, bn)
+    F_pad = _round_up(F, Fc)
+    if n_pad != n:
+        pad = n_pad - n
+        Xb = jnp.concatenate([Xb, jnp.zeros((pad, F), Xb.dtype)])
+        node = jnp.concatenate(
+            [node, jnp.full((pad,), n_nodes, node.dtype)])
+        stats = jnp.concatenate([stats, jnp.zeros((pad, C), stats.dtype)])
+    if F_pad != F:
+        Xb = jnp.concatenate(
+            [Xb, jnp.zeros((n_pad, F_pad - F), Xb.dtype)], axis=1)
+    mm_dtype = jnp.bfloat16 if stats.dtype == jnp.float32 else stats.dtype
+    kern = functools.partial(_kernel, n_nodes=n_nodes, n_bins=n_bins,
+                             mm_dtype=mm_dtype)
+    nfb = F_pad // Fc
+    out = pl.pallas_call(
+        kern,
+        grid=(nfb, n_pad // bn),                           # rows fastest
+        in_specs=[
+            pl.BlockSpec((bn, Fc), lambda fb, rb: (rb, fb)),
+            pl.BlockSpec((bn, 1), lambda fb, rb: (rb, 0)),
+            pl.BlockSpec((bn, C), lambda fb, rb: (rb, 0)),
+        ],
+        out_specs=pl.BlockSpec((C * n_nodes, n_bins * Fc),
+                               lambda fb, rb: (0, fb)),
+        out_shape=jax.ShapeDtypeStruct((C * n_nodes, nfb * n_bins * Fc),
+                                       stats.dtype),
+        interpret=interpret,
+    )(Xb, node.reshape(-1, 1).astype(jnp.int32), stats)
+    # rows are channel-major (c·A + a), columns (fb, t, f_local): restore
+    # the channel-minor [A, C, B, F] layout the tree engine expects.
+    out = out.reshape(C, n_nodes, nfb, n_bins, Fc)
+    out = out.transpose(1, 0, 3, 2, 4).reshape(
+        n_nodes, C, n_bins, F_pad)
+    return out[..., :F]
+
+
+def pallas_histograms_enabled() -> bool:
+    """Trace-time gate for the tree engine. ``TMOG_PALLAS=1`` forces the
+    kernel on (interpret mode off-TPU), ``auto`` enables it on TPU after
+    a one-time compile probe, anything else (default) keeps the XLA
+    matmul path (see module docstring for the measurements behind the
+    default)."""
+    global _PROBE
+    env = os.environ.get("TMOG_PALLAS", "").strip()
+    if env == "1":
+        return True
+    if env != "auto":
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    if _PROBE is None:
+        try:
+            import numpy as np
+            # The gate is consulted at trace time (inside jit tracing of the
+            # tree fit); ensure_compile_time_eval runs the probe eagerly so
+            # its arrays do not become tracers of the enclosing trace.
+            with jax.ensure_compile_time_eval():
+                out = cumhist(
+                    jnp.ones((16, 3), jnp.float32),
+                    jnp.zeros((16,), jnp.int32),
+                    jnp.zeros((16, 4), jnp.int32),
+                    2, 2, interpret=False)
+                ok = bool(np.asarray(out).shape == (2, 3, 2, 4))
+            _PROBE = ok
+        except Exception as e:  # Mosaic/backend failure → XLA path
+            import warnings
+            warnings.warn(
+                f"pallas histogram kernel unavailable ({e!r}); "
+                "falling back to the XLA matmul path")
+            _PROBE = False
+    return _PROBE

@@ -224,6 +224,11 @@ def _level_cumhist(stats, node, Xb, n_nodes, n_bins,
     """
     n, F = Xb.shape
     C = stats.shape[1]
+    from ._pallas_hist import cumhist, pallas_histograms_enabled
+    if pallas_histograms_enabled():
+        # Pallas path: operand construction fused into the matmul in VMEM —
+        # NS/Bc never hit HBM (see _pallas_hist module docstring).
+        return cumhist(stats, node, Xb, n_nodes, n_bins)
     # f32 matmuls run at a fraction of MXU bf16 throughput; bf16 operands
     # with f32 accumulation keep COUNT channels exact (sums of exact 1.0s
     # in an f32 accumulator) and only add ~1e-3 relative rounding to the
