@@ -1,0 +1,53 @@
+"""utils.stats — the OpStatistics analog (OpStatistics.scala:71-346)."""
+import numpy as np
+
+from transmogrifai_tpu.utils import stats
+
+
+def test_moments_matches_numpy(rng):
+    X = rng.normal(size=(200, 5))
+    y = X[:, 0] * 0.5 + rng.normal(size=200)
+    mean, var, corr_label, corr, zmin, zmax = stats.moments(
+        X, y, label_corr_only=False)
+    Z = np.column_stack([X, y])
+    np.testing.assert_allclose(np.asarray(mean), Z.mean(0), rtol=1e-9)
+    np.testing.assert_allclose(np.asarray(var), Z.var(0, ddof=1), rtol=1e-9)
+    ref_corr = np.corrcoef(Z, rowvar=False)
+    np.testing.assert_allclose(np.asarray(corr), ref_corr, rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(corr_label), ref_corr[:-1, -1],
+                               rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(zmin), Z.min(0))
+    np.testing.assert_allclose(np.asarray(zmax), Z.max(0))
+
+
+def test_contingency_and_cramers_v():
+    # textbook 2x2 table: perfect association → V = 1
+    cont = np.array([[30.0, 0.0], [0.0, 20.0]])
+    v, support, confidence = stats.cramers_v_stats(cont)
+    assert abs(v - 1.0) < 1e-12
+    np.testing.assert_allclose(support, [0.6, 0.4])
+    np.testing.assert_allclose(confidence, [1.0, 1.0])
+    # independence → V = 0, MI = 0
+    indep = np.outer([0.5, 0.5], [30.0, 20.0])
+    v0, _, _ = stats.cramers_v_stats(indep)
+    assert abs(v0) < 1e-12
+    _pmi, mi = stats.pmi_mutual_info(indep)
+    assert abs(mi) < 1e-12
+    # perfect association: MI = label entropy (0.6/0.4 split → ~0.971 bits)
+    _pmi, mi1 = stats.pmi_mutual_info(cont)
+    ent = -(0.6 * np.log2(0.6) + 0.4 * np.log2(0.4))
+    assert abs(mi1 - ent) < 1e-12
+
+
+def test_average_ranks_ties():
+    v = np.array([3.0, 1.0, 3.0, 2.0])
+    np.testing.assert_allclose(stats.average_ranks(v), [3.5, 1.0, 3.5, 2.0])
+
+
+def test_spearman_monotone_invariance(rng):
+    # Spearman is invariant under monotone transforms; Pearson is not.
+    x = rng.normal(size=300)
+    y = np.exp(2.0 * x)           # monotone in x, wildly non-linear
+    X = x[:, None]
+    corr_label, _ = stats.spearman_with_label(X, y)
+    assert abs(float(corr_label[0]) - 1.0) < 1e-9

@@ -171,6 +171,50 @@ def test_obj_codec_allowlist_and_var_kwargs():
     assert back.grid == fam.grid
     assert back.fixed == {"some_fixed": 7}
 
+    # out-of-package module: rejected BEFORE import (importing executes
+    # the module's top-level code)
     evil = {"__obj__": "os:system", "params": {}}
-    with pytest.raises(ValueError, match="Refusing to instantiate"):
+    with pytest.raises(ValueError, match="Refusing to import"):
         model_io._decode_param(evil, {})
+    # in-package but not a registered codec base: import ok, instantiate
+    # refused
+    sneaky = {"__obj__": "transmogrifai_tpu.model_io:save_workflow_model",
+              "params": {}}
+    with pytest.raises(ValueError, match="Refusing to instantiate"):
+        model_io._decode_param(sneaky, {})
+
+
+def test_checkpoint_swap_crash_windows(rng, tmp_path):
+    """A preemption between the checkpoint swap's renames leaves the save
+    at <dir>.tmp (complete) and the previous one at <dir>.old; load
+    recovers from either, preferring .tmp (workflow._atomic_checkpoint /
+    model_io._recover_checkpoint)."""
+    import os
+    import shutil
+
+    from transmogrifai_tpu.workflow import WorkflowModel
+
+    n = 60
+    store = ColumnStore({
+        "x": column_from_values(ft.Real, list(rng.normal(size=n))),
+    })
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    model = (Workflow().set_input_store(store)
+             .set_result_features(fx).train())
+    ckpt = str(tmp_path / "ckpt")
+    model.save(ckpt)
+
+    # window: dir renamed away, tmp not yet renamed in
+    shutil.copytree(ckpt, ckpt + ".tmp")
+    os.rename(ckpt, ckpt + ".old")
+    loaded = WorkflowModel.load(ckpt)
+    assert loaded.result_features[0].name == "x"
+    assert os.path.exists(ckpt)           # recovered sibling renamed in
+
+    # window: only .old remains (torn .tmp was discarded by next cycle).
+    # The .old leftover from the first recovery is cleared by the next
+    # checkpoint cycle; do the same here.
+    shutil.rmtree(ckpt + ".old")
+    os.rename(ckpt, ckpt + ".old")
+    loaded = WorkflowModel.load(ckpt)
+    assert loaded.result_features[0].name == "x"

@@ -22,13 +22,7 @@ __all__ = ["features_to_json", "features_from_json"]
 def features_to_json(result_features: Sequence[Feature]) -> Dict[str, Any]:
     arrays: Dict[str, np.ndarray] = {}
     feats = model_io._topo_features(result_features)
-    recorded = set()
-    stage_records: List[Dict[str, Any]] = []
-    for f in feats:
-        st = f.origin_stage
-        if st is not None and st.uid not in recorded:
-            recorded.add(st.uid)
-            stage_records.append(model_io._stage_record(st, arrays))
+    stage_records = model_io.collect_stage_records(feats, arrays)
     return {
         "features": [model_io._feature_record(f) for f in feats],
         "resultFeatureUids": [f.uid for f in result_features],

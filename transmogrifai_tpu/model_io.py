@@ -100,6 +100,15 @@ def _decode_param(v: Any, arrays: Dict[str, np.ndarray]) -> Any:
         if "__obj__" in v:
             import importlib
             mod_name, _, qual = v["__obj__"].partition(":")
+            # module allowlist BEFORE import: importing runs a module's
+            # top-level code, so an arbitrary module path in tampered
+            # JSON must be rejected here, not after (every codec base
+            # lives inside this package)
+            pkg = __name__.partition(".")[0]
+            if mod_name != pkg and not mod_name.startswith(pkg + "."):
+                raise ValueError(
+                    f"Refusing to import {mod_name!r} from serialized "
+                    f"data: only {pkg} modules may be referenced")
             obj = importlib.import_module(mod_name)
             for part in qual.split("."):
                 obj = getattr(obj, part)
@@ -168,6 +177,27 @@ def _topo_features(result_features) -> List[Feature]:
     return order
 
 
+def collect_stage_records(features: List[Feature],
+                          arrays: Dict[str, np.ndarray],
+                          fitted_lookup: Optional[Dict[str, Any]] = None
+                          ) -> List[Dict[str, Any]]:
+    """One stage record per distinct origin stage of ``features`` (topo
+    order, deduped by uid), substituting fitted models when a lookup is
+    given. Shared by the model writer and the feature-graph JSON codec so
+    the two serializations cannot drift."""
+    records: List[Dict[str, Any]] = []
+    recorded = set()
+    for f in features:
+        st = f.origin_stage
+        if st is None or st.uid in recorded:
+            continue
+        recorded.add(st.uid)
+        if fitted_lookup is not None:
+            st = fitted_lookup.get(st.uid, st)
+        records.append(_stage_record(st, arrays))
+    return records
+
+
 def save_workflow_model(model, path: str, overwrite: bool = False) -> None:
     if os.path.exists(os.path.join(path, MODEL_JSON)) and not overwrite:
         raise FileExistsError(f"Model already exists at {path}")
@@ -175,15 +205,8 @@ def save_workflow_model(model, path: str, overwrite: bool = False) -> None:
     arrays: Dict[str, np.ndarray] = {}
 
     features = _topo_features(model.result_features)
-    stage_records: List[Dict[str, Any]] = []
-    recorded = set()
-    for f in features:
-        st = f.origin_stage
-        if st is None or st.uid in recorded:
-            continue
-        recorded.add(st.uid)
-        fitted = model.fitted_stages.get(st.uid, st)
-        stage_records.append(_stage_record(fitted, arrays))
+    stage_records = collect_stage_records(
+        features, arrays, fitted_lookup=model.fitted_stages)
 
     from .utils.version import version_info
     doc = {
@@ -256,9 +279,30 @@ def rebuild_features(records, stage_by_uid: Dict[str, OpPipelineStage]
     return feat_by_uid
 
 
+def _recover_checkpoint(path: str) -> str:
+    """Resolve a checkpoint dir that a preemption left mid-swap.
+
+    ``workflow._atomic_checkpoint`` renames ``<path>.tmp`` (a complete
+    save) over ``<path>``, parking the previous good save at
+    ``<path>.old``. If the process died between the renames, the target
+    dir is missing but one of the siblings is loadable — prefer ``.tmp``
+    (newer; it is fully written before any rename starts) and fall back
+    to ``.old``. The chosen sibling is renamed into place so the next
+    checkpoint cycle starts clean."""
+    if os.path.exists(os.path.join(path, MODEL_JSON)):
+        return path
+    for sibling in (f"{path}.tmp", f"{path}.old"):
+        if os.path.exists(os.path.join(sibling, MODEL_JSON)):
+            if not os.path.exists(path):
+                os.rename(sibling, path)
+            return path
+    return path
+
+
 def load_workflow_model(path: str):
     from .workflow import WorkflowModel
 
+    path = _recover_checkpoint(path)
     with open(os.path.join(path, MODEL_JSON)) as fh:
         doc = json.load(fh)
     npz_path = os.path.join(path, WEIGHTS_NPZ)

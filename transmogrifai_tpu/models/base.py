@@ -191,6 +191,11 @@ class ModelFamily:
                     (kk, repr(vv)) for kk, vv in v.items()))))
             else:
                 items.append((k, repr(v)))
+        # trace-time environment toggles that change the emitted program
+        # (the Pallas histogram gate) must key the executable cache too,
+        # or flipping them mid-process silently reuses the old path
+        from ._pallas_hist import pallas_histograms_enabled
+        items.append(("__pallas__", pallas_histograms_enabled()))
         return (type(self).__module__, type(self).__name__, tuple(items))
 
     def clone_single(self, hparams: Dict[str, Any]) -> "ModelFamily":

@@ -18,7 +18,6 @@ The fitted model drops flagged vector slots and re-indexes the metadata.
 """
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -46,77 +45,13 @@ MAX_RULE_CONFIDENCE = 1.0
 MIN_REQUIRED_RULE_SUPPORT = 1.0
 
 
-@functools.partial(jax.jit, static_argnames=("label_corr_only",))
-def _moments_kernel(X, y, label_corr_only: bool):
-    """One fused pass: means, variances, label correlation (+ full corr)."""
-    n = X.shape[0]
-    Z = jnp.concatenate([X, y[:, None]], axis=1)
-    mean = Z.mean(axis=0)
-    Zc = Z - mean
-    cov = Zc.T @ Zc / jnp.maximum(n - 1, 1)
-    var = jnp.diagonal(cov)
-    std = jnp.sqrt(jnp.maximum(var, 0.0))
-    denom = jnp.maximum(jnp.outer(std, std), 1e-30)
-    if label_corr_only:
-        corr_label = cov[:-1, -1] / denom[:-1, -1]
-        corr = None
-    else:
-        corr = cov / denom
-        corr_label = corr[:-1, -1]
-    zmin = Z.min(axis=0)
-    zmax = Z.max(axis=0)
-    return mean, var, corr_label, corr, zmin, zmax
-
-
-@jax.jit
-def _contingency_kernel(Y_onehot, Xg):
-    """Contingency counts: [n_classes, n_categories]."""
-    return Y_onehot.T @ Xg
-
-
-def _cramers_v(cont: np.ndarray) -> Tuple[float, np.ndarray, np.ndarray]:
-    """Cramér's V (bias-uncorrected, MLlib chi2 semantics) + per-category
-    support and max rule confidence (OpStatistics.scala:71-346)."""
-    total = cont.sum()
-    if total <= 0:
-        return 0.0, np.zeros(cont.shape[1]), np.zeros(cont.shape[1])
-    row = cont.sum(axis=1, keepdims=True)
-    col = cont.sum(axis=0, keepdims=True)
-    expected = row @ col / total
-    with np.errstate(divide="ignore", invalid="ignore"):
-        chi2 = np.where(expected > 0, (cont - expected) ** 2 / expected, 0.0).sum()
-    r, c = cont.shape
-    dof_dim = min(r - 1, c - 1)
-    v = float(np.sqrt(chi2 / (total * dof_dim))) if dof_dim > 0 else 0.0
-    support = (col / total).ravel()
-    with np.errstate(divide="ignore", invalid="ignore"):
-        confidence = np.where(col > 0, cont.max(axis=0) / col.ravel(), 0.0).ravel()
-    return v, support, confidence
-
-
-def _pmi_mi(cont: np.ndarray) -> Tuple[np.ndarray, float]:
-    """Pointwise mutual information per (class, category) cell and total
-    mutual information, log base 2 (OpStatistics.contingencyStats :300)."""
-    total = cont.sum()
-    if total <= 0:
-        return np.zeros_like(cont), 0.0
-    p = cont / total
-    pr = p.sum(axis=1, keepdims=True)
-    pc = p.sum(axis=0, keepdims=True)
-    with np.errstate(divide="ignore", invalid="ignore"):
-        pmi = np.where(p > 0, np.log2(p / np.maximum(pr @ pc, 1e-300)), 0.0)
-    mi = float((p * pmi).sum())
-    return pmi, mi
-
-
-def _average_ranks(v: np.ndarray) -> np.ndarray:
-    """Average ranks with ties (scipy.stats.rankdata 'average' semantics,
-    what MLlib's Spearman uses) — one unique pass per column."""
-    _uniq, inv, counts = np.unique(v, return_inverse=True,
-                                   return_counts=True)
-    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
-    avg = starts + (counts - 1) / 2.0 + 1.0     # 1-based average rank
-    return avg[inv]
+# statistics kernels live in utils.stats (the OpStatistics analog);
+# aliased here for the fit path below
+from ..utils.stats import (average_ranks as _average_ranks,
+                           contingency as _contingency_kernel,
+                           cramers_v_stats as _cramers_v,
+                           moments as _moments_kernel,
+                           pmi_mutual_info as _pmi_mi)
 
 
 class SanityCheckerSummary:

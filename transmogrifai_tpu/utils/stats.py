@@ -1,0 +1,125 @@
+"""Statistics utilities — the OpStatistics analog.
+
+Parity: ``utils/src/main/scala/com/salesforce/op/utils/stats/OpStatistics.scala``
+(:71-346): contingency statistics (Cramér's V, pointwise mutual
+information, mutual information, association-rule support/confidence) and
+streaming label correlations, re-designed as fused XLA reductions over
+columnar arrays. The SanityChecker composes these (as the reference's does
+``OpStatistics.contingencyStats``); they are exported here as standalone
+utilities so user code can run the same statistics outside a workflow.
+
+Device kernels (``moments``, ``contingency``) are jitted; the small
+contingency-table post-processing is plain numpy on host (tables are
+[n_classes, n_categories] — tiny).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["moments", "contingency", "cramers_v_stats", "pmi_mutual_info",
+           "average_ranks", "spearman_with_label"]
+
+
+@functools.partial(jax.jit, static_argnames=("label_corr_only",))
+def moments(X, y, label_corr_only: bool = False):
+    """One fused pass over [n, d] features + [n] label: means, variances,
+    per-column label correlation, optional full correlation matrix, and
+    column min/max — ``Statistics.colStats`` + ``corr`` in one program
+    (SanityChecker.scala:575,634-638)."""
+    n = X.shape[0]
+    Z = jnp.concatenate([X, y[:, None]], axis=1)
+    mean = Z.mean(axis=0)
+    Zc = Z - mean
+    cov = Zc.T @ Zc / jnp.maximum(n - 1, 1)
+    var = jnp.diagonal(cov)
+    std = jnp.sqrt(jnp.maximum(var, 0.0))
+    denom = jnp.maximum(jnp.outer(std, std), 1e-30)
+    if label_corr_only:
+        corr_label = cov[:-1, -1] / denom[:-1, -1]
+        corr = None
+    else:
+        corr = cov / denom
+        corr_label = corr[:-1, -1]
+    zmin = Z.min(axis=0)
+    zmax = Z.max(axis=0)
+    return mean, var, corr_label, corr, zmin, zmax
+
+
+@jax.jit
+def contingency(Y_onehot, Xg):
+    """Contingency counts [n_classes, n_categories] as one matmul — the
+    reference's per-key ``reduceByKey`` sweep (SanityChecker.scala:420-516)
+    collapsed onto the MXU."""
+    return Y_onehot.T @ Xg
+
+
+def cramers_v_stats(cont: np.ndarray
+                    ) -> Tuple[float, np.ndarray, np.ndarray]:
+    """Cramér's V (bias-uncorrected, MLlib chi2 semantics) + per-category
+    support and max association-rule confidence
+    (OpStatistics.scala:71-346)."""
+    total = cont.sum()
+    if total <= 0:
+        return 0.0, np.zeros(cont.shape[1]), np.zeros(cont.shape[1])
+    row = cont.sum(axis=1, keepdims=True)
+    col = cont.sum(axis=0, keepdims=True)
+    expected = row @ col / total
+    with np.errstate(divide="ignore", invalid="ignore"):
+        chi2 = np.where(expected > 0,
+                        (cont - expected) ** 2 / expected, 0.0).sum()
+    r, c = cont.shape
+    dof_dim = min(r - 1, c - 1)
+    v = float(np.sqrt(chi2 / (total * dof_dim))) if dof_dim > 0 else 0.0
+    support = (col / total).ravel()
+    with np.errstate(divide="ignore", invalid="ignore"):
+        confidence = np.where(col > 0,
+                              cont.max(axis=0) / col.ravel(), 0.0).ravel()
+    return v, support, confidence
+
+
+def pmi_mutual_info(cont: np.ndarray) -> Tuple[np.ndarray, float]:
+    """Pointwise mutual information per (class, category) cell and total
+    mutual information, log base 2 (OpStatistics.contingencyStats :300)."""
+    total = cont.sum()
+    if total <= 0:
+        return np.zeros_like(cont), 0.0
+    p = cont / total
+    pr = p.sum(axis=1, keepdims=True)
+    pc = p.sum(axis=0, keepdims=True)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        pmi = np.where(p > 0, np.log2(p / np.maximum(pr @ pc, 1e-300)), 0.0)
+    mi = float((p * pmi).sum())
+    return pmi, mi
+
+
+def average_ranks(v: np.ndarray) -> np.ndarray:
+    """Average ranks with ties (scipy.stats.rankdata 'average' semantics,
+    what MLlib's Spearman uses) — one unique pass per column."""
+    _uniq, inv, counts = np.unique(v, return_inverse=True,
+                                   return_counts=True)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    avg = starts + (counts - 1) / 2.0 + 1.0     # 1-based average rank
+    return avg[inv]
+
+
+def spearman_with_label(X: np.ndarray, y: np.ndarray,
+                        label_corr_only: bool = True,
+                        device: Optional[bool] = None):
+    """Spearman rank correlation of each column with the label: ranks are
+    built per column on host (ties averaged), then the Pearson moments of
+    the ranks run on device (``Statistics.corr(..., "spearman")``
+    semantics, SanityChecker.scala:634-638)."""
+    Xr = np.column_stack([average_ranks(np.asarray(X[:, j]))
+                          for j in range(X.shape[1])]) \
+        if X.size else np.asarray(X, dtype=np.float64)
+    yr = average_ranks(np.asarray(y))
+    dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    _mean, _var, corr_label, corr, _zmin, _zmax = moments(
+        jnp.asarray(Xr, dtype), jnp.asarray(yr, dtype),
+        label_corr_only=label_corr_only)
+    return corr_label, corr

@@ -110,13 +110,27 @@ def device_roundtrip_mbps() -> float:
 
 def _atomic_checkpoint(model: "WorkflowModel", directory: str) -> None:
     """Write a checkpoint crash-consistently: save into a sibling temp dir
-    and swap it in (rename), so a preemption mid-save leaves either the
-    old or the new checkpoint, never a torn one."""
+    and swap it in (rename). A preemption at any point leaves a loadable
+    checkpoint: mid-save the target dir is untouched; between the two
+    renames the COMPLETE new save sits at ``<dir>.tmp`` and the previous
+    good one at ``<dir>.old`` — ``model_io.load_workflow_model`` recovers
+    from both (preferring ``.tmp``, which is always fully written before
+    any rename starts). Names are pid-free so a resumed process cleans up
+    a crashed predecessor's leftovers instead of leaking full-size copies
+    (concurrent writers to one checkpoint dir are not supported)."""
     import shutil
-    tmp = f"{directory}.tmp.{os.getpid()}"
-    old = f"{directory}.old.{os.getpid()}"
+
+    from .model_io import _recover_checkpoint
+    tmp = f"{directory}.tmp"
+    old = f"{directory}.old"
+    # adopt a predecessor's mid-swap save first (a complete .tmp/.old with
+    # the target dir missing) so the cleanup below only ever deletes a
+    # torn .tmp or a superseded .old — never the sole loadable save
+    _recover_checkpoint(directory)
     shutil.rmtree(tmp, ignore_errors=True)
     model.save(tmp, overwrite=True)
+    # the new save is complete on disk; stale .old is now safe to drop
+    # (and must be, for the rename below to succeed)
     shutil.rmtree(old, ignore_errors=True)
     if os.path.exists(directory):
         os.rename(directory, old)
@@ -366,6 +380,7 @@ class Workflow:
         fitted = {} if fitted is None else fitted
         for layer in dag:
             models: List[Transformer] = []
+            n_fitted_before = len(fitted)
             for stage in layer:
                 metrics = self._stage_metrics.setdefault(
                     stage.uid, {"stageName": stage.stage_name()})
@@ -405,10 +420,12 @@ class Workflow:
                 self._stage_metrics.setdefault(
                     m.uid, {"stageName": m.stage_name()})[
                     "layerTransformSeconds"] = round(layer_transform_s, 4)
-            if checkpoint and self._checkpoint_dir:
+            if checkpoint and self._checkpoint_dir \
+                    and len(fitted) > n_fitted_before:
                 # the ACTIVE graph (post-RawFeatureFilter pruning), written
                 # crash-consistently: a preemption mid-save must not
-                # destroy the previous good checkpoint
+                # destroy the previous good checkpoint. Transformer-only
+                # layers add no fitted state, so they skip the write.
                 feats = getattr(self, "_active_result_features",
                                 self.result_features)
                 if feats:
