@@ -140,3 +140,48 @@ def test_dsl_breadth(rng):
     assert np.asarray(out[both.name].values).shape[0] == n
     p = np.asarray(out[pct.name].values)
     assert p.min() >= 0.0 and p.max() <= 99.0
+
+
+def test_filter_map_keys_and_extract_key():
+    """Map DSL: .filter_keys / .extract_key (RichMapFeature filter + the
+    per-key access path)."""
+    import transmogrifai_tpu.dsl  # noqa: F401 — attaches the methods
+
+    m = FeatureBuilder.RealMap("m").from_column().as_predictor()
+    store = ColumnStore.from_dict({
+        "m": (ft.RealMap, [{"a": 1.0, "b": 2.0, "c": 3.0},
+                           {"b": 5.0}, {}])})
+
+    kept = m.filter_keys(block=["c"])
+    out = kept.origin_stage.transform_columns(store)
+    assert set(out.children.keys()) == {"a", "b"}
+    assert out.ftype is ft.RealMap
+
+    allowed = m.filter_keys(allow=["a"])
+    out2 = allowed.origin_stage.transform_columns(store)
+    assert set(out2.children.keys()) == {"a"}
+
+    b = m.extract_key("b")
+    assert b.ftype is ft.Real
+    col = b.origin_stage.transform_columns(store)
+    np.testing.assert_allclose(col.values[col.mask], [2.0, 5.0])
+    # missing key -> all-null column of the element type
+    missing = m.extract_key("zz").origin_stage.transform_columns(store)
+    assert not missing.mask.any()
+
+
+def test_extract_key_through_workflow(rng):
+    """extract_key output feeds the normal scalar pipeline end-to-end."""
+    import transmogrifai_tpu.dsl as dsl
+
+    n = 40
+    vals = rng.normal(size=n)
+    m = FeatureBuilder.RealMap("m").from_column().as_predictor()
+    rows = [{"x": float(v)} if i % 5 else {} for i, v in enumerate(vals)]
+    store = ColumnStore.from_dict({"m": (ft.RealMap, rows)})
+    filled = m.extract_key("x").fill_missing_with_mean()
+    model = (Workflow().set_input_store(store)
+             .set_result_features(filled).train())
+    out = model.score(store)[filled.name]
+    assert out.mask.all() or not np.isnan(
+        np.asarray(out.values, dtype=float)).any()

@@ -1,0 +1,19 @@
+"""parallel.multihost — env-driven initialization logic (single-process
+semantics; real multi-process joins are exercised on pods, not in CI)."""
+import jax
+
+from transmogrifai_tpu.parallel import multihost
+
+
+def test_single_host_is_noop(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+    assert multihost.initialize() is False
+    assert multihost.is_distributed() is False
+
+
+def test_process_summary_shape():
+    s = multihost.process_summary()
+    assert s["process_count"] == 1
+    assert s["local_devices"] == s["global_devices"] == len(jax.devices())
+    assert s["process_id"] == 0

@@ -217,6 +217,24 @@ def _cases():
         return stage, feats, store
     cases["MapVectorizer"] = map_case
 
+    def filter_keys_case():
+        from transmogrifai_tpu.ops.maps import FilterMapKeys
+        stage = FilterMapKeys(block=["k1"])
+        feats = [_f("a", ft.RealMap)]
+        store = ColumnStore({"a": RandomData.real_maps()
+                             .column(ft.RealMap, N)})
+        return stage, feats, store
+    cases["FilterMapKeys"] = filter_keys_case
+
+    def extract_key_case():
+        from transmogrifai_tpu.ops.maps import ExtractMapKey
+        stage = ExtractMapKey(key="k1")
+        feats = [_f("a", ft.RealMap)]
+        store = ColumnStore({"a": RandomData.real_maps()
+                             .column(ft.RealMap, N)})
+        return stage, feats, store
+    cases["ExtractMapKey"] = extract_key_case
+
     def bucketizer_case():
         stage = NumericBucketizer(splits=[-1.0, 0.0, 1.0],
                                   track_invalid=True)

@@ -397,6 +397,16 @@ def _deindexed(self: Feature, prediction: Feature, **kw):
     return self.transform_with(PredictionDeIndexer(**kw), prediction)
 
 
+def _filter_keys(self: Feature, allow=None, block=(), **kw):
+    from .ops.maps import FilterMapKeys
+    return self.transform_with(FilterMapKeys(allow=allow, block=block, **kw))
+
+
+def _extract_key(self: Feature, key: str, **kw):
+    from .ops.maps import ExtractMapKey
+    return self.transform_with(ExtractMapKey(key=key, **kw))
+
+
 def _sanity_check(self: Feature, features: Feature,
                   remove_bad_features: bool = True, **kw):
     from .ops.sanity_checker import SanityChecker
@@ -436,5 +446,7 @@ Feature.combine = _combine
 Feature.to_percentile = _to_percentile
 Feature.lda = _lda
 Feature.word2vec = _word2vec
+Feature.filter_keys = _filter_keys
+Feature.extract_key = _extract_key
 
 transmogrify = _vectorize_collection

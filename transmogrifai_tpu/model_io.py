@@ -222,9 +222,15 @@ def save_workflow_model(model, path: str, overwrite: bool = False) -> None:
         "rawFeatureFilterResults": (model.rff_results.to_json()
                                     if model.rff_results is not None else None),
     }
-    with open(os.path.join(path, MODEL_JSON), "w") as fh:
-        json.dump(doc, fh, indent=1, default=str)
+    # weights first, then model.json via tmp-file + atomic replace:
+    # MODEL_JSON's existence is the save's completeness marker (the
+    # checkpoint recovery in _recover_checkpoint relies on it), so it must
+    # appear only after every other artifact is fully on disk
     np.savez(os.path.join(path, WEIGHTS_NPZ), **arrays)
+    json_tmp = os.path.join(path, MODEL_JSON + ".tmp")
+    with open(json_tmp, "w") as fh:
+        json.dump(doc, fh, indent=1, default=str)
+    os.replace(json_tmp, os.path.join(path, MODEL_JSON))
 
 
 def rebuild_stages(records, arrays: Dict[str, np.ndarray]
@@ -288,7 +294,10 @@ def _recover_checkpoint(path: str) -> str:
     dir is missing but one of the siblings is loadable — prefer ``.tmp``
     (newer; it is fully written before any rename starts) and fall back
     to ``.old``. The chosen sibling is renamed into place so the next
-    checkpoint cycle starts clean."""
+    checkpoint cycle starts clean. MODEL_JSON doubles as the completeness
+    marker: ``save_workflow_model`` writes it last (atomic replace, after
+    weights), so a crash mid-save leaves no MODEL_JSON in ``.tmp`` and
+    the torn sibling is correctly ignored."""
     if os.path.exists(os.path.join(path, MODEL_JSON)):
         return path
     for sibling in (f"{path}.tmp", f"{path}.old"):

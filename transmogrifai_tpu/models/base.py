@@ -191,12 +191,16 @@ class ModelFamily:
                     (kk, repr(vv)) for kk, vv in v.items()))))
             else:
                 items.append((k, repr(v)))
-        # trace-time environment toggles that change the emitted program
-        # (the Pallas histogram gate) must key the executable cache too,
-        # or flipping them mid-process silently reuses the old path
-        from ._pallas_hist import pallas_histograms_enabled
-        items.append(("__pallas__", pallas_histograms_enabled()))
+        items.extend(self._trace_extras())
         return (type(self).__module__, type(self).__name__, tuple(items))
+
+    def _trace_extras(self) -> Tuple:
+        """Extra (key, value) pairs for trace_signature — trace-time
+        environment toggles that change the family's emitted program must
+        key the executable cache too, or flipping them mid-process
+        silently reuses the old compiled path. Base families have none;
+        tree families key the Pallas histogram gate."""
+        return ()
 
     def clone_single(self, hparams: Dict[str, Any]) -> "ModelFamily":
         """Same family configured with a one-point grid (final refit).

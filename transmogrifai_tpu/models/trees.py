@@ -151,6 +151,12 @@ class _TreeFamilyBase(ModelFamily):
     #: keys whose stacked values are traced & vmapped
     traced_keys: List[str] = []
 
+    def _trace_extras(self):
+        # the Pallas histogram gate changes the tree engine's emitted
+        # program, so it must key this family's executable cache entries
+        from ._pallas_hist import pallas_histograms_enabled
+        return (("__pallas__", pallas_histograms_enabled()),)
+
     def _fit_single(self, X, y, w, depth: int, n_trees: int,
                     traced: Dict[str, Any]) -> Dict[str, Any]:
         raise NotImplementedError

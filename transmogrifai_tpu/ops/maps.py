@@ -23,7 +23,8 @@ from ..columns import (Column, ColumnStore, GeoColumn, MapColumn,
                        NumericColumn, TextColumn, TextSetColumn,
                        column_of_empty)
 from ..features import Feature
-from ..stages.base import VarArity, register_stage
+from ..stages.base import (FixedArity, Transformer, VarArity,
+                           register_stage)
 from ..types import feature_types as ft
 from ..vector_metadata import VectorColumnMetadata, VectorMetadata
 from .dates import DateToUnitCircleVectorizer
@@ -33,7 +34,8 @@ from .onehot import OneHotModel, _sorted_topk
 from .vectorizer_base import (TransmogrifierDefaults, VectorizerEstimator,
                               VectorizerModel)
 
-__all__ = ["MapVectorizer", "MapVectorizerModel", "vectorize_maps"]
+__all__ = ["MapVectorizer", "MapVectorizerModel", "vectorize_maps",
+           "FilterMapKeys", "ExtractMapKey"]
 
 
 def _exploded_name(feature: str, key: str) -> str:
@@ -226,3 +228,81 @@ def vectorize_maps(features: Sequence[Feature],
                               track_nulls=defaults.TRACK_NULLS)
         out.append(feats[0].transform_with(stage, *feats[1:]))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Map-feature DSL transformers (RichMapFeature analogs)
+# ---------------------------------------------------------------------------
+
+@register_stage
+class FilterMapKeys(Transformer):
+    """Map → same map with keys filtered by allow/block lists
+    (RichMapFeature ``filter`` with whiteList/blackList keys,
+    ``core/.../dsl/RichMapFeature.scala``)."""
+
+    operation_name = "filterMapKeys"
+
+    def __init__(self, allow: Optional[Sequence[str]] = None,
+                 block: Sequence[str] = (), uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.allow = list(allow) if allow is not None else None
+        self.block = list(block)
+        self.output_type = ft.FeatureType    # refined in get_output
+
+    @property
+    def input_spec(self):
+        return FixedArity(ft.OPMap)
+
+    def get_output(self) -> Feature:
+        if self._output_feature is None:
+            f = self.input_features[0]
+            self._output_feature = Feature(
+                name=self.make_output_name(), ftype=f.ftype,
+                is_response=f.is_response, origin_stage=self,
+                parents=self.input_features)
+        return self._output_feature
+
+    def _keep(self, key: str) -> bool:
+        if self.allow is not None and key not in self.allow:
+            return False
+        return key not in self.block
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        col = store[self.input_features[0].name]
+        assert isinstance(col, MapColumn)
+        children = {k: c for k, c in col.children.items() if self._keep(k)}
+        return MapColumn(col.ftype, children, len(col))
+
+
+@register_stage
+class ExtractMapKey(Transformer):
+    """Map → the element-typed column of one key (missing key → all-null;
+    the per-key access every map vectorizer/pivot builds on — exposed as a
+    standalone DSL stage so users can route single map entries into any
+    scalar pipeline)."""
+
+    operation_name = "extractMapKey"
+
+    def __init__(self, key: str = "", uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.key = key
+        self.output_type = ft.FeatureType    # refined in get_output
+
+    @property
+    def input_spec(self):
+        return FixedArity(ft.OPMap)
+
+    def get_output(self) -> Feature:
+        if self._output_feature is None:
+            f = self.input_features[0]
+            self._output_feature = Feature(
+                name=self.make_output_name(),
+                ftype=f.ftype.element_type,
+                is_response=f.is_response, origin_stage=self,
+                parents=self.input_features)
+        return self._output_feature
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        col = store[self.input_features[0].name]
+        assert isinstance(col, MapColumn)
+        return _child_or_empty(col, self.key, col.ftype.element_type)

@@ -47,11 +47,11 @@ MIN_REQUIRED_RULE_SUPPORT = 1.0
 
 # statistics kernels live in utils.stats (the OpStatistics analog);
 # aliased here for the fit path below
-from ..utils.stats import (average_ranks as _average_ranks,
-                           contingency as _contingency_kernel,
+from ..utils.stats import (contingency as _contingency_kernel,
                            cramers_v_stats as _cramers_v,
                            moments as _moments_kernel,
-                           pmi_mutual_info as _pmi_mi)
+                           pmi_mutual_info as _pmi_mi,
+                           spearman_with_label as _spearman_with_label)
 
 
 class SanityCheckerSummary:
@@ -190,11 +190,7 @@ class SanityChecker(Estimator, AllowLabelAsInput):
         # is real money on wide hashed-text vectors.
         spearman_dev = None
         if self.correlation_type == "spearman":
-            R = np.empty_like(X)
-            for j in range(d):
-                R[:, j] = _average_ranks(X[:, j])
-            spearman_dev = _moments_kernel(
-                jnp.asarray(R), jnp.asarray(_average_ranks(y)), True)
+            spearman_dev, _full = _spearman_with_label(X, y)
 
         groups: Dict[Tuple[str, str], List[int]] = {}
         if meta.size == d:
@@ -213,7 +209,7 @@ class SanityChecker(Estimator, AllowLabelAsInput):
 
         (mean, var, corr_label, corr, zmin, zmax), spearman_out, conts = \
             jax.device_get((moments_dev, spearman_dev, conts_dev))
-        spearman_label = spearman_out[2] if spearman_out is not None else None
+        spearman_label = spearman_out  # corr-with-label vector or None
 
         names = meta.column_names() if meta.size == d else \
             [f"{feat_name}_{i}" for i in range(d)]

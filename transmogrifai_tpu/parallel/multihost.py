@@ -1,0 +1,85 @@
+"""Multi-host initialization — DCN-scale runs (v5e pods / multi-slice).
+
+The reference scales out by adding Spark executors over the network; its
+communication backend is Spark's netty RPC + shuffle (SURVEY §2.10, §5).
+The TPU-native equivalent needs no custom backend at all: once every host
+process joins the same JAX runtime, the SAME ``Mesh``/``NamedSharding``
+program runs globally — XLA routes collectives over ICI within a slice
+and DCN across slices. This module is the (thin) piece that joins the
+processes, mirroring ``OpSparkListener``-era cluster bootstrap without a
+driver/executor split.
+
+Recipe (each host runs the identical program):
+
+    from transmogrifai_tpu.parallel import multihost, mesh
+    multihost.initialize()              # env-driven (TPU pods: automatic)
+    m = mesh.make_mesh()                # sees GLOBAL devices
+    ... Workflow(...).train() with mesh=m ...
+
+Axis placement for DCN efficiency: put ``data`` (row sharding — fit
+reductions are one psum of [d, d] gram / histogram partials, latency
+tolerant) across slices, and ``grid`` (the fold × hyperparameter batch,
+which communicates nothing until the final argmax) anywhere;
+``make_mesh`` already orders axes so data is outermost, which maps
+contiguous device blocks (slices) to data shards.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["initialize", "is_distributed", "process_summary"]
+
+_INITIALIZED = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids: Optional[list] = None) -> bool:
+    """Join this process to the global JAX runtime.
+
+    On Cloud TPU pods all arguments are discovered from the metadata/env
+    (``jax.distributed.initialize()`` with no args); elsewhere pass the
+    coordinator's ``host:port`` plus this process's rank and the world
+    size, or set ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID``. Returns True if a multi-process runtime was
+    initialized, False for the single-process (no-op) case. Idempotent.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    on_tpu_pod = os.environ.get("TPU_WORKER_HOSTNAMES") is not None
+    if coordinator_address is None and not on_tpu_pod:
+        return False                      # single host — nothing to join
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _INITIALIZED = True
+    return True
+
+
+def is_distributed() -> bool:
+    import jax
+    return jax.process_count() > 1
+
+
+def process_summary() -> dict:
+    """Per-process view for logs/metrics sinks (runner observability)."""
+    import jax
+    return {
+        "process_id": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

@@ -137,6 +137,8 @@ class OpWorkflowRunner:
                 model.save(params.model_location, overwrite=True)
             metrics = model.summary()
             metrics["appSeconds"] = round(time.time() - t0, 3)
+            from .parallel.multihost import process_summary
+            metrics["process"] = process_summary()
             self._write_metrics(params.metrics_location, metrics)
             return RunnerResult(run_type, metrics=metrics,
                                 model_location=params.model_location)

@@ -15,7 +15,7 @@ contingency-table post-processing is plain numpy on host (tables are
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -108,18 +108,20 @@ def average_ranks(v: np.ndarray) -> np.ndarray:
 
 
 def spearman_with_label(X: np.ndarray, y: np.ndarray,
-                        label_corr_only: bool = True,
-                        device: Optional[bool] = None):
+                        label_corr_only: bool = True):
     """Spearman rank correlation of each column with the label: ranks are
     built per column on host (ties averaged), then the Pearson moments of
     the ranks run on device (``Statistics.corr(..., "spearman")``
-    semantics, SanityChecker.scala:634-638)."""
-    Xr = np.column_stack([average_ranks(np.asarray(X[:, j]))
-                          for j in range(X.shape[1])]) \
-        if X.size else np.asarray(X, dtype=np.float64)
-    yr = average_ranks(np.asarray(y))
-    dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    semantics, SanityChecker.scala:634-638). Returns device arrays
+    (corr_label, corr) — fetch lazily/batched with ``jax.device_get``.
+    The SanityChecker's spearman gate routes through this function."""
+    Xn = np.asarray(X)
+    dtype = (Xn.dtype if np.issubdtype(Xn.dtype, np.floating)
+             else np.float64)
+    Xr = np.empty_like(Xn, dtype=dtype)
+    for j in range(Xn.shape[1]):
+        Xr[:, j] = average_ranks(Xn[:, j])
+    yr = average_ranks(np.asarray(y)).astype(dtype)
     _mean, _var, corr_label, corr, _zmin, _zmax = moments(
-        jnp.asarray(Xr, dtype), jnp.asarray(yr, dtype),
-        label_corr_only=label_corr_only)
+        jnp.asarray(Xr), jnp.asarray(yr), label_corr_only=label_corr_only)
     return corr_label, corr
