@@ -225,12 +225,17 @@ def save_workflow_model(model, path: str, overwrite: bool = False) -> None:
     # weights first, then model.json via tmp-file + atomic replace:
     # MODEL_JSON's existence is the save's completeness marker (the
     # checkpoint recovery in _recover_checkpoint relies on it), so it must
-    # appear only after every other artifact is fully on disk
+    # appear only after every other artifact is fully on disk — including
+    # on overwriting re-saves, where the STALE marker must come down
+    # before the non-atomic weights write begins
+    mj = os.path.join(path, MODEL_JSON)
+    if os.path.exists(mj):
+        os.remove(mj)
     np.savez(os.path.join(path, WEIGHTS_NPZ), **arrays)
-    json_tmp = os.path.join(path, MODEL_JSON + ".tmp")
+    json_tmp = mj + ".tmp"
     with open(json_tmp, "w") as fh:
         json.dump(doc, fh, indent=1, default=str)
-    os.replace(json_tmp, os.path.join(path, MODEL_JSON))
+    os.replace(json_tmp, mj)
 
 
 def rebuild_stages(records, arrays: Dict[str, np.ndarray]

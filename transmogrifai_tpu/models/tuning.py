@@ -327,6 +327,15 @@ def _auto_chunks(family, n_rows: int, n_shards: int, n_folds: int
     per_instance = rows * max(A, 64) * 4 * 3
     max_instances = max(int(CHUNK_MEM_BUDGET_BYTES // per_instance), 1)
     g = family.grid_size()
+    if getattr(family, "tree_chunk", 1) is None:
+        # auto: spend leftover budget batching bootstrap trees per scan
+        # step (fewer, larger device steps — RF/DT only; the attr is
+        # ignored by the sequential boosting fits). Stored in a shadow
+        # attr, recomputed every call like grid_chunk — mutating
+        # tree_chunk itself would pin the first dataset's choice on a
+        # reused family object.
+        family._tree_chunk_auto = int(np.clip(
+            max_instances // max(g * n_folds, 1), 1, 4))
     if max_instances >= g * n_folds:
         family.grid_chunk = None
         return None

@@ -28,7 +28,8 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-__all__ = ["initialize", "is_distributed", "process_summary"]
+__all__ = ["initialize", "is_distributed", "is_coordinator",
+           "process_summary"]
 
 _INITIALIZED = False
 
@@ -72,6 +73,17 @@ def initialize(coordinator_address: Optional[str] = None,
 def is_distributed() -> bool:
     import jax
     return jax.process_count() > 1
+
+
+def is_coordinator() -> bool:
+    """True on the single process that should perform shared-filesystem
+    writes (model save, metrics sink, checkpoints). Every host runs the
+    identical program and computes identical results (GSPMD), so exactly
+    one writer suffices — and the crash-consistent checkpoint swap
+    explicitly does not support concurrent writers. Always True
+    single-process."""
+    import jax
+    return jax.process_index() == 0
 
 
 def process_summary() -> dict:

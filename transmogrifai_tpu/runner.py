@@ -133,13 +133,16 @@ class OpWorkflowRunner:
             if self.training_reader is not None:
                 self.workflow.set_reader(self.training_reader)
             model = self.workflow.train()
-            if params.model_location:
+            # multi-host: every process computes the identical model;
+            # only the coordinator touches the shared filesystem
+            from .parallel.multihost import is_coordinator, process_summary
+            if params.model_location and is_coordinator():
                 model.save(params.model_location, overwrite=True)
             metrics = model.summary()
             metrics["appSeconds"] = round(time.time() - t0, 3)
-            from .parallel.multihost import process_summary
             metrics["process"] = process_summary()
-            self._write_metrics(params.metrics_location, metrics)
+            if is_coordinator():
+                self._write_metrics(params.metrics_location, metrics)
             return RunnerResult(run_type, metrics=metrics,
                                 model_location=params.model_location)
 

@@ -108,6 +108,13 @@ def device_roundtrip_mbps() -> float:
     return _DEVICE_BW_MBPS
 
 
+def _is_coordinator() -> bool:
+    """Shared-filesystem writes (checkpoints) happen on one process only
+    — multi-host runs compute identical state on every host."""
+    from .parallel.multihost import is_coordinator
+    return is_coordinator()
+
+
 def _atomic_checkpoint(model: "WorkflowModel", directory: str) -> None:
     """Write a checkpoint crash-consistently: save into a sibling temp dir
     and swap it in (rename). A preemption at any point leaves a loadable
@@ -421,7 +428,8 @@ class Workflow:
                     m.uid, {"stageName": m.stage_name()})[
                     "layerTransformSeconds"] = round(layer_transform_s, 4)
             if checkpoint and self._checkpoint_dir \
-                    and len(fitted) > n_fitted_before:
+                    and len(fitted) > n_fitted_before \
+                    and _is_coordinator():
                 # the ACTIVE graph (post-RawFeatureFilter pruning), written
                 # crash-consistently: a preemption mid-save must not
                 # destroy the previous good checkpoint. Transformer-only
