@@ -307,8 +307,17 @@ def _recover_checkpoint(path: str) -> str:
         return path
     for sibling in (f"{path}.tmp", f"{path}.old"):
         if os.path.exists(os.path.join(sibling, MODEL_JSON)):
+            from .parallel.multihost import is_coordinator
+            if not is_coordinator():
+                # multi-host: only the coordinator repairs the shared
+                # directory (single-writer invariant); other processes
+                # read straight from the complete sibling
+                return sibling
             if not os.path.exists(path):
-                os.rename(sibling, path)
+                try:
+                    os.rename(sibling, path)
+                except FileNotFoundError:
+                    continue   # lost a rename race; retry next candidate
             return path
     return path
 

@@ -155,20 +155,31 @@ def pallas_histograms_enabled() -> bool:
     if jax.default_backend() != "tpu":
         return False
     if _PROBE is None:
+        # The probe must run EAGERLY — pallas_call cannot execute under an
+        # enclosing trace (and ensure_compile_time_eval cannot evaluate
+        # program_id). The gate is consulted from host code first
+        # (ModelFamily._trace_extras during trace_signature), which caches
+        # the result; if a direct fit consults it mid-trace before any
+        # host-side call, fall back to XLA for that trace WITHOUT caching
+        # so a later eager call can still probe.
+        from jax._src import core as _core
+        detector = getattr(_core, "trace_state_clean", None)
+        if detector is not None and not detector():
+            return False
         try:
             import numpy as np
-            # The gate is consulted at trace time (inside jit tracing of the
-            # tree fit); ensure_compile_time_eval runs the probe eagerly so
-            # its arrays do not become tracers of the enclosing trace.
-            with jax.ensure_compile_time_eval():
-                out = cumhist(
-                    jnp.ones((16, 3), jnp.float32),
-                    jnp.zeros((16,), jnp.int32),
-                    jnp.zeros((16, 4), jnp.int32),
-                    2, 2, interpret=False)
-                ok = bool(np.asarray(out).shape == (2, 3, 2, 4))
-            _PROBE = ok
+            out = cumhist(
+                jnp.ones((16, 3), jnp.float32),
+                jnp.zeros((16,), jnp.int32),
+                jnp.zeros((16, 4), jnp.int32),
+                2, 2, interpret=False)
+            _PROBE = bool(np.asarray(out).shape == (2, 3, 2, 4))
         except Exception as e:  # Mosaic/backend failure → XLA path
+            if detector is None:
+                # can't tell an eager failure from a mid-trace one (the
+                # private trace-state API moved): fall back for THIS
+                # consult but leave the probe open for a later eager call
+                return False
             import warnings
             warnings.warn(
                 f"pallas histogram kernel unavailable ({e!r}); "

@@ -176,7 +176,11 @@ class _TreeFamilyBase(ModelFamily):
     def _stacked_col(self, stacked, key):
         if key in stacked:
             return stacked[key]          # may be a tracer (jit argument)
-        return np.full((self.grid_size(),), self.param_defaults()[key])
+        # default column sized to the PASSED grid batch — the CV engine
+        # may hand fit_batch a chunk of the grid, not the whole of it
+        gsize = (next(iter(stacked.values())).shape[0] if stacked
+                 else self.grid_size())
+        return np.full((gsize,), self.param_defaults()[key])
 
     def global_depth(self) -> int:
         return int(max(int(g.get("maxDepth",

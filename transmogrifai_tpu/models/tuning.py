@@ -308,23 +308,34 @@ _FUSED_EXE_CACHE: Dict[Any, Any] = {}
 CHUNK_MEM_BUDGET_BYTES = 6e9
 
 
-def _auto_chunks(family, n_rows: int, n_shards: int, n_folds: int
-                 ) -> Optional[int]:
-    """Bound the (fold × grid) product so tree-routing transients fit HBM.
+def _auto_chunks(family, n_rows: int, n_shards: int, n_folds: int,
+                 n_features: int = 0) -> Optional[int]:
+    """Bound the (fold × grid) product so tree-engine transients fit HBM.
 
-    Each in-flight tree-grid instance materializes ~3 × [rows_per_shard,
-    max_active_nodes] f32 routing tensors (one-hot slot matmul in
-    _treefit.grow_tree). At small n the whole (fold × grid) sweep runs as
-    one vmap (fastest); as n grows we first serialize folds (lax.map), then
-    grid points within a fold (family.grid_chunk). Non-tree families are
-    cheap — never chunked. Returns fold_chunk (None = no fold chunking);
-    sets family.grid_chunk as a side effect.
+    Each in-flight tree-grid instance materializes, per level,
+    ~3 × [rows_per_shard, max_active_nodes] f32 routing tensors (one-hot
+    slot matmul in _treefit.grow_tree) PLUS — on the XLA histogram path —
+    the bf16 matmul operands NS [rows, A·C] and Bc [rows, bins·F] that
+    _level_cumhist materializes in HBM (the Pallas kernel builds these in
+    VMEM, but the budget must cover the fallback: at 1M rows × F=20 these
+    operands alone are ~2 GB/instance and undercounting them crashed the
+    TPU worker). At small n the whole (fold × grid) sweep runs as one
+    vmap (fastest); as n grows we first serialize folds, then grid points
+    within a fold — the caller (validate's chunk_plan) turns both into
+    HOST-level chunk re-dispatches of one compiled executable. Non-tree
+    families are cheap — never chunked. Returns fold_chunk (None = no
+    fold chunking); sets family.grid_chunk as a side effect (consumed and
+    reset by chunk_plan).
     """
     A = getattr(family, "max_active_nodes", None)
     if not A:
         return None
     rows = n_rows / max(n_shards, 1)
-    per_instance = rows * max(A, 64) * 4 * 3
+    A = max(A, 64)
+    n_bins = getattr(family, "n_bins", 32)
+    C_est = max(getattr(family, "n_classes", 2) + 1, 4)
+    per_instance = rows * A * 4 * 3 \
+        + rows * (A * C_est + n_bins * max(n_features, 1)) * 2
     max_instances = max(int(CHUNK_MEM_BUDGET_BYTES // per_instance), 1)
     g = family.grid_size()
     if getattr(family, "tree_chunk", 1) is None:
@@ -406,7 +417,18 @@ class _ValidatorBase:
         # (family trace signature, metric, arg shapes): data, fold weights
         # and the stacked hyperparameter grid are jit ARGUMENTS, so repeat
         # sweeps skip tracing AND compilation entirely.
-        def make_fit_eval(family, metric_fn, fold_chunk=None):
+        #
+        # Memory-bounded chunking is HOST-level: when _auto_chunks bounds
+        # the in-flight (fold × grid) product, the fold/grid axes are cut
+        # into equal chunks and ONE executable (compiled for the chunk
+        # shape) is re-dispatched per chunk. An earlier design serialized
+        # chunks with lax.map INSIDE the program; that compiled a second,
+        # markedly slower program (a 1M-row RF fit ran ~4× slower under
+        # the map than standalone) and concentrated the whole sweep's
+        # transients into one device program, which crashed the TPU
+        # worker at 1M rows. Host chunk calls reuse the executable, queue
+        # async back-to-back, and bound peak memory to one chunk.
+        def make_fit_eval(family, metric_fn):
             def fit_eval(X, y, w_folds, v_folds, stacked):
                 def per_fold(w, v):
                     params = family.fit_batch(X, y, w, stacked)
@@ -415,11 +437,6 @@ class _ValidatorBase:
                     return jax.vmap(
                         lambda pg, prg: metric_fn(y, pg, prg, v)
                     )(pred, prob)
-                if fold_chunk and fold_chunk < w_folds.shape[0]:
-                    from jax import lax
-                    return lax.map(lambda wv: per_fold(*wv),
-                                   (w_folds, v_folds),
-                                   batch_size=int(fold_chunk))
                 return jax.vmap(per_fold)(w_folds, v_folds)
             return fit_eval
 
@@ -433,8 +450,49 @@ class _ValidatorBase:
         n_shards = (mesh.shape.get("data", 1) if mesh is not None else 1)
         k_folds = len(splits)
 
+        def chunk_plan(family):
+            """(fc, gc, wd_p, vwd_p, stacked_chunks): equal-size
+            fold/grid chunks — folds padded with zero-weight rows,
+            grid padded by repeating the last point (discarded on
+            assembly)."""
+            fold_chunk = _auto_chunks(family, len(y), n_shards, k_folds,
+                                      n_features=X.shape[1])
+            gc = getattr(family, "grid_chunk", None) or family.grid_size()
+            if hasattr(family, "grid_chunk"):
+                family.grid_chunk = None    # chunking happens here, not
+            fc = fold_chunk or k_folds      # in fit_batch's lax.map
+
+            def best_chunk(total, cmax):
+                # padded chunks waste whole fits (k=3 at chunk 2 pads a
+                # 4th zero-weight fold = +33% work); pick the chunk size
+                # ≤ cmax minimizing total padded work, preferring larger
+                # chunks (fewer dispatches) on ties
+                return min(range(1, min(cmax, total) + 1),
+                           key=lambda c: (-(-total // c) * c, -c))
+            fc = best_chunk(k_folds, fc)
+            gc = best_chunk(family.grid_size(), gc)
+            g = family.grid_size()
+            kpad = (-k_folds) % fc
+            wd_p, vwd_p = wd, vwd
+            if kpad:
+                zeros = jnp.zeros((kpad,) + tuple(wd.shape[1:]), wd.dtype)
+                wd_p = jnp.concatenate([wd, zeros])
+                vwd_p = jnp.concatenate([vwd, jnp.zeros(
+                    (kpad,) + tuple(vwd.shape[1:]), vwd.dtype)])
+            gpad = (-g) % gc
+            stacked = family.stack_grid()
+            if gpad:
+                stacked = {k2: np.concatenate(
+                    [v, np.repeat(v[-1:], gpad, axis=0)])
+                    for k2, v in stacked.items()}
+            chunks = []
+            for j0 in range(0, g + gpad, gc):
+                chunks.append({k2: jnp.asarray(v[j0:j0 + gc])
+                               for k2, v in stacked.items()})
+            return fc, gc, wd_p, vwd_p, chunks
+
         fused: Dict[int, Any] = {}
-        stacked_devs: Dict[int, Any] = {}
+        plans: Dict[int, Any] = {}
         to_compile = []
         for fi, family in enumerate(families):
             metric_fn = device_metric_fn(
@@ -442,28 +500,30 @@ class _ValidatorBase:
                 n_classes=getattr(family, "n_classes", 2))
             if metric_fn is None:
                 continue
-            fold_chunk = _auto_chunks(family, len(y), n_shards, k_folds)
-            stacked = {k2: jnp.asarray(v) for k2, v in
-                       family.stack_grid().items()}
-            stacked_devs[fi] = stacked
+            plan = chunk_plan(family)
+            plans[fi] = plan
+            fc, gc, wd_p, vwd_p, stacked_chunks = plan
             key = (family.trace_signature(), self.task, self.metric_name,
-                   mesh_key, fold_chunk,
-                   shapes_of((Xd, yd, wd, vwd, stacked)))
+                   mesh_key, ("chunk", fc, gc),
+                   shapes_of((Xd, yd, wd_p[:fc], vwd_p[:fc],
+                              stacked_chunks[0])))
             exe = _FUSED_EXE_CACHE.get(key)
             if exe is not None:
                 fused[fi] = exe
             else:
                 to_compile.append(
-                    (fi, key, jax.jit(make_fit_eval(family, metric_fn,
-                                                    fold_chunk))))
+                    (fi, key, jax.jit(make_fit_eval(family, metric_fn))))
 
         if to_compile:
             import concurrent.futures as cf
             with cf.ThreadPoolExecutor(len(to_compile)) as ex:
-                futs = [(fi, key, ex.submit(
-                    lambda jf=jf, st=stacked_devs[fi]:
-                    jf.lower(Xd, yd, wd, vwd, st).compile()))
-                    for fi, key, jf in to_compile]
+                futs = []
+                for fi, key, jf in to_compile:
+                    fc, gc, wd_p, vwd_p, stacked_chunks = plans[fi]
+                    futs.append((fi, key, ex.submit(
+                        lambda jf=jf, w=wd_p[:fc], v=vwd_p[:fc],
+                        st=stacked_chunks[0]:
+                        jf.lower(Xd, yd, w, v, st).compile())))
                 for fi, key, fut in futs:
                     exe = fut.result()
                     fused[fi] = exe
@@ -472,19 +532,37 @@ class _ValidatorBase:
                             next(iter(_FUSED_EXE_CACHE)))   # FIFO evict
                     _FUSED_EXE_CACHE[key] = exe
 
-        # dispatch every fused family program FIRST (async — the device
+        # dispatch every chunk of every family FIRST (async — the device
         # queues them back-to-back), then ONE batched metrics pull: per-
-        # family synchronous pulls would pay a full link round-trip each
+        # chunk synchronous pulls would pay a full link round-trip each
         # AND serialize device execution against host latency
-        fused_out = {fi: fused[fi](Xd, yd, wd, vwd, stacked_devs[fi])
-                     for fi in fused}
+        fused_out: Dict[int, Any] = {}
+        for fi in fused:
+            fc, gc, wd_p, vwd_p, stacked_chunks = plans[fi]
+            kp = wd_p.shape[0]
+            outs = []
+            for i0 in range(0, kp, fc):
+                for st in stacked_chunks:
+                    outs.append(fused[fi](Xd, yd, wd_p[i0:i0 + fc],
+                                          vwd_p[i0:i0 + fc], st))
+            fused_out[fi] = outs
         fused_np = jax.device_get(fused_out)
 
         for fi, family in enumerate(families):
             k, g = len(splits), family.grid_size()
 
             if fi in fused:
-                per_grid_metrics = np.asarray(fused_np[fi]).T   # [G, K]
+                fc, gc, wd_p, vwd_p, stacked_chunks = plans[fi]
+                kp = wd_p.shape[0]
+                gp = gc * len(stacked_chunks)
+                full = np.zeros((kp, gp))
+                ci = 0
+                for i0 in range(0, kp, fc):
+                    for cj, _st in enumerate(stacked_chunks):
+                        full[i0:i0 + fc, cj * gc:(cj + 1) * gc] = \
+                            np.asarray(fused_np[fi][ci])
+                        ci += 1
+                per_grid_metrics = full[:k, :g].T               # [G, K]
             else:
                 stacked = family.stack_grid()
                 def fit_all(w_folds):
@@ -595,7 +673,8 @@ class _ValidatorBase:
                             prob[gi][:len(y)][vm] if prob.ndim == 3
                             else prob[gi])
                     continue
-                fold_chunk = _auto_chunks(family, len(y), n_shards, 1)
+                fold_chunk = _auto_chunks(family, len(y), n_shards, 1,
+                                          n_features=X.shape[1])
                 key = (family.trace_signature(), self.task, self.metric_name,
                        mesh_key, fold_chunk, "per_fold",
                        tuple((tuple(a.shape), str(a.dtype)) for a in

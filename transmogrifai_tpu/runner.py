@@ -114,7 +114,9 @@ class OpWorkflowRunner:
     # -- metrics sink ------------------------------------------------------
     @staticmethod
     def _write_metrics(location: Optional[str], doc: Dict[str, Any]) -> None:
-        if not location:
+        # multi-host: every process computes identical metrics; one writer
+        from .parallel.multihost import is_coordinator
+        if not location or not is_coordinator():
             return
         os.makedirs(os.path.dirname(location) or ".", exist_ok=True)
         with open(location, "w") as fh:
@@ -227,28 +229,40 @@ class OpWorkflowRunner:
 
 class _CsvSink:
     """Incremental CSV sink (saveScores analog): header from the first
-    store, batches appended as they arrive."""
+    store, batches appended as they arrive. On non-coordinator processes
+    of a multi-host run the sink is a no-op — every host computes the
+    identical scores and one writer owns the shared file."""
 
     def __init__(self, path: str):
         import csv
+
+        from .parallel.multihost import is_coordinator
+        self._active = is_coordinator()
+        self._names = None
+        if not self._active:
+            self._fh = self._writer = None
+            return
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._fh = open(path, "w", newline="")
         self._writer = csv.writer(self._fh)
-        self._names = None
 
     def write_header(self, names) -> None:
         if self._names is None:
             self._names = list(names)
-            self._writer.writerow(self._names)
+            if self._active:
+                self._writer.writerow(self._names)
 
     def write(self, store) -> None:
         self.write_header(store.names())
+        if not self._active:
+            return
         for i in range(store.n_rows):
             self._writer.writerow([store[n].get_raw(i)
                                    for n in self._names])
 
     def close(self) -> None:
-        self._fh.close()
+        if self._fh is not None:
+            self._fh.close()
 
 
 def _write_store_csv(store, path: str) -> None:
