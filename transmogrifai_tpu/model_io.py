@@ -305,14 +305,20 @@ def _recover_checkpoint(path: str) -> str:
     the torn sibling is correctly ignored."""
     if os.path.exists(os.path.join(path, MODEL_JSON)):
         return path
+    from .parallel.multihost import is_coordinator
+    if not is_coordinator():
+        # multi-host: only the coordinator repairs the shared directory
+        # (single-writer invariant). Workers wait for the repaired target
+        # to appear — reading a sibling directly would race the
+        # coordinator's rename out from under the open() calls.
+        import time
+        for _ in range(60):
+            if os.path.exists(os.path.join(path, MODEL_JSON)):
+                return path
+            time.sleep(0.5)
+        return path
     for sibling in (f"{path}.tmp", f"{path}.old"):
         if os.path.exists(os.path.join(sibling, MODEL_JSON)):
-            from .parallel.multihost import is_coordinator
-            if not is_coordinator():
-                # multi-host: only the coordinator repairs the shared
-                # directory (single-writer invariant); other processes
-                # read straight from the complete sibling
-                return sibling
             if not os.path.exists(path):
                 try:
                     os.rename(sibling, path)

@@ -357,6 +357,26 @@ def _auto_chunks(family, n_rows: int, n_shards: int, n_folds: int,
     return 1
 
 
+def _best_chunk(total: int, cmax: int) -> int:
+    """Largest chunk size ≤ cmax that DIVIDES total: zero padded work
+    (a padded fold/grid slot costs a whole wasted fit at large n, which
+    dominates the ~100ms saved per avoided dispatch), fewest dispatches
+    among the zero-padding options. Prime totals over budget degrade to
+    1-wide chunks — more dispatches, never more compute."""
+    cmax = max(1, min(cmax, total))
+    return max(c for c in range(1, cmax + 1) if total % c == 0)
+
+
+def _grid_chunks(family, gc: int):
+    """Split the family's stacked hyperparameter grid into device-ready
+    chunks of gc points (gc divides grid_size; shared by validate and
+    validate_per_fold so the chunking logic cannot drift)."""
+    stacked = family.stack_grid()
+    g = family.grid_size()
+    return [{k2: jnp.asarray(v[j0:j0 + gc]) for k2, v in stacked.items()}
+            for j0 in range(0, g, gc)]
+
+
 class _ValidatorBase:
     """Shared fold-mask validation engine."""
 
@@ -451,45 +471,18 @@ class _ValidatorBase:
         k_folds = len(splits)
 
         def chunk_plan(family):
-            """(fc, gc, wd_p, vwd_p, stacked_chunks): equal-size
-            fold/grid chunks — folds padded with zero-weight rows,
-            grid padded by repeating the last point (discarded on
-            assembly)."""
+            """(fc, gc, stacked_chunks): fold/grid chunk sizes (divisors
+            of k_folds / grid_size — see _best_chunk) and the grid's
+            device-ready chunks."""
             fold_chunk = _auto_chunks(family, len(y), n_shards, k_folds,
                                       n_features=X.shape[1])
             gc = getattr(family, "grid_chunk", None) or family.grid_size()
             if hasattr(family, "grid_chunk"):
                 family.grid_chunk = None    # chunking happens here, not
             fc = fold_chunk or k_folds      # in fit_batch's lax.map
-
-            def best_chunk(total, cmax):
-                # padded chunks waste whole fits (k=3 at chunk 2 pads a
-                # 4th zero-weight fold = +33% work); pick the chunk size
-                # ≤ cmax minimizing total padded work, preferring larger
-                # chunks (fewer dispatches) on ties
-                return min(range(1, min(cmax, total) + 1),
-                           key=lambda c: (-(-total // c) * c, -c))
-            fc = best_chunk(k_folds, fc)
-            gc = best_chunk(family.grid_size(), gc)
-            g = family.grid_size()
-            kpad = (-k_folds) % fc
-            wd_p, vwd_p = wd, vwd
-            if kpad:
-                zeros = jnp.zeros((kpad,) + tuple(wd.shape[1:]), wd.dtype)
-                wd_p = jnp.concatenate([wd, zeros])
-                vwd_p = jnp.concatenate([vwd, jnp.zeros(
-                    (kpad,) + tuple(vwd.shape[1:]), vwd.dtype)])
-            gpad = (-g) % gc
-            stacked = family.stack_grid()
-            if gpad:
-                stacked = {k2: np.concatenate(
-                    [v, np.repeat(v[-1:], gpad, axis=0)])
-                    for k2, v in stacked.items()}
-            chunks = []
-            for j0 in range(0, g + gpad, gc):
-                chunks.append({k2: jnp.asarray(v[j0:j0 + gc])
-                               for k2, v in stacked.items()})
-            return fc, gc, wd_p, vwd_p, chunks
+            fc = _best_chunk(k_folds, fc)
+            gc = _best_chunk(family.grid_size(), gc)
+            return fc, gc, _grid_chunks(family, gc)
 
         fused: Dict[int, Any] = {}
         plans: Dict[int, Any] = {}
@@ -502,10 +495,10 @@ class _ValidatorBase:
                 continue
             plan = chunk_plan(family)
             plans[fi] = plan
-            fc, gc, wd_p, vwd_p, stacked_chunks = plan
+            fc, gc, stacked_chunks = plan
             key = (family.trace_signature(), self.task, self.metric_name,
                    mesh_key, ("chunk", fc, gc),
-                   shapes_of((Xd, yd, wd_p[:fc], vwd_p[:fc],
+                   shapes_of((Xd, yd, wd[:fc], vwd[:fc],
                               stacked_chunks[0])))
             exe = _FUSED_EXE_CACHE.get(key)
             if exe is not None:
@@ -519,9 +512,9 @@ class _ValidatorBase:
             with cf.ThreadPoolExecutor(len(to_compile)) as ex:
                 futs = []
                 for fi, key, jf in to_compile:
-                    fc, gc, wd_p, vwd_p, stacked_chunks = plans[fi]
+                    fc, gc, stacked_chunks = plans[fi]
                     futs.append((fi, key, ex.submit(
-                        lambda jf=jf, w=wd_p[:fc], v=vwd_p[:fc],
+                        lambda jf=jf, w=wd[:fc], v=vwd[:fc],
                         st=stacked_chunks[0]:
                         jf.lower(Xd, yd, w, v, st).compile())))
                 for fi, key, fut in futs:
@@ -538,13 +531,12 @@ class _ValidatorBase:
         # AND serialize device execution against host latency
         fused_out: Dict[int, Any] = {}
         for fi in fused:
-            fc, gc, wd_p, vwd_p, stacked_chunks = plans[fi]
-            kp = wd_p.shape[0]
+            fc, gc, stacked_chunks = plans[fi]
             outs = []
-            for i0 in range(0, kp, fc):
+            for i0 in range(0, k_folds, fc):
                 for st in stacked_chunks:
-                    outs.append(fused[fi](Xd, yd, wd_p[i0:i0 + fc],
-                                          vwd_p[i0:i0 + fc], st))
+                    outs.append(fused[fi](Xd, yd, wd[i0:i0 + fc],
+                                          vwd[i0:i0 + fc], st))
             fused_out[fi] = outs
         fused_np = jax.device_get(fused_out)
 
@@ -552,17 +544,15 @@ class _ValidatorBase:
             k, g = len(splits), family.grid_size()
 
             if fi in fused:
-                fc, gc, wd_p, vwd_p, stacked_chunks = plans[fi]
-                kp = wd_p.shape[0]
-                gp = gc * len(stacked_chunks)
-                full = np.zeros((kp, gp))
+                fc, gc, stacked_chunks = plans[fi]
+                full = np.zeros((k, g))
                 ci = 0
-                for i0 in range(0, kp, fc):
-                    for cj, _st in enumerate(stacked_chunks):
+                for i0 in range(0, k, fc):
+                    for cj in range(len(stacked_chunks)):
                         full[i0:i0 + fc, cj * gc:(cj + 1) * gc] = \
                             np.asarray(fused_np[fi][ci])
                         ci += 1
-                per_grid_metrics = full[:k, :g].T               # [G, K]
+                per_grid_metrics = full.T                       # [G, K]
             else:
                 stacked = family.stack_grid()
                 def fit_all(w_folds):
@@ -647,8 +637,6 @@ class _ValidatorBase:
                 X, y, w_tr, w_val = fold[:4]
                 if len(fold) > 4 and hasattr(family, "binary_mask"):
                     family.binary_mask = fold[4]
-                stacked = {k2: jnp.asarray(v) for k2, v in
-                           family.stack_grid().items()}
                 if mesh is not None:
                     from ..parallel.mesh import shard_cv_inputs
                     Xd, yd, wd, vwd, _n0 = shard_cv_inputs(
@@ -658,6 +646,8 @@ class _ValidatorBase:
                     wd = jnp.asarray(w_tr[None, :])
                     vwd = jnp.asarray(w_val[None, :])
                 if metric_fn is None:   # host-metric fallback
+                    stacked = {k2: jnp.asarray(v) for k2, v in
+                               family.stack_grid().items()}
                     if fit_host is None:
                         def fit_host(Xa, ya, wa, st, _f=family):
                             return _f.fit_batch(Xa, ya, wa, st)
@@ -673,15 +663,23 @@ class _ValidatorBase:
                             prob[gi][:len(y)][vm] if prob.ndim == 3
                             else prob[gi])
                     continue
-                fold_chunk = _auto_chunks(family, len(y), n_shards, 1,
-                                          n_features=X.shape[1])
+                _auto_chunks(family, len(y), n_shards, 1,
+                             n_features=X.shape[1])
+                # grid chunking at HOST level, one executable re-dispatched
+                # per chunk (same rationale as validate's chunk_plan: the
+                # in-program lax.map alternative compiles a slower program
+                # and concentrates transients)
+                gc = getattr(family, "grid_chunk", None) or g
+                if hasattr(family, "grid_chunk"):
+                    family.grid_chunk = None
+                gc = _best_chunk(g, gc)
+                st_chunks = _grid_chunks(family, gc)
                 key = (family.trace_signature(), self.task, self.metric_name,
-                       mesh_key, fold_chunk, "per_fold",
+                       mesh_key, ("per_fold", gc),
                        tuple((tuple(a.shape), str(a.dtype)) for a in
                              (Xd, yd, wd, vwd)))
                 exe = _FUSED_EXE_CACHE.get(key)
                 if exe is None:
-
                     def fit_eval(X, y, w_folds, v_folds, stacked):
                         def per_fold(w, v):
                             params = family.fit_batch(X, y, w, stacked)
@@ -692,12 +690,13 @@ class _ValidatorBase:
                             )(pred, prob)
                         return jax.vmap(per_fold)(w_folds, v_folds)
                     exe = jax.jit(fit_eval).lower(
-                        Xd, yd, wd, vwd, stacked).compile()
+                        Xd, yd, wd, vwd, st_chunks[0]).compile()
                     while len(_FUSED_EXE_CACHE) > 64:
                         _FUSED_EXE_CACHE.pop(next(iter(_FUSED_EXE_CACHE)))
                     _FUSED_EXE_CACHE[key] = exe
-                per_grid[:, ki] = np.asarray(
-                    exe(Xd, yd, wd, vwd, stacked))[0]
+                outs = [exe(Xd, yd, wd, vwd, st) for st in st_chunks]
+                per_grid[:, ki] = np.concatenate(
+                    [np.asarray(o)[0] for o in outs])
             means = per_grid.mean(axis=1)
             for gi in range(g):
                 r = ValidationResult(
