@@ -308,15 +308,23 @@ def _recover_checkpoint(path: str) -> str:
     from .parallel.multihost import is_coordinator
     if not is_coordinator():
         # multi-host: only the coordinator repairs the shared directory
-        # (single-writer invariant). Workers wait for the repaired target
-        # to appear — reading a sibling directly would race the
-        # coordinator's rename out from under the open() calls.
+        # (single-writer invariant). When a complete sibling exists,
+        # workers wait for the repaired target — reading the sibling
+        # immediately would race the coordinator's rename out from under
+        # the open() calls; if the coordinator never repairs (it crashed
+        # again / this load runs on workers only), fall back to the
+        # sibling, which nothing is renaming any more. No sibling → fail
+        # fast downstream.
+        sibs = [s for s in (f"{path}.tmp", f"{path}.old")
+                if os.path.exists(os.path.join(s, MODEL_JSON))]
+        if not sibs:
+            return path
         import time
         for _ in range(60):
             if os.path.exists(os.path.join(path, MODEL_JSON)):
                 return path
             time.sleep(0.5)
-        return path
+        return sibs[0]
     for sibling in (f"{path}.tmp", f"{path}.old"):
         if os.path.exists(os.path.join(sibling, MODEL_JSON)):
             if not os.path.exists(path):

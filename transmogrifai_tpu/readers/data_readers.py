@@ -42,6 +42,11 @@ class CutOffTime:
     def no_cutoff() -> "CutOffTime":
         return CutOffTime(None)
 
+    @staticmethod
+    def at(timestamp_ms: int) -> "CutOffTime":
+        """Fixed cutoff (CutOffTime.asOf analog)."""
+        return CutOffTime(int(timestamp_ms))
+
 
 class DataReader:
     """Base reader: produces record dicts; generates raw feature columns."""
