@@ -178,6 +178,12 @@ class ModelFamily:
         workflow-level CV folds) skip tracing entirely."""
         items = []
         for k, v in sorted(self.__dict__.items()):
+            if k in ("_max_instances", "_tree_chunk_cap"):
+                # budget bookkeeping that does NOT shape the traced
+                # program (only the finalized _tree_chunk_auto does) —
+                # keying it would recompile byte-identical executables
+                # whenever the HBM budget constant moves
+                continue
             if k == "grid":
                 items.append((k, tuple(tuple(sorted(g.items()))
                                        for g in self.grid)))

@@ -342,9 +342,11 @@ def _auto_chunks(family, n_rows: int, n_shards: int, n_folds: int,
         # auto tree-chunking (RF/DT bootstrap batching) is finalized by
         # the caller once the in-flight (fold × grid) chunk sizes are
         # known — record the budget and the row-count gate here. Only
-        # engaged at large row counts: the 200k-row RF sweep gains 28%
-        # from chunking, but at Titanic scale (~900 rows) it costs ~20%
-        # — tiny per-step work doesn't amortize the widened tensors.
+        # engaged at large PER-SHARD row counts (per-device step work is
+        # what must amortize the widened level tensors): measured
+        # single-chip, a 200k-row RF sweep gains 28% from chunking while
+        # Titanic scale (~900 rows) loses ~20%; the crossover gate is
+        # per-shard by construction.
         family._max_instances = max_instances
         family._tree_chunk_cap = 1 if rows < 32_768 else 4
         family._tree_chunk_auto = 1
