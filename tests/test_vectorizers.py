@@ -137,8 +137,9 @@ def test_date_unit_circle():
                                        input_names=["d"])
     d.transform_with(model)
     out = model.transform_columns(store)
-    # noon -> theta = pi -> sin=0, cos=-1
-    np.testing.assert_allclose(out.values[0, :2], [0.0, -1.0], atol=1e-9)
+    # noon -> theta = pi -> sin=0, cos=-1 (f32-native pipeline: atol at
+    # f32 eps — sin(float32(pi)) is ~-8.7e-8, not 0)
+    np.testing.assert_allclose(out.values[0, :2], [0.0, -1.0], atol=1e-6)
     assert out.values[1, 2] == 1.0  # null
 
 

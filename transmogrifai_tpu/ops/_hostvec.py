@@ -24,6 +24,8 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .vectorizer_base import VEC_DTYPE
+
 __all__ = ["string_codes", "onehot_block", "multihot_block",
            "hashed_count_block", "hashed_count_flat", "flatten_ragged",
            "value_counts"]
@@ -78,7 +80,7 @@ def onehot_block(values: Sequence[Optional[str]], vocab: Sequence[str],
     n = len(values)
     k = len(vocab)
     width = k + 1 + (1 if track_nulls else 0)
-    block = out if out is not None else np.zeros((n, width), dtype=np.float64)
+    block = out if out is not None else np.zeros((n, width), dtype=VEC_DTYPE)
     codes, null_mask = string_codes(values, vocab)
     rows = np.nonzero(~null_mask)[0]
     block[rows, codes[rows]] = 1.0
@@ -108,7 +110,7 @@ def multihot_block(row_values: Sequence[Sequence[str]], vocab: Sequence[str],
     n = len(row_values)
     k = len(vocab)
     width = k + 1 + (1 if track_nulls else 0)
-    block = out if out is not None else np.zeros((n, width), dtype=np.float64)
+    block = out if out is not None else np.zeros((n, width), dtype=VEC_DTYPE)
     flat, rows, lengths = flatten_ragged(row_values)
     if flat:
         codes, _ = string_codes(flat, vocab)
@@ -154,7 +156,7 @@ def hashed_count_flat(flat: Sequence[str], rows: np.ndarray,
     from .hashing import hash_tokens
 
     counts = out if out is not None else np.zeros((n, num_features),
-                                                  dtype=np.float64)
+                                                  dtype=VEC_DTYPE)
     if len(flat):
         uniq, inv = _unique_object(np.asarray(flat, dtype=object),
                                    return_inverse=True)
@@ -171,4 +173,4 @@ def hashed_count_flat(flat: Sequence[str], rows: np.ndarray,
             region[r, b] = 1.0
         else:
             region[r, b] += mult
-    return counts, np.asarray(null_mask, np.float64)
+    return counts, np.asarray(null_mask, VEC_DTYPE)

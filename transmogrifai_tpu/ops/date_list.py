@@ -59,30 +59,39 @@ class DateListVectorizer(VectorizerModel):
         anchor = np.zeros((n, k), dtype=np.float64)
         mask = np.zeros((n, k), dtype=bool)
         ref = self.reference_date_ms
+        reduce = (np.maximum if self.pivot == DateListPivot.SINCE_LAST
+                  else np.minimum)
         for j, name in enumerate(names):
             col = store[name]
             assert isinstance(col, RaggedColumn)
-            for r in range(n):
-                row = col.flat[col.offsets[r]:col.offsets[r + 1]]
-                if row.size == 0:
-                    continue
-                mask[r, j] = True
-                anchor[r, j] = (row.max() if self.pivot == DateListPivot.SINCE_LAST
-                                else row.min())
+            flat = col.flat.astype(np.float64, copy=False)
+            counts = np.diff(col.offsets)
+            m = counts > 0
+            mask[:, j] = m
+            if flat.size:
+                # segment-reduce over the ragged rows, no per-row Python.
+                # Boundaries come from NON-EMPTY rows only: their starts are
+                # strictly increasing and each segment then spans exactly
+                # that row's events (empty rows contribute no boundary, so
+                # they can't truncate a neighbour's segment).
+                nonempty = np.flatnonzero(m)
+                anchor[nonempty, j] = reduce.reduceat(
+                    flat, col.offsets[:-1][nonempty])
         if ref is None:
             present = anchor[mask]
             ref = float(present.max()) if present.size else 0.0
-        return {"anchor": anchor, "mask": mask,
-                "ref": np.asarray(float(ref))}
+        # subtract epoch-scale anchors on host in f64: ref-anchor is a
+        # catastrophic cancellation in f32 (both ~1.7e12); the day delta
+        # itself is small and f32-safe
+        days = (float(ref) - anchor) / _MS_PER_DAY
+        return {"days": days, "mask": mask}
 
     def device_compute(self, xp, prepared):
-        anchor, mask = prepared["anchor"], prepared["mask"]
-        ref = prepared["ref"]
-        days = (ref - anchor) / _MS_PER_DAY
+        days, mask = prepared["days"], prepared["mask"]
         days = xp.where(mask, days, 0.0)
         if not self.track_nulls:
             return days
-        n, k = anchor.shape
+        n, k = days.shape
         nulls = (~mask).astype(days.dtype)
         return xp.stack([days, nulls], axis=2).reshape(n, 2 * k)
 

@@ -95,26 +95,39 @@ class DateToUnitCircleVectorizer(VectorizerModel):
         return self.input_names_saved
 
     def host_prepare(self, store: ColumnStore) -> Dict[str, np.ndarray]:
-        vals, masks = [], []
+        """Reduce epoch millis → (sin θ, cos θ) ON HOST in f64.
+
+        Two reasons the reduction happens host-side: raw epoch milliseconds
+        (~1.7e12) defeat f32 (24-bit mantissa ⇒ ~±1e5 ms error, enough to
+        flip a day boundary), and sin/cos are transcendentals — XLA's TPU
+        polynomial approximations differ from libm at the ULP level, which
+        would break the fused-vs-host bit-identity guarantee. After this,
+        ``device_compute`` is pure where/concat (exact in f32)."""
+        sincos, masks = [], []
         for name in self._names():
             col = store[name]
-            vals.append(col.values.astype(np.float64))
+            millis = col.values.astype(np.float64)
+            sc = np.empty((len(millis), len(self.periods), 2), np.float64)
+            for p_i, p in enumerate(self.periods):
+                theta = period_radians(np, millis, p)
+                sc[:, p_i, 0] = np.sin(theta)
+                sc[:, p_i, 1] = np.cos(theta)
+            sincos.append(sc)  # [n, P, 2] (P may be 0: null-only output)
             masks.append(col.mask)
-        return {"millis": np.stack(vals, axis=1),
-                "mask": np.stack(masks, axis=1)}
+        return {"sincos": np.stack(sincos, axis=1),  # [n, k, P, 2]
+                "mask": np.stack(masks, axis=1)}     # [n, k]
 
     def device_compute(self, xp, prepared):
-        millis, mask = prepared["millis"], prepared["mask"]
-        n, k = millis.shape
+        sincos, mask = prepared["sincos"], prepared["mask"]
+        n, k, P, _ = sincos.shape
         outs = []
         for j in range(k):
             m = mask[:, j]
-            for period in self.periods:
-                theta = period_radians(xp, millis[:, j], period)
-                outs.append(xp.where(m, xp.sin(theta), 0.0)[:, None])
-                outs.append(xp.where(m, xp.cos(theta), 0.0)[:, None])
+            if P:
+                vals = xp.where(m[:, None], sincos[:, j].reshape(n, 2 * P), 0.0)
+                outs.append(vals)
             if self.track_nulls:
-                outs.append((~m).astype(millis.dtype)[:, None])
+                outs.append((~m).astype(sincos.dtype)[:, None])
         return xp.concatenate(outs, axis=1)
 
     def vector_metadata(self) -> VectorMetadata:

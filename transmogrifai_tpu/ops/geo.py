@@ -15,8 +15,9 @@ from ..columns import ColumnStore, GeoColumn
 from ..stages.base import register_stage
 from ..types.feature_types import Geolocation
 from ..vector_metadata import VectorColumnMetadata, VectorMetadata
-from .vectorizer_base import (TransmogrifierDefaults, VectorizerEstimator,
-                              VectorizerModel, null_indicator_meta)
+from .vectorizer_base import (TransmogrifierDefaults, VEC_DTYPE,
+                              VectorizerEstimator, VectorizerModel,
+                              null_indicator_meta)
 
 __all__ = ["GeolocationVectorizer", "GeolocationVectorizerModel"]
 
@@ -70,7 +71,7 @@ class GeolocationVectorizerModel(VectorizerModel):
     def device_compute(self, xp, prepared):
         values, mask = prepared["values"], prepared["mask"]
         n, k, _ = values.shape
-        fills = xp.asarray(np.array(self.fill_values, dtype=np.float64))  # [k,3]
+        fills = xp.asarray(np.asarray(self.fill_values, dtype=VEC_DTYPE))  # [k,3]
         filled = xp.where(mask[:, :, None], values, fills[None, :, :])
         if self.track_nulls:
             nulls = (~mask).astype(values.dtype)[:, :, None]

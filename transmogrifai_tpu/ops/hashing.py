@@ -22,7 +22,8 @@ from ..columns import ColumnStore, TextColumn, TextListColumn, TextSetColumn
 from ..stages.base import register_stage
 from ..types.feature_types import MultiPickList, Text, TextList
 from ..vector_metadata import VectorColumnMetadata, VectorMetadata
-from .vectorizer_base import (TransmogrifierDefaults, VectorizerEstimator,
+from .vectorizer_base import (TransmogrifierDefaults, VEC_DTYPE,
+                              VectorizerEstimator,
                               VectorizerModel, null_indicator_meta)
 
 __all__ = ["murmur3_32", "hash_tokens", "HashingVectorizerModel",
@@ -194,7 +195,7 @@ class HashingVectorizerModel(VectorizerModel):
         # counts and null indicators live in ONE matrix (nulls in the tail
         # columns) so no concat copy is needed downstream
         mat = np.zeros((n, width + (k if self.track_nulls else 0)),
-                       dtype=np.float64)
+                       dtype=VEC_DTYPE)
         for j, name in enumerate(names):
             col = store[name]
             base = 0 if self.shared_hash_space else j * self.num_features

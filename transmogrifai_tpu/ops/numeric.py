@@ -18,8 +18,9 @@ from ..stages.base import register_stage
 from ..types.feature_types import (Binary, FeatureType, Integral, OPNumeric,
                                    Real)
 from ..vector_metadata import VectorColumnMetadata, VectorMetadata
-from .vectorizer_base import (TransmogrifierDefaults, VectorizerEstimator,
-                              VectorizerModel, null_indicator_meta)
+from .vectorizer_base import (TransmogrifierDefaults, VEC_DTYPE,
+                              VectorizerEstimator, VectorizerModel,
+                              null_indicator_meta, vec_dtype_round)
 
 __all__ = ["RealVectorizer", "IntegralVectorizer", "BinaryVectorizer",
            "NumericBucketizer", "NumericVectorizerModel"]
@@ -59,7 +60,10 @@ class NumericVectorizerModel(VectorizerModel):
 
     def device_compute(self, xp, prepared):
         values, mask = prepared["values"], prepared["mask"]
-        fill = xp.asarray(np.array(self.fill_values, dtype=np.float64))
+        # VEC_DTYPE to match the canonicalized values on both paths (a f64
+        # constant would make numpy promote where jit canonicalizes, and
+        # the two paths would drift)
+        fill = xp.asarray(np.asarray(self.fill_values, dtype=VEC_DTYPE))
         imputed = xp.where(mask, values, fill[None, :])
         if not self.track_nulls:
             return imputed
@@ -174,7 +178,14 @@ class NumericBucketizerModel(VectorizerModel):
                  ftype_name: str = "Real",
                  uid: Optional[str] = None):
         super().__init__(uid=uid)
-        self.splits = [list(map(float, s)) for s in splits]
+        # round fitted edges through the pipeline dtype at CONSTRUCTION so
+        # the stored edges ARE the values transform compares against (no
+        # second rounding at transform time). Deliberately NO dedup: two f64
+        # edges within one f32 ULP collapse to an identical pair, whose
+        # bucket simply never fires — keeping the vector width stable is
+        # what matters (checkpointed downstream stages are fitted against
+        # this width; shrinking it on reload would misalign them all).
+        self.splits = [vec_dtype_round(list(s)).tolist() for s in splits]
         self.track_nulls = track_nulls
         self.track_invalid = track_invalid
         self.input_names_saved = list(input_names)
@@ -198,7 +209,9 @@ class NumericBucketizerModel(VectorizerModel):
         values, mask = prepared["values"], prepared["mask"]
         outs = []
         for j, splits in enumerate(self.splits):
-            edges = xp.asarray(np.array(splits, dtype=np.float64))
+            # VEC_DTYPE edges: values are canonicalized the same way, so
+            # both paths bucket identically (comparisons agree bit-for-bit)
+            edges = xp.asarray(np.asarray(splits, dtype=VEC_DTYPE))
             v = values[:, j]
             m = mask[:, j]
             # bucket b: edges[b] <= v < edges[b+1]; last bucket right-closed

@@ -20,7 +20,8 @@ from ..stages.base import register_stage
 from ..types.feature_types import MultiPickList, OPSet, Text
 from ..vector_metadata import (NULL_INDICATOR, OTHER_INDICATOR,
                                VectorColumnMetadata, VectorMetadata)
-from .vectorizer_base import (TransmogrifierDefaults, VectorizerEstimator,
+from .vectorizer_base import (TransmogrifierDefaults, VEC_DTYPE,
+                              VectorizerEstimator,
                               VectorizerModel)
 
 __all__ = ["OneHotVectorizer", "SetVectorizer", "OneHotModel"]
@@ -77,7 +78,7 @@ class OneHotModel(VectorizerModel):
         n = store.n_rows
         nul = 1 if self.track_nulls else 0
         widths = [len(v) + 1 + nul for v in self.vocabs]
-        mat = np.zeros((n, sum(widths)), dtype=np.float64)
+        mat = np.zeros((n, sum(widths)), dtype=VEC_DTYPE)
         off = 0
         for name, vocab, w in zip(names, self.vocabs, widths):
             col = store[name]

@@ -23,7 +23,8 @@ from ..vector_metadata import VectorColumnMetadata, VectorMetadata
 from .hashing import HashingVectorizerModel, hash_tokens
 from .onehot import OneHotModel, _sorted_topk
 from .text import tokenize_simple
-from .vectorizer_base import (TransmogrifierDefaults, VectorizerEstimator,
+from .vectorizer_base import (TransmogrifierDefaults, VEC_DTYPE,
+                              VectorizerEstimator,
                               VectorizerModel, null_indicator_meta)
 
 __all__ = ["SmartTextVectorizer", "SmartTextVectorizerModel"]
@@ -100,7 +101,7 @@ class SmartTextVectorizerModel(VectorizerModel):
         names = self._names()
         n = store.n_rows
         widths = self._widths()
-        mat = np.zeros((n, sum(widths)), dtype=np.float64)
+        mat = np.zeros((n, sum(widths)), dtype=VEC_DTYPE)
         vocab_iter = iter(self.vocabs)
         off = 0
         for j, name in enumerate(names):

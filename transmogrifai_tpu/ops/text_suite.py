@@ -32,7 +32,7 @@ from ..types.feature_types import (Base64, Binary, Email, MultiPickList,
                                    OPVector, Phone, Real, Text, TextList,
                                    URL)
 from ..vector_metadata import VectorColumnMetadata, VectorMetadata
-from .vectorizer_base import VectorizerEstimator, VectorizerModel
+from .vectorizer_base import VEC_DTYPE, VectorizerEstimator, VectorizerModel
 
 __all__ = [
     "OpCountVectorizer", "CountVectorizerModel", "NGramSimilarity",
@@ -71,7 +71,7 @@ class CountVectorizerModel(VectorizerModel):
         names = self._names()
         n = store.n_rows
         widths = [len(v) for v in self.vocabs]
-        mat = np.zeros((n, sum(widths)), dtype=np.float64)
+        mat = np.zeros((n, sum(widths)), dtype=VEC_DTYPE)
         off = 0
         for name, vocab in zip(names, self.vocabs):
             col = store[name]

@@ -9,6 +9,15 @@ Every vectorizer model separates its transform into:
   written against the ``xp`` namespace so the same code runs as numpy on
   host or inside a jitted XLA computation (``xp = jax.numpy``).
 
+The contract is **f32-native**: every prepared block is canonicalized
+(``canonicalize_prepared``) to the dtypes jit sees with x64 off — f64→f32,
+i64→i32 — BEFORE ``device_compute`` on BOTH the numpy and the fused-jit
+path, so the two paths compute on bit-identical inputs and can never
+drift. The flip side is a contract obligation on ``host_prepare``: any
+quantity whose magnitude defeats f32 (epoch milliseconds, row counts ≥2³¹)
+must be reduced on host in f64 first (see dates.py: period angles, not raw
+timestamps, cross the boundary).
+
 This is the TPU answer to ``FitStagesUtil.applyOpTransformations``'s row
 fusion (``core/.../utils/stages/FitStagesUtil.scala:96-119``): the workflow
 can jit ONE function per DAG layer that runs every vectorizer's
@@ -30,7 +39,39 @@ from ..stages.base import (Estimator, FittedModel, InputSpec, Transformer,
 from ..types.feature_types import FeatureType, OPVector
 from ..vector_metadata import VectorColumnMetadata, VectorMetadata
 
-__all__ = ["VectorizerModel", "VectorizerEstimator", "TransmogrifierDefaults"]
+__all__ = ["VectorizerModel", "VectorizerEstimator", "TransmogrifierDefaults",
+           "canonicalize_prepared", "VEC_DTYPE", "vec_dtype_round"]
+
+#: dtype of the vector pipeline: f32 end-to-end (TPU-native; MXU/VPU run
+#: f32/bf16 — f64 would be emulated and silently downcast under jit anyway)
+VEC_DTYPE = np.float32
+
+
+def canonicalize_prepared(prepared: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Cast prepared blocks to the dtypes jit produces under x64-off.
+
+    f64→f32, i64→i32, u64→u32; bools and narrower types pass through.
+    Applying this on the host path too makes numpy and fused-jit transforms
+    bit-identical for elementwise work (the x64 gate this replaces existed
+    only because the two paths used to see different dtypes)."""
+    out = {}
+    for k, v in prepared.items():
+        a = np.asarray(v)
+        if a.dtype == np.float64:
+            a = a.astype(VEC_DTYPE)
+        elif a.dtype == np.int64:
+            a = a.astype(np.int32)
+        elif a.dtype == np.uint64:
+            a = a.astype(np.uint32)
+        out[k] = a
+    return out
+
+
+def vec_dtype_round(values) -> "np.ndarray":
+    """Round fitted f64 constants (bucket edges, fill values) through the
+    pipeline dtype ONCE at fit time, so fit-time decisions and transform-time
+    comparisons see exactly the same numbers."""
+    return np.asarray(values, dtype=VEC_DTYPE).astype(np.float64)
 
 
 class TransmogrifierDefaults:
@@ -75,7 +116,7 @@ class VectorizerModel(FittedModel):
 
     # -- Transformer impl --------------------------------------------------
     def transform_columns(self, store: ColumnStore) -> Column:
-        prepared = self.host_prepare(store)
+        prepared = canonicalize_prepared(self.host_prepare(store))
         mat = self.device_compute(np, prepared)
         mat = np.asarray(mat, dtype=np.float64)
         meta = self.vector_metadata()

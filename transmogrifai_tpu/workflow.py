@@ -157,23 +157,23 @@ def apply_layer_vectorized(models: Sequence[Transformer], store: ColumnStore,
     elementwise work across stages and the data crosses host↔device once
     per layer. Non-vectorizer transformers apply as usual.
 
-    The fused path engages only when ALL of these hold; otherwise the
+    The vector pipeline is f32-native: prepared blocks are canonicalized
+    (``canonicalize_prepared``) to f32/i32 on BOTH paths, so the fused jit
+    path (x64 off) and the numpy path compute on bit-identical inputs —
+    no train/serve skew, no x64 requirement. Magnitudes that defeat f32
+    are reduced on host first (see vectorizer_base docstring).
+
+    The fused path engages only when BOTH of these hold; otherwise the
     numerically identical numpy path runs:
 
     * ``store.n_rows >= fuse_min_rows`` — below it, compile cost dominates;
-    * ``jax_enable_x64`` is on — otherwise jit would silently round the f64
-      blocks to f32 and drift from the numpy path (train/serve skew);
     * measured host↔device bandwidth clears ``FUSE_MIN_BANDWIDTH_MBPS`` —
       a transform layer is memory-bound, so on a slow link (e.g. a
       network-tunnelled TPU) the round-trip costs more than the compute.
-
-    In the production TPU configuration (x64 off) transforms therefore run
-    on host by design — the device is reserved for the model math, where
-    the FLOPs are. A planned f32 end-to-end migration of the vector
-    pipeline will let the fused path run on TPU natively.
+      Locally attached chips (PCIe/ICI) clear it easily.
     """
     from .columns import VectorColumn
-    from .ops.vectorizer_base import VectorizerModel
+    from .ops.vectorizer_base import VectorizerModel, canonicalize_prepared
     from .types.feature_types import OPVector
 
     import jax
@@ -181,15 +181,11 @@ def apply_layer_vectorized(models: Sequence[Transformer], store: ColumnStore,
     threshold = FUSE_MIN_ROWS if fuse_min_rows is None else fuse_min_rows
     vecs = [m for m in models if isinstance(m, VectorizerModel)]
     rest = [m for m in models if not isinstance(m, VectorizerModel)]
-    # x64 gate: without jax_enable_x64 the jit would silently canonicalize
-    # the f64 prepared blocks to f32 and fused results would drift from the
-    # numpy path (e.g. bucket edges within f32 eps) — train/serve skew.
     if (len(vecs) >= 1 and store.n_rows >= threshold
-            and jax.config.jax_enable_x64
             and device_roundtrip_mbps() >= FUSE_MIN_BANDWIDTH_MBPS):
         import jax.numpy as jnp
 
-        preps = [m.host_prepare(store) for m in vecs]
+        preps = [canonicalize_prepared(m.host_prepare(store)) for m in vecs]
         key = (tuple(id(m) for m in vecs),
                tuple((k, tuple(np.shape(v)), str(np.asarray(v).dtype))
                      for p in preps for k, v in sorted(p.items())))
