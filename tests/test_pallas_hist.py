@@ -95,3 +95,42 @@ def test_forced_pallas_tree_fit_matches_xla(rng, monkeypatch):
                                np.asarray(forced["thr"]))
     np.testing.assert_allclose(np.asarray(base["leaf"]),
                                np.asarray(forced["leaf"]), rtol=1e-8)
+
+
+def test_fit_level_pallas_fallback(monkeypatch):
+    """ADVICE r2: the tiny-shape probe can pass while production shapes
+    fail Mosaic. A kernel-shaped failure mid-fit must flip the gate off and
+    retry (re-keying families onto the XLA path); unrelated errors and the
+    user-forced TMOG_PALLAS=1 must propagate untouched."""
+    import pytest
+
+    import transmogrifai_tpu.models._pallas_hist as ph
+
+    monkeypatch.delenv("TMOG_PALLAS", raising=False)
+    monkeypatch.setattr(ph, "_PROBE", True)
+    calls = []
+
+    def boom():
+        calls.append(ph._PROBE)
+        if ph._PROBE:
+            raise RuntimeError("Mosaic lowering failed: VMEM limit exceeded")
+        return "ok"
+
+    with pytest.warns(UserWarning, match="XLA matmul path"):
+        assert ph.with_pallas_fallback(boom) == "ok"
+    assert calls == [True, False] and ph._PROBE is False
+
+    # unrelated errors propagate without flipping the gate
+    monkeypatch.setattr(ph, "_PROBE", True)
+    def unrelated():
+        raise ValueError("user data has NaNs")
+    with pytest.raises(ValueError):
+        ph.with_pallas_fallback(unrelated)
+    assert ph._PROBE is True
+
+    # TMOG_PALLAS=1 means the user insists: fail loudly, don't fall back
+    monkeypatch.setenv("TMOG_PALLAS", "1")
+    def forced():
+        raise RuntimeError("Mosaic lowering failed")
+    with pytest.raises(RuntimeError):
+        ph.with_pallas_fallback(forced)

@@ -235,3 +235,30 @@ def test_cutter_relabels_and_model_maps_back(rng):
     assert acc > 0.9, acc
     sel = model.fitted_stages[selector.uid]
     assert sel.label_mapping == [0.0, 2.0, 7.0]
+
+
+def test_ragged_grid_chunk_parity(rng):
+    """ADVICE r2: a prime 7-point grid with a 3-point chunk budget must run
+    a ragged [3,3,1] schedule — same metrics as the unchunked sweep, not
+    seven 1-wide dispatches."""
+    import transmogrifai_tpu.models.tuning as tuning
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+
+    assert tuning._chunk_sizes(7, 3) == [3, 3, 1]
+
+    n = 300
+    X = rng.normal(size=(n, 5))
+    y = (X[:, 0] - 0.3 * X[:, 2] > 0).astype(float)
+    grid = [{"regParam": 10.0 ** -k, "elasticNetParam": 0.0}
+            for k in range(7)]
+
+    def sweep(chunk):
+        fam = LogisticRegressionFamily(grid=[dict(g) for g in grid])
+        if chunk:
+            fam.grid_chunk = chunk
+        cv = tuning.CrossValidation(num_folds=2, metric_name="AuROC",
+                                    task="binary", seed=5)
+        _, _, summ = cv.validate([fam], X, y)
+        return np.array([r.mean_metric for r in summ.results])
+
+    np.testing.assert_allclose(sweep(None), sweep(3), rtol=1e-5)

@@ -218,3 +218,39 @@ def test_checkpoint_swap_crash_windows(rng, tmp_path):
     os.rename(ckpt, ckpt + ".old")
     loaded = WorkflowModel.load(ckpt)
     assert loaded.result_features[0].name == "x"
+
+
+def test_direct_overwrite_save_survives_midsave_crash(tmp_path, monkeypatch):
+    """ADVICE r2: a crash during an overwriting direct save (runner's
+    model.save(loc, overwrite=True)) must leave the PREVIOUS save loadable
+    — the marker always references a fully-written weights file."""
+    from transmogrifai_tpu import model_io
+
+    store = _make_store()
+    y = FeatureBuilder.RealNN("y").from_column().as_response()
+    age = FeatureBuilder.Real("age").from_column().as_predictor()
+    vec = transmogrify([age])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily(
+            grid=[{"regParam": 0.01, "elasticNetParam": 0.0}])])
+    pred = y.transform_with(sel, vec)
+    model = Workflow().set_input_store(store).set_result_features(pred).train()
+    loc = str(tmp_path / "m")
+    model.save(loc)
+    before = model_io.load_workflow_model(loc)
+
+    real_savez = np.savez
+
+    def dying_savez(path, **arrays):
+        real_savez(path, **{k: v for k, v in list(arrays.items())[:1]})
+        raise OSError("disk full mid-weights-write")
+
+    monkeypatch.setattr(np, "savez", dying_savez)
+    try:
+        model.save(loc, overwrite=True)
+    except OSError:
+        pass
+    monkeypatch.setattr(np, "savez", real_savez)
+
+    after = model_io.load_workflow_model(loc)   # old save intact
+    assert sorted(after.fitted_stages) == sorted(before.fitted_stages)

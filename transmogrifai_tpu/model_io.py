@@ -222,20 +222,30 @@ def save_workflow_model(model, path: str, overwrite: bool = False) -> None:
         "rawFeatureFilterResults": (model.rff_results.to_json()
                                     if model.rff_results is not None else None),
     }
-    # weights first, then model.json via tmp-file + atomic replace:
-    # MODEL_JSON's existence is the save's completeness marker (the
-    # checkpoint recovery in _recover_checkpoint relies on it), so it must
-    # appear only after every other artifact is fully on disk — including
-    # on overwriting re-saves, where the STALE marker must come down
-    # before the non-atomic weights write begins
+    # Crash-consistent DIRECT save (ADVICE r2): the weights go to a save-
+    # unique file name recorded in model.json, and model.json lands last
+    # via tmp + atomic replace. At every instant the marker on disk
+    # references a weights file that is fully written: a crash mid-weights
+    # leaves the PREVIOUS (json, weights) pair untouched and loadable; a
+    # crash before the json replace leaves the new weights as an orphan
+    # (cleaned up by the next successful save). MODEL_JSON's existence
+    # remains the completeness marker `_recover_checkpoint` relies on.
+    import uuid
     mj = os.path.join(path, MODEL_JSON)
-    if os.path.exists(mj):
-        os.remove(mj)
-    np.savez(os.path.join(path, WEIGHTS_NPZ), **arrays)
+    weights_name = f"weights-{uuid.uuid4().hex[:12]}.npz"
+    doc["weightsFile"] = weights_name
+    np.savez(os.path.join(path, weights_name), **arrays)
     json_tmp = mj + ".tmp"
     with open(json_tmp, "w") as fh:
         json.dump(doc, fh, indent=1, default=str)
     os.replace(json_tmp, mj)
+    for fn in os.listdir(path):   # orphaned weights from prior/torn saves
+        if (fn.endswith(".npz") and fn != weights_name
+                and (fn.startswith("weights-") or fn == WEIGHTS_NPZ)):
+            try:
+                os.remove(os.path.join(path, fn))
+            except OSError:
+                pass
 
 
 def rebuild_stages(records, arrays: Dict[str, np.ndarray]
@@ -324,7 +334,14 @@ def _recover_checkpoint(path: str) -> str:
             if os.path.exists(os.path.join(path, MODEL_JSON)):
                 return path
             time.sleep(0.5)
-        return sibs[0]
+        # timed out: the coordinator may have completed its rename JUST
+        # after the poll (sibling gone, target repaired) — re-check both
+        # rather than returning a possibly-vanished sibling (ADVICE r2)
+        if os.path.exists(os.path.join(path, MODEL_JSON)):
+            return path
+        sibs = [s for s in (f"{path}.tmp", f"{path}.old")
+                if os.path.exists(os.path.join(s, MODEL_JSON))]
+        return sibs[0] if sibs else path
     for sibling in (f"{path}.tmp", f"{path}.old"):
         if os.path.exists(os.path.join(sibling, MODEL_JSON)):
             if not os.path.exists(path):
@@ -339,14 +356,35 @@ def _recover_checkpoint(path: str) -> str:
 def load_workflow_model(path: str):
     from .workflow import WorkflowModel
 
-    path = _recover_checkpoint(path)
-    with open(os.path.join(path, MODEL_JSON)) as fh:
-        doc = json.load(fh)
-    npz_path = os.path.join(path, WEIGHTS_NPZ)
-    arrays: Dict[str, np.ndarray] = {}
-    if os.path.exists(npz_path):
-        with np.load(npz_path, allow_pickle=False) as npz:
-            arrays = {k: npz[k] for k in npz.files}
+    # a concurrent coordinator repair can rename the resolved directory out
+    # from under these opens (worker-side race, ADVICE r2): re-resolve and
+    # retry rather than surfacing FileNotFoundError for a repairable state
+    for attempt in range(3):
+        resolved = _recover_checkpoint(path)
+        try:
+            with open(os.path.join(resolved, MODEL_JSON)) as fh:
+                doc = json.load(fh)
+            arrays: Dict[str, np.ndarray] = {}
+            if "weightsFile" in doc:
+                # new format: the marker references a weights file written
+                # BEFORE it — absence means a concurrent re-save's orphan
+                # cleanup won the race; raising re-enters the retry with a
+                # fresh marker read instead of crashing later on a missing
+                # array ref
+                with np.load(os.path.join(resolved, doc["weightsFile"]),
+                             allow_pickle=False) as npz:
+                    arrays = {k: npz[k] for k in npz.files}
+            else:
+                npz_path = os.path.join(resolved, WEIGHTS_NPZ)  # legacy
+                if os.path.exists(npz_path):
+                    with np.load(npz_path, allow_pickle=False) as npz:
+                        arrays = {k: npz[k] for k in npz.files}
+            break
+        except FileNotFoundError:
+            if attempt == 2:
+                raise
+            import time
+            time.sleep(0.25)
 
     stage_by_uid = rebuild_stages(doc["stages"], arrays)
     feat_by_uid = rebuild_features(doc["features"], stage_by_uid)

@@ -141,6 +141,44 @@ def cumhist(stats: jnp.ndarray, node: jnp.ndarray, Xb: jnp.ndarray,
     return out[..., :F]
 
 
+def disable_pallas_histograms(exc: BaseException) -> bool:
+    """Fit-level fallback (ADVICE r2): the probe compiles only a tiny
+    shape, so Mosaic can still reject PRODUCTION shapes (n_bins·Fc off the
+    128-lane grid, C·A blocks pressuring VMEM). When a tree-fit compile or
+    dispatch dies with a kernel-looking error while the gate is on,
+    disable the kernel process-wide and return True — callers retrace,
+    which re-keys every family's ``trace_signature`` onto the XLA matmul
+    path. Returns False (caller re-raises) for unrelated errors, when
+    already off, or when ``TMOG_PALLAS=1`` explicitly forces the kernel
+    (the user asked for it; failing loudly beats silently ignoring them).
+    """
+    global _PROBE
+    if os.environ.get("TMOG_PALLAS", "").strip() == "1":
+        return False
+    if _PROBE is not True:
+        return False
+    text = repr(exc).lower()
+    if not any(s in text for s in ("mosaic", "pallas", "vmem", "internal:")):
+        return False
+    import warnings
+    warnings.warn(
+        f"pallas histogram kernel failed at production shapes ({exc!r}); "
+        "retracing the tree engine onto the XLA matmul path")
+    _PROBE = False
+    return True
+
+
+def with_pallas_fallback(build):
+    """Run ``build()`` (a compile/fit thunk); on a kernel-shaped failure
+    with the gate on, flip the gate off and run it once more."""
+    try:
+        return build()
+    except Exception as e:
+        if disable_pallas_histograms(e):
+            return build()
+        raise
+
+
 def pallas_histograms_enabled() -> bool:
     """Trace-time gate for the tree engine. Default: on for TPU backends
     after a one-time compile probe, off elsewhere. ``TMOG_PALLAS=1``

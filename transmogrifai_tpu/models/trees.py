@@ -458,9 +458,11 @@ class _TreeEstimatorBase(PredictorEstimator):
         fam.binary_mask = detect_binary_columns(X)
         Xd = jnp.asarray(X, jnp.float32)
         grid = fam.stack_grid()
-        params = jax.jit(lambda X, y, w: fam.fit_batch(X, y, w, grid))(
-            Xd, jnp.asarray(y, jnp.float32),
-            jnp.ones((X.shape[0],), jnp.float32))
+        from ._pallas_hist import with_pallas_fallback
+        params = with_pallas_fallback(
+            lambda: jax.jit(lambda X, y, w: fam.fit_batch(X, y, w, grid))(
+                Xd, jnp.asarray(y, jnp.float32),
+                jnp.ones((X.shape[0],), jnp.float32)))
         single = jax.tree_util.tree_map(lambda a: np.asarray(a)[0], params)
         return fam.realize(single, fam.grid[0])
 

@@ -364,20 +364,39 @@ def _best_chunk(total: int, cmax: int) -> int:
     """Largest chunk size ≤ cmax that DIVIDES total: zero padded work
     (a padded fold/grid slot costs a whole wasted fit at large n, which
     dominates the ~100ms saved per avoided dispatch), fewest dispatches
-    among the zero-padding options. Prime totals over budget degrade to
-    1-wide chunks — more dispatches, never more compute."""
+    among the zero-padding options."""
     cmax = max(1, min(cmax, total))
     return max(c for c in range(1, cmax + 1) if total % c == 0)
 
 
-def _grid_chunks(family, gc: int):
+def _chunk_sizes(total: int, cmax: int) -> List[int]:
+    """Chunk-size schedule ≤ cmax covering total.
+
+    Prefers ONE uniform divisor size (single executable, zero padding).
+    When the best divisor sits far below the budget — e.g. a prime
+    7-point grid with budget 6 would degrade to seven 1-wide dispatches —
+    it instead emits a ragged schedule ``[cmax]*q + [r]`` (ADVICE r2):
+    one extra compile for the remainder shape beats multiplying dispatch
+    count and collapsing the vmap batch width."""
+    cmax = max(1, min(cmax, total))
+    d = _best_chunk(total, cmax)
+    if 2 * d > cmax:          # divisor uses >half the budget: good enough
+        return [d] * (total // d)
+    q, r = divmod(total, cmax)
+    return [cmax] * q + ([r] if r else [])
+
+
+def _grid_chunks(family, sizes: List[int]):
     """Split the family's stacked hyperparameter grid into device-ready
-    chunks of gc points (gc divides grid_size; shared by validate and
+    chunks following the ``sizes`` schedule (shared by validate and
     validate_per_fold so the chunking logic cannot drift)."""
     stacked = family.stack_grid()
-    g = family.grid_size()
-    return [{k2: jnp.asarray(v[j0:j0 + gc]) for k2, v in stacked.items()}
-            for j0 in range(0, g, gc)]
+    chunks, j0 = [], 0
+    for gc in sizes:
+        chunks.append({k2: jnp.asarray(v[j0:j0 + gc])
+                       for k2, v in stacked.items()})
+        j0 += gc
+    return chunks
 
 
 def _finalize_tree_chunk(family, in_flight: int) -> None:
@@ -388,6 +407,19 @@ def _finalize_tree_chunk(family, in_flight: int) -> None:
         family._tree_chunk_auto = int(np.clip(
             getattr(family, "_max_instances", 1) // max(in_flight, 1),
             1, getattr(family, "_tree_chunk_cap", 1)))
+
+
+_NO_CHUNK_ATTR = object()
+
+
+def _snapshot_grid_chunks(families):
+    return [(f, getattr(f, "grid_chunk", _NO_CHUNK_ATTR)) for f in families]
+
+
+def _restore_grid_chunks(snaps) -> None:
+    for f, gc in snaps:
+        if gc is not _NO_CHUNK_ATTR:
+            f.grid_chunk = gc
 
 
 class _ValidatorBase:
@@ -418,7 +450,23 @@ class _ValidatorBase:
         predictions never leave the device. With a mesh, X/y are device_put
         with a row sharding so XLA partitions the batch over chips (GSPMD).
         Metrics without a device kernel fall back to host numpy.
+
+        Wrapped in the Pallas fit-level fallback: a Mosaic failure at
+        production shapes disables the kernel and re-runs the sweep on the
+        XLA path (families re-key via ``trace_signature``). chunk_plan
+        consumes each family's ``grid_chunk``, so the retry restores the
+        pre-attempt values — otherwise the degraded-hardware pass would
+        dispatch the full grid unchunked.
         """
+        from ._pallas_hist import with_pallas_fallback
+        snaps = _snapshot_grid_chunks(families)
+
+        def attempt():
+            _restore_grid_chunks(snaps)
+            return self._validate_impl(families, X, y, base_weights, mesh)
+        return with_pallas_fallback(attempt)
+
+    def _validate_impl(self, families, X, y, base_weights=None, mesh=None):
         from ..evaluators.device_metrics import device_metric_fn
 
         splits = self._splits(y)
@@ -484,9 +532,9 @@ class _ValidatorBase:
         k_folds = len(splits)
 
         def chunk_plan(family):
-            """(fc, gc, stacked_chunks): fold/grid chunk sizes (divisors
-            of k_folds / grid_size — see _best_chunk) and the grid's
-            device-ready chunks."""
+            """(fc, g_sizes, stacked_chunks): fold chunk size (a divisor
+            of k_folds), the grid's chunk-size schedule (possibly ragged —
+            see _chunk_sizes) and its device-ready chunks."""
             fold_chunk = _auto_chunks(family, len(y), n_shards, k_folds,
                                       n_features=X.shape[1])
             gc = getattr(family, "grid_chunk", None) or family.grid_size()
@@ -494,11 +542,13 @@ class _ValidatorBase:
                 family.grid_chunk = None    # chunking happens here, not
             fc = fold_chunk or k_folds      # in fit_batch's lax.map
             fc = _best_chunk(k_folds, fc)
-            gc = _best_chunk(family.grid_size(), gc)
-            _finalize_tree_chunk(family, fc * gc)
-            return fc, gc, _grid_chunks(family, gc)
+            g_sizes = _chunk_sizes(family.grid_size(), gc)
+            _finalize_tree_chunk(family, fc * max(g_sizes))
+            return fc, g_sizes, _grid_chunks(family, g_sizes)
 
-        fused: Dict[int, Any] = {}
+        # one executable per (family, grid-chunk WIDTH) — a ragged schedule
+        # adds exactly one extra width for the remainder chunk
+        fused: Dict[int, Dict[int, Any]] = {}
         plans: Dict[int, Any] = {}
         to_compile = []
         for fi, family in enumerate(families):
@@ -509,31 +559,37 @@ class _ValidatorBase:
                 continue
             plan = chunk_plan(family)
             plans[fi] = plan
-            fc, gc, stacked_chunks = plan
-            key = (family.trace_signature(), self.task, self.metric_name,
-                   mesh_key, ("chunk", fc, gc),
-                   shapes_of((Xd, yd, wd[:fc], vwd[:fc],
-                              stacked_chunks[0])))
-            exe = _FUSED_EXE_CACHE.get(key)
-            if exe is not None:
-                fused[fi] = exe
-            else:
-                to_compile.append(
-                    (fi, key, jax.jit(make_fit_eval(family, metric_fn))))
+            fc, g_sizes, stacked_chunks = plan
+            exes: Dict[int, Any] = {}
+            jf = None
+            for gw, st in zip(g_sizes, stacked_chunks):
+                if gw in exes:
+                    continue
+                key = (family.trace_signature(), self.task, self.metric_name,
+                       mesh_key, ("chunk", fc, gw),
+                       shapes_of((Xd, yd, wd[:fc], vwd[:fc], st)))
+                exe = _FUSED_EXE_CACHE.get(key)
+                if exe is not None:
+                    exes[gw] = exe
+                else:
+                    if jf is None:
+                        jf = jax.jit(make_fit_eval(family, metric_fn))
+                    exes[gw] = None
+                    to_compile.append((fi, gw, key, jf, st))
+            fused[fi] = exes
 
         if to_compile:
             import concurrent.futures as cf
             with cf.ThreadPoolExecutor(len(to_compile)) as ex:
                 futs = []
-                for fi, key, jf in to_compile:
-                    fc, gc, stacked_chunks = plans[fi]
-                    futs.append((fi, key, ex.submit(
-                        lambda jf=jf, w=wd[:fc], v=vwd[:fc],
-                        st=stacked_chunks[0]:
+                for fi, gw, key, jf, st in to_compile:
+                    fc, g_sizes, stacked_chunks = plans[fi]
+                    futs.append((fi, gw, key, ex.submit(
+                        lambda jf=jf, w=wd[:fc], v=vwd[:fc], st=st:
                         jf.lower(Xd, yd, w, v, st).compile())))
-                for fi, key, fut in futs:
+                for fi, gw, key, fut in futs:
                     exe = fut.result()
-                    fused[fi] = exe
+                    fused[fi][gw] = exe
                     while len(_FUSED_EXE_CACHE) > 64:
                         _FUSED_EXE_CACHE.pop(
                             next(iter(_FUSED_EXE_CACHE)))   # FIFO evict
@@ -545,12 +601,12 @@ class _ValidatorBase:
         # AND serialize device execution against host latency
         fused_out: Dict[int, Any] = {}
         for fi in fused:
-            fc, gc, stacked_chunks = plans[fi]
+            fc, g_sizes, stacked_chunks = plans[fi]
             outs = []
             for i0 in range(0, k_folds, fc):
-                for st in stacked_chunks:
-                    outs.append(fused[fi](Xd, yd, wd[i0:i0 + fc],
-                                          vwd[i0:i0 + fc], st))
+                for gw, st in zip(g_sizes, stacked_chunks):
+                    outs.append(fused[fi][gw](Xd, yd, wd[i0:i0 + fc],
+                                              vwd[i0:i0 + fc], st))
             fused_out[fi] = outs
         fused_np = jax.device_get(fused_out)
 
@@ -558,14 +614,16 @@ class _ValidatorBase:
             k, g = len(splits), family.grid_size()
 
             if fi in fused:
-                fc, gc, stacked_chunks = plans[fi]
+                fc, g_sizes, stacked_chunks = plans[fi]
                 full = np.zeros((k, g))
                 ci = 0
                 for i0 in range(0, k, fc):
-                    for cj in range(len(stacked_chunks)):
-                        full[i0:i0 + fc, cj * gc:(cj + 1) * gc] = \
+                    col = 0
+                    for gw in g_sizes:
+                        full[i0:i0 + fc, col:col + gw] = \
                             np.asarray(fused_np[fi][ci])
                         ci += 1
+                        col += gw
                 per_grid_metrics = full.T                       # [G, K]
             else:
                 stacked = family.stack_grid()
@@ -629,6 +687,15 @@ class _ValidatorBase:
         one-hot column mask (each fold's engineered X has its own).
         Ref: ``OpCrossValidation.scala:89-116`` (per-fold dagCopy).
         """
+        from ._pallas_hist import with_pallas_fallback
+        snaps = _snapshot_grid_chunks(families)
+
+        def attempt():
+            _restore_grid_chunks(snaps)
+            return self._validate_per_fold_impl(families, fold_data, mesh)
+        return with_pallas_fallback(attempt)
+
+    def _validate_per_fold_impl(self, families, fold_data, mesh=None):
         from ..evaluators.device_metrics import device_metric_fn
 
         summary = ValidatorSummary("WorkflowCV:" + self.validation_type,
@@ -686,30 +753,38 @@ class _ValidatorBase:
                 gc = getattr(family, "grid_chunk", None) or g
                 if hasattr(family, "grid_chunk"):
                     family.grid_chunk = None
-                gc = _best_chunk(g, gc)
-                _finalize_tree_chunk(family, gc)   # one fold in flight
-                st_chunks = _grid_chunks(family, gc)
-                key = (family.trace_signature(), self.task, self.metric_name,
-                       mesh_key, ("per_fold", gc),
-                       tuple((tuple(a.shape), str(a.dtype)) for a in
-                             (Xd, yd, wd, vwd)))
-                exe = _FUSED_EXE_CACHE.get(key)
-                if exe is None:
-                    def fit_eval(X, y, w_folds, v_folds, stacked):
-                        def per_fold(w, v):
-                            params = family.fit_batch(X, y, w, stacked)
-                            pred, _raw, prob = family.predict_batch(
-                                params, X, on_train=True)
-                            return jax.vmap(
-                                lambda pg, prg: metric_fn(y, pg, prg, v)
-                            )(pred, prob)
-                        return jax.vmap(per_fold)(w_folds, v_folds)
-                    exe = jax.jit(fit_eval).lower(
-                        Xd, yd, wd, vwd, st_chunks[0]).compile()
-                    while len(_FUSED_EXE_CACHE) > 64:
-                        _FUSED_EXE_CACHE.pop(next(iter(_FUSED_EXE_CACHE)))
-                    _FUSED_EXE_CACHE[key] = exe
-                outs = [exe(Xd, yd, wd, vwd, st) for st in st_chunks]
+                g_sizes = _chunk_sizes(g, gc)
+                _finalize_tree_chunk(family, max(g_sizes))  # one fold live
+                st_chunks = _grid_chunks(family, g_sizes)
+
+                def fit_eval(X, y, w_folds, v_folds, stacked):
+                    def per_fold(w, v):
+                        params = family.fit_batch(X, y, w, stacked)
+                        pred, _raw, prob = family.predict_batch(
+                            params, X, on_train=True)
+                        return jax.vmap(
+                            lambda pg, prg: metric_fn(y, pg, prg, v)
+                        )(pred, prob)
+                    return jax.vmap(per_fold)(w_folds, v_folds)
+
+                exe_by_width: Dict[int, Any] = {}
+                for gw, st in zip(g_sizes, st_chunks):
+                    if gw in exe_by_width:
+                        continue
+                    key = (family.trace_signature(), self.task,
+                           self.metric_name, mesh_key, ("per_fold", gw),
+                           tuple((tuple(a.shape), str(a.dtype)) for a in
+                                 (Xd, yd, wd, vwd)))
+                    exe = _FUSED_EXE_CACHE.get(key)
+                    if exe is None:
+                        exe = jax.jit(fit_eval).lower(
+                            Xd, yd, wd, vwd, st).compile()
+                        while len(_FUSED_EXE_CACHE) > 64:
+                            _FUSED_EXE_CACHE.pop(next(iter(_FUSED_EXE_CACHE)))
+                        _FUSED_EXE_CACHE[key] = exe
+                    exe_by_width[gw] = exe
+                outs = [exe_by_width[gw](Xd, yd, wd, vwd, st)
+                        for gw, st in zip(g_sizes, st_chunks)]
                 per_grid[:, ki] = np.concatenate(
                     [np.asarray(o)[0] for o in outs])
             means = per_grid.mean(axis=1)
