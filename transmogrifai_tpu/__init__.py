@@ -10,6 +10,28 @@ computations over sharded device arrays, and the model-selection grid
 
 __version__ = "0.2.0"
 
+import logging as _logging
+
+_logging.getLogger(__name__).addHandler(_logging.NullHandler())
+
+
+def enable_logging(level: int = _logging.INFO) -> None:
+    """Turn on human-readable progress logging for the package.
+
+    The library itself only emits records (stage fit/transform timings,
+    chunk-plan decisions, Pallas gate/fallback events, runner phases) —
+    this attaches a stderr handler so a long run narrates itself, the
+    OpSparkListener-console analog. The runner CLI calls it by default."""
+    root = _logging.getLogger(__name__)
+    root.setLevel(level)
+    if not any(isinstance(h, _logging.StreamHandler)
+               for h in root.handlers):
+        h = _logging.StreamHandler()
+        h.setFormatter(_logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s", "%H:%M:%S"))
+        root.addHandler(h)
+
+
 from . import types  # noqa: F401
 from .columns import Column, ColumnStore, column_from_values  # noqa: F401
 from .features import Feature, FeatureBuilder  # noqa: F401

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -27,7 +28,10 @@ from .vector_metadata import VectorMetadata
 
 MODEL_JSON = "model.json"
 WEIGHTS_NPZ = "weights.npz"
-FORMAT_VERSION = 1
+#: v2: weights live in a save-unique ``weights-<id>.npz`` referenced by
+#: model.json's ``weightsFile`` (crash-consistent overwrites); v1 saves
+#: (fixed weights.npz) still load via the legacy branch
+FORMAT_VERSION = 2
 
 
 import functools
@@ -239,11 +243,18 @@ def save_workflow_model(model, path: str, overwrite: bool = False) -> None:
     with open(json_tmp, "w") as fh:
         json.dump(doc, fh, indent=1, default=str)
     os.replace(json_tmp, mj)
-    for fn in os.listdir(path):   # orphaned weights from prior/torn saves
+    # orphaned weights from prior/torn saves. Age-gated: a CONCURRENT
+    # saver's freshly written npz (its json replace still pending) must
+    # survive this sweep, or its final marker would reference a deleted
+    # file — only files quietly sitting around for a minute are orphans.
+    now = time.time()
+    for fn in os.listdir(path):
         if (fn.endswith(".npz") and fn != weights_name
                 and (fn.startswith("weights-") or fn == WEIGHTS_NPZ)):
             try:
-                os.remove(os.path.join(path, fn))
+                full = os.path.join(path, fn)
+                if now - os.path.getmtime(full) > 60.0:
+                    os.remove(full)
             except OSError:
                 pass
 
@@ -364,6 +375,11 @@ def load_workflow_model(path: str):
         try:
             with open(os.path.join(resolved, MODEL_JSON)) as fh:
                 doc = json.load(fh)
+            if int(doc.get("formatVersion", 1)) > FORMAT_VERSION:
+                raise ValueError(
+                    f"Model at {path} uses format "
+                    f"{doc['formatVersion']}, newer than this library "
+                    f"supports ({FORMAT_VERSION}); upgrade the package")
             arrays: Dict[str, np.ndarray] = {}
             if "weightsFile" in doc:
                 # new format: the marker references a weights file written

@@ -42,6 +42,7 @@ path. The gate value is part of the CV executable cache key
 from __future__ import annotations
 
 import functools
+import logging
 import os
 from typing import Optional
 
@@ -49,6 +50,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["cumhist", "pallas_histograms_enabled"]
 
@@ -161,9 +164,10 @@ def disable_pallas_histograms(exc: BaseException) -> bool:
     if not any(s in text for s in ("mosaic", "pallas", "vmem", "internal:")):
         return False
     import warnings
-    warnings.warn(
-        f"pallas histogram kernel failed at production shapes ({exc!r}); "
-        "retracing the tree engine onto the XLA matmul path")
+    msg = (f"pallas histogram kernel failed at production shapes ({exc!r}); "
+           "retracing the tree engine onto the XLA matmul path")
+    logger.warning(msg)
+    warnings.warn(msg)
     _PROBE = False
     return True
 
@@ -212,6 +216,8 @@ def pallas_histograms_enabled() -> bool:
                 jnp.zeros((16, 4), jnp.int32),
                 2, 2, interpret=False)
             _PROBE = bool(np.asarray(out).shape == (2, 3, 2, 4))
+            logger.info("pallas histogram kernel %s (compile probe)",
+                        "enabled" if _PROBE else "disabled")
         except Exception as e:  # Mosaic/backend failure → XLA path
             if detector is None:
                 # can't tell an eager failure from a mid-trace one (the
@@ -219,8 +225,9 @@ def pallas_histograms_enabled() -> bool:
                 # consult but leave the probe open for a later eager call
                 return False
             import warnings
-            warnings.warn(
-                f"pallas histogram kernel unavailable ({e!r}); "
-                "falling back to the XLA matmul path")
+            msg = (f"pallas histogram kernel unavailable ({e!r}); "
+                   "falling back to the XLA matmul path")
+            logger.warning(msg)
+            warnings.warn(msg)
             _PROBE = False
     return _PROBE

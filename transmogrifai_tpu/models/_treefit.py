@@ -254,7 +254,7 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
               crit, leaf_fn: Callable, max_depth: int,
               n_bins: int, min_instances, min_info_gain,
               depth_limit=None, feat_mask=None, max_active_nodes: int = 128,
-              col_blocks=None
+              col_blocks=None, node_feat_key=None, node_feat_k=None
               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Grow one tree level-wise; returns (feat [2^D−1], thr [2^D−1],
     leaf [2^D, K], node [n] final sample→leaf assignment).
@@ -263,8 +263,14 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
     scalars — ``depth_limit`` stops splitting past that level while the
     static scan runs to ``max_depth`` (nodes that stop route all samples
     left through +inf thresholds, so routing to depth ``max_depth`` is
-    exact). ``feat_mask`` [F] bool restricts candidate features (RF column
-    subsampling).
+    exact). ``feat_mask`` [F] bool restricts candidate features (per-TREE
+    column subsampling). ``node_feat_key``/``node_feat_k`` instead draw an
+    exactly-k candidate mask PER (level, slot): Spark RF samples features
+    per NODE (``featureSubsetStrategy``, used by
+    ``OpRandomForestClassifier.scala:159`` via MLlib's RandomForest), and
+    per-node draws decorrelate trees beyond a per-tree mask on correlated
+    features. The [A, F] uniform-threshold draw folds the level index into
+    the key, so the level scan body stays one compiled program.
 
     ``col_blocks`` — static list of (column-index ndarray, bins, thr_fn)
     partitioning the features into histogram blocks with different bin
@@ -302,6 +308,16 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
 
     def level(carry, d):
         slot, g, gpos, alive = carry
+        if node_feat_key is not None:
+            # per-node candidate draw: exactly node_feat_k features per
+            # slot, re-drawn every level (slot identity changes per level,
+            # so (level, slot) ≡ node)
+            ku = jax.random.fold_in(node_feat_key, d)
+            u = jax.random.uniform(ku, (A, F))
+            kth = jnp.sort(u, axis=1)[:, node_feat_k - 1][:, None]
+            node_mask = u <= kth                       # [A, F]
+        else:
+            node_mask = None
         # per-block cumulative histograms over slots; idle (slot == A) → 0.
         # Candidate axis = concat of every block's (bins−1)·F_b pairs.
         flats, oks, cums = [], [], []
@@ -316,6 +332,8 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
                 okb = okb & extra
             if feat_mask is not None:
                 okb = okb & feat_mask[jnp.asarray(cols)][None, None, :]
+            if node_mask is not None:
+                okb = okb & node_mask[:, jnp.asarray(cols)][:, None, :]
             flats.append(jnp.where(okb, sb, _NEG).reshape(A, -1))
             oks.append(okb.reshape(A, -1))
             cums.append(cumb)
@@ -525,7 +543,8 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
                max_depth: int, n_bins: int, min_instances, min_info_gain,
                num_trees_used, subsample_rate, depth_limit=None,
                max_active_nodes: int = 128, tree_chunk: int = 1,
-               binary_mask=None, seed: int = 7):
+               binary_mask=None, seed: int = 7,
+               per_node_features: bool = True):
     """Random forest via scanned bootstrap trees.
 
     Traced: min_instances, min_info_gain, num_trees_used (≤ n_trees,
@@ -543,13 +562,23 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
     boot = jax.random.poisson(
         k_boot, jnp.broadcast_to(jnp.asarray(subsample_rate, jnp.float32),
                                  ()), (n_trees, n)).astype(X.dtype)
+    per_node = False
+    feat_k = F
     if n_trees == 1:
         boot = jnp.ones((1, n), X.dtype)          # single DT: no bootstrap
         fmask = jnp.ones((1, F), bool)
     else:
         k = max(1, int(round(np.sqrt(F))) if task == "classification"
                 else max(1, F // 3))
-        fmask = _feature_masks(k_feat, n_trees, F, k)
+        per_node = per_node_features and k < F
+        if per_node:
+            # Spark-parity per-NODE candidate sampling: masks are drawn
+            # inside grow_tree's level scan from a per-tree key
+            feat_k = k
+            fmask = jnp.ones((n_trees, F), bool)
+        else:
+            fmask = _feature_masks(k_feat, n_trees, F, k)
+    fkeys = jax.random.split(k_feat, n_trees)
 
     if task == "classification":
         onehot = jax.nn.one_hot(y.astype(jnp.int32), n_classes, dtype=X.dtype)
@@ -563,13 +592,16 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
                 [wt, wt * y, wt * y * y, (wt > 0).astype(X.dtype)], axis=1)
         crit, leaf_fn = VarianceCriterion(), variance_leaf
 
-    def fit_one(bw, fm):
+    def fit_one(bw, fm, fk):
         wt = w * bw
         feat, thr, leaf, node, gain = grow_tree(
             Xb, edges, make_stats(wt), crit, leaf_fn, max_depth,
             n_bins, min_instances, min_info_gain, depth_limit=depth_limit,
-            feat_mask=fm, max_active_nodes=max_active_nodes,
-            col_blocks=col_blocks)
+            feat_mask=None if per_node else fm,
+            max_active_nodes=max_active_nodes,
+            col_blocks=col_blocks,
+            node_feat_key=fk if per_node else None,
+            node_feat_k=feat_k)
         return feat, thr, leaf, node, gain
 
     c = max(1, min(tree_chunk, n_trees))
@@ -577,13 +609,16 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
     if pad:
         boot = jnp.concatenate([boot, jnp.zeros((pad, n), boot.dtype)])
         fmask = jnp.concatenate([fmask, jnp.ones((pad, F), bool)])
+        fkeys = jnp.concatenate([fkeys, jnp.zeros((pad,) + fkeys.shape[1:],
+                                                  fkeys.dtype)])
     nc = (n_trees + pad) // c
 
     def body(_, per_chunk):
-        bw, fm = per_chunk                             # [c, n], [c, F]
-        return None, jax.vmap(fit_one)(bw, fm)
+        bw, fm, fk = per_chunk                  # [c, n], [c, F], [c, key]
+        return None, jax.vmap(fit_one)(bw, fm, fk)
     _, (feat, thr, leaf, node, gain) = lax.scan(
-        body, None, (boot.reshape(nc, c, n), fmask.reshape(nc, c, F)))
+        body, None, (boot.reshape(nc, c, n), fmask.reshape(nc, c, F),
+                     fkeys.reshape((nc, c) + fkeys.shape[1:])))
     feat = feat.reshape((nc * c,) + feat.shape[2:])[:n_trees]
     thr = thr.reshape((nc * c,) + thr.shape[2:])[:n_trees]
     leaf = leaf.reshape((nc * c,) + leaf.shape[2:])[:n_trees]

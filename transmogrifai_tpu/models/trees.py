@@ -298,10 +298,15 @@ class RandomForestFamily(_TreeFamilyBase):
 
     def __init__(self, grid=None, task: Optional[str] = None,
                  n_classes: int = 2, num_trees: int = 50, seed: int = 7,
-                 **fixed):
+                 per_node_features: bool = True, **fixed):
         super().__init__(grid, task=task, n_classes=n_classes, seed=seed,
                          **fixed)
         self.num_trees = num_trees
+        #: Spark-parity per-node candidate feature sampling (MLlib
+        #: featureSubsetStrategy); False reverts to per-tree masks.
+        #: Lands in trace_signature via __dict__, so flipping it re-keys
+        #: the compiled-executable cache.
+        self.per_node_features = per_node_features
         if task == "regression":
             self.name = "OpRandomForestRegressor"
             self.task = "regression"
@@ -327,7 +332,8 @@ class RandomForestFamily(_TreeFamilyBase):
             max_active_nodes=self.max_active_nodes,
             tree_chunk=self.tree_chunk
             or getattr(self, "_tree_chunk_auto", 1),
-            binary_mask=self.binary_mask, seed=self.seed)
+            binary_mask=self.binary_mask, seed=self.seed,
+            per_node_features=getattr(self, "per_node_features", True))
 
 
 class DecisionTreeFamily(RandomForestFamily):

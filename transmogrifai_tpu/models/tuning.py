@@ -22,12 +22,15 @@ TPU re-design highlights:
 from __future__ import annotations
 
 import functools
+import logging
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from ..columns import ColumnStore
 from ..evaluators import metrics as M
@@ -544,6 +547,11 @@ class _ValidatorBase:
             fc = _best_chunk(k_folds, fc)
             g_sizes = _chunk_sizes(family.grid_size(), gc)
             _finalize_tree_chunk(family, fc * max(g_sizes))
+            logger.info(
+                "chunk plan %s: fold_chunk=%d/%d grid_chunks=%s%s",
+                family.name, fc, k_folds, g_sizes,
+                f" tree_chunk={family._tree_chunk_auto}"
+                if getattr(family, "_tree_chunk_auto", None) else "")
             return fc, g_sizes, _grid_chunks(family, g_sizes)
 
         # one executable per (family, grid-chunk WIDTH) — a ragged schedule
@@ -580,6 +588,10 @@ class _ValidatorBase:
 
         if to_compile:
             import concurrent.futures as cf
+            import time as _time
+            tc0 = _time.time()
+            logger.info("compiling %d fused fit+predict+metric program(s) "
+                        "concurrently", len(to_compile))
             with cf.ThreadPoolExecutor(len(to_compile)) as ex:
                 futs = []
                 for fi, gw, key, jf, st in to_compile:
@@ -594,6 +606,8 @@ class _ValidatorBase:
                         _FUSED_EXE_CACHE.pop(
                             next(iter(_FUSED_EXE_CACHE)))   # FIFO evict
                     _FUSED_EXE_CACHE[key] = exe
+            logger.info("compile phase done in %.2fs (max over families, "
+                        "not sum — concurrent)", _time.time() - tc0)
 
         # dispatch every chunk of every family FIRST (async — the device
         # queues them back-to-back), then ONE batched metrics pull: per-

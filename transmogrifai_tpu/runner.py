@@ -16,10 +16,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import logging
 import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["OpParams", "RunType", "RunnerResult", "OpWorkflowRunner",
            "OpApp"]
@@ -129,6 +132,8 @@ class OpWorkflowRunner:
             raise ValueError(
                 f"Unknown run type {run_type!r}; expected one of "
                 f"{RunType.ALL}")
+        logger.info("run type=%s model=%s write=%s", run_type,
+                    params.model_location, params.write_location)
         t0 = time.time()
         if run_type == RunType.TRAIN:
             params.apply_to_workflow(self.workflow)
@@ -288,7 +293,12 @@ class OpApp:
         ap.add_argument("--model-location")
         ap.add_argument("--write-location")
         ap.add_argument("--metrics-location")
+        ap.add_argument("--quiet", action="store_true",
+                        help="suppress INFO progress logging")
         args = ap.parse_args(argv)
+        if not args.quiet:
+            from . import enable_logging
+            enable_logging()
         params = (OpParams.from_file(args.params) if args.params
                   else OpParams())
         if args.model_location:

@@ -22,11 +22,14 @@ Re-designs ``OpWorkflow`` / ``OpWorkflowModel`` / ``FitStagesUtil``
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from .columns import Column, ColumnStore
 from .features import Feature, copy_dag
@@ -89,6 +92,38 @@ _DEVICE_BW_MBPS: Optional[float] = None
 #: jitted per-layer programs keyed by (model ids, prepared shapes)
 _LAYER_JIT_CACHE: Dict[Any, Any] = {}
 
+#: process-wide XLA compile-time clock fed by jax.monitoring duration
+#: events; stage timers snapshot it to split fit wall-clock into
+#: compile-vs-execute (OpSparkListener's stage breakdown analog).
+#: NOTE this sums compile WORK: concurrent compiles (the CV engine's
+#: thread-pool phase) can make the delta exceed wall-clock, so consumers
+#: clamp to the stage's elapsed time.
+_COMPILE_CLOCK = {"s": 0.0}
+_COMPILE_LISTENER_ON = [False]
+_COMPILE_CLOCK_LOCK = None
+
+
+def _ensure_compile_listener() -> None:
+    global _COMPILE_CLOCK_LOCK
+    if _COMPILE_LISTENER_ON[0]:
+        return
+    import threading
+
+    from jax import monitoring
+    _COMPILE_CLOCK_LOCK = threading.Lock()
+
+    def on_event(event: str, duration: float, **_kw) -> None:
+        if event.startswith("/jax/core/compile/"):
+            with _COMPILE_CLOCK_LOCK:
+                _COMPILE_CLOCK["s"] += duration
+    monitoring.register_event_duration_secs_listener(on_event)
+    _COMPILE_LISTENER_ON[0] = True
+
+
+def compile_clock_s() -> float:
+    """Cumulative XLA trace+lower+compile seconds in this process."""
+    return _COMPILE_CLOCK["s"]
+
 
 def device_roundtrip_mbps() -> float:
     """Measured host→device→host bandwidth (MB/s); probed once per process
@@ -105,6 +140,11 @@ def device_roundtrip_mbps() -> float:
             dt = max(time.time() - t0, 1e-9)
             best = max(best, (2 * buf.nbytes / 1e6) / dt)
         _DEVICE_BW_MBPS = best
+        logger.info(
+            "host<->device bandwidth: %.0f MB/s (%s) -> layer fusion %s",
+            best, jax.devices()[0].platform,
+            "ON" if best >= FUSE_MIN_BANDWIDTH_MBPS else
+            "OFF (tunnelled/slow link: transforms stay on host)")
     return _DEVICE_BW_MBPS
 
 
@@ -354,12 +394,20 @@ class Workflow:
         # layer checkpoints must record THIS graph, not the original
         self._active_result_features = result_features
         dag = compute_dag(result_features)
+        logger.info(
+            "train: %d rows (%d held out), %d DAG layers, %d stages%s",
+            train_store.n_rows,
+            test_store.n_rows if test_store is not None else 0,
+            len(dag), sum(len(l) for l in dag),
+            " [workflow-level CV]" if self._workflow_cv else "")
         if self._workflow_cv:
             fitted, train_time = self._fit_dag_workflow_cv(
                 result_features, dag, train_store, test_store)
         else:
             fitted, train_time, _, _ = self._fit_dag(
                 dag, train_store, test_store)
+        logger.info("train: done in %.2fs (%d fitted stages)",
+                    train_time, len(fitted))
         return WorkflowModel(
             result_features=result_features,
             fitted_stages=fitted,
@@ -380,8 +428,9 @@ class Workflow:
         """Fold layers: fit estimators, holdout-eval, transform both splits
         (FitStagesUtil.fitAndTransformDAG/Layer)."""
         t0 = time.time()
+        _ensure_compile_listener()
         fitted = {} if fitted is None else fitted
-        for layer in dag:
+        for li, layer in enumerate(dag):
             models: List[Transformer] = []
             n_fitted_before = len(fitted)
             for stage in layer:
@@ -400,10 +449,27 @@ class Workflow:
                         model._output_feature = stage.get_output()
                         metrics["warmStarted"] = True
                         metrics["fitSeconds"] = 0.0
+                        logger.info("layer %d: %s [%s] warm-started",
+                                    li, stage.stage_name(), stage.uid)
                     else:
+                        logger.info("layer %d: fitting %s [%s] on %d rows",
+                                    li, stage.stage_name(), stage.uid,
+                                    train.n_rows)
                         tf = time.time()
+                        c0 = _COMPILE_CLOCK["s"]
                         model = stage.fit(train)
-                        metrics["fitSeconds"] = round(time.time() - tf, 4)
+                        fit_s = time.time() - tf
+                        # clamp: concurrent compiles sum WORK > wall-clock
+                        compile_s = min(_COMPILE_CLOCK["s"] - c0, fit_s)
+                        metrics["fitSeconds"] = round(fit_s, 4)
+                        metrics["compileSeconds"] = round(compile_s, 4)
+                        metrics["executeSeconds"] = round(
+                            max(fit_s - compile_s, 0.0), 4)
+                        logger.info(
+                            "layer %d: %s fit in %.2fs "
+                            "(compile %.2fs, execute %.2fs)",
+                            li, stage.stage_name(), fit_s, compile_s,
+                            max(fit_s - compile_s, 0.0))
                     fitted[stage.uid] = model
                     if model.has_test_eval() and test is not None:
                         model.evaluate_model(test)
@@ -419,6 +485,9 @@ class Workflow:
             if test is not None:
                 test = apply_layer_vectorized(models, test)
             layer_transform_s = time.time() - tt
+            if models:
+                logger.info("layer %d: transformed %d stage(s) in %.2fs",
+                            li, len(models), layer_transform_s)
             for m in models:
                 self._stage_metrics.setdefault(
                     m.uid, {"stageName": m.stage_name()})[
@@ -436,6 +505,9 @@ class Workflow:
                     _atomic_checkpoint(WorkflowModel(
                         result_features=feats, fitted_stages=fitted),
                         self._checkpoint_dir)
+                    logger.info(
+                        "layer %d: checkpointed %d fitted stage(s) to %s",
+                        li, len(fitted), self._checkpoint_dir)
         return fitted, time.time() - t0, train, test
 
     def _fit_dag_workflow_cv(self, result_features, dag: StagesDAG,
@@ -644,11 +716,14 @@ class WorkflowModel:
         parts = []
         if self.stage_metrics:
             rows = [[m.get("stageName", uid), uid,
-                     m.get("fitSeconds"), m.get("layerTransformSeconds"),
+                     m.get("fitSeconds"), m.get("compileSeconds"),
+                     m.get("executeSeconds"),
+                     m.get("layerTransformSeconds"),
                      "yes" if m.get("warmStarted") else ""]
                     for uid, m in sorted(self.stage_metrics.items())]
             parts.append(Table(
-                ["stage", "uid", "fit s", "layer transform s", "warm"],
+                ["stage", "uid", "fit s", "compile s", "execute s",
+                 "layer transform s", "warm"],
                 rows, name="Stage metrics").render())
         doc = self.summary()
         doc.pop("stageMetrics", None)
