@@ -1,0 +1,64 @@
+"""Worker program for the two-process jax.distributed test.
+
+Each process joins a local coordinator, runs a GSPMD-sharded computation
+over the 2-process global device set (a cross-host psum rides the
+coordination backend), and routes a shared-filesystem write through the
+coordinator gate. Invoked as:
+
+    python _multihost_worker.py <coordinator host:port> <rank> <outdir>
+"""
+import json
+import os
+import sys
+
+
+def main() -> None:
+    addr, rank, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+    import jax
+
+    # the axon shim pins jax_platforms at interpreter start; override
+    # BEFORE any backend init (same as tests/conftest.py)
+    jax.config.update("jax_platforms", "cpu")
+
+    from transmogrifai_tpu.parallel import multihost
+
+    assert multihost.initialize(coordinator_address=addr,
+                                num_processes=2, process_id=rank) is True
+    assert multihost.is_distributed(), "process_count should be 2"
+    assert jax.process_count() == 2
+    assert multihost.is_coordinator() == (rank == 0)
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    # one global mesh over both processes' devices; a row-sharded gram
+    # matrix forces a cross-process reduction (the fit path's collective)
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("data",))
+    n, d = 8, 3
+    X_host = np.arange(n * d, dtype=np.float32).reshape(n, d)
+    X = jax.make_array_from_callback(
+        (n, d), NamedSharding(mesh, P("data")),
+        lambda idx: X_host[idx])
+    gram = jax.jit(lambda a: a.T @ a)(X)
+    np.testing.assert_allclose(np.asarray(gram), X_host.T @ X_host,
+                               rtol=1e-6)
+
+    # coordinator-gated shared-filesystem write (runner metrics-sink path)
+    from transmogrifai_tpu.runner import OpWorkflowRunner
+
+    OpWorkflowRunner._write_metrics(
+        os.path.join(outdir, "metrics.json"),
+        {"writer_rank": rank, **multihost.process_summary()})
+
+    # per-process completion marker (not coordinator-gated, for the parent)
+    with open(os.path.join(outdir, f"done-{rank}"), "w") as fh:
+        json.dump({"gram00": float(np.asarray(gram)[0, 0])}, fh)
+    print(f"worker {rank} ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""OPCollectionTransformer family: scalar unary transforms lifted over
+maps/lists/sets (OPCollectionTransformer.scala:1-209) — columnar lift,
+type validation at wiring, empty-in → empty-out, persistence."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, Workflow, WorkflowModel
+from transmogrifai_tpu.columns import ColumnStore, column_from_values
+from transmogrifai_tpu.ops.collections import (OPListTransformer,
+                                               OPMapTransformer,
+                                               OPSetTransformer,
+                                               lift_to_collection)
+from transmogrifai_tpu.ops.scalers import ScalerTransformer
+from transmogrifai_tpu.ops.text_suite import EmailParser
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def test_map_values_lift():
+    store = ColumnStore.from_dict({
+        "m": (ft.RealMap, [{"a": 1.0, "b": 2.0}, {"a": 3.0}, {}])})
+    feat = FeatureBuilder.RealMap("m").from_column().as_predictor()
+    lifted = OPMapTransformer(ScalerTransformer(slope=10.0, intercept=1.0))
+    out_feat = feat.transform_with(lifted)
+    assert out_feat.ftype is ft.RealMap
+    out = lifted.transform_columns(store)
+    assert out.get_raw(0) == {"a": 11.0, "b": 21.0}
+    assert out.get_raw(1) == {"a": 31.0}
+    assert out.get_raw(2) == {}                      # empty in → empty out
+
+
+def test_list_and_set_lift():
+    store = ColumnStore.from_dict({
+        "l": (ft.TextList, [["x@a.com", "y@b.org"], [], ["z@a.com"]])})
+    sstore = ColumnStore.from_dict({
+        "s": (ft.MultiPickList, [{"u@a.com", "v@a.com"}, set()])})
+    lifted_l = OPListTransformer(EmailParser(part="domain"))
+    lf = FeatureBuilder.TextList("l").from_column().as_predictor()
+    out_feat = lf.transform_with(lifted_l)
+    assert out_feat.ftype is ft.TextList
+    out = lifted_l.transform_columns(store)
+    assert out.get_raw(0) == ["a.com", "b.org"]
+    assert out.get_raw(1) == []
+    assert out.get_raw(2) == ["a.com"]
+
+    lifted_s = OPSetTransformer(EmailParser(part="domain"))
+    sf = FeatureBuilder.MultiPickList("s").from_column().as_predictor()
+    sout_feat = sf.transform_with(lifted_s)
+    assert sout_feat.ftype is ft.MultiPickList
+    sout = lifted_s.transform_columns(sstore)
+    assert sout.get_raw(0) == {"a.com"}              # set semantics dedupe
+    assert sout.get_raw(1) == set()
+
+
+def test_type_validation_at_wiring():
+    # Real-scalar transformer cannot lift over a Text-element collection
+    bad = OPListTransformer(ScalerTransformer())
+    lf = FeatureBuilder.TextList("l").from_column().as_predictor()
+    with pytest.raises(TypeError, match="not convertible"):
+        lf.transform_with(bad)
+
+    with pytest.raises(TypeError, match="not convertible"):
+        lift_to_collection(ScalerTransformer(), ft.TextMap)
+    # and the factory picks the right lift for a matching pair
+    ok = lift_to_collection(ScalerTransformer(), ft.RealMap)
+    assert isinstance(ok, OPMapTransformer)
+
+
+def test_lifted_transform_in_workflow_and_persistence(tmp_path):
+    """A lifted stage rides the DAG, and the nested scalar transformer
+    round-trips through model save/load (the __stage__ codec)."""
+    store = ColumnStore.from_dict({
+        "m": (ft.RealMap, [{"a": 1.0}, {"a": 2.0, "b": -1.0}])})
+    feat = FeatureBuilder.RealMap("m").from_column().as_predictor()
+    lifted = OPMapTransformer(ScalerTransformer(slope=2.0))
+    out_feat = feat.transform_with(lifted)
+    model = (Workflow().set_input_store(store)
+             .set_result_features(out_feat).train())
+    scored = model.transform(store)
+    assert scored[out_feat.name].get_raw(1) == {"a": 4.0, "b": -2.0}
+
+    path = str(tmp_path / "m")
+    model.save(path)
+    loaded = WorkflowModel.load(path)
+    re_scored = loaded.transform(store)
+    assert re_scored[out_feat.name].get_raw(1) == {"a": 4.0, "b": -2.0}

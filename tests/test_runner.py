@@ -218,3 +218,41 @@ def test_train_logs_and_compile_split(rng, caplog, tmp_path):
     assert sel_metrics["fitSeconds"] >= sel_metrics["executeSeconds"]
     pretty = model.summary_pretty()
     assert "compile s" in pretty and "execute s" in pretty
+
+
+def test_score_avro_output_roundtrip(rng, tmp_path):
+    """VERDICT r2 #10: the Score run type writes Avro (saveScores /
+    RichDataset.saveAvro analog) when the sink path ends in .avro, with
+    the store column-pruned to result features; the package's own decoder
+    round-trips it."""
+    from transmogrifai_tpu.readers.avro import read_avro_records
+
+    records = _records(rng)
+    reader = _ListReader(records)
+    wf, label, pred, _sel = _flow()
+    runner = OpWorkflowRunner(wf, training_reader=reader,
+                              scoring_reader=reader)
+    params = OpParams(model_location=str(tmp_path / "model"),
+                      write_location=str(tmp_path / "scores.avro"))
+    runner.run(RunType.TRAIN, params)
+    out = runner.run(RunType.SCORE, params)
+
+    back = read_avro_records(params.write_location)
+    assert len(back) == len(records)
+    # pruned to the result feature column (+ no intermediate vectors)
+    assert set(back[0].keys()) == set(out.scores.names())
+    row0 = back[0][pred.name]
+    assert "prediction" in row0 and any(k.startswith("prob") for k in row0)
+    preds = [r[pred.name]["prediction"] for r in back]
+    np.testing.assert_allclose(
+        preds, np.asarray(out.scores[pred.name].prediction), rtol=1e-12)
+
+    # streaming scoring writes the same container incrementally
+    params2 = OpParams(model_location=params.model_location,
+                       write_location=str(tmp_path / "stream.avro"),
+                       custom_params={"batchSize": 64})
+    runner.run(RunType.STREAMING_SCORE, params2)
+    back2 = read_avro_records(params2.write_location)
+    assert len(back2) == len(records)
+    np.testing.assert_allclose(
+        [r[pred.name]["prediction"] for r in back2], preds, rtol=1e-12)

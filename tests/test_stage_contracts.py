@@ -200,6 +200,36 @@ def _cases():
         return stage, feats, store
     cases["DateListVectorizer"] = date_list_case
 
+    # collection lifts (OPCollectionTransformer family) --------------------
+    from transmogrifai_tpu.ops.collections import (OPListTransformer,
+                                                   OPMapTransformer,
+                                                   OPSetTransformer)
+    from transmogrifai_tpu.ops.text_suite import EmailParser
+
+    def map_lift_case():
+        stage = OPMapTransformer(ScalerTransformer(slope=2.0, intercept=1.0))
+        feats = [_f("a", ft.RealMap)]
+        store = ColumnStore({"a": RandomData.real_maps()
+                             .column(ft.RealMap, N)})
+        return stage, feats, store
+    cases["OPMapTransformer"] = map_lift_case
+
+    def list_lift_case():
+        stage = OPListTransformer(EmailParser(part="domain"))
+        feats = [_f("a", ft.TextList)]
+        store = ColumnStore({"a": RandomData.text_lists()
+                             .column(ft.TextList, N)})
+        return stage, feats, store
+    cases["OPListTransformer"] = list_lift_case
+
+    def set_lift_case():
+        stage = OPSetTransformer(EmailParser(part="domain"))
+        feats = [_f("a", ft.MultiPickList)]
+        store = ColumnStore({"a": RandomData.multi_picklists()
+                             .column(ft.MultiPickList, N)})
+        return stage, feats, store
+    cases["OPSetTransformer"] = set_lift_case
+
     def geo_case():
         stage = GeolocationVectorizer()
         feats = [_f("a", ft.Geolocation)]

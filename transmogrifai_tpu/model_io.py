@@ -88,6 +88,19 @@ def _encode_param(v: Any, arrays: Dict[str, np.ndarray], prefix: str) -> Any:
         return {str(k): _encode_param(x, arrays, prefix) for k, x in v.items()}
     if isinstance(v, _codec_bases()):
         return _encode_obj(v, arrays, prefix)
+    if isinstance(v, OpPipelineStage):
+        # nested stage (e.g. the scalar transformer inside an
+        # OPCollectionTransformer lift): className + ctor params
+        # (+ fitted state), decoded through the same registry as
+        # top-level stage records
+        rec: Dict[str, Any] = {
+            "__stage__": type(v).__name__,
+            "params": {k: _encode_param(x, arrays, prefix)
+                       for k, x in v.get_params().items()}}
+        if isinstance(v, FittedModel):
+            rec["modelState"] = {k: _encode_param(x, arrays, prefix)
+                                 for k, x in v.get_model_state().items()}
+        return rec
     if callable(v):
         return {"__dropped_callable__": getattr(v, "__name__", "fn")}
     return v
@@ -101,6 +114,26 @@ def _decode_param(v: Any, arrays: Dict[str, np.ndarray]) -> Any:
             return arrays[v["__array__"]]
         if "__vecmeta__" in v:
             return VectorMetadata.from_json(v["__vecmeta__"])
+        if "__stage__" in v:
+            cls = STAGE_REGISTRY.get(v["__stage__"])
+            if cls is None:
+                raise ValueError(
+                    f"Nested stage class {v['__stage__']!r} is not "
+                    "registered; import its module before loading")
+            params = {k: _decode_param(x, arrays)
+                      for k, x in v["params"].items()}
+            params.pop("uid", None)
+            stage = cls(**params)
+            state = v.get("modelState")
+            if state:
+                decoded = {k: _decode_param(x, arrays)
+                           for k, x in state.items()}
+                if hasattr(stage, "apply_model_state"):
+                    stage.apply_model_state(decoded)
+                else:
+                    for k, x in decoded.items():
+                        setattr(stage, k, x)
+            return stage
         if "__obj__" in v:
             import importlib
             mod_name, _, qual = v["__obj__"].partition(":")

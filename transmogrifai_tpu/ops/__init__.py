@@ -14,3 +14,5 @@ from .indexers import (OpStringIndexerNoFilter, OpStringIndexerModel,  # noqa: F
 from .text_suite import (OpCountVectorizer, CountVectorizerModel,  # noqa: F401
                          NGramSimilarity, EmailParser, PhoneNumberParser,
                          UrlParser, MimeTypeDetector)
+from .collections import (OPMapTransformer, OPListTransformer,  # noqa: F401
+                          OPSetTransformer, lift_to_collection)

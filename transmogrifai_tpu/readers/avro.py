@@ -13,9 +13,10 @@ from __future__ import annotations
 import json
 import struct
 import zlib
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["read_avro_records", "AvroDecodeError"]
+__all__ = ["read_avro_records", "AvroDecodeError", "AvroWriter",
+           "write_avro_records", "infer_avro_schema"]
 
 _MAGIC = b"Obj\x01"
 
@@ -122,7 +123,11 @@ def _decode(cur: _Cursor, schema: Any, named: Dict[str, Any]) -> Any:
                 n = -n
                 cur.zigzag_long()
             for _ in range(n):
-                m[cur.string()] = _decode(cur, schema["values"], named)
+                # key must be read BEFORE the value — and Python evaluates
+                # the assignment's RHS first, so m[cur.string()] = decode()
+                # would consume them in the wrong order
+                k = cur.string()
+                m[k] = _decode(cur, schema["values"], named)
         return m
     return _decode(cur, t, named)     # e.g. {"type": "string"}
 
@@ -177,3 +182,223 @@ def read_avro_records(path: str) -> List[Dict[str, Any]]:
         if cur.read(16) != sync:
             raise AvroDecodeError("Sync marker mismatch")
     return records
+
+
+# ---------------------------------------------------------------------------
+# Encoder — score output (OpWorkflowModel.saveScores / RichDataset.saveAvro,
+# core/.../OpWorkflowModel.scala:376-421). Counterpart of the decoder above:
+# same container format, null/deflate codecs, same schema subset.
+# ---------------------------------------------------------------------------
+
+def _zigzag_bytes(v: int) -> bytes:
+    u = (v << 1) ^ (v >> 63) if v < 0 else (v << 1)
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _encode(buf: bytearray, schema: Any, value: Any,
+            named: Dict[str, Any]) -> None:
+    if isinstance(schema, str):
+        s = schema
+        if s == "null":
+            return
+        if s == "boolean":
+            buf += b"\x01" if value else b"\x00"
+        elif s in ("int", "long"):
+            buf += _zigzag_bytes(int(value))
+        elif s == "float":
+            buf += struct.pack("<f", float(value))
+        elif s == "double":
+            buf += struct.pack("<d", float(value))
+        elif s == "bytes":
+            b = bytes(value)
+            buf += _zigzag_bytes(len(b)) + b
+        elif s == "string":
+            b = str(value).encode("utf-8")
+            buf += _zigzag_bytes(len(b)) + b
+        elif s in named:
+            _encode(buf, named[s], value, named)
+        else:
+            raise AvroDecodeError(f"Unknown schema reference {s!r}")
+        return
+    if isinstance(schema, list):                  # union: pick the branch
+        for idx, branch in enumerate(schema):
+            if _union_matches(branch, value):
+                buf += _zigzag_bytes(idx)
+                _encode(buf, branch, value, named)
+                return
+        raise AvroDecodeError(
+            f"No union branch of {schema} matches {type(value).__name__}")
+    t = schema["type"]
+    if t == "record":
+        _register(schema, named)
+        for f in schema["fields"]:
+            _encode(buf, f["type"], (value or {}).get(f["name"]), named)
+    elif t == "enum":
+        _register(schema, named)
+        buf += _zigzag_bytes(schema["symbols"].index(value))
+    elif t == "fixed":
+        _register(schema, named)
+        buf += bytes(value)
+    elif t == "array":
+        items = list(value or ())
+        if items:
+            buf += _zigzag_bytes(len(items))
+            for it in items:
+                _encode(buf, schema["items"], it, named)
+        buf += _zigzag_bytes(0)
+    elif t == "map":
+        entries = dict(value or {})
+        if entries:
+            buf += _zigzag_bytes(len(entries))
+            for k, v in entries.items():
+                kb = str(k).encode("utf-8")
+                buf += _zigzag_bytes(len(kb)) + kb
+                _encode(buf, schema["values"], v, named)
+        buf += _zigzag_bytes(0)
+    else:
+        _encode(buf, t, value, named)
+
+
+def _union_matches(branch: Any, value: Any) -> bool:
+    if branch == "null":
+        return value is None
+    if value is None:
+        return False
+    if branch == "boolean":
+        return isinstance(value, bool)
+    if branch in ("int", "long"):
+        return isinstance(value, int) and not isinstance(value, bool)
+    if branch in ("float", "double"):
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    if branch == "string":
+        return isinstance(value, str)
+    if branch == "bytes":
+        return isinstance(value, (bytes, bytearray))
+    if isinstance(branch, dict):
+        t = branch.get("type")
+        if t == "array":
+            return isinstance(value, (list, tuple))
+        if t in ("map", "record"):
+            return isinstance(value, dict)
+    return True
+
+
+def _infer_value_schema(values: List[Any]) -> Any:
+    """ALWAYS-nullable union schema for one field's observed values.
+
+    Unconditional nullability (and a long+double pair for numerics) keeps
+    a schema inferred from the FIRST streaming batch valid for later
+    batches whose null pattern or int/float flavor differs — the sink
+    locks the container schema at the first block. Collection element
+    schemas are unions too, so None elements inside lists/maps encode.
+    All-None fields get a catch-all branch set."""
+    present = [v for v in values if v is not None]
+    if not present:
+        return ["null", "long", "double", "string"]
+    if all(isinstance(p, bool) for p in present):
+        return ["null", "boolean"]
+    if all(isinstance(p, (int, float)) and not isinstance(p, bool)
+           for p in present):
+        return ["null", "long", "double"]
+    if all(isinstance(p, (bytes, bytearray)) for p in present):
+        return ["null", "bytes"]
+    if all(isinstance(p, dict) for p in present):
+        inner = _infer_value_schema(
+            [x for p in present for x in p.values()])
+        return ["null", {"type": "map", "values": inner}]
+    if all(isinstance(p, (list, tuple, set, frozenset)) for p in present):
+        inner = _infer_value_schema([x for p in present for x in p])
+        return ["null", {"type": "array", "items": inner}]
+    return ["null", "string"]
+
+
+def infer_avro_schema(records: List[Dict[str, Any]],
+                      name: str = "ScoreRecord") -> Dict[str, Any]:
+    """Record schema from score rows (field order = first-seen order)."""
+    fields: List[str] = []
+    for r in records:
+        for k in r:
+            if k not in fields:
+                fields.append(k)
+    return {"type": "record", "name": name,
+            "fields": [{"name": f,
+                        "type": _infer_value_schema(
+                            [r.get(f) for r in records])}
+                       for f in fields]}
+
+
+class AvroWriter:
+    """Streaming Avro container writer (null/deflate codecs).
+
+    Header (magic + metadata + sync marker) goes out on construction;
+    each :meth:`append` emits one sync-delimited block, so the sink can
+    stream scoring batches without holding the dataset (the
+    StreamingScore regime)."""
+
+    def __init__(self, path: str, schema: Dict[str, Any],
+                 codec: str = "deflate"):
+        import os as _os
+        import secrets
+
+        if codec not in ("null", "deflate"):
+            raise AvroDecodeError(f"Unsupported avro codec {codec!r}")
+        self.schema = schema
+        self.codec = codec
+        self._named: Dict[str, Any] = {}
+        self._sync = secrets.token_bytes(16)
+        d = _os.path.dirname(path)
+        if d:
+            _os.makedirs(d, exist_ok=True)
+        self._fh = open(path, "wb")
+        header = bytearray(_MAGIC)
+        meta = {"avro.schema": json.dumps(schema).encode(),
+                "avro.codec": codec.encode()}
+        header += _zigzag_bytes(len(meta))
+        for k, v in meta.items():
+            kb = k.encode()
+            header += _zigzag_bytes(len(kb)) + kb
+            header += _zigzag_bytes(len(v)) + v
+        header += _zigzag_bytes(0)
+        header += self._sync
+        self._fh.write(bytes(header))
+
+    def append(self, records: List[Dict[str, Any]]) -> None:
+        if not records:
+            return
+        buf = bytearray()
+        for r in records:
+            _encode(buf, self.schema, r, self._named)
+        block = bytes(buf)
+        if self.codec == "deflate":
+            co = zlib.compressobj(wbits=-15)
+            block = co.compress(block) + co.flush()
+        out = bytearray()
+        out += _zigzag_bytes(len(records))
+        out += _zigzag_bytes(len(block))
+        out += block
+        out += self._sync
+        self._fh.write(bytes(out))
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def write_avro_records(path: str, records: List[Dict[str, Any]],
+                       schema: Optional[Dict[str, Any]] = None,
+                       codec: str = "deflate") -> None:
+    """One-shot counterpart of :func:`read_avro_records`."""
+    w = AvroWriter(path, schema or infer_avro_schema(records), codec)
+    try:
+        w.append(records)
+    finally:
+        w.close()

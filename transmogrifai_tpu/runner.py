@@ -184,7 +184,7 @@ class OpWorkflowRunner:
             batches = (data[i:i + batch] for i in range(0, len(data), batch))
             rows = 0
             n_batches = 0
-            sink = (_CsvSink(params.write_location)
+            sink = (_make_sink(params.write_location)
                     if params.write_location else None)
             try:
                 for scored in stream_score(model, batches):
@@ -270,9 +270,75 @@ class _CsvSink:
             self._fh.close()
 
 
+class _AvroSink:
+    """Incremental Avro container sink (``saveScores``/``saveAvro``,
+    ``OpWorkflowModel.scala:376-421``): schema inferred from the first
+    batch, each batch appended as one sync-delimited block. Score stores
+    are already column-pruned to the result features (+ keys) by
+    ``WorkflowModel.score``. Coordinator-only, like the CSV sink."""
+
+    def __init__(self, path: str):
+        from .parallel.multihost import is_coordinator
+        self._active = is_coordinator()
+        self._path = path
+        self._names = None
+        self._writer = None
+
+    def write_header(self, names) -> None:
+        if self._names is None:
+            self._names = list(names)
+
+    @staticmethod
+    def _pyify(v):
+        import numpy as np
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+        if isinstance(v, np.generic):
+            return v.item()
+        if isinstance(v, dict):
+            return {k: _AvroSink._pyify(x) for k, x in v.items()}
+        if isinstance(v, (set, frozenset)):
+            return sorted(_AvroSink._pyify(x) for x in v)
+        if isinstance(v, (list, tuple)):
+            return [_AvroSink._pyify(x) for x in v]
+        return v
+
+    def write(self, store) -> None:
+        self.write_header(store.names())
+        if not self._active:
+            return
+        records = [{n: self._pyify(store[n].get_raw(i))
+                    for n in self._names}
+                   for i in range(store.n_rows)]
+        if not records:
+            return      # empty store: close() writes the header-only file
+        if self._writer is None:
+            from .readers.avro import AvroWriter, infer_avro_schema
+            self._writer = AvroWriter(
+                self._path, infer_avro_schema(records))
+        self._writer.append(records)
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+        elif self._active and self._names is not None:
+            # header-only output on empty input (schema from names alone)
+            from .readers.avro import AvroWriter
+            AvroWriter(self._path, {
+                "type": "record", "name": "ScoreRecord",
+                "fields": [{"name": n, "type": ["null", "string"]}
+                           for n in self._names]}).close()
+
+
+def _make_sink(path: str):
+    """Sink by extension: ``.avro`` → Avro container, else CSV
+    (the reference's saveScores writes Avro; CSV stays the default)."""
+    return _AvroSink(path) if path.endswith(".avro") else _CsvSink(path)
+
+
 def _write_store_csv(store, path: str) -> None:
-    """One-shot CSV sink over a single store."""
-    sink = _CsvSink(path)
+    """One-shot sink over a single store (CSV or Avro by extension)."""
+    sink = _make_sink(path)
     try:
         sink.write(store)
     finally:
