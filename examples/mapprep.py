@@ -1,0 +1,91 @@
+"""Map-feature data prep — the RichMapFeature DSL surface end-to-end.
+
+A support-ticket dataset where most signal lives in MAP-typed columns
+(per-channel counts, free-text attributes): the walkthrough filters keys,
+smart-vectorizes a text map (low-cardinality keys pivot, high-cardinality
+keys hash), decision-tree-bucketizes a numeric map key against the label,
+and trains the usual CV sweep on the combined vector.
+
+Parity surface: ``RichMapFeature.vectorize`` white/blacklists,
+``RichMapFeature.smartVectorize``, ``autoBucketize``
+(``core/.../dsl/RichMapFeature.scala:91-664``).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+from transmogrifai_tpu import FeatureBuilder, Workflow
+from transmogrifai_tpu.columns import ColumnStore
+from transmogrifai_tpu.dsl import transmogrify
+from transmogrifai_tpu.evaluators import Evaluators
+from transmogrifai_tpu.models import (BinaryClassificationModelSelector,
+                                      LogisticRegressionFamily)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def make_records(n=4000, seed=7):
+    rng = np.random.default_rng(seed)
+    channels = ["email", "phone", "chat"]
+    plans = ["free", "pro", "enterprise"]
+    recs = []
+    for i in range(n):
+        usage = {c: float(rng.poisson(3)) for c in channels
+                 if rng.random() > 0.2}
+        usage["internal_audit"] = float(i)          # leak-ish key to block
+        attrs = {"plan": plans[int(rng.integers(0, 3))],
+                 "agent_note": f"case {rng.integers(0, 10_000)} opened"}
+        if rng.random() > 0.5:
+            attrs["region"] = ["emea", "amer", "apac"][
+                int(rng.integers(0, 3))]
+        churn = float((usage.get("phone", 0) > 4)
+                      or (attrs["plan"] == "free" and rng.random() < 0.4))
+        recs.append({"usage": usage, "attrs": attrs, "churned": churn})
+    return recs
+
+
+def run(n=4000, seed=7):
+    recs = make_records(n, seed)
+    store = ColumnStore.from_dict({
+        "usage": (ft.RealMap, [r["usage"] for r in recs]),
+        "attrs": (ft.TextMap, [r["attrs"] for r in recs]),
+        "churned": (ft.RealNN, [r["churned"] for r in recs]),
+    })
+
+    churned = FeatureBuilder.RealNN("churned").from_column().as_response()
+    usage = FeatureBuilder.RealMap("usage").from_column().as_predictor()
+    attrs = FeatureBuilder.TextMap("attrs").from_column().as_predictor()
+
+    # RichMapFeature surface: blacklist the leaky key, pivot the rest
+    usage_vec = usage.vectorize(block_keys=["internal_audit"])
+    # smartVectorize: 'plan'/'region' pivot (low cardinality),
+    # 'agent_note' hashes (unique per row)
+    attrs_vec = attrs.smart_vectorize(max_cardinality=10, num_features=64)
+    # label-aware bucketing of one numeric key
+    phone_buckets = usage.extract_key("phone").auto_bucketize(churned)
+
+    features = transmogrify([usage_vec, attrs_vec, phone_buckets])
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, families=[LogisticRegressionFamily()], seed=seed)
+    pred = churned.transform_with(selector, features)
+
+    model = (Workflow().set_input_store(store)
+             .set_result_features(pred).train())
+    evaluator = Evaluators.BinaryClassification.auPR().set_columns(
+        churned, pred)
+    metrics = model.evaluate(store, evaluator)
+    vec_meta = model.transform(store)[usage_vec.name].metadata
+    blocked = [c for c in vec_meta.columns
+               if c.grouping == "internal_audit"]
+    return {"model": model, "metrics": metrics, "blocked_cols": blocked,
+            "summary": model.fitted_stages[selector.uid].selector_summary}
+
+
+if __name__ == "__main__":
+    out = run()
+    assert not out["blocked_cols"], "blacklisted key leaked into the vector"
+    s = out["summary"]
+    print(f"best: {s.best_model_name} {s.best_model_params}")
+    print(f"full-data eval: { {k: round(float(v), 4) for k, v in out['metrics'].items() if isinstance(v, (int, float))} }")

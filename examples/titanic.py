@@ -22,7 +22,15 @@ from transmogrifai_tpu.types import feature_types as ft
 
 TITANIC_SCHEMA = ["id", "survived", "pClass", "name", "sex", "age", "sibSp",
                   "parCh", "ticket", "fare", "cabin", "embarked"]
-DEFAULT_CSV = "/root/reference/test-data/PassengerDataAll.csv"
+_BUNDLED_CSV = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.pardir, "tests", "resources",
+                            "PassengerDataAll.csv")
+_REFERENCE_CSV = "/root/reference/test-data/PassengerDataAll.csv"
+#: the public Titanic dataset; prefer the reference checkout's copy, fall
+#: back to the bundled one so the example (and dryrun_multichip) runs on
+#: hosts without /root/reference
+DEFAULT_CSV = (_REFERENCE_CSV if os.path.exists(_REFERENCE_CSV)
+               else _BUNDLED_CSV)
 
 
 def _num(field):

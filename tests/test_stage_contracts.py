@@ -230,6 +230,26 @@ def _cases():
         return stage, feats, store
     cases["OPSetTransformer"] = set_lift_case
 
+    from transmogrifai_tpu.ops.maps import SmartTextMapVectorizer
+    from transmogrifai_tpu.ops.text_suite import LanguageDetector
+
+    def smart_text_map_case():
+        stage = SmartTextMapVectorizer(max_cardinality=4, num_features=16,
+                                       min_support=1, top_k=5)
+        feats = [_f("a", ft.TextMap)]
+        store = ColumnStore({"a": RandomData.text_maps()
+                             .column(ft.TextMap, N)})
+        return stage, feats, store
+    cases["SmartTextMapVectorizer"] = smart_text_map_case
+
+    def language_detector_case():
+        stage = LanguageDetector()
+        feats = [_f("a", ft.Text)]
+        store = ColumnStore({"a": RandomData.texts().with_prob_empty(0.2)
+                             .column(ft.Text, N)})
+        return stage, feats, store
+    cases["LanguageDetector"] = language_detector_case
+
     def geo_case():
         stage = GeolocationVectorizer()
         feats = [_f("a", ft.Geolocation)]

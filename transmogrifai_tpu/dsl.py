@@ -415,6 +415,172 @@ def _sanity_check(self: Feature, features: Feature,
     return checker.get_output()
 
 
+# ---------------------------------------------------------------------------
+# Rich* long tail (RichMapFeature.scala:1-1118, RichTextFeature.scala:75-822)
+# ---------------------------------------------------------------------------
+
+def _vectorize(self: Feature, *others: Feature,
+               top_k: Optional[int] = None,
+               min_support: Optional[int] = None,
+               track_nulls: Optional[bool] = None,
+               track_invalid: Optional[bool] = None,
+               num_features: Optional[int] = None,
+               fill_with_mean: Optional[bool] = None,
+               allow_keys: Optional[Sequence[str]] = None,
+               block_keys: Sequence[str] = ()):
+    """One-call vectorization of this feature (+ same-typed ``others``)
+    with per-call Transmogrifier overrides — the reference's per-type
+    ``vectorize(...)`` surface collapsed onto one method: the stage each
+    type gets is decided by the same dispatch table transmogrify uses.
+    Map features additionally honor ``allow_keys``/``block_keys``
+    (RichMapFeature's whiteListKeys/blackListKeys)."""
+    from .ops.transmogrifier import Transmogrifier
+    from .ops.vectorizer_base import TransmogrifierDefaults
+    from .types.feature_types import ColumnKind
+
+    feats = [self, *others]
+    if self.ftype.column_kind is ColumnKind.MAP and (
+            allow_keys is not None or block_keys):
+        feats = [f.filter_keys(allow=allow_keys, block=block_keys)
+                 for f in feats]
+
+    class _Defaults(TransmogrifierDefaults):
+        pass
+    for attr, v in (("TOP_K", top_k), ("MIN_SUPPORT", min_support),
+                    ("TRACK_NULLS", track_nulls),
+                    ("TRACK_INVALID", track_invalid),
+                    ("HASH_SIZE", num_features),
+                    ("FILL_WITH_MEAN", fill_with_mean)):
+        if v is not None:
+            setattr(_Defaults, attr, v)
+    return Transmogrifier.vectorize(feats, _Defaults)
+
+
+def _smart_vectorize(self: Feature, *others: Feature,
+                     max_cardinality: int = 100,
+                     top_k: Optional[int] = None,
+                     min_support: Optional[int] = None,
+                     num_features: Optional[int] = None,
+                     track_nulls: bool = True,
+                     track_text_len: bool = False,
+                     allow_keys: Optional[Sequence[str]] = None,
+                     block_keys: Sequence[str] = ()):
+    """Cardinality-probing text vectorization (RichTextFeature
+    ``smartVectorize`` :223-281 / RichMapFeature ``smartVectorize``
+    :280-350): low-cardinality values pivot, high-cardinality values
+    hash. Works on Text-ish features and on text-valued maps."""
+    from .ops.smart_text import SmartTextVectorizer
+    from .ops.maps import SmartTextMapVectorizer
+    from .ops.vectorizer_base import TransmogrifierDefaults as TD
+    from .types.feature_types import ColumnKind
+
+    kw = dict(max_cardinality=max_cardinality,
+              top_k=TD.TOP_K if top_k is None else top_k,
+              min_support=TD.MIN_SUPPORT if min_support is None
+              else min_support,
+              num_features=TD.HASH_SIZE if num_features is None
+              else num_features,
+              track_nulls=track_nulls, track_text_len=track_text_len)
+    feats = [self, *others]
+    if self.ftype.column_kind is ColumnKind.MAP:
+        if allow_keys is not None or block_keys:
+            feats = [f.filter_keys(allow=allow_keys, block=block_keys)
+                     for f in feats]
+        stage = SmartTextMapVectorizer(**kw)
+    else:
+        stage = SmartTextVectorizer(**kw)
+    return feats[0].transform_with(stage, *feats[1:])
+
+
+def _auto_bucketize(self: Feature, label: Feature, **kw):
+    """Label-aware decision-tree bucketing (RichNumericFeature/
+    RichMapFeature ``autoBucketize`` :542-664): split points come from a
+    single-feature decision tree against the label."""
+    from .ops.dt_bucketizer import (DecisionTreeNumericBucketizer,
+                                    DecisionTreeNumericMapBucketizer)
+    from .types.feature_types import ColumnKind
+
+    cls = (DecisionTreeNumericMapBucketizer
+           if self.ftype.column_kind is ColumnKind.MAP
+           else DecisionTreeNumericBucketizer)
+    return label.transform_with(cls(**kw), self)
+
+
+def _detect_languages(self: Feature):
+    """Text → RealMap of language-confidence scores
+    (RichTextFeature.detectLanguages :403)."""
+    from .ops.text_suite import LanguageDetector
+    return self.transform_with(LanguageDetector())
+
+
+def _recognize_entities(self: Feature):
+    """Text → MultiPickList of entity spans
+    (RichTextFeature.recognizeEntities :420)."""
+    from .ops.text_suite import NameEntityRecognizer
+    return self.transform_with(NameEntityRecognizer())
+
+
+def _is_substring(self: Feature, other: Feature):
+    """Binary: is this text a (case-insensitive) substring of ``other``
+    (RichTextFeature.isSubstring :445)."""
+    import numpy as np
+
+    from .columns import NumericColumn
+    from .stages.base import LambdaTransformer
+    from .types.feature_types import Binary, Text
+
+    def fn(a_col, b_col):
+        n = len(a_col)
+        vals = np.zeros((n,), np.float64)
+        mask = np.zeros((n,), bool)
+        for i in range(n):
+            a, b = a_col.get_raw(i), b_col.get_raw(i)
+            if a is not None and b is not None:
+                mask[i] = True
+                vals[i] = float(str(a).lower() in str(b).lower())
+        return NumericColumn(Binary, vals, mask)
+
+    stage = LambdaTransformer("isSubstring", fn, [Text, Text], Binary)
+    stage.set_input(self, other)
+    return stage.get_output()
+
+
+def _is_valid_email(self: Feature):
+    """Email → Binary validity (RichTextFeature.isValidEmail :591)."""
+    return _map_to(
+        self, lambda v: (None if v is None else
+                         ("@" in v and "." in v.rsplit("@", 1)[-1]
+                          and " " not in v)),
+        _ft().Binary, "isValidEmail")
+
+
+def _is_valid_url(self: Feature):
+    """URL → Binary validity (RichTextFeature.isValidUrl :642)."""
+    return _map_to(
+        self, lambda v: (None if v is None else
+                         v.partition("://")[0] in ("http", "https", "ftp")
+                         and "." in v.partition("://")[2]),
+        _ft().Binary, "isValidUrl")
+
+
+def _parse_phone(self: Feature, default_region: str = "US"):
+    """Phone → Text national number (RichTextFeature.parsePhone :464)."""
+    from .ops.text_suite import PhoneNumberParser
+    return self.transform_with(PhoneNumberParser(
+        default_region=default_region, output="national"))
+
+
+def _to_multi_pick_list(self: Feature):
+    """TextList → MultiPickList (RichTextFeature.toMultiPickList :58)."""
+    return _map_to(self, lambda v: set(v or ()), _ft().MultiPickList,
+                   "toMultiPickList")
+
+
+def _ft():
+    from .types import feature_types
+    return feature_types
+
+
 Feature.__add__ = _binary_math("add")
 Feature.__sub__ = _binary_math("subtract")
 Feature.__mul__ = _binary_math("multiply")
@@ -448,5 +614,15 @@ Feature.lda = _lda
 Feature.word2vec = _word2vec
 Feature.filter_keys = _filter_keys
 Feature.extract_key = _extract_key
+Feature.vectorize = _vectorize
+Feature.smart_vectorize = _smart_vectorize
+Feature.auto_bucketize = _auto_bucketize
+Feature.detect_languages = _detect_languages
+Feature.recognize_entities = _recognize_entities
+Feature.is_substring = _is_substring
+Feature.is_valid_email = _is_valid_email
+Feature.is_valid_url = _is_valid_url
+Feature.parse_phone = _parse_phone
+Feature.to_multi_pick_list = _to_multi_pick_list
 
 transmogrify = _vectorize_collection

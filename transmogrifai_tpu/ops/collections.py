@@ -74,6 +74,23 @@ def _check_elem(collection_ftype: Type[FeatureType],
             f"given {what} transformer over {scalar_in.__name__}")
 
 
+def _private_copy(stage: Transformer) -> Transformer:
+    """Fresh instance from ctor params (+ fitted state) — same mechanism
+    stage persistence uses, so anything serializable copies faithfully."""
+    from ..stages.base import FittedModel
+    params = dict(stage.get_params())
+    params.pop("uid", None)
+    copy = type(stage)(**params)
+    if isinstance(stage, FittedModel):
+        state = stage.get_model_state()
+        if hasattr(copy, "apply_model_state"):
+            copy.apply_model_state(state)
+        else:
+            for k, v in state.items():
+                setattr(copy, k, v)
+    return copy
+
+
 class _LiftedTransformer(Transformer):
     """Shared wrapper: holds the scalar transformer, wires it to a
     synthetic element feature once, and exposes columnar element
@@ -85,7 +102,11 @@ class _LiftedTransformer(Transformer):
                  operation_name: Optional[str] = None,
                  uid: Optional[str] = None):
         super().__init__(uid=uid)
-        self.transformer = transformer
+        # PRIVATE copy (ctor-params + fitted state, the persistence
+        # mechanism): the lift wires the scalar transformer to its own
+        # synthetic element feature, which would silently clobber wiring
+        # on a caller-owned instance shared with the DAG or another lift
+        self.transformer = _private_copy(transformer)
         self.operation_name = (operation_name
                                or f"{self.lift_name}_"
                                   f"{transformer.operation_name}")
@@ -182,9 +203,15 @@ class _FlatLift(_LiftedTransformer):
 
 @register_stage
 class OPListTransformer(_FlatLift):
-    """Lift over OPList elements (order preserved, one entry per input
-    element — nulls from the scalar transform stay in place, matching
-    the reference's 'no checks on the output' note)."""
+    """Lift over OPList elements.
+
+    Text-output lifts preserve order with one entry per input element —
+    nulls from the scalar transform stay in place (the reference's 'no
+    checks on the output' note). Integral-output lifts DROP null
+    elements: the CSR ragged encoding has no element mask, so an
+    unparseable element shortens that row's list rather than poisoning
+    the numeric flat array — alignment with the source list is not
+    preserved in that case."""
 
     lift_name = "listElems"
     operation_name = "listElems"

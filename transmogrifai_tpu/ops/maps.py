@@ -214,6 +214,64 @@ class MapVectorizer(VectorizerEstimator):
             ftype_name=ftype.__name__)
 
 
+@register_stage
+class SmartTextMapVectorizer(MapVectorizer):
+    """TextMap smart vectorization (``RichMapFeature.smartVectorize``,
+    ``core/.../dsl/RichMapFeature.scala:280-350``): each map KEY gets the
+    SmartText cardinality probe — low-cardinality keys pivot into top-K
+    one-hot columns, high-cardinality keys hash — instead of the plain
+    MapVectorizer's pivot-everything. The fitted delegate is a
+    ``SmartTextVectorizerModel`` over the exploded per-key columns."""
+
+    operation_name = "smartVecTextMap"
+    seq_type = ft.OPMap
+
+    def __init__(self, max_cardinality: int = 100,
+                 top_k: int = TransmogrifierDefaults.TOP_K,
+                 min_support: int = TransmogrifierDefaults.MIN_SUPPORT,
+                 num_features: int = TransmogrifierDefaults.HASH_SIZE,
+                 track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
+                 track_text_len: bool = False,
+                 uid: Optional[str] = None):
+        super().__init__(top_k=top_k, min_support=min_support,
+                         track_nulls=track_nulls, uid=uid)
+        self.max_cardinality = max_cardinality
+        self.num_features = num_features
+        self.track_text_len = track_text_len
+
+    def fit_columns(self, store: ColumnStore) -> MapVectorizerModel:
+        from .smart_text import TextStats
+
+        ftype = self.input_features[0].ftype
+        if ftype.map_element_kind is not ft.ColumnKind.TEXT:
+            raise TypeError(
+                f"smartVectorize needs a text-valued map, got "
+                f"{ftype.__name__}")
+        keys = self._discover_keys(store)
+        exploded = _explode(store, self.input_names, keys)
+        is_cat: List[bool] = []
+        vocabs: List[List[str]] = []
+        for name in exploded.names():
+            stats = TextStats(self.max_cardinality)
+            for v in exploded[name].values:
+                stats.add(v)
+            if not stats.capped:
+                is_cat.append(True)
+                vocabs.append(_sorted_topk(stats.counts, self.top_k,
+                                           self.min_support))
+            else:
+                is_cat.append(False)
+        return MapVectorizerModel(
+            keys_per_feature=keys, delegate_class="SmartTextVectorizerModel",
+            delegate_params={
+                "is_categorical": is_cat, "vocabs": vocabs,
+                "num_features": self.num_features,
+                "track_nulls": self.track_nulls,
+                "track_text_len": self.track_text_len,
+                "ftype_name": ftype.__name__},
+            input_names=self.input_names, ftype_name=ftype.__name__)
+
+
 def vectorize_maps(features: Sequence[Feature],
                    defaults: Type[TransmogrifierDefaults]
                    ) -> List[Feature]:

@@ -373,6 +373,47 @@ _CAP_TOKEN = re.compile(r"^[A-Z][a-zA-Z'’-]*$")
 
 
 @register_stage
+class LanguageDetector(Transformer):
+    """Text → RealMap of per-language confidence scores
+    (``RichTextFeature.detectLanguages`` :403-417; the reference scores
+    with Optimaize's n-gram profiles, here the stopword-overlap fraction
+    each language's table achieves — same output contract: a RealMap
+    keyed by language code)."""
+
+    operation_name = "detectLanguages"
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(Text)
+
+    @property
+    def output_type(self):
+        from ..types.feature_types import RealMap
+        return RealMap
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        from ..columns import column_from_values
+        from .text import STOPWORDS, _TOKEN_RE
+
+        col = store[self.input_features[0].name]
+        rows = []
+        for i in range(store.n_rows):
+            v = col.get_raw(i)
+            if v is None:
+                rows.append(None)
+                continue
+            toks = _TOKEN_RE.findall(str(v).lower())
+            scores = {}
+            for lang, words in STOPWORDS.items():
+                s = (sum(1 for t in toks if t in words) / len(toks)
+                     if toks else 0.0)
+                if s > 0.0:
+                    scores[lang] = s
+            rows.append(scores)
+        return column_from_values(self.output_type, rows)
+
+
+@register_stage
 class NameEntityRecognizer(Transformer):
     """Text → MultiPickList of detected proper-noun spans.
 

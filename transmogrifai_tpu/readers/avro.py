@@ -279,7 +279,12 @@ def _union_matches(branch: Any, value: Any) -> bool:
     if branch in ("float", "double"):
         return isinstance(value, (int, float)) and not isinstance(value, bool)
     if branch == "string":
-        return isinstance(value, str)
+        # catch-all: the encoder str()s anything, and inferred unions use
+        # a trailing string branch as the escape hatch for values the
+        # schema didn't anticipate (heterogeneous fields, post-lock
+        # streaming batches) — better a stringified value than a torn
+        # container file. Specific branches are tried first, in order.
+        return not isinstance(value, (bytes, bytearray))
     if branch == "bytes":
         return isinstance(value, (bytes, bytearray))
     if isinstance(branch, dict):
