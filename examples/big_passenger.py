@@ -28,6 +28,15 @@ _WORDS = ("travel cabin sea ocean deck luxury family crew storm rescue "
           "engine coal first second third class suite promenade").split()
 
 
+#: pass line for the deterministic label rule below: the rule is exactly
+#: recoverable from [has-"rescue"-token, gender one-hot, height] — all of
+#: which survive vectorization — so a sound text path scores near-perfect
+#: AuPR. Measured: LR reaches ~0.99 at 8k-200k rows; 0.95 leaves slack
+#: for fold noise while still failing hard if the text signal is dropped
+#: (without it the ceiling is ~0.8).
+TARGET_AUPR = 0.95
+
+
 def synthesize_records(n: int, seed: int = 7):
     rng = np.random.default_rng(seed)
     genders = np.array(["Male", "Female"], dtype=object)
@@ -38,13 +47,18 @@ def synthesize_records(n: int, seed: int = 7):
     ages = rng.integers(1, 90, n)
     n_words = rng.integers(3, 12, n)
     word_idx = rng.integers(0, len(_WORDS), (n, 12))
-    # label depends on gender + a text token ("rescue") + weight
-    has_rescue = (word_idx[:, :3] == _WORDS.index("rescue")).any(axis=1)
-    p = 0.15 + 0.4 * (g_idx == 1) + 0.25 * has_rescue \
-        - 0.1 * (weights > 85)
-    y = rng.random(n) < p
     for i in range(n):
         words = [_WORDS[j] for j in word_idx[i, :n_words[i]]]
+        # DETERMINISTIC label (VERDICT r2 #8): a text-dependent LINEAR
+        # threshold rule over quantities the vectorizers expose — the
+        # "rescue" token presence (hashed text path; bag-of-tokens, so
+        # the rule uses presence anywhere in the WRITTEN text), gender
+        # (pivot path), height (numeric path). A sound pipeline can
+        # recover it almost exactly; dropping the text path caps AuPR
+        # far below TARGET_AUPR.
+        has_rescue = "rescue" in words
+        score = (2.0 * has_rescue + 1.0 * (g_idx[i] == 1)
+                 + 0.02 * (heights[i] - 170.0))
         recs.append({
             "age": float(ages[i]) if rng.random() > 0.05 else None,
             "gender": str(genders[g_idx[i]]),
@@ -53,7 +67,7 @@ def synthesize_records(n: int, seed: int = 7):
             "description": " ".join(words) + f" voyage{i % 997}",
             "boarded": 1471046600 + int(rng.integers(0, 3_000_000)),
             "anotherFloat": float(rng.random()),
-            "survived": 1.0 if y[i] else 0.0,
+            "survived": 1.0 if score > 1.2 else 0.0,
         })
     return recs
 
@@ -116,6 +130,11 @@ if __name__ == "__main__":
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 30_000
     out = run(n)
     s = out["summary"]
+    aupr = float(out["metrics"]["AuPR"])
     print(f"train wall-clock: {out['train_time_s']:.2f}s ({n} rows)")
     print(f"best model: {s.best_model_name} {s.best_model_params}")
     print(f"full-data eval: { {k: round(float(v), 4) for k, v in out['metrics'].items() if isinstance(v, (int, float))} }")
+    verdict = "PASS" if aupr >= TARGET_AUPR else "FAIL"
+    print(f"AuPR {aupr:.4f} vs target {TARGET_AUPR} -> {verdict}")
+    if verdict == "FAIL":
+        raise SystemExit(1)

@@ -1,0 +1,47 @@
+"""big_text quality anchor (VERDICT r2 #8): deterministic text-dependent
+labels give AuPR a real target, and the Transmogrifier defaults match the
+reference's constants (Transmogrifier.scala:52-88)."""
+import os
+import sys
+
+import pytest
+
+
+def test_transmogrifier_defaults_match_reference():
+    """Pin our defaults to Transmogrifier.scala:52-88 — a silent drift in
+    TopK/MinSupport/hash dims changes every AutoML vector."""
+    from transmogrifai_tpu.ops.vectorizer_base import TransmogrifierDefaults as D
+
+    assert D.TOP_K == 20                      # TopK
+    assert D.MIN_SUPPORT == 10                # MinSupport
+    assert D.HASH_SIZE == 512                 # DefaultNumOfFeatures
+    assert D.MAX_NUM_FEATURES == 16384        # MaxNumOfFeatures
+    assert D.FILL_VALUE == 0                  # FillValue
+    assert D.BINARY_FILL_VALUE == 0.0         # BinaryFillValue (false)
+    assert D.FILL_WITH_MEAN is True           # FillWithMean
+    assert D.FILL_WITH_MODE is True           # FillWithMode
+    assert D.TRACK_NULLS is True              # TrackNulls
+    assert D.TRACK_INVALID is False           # TrackInvalid
+    assert D.MIN_DOC_FREQUENCY == 0           # MinDocFrequency
+    assert D.OTHER_STRING == "OTHER"          # OtherString
+    assert D.NULL_STRING == "NullIndicatorValue"  # OpVectorColumnMetadata
+    assert D.CIRCULAR_DATE_REPRESENTATIONS == [
+        "HourOfDay", "DayOfWeek", "DayOfMonth", "DayOfYear"]
+
+
+def test_big_text_deterministic_quality():
+    """The BigPassenger-schema config trains against a deterministic
+    text-dependent rule: AuPR must clear TARGET_AUPR (a pipeline that
+    drops or mangles the hashed text path fails this hard)."""
+    examples = os.path.join(os.path.dirname(__file__), os.pardir, "examples")
+    sys.path.insert(0, examples)
+    try:
+        from big_passenger import TARGET_AUPR, run
+    finally:
+        sys.path.remove(examples)
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+
+    out = run(n_rows=6000, num_folds=2,
+              families=[LogisticRegressionFamily()], mesh=False, seed=11)
+    aupr = float(out["metrics"]["AuPR"])
+    assert aupr >= TARGET_AUPR, f"big_text AuPR {aupr} below {TARGET_AUPR}"

@@ -66,7 +66,7 @@ def test_auto_bucketize_map_key():
     assert mat.shape[0] == 200 and mat.shape[1] >= 2
     # the DT found a split near 0.3: bucket membership predicts y
     upper = mat[:, -2] if mat.shape[1] > 2 else mat[:, 1]
-    assert abs(np.corrcoef(mat.sum(axis=1) * 0 + upper, y)[0, 1]) > 0.5
+    assert abs(np.corrcoef(upper, y)[0, 1]) > 0.5
 
 
 def test_text_predicates_and_language():
@@ -74,7 +74,7 @@ def test_text_predicates_and_language():
         "t": (ft.Text, ["la casa de la madre en la ciudad",
                         "the dog and the cat in the house", None]),
         "e": (ft.Email, ["ok@x.io", "not-an-email", None]),
-        "u": (ft.URL, ["http://a.b/c", "junk", None]),
+        "u": (ft.URL, ["http://a.bc/c", "junk", None]),
         "s": (ft.Text, ["dog", "zebra", None]),
         "big": (ft.Text, ["the dog barks", "the cat meows", "x"]),
     })

@@ -419,6 +419,21 @@ def _sanity_check(self: Feature, features: Feature,
 # Rich* long tail (RichMapFeature.scala:1-1118, RichTextFeature.scala:75-822)
 # ---------------------------------------------------------------------------
 
+def _apply_key_filters(feats, allow_keys, block_keys, ColumnKind):
+    """Key white/blacklists apply to every MAP-kind feature in the group;
+    passing them with no map feature present is a silent no-op the caller
+    almost certainly didn't intend (a dropped blacklist = a leaked key),
+    so it raises instead."""
+    if allow_keys is None and not block_keys:
+        return feats
+    is_map = [f.ftype.column_kind is ColumnKind.MAP for f in feats]
+    if not any(is_map):
+        raise ValueError(
+            "allow_keys/block_keys were given but none of the features "
+            "is map-typed — the key filter would be silently dropped")
+    return [f.filter_keys(allow=allow_keys, block=block_keys) if m else f
+            for f, m in zip(feats, is_map)]
+
 def _vectorize(self: Feature, *others: Feature,
                top_k: Optional[int] = None,
                min_support: Optional[int] = None,
@@ -438,11 +453,8 @@ def _vectorize(self: Feature, *others: Feature,
     from .ops.vectorizer_base import TransmogrifierDefaults
     from .types.feature_types import ColumnKind
 
-    feats = [self, *others]
-    if self.ftype.column_kind is ColumnKind.MAP and (
-            allow_keys is not None or block_keys):
-        feats = [f.filter_keys(allow=allow_keys, block=block_keys)
-                 for f in feats]
+    feats = _apply_key_filters([self, *others], allow_keys, block_keys,
+                               ColumnKind)
 
     class _Defaults(TransmogrifierDefaults):
         pass
@@ -481,11 +493,9 @@ def _smart_vectorize(self: Feature, *others: Feature,
               num_features=TD.HASH_SIZE if num_features is None
               else num_features,
               track_nulls=track_nulls, track_text_len=track_text_len)
-    feats = [self, *others]
+    feats = _apply_key_filters([self, *others], allow_keys, block_keys,
+                               ColumnKind)
     if self.ftype.column_kind is ColumnKind.MAP:
-        if allow_keys is not None or block_keys:
-            feats = [f.filter_keys(allow=allow_keys, block=block_keys)
-                     for f in feats]
         stage = SmartTextMapVectorizer(**kw)
     else:
         stage = SmartTextVectorizer(**kw)
@@ -546,20 +556,23 @@ def _is_substring(self: Feature, other: Feature):
 
 
 def _is_valid_email(self: Feature):
-    """Email → Binary validity (RichTextFeature.isValidEmail :591)."""
+    """Email → Binary validity (RichTextFeature.isValidEmail :591).
+    Same grammar as to_email_prefix/domain (``parse_email``), so a value
+    can never be 'valid' yet unparseable."""
+    from .ops.text_suite import parse_email
     return _map_to(
-        self, lambda v: (None if v is None else
-                         ("@" in v and "." in v.rsplit("@", 1)[-1]
-                          and " " not in v)),
+        self, lambda v: (None if v is None
+                         else parse_email(v)[0] is not None),
         _ft().Binary, "isValidEmail")
 
 
 def _is_valid_url(self: Feature):
-    """URL → Binary validity (RichTextFeature.isValidUrl :642)."""
+    """URL → Binary validity (RichTextFeature.isValidUrl :642); same
+    grammar as to_url_protocol/domain (``parse_url``)."""
+    from .ops.text_suite import parse_url
     return _map_to(
-        self, lambda v: (None if v is None else
-                         v.partition("://")[0] in ("http", "https", "ftp")
-                         and "." in v.partition("://")[2]),
+        self, lambda v: (None if v is None
+                         else parse_url(v)[0] is not None),
         _ft().Binary, "isValidUrl")
 
 

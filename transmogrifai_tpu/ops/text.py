@@ -62,19 +62,29 @@ STOPWORDS: Dict[str, frozenset] = {
 }
 
 
-def detect_language(text: str, default: str = "en") -> str:
-    """Stopword-overlap language detector (Optimaize replacement: table-
-    driven, host-side). Scores each language by the fraction of tokens in
-    its stopword table; ties/no-signal fall back to ``default``."""
+def score_languages(text: str) -> dict:
+    """Per-language stopword-overlap fractions (the one scoring formula
+    shared by :func:`detect_language` and the ``LanguageDetector`` stage —
+    the Optimaize n-gram profile replacement)."""
     toks = _TOKEN_RE.findall(text.lower())
     if not toks:
-        return default
-    best, best_score = default, 0.0
+        return {}
+    out = {}
     for lang, words in STOPWORDS.items():
         score = sum(1 for t in toks if t in words) / len(toks)
-        if score > best_score:
-            best, best_score = lang, score
-    return best if best_score > 0.05 else default
+        if score > 0.0:
+            out[lang] = score
+    return out
+
+
+def detect_language(text: str, default: str = "en") -> str:
+    """Best language by stopword overlap; ties/no-signal fall back to
+    ``default`` (scores below the 0.05 noise floor are ignored)."""
+    scores = score_languages(text)
+    if not scores:
+        return default
+    best = max(scores, key=scores.get)
+    return best if scores[best] > 0.05 else default
 
 
 _STEM_SUFFIXES = [

@@ -393,23 +393,12 @@ class LanguageDetector(Transformer):
 
     def transform_columns(self, store: ColumnStore) -> Column:
         from ..columns import column_from_values
-        from .text import STOPWORDS, _TOKEN_RE
+        from .text import score_languages
 
         col = store[self.input_features[0].name]
-        rows = []
-        for i in range(store.n_rows):
-            v = col.get_raw(i)
-            if v is None:
-                rows.append(None)
-                continue
-            toks = _TOKEN_RE.findall(str(v).lower())
-            scores = {}
-            for lang, words in STOPWORDS.items():
-                s = (sum(1 for t in toks if t in words) / len(toks)
-                     if toks else 0.0)
-                if s > 0.0:
-                    scores[lang] = s
-            rows.append(scores)
+        rows = [None if (v := col.get_raw(i)) is None
+                else score_languages(str(v))
+                for i in range(store.n_rows)]
         return column_from_values(self.output_type, rows)
 
 

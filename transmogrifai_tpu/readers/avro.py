@@ -229,11 +229,17 @@ def _encode(buf: bytearray, schema: Any, value: Any,
             raise AvroDecodeError(f"Unknown schema reference {s!r}")
         return
     if isinstance(schema, list):                  # union: pick the branch
-        for idx, branch in enumerate(schema):
-            if _union_matches(branch, value):
-                buf += _zigzag_bytes(idx)
-                _encode(buf, branch, value, named)
-                return
+        # two passes: STRICT typing first (a long in ["null","string",
+        # "long"] must encode as long, not be swallowed by an earlier
+        # string branch), then the lenient pass where "string" acts as
+        # the stringify-anything escape hatch for values the inferred
+        # schema didn't anticipate
+        for strict in (True, False):
+            for idx, branch in enumerate(schema):
+                if _union_matches(branch, value, strict=strict):
+                    buf += _zigzag_bytes(idx)
+                    _encode(buf, branch, value, named)
+                    return
         raise AvroDecodeError(
             f"No union branch of {schema} matches {type(value).__name__}")
     t = schema["type"]
@@ -267,7 +273,7 @@ def _encode(buf: bytearray, schema: Any, value: Any,
         _encode(buf, t, value, named)
 
 
-def _union_matches(branch: Any, value: Any) -> bool:
+def _union_matches(branch: Any, value: Any, strict: bool = True) -> bool:
     if branch == "null":
         return value is None
     if value is None:
@@ -279,11 +285,11 @@ def _union_matches(branch: Any, value: Any) -> bool:
     if branch in ("float", "double"):
         return isinstance(value, (int, float)) and not isinstance(value, bool)
     if branch == "string":
-        # catch-all: the encoder str()s anything, and inferred unions use
-        # a trailing string branch as the escape hatch for values the
-        # schema didn't anticipate (heterogeneous fields, post-lock
-        # streaming batches) — better a stringified value than a torn
-        # container file. Specific branches are tried first, in order.
+        if strict:
+            return isinstance(value, str)
+        # lenient pass: the stringify-anything escape hatch — better a
+        # str()'d value than a torn container when a post-schema-lock
+        # streaming batch surprises the inferred union
         return not isinstance(value, (bytes, bytearray))
     if branch == "bytes":
         return isinstance(value, (bytes, bytearray))
