@@ -43,8 +43,25 @@ def fields(buf):
         yield fno, wt, v
 
 
-def parse(path, topn=20):
+def device_op_times(path, window_ps=None):
+    """Per-device-plane XLA op times from one xplane.pb.
+
+    Returns ``[{"plane", "busy_ps", "sum_ps", "ops"}]`` for TPU/device
+    planes (durations are picoseconds in XSpace):
+
+    * ``busy_ps`` — the interval UNION of all op events: true device-busy
+      time (the "XLA Ops" line nests control-flow parents with their body
+      ops, so plain summation double-counts);
+    * ``sum_ps`` / ``ops`` — per-op INCLUSIVE durations (a while loop
+      carries its body's time), the ranking signal for "where does device
+      time go".
+
+    ``window_ps`` keeps only events in the last ``window_ps`` before the
+    latest event end (some libtpu builds dump ops beyond the capture
+    window).
+    """
     xs = open(path, "rb").read()
+    out = []
     for fno, _wt, plane in fields(xs):
         if fno != 1:
             continue
@@ -72,35 +89,71 @@ def parse(path, topn=20):
         nm = name.decode(errors="replace")
         if "TPU" not in nm and "/device" not in nm:
             continue
-        agg = {}
-        total = 0
+        parsed = []       # (metadata_id, offset_ps, dur_ps, occurrences)
         for line in lines:
             lname = b""
             events = []
             for lf, _, lv in fields(line):
                 if lf == 2:
                     lname = lv
-                elif lf == 6:
+                elif lf in (4, 6):
+                    # XLine.events: field 4 in current libtpu XSpace
+                    # builds, 6 in older ones
                     events.append(lv)
-            if b"XLA Ops" not in lname:
-                continue
+            if lname != b"XLA Ops":     # NOT "Async XLA Ops": async copy
+                continue                # events overlap compute self-time
             for ev in events:
-                mid = dur = occ = 0
+                mid = off = dur = occ = 0
                 for ef, _, evv in fields(ev):
                     if ef == 1:
                         mid = evv
+                    elif ef == 2:
+                        off = evv
                     elif ef == 3:
                         dur = evv
                     elif ef == 5:
                         occ = evv
-                d = dur * max(occ, 1)
-                agg[emeta.get(mid, str(mid))] = \
-                    agg.get(emeta.get(mid, str(mid)), 0) + d
-                total += d
-        if not agg:
+                parsed.append((mid, off, dur, occ))
+        if not parsed:
             continue
-        print(f"== plane {nm}  total {total/1e9:.1f} ms (XLA Ops self-time)")
-        for op, t in sorted(agg.items(), key=lambda kv: -kv[1])[:topn]:
+        if window_ps is not None:
+            end = max(off + dur for _, off, dur, _ in parsed)
+            parsed = [p for p in parsed if p[1] >= end - window_ps]
+        agg = {}
+        total = 0
+        for mid, _off, dur, occ in parsed:
+            d = dur * max(occ, 1)
+            key = emeta.get(mid, str(mid))
+            agg[key] = agg.get(key, 0) + d
+            total += d
+        # interval union over (offset, offset+dur): true busy time
+        busy = 0
+        cur_end = -1
+        for _mid, off, dur, _occ in sorted(parsed, key=lambda p: p[1]):
+            s, e = off, off + dur
+            if s > cur_end:
+                busy += e - s
+                cur_end = e
+            elif e > cur_end:
+                busy += e - cur_end
+                cur_end = e
+        if agg:
+            out.append({"plane": nm, "busy_ps": busy, "sum_ps": total,
+                        "ops": agg})
+    return out
+
+
+def latest_xplane(root):
+    paths = sorted(glob.glob(root + "/plugins/profile/*/*.xplane.pb"))
+    return paths[-1] if paths else None
+
+
+def parse(path, topn=20):
+    for p in device_op_times(path):
+        total = p["sum_ps"]
+        print(f"== plane {p['plane']}  busy {p['busy_ps']/1e9:.1f} ms "
+              f"(inclusive sum {total/1e9:.1f} ms)")
+        for op, t in sorted(p["ops"].items(), key=lambda kv: -kv[1])[:topn]:
             print(f"  {t/total*100:5.1f}%  {t/1e9:9.2f}ms  {op[:95]}")
 
 

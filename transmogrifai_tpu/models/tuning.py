@@ -412,6 +412,34 @@ def _finalize_tree_chunk(family, in_flight: int) -> None:
             1, getattr(family, "_tree_chunk_cap", 1)))
 
 
+#: executed-FLOP accounting for MFU reporting (bench.py): every compiled
+#: CV executable's XLA cost-analysis FLOPs accumulate here per DISPATCH.
+#: Covers the sweep executables (where the device math is); single-model
+#: refits and transforms are excluded, so this is a lower bound.
+DEVICE_FLOPS = {"total": 0.0}
+#: id(exe) → flops. Keys can outlive evicted executables (bounded by the
+#: 64-entry FIFO cache, a few floats) — id() reuse is harmless because a
+#: new executable re-registers its own flops before any dispatch.
+_EXE_FLOPS: Dict[int, float] = {}
+
+
+def _register_exe_flops(exe) -> None:
+    try:
+        ca = exe.cost_analysis()
+        d = ca[0] if isinstance(ca, (list, tuple)) else ca
+        _EXE_FLOPS[id(exe)] = float(d.get("flops", 0.0))
+    except Exception:       # cost analysis is best-effort (backend-dep)
+        _EXE_FLOPS[id(exe)] = 0.0
+
+
+def _count_dispatch(exe) -> None:
+    f = _EXE_FLOPS.get(id(exe))
+    if f is None:
+        _register_exe_flops(exe)
+        f = _EXE_FLOPS[id(exe)]
+    DEVICE_FLOPS["total"] += f
+
+
 _NO_CHUNK_ATTR = object()
 
 
@@ -619,6 +647,7 @@ class _ValidatorBase:
             outs = []
             for i0 in range(0, k_folds, fc):
                 for gw, st in zip(g_sizes, stacked_chunks):
+                    _count_dispatch(fused[fi][gw])
                     outs.append(fused[fi][gw](Xd, yd, wd[i0:i0 + fc],
                                               vwd[i0:i0 + fc], st))
             fused_out[fi] = outs
@@ -797,6 +826,8 @@ class _ValidatorBase:
                             _FUSED_EXE_CACHE.pop(next(iter(_FUSED_EXE_CACHE)))
                         _FUSED_EXE_CACHE[key] = exe
                     exe_by_width[gw] = exe
+                for gw, _st in zip(g_sizes, st_chunks):
+                    _count_dispatch(exe_by_width[gw])
                 outs = [exe_by_width[gw](Xd, yd, wd, vwd, st)
                         for gw, st in zip(g_sizes, st_chunks)]
                 per_grid[:, ki] = np.concatenate(

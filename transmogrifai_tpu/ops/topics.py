@@ -225,13 +225,17 @@ class OpWord2Vec(Estimator):
     operation_name = "w2v"
     output_type = OPVector
 
-    def __init__(self, dim: int = 32, window: int = 2, epochs: int = 100,
-                 neg_samples: int = 4, lr: float = 0.5,
-                 vocab_size: int = 4096, min_count: int = 2,
+    def __init__(self, dim: int = 100, window: int = 5, epochs: int = 100,
+                 neg_samples: int = 5, lr: float = 0.5,
+                 vocab_size: int = 65536, min_count: int = 5,
+                 subsample_t: float = 1e-3,
                  seed: int = 42, uid: Optional[str] = None):
-        # NB: one "epoch" is one FULL-BATCH gradient step over every
-        # skip-gram pair (the whole update is a fused jitted scan), so the
-        # defaults are GD-scale (many steps, large lr), not SGD-scale
+        # dim/window/min_count match Spark ml Word2Vec's defaults
+        # (vectorSize=100, windowSize=5, minCount=5 — the estimator
+        # OpWord2Vec wraps in the reference). NB: one "epoch" is one
+        # FULL-BATCH gradient step over every skip-gram pair (the whole
+        # update is a fused jitted scan), so the defaults are GD-scale
+        # (many steps, large lr), not SGD-scale.
         super().__init__(uid=uid)
         self.dim = dim
         self.window = window
@@ -240,6 +244,9 @@ class OpWord2Vec(Estimator):
         self.lr = lr
         self.vocab_size = vocab_size
         self.min_count = min_count
+        #: frequent-word subsampling threshold (word2vec's t; 0 disables):
+        #: tokens with frequency f are kept with prob sqrt(t/f) (+ t/f)
+        self.subsample_t = subsample_t
         self.seed = seed
 
     @property
@@ -260,11 +267,25 @@ class OpWord2Vec(Estimator):
         if V == 0:
             return Word2VecModel(vocab=[], vectors=np.zeros((0, self.dim)))
 
+        # frequent-word subsampling (word2vec's t-schedule): discard
+        # tokens of very frequent words with prob 1 - (sqrt(t/f) + t/f)
+        total_tokens = float(sum(counts[t] for t in vocab)) or 1.0
+        keep_p = np.ones((V,))
+        if self.subsample_t > 0:
+            freq = np.array([counts[t] / total_tokens for t in vocab])
+            with np.errstate(divide="ignore"):
+                keep_p = np.minimum(
+                    np.sqrt(self.subsample_t / freq)
+                    + self.subsample_t / freq, 1.0)
+
         # host: materialize (center, context) pairs once
         centers: List[int] = []
         contexts: List[int] = []
         for toks in col.values:
             ids = [index[t] for t in toks if t in index]
+            if self.subsample_t > 0 and ids:
+                kept = rng.random(len(ids)) < keep_p[ids]
+                ids = [i for i, k in zip(ids, kept) if k]
             for i, c in enumerate(ids):
                 lo = max(0, i - self.window)
                 for j in range(lo, min(len(ids), i + self.window + 1)):
@@ -283,14 +304,18 @@ class OpWord2Vec(Estimator):
         lr = self.lr
         S = self.neg_samples
         key0 = jax.random.PRNGKey(self.seed)
+        # word2vec's unigram^0.75 negative-sampling distribution
+        uni = np.array([counts[t] for t in vocab], dtype=np.float64) ** 0.75
+        neg_logits = jnp.asarray(np.log(uni / uni.sum()), jnp.float32)
 
         @jax.jit
         def train(W, C):
             def epoch(carry, e):
                 W, C = carry
                 # negatives sampled in-loop: memory stays one epoch's worth
-                neg_e = jax.random.randint(
-                    jax.random.fold_in(key0, e), (n_pairs, S), 0, V)
+                neg_e = jax.random.categorical(
+                    jax.random.fold_in(key0, e), neg_logits,
+                    shape=(n_pairs, S))
 
                 def loss_fn(params):
                     W_, C_ = params
