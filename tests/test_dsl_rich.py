@@ -113,3 +113,24 @@ def test_mapprep_example_end_to_end():
     out = run(n=800, seed=3)
     assert not out["blocked_cols"], "blacklisted key leaked into the vector"
     assert out["metrics"]["AuPR"] > 0.7
+
+
+def test_dsl_defaults_match_estimator_defaults():
+    """VERDICT r3 #8: DSL entry points must forward estimator defaults
+    untouched — a round-3 `word2vec(dim=32)` default in dsl.py silently
+    diverged from OpWord2Vec's Spark-parity dim=100/window=5."""
+    import inspect
+
+    from transmogrifai_tpu.ops.topics import OpLDA, OpWord2Vec
+
+    t = FeatureBuilder.TextList("t").from_column().as_predictor()
+    w2v = t.word2vec()
+    stage = w2v.origin_stage
+    sig = inspect.signature(OpWord2Vec.__init__)
+    assert stage.dim == sig.parameters["dim"].default == 100
+    assert stage.window == sig.parameters["window"].default == 5
+
+    t2 = FeatureBuilder.TextList("t").from_column().as_predictor()
+    lda = t2.lda()
+    assert lda.origin_stage.n_topics == \
+        inspect.signature(OpLDA.__init__).parameters["n_topics"].default

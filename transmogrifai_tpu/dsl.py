@@ -382,9 +382,13 @@ def _lda(self: Feature, n_topics: int = 10, **kw):
     return self.transform_with(OpLDA(n_topics=n_topics, **kw))
 
 
-def _word2vec(self: Feature, dim: int = 32, **kw):
+def _word2vec(self: Feature, **kw):
+    """Estimator defaults (dim=100, window=5 — Spark ml Word2Vec parity,
+    ``ops/topics.py``): the DSL entry forwards kwargs untouched so the
+    two surfaces cannot drift (a round-3 ``dim=32`` default here silently
+    gave DSL users a non-parity model)."""
     from .ops.topics import OpWord2Vec
-    return self.transform_with(OpWord2Vec(dim=dim, **kw))
+    return self.transform_with(OpWord2Vec(**kw))
 
 
 def _indexed(self: Feature, **kw):

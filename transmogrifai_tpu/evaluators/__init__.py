@@ -16,13 +16,15 @@ import numpy as np
 
 from ..columns import ColumnStore, NumericColumn, PredictionColumn
 from ..features import Feature
-from .metrics import (aupr, auroc, binary_metrics, multiclass_metrics,
+from .metrics import (aupr, auroc, binary_metrics, binary_threshold_curves,
+                      multiclass_metrics, multiclass_threshold_metrics,
                       regression_metrics)
 
 __all__ = ["OpEvaluatorBase", "BinaryClassificationEvaluator",
            "MultiClassificationEvaluator", "RegressionEvaluator",
            "BinScoreEvaluator", "Evaluators",
-           "binary_metrics", "multiclass_metrics", "regression_metrics"]
+           "binary_metrics", "multiclass_metrics", "regression_metrics",
+           "multiclass_threshold_metrics", "binary_threshold_curves"]
 
 
 class OpEvaluatorBase:
@@ -70,20 +72,43 @@ class BinaryClassificationEvaluator(OpEvaluatorBase):
     name = "binEval"
     default_metric = "AuROC"
 
-    def evaluate_all(self, store: ColumnStore) -> Dict[str, float]:
+    def __init__(self, threshold_curves: bool = False, **kw):
+        super().__init__(**kw)
+        #: include precision/recall/FPR-by-threshold curves in the bundle
+        #: (BinaryClassificationMetrics parity; off by default — the
+        #: curves are lists, not scalars)
+        self.threshold_curves = threshold_curves
+
+    def evaluate_all(self, store: ColumnStore) -> Dict[str, Any]:
         y, pred = self._extract(store)
         scores = (pred.probability[:, 1] if pred.probability.shape[1] >= 2
                   else pred.prediction)
-        return binary_metrics(y, pred.prediction, scores)
+        out: Dict[str, Any] = binary_metrics(y, pred.prediction, scores)
+        if self.threshold_curves:
+            out["ThresholdCurves"] = binary_threshold_curves(y, scores)
+        return out
 
 
 class MultiClassificationEvaluator(OpEvaluatorBase):
+    """Weighted P/R/F1/Error + topN × confidence-threshold metrics
+    (``OpMultiClassificationEvaluator.scala:120-229``)."""
+
     name = "multiEval"
     default_metric = "F1"
 
-    def evaluate_all(self, store: ColumnStore) -> Dict[str, float]:
+    def __init__(self, top_ns=(1, 3), thresholds=None, **kw):
+        super().__init__(**kw)
+        self.top_ns = tuple(top_ns)
+        self.thresholds = thresholds
+
+    def evaluate_all(self, store: ColumnStore) -> Dict[str, Any]:
         y, pred = self._extract(store)
-        return multiclass_metrics(y, pred.prediction)
+        out: Dict[str, Any] = multiclass_metrics(y, pred.prediction)
+        if pred.probability.ndim == 2 and pred.probability.shape[1] >= 2:
+            out["ThresholdMetrics"] = multiclass_threshold_metrics(
+                y, pred.probability, top_ns=self.top_ns,
+                thresholds=self.thresholds)
+        return out
 
 
 class RegressionEvaluator(OpEvaluatorBase):

@@ -149,39 +149,71 @@ def binary_threshold_curves(labels: np.ndarray, scores: np.ndarray,
 
 def multiclass_threshold_metrics(labels: np.ndarray, probabilities: np.ndarray,
                                  top_ns: Tuple[int, ...] = (1, 3),
-                                 n_thresholds: int = 100) -> Dict[str, object]:
-    """Top-N threshold metrics (OpMultiClassificationEvaluator
-    ``calculateThresholdMetrics`` :154): for each topN and confidence
-    threshold t, counts of rows whose max prob ≥ t that are correct
-    (true label within the top-N scored classes), incorrect, and rows
-    below t (no prediction). Vectorized: one argsort + histogram per topN."""
+                                 thresholds: Optional[np.ndarray] = None
+                                 ) -> Dict[str, object]:
+    """Top-N threshold metrics — exact port of
+    ``OpMultiClassificationEvaluator.calculateThresholdMetrics``
+    (``core/.../evaluators/OpMultiClassificationEvaluator.scala:154-229``).
+
+    Per row with true-class score ``s_true = probs[label]`` and top score
+    ``s_max = max(probs)``, at each threshold t:
+
+    * label within the top-N indices and ``t ≤ s_true`` → **correct**;
+    * otherwise ``t ≤ s_max`` → **incorrect** (note: a topN hit whose
+      true-class score falls below t while the top score stays above is
+      *incorrect*, not merely unpredicted — the serving-threshold
+      semantics the round-3 draft got wrong);
+    * ``t > s_max`` → **no prediction**.
+
+    correct + incorrect + noPrediction = n at every (topN, threshold).
+    Defaults match the reference: topNs (1, 3), thresholds 0.00..1.00
+    step 0.01 (``setDefault(thresholds, (0 to 100).map(_ / 100.0))``).
+    Vectorized: one argsort + cumulative histograms per topN.
+    """
     labels = np.asarray(labels).astype(np.int64)
     probs = np.asarray(probabilities, dtype=np.float64)
-    thresholds = np.linspace(0.0, 1.0, n_thresholds + 1)
+    if thresholds is None:
+        thresholds = np.linspace(0.0, 1.0, 101)
+    # per-threshold counts are order-independent; sort so the cutoff
+    # searches are well-defined for any user-supplied order (the output
+    # reports the sorted thresholds)
+    thresholds = np.sort(np.asarray(thresholds, dtype=np.float64))
+    n_t = len(thresholds)
     out: Dict[str, object] = {"topNs": list(top_ns),
                               "thresholds": thresholds.tolist(),
                               "correctCounts": {}, "incorrectCounts": {},
                               "noPredictionCounts": {}}
     if probs.size == 0:
         for k in top_ns:
-            out["correctCounts"][k] = [0] * (n_thresholds + 1)
-            out["incorrectCounts"][k] = [0] * (n_thresholds + 1)
-            out["noPredictionCounts"][k] = [0] * (n_thresholds + 1)
+            out["correctCounts"][k] = [0] * n_t
+            out["incorrectCounts"][k] = [0] * n_t
+            out["noPredictionCounts"][k] = [0] * n_t
         return out
-    max_prob = probs.max(axis=1)
-    rank_order = np.argsort(-probs, axis=1)           # [n, K]
-    n_rows = len(labels)
-    # bin index of each row's max prob: row predicted for thresholds ≤ bin
-    bins = np.clip(np.searchsorted(thresholds, max_prob, side="right") - 1,
-                   0, n_thresholds)
+    n_rows, n_cls = probs.shape
+    safe_lab = np.clip(labels, 0, n_cls - 1)
+    true_score = probs[np.arange(n_rows), safe_lab]
+    top_score = probs.max(axis=1)
+    rank_order = np.argsort(-probs, axis=1, kind="stable")   # [n, K]
+    # cutoff index: first threshold STRICTLY above the score — the row
+    # counts (as correct/predicted) at indices < cutoff
+    true_cut = np.searchsorted(thresholds, true_score, side="right")
+    max_cut = np.searchsorted(thresholds, top_score, side="right")
+
+    def below_counts(cuts):
+        """[n_t] array: c[i] = #rows with cutoff > i (i.e. counted at i)."""
+        h = np.bincount(cuts, minlength=n_t + 1)[:n_t + 1]
+        ge = np.cumsum(h[::-1])[::-1]                 # ge[j] = #cuts ≥ j
+        return ge[1:]                                 # #cuts > i = ge[i+1]
+
     for k in top_ns:
-        in_topk = (rank_order[:, :min(k, probs.shape[1])]
+        in_topk = (rank_order[:, :min(k, n_cls)]
                    == labels[:, None]).any(axis=1)
-        cor = np.bincount(bins[in_topk], minlength=n_thresholds + 1)
-        inc = np.bincount(bins[~in_topk], minlength=n_thresholds + 1)
-        # cumulative from the top: predicted at threshold t ⇔ bin ≥ t
-        cor_at = np.cumsum(cor[::-1])[::-1]
-        inc_at = np.cumsum(inc[::-1])[::-1]
+        cor_at = below_counts(true_cut[in_topk])
+        # topN hits turn incorrect between the true-score and top-score
+        # cutoffs; misses are incorrect up to the top-score cutoff
+        inc_at = (below_counts(max_cut[in_topk])
+                  - below_counts(true_cut[in_topk])
+                  + below_counts(max_cut[~in_topk]))
         out["correctCounts"][k] = cor_at.tolist()
         out["incorrectCounts"][k] = inc_at.tolist()
         out["noPredictionCounts"][k] = (n_rows - cor_at - inc_at).tolist()

@@ -271,25 +271,41 @@ def save_workflow_model(model, path: str, overwrite: bool = False) -> None:
     mj = os.path.join(path, MODEL_JSON)
     weights_name = f"weights-{uuid.uuid4().hex[:12]}.npz"
     doc["weightsFile"] = weights_name
+    # in-flight sidecar (ADVICE r3): a concurrent saver stalled for any
+    # length of time between its np.savez and its model.json replace is
+    # exempt from the orphan sweep via this marker — the previous pure
+    # mtime gate could delete a >60s-stalled saver's fresh weights
+    pending = os.path.join(path, weights_name + ".pending")
+    with open(pending, "w") as fh:
+        fh.write(str(os.getpid()))
     np.savez(os.path.join(path, weights_name), **arrays)
     json_tmp = mj + ".tmp"
     with open(json_tmp, "w") as fh:
         json.dump(doc, fh, indent=1, default=str)
     os.replace(json_tmp, mj)
-    # orphaned weights from prior/torn saves. Age-gated: a CONCURRENT
-    # saver's freshly written npz (its json replace still pending) must
-    # survive this sweep, or its final marker would reference a deleted
-    # file — only files quietly sitting around for a minute are orphans.
+    try:
+        os.remove(pending)
+    except OSError:
+        pass
+    # orphaned weights from prior/torn saves: skip any npz whose .pending
+    # sidecar still exists (a live concurrent saver), age-gate the rest;
+    # stale sidecars (crashed savers) fall to a 24h gate with their npz
     now = time.time()
     for fn in os.listdir(path):
-        if (fn.endswith(".npz") and fn != weights_name
-                and (fn.startswith("weights-") or fn == WEIGHTS_NPZ)):
-            try:
-                full = os.path.join(path, fn)
+        full = os.path.join(path, fn)
+        try:
+            if fn.endswith(".npz.pending") and fn != weights_name + ".pending":
+                if now - os.path.getmtime(full) > 86_400.0:
+                    os.remove(full)
+                continue
+            if (fn.endswith(".npz") and fn != weights_name
+                    and (fn.startswith("weights-") or fn == WEIGHTS_NPZ)):
+                if os.path.exists(full + ".pending"):
+                    continue            # concurrent saver still in flight
                 if now - os.path.getmtime(full) > 60.0:
                     os.remove(full)
-            except OSError:
-                pass
+        except OSError:
+            pass
 
 
 def rebuild_stages(records, arrays: Dict[str, np.ndarray]

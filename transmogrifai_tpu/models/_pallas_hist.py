@@ -53,7 +53,7 @@ from jax.experimental import pallas as pl
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["cumhist", "pallas_histograms_enabled"]
+__all__ = ["cumhist", "route_level", "pallas_histograms_enabled"]
 
 _PROBE: Optional[bool] = None
 
@@ -144,6 +144,87 @@ def cumhist(stats: jnp.ndarray, node: jnp.ndarray, Xb: jnp.ndarray,
     return out[..., :F]
 
 
+def _route_kernel(xb_ref, slot_ref, g_ref, tab_ref, slot_out, g_out, *,
+                  A_parent, A_child):
+    """Per-row level routing, one streamed pass over [bn, F] bin rows.
+
+    The XLA routing path materializes ~3 [n, A] f32 tensors per level
+    (one-hot slot masks, per-row split-feature values, child selects) —
+    at 1.8M rows × A=128 that is several GB of HBM traffic per level per
+    tree, and it showed up as ~42% of device time in the round-3 profile
+    (``BENCH_r03.json`` top ops are %while routing/binning state). Here
+    the whole lookup chain (slot → split feature/threshold/children →
+    compare → child slot) runs in VMEM with only [n, F] streamed in and
+    two [n] vectors out.
+
+    ``tab_ref`` rows: 0=f_idx, 1=t_idx(bin), 2=lchild, 3=rchild,
+    4=do_split — all int32, one column per parent slot.
+    """
+    bn, F = xb_ref.shape
+    slot = slot_ref[:, 0]                                   # [bn] i32
+    g = g_ref[:, 0]
+    oh = (slot[:, None] ==
+          lax.broadcasted_iota(jnp.int32, (bn, A_parent), 1)
+          ).astype(jnp.float32)                             # [bn, Ap]
+
+    def sel(row):                                           # [bn] f32
+        return jnp.sum(oh * tab_ref[row, :][None, :].astype(jnp.float32),
+                       axis=1)
+    f_sel = sel(0)
+    t_sel = sel(1)
+    l_sel = sel(2)
+    r_sel = sel(3)
+    ds_sel = sel(4)
+    fiota = lax.broadcasted_iota(jnp.int32, (bn, F), 1)
+    xv = jnp.sum(jnp.where(fiota == f_sel.astype(jnp.int32)[:, None],
+                           xb_ref[:].astype(jnp.float32), 0.0), axis=1)
+    right = ((xv > t_sel) & (ds_sel > 0.5)
+             & (slot < A_parent)).astype(jnp.int32)
+    child = jnp.where(right > 0, r_sel, l_sel).astype(jnp.int32)
+    slot_out[:, 0] = jnp.where(slot >= A_parent, A_child, child)
+    g_out[:, 0] = 2 * g + right
+
+
+def route_level(Xb: jnp.ndarray, slot: jnp.ndarray, g: jnp.ndarray,
+                f_idx, t_idx, lchild, rchild, do_split,
+                A_parent: int, A_child: int, *,
+                interpret: Optional[bool] = None):
+    """(slot, g) → (slot', g') for one tree level (see ``_route_kernel``)."""
+    n, F = Xb.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bn = max(8, min(512, (1 << 21) // max(4 * F, 1) // 8 * 8))
+    n_pad = _round_up(n, bn)
+    if n_pad != n:
+        pad = n_pad - n
+        Xb = jnp.concatenate([Xb, jnp.zeros((pad, F), Xb.dtype)])
+        slot = jnp.concatenate(
+            [slot, jnp.full((pad,), A_parent, slot.dtype)])
+        g = jnp.concatenate([g, jnp.zeros((pad,), g.dtype)])
+    tab = jnp.stack([f_idx.astype(jnp.int32), t_idx.astype(jnp.int32),
+                     lchild.astype(jnp.int32), rchild.astype(jnp.int32),
+                     do_split.astype(jnp.int32)])           # [5, Ap]
+    kern = functools.partial(_route_kernel, A_parent=A_parent,
+                             A_child=A_child)
+    slot2, g2 = pl.pallas_call(
+        kern,
+        grid=(n_pad // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, F), lambda rb: (rb, 0)),
+            pl.BlockSpec((bn, 1), lambda rb: (rb, 0)),
+            pl.BlockSpec((bn, 1), lambda rb: (rb, 0)),
+            pl.BlockSpec((5, A_parent), lambda rb: (0, 0)),
+        ],
+        out_specs=[pl.BlockSpec((bn, 1), lambda rb: (rb, 0)),
+                   pl.BlockSpec((bn, 1), lambda rb: (rb, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((n_pad, 1), jnp.int32)],
+        interpret=interpret,
+    )(Xb, slot.reshape(-1, 1).astype(jnp.int32),
+      g.reshape(-1, 1).astype(jnp.int32), tab)
+    return slot2[:n, 0], g2[:n, 0]
+
+
 def disable_pallas_histograms(exc: BaseException) -> bool:
     """Fit-level fallback (ADVICE r2): the probe compiles only a tiny
     shape, so Mosaic can still reject PRODUCTION shapes (n_bins·Fc off the
@@ -161,7 +242,10 @@ def disable_pallas_histograms(exc: BaseException) -> bool:
     if _PROBE is not True:
         return False
     text = repr(exc).lower()
-    if not any(s in text for s in ("mosaic", "pallas", "vmem", "internal:")):
+    # kernel-specific markers only (ADVICE r3): a generic "internal:"
+    # match let any unrelated XLA INTERNAL error permanently disable the
+    # kernel process-wide and silently re-run the sweep on the slow path
+    if not any(s in text for s in ("mosaic", "pallas", "vmem")):
         return False
     import warnings
     msg = (f"pallas histogram kernel failed at production shapes ({exc!r}); "

@@ -49,19 +49,32 @@ _NEG = -1e30
 # Binning
 # ---------------------------------------------------------------------------
 
+#: rows used for the quantile sketch at large n — full-column device sorts
+#: inside every fit were ~13% of the round-3 2M-row profile; Spark's
+#: approxQuantile and xgboost's quantile sketch are likewise approximate
+QUANTILE_SAMPLE_ROWS = 262_144
+
+
 def quantile_bin_edges(X: jnp.ndarray, n_bins: int) -> jnp.ndarray:
-    """Per-feature interior quantile edges → [F, n_bins - 1]."""
+    """Per-feature interior quantile edges → [F, n_bins - 1].
+
+    Edges come from a strided row subsample beyond QUANTILE_SAMPLE_ROWS
+    (deterministic, jit-static stride)."""
+    n = X.shape[0]
+    stride = max(1, -(-n // QUANTILE_SAMPLE_ROWS))
     qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
-    return jnp.quantile(X, qs, axis=0).T
+    return jnp.quantile(X[::stride], qs, axis=0).T
 
 
 def binarize(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
     """bin[i, f] = #{edges[f] < x[i, f]} ∈ [0, n_bins-1]; bin ≤ t ⟺
-    x ≤ edges[f, t], matching the stored split threshold."""
-    def per_feature(col, e):
-        return jnp.searchsorted(e, col, side="left")
-    return jax.vmap(per_feature, in_axes=(1, 0), out_axes=1)(
-        X, edges).astype(jnp.int32)
+    x ≤ edges[f, t], matching the stored split threshold.
+
+    One fused compare-accumulate pass over X — ``jnp.searchsorted``'s
+    default lowering is a binary-search *scan* carrying [n] state per
+    step, which ran on the TPU as serialized while-loops."""
+    return jnp.sum(X[:, :, None] > edges[None, :, :], axis=2,
+                   dtype=jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -254,14 +267,15 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
               crit, leaf_fn: Callable, max_depth: int,
               n_bins: int, min_instances, min_info_gain,
               depth_limit=None, feat_mask=None, max_active_nodes: int = 128,
-              col_blocks=None, node_feat_key=None, node_feat_k=None
+              col_blocks=None, node_feat_key=None, node_feat_k=None,
+              unroll: bool = False
               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Grow one tree level-wise; returns (feat [2^D−1], thr [2^D−1],
-    leaf [2^D, K], node [n] final sample→leaf assignment).
+    leaf [2^D, K], node [n] final sample→leaf assignment, gain [2^D−1]).
 
     ``min_instances`` / ``min_info_gain`` / ``depth_limit`` may be traced
     scalars — ``depth_limit`` stops splitting past that level while the
-    static scan runs to ``max_depth`` (nodes that stop route all samples
+    static loop runs to ``max_depth`` (nodes that stop route all samples
     left through +inf thresholds, so routing to depth ``max_depth`` is
     exact). ``feat_mask`` [F] bool restricts candidate features (per-TREE
     column subsampling). ``node_feat_key``/``node_feat_k`` instead draw an
@@ -270,7 +284,7 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
     ``OpRandomForestClassifier.scala:159`` via MLlib's RandomForest), and
     per-node draws decorrelate trees beyond a per-tree mask on correlated
     features. The [A, F] uniform-threshold draw folds the level index into
-    the key, so the level scan body stays one compiled program.
+    the key, so the level loop body stays one compiled program per shape.
 
     ``col_blocks`` — static list of (column-index ndarray, bins, thr_fn)
     partitioning the features into histogram blocks with different bin
@@ -284,30 +298,48 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
     Active-node compaction: a dense level-wise build would need a
     [2^d, F, B, C] histogram per level — 1.5 GB per grid instance at depth
     12 — even though most of those nodes are empty. Instead each level keeps
-    at most ``A = min(max_active_nodes, 2^(max_depth-1))`` live nodes in a
+    at most ``cap = min(max_active_nodes, 2^(max_depth-1))`` live nodes in a
     compact slot space (ranked by parent split gain). With min-instances ≥
-    n/A this is exact; beyond that the lowest-gain subtrees are truncated,
+    n/cap this is exact; beyond that the lowest-gain subtrees are truncated,
     which matches leaf-wise growers' behavior under a node budget.
 
-    The level loop is a ``lax.scan`` with a CONSTANT slot count, so the
-    body is traced and compiled once regardless of depth; per-level split
-    records are scattered into the dense level-order arrays after the scan.
+    **Two drivers, one level body.**  Default: a ``lax.scan`` over levels
+    with a CONSTANT slot count, traced and compiled once regardless of
+    depth — right when rows are few and compile time dominates.  With
+    ``unroll=True`` the level loop is a Python loop with a PER-LEVEL slot
+    count ``A_d = min(2^d, cap)``: level d has at most 2^d nodes, so the
+    histogram matmul (O(n·A·C·bins·F) on the MXU) stops paying the full
+    cap=128 at every level — a ~3× FLOP cut for a depth-9 tree and the
+    round-4 fix for the 0.001%-MFU profile.  Unrolling compiles one body
+    per level; callers enable it at large row counts where compute
+    dominates compile (the CV engine groups grid points by static depth
+    first, see ``models/tuning.py``).
+
+    Leaf values are scatter-built from the level histograms (a node's
+    total stats are already in its cumulative histogram): the previous
+    design's ``one_hot(g, 2^D)ᵀ @ stats`` matmul materialized an
+    [n, 2^D] bf16 operand — 1.8 GB per tree at 2M rows, depth 9.
     """
     n, F = Xb.shape
     B = n_bins
     C = stats.shape[1]
-    A = max(2, min(max_active_nodes, 1 << max(max_depth - 1, 1)))
+    D = max_depth
+    cap = max(2, min(max_active_nodes, 1 << max(D - 1, 1)))
     mmd = jnp.bfloat16 if stats.dtype == jnp.float32 else stats.dtype
-    if depth_limit is None:
-        depth_limit = jnp.asarray(max_depth, jnp.int32)
     if col_blocks is None:
         col_blocks = [(np.arange(F), B,
                        lambda fl, tl: edges[fl, tl])]
     blocks = [(np.asarray(cols), nb, thr_fn, Xb[:, np.asarray(cols)])
               for cols, nb, thr_fn in col_blocks]
+    total_nodes = (1 << D) - 1
+    n_leaves = 1 << D
 
-    def level(carry, d):
-        slot, g, gpos, alive = carry
+    from ._pallas_hist import pallas_histograms_enabled, route_level
+    use_pallas_route = pallas_histograms_enabled()
+
+    def level(d, A, A_next, slot, g, gpos, alive, feat, thr, gain, leafS):
+        """One level at A parent slots → A_next child slots. ``d`` may be
+        traced (scan driver) or a Python int (unrolled driver)."""
         if node_feat_key is not None:
             # per-node candidate draw: exactly node_feat_k features per
             # slot, re-drawn every level (slot identity changes per level,
@@ -366,74 +398,106 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
         tstats = cums[0][:, :, -1, 0]
         best_gain = crit.gain(lstats, tstats)
         do_split = alive & valid \
-            & (best_gain >= jnp.maximum(min_info_gain, 1e-10)) \
-            & (d < depth_limit)
+            & (best_gain >= jnp.maximum(min_info_gain, 1e-10))
+        if depth_limit is not None:
+            do_split = do_split & (d < depth_limit)
         f_idx = jnp.where(do_split, f_idx, 0)
-        thr = jnp.where(do_split, thr_v, jnp.inf)
+        thr_rec = jnp.where(do_split, thr_v, jnp.inf)
 
         # next level: rank splitting slots by gain, allocate child slots
         rank = jnp.argsort(jnp.where(do_split, -best_gain, jnp.inf))
         inv = jnp.zeros((A,), jnp.int32).at[rank].set(
             jnp.arange(A, dtype=jnp.int32))
-        parent_ok = do_split & (inv < A // 2)
-        lchild = jnp.where(parent_ok, 2 * inv, A)
-        rchild = jnp.where(parent_ok, 2 * inv + 1, A)
+        parent_ok = do_split & (inv < A_next // 2)
+        lchild = jnp.where(parent_ok, 2 * inv, A_next)
+        rchild = jnp.where(parent_ok, 2 * inv + 1, A_next)
 
-        # gather-free sample routing: per-sample table lookups run on the
-        # TPU scalar core and were ~15% of the sweep; instead select each
-        # sample's split feature with a one-hot matmul (MXU) and its
-        # slot-table values with masked [n, A] reductions (VPU).
-        oh = jax.nn.one_hot(slot, A, dtype=mmd)       # [n, A]; idle → 0-row
-        sel = jax.nn.one_hot(f_idx, F, dtype=mmd)     # [A, F]
-        xf = jnp.matmul(Xb.astype(mmd), sel.T,
-                        preferred_element_type=stats.dtype)   # [n, A]
-        Q = (xf > t_idx[None, :].astype(xf.dtype)) \
-            & do_split[None, :]                       # [n, A]
-        ohb = oh > 0
-        go_right = jnp.any(ohb & Q, axis=1)
-        g2 = 2 * g + go_right.astype(jnp.int32)
-        child = jnp.where(Q, rchild[None, :], lchild[None, :])
-        slot2 = jnp.where(slot == A, A,
-                          jnp.sum(jnp.where(ohb, child, 0), axis=1,
-                                  dtype=jnp.int32))
-        gpos2 = (jnp.zeros((A,), jnp.int32)
+        if use_pallas_route:
+            # single streamed VMEM pass (see _pallas_hist._route_kernel);
+            # the XLA alternative below materializes ~3 [n, A] tensors
+            slot2, g2 = route_level(Xb, slot, g, f_idx, t_idx,
+                                    lchild, rchild, do_split, A, A_next)
+        else:
+            # gather-free sample routing: per-sample table lookups run on
+            # the TPU scalar core; instead select each sample's split
+            # feature with a one-hot matmul (MXU) and its slot-table
+            # values with masked [n, A] reductions (VPU).
+            oh = jax.nn.one_hot(slot, A, dtype=mmd)   # [n, A]; idle → 0-row
+            sel = jax.nn.one_hot(f_idx, F, dtype=mmd)  # [A, F]
+            xf = jnp.matmul(Xb.astype(mmd), sel.T,
+                            preferred_element_type=stats.dtype)   # [n, A]
+            Q = (xf > t_idx[None, :].astype(xf.dtype)) \
+                & do_split[None, :]                   # [n, A]
+            ohb = oh > 0
+            go_right = jnp.any(ohb & Q, axis=1)
+            g2 = 2 * g + go_right.astype(jnp.int32)
+            child = jnp.where(Q, rchild[None, :], lchild[None, :])
+            slot2 = jnp.where(slot == A, A_next,
+                              jnp.sum(jnp.where(ohb, child, 0), axis=1,
+                                      dtype=jnp.int32))
+        gpos2 = (jnp.zeros((A_next,), jnp.int32)
                  .at[lchild].set(2 * gpos, mode="drop")
                  .at[rchild].set(2 * gpos + 1, mode="drop"))
-        alive2 = (jnp.zeros((A,), bool)
+        alive2 = (jnp.zeros((A_next,), bool)
                   .at[lchild].set(parent_ok, mode="drop")
                   .at[rchild].set(parent_ok, mode="drop"))
-        # record (compact): dense node id per slot, sentinel 2^D if dead
-        rec_pos = jnp.where(alive, gpos, jnp.int32(1 << max_depth))
-        g_rec = jnp.where(do_split, best_gain, 0).astype(stats.dtype)
-        return (slot2, g2, gpos2, alive2), (f_idx, thr, rec_pos, g_rec)
 
+        # record splits: node (d, j) lives at flat index (2^d - 1) + j
+        off_d = jnp.left_shift(jnp.int32(1), d) - 1
+        idx = jnp.where(alive, off_d + gpos, total_nodes)
+        feat = feat.at[idx].set(f_idx, mode="drop")
+        thr = thr.at[idx].set(thr_rec, mode="drop")
+        gain = gain.at[idx].set(
+            jnp.where(do_split, best_gain, 0).astype(stats.dtype),
+            mode="drop")
+        # leaf stats: a node that stops splitting is a leaf covering the
+        # g-range [gpos << (D-d), …); its rows' final g is exactly
+        # gpos << (D-d) (g doubles with +0 once a row's slot is dead).
+        # A split whose children leave the slot budget (truncation) or
+        # that happens at the last level yields two leaf children.
+        dying = alive & ~do_split
+        leafS = leafS.at[
+            jnp.where(dying, jnp.left_shift(gpos, D - d), n_leaves)
+        ].set(tstats, mode="drop")
+        is_last = (d == D - 1)
+        emit_children = do_split & (~parent_ok | is_last)
+        sh = D - d - 1
+        li = jnp.where(emit_children,
+                       jnp.left_shift(2 * gpos, sh), n_leaves)
+        ri = jnp.where(emit_children,
+                       jnp.left_shift(2 * gpos + 1, sh), n_leaves)
+        leafS = (leafS.at[li].set(lstats, mode="drop")
+                 .at[ri].set(tstats - lstats, mode="drop"))
+        return slot2, g2, gpos2, alive2, feat, thr, gain, leafS
+
+    feat0 = jnp.zeros((total_nodes,), jnp.int32)
+    thr0 = jnp.full((total_nodes,), jnp.inf, edges.dtype)
+    gain0 = jnp.zeros((total_nodes,), stats.dtype)
+    leafS0 = jnp.zeros((n_leaves, C), stats.dtype)
     slot0 = jnp.zeros((n,), jnp.int32)
     g0 = jnp.zeros((n,), jnp.int32)
-    gpos0 = jnp.zeros((A,), jnp.int32)
-    alive0 = jnp.arange(A) == 0
-    (_, g, _, _), (f_rec, t_rec, pos_rec, gain_rec) = lax.scan(
-        level, (slot0, g0, gpos0, alive0),
-        jnp.arange(max_depth, dtype=jnp.int32))
 
-    # scatter compact per-level records into dense level-order arrays:
-    # node (d, j) lives at flat index (2^d - 1) + j
-    total_nodes = (1 << max_depth) - 1
-    offsets = (jnp.left_shift(1, jnp.arange(max_depth, dtype=jnp.int32))
-               - 1)[:, None]                          # [D, 1]
-    idx = (offsets + pos_rec).ravel()                 # dead slots → ≥ total
-    feat = jnp.zeros((total_nodes,), jnp.int32).at[idx].set(
-        f_rec.ravel(), mode="drop")
-    thr = jnp.full((total_nodes,), jnp.inf, t_rec.dtype).at[idx].set(
-        t_rec.ravel(), mode="drop")
-    gain = jnp.zeros((total_nodes,), stats.dtype).at[idx].set(
-        gain_rec.ravel(), mode="drop")
+    if unroll:
+        # per-level slot growth; every level body is its own trace
+        slot, g = slot0, g0
+        gpos = jnp.zeros((1,), jnp.int32)
+        alive = jnp.ones((1,), bool)
+        feat, thr, gain, leafS = feat0, thr0, gain0, leafS0
+        for d in range(D):
+            A = min(1 << d, cap)
+            A_next = min(1 << (d + 1), cap)
+            slot, g, gpos, alive, feat, thr, gain, leafS = level(
+                d, A, A_next, slot, g, gpos, alive, feat, thr, gain, leafS)
+    else:
+        def body(carry, d):
+            return level(d, cap, cap, *carry), None
+        gpos0 = jnp.zeros((cap,), jnp.int32)
+        alive0 = jnp.arange(cap) == 0
+        (slot, g, gpos, alive, feat, thr, gain, leafS), _ = lax.scan(
+            body, (slot0, g0, gpos0, alive0, feat0, thr0, gain0, leafS0),
+            jnp.arange(D, dtype=jnp.int32))
 
-    # leaf values: one MXU matmul instead of a vmapped scatter
-    mm_dtype = jnp.bfloat16 if stats.dtype == jnp.float32 else stats.dtype
-    onehot_leaf = jax.nn.one_hot(g, 1 << max_depth, dtype=mm_dtype)
-    leaf_stats = jnp.matmul(onehot_leaf.T, stats.astype(mm_dtype),
-                            preferred_element_type=stats.dtype)
-    leaf = leaf_fn(leaf_stats)
+    leaf = leaf_fn(leafS)
     return feat, thr, leaf, g, gain
 
 
@@ -509,34 +573,47 @@ def _feature_masks(key, n_trees: int, n_feat: int, k: int) -> jnp.ndarray:
     return u <= kth
 
 
-def prepare_bins(X, n_bins, binary_mask=None):
-    """Quantile-bin X; binary indicator columns get a 2-bin block.
+def compute_bins(X, n_bins, binary_mask=None):
+    """Jittable one-shot binning: [n, F] reals → (Xb int32, edges).
 
-    Returns (Xb, edges, col_blocks): ``Xb`` [n, F] int bins (binary columns
-    re-binned to {0, 1} so the routing compare ``bin > t_idx`` works with
-    the block-local threshold index 0), ``col_blocks`` for
-    :func:`grow_tree` — or None when there is no binary column worth
-    splitting off. ``binary_mask`` is a STATIC host-side [F] bool (the
-    caller detects indicator columns on the host; data-dependent shapes
-    are not jittable).
-    """
-    n, F = X.shape
+    Binary indicator columns are re-binned to {0, 1} so the routing
+    compare ``bin > t_idx`` works with the block-local threshold index 0.
+    The CV engine calls this ONCE per (data, family-binning-config) and
+    passes the result to every fold × grid fit — round 3 recomputed the
+    quantile sort + binarize inside every dispatched fit (~13% of the
+    2M-row profile)."""
     edges = quantile_bin_edges(X, n_bins)
     Xb = binarize(X, edges)
+    if binary_mask is not None and np.asarray(binary_mask).any():
+        Xb = jnp.where(jnp.asarray(np.asarray(binary_mask, bool))[None, :],
+                       (X > 0.5).astype(jnp.int32), Xb)
+    return Xb, edges
+
+
+def make_col_blocks(edges, n_bins, binary_mask=None):
+    """Static col_blocks for :func:`grow_tree` from a host-side [F] bool
+    indicator-column mask — or None when there is no binary column worth
+    splitting off (data-dependent shapes are not jittable, so the caller
+    detects indicator columns on the host)."""
     if binary_mask is None or not np.asarray(binary_mask).any():
-        return Xb, edges, None
+        return None
     bmask = np.asarray(binary_mask, bool)
     bin_cols = np.nonzero(bmask)[0]
     cont_cols = np.nonzero(~bmask)[0]
-    Xb = jnp.where(jnp.asarray(bmask)[None, :],
-                   (X > 0.5).astype(jnp.int32), Xb)
     blocks = []
     if len(cont_cols):
         blocks.append((cont_cols, n_bins,
                        lambda fl, tl: edges[fl, tl]))
     blocks.append((bin_cols, 2,
                    lambda fl, tl: jnp.full(fl.shape, 0.5, edges.dtype)))
-    return Xb, edges, blocks
+    return blocks
+
+
+def prepare_bins(X, n_bins, binary_mask=None):
+    """Quantile-bin X; binary indicator columns get a 2-bin block.
+    Returns (Xb, edges, col_blocks) — see compute_bins/make_col_blocks."""
+    Xb, edges = compute_bins(X, n_bins, binary_mask)
+    return Xb, edges, make_col_blocks(edges, n_bins, binary_mask)
 
 
 def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
@@ -544,7 +621,8 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
                num_trees_used, subsample_rate, depth_limit=None,
                max_active_nodes: int = 128, tree_chunk: int = 1,
                binary_mask=None, seed: int = 7,
-               per_node_features: bool = True):
+               per_node_features: bool = True,
+               prebinned=None, unroll: bool = False):
     """Random forest via scanned bootstrap trees.
 
     Traced: min_instances, min_info_gain, num_trees_used (≤ n_trees,
@@ -554,18 +632,29 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
     time (vmap inside the scan): fewer, larger device steps — per-step
     histogram work is batched onto the MXU instead of serializing
     T × depth small steps. ``tree_chunk`` bounds the transient
-    [chunk, A, F, B, C] histogram memory."""
+    [chunk, A, F, B, C] histogram memory.
+
+    ``prebinned`` — optional (Xb, edges, col_blocks) computed once by the
+    caller (see compute_bins); skips in-fit binning so the CV engine bins
+    the data exactly once per sweep. ``unroll`` — per-level slot growth
+    (see grow_tree); pair with a static ``max_depth`` at large n.
+
+    Bootstrap Poisson weights are drawn per tree inside the tree scan
+    (key folded on the tree index — chunk-size invariant): the previous
+    up-front [n_trees, n] draw materialized 360 MB per grid instance at
+    2M rows."""
     key = jax.random.PRNGKey(seed)
     k_boot, k_feat = jax.random.split(key)
-    n, F = X.shape
-    Xb, edges, col_blocks = prepare_bins(X, n_bins, binary_mask)
-    boot = jax.random.poisson(
-        k_boot, jnp.broadcast_to(jnp.asarray(subsample_rate, jnp.float32),
-                                 ()), (n_trees, n)).astype(X.dtype)
+    if prebinned is not None:
+        Xb, edges, col_blocks = prebinned
+    else:
+        Xb, edges, col_blocks = prepare_bins(X, n_bins, binary_mask)
+    n, F = Xb.shape
+    dt = w.dtype
+    rate = jnp.broadcast_to(jnp.asarray(subsample_rate, jnp.float32), ())
     per_node = False
     feat_k = F
     if n_trees == 1:
-        boot = jnp.ones((1, n), X.dtype)          # single DT: no bootstrap
         fmask = jnp.ones((1, F), bool)
     else:
         k = max(1, int(round(np.sqrt(F))) if task == "classification"
@@ -581,18 +670,23 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
     fkeys = jax.random.split(k_feat, n_trees)
 
     if task == "classification":
-        onehot = jax.nn.one_hot(y.astype(jnp.int32), n_classes, dtype=X.dtype)
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), n_classes, dtype=dt)
         def make_stats(wt):
             return jnp.concatenate(
-                [onehot * wt[:, None], (wt > 0).astype(X.dtype)[:, None]], 1)
+                [onehot * wt[:, None], (wt > 0).astype(dt)[:, None]], 1)
         crit, leaf_fn = GiniCriterion(), gini_leaf
     else:
         def make_stats(wt):
             return jnp.stack(
-                [wt, wt * y, wt * y * y, (wt > 0).astype(X.dtype)], axis=1)
+                [wt, wt * y, wt * y * y, (wt > 0).astype(dt)], axis=1)
         crit, leaf_fn = VarianceCriterion(), variance_leaf
 
-    def fit_one(bw, fm, fk):
+    def fit_one(tid, fm, fk):
+        if n_trees == 1:
+            bw = jnp.ones((n,), dt)             # single DT: no bootstrap
+        else:
+            bw = jax.random.poisson(
+                jax.random.fold_in(k_boot, tid), rate, (n,)).astype(dt)
         wt = w * bw
         feat, thr, leaf, node, gain = grow_tree(
             Xb, edges, make_stats(wt), crit, leaf_fn, max_depth,
@@ -601,30 +695,30 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
             max_active_nodes=max_active_nodes,
             col_blocks=col_blocks,
             node_feat_key=fk if per_node else None,
-            node_feat_k=feat_k)
+            node_feat_k=feat_k, unroll=unroll)
         return feat, thr, leaf, node, gain
 
     c = max(1, min(tree_chunk, n_trees))
     pad = (-n_trees) % c
+    tids = jnp.arange(n_trees + pad, dtype=jnp.int32)
     if pad:
-        boot = jnp.concatenate([boot, jnp.zeros((pad, n), boot.dtype)])
         fmask = jnp.concatenate([fmask, jnp.ones((pad, F), bool)])
         fkeys = jnp.concatenate([fkeys, jnp.zeros((pad,) + fkeys.shape[1:],
                                                   fkeys.dtype)])
     nc = (n_trees + pad) // c
 
     def body(_, per_chunk):
-        bw, fm, fk = per_chunk                  # [c, n], [c, F], [c, key]
-        return None, jax.vmap(fit_one)(bw, fm, fk)
+        tid, fm, fk = per_chunk                 # [c], [c, F], [c, key]
+        return None, jax.vmap(fit_one)(tid, fm, fk)
     _, (feat, thr, leaf, node, gain) = lax.scan(
-        body, None, (boot.reshape(nc, c, n), fmask.reshape(nc, c, F),
+        body, None, (tids.reshape(nc, c), fmask.reshape(nc, c, F),
                      fkeys.reshape((nc, c) + fkeys.shape[1:])))
     feat = feat.reshape((nc * c,) + feat.shape[2:])[:n_trees]
     thr = thr.reshape((nc * c,) + thr.shape[2:])[:n_trees]
     leaf = leaf.reshape((nc * c,) + leaf.shape[2:])[:n_trees]
     node = node.reshape((nc * c,) + node.shape[2:])[:n_trees]
     gain = gain.reshape((nc * c,) + gain.shape[2:])[:n_trees]
-    tree_w = (jnp.arange(n_trees) < num_trees_used).astype(X.dtype)
+    tree_w = (jnp.arange(n_trees) < num_trees_used).astype(dt)
     tree_w = tree_w / jnp.maximum(tree_w.sum(), 1.0)
     # train_node caches the fit-time sample→leaf routing: predicting the
     # TRAINING matrix (the CV sweep's case) is then leaf gathers only — no
@@ -640,12 +734,16 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
 def fit_gbt(X, y, w, *, task: str, n_rounds: int, max_depth: int,
             n_bins: int, min_instances, min_info_gain, step_size,
             num_rounds_used, depth_limit=None, max_active_nodes: int = 128,
-            binary_mask=None):
+            binary_mask=None, prebinned=None, unroll: bool = False):
     """Spark-style GBT: each round fits a weighted regression tree to the
     pseudo-residuals; classification uses logloss on y' ∈ {−1,+1} with
     margin F, prob = σ(2F) (GBTClassificationModel semantics)."""
-    Xb, edges, col_blocks = prepare_bins(X, n_bins, binary_mask)
-    n, F = X.shape
+    if prebinned is not None:
+        Xb, edges, col_blocks = prebinned
+    else:
+        Xb, edges, col_blocks = prepare_bins(X, n_bins, binary_mask)
+    n = Xb.shape[0]
+    dt = w.dtype
     ypm = 2.0 * y - 1.0
 
     def residual(Fm):
@@ -656,21 +754,22 @@ def fit_gbt(X, y, w, *, task: str, n_rounds: int, max_depth: int,
     def body(Fm, t):
         r = residual(Fm)
         stats = jnp.stack([w, w * r, w * r * r,
-                           (w > 0).astype(X.dtype)], axis=1)
+                           (w > 0).astype(dt)], axis=1)
         feat, thr, leaf, node, gain = grow_tree(
             Xb, edges, stats, VarianceCriterion(), variance_leaf, max_depth,
             n_bins, min_instances, min_info_gain, depth_limit=depth_limit,
-            max_active_nodes=max_active_nodes, col_blocks=col_blocks)
-        use = (t < num_rounds_used).astype(X.dtype)
+            max_active_nodes=max_active_nodes, col_blocks=col_blocks,
+            unroll=unroll)
+        use = (t < num_rounds_used).astype(dt)
         scale = use * step_size
         Fm = Fm + scale * leaf[node][:, 0]
         return Fm, (feat, thr, leaf * scale, gain * use)
-    F0 = jnp.zeros((n,), X.dtype)
+    F0 = jnp.zeros((n,), dt)
     Fm, (feat, thr, leaf, gain) = lax.scan(body, F0, jnp.arange(n_rounds))
     # train_margin caches the final boosted margin on the training matrix
     # (see fit_forest.train_node) — CV predict needs no routing at all.
     return {"feat": feat, "thr": thr, "leaf": leaf,
-            "tree_w": jnp.ones((n_rounds,), X.dtype), "train_margin": Fm,
+            "tree_w": jnp.ones((n_rounds,), dt), "train_margin": Fm,
             "gain": gain}
 
 
@@ -681,12 +780,16 @@ def fit_gbt(X, y, w, *, task: str, n_rounds: int, max_depth: int,
 def fit_xgb(X, y, w, *, task: str, n_rounds: int, max_depth: int,
             n_bins: int, eta, lam, min_child_weight, num_rounds_used,
             depth_limit=None, max_active_nodes: int = 128,
-            binary_mask=None):
+            binary_mask=None, prebinned=None, unroll: bool = False):
     """Second-order boosting: g/h from logistic (classification) or squared
     (regression) loss; leaf = −G/(H+λ) (xgboost4j replacement — Rabit's
     histogram allreduce becomes psum under a sharded batch axis)."""
-    Xb, edges, col_blocks = prepare_bins(X, n_bins, binary_mask)
-    n, F = X.shape
+    if prebinned is not None:
+        Xb, edges, col_blocks = prebinned
+    else:
+        Xb, edges, col_blocks = prepare_bins(X, n_bins, binary_mask)
+    n = Xb.shape[0]
+    dt = w.dtype
     crit = XGBCriterion(lam, min_child_weight)
     leaf_fn = make_xgb_leaf(lam)
 
@@ -698,20 +801,20 @@ def fit_xgb(X, y, w, *, task: str, n_rounds: int, max_depth: int,
 
     def body(Fm, t):
         g, h = grads(Fm)
-        stats = jnp.stack([g, h, (w > 0).astype(X.dtype)], axis=1)
+        stats = jnp.stack([g, h, (w > 0).astype(dt)], axis=1)
         feat, thr, leaf, node, gain = grow_tree(
             Xb, edges, stats, crit, leaf_fn, max_depth, n_bins,
-            jnp.asarray(0.0, X.dtype), jnp.asarray(-1e29, X.dtype),
+            jnp.asarray(0.0, dt), jnp.asarray(-1e29, dt),
             depth_limit=depth_limit, max_active_nodes=max_active_nodes,
-            col_blocks=col_blocks)
-        use = (t < num_rounds_used).astype(X.dtype)
+            col_blocks=col_blocks, unroll=unroll)
+        use = (t < num_rounds_used).astype(dt)
         scale = use * eta
         Fm = Fm + scale * leaf[node][:, 0]
         return Fm, (feat, thr, leaf * scale, gain * use)
-    F0 = jnp.zeros((n,), X.dtype)
+    F0 = jnp.zeros((n,), dt)
     Fm, (feat, thr, leaf, gain) = lax.scan(body, F0, jnp.arange(n_rounds))
     return {"feat": feat, "thr": thr, "leaf": leaf,
-            "tree_w": jnp.ones((n_rounds,), X.dtype), "train_margin": Fm,
+            "tree_w": jnp.ones((n_rounds,), dt), "train_margin": Fm,
             "gain": gain}
 
 
@@ -719,27 +822,28 @@ def fit_xgb(X, y, w, *, task: str, n_rounds: int, max_depth: int,
 # Ensemble → Prediction triple (pred, raw, prob)
 # ---------------------------------------------------------------------------
 
-def rf_head(out, X, task: str):
+def rf_head(out, dtype, task: str):
     """[n, K] weighted leaf aggregate → Prediction triple (shared by the
-    routed predict path and the CV train-cache path)."""
+    routed predict path and the CV train-cache path). ``dtype`` is the
+    prediction dtype (raw X is absent on the prebinned CV path)."""
     if task == "classification":
         probs = out / jnp.maximum(out.sum(-1, keepdims=True), _EPS)
-        pred = jnp.argmax(probs, axis=-1).astype(X.dtype)
+        pred = jnp.argmax(probs, axis=-1).astype(dtype)
         return pred, probs, probs
-    empty = jnp.zeros((X.shape[0], 0), X.dtype)
+    empty = jnp.zeros((out.shape[0], 0), dtype)
     return out[:, 0], empty, empty
 
 
-def margin_head(m, margin_scale, X, task: str):
+def margin_head(m, margin_scale, dtype, task: str):
     """[n] boosted margin → Prediction triple. GBT uses prob = σ(2F),
     XGB σ(F) (shared by routed and train-cache paths)."""
     if task == "classification":
         p1 = jax.nn.sigmoid(margin_scale * m)
         prob = jnp.stack([1.0 - p1, p1], axis=1)
         raw = jnp.stack([-m, m], axis=1)
-        pred = (p1 > 0.5).astype(X.dtype)
+        pred = (p1 > 0.5).astype(dtype)
         return pred, raw, prob
-    empty = jnp.zeros((X.shape[0], 0), X.dtype)
+    empty = jnp.zeros((m.shape[0], 0), dtype)
     return m, empty, empty
 
 
@@ -747,14 +851,14 @@ def margin_head(m, margin_scale, X, task: str):
 def predict_rf_classification(params, X, max_depth: int, n_classes: int):
     probs = predict_ensemble(params["feat"], params["thr"], params["leaf"],
                              params["tree_w"], X, max_depth)
-    return rf_head(probs, X, "classification")
+    return rf_head(probs, X.dtype, "classification")
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def predict_rf_regression(params, X, max_depth: int):
     out = predict_ensemble(params["feat"], params["thr"], params["leaf"],
                            params["tree_w"], X, max_depth)
-    return rf_head(out, X, "regression")
+    return rf_head(out, X.dtype, "regression")
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "margin_scale"))
@@ -763,11 +867,11 @@ def predict_margin_classification(params, X, max_depth: int,
     """GBT (margin_scale=2: prob = σ(2F)) and XGB (=1) binary heads."""
     m = predict_ensemble(params["feat"], params["thr"], params["leaf"],
                          params["tree_w"], X, max_depth)[:, 0]
-    return margin_head(m, margin_scale, X, "classification")
+    return margin_head(m, margin_scale, X.dtype, "classification")
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth",))
 def predict_margin_regression(params, X, max_depth: int):
     m = predict_ensemble(params["feat"], params["thr"], params["leaf"],
                          params["tree_w"], X, max_depth)[:, 0]
-    return margin_head(m, 1.0, X, "regression")
+    return margin_head(m, 1.0, X.dtype, "regression")

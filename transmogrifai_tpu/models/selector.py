@@ -159,7 +159,13 @@ def _task_metrics(task: str, y, pred, prob) -> Dict[str, float]:
         scores = prob[:, 1] if prob.ndim == 2 and prob.shape[1] >= 2 else pred
         return M.binary_metrics(y, pred, scores)
     if task == "multiclass":
-        return M.multiclass_metrics(y, pred)
+        out = M.multiclass_metrics(y, pred)
+        if prob is not None and np.ndim(prob) == 2 and prob.shape[1] >= 2:
+            # topN × confidence-band counts ride in the selector summary
+            # like the reference's MultiClassificationMetrics
+            # (OpMultiClassificationEvaluator.scala:120-132)
+            out["ThresholdMetrics"] = M.multiclass_threshold_metrics(y, prob)
+        return out
     return M.regression_metrics(y, pred)
 
 
@@ -253,11 +259,19 @@ class ModelSelector(PredictorEstimator):
             Xk, yk = X, y
             w = np.ones_like(yk)
         single = best_family.clone_single(best_hparams)
-        grid = single.stack_grid()
-        params = jax.jit(lambda X, y, w: single.fit_batch(X, y, w, grid))(
-            jnp.asarray(Xk), jnp.asarray(yk), jnp.asarray(w))
-        pred_d, _raw_d, prob_d = single.predict_batch(params,
-                                                      jnp.asarray(Xk))
+        Xd = jnp.asarray(Xk)
+        if hasattr(single, "fit_prepared"):
+            # tree refit: bin once, static-depth unrolled fit at large n,
+            # train predictions straight from the fit-time caches
+            params, Xarg = single.fit_prepared(
+                Xd, jnp.asarray(yk), jnp.asarray(w))
+            pred_d, _raw_d, prob_d = single.predict_batch(params, Xarg,
+                                                          on_train=True)
+        else:
+            grid = single.stack_grid()
+            params = jax.jit(lambda X, y, w: single.fit_batch(
+                X, y, w, grid))(Xd, jnp.asarray(yk), jnp.asarray(w))
+            pred_d, _raw_d, prob_d = single.predict_batch(params, Xd)
         # ONE batched pull for fitted params + train predictions (per-array
         # pulls each pay the device link's round-trip latency)
         params, pred, prob = jax.device_get((params, pred_d, prob_d))

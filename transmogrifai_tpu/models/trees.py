@@ -35,6 +35,36 @@ def _strip_caches(p: Dict[str, Any]) -> Dict[str, Any]:
             if k not in ("train_node", "train_margin")}
 
 
+#: row count beyond which the level loop unrolls with per-level slot
+#: growth (grow_tree unroll=True) and the CV engine compiles one program
+#: per static maxDepth: below it compile time dominates and the traced-
+#: depth scan program is the right trade; above it the A=cap histogram
+#: matmuls at shallow levels dominate device time (round-3 profile)
+UNROLL_MIN_ROWS = 131_072
+
+#: binned-input cache for device_prep: (id(X), shape, dtype, bins, mask)
+#: → (weakref to X, prep). The weakref dies with the caller's array, so
+#: the cached Xb/edges (and the id-keyed entry) release their HBM as soon
+#: as the sweep drops the feature matrix — a strong ref here would pin
+#: ~1.6 GB per 2M×100 entry for the life of the process
+_PREP_CACHE: Dict[Any, Any] = {}
+
+#: jitted compute_bins per (n_bins, mask-bytes): jit's own shape cache
+#: handles retraces; a fresh jax.jit(lambda) per device_prep call would
+#: recompile the same binning program on every cache miss (per-fold CV)
+_BIN_FNS: Dict[Any, Any] = {}
+
+
+def _tree_dtype(X) -> Any:
+    """Prediction dtype for a fit/predict input that may be the prebinned
+    dict (no raw X on the CV path)."""
+    return X["edges"].dtype if isinstance(X, dict) else X.dtype
+
+
+def _tree_rows(X) -> int:
+    return (X["Xb"] if isinstance(X, dict) else X).shape[0]
+
+
 def detect_binary_columns(X: np.ndarray) -> Optional[np.ndarray]:
     """Host-side [F] bool: columns whose values are all in {0, 1}.
 
@@ -160,6 +190,10 @@ class _TreeFamilyBase(ModelFamily):
     #: keys whose stacked values are traced & vmapped
     traced_keys: List[str] = []
 
+    #: the CV engine may group grid points by maxDepth and compile one
+    #: static-depth unrolled program per group at large row counts
+    supports_static_depth = True
+
     def _trace_extras(self):
         # the Pallas histogram gate changes the tree engine's emitted
         # program, so it must key this family's executable cache entries
@@ -167,7 +201,8 @@ class _TreeFamilyBase(ModelFamily):
         return (("__pallas__", pallas_histograms_enabled()),)
 
     def _fit_single(self, X, y, w, depth: int, n_trees: int,
-                    traced: Dict[str, Any]) -> Dict[str, Any]:
+                    traced: Dict[str, Any], prebinned=None,
+                    unroll: bool = False) -> Dict[str, Any]:
         raise NotImplementedError
 
     def _static_trees(self) -> int:
@@ -187,16 +222,81 @@ class _TreeFamilyBase(ModelFamily):
                                  self.param_defaults()["maxDepth"]))
                        for g in self.grid))
 
-    def fit_batch(self, X, y, w, stacked):
-        D = self.global_depth()
+    def device_prep(self, Xd):
+        """Bin the feature matrix ONCE per (data, binning config) and
+        return the ``{"Xb", "edges"}`` dict fit_batch/predict_batch accept
+        in place of raw X. Round 3 recomputed quantile edges + binarize
+        inside every dispatched (fold × grid-chunk) fit — ~13% of the
+        2M-row device profile. Cached across families/folds sharing the
+        same device array (strong ref keeps ``id`` stable)."""
+        import functools
+        import weakref
+        bm = self.binary_mask
+        mkey = None if bm is None else np.asarray(bm, bool).tobytes()
+        key = (id(Xd), tuple(Xd.shape), str(Xd.dtype), self.n_bins, mkey)
+        hit = _PREP_CACHE.get(key)
+        if hit is not None and hit[0]() is not None:
+            return hit[1]
+        fkey = (self.n_bins, mkey)
+        fn = _BIN_FNS.get(fkey)
+        if fn is None:
+            fn = jax.jit(functools.partial(
+                TF.compute_bins, n_bins=self.n_bins, binary_mask=bm))
+            while len(_BIN_FNS) >= 16:
+                _BIN_FNS.pop(next(iter(_BIN_FNS)))
+            _BIN_FNS[fkey] = fn
+        Xb, edges = fn(Xd)
+        prep = {"Xb": Xb, "edges": edges}
+        while len(_PREP_CACHE) >= 4:
+            _PREP_CACHE.pop(next(iter(_PREP_CACHE)))    # FIFO evict
+        try:
+            ref = weakref.ref(Xd, lambda _r, k=key: _PREP_CACHE.pop(k, None))
+        except TypeError:       # non-weakref-able input (plain ndarray)
+            ref = lambda: Xd
+        _PREP_CACHE[key] = (ref, prep)
+        return prep
+
+    def fit_prepared(self, Xd, y, w, grid=None):
+        """Bin once + (single-depth grids at large n) static-depth
+        unrolled fit — the one place encoding that decision, shared by
+        the standalone estimator stages and the selector's final refit.
+        Returns (params, Xarg) with Xarg reusable for on_train predicts."""
+        grid = grid if grid is not None else self.stack_grid()
+        Xarg = self.device_prep(Xd)
+        dflt = self.param_defaults().get("maxDepth", 0)
+        depths = {int(g.get("maxDepth", dflt)) for g in self.grid}
+        sd = (depths.pop() if len(depths) == 1
+              and Xd.shape[0] >= UNROLL_MIN_ROWS else None)
+        params = jax.jit(lambda X, y, w: self.fit_batch(
+            X, y, w, grid, static_depth=sd))(Xarg, y, w)
+        return params, Xarg
+
+    def _prebinned_of(self, X):
+        """(prebinned tuple or None, raw-X or None) from a fit input that
+        is either raw [n, F] or a device_prep dict."""
+        if isinstance(X, dict):
+            edges = X["edges"]
+            return (X["Xb"], edges,
+                    TF.make_col_blocks(edges, self.n_bins,
+                                       self.binary_mask)), None
+        return None, X
+
+    def fit_batch(self, X, y, w, stacked, static_depth: Optional[int] = None):
+        prebinned, Xraw = self._prebinned_of(X)
+        unroll = static_depth is not None
+        D = int(static_depth) if unroll else self.global_depth()
         n_trees = self._static_trees()
-        traced = {k: jnp.asarray(self._stacked_col(stacked, k), dtype=X.dtype)
+        traced = {k: jnp.asarray(self._stacked_col(stacked, k), dtype=y.dtype)
                   for k in self.traced_keys}
-        traced["maxDepth"] = jnp.asarray(
-            self._stacked_col(stacked, "maxDepth"), jnp.int32)
+        if not unroll:
+            # traced depth gate shares one program across grid depths;
+            # static-depth chunks (all points at depth D) need no gate
+            traced["maxDepth"] = jnp.asarray(
+                self._stacked_col(stacked, "maxDepth"), jnp.int32)
 
         def fit_one(tr):
-            return self._fit_single(X, y, w, D, n_trees, tr)
+            return self._fit_single(Xraw, y, w, D, n_trees, tr,
+                                    prebinned=prebinned, unroll=unroll)
         if self.grid_chunk and self.grid_chunk < self.grid_size():
             from jax import lax
             return lax.map(fit_one, traced,
@@ -216,19 +316,20 @@ class _TreeFamilyBase(ModelFamily):
         """
         D = self.global_depth()
         head = self._head()
+        dt = _tree_dtype(X)
         if on_train and head == "rf" and "train_node" in params:
             from jax import lax
 
-            n = X.shape[0]
+            n = _tree_rows(X)
 
             def fn(p):
-                # trees accumulate in byte-capped chunks: one [T, n, K]
-                # gather tensor would tile-pad K→128 on TPU (grid × T × n
-                # × 128 × 4B ≈ 69GB at 1M rows), so scan chunks of c trees
-                # with a [c, n, K] transient ≤ ~1GB padded
+                # trees accumulate in byte-capped chunks, K-MAJOR: a
+                # [c, n, K] gather tensor would tile-pad K→128 on TPU
+                # (64× physical blowup for binary K=2); gathering from
+                # [K, L] leaves keeps n in the lane dimension — unpadded
                 leaf, node, tw = p["leaf"], p["train_node"], p["tree_w"]
                 T_, L, K = leaf.shape
-                c = max(1, min(T_, int(1e9 // max(n * 128 * 4, 1))))
+                c = max(1, min(T_, int(64e6 // max(n * K * 4, 1))))
                 pad = (-T_) % c
                 if pad:
                     leaf = jnp.concatenate(
@@ -238,23 +339,28 @@ class _TreeFamilyBase(ModelFamily):
                     tw = jnp.concatenate(
                         [tw, jnp.zeros((pad,), tw.dtype)])
                 nc = (T_ + pad) // c
+                leafT = leaf.transpose(0, 2, 1)         # [T, K, L]
 
                 def body(acc, tl):
-                    lf, nd, w_t = tl           # [c, L, K], [c, n], [c]
-                    vals = jax.vmap(lambda l, m: l[m])(lf, nd)  # [c, n, K]
-                    return acc + jnp.einsum("t,tnk->nk", w_t, vals), None
+                    lf, nd, w_t = tl           # [c, K, L], [c, n], [c]
+                    vals = jax.vmap(lambda l, m: l[:, m])(lf, nd)
+                    return acc + jnp.einsum("t,tkn->kn", w_t, vals), None
                 acc, _ = lax.scan(
-                    body, jnp.zeros((n, K), leaf.dtype),
-                    (leaf.reshape(nc, c, L, K), node.reshape(nc, c, n),
+                    body, jnp.zeros((K, n), leaf.dtype),
+                    (leafT.reshape(nc, c, K, L), node.reshape(nc, c, n),
                      tw.reshape(nc, c)))
-                return TF.rf_head(acc, X, self.task)
+                return TF.rf_head(acc.T, dt, self.task)
             return jax.vmap(fn)(params)
         if on_train and head in ("gbt", "xgb") and "train_margin" in params:
             scale = 2.0 if head == "gbt" else 1.0
 
             def fn(p):
-                return TF.margin_head(p["train_margin"], scale, X, self.task)
+                return TF.margin_head(p["train_margin"], scale, dt,
+                                      self.task)
             return jax.vmap(fn)(params)
+        assert not isinstance(X, dict), \
+            "routed prediction needs the raw feature matrix, not the " \
+            "prebinned dict (on_train caches missing?)"
         if self.task == "classification":
             if head == "rf":
                 fn = lambda p: TF.predict_rf_classification(
@@ -320,7 +426,8 @@ class RandomForestFamily(_TreeFamilyBase):
         return int(max(int(g.get("numTrees", self.num_trees))
                        for g in self.grid))
 
-    def _fit_single(self, X, y, w, depth, n_trees, tr):
+    def _fit_single(self, X, y, w, depth, n_trees, tr, prebinned=None,
+                    unroll=False):
         return TF.fit_forest(
             X, y, w, task=self.task, n_classes=self.n_classes,
             n_trees=n_trees, max_depth=depth, n_bins=self.n_bins,
@@ -328,12 +435,13 @@ class RandomForestFamily(_TreeFamilyBase):
             min_info_gain=tr["minInfoGain"],
             num_trees_used=tr["numTrees"],
             subsample_rate=tr["subsamplingRate"],
-            depth_limit=tr["maxDepth"],
+            depth_limit=tr.get("maxDepth"),
             max_active_nodes=self.max_active_nodes,
             tree_chunk=self.tree_chunk
             or getattr(self, "_tree_chunk_auto", 1),
             binary_mask=self.binary_mask, seed=self.seed,
-            per_node_features=getattr(self, "per_node_features", True))
+            per_node_features=getattr(self, "per_node_features", True),
+            prebinned=prebinned, unroll=unroll)
 
 
 class DecisionTreeFamily(RandomForestFamily):
@@ -393,14 +501,16 @@ class GBTFamily(_TreeFamilyBase):
         return int(max(int(g.get("maxIter", self.max_iter))
                        for g in self.grid))
 
-    def _fit_single(self, X, y, w, depth, n_trees, tr):
+    def _fit_single(self, X, y, w, depth, n_trees, tr, prebinned=None,
+                    unroll=False):
         return TF.fit_gbt(
             X, y, w, task=self.task, n_rounds=n_trees, max_depth=depth,
             n_bins=self.n_bins, min_instances=tr["minInstancesPerNode"],
             min_info_gain=tr["minInfoGain"], step_size=tr["stepSize"],
-            num_rounds_used=tr["maxIter"], depth_limit=tr["maxDepth"],
+            num_rounds_used=tr["maxIter"], depth_limit=tr.get("maxDepth"),
             max_active_nodes=self.max_active_nodes,
-            binary_mask=self.binary_mask)
+            binary_mask=self.binary_mask,
+            prebinned=prebinned, unroll=unroll)
 
 
 class XGBoostFamily(_TreeFamilyBase):
@@ -435,14 +545,16 @@ class XGBoostFamily(_TreeFamilyBase):
     def _static_trees(self) -> int:
         return int(max(int(g.get("numRound", 100)) for g in self.grid))
 
-    def _fit_single(self, X, y, w, depth, n_trees, tr):
+    def _fit_single(self, X, y, w, depth, n_trees, tr, prebinned=None,
+                    unroll=False):
         return TF.fit_xgb(
             X, y, w, task=self.task, n_rounds=n_trees, max_depth=depth,
             n_bins=self.n_bins, eta=tr["eta"], lam=self.reg_lambda,
             min_child_weight=tr["minChildWeight"],
-            num_rounds_used=tr["numRound"], depth_limit=tr["maxDepth"],
+            num_rounds_used=tr["numRound"], depth_limit=tr.get("maxDepth"),
             max_active_nodes=self.max_active_nodes,
-            binary_mask=self.binary_mask)
+            binary_mask=self.binary_mask,
+            prebinned=prebinned, unroll=unroll)
 
 
 # ---------------------------------------------------------------------------
@@ -463,10 +575,9 @@ class _TreeEstimatorBase(PredictorEstimator):
         fam = self._family(n_classes)
         fam.binary_mask = detect_binary_columns(X)
         Xd = jnp.asarray(X, jnp.float32)
-        grid = fam.stack_grid()
         from ._pallas_hist import with_pallas_fallback
-        params = with_pallas_fallback(
-            lambda: jax.jit(lambda X, y, w: fam.fit_batch(X, y, w, grid))(
+        params, _ = with_pallas_fallback(
+            lambda: fam.fit_prepared(
                 Xd, jnp.asarray(y, jnp.float32),
                 jnp.ones((X.shape[0],), jnp.float32)))
         single = jax.tree_util.tree_map(lambda a: np.asarray(a)[0], params)

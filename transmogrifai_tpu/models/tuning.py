@@ -337,8 +337,17 @@ def _auto_chunks(family, n_rows: int, n_shards: int, n_folds: int,
     A = max(A, 64)
     n_bins = getattr(family, "n_bins", 32)
     C_est = max(getattr(family, "n_classes", 2) + 1, 4)
-    per_instance = rows * A * 4 * 3 \
-        + rows * (A * C_est + n_bins * max(n_features, 1)) * 2
+    from ._pallas_hist import pallas_histograms_enabled
+    if pallas_histograms_enabled():
+        # prebinned + fused-kernel path (round 4): the [n, A] routing
+        # tensors and the NS/Bc matmul operands never hit HBM, so an
+        # in-flight instance carries only its [n] slot/g/margin vectors,
+        # [n, C] stats, the per-chunk bootstrap draw, and the K-major
+        # train-predict gather chunk (~64 MB cap in trees.predict_batch)
+        per_instance = rows * (24 + 4 * C_est) + 96e6
+    else:
+        per_instance = rows * A * 4 * 3 \
+            + rows * (A * C_est + n_bins * max(n_features, 1)) * 2
     max_instances = max(int(CHUNK_MEM_BUDGET_BYTES // per_instance), 1)
     g = family.grid_size()
     if getattr(family, "tree_chunk", 1) is None:
@@ -540,10 +549,14 @@ class _ValidatorBase:
         # transients into one device program, which crashed the TPU
         # worker at 1M rows. Host chunk calls reuse the executable, queue
         # async back-to-back, and bound peak memory to one chunk.
-        def make_fit_eval(family, metric_fn):
+        def make_fit_eval(family, metric_fn, static_depth=None):
             def fit_eval(X, y, w_folds, v_folds, stacked):
                 def per_fold(w, v):
-                    params = family.fit_batch(X, y, w, stacked)
+                    if static_depth is not None:
+                        params = family.fit_batch(
+                            X, y, w, stacked, static_depth=static_depth)
+                    else:
+                        params = family.fit_batch(X, y, w, stacked)
                     pred, _raw, prob = family.predict_batch(params, X,
                                                             on_train=True)
                     return jax.vmap(
@@ -563,9 +576,17 @@ class _ValidatorBase:
         k_folds = len(splits)
 
         def chunk_plan(family):
-            """(fc, g_sizes, stacked_chunks): fold chunk size (a divisor
-            of k_folds), the grid's chunk-size schedule (possibly ragged —
-            see _chunk_sizes) and its device-ready chunks."""
+            """(fc, chunks): fold chunk size (a divisor of k_folds) and
+            the grid chunks as (grid-index array, device-ready stacked
+            slice, static_depth|None) triples.
+
+            Tree families at ≥ UNROLL_MIN_ROWS rows are grouped by
+            ``maxDepth`` first: each group compiles a STATIC-depth
+            unrolled program (per-level slot growth, no dead levels for
+            shallow grid points), which is where the round-4 histogram
+            FLOP cut comes from. Below that, one traced-depth program
+            serves the whole grid (compile time dominates at small n).
+            """
             fold_chunk = _auto_chunks(family, len(y), n_shards, k_folds,
                                       n_features=X.shape[1])
             gc = getattr(family, "grid_chunk", None) or family.grid_size()
@@ -573,19 +594,45 @@ class _ValidatorBase:
                 family.grid_chunk = None    # chunking happens here, not
             fc = fold_chunk or k_folds      # in fit_batch's lax.map
             fc = _best_chunk(k_folds, fc)
-            g_sizes = _chunk_sizes(family.grid_size(), gc)
-            _finalize_tree_chunk(family, fc * max(g_sizes))
+            groups = []                     # (index list, static depth)
+            if getattr(family, "supports_static_depth", False):
+                from .trees import UNROLL_MIN_ROWS
+                if len(y) >= UNROLL_MIN_ROWS:
+                    dflt = family.param_defaults().get("maxDepth", 0)
+                    by_depth: Dict[int, list] = {}
+                    for i, gpt in enumerate(family.grid):
+                        by_depth.setdefault(
+                            int(gpt.get("maxDepth", dflt)), []).append(i)
+                    for dpt, idxs in sorted(by_depth.items()):
+                        j0 = 0
+                        for sz in _chunk_sizes(len(idxs), gc):
+                            groups.append((idxs[j0:j0 + sz], dpt))
+                            j0 += sz
+            if not groups:
+                idxs = list(range(family.grid_size()))
+                j0 = 0
+                for sz in _chunk_sizes(family.grid_size(), gc):
+                    groups.append((idxs[j0:j0 + sz], None))
+                    j0 += sz
+            stacked = family.stack_grid()
+            chunks = [(np.asarray(ix),
+                       {k2: jnp.asarray(np.asarray(v)[np.asarray(ix)])
+                        for k2, v in stacked.items()}, sd)
+                      for ix, sd in groups]
+            _finalize_tree_chunk(family,
+                                 fc * max(len(ix) for ix, _ in groups))
             logger.info(
                 "chunk plan %s: fold_chunk=%d/%d grid_chunks=%s%s",
-                family.name, fc, k_folds, g_sizes,
+                family.name, fc, k_folds,
+                [(len(ix), sd) for ix, sd in groups],
                 f" tree_chunk={family._tree_chunk_auto}"
                 if getattr(family, "_tree_chunk_auto", None) else "")
-            return fc, g_sizes, _grid_chunks(family, g_sizes)
+            return fc, chunks
 
-        # one executable per (family, grid-chunk WIDTH) — a ragged schedule
-        # adds exactly one extra width for the remainder chunk
-        fused: Dict[int, Dict[int, Any]] = {}
+        # one executable per (family, grid-chunk width, static depth)
+        fused: Dict[int, Dict[Any, Any]] = {}
         plans: Dict[int, Any] = {}
+        xargs: Dict[int, Any] = {}
         to_compile = []
         for fi, family in enumerate(families):
             metric_fn = device_metric_fn(
@@ -593,25 +640,31 @@ class _ValidatorBase:
                 n_classes=getattr(family, "n_classes", 2))
             if metric_fn is None:
                 continue
+            # bin the data once per family config (cached across families
+            # sharing the same device array + binning config)
+            xargs[fi] = (family.device_prep(Xd)
+                         if hasattr(family, "device_prep") else Xd)
             plan = chunk_plan(family)
             plans[fi] = plan
-            fc, g_sizes, stacked_chunks = plan
-            exes: Dict[int, Any] = {}
-            jf = None
-            for gw, st in zip(g_sizes, stacked_chunks):
-                if gw in exes:
+            fc, chunks = plan
+            exes: Dict[Any, Any] = {}
+            jfs: Dict[Any, Any] = {}
+            for ix, st, sd in chunks:
+                ek = (len(ix), sd)
+                if ek in exes:
                     continue
                 key = (family.trace_signature(), self.task, self.metric_name,
-                       mesh_key, ("chunk", fc, gw),
-                       shapes_of((Xd, yd, wd[:fc], vwd[:fc], st)))
+                       mesh_key, ("chunk", fc, ek),
+                       shapes_of((xargs[fi], yd, wd[:fc], vwd[:fc], st)))
                 exe = _FUSED_EXE_CACHE.get(key)
                 if exe is not None:
-                    exes[gw] = exe
+                    exes[ek] = exe
                 else:
-                    if jf is None:
-                        jf = jax.jit(make_fit_eval(family, metric_fn))
-                    exes[gw] = None
-                    to_compile.append((fi, gw, key, jf, st))
+                    if sd not in jfs:
+                        jfs[sd] = jax.jit(
+                            make_fit_eval(family, metric_fn, sd))
+                    exes[ek] = None
+                    to_compile.append((fi, ek, key, jfs[sd], st))
             fused[fi] = exes
 
         if to_compile:
@@ -622,14 +675,14 @@ class _ValidatorBase:
                         "concurrently", len(to_compile))
             with cf.ThreadPoolExecutor(len(to_compile)) as ex:
                 futs = []
-                for fi, gw, key, jf, st in to_compile:
-                    fc, g_sizes, stacked_chunks = plans[fi]
-                    futs.append((fi, gw, key, ex.submit(
-                        lambda jf=jf, w=wd[:fc], v=vwd[:fc], st=st:
-                        jf.lower(Xd, yd, w, v, st).compile())))
-                for fi, gw, key, fut in futs:
+                for fi, ek, key, jf, st in to_compile:
+                    fc, chunks = plans[fi]
+                    futs.append((fi, ek, key, ex.submit(
+                        lambda jf=jf, x=xargs[fi], w=wd[:fc], v=vwd[:fc],
+                        st=st: jf.lower(x, yd, w, v, st).compile())))
+                for fi, ek, key, fut in futs:
                     exe = fut.result()
-                    fused[fi][gw] = exe
+                    fused[fi][ek] = exe
                     while len(_FUSED_EXE_CACHE) > 64:
                         _FUSED_EXE_CACHE.pop(
                             next(iter(_FUSED_EXE_CACHE)))   # FIFO evict
@@ -643,13 +696,14 @@ class _ValidatorBase:
         # AND serialize device execution against host latency
         fused_out: Dict[int, Any] = {}
         for fi in fused:
-            fc, g_sizes, stacked_chunks = plans[fi]
+            fc, chunks = plans[fi]
             outs = []
             for i0 in range(0, k_folds, fc):
-                for gw, st in zip(g_sizes, stacked_chunks):
-                    _count_dispatch(fused[fi][gw])
-                    outs.append(fused[fi][gw](Xd, yd, wd[i0:i0 + fc],
-                                              vwd[i0:i0 + fc], st))
+                for ix, st, sd in chunks:
+                    exe = fused[fi][(len(ix), sd)]
+                    _count_dispatch(exe)
+                    outs.append(exe(xargs[fi], yd, wd[i0:i0 + fc],
+                                    vwd[i0:i0 + fc], st))
             fused_out[fi] = outs
         fused_np = jax.device_get(fused_out)
 
@@ -657,16 +711,13 @@ class _ValidatorBase:
             k, g = len(splits), family.grid_size()
 
             if fi in fused:
-                fc, g_sizes, stacked_chunks = plans[fi]
+                fc, chunks = plans[fi]
                 full = np.zeros((k, g))
                 ci = 0
                 for i0 in range(0, k, fc):
-                    col = 0
-                    for gw in g_sizes:
-                        full[i0:i0 + fc, col:col + gw] = \
-                            np.asarray(fused_np[fi][ci])
+                    for ix, st, sd in chunks:
+                        full[i0:i0 + fc, ix] = np.asarray(fused_np[fi][ci])
                         ci += 1
-                        col += gw
                 per_grid_metrics = full.T                       # [G, K]
             else:
                 stacked = family.stack_grid()
@@ -799,6 +850,9 @@ class _ValidatorBase:
                 g_sizes = _chunk_sizes(g, gc)
                 _finalize_tree_chunk(family, max(g_sizes))  # one fold live
                 st_chunks = _grid_chunks(family, g_sizes)
+                # bin each fold's engineered matrix once for all chunks
+                Xarg = (family.device_prep(Xd)
+                        if hasattr(family, "device_prep") else Xd)
 
                 def fit_eval(X, y, w_folds, v_folds, stacked):
                     def per_fold(w, v):
@@ -817,18 +871,19 @@ class _ValidatorBase:
                     key = (family.trace_signature(), self.task,
                            self.metric_name, mesh_key, ("per_fold", gw),
                            tuple((tuple(a.shape), str(a.dtype)) for a in
-                                 (Xd, yd, wd, vwd)))
+                                 jax.tree_util.tree_leaves(
+                                     (Xarg, yd, wd, vwd))))
                     exe = _FUSED_EXE_CACHE.get(key)
                     if exe is None:
                         exe = jax.jit(fit_eval).lower(
-                            Xd, yd, wd, vwd, st).compile()
+                            Xarg, yd, wd, vwd, st).compile()
                         while len(_FUSED_EXE_CACHE) > 64:
                             _FUSED_EXE_CACHE.pop(next(iter(_FUSED_EXE_CACHE)))
                         _FUSED_EXE_CACHE[key] = exe
                     exe_by_width[gw] = exe
                 for gw, _st in zip(g_sizes, st_chunks):
                     _count_dispatch(exe_by_width[gw])
-                outs = [exe_by_width[gw](Xd, yd, wd, vwd, st)
+                outs = [exe_by_width[gw](Xarg, yd, wd, vwd, st)
                         for gw, st in zip(g_sizes, st_chunks)]
                 per_grid[:, ki] = np.concatenate(
                     [np.asarray(o)[0] for o in outs])

@@ -230,6 +230,24 @@ class OPListTransformer(_FlatLift):
         lengths = np.asarray(
             [sum(1 for x in r if x is not None) for r in nested],
             dtype=np.int64)
+        # per-row dropped-null accounting (ADVICE r3): integral lifts
+        # shorten rows, so consumers needing element alignment with the
+        # source list can detect (and quantify) the divergence here
+        dropped = np.asarray([len(r) for r in nested],
+                             dtype=np.int64) - lengths
+        self.last_dropped_counts = dropped
+        total = int(dropped.sum())
+        if total and not getattr(self, "_warned_dropped", False):
+            # once per stage instance — a streaming scoring loop would
+            # otherwise emit one identical warning per micro-batch
+            self._warned_dropped = True
+            import logging
+            logging.getLogger(__name__).warning(
+                "OPListTransformer %s dropped %d null element(s) across "
+                "%d row(s); integral output rows are shorter than their "
+                "source lists (see last_dropped_counts; further drops "
+                "by this stage are not logged)",
+                self.uid, total, int((dropped > 0).sum()))
         offsets = np.concatenate([[0], np.cumsum(lengths)])
         return RaggedColumn(out_t, flat, offsets)
 

@@ -60,8 +60,23 @@ def canonicalize_prepared(prepared: Dict[str, np.ndarray]) -> Dict[str, np.ndarr
         if a.dtype == np.float64:
             a = a.astype(VEC_DTYPE)
         elif a.dtype == np.int64:
+            # host_prepare's contract forbids magnitudes beyond int32 —
+            # enforce it (ADVICE r3): a vectorizer passing raw epoch-millis
+            # would otherwise wrap silently and corrupt values downstream
+            if a.size and (a.max(initial=0) > np.iinfo(np.int32).max
+                           or a.min(initial=0) < np.iinfo(np.int32).min):
+                raise ValueError(
+                    f"prepared block {k!r} holds int64 values outside the "
+                    "int32 range; host_prepare must pre-scale them "
+                    "(e.g. epoch-millis → coarser units) before canonical "
+                    "casting")
             a = a.astype(np.int32)
         elif a.dtype == np.uint64:
+            if a.size and a.max(initial=0) > np.iinfo(np.uint32).max:
+                raise ValueError(
+                    f"prepared block {k!r} holds uint64 values outside the "
+                    "uint32 range; host_prepare must pre-scale them before "
+                    "canonical casting")
             a = a.astype(np.uint32)
         out[k] = a
     return out

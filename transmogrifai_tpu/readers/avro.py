@@ -314,20 +314,25 @@ def _infer_value_schema(values: List[Any]) -> Any:
     present = [v for v in values if v is not None]
     if not present:
         return ["null", "long", "double", "string"]
+    # every union keeps a trailing "string" branch: the schema locks at
+    # the first streaming block, and the lenient stringify-anything escape
+    # hatch (_union_matches strict=False) can only fire if the branch
+    # exists — without it a later batch whose value type surprises the
+    # union aborts the scoring stream (ADVICE r3)
     if all(isinstance(p, bool) for p in present):
-        return ["null", "boolean"]
+        return ["null", "boolean", "string"]
     if all(isinstance(p, (int, float)) and not isinstance(p, bool)
            for p in present):
-        return ["null", "long", "double"]
+        return ["null", "long", "double", "string"]
     if all(isinstance(p, (bytes, bytearray)) for p in present):
-        return ["null", "bytes"]
+        return ["null", "bytes", "string"]
     if all(isinstance(p, dict) for p in present):
         inner = _infer_value_schema(
             [x for p in present for x in p.values()])
-        return ["null", {"type": "map", "values": inner}]
+        return ["null", {"type": "map", "values": inner}, "string"]
     if all(isinstance(p, (list, tuple, set, frozenset)) for p in present):
         inner = _infer_value_schema([x for p in present for x in p])
-        return ["null", {"type": "array", "items": inner}]
+        return ["null", {"type": "array", "items": inner}, "string"]
     return ["null", "string"]
 
 
