@@ -134,8 +134,10 @@ def main() -> None:
         **_mfu_fields(wf, warm["train_time_s"]),
     }
 
-    # 4. SmartText-heavy (BigPassenger schema at scale)
-    big_rows = int(os.environ.get("BENCH_TEXT_ROWS", 30_000))
+    # 4. SmartText-heavy (BigPassenger schema at scale — 300k rows per
+    #    VERDICT r3 #4: host text prep + the fusion decision measured at
+    #    non-toy size)
+    big_rows = int(os.environ.get("BENCH_TEXT_ROWS", 300_000))
     from big_passenger import run as run_big
     cold, warm, cold_s, warm_s, wf = _run_twice(
         lambda: run_big(n_rows=big_rows, num_folds=3, seed=42), "big_text")
@@ -148,6 +150,7 @@ def main() -> None:
         "quality": "PASS" if big_aupr >= TARGET_AUPR else "FAIL",
         "cv_warm_s": round(warm["train_time_s"], 2),
         "cv_cold_s": round(cold["train_time_s"], 2),
+        "phases": warm.get("phases"),
         **_mfu_fields(wf, warm["train_time_s"]),
     }
 
@@ -168,6 +171,77 @@ def main() -> None:
         "phases": warm.get("phases"),
         **_mfu_fields(wf, warm["train_time_s"]),
     }
+
+    # 5b. The FULL 10M-row BASELINE config (VERDICT r3 #2) — one pass
+    #     (its own shapes compile fresh; a second pass would double a
+    #     multi-minute run for a number that matters as "it runs at all").
+    full_rows = int(os.environ.get("BENCH_SYNTH_FULL_ROWS", 10_000_000))
+    if full_rows > synth_rows and backend == "tpu":
+        try:
+            f0 = _flops_total()
+            t0 = time.time()
+            out_full = run_synth(n_rows=full_rows, num_folds=3, seed=42)
+            full_total = time.time() - t0
+            configs["synthetic_trees_full"] = {
+                "rows": full_rows,
+                "AuPR": round(float(out_full["metrics"]["AuPR"]), 4),
+                "train_s_incl_compile": round(
+                    out_full["train_time_s"], 2),
+                "total_s": round(full_total, 2),
+                "best_model": out_full["summary"].best_model_name,
+                "phases": out_full.get("phases"),
+                **_mfu_fields(_flops_total() - f0,
+                              out_full["train_time_s"]),
+            }
+        except Exception as e:          # record instead of killing bench
+            _log(f"[bench] 10M config failed: {e!r}")
+            configs["synthetic_trees_full"] = {
+                "rows": full_rows, "error": repr(e)[:400]}
+
+    # CPU-host denominator (VERDICT r3 #3): same code on the host CPU
+    # backend as the Spark-local[8] proxy. Subprocess (the axon shim pins
+    # the platform per process). Synthetic runs at a reduced row count by
+    # default and extrapolates LINEARLY — conservative: CPU throughput
+    # degrades with rows (cache pressure), so the reported speedup is a
+    # floor. BENCH_CPU=0 disables; BENCH_CPU_SYNTH_ROWS overrides.
+    if os.environ.get("BENCH_CPU", "1") != "0" and backend == "tpu":
+        import subprocess
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("BENCH_CPU_SYNTH_ROWS", "200000")
+        try:
+            t0 = time.time()
+            proc = subprocess.run(
+                [sys.executable, os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "tools", "bench_cpu.py")],
+                env=env, capture_output=True, text=True,
+                timeout=int(os.environ.get("BENCH_CPU_TIMEOUT_S", 2400)))
+            line = proc.stdout.strip().splitlines()[-1]
+            cpu = json.loads(line)
+            cpu["wall_s"] = round(time.time() - t0, 1)
+            configs["cpu_host_denominator"] = cpu
+            tw = configs["titanic"]["cv_warm_s"]
+            if tw > 0 and cpu.get("titanic_warm_s"):
+                configs["titanic"]["speedup_vs_cpu_host"] = round(
+                    cpu["titanic_warm_s"] / tw, 2)
+            sw = configs["synthetic_trees"]["cv_warm_s"]
+            if sw > 0 and cpu.get("synth_warm_s") and cpu.get("synth_rows"):
+                scale = synth_rows / cpu["synth_rows"]
+                configs["synthetic_trees"]["speedup_vs_cpu_host_est"] = \
+                    round(cpu["synth_warm_s"] * scale / sw, 2)
+                configs["synthetic_trees"]["cpu_extrapolated_from_rows"] = \
+                    cpu["synth_rows"]
+        except Exception as e:
+            _log(f"[bench] cpu denominator failed: {e!r}")
+
+    # fusion gate state (process-wide probe; VERDICT r3 #4)
+    try:
+        from transmogrifai_tpu.workflow import fusion_state
+        fus = fusion_state()
+    except Exception:
+        fus = None
 
     # profiled warm pass (BENCH_PROFILE=0 disables): device-busy time and
     # top-5 XLA ops from the xplane trace — the compute- vs bandwidth-
@@ -220,6 +294,7 @@ def main() -> None:
         "cv_wallclock_s": configs["titanic"]["cv_warm_s"],
         "cv_cold_s": configs["titanic"]["cv_cold_s"],
         "configs": configs,
+        "fusion_gate": fus,
         "backend": backend,
         "n_devices": len(jax.devices()),
     }))

@@ -107,23 +107,30 @@ def run(n_rows: int = 30_000, num_folds: int = 3, families=None,
         seed=seed, mesh=mesh)
     prediction = survived.transform_with(selector, checked)
 
+    tp0 = time.time()
     records = synthesize_records(n_rows, seed=seed)
     wf = (Workflow()
           .set_input_records(records)
           .set_result_features(prediction)
           .set_splitter(selector.splitter))
+    prep_s = time.time() - tp0
 
     t0 = time.time()
     model = wf.train()
     train_time = time.time() - t0
 
+    te0 = time.time()
     evaluator = Evaluators.BinaryClassification.auPR().set_columns(
         survived, prediction)
     metrics = model.evaluate(records, evaluator)
+    eval_s = time.time() - te0
     selected = model.fitted_stages[selector.uid]
     return {"model": model, "metrics": metrics,
             "summary": selected.selector_summary,
-            "train_time_s": train_time}
+            "train_time_s": train_time,
+            "phases": {"data_prep_s": round(prep_s, 2),
+                       "train_s": round(train_time, 2),
+                       "eval_s": round(eval_s, 2)}}
 
 
 if __name__ == "__main__":

@@ -34,7 +34,7 @@ def test_cumhist_matches_reference_and_xla(rng, n, F, A, B, C):
 
     ref = _ref_hist(stats, node, Xb, A, B)
     xla = _level_cumhist(stats, node, Xb, A, B)
-    pal = _pallas_hist.cumhist(stats, node, Xb, A, B, interpret=True)
+    pal = _pallas_hist.cumhist(stats, node, Xb.T, A, B, interpret=True)
 
     np.testing.assert_allclose(np.asarray(xla), ref, rtol=1e-9, atol=1e-9)
     np.testing.assert_allclose(np.asarray(pal), ref, rtol=1e-9, atol=1e-9)
@@ -47,8 +47,8 @@ def test_cumhist_feature_tiling_and_row_padding(rng):
     stats = jnp.asarray(rng.normal(size=(n, C)))
     node = jnp.asarray(rng.integers(0, A, size=(n,)), jnp.int32)
     Xb = jnp.asarray(rng.integers(0, B, size=(n, F)), jnp.int32)
-    pal = _pallas_hist.cumhist(stats, node, Xb, A, B,
-                               block_rows=32, max_cols=16, interpret=True)
+    pal = _pallas_hist.cumhist(stats, node, Xb.T, A, B,
+                               block_lanes=32, max_sub=16, interpret=True)
     np.testing.assert_allclose(
         np.asarray(pal), _ref_hist(stats, node, Xb, A, B),
         rtol=1e-9, atol=1e-9)
@@ -62,7 +62,7 @@ def test_cumhist_under_vmap(rng):
     Xb = jnp.asarray(rng.integers(0, B, size=(G, n, F)), jnp.int32)
 
     f = jax.vmap(lambda s, nd, xb: _pallas_hist.cumhist(
-        s, nd, xb, A, B, interpret=True))
+        s, nd, xb.T, A, B, interpret=True))
     out = f(stats, node, Xb)
     for g in range(G):
         np.testing.assert_allclose(
@@ -134,3 +134,26 @@ def test_fit_level_pallas_fallback(monkeypatch):
         raise RuntimeError("Mosaic lowering failed")
     with pytest.raises(RuntimeError):
         ph.with_pallas_fallback(forced)
+
+
+def test_predict_kernel_matches_xla_routing(rng):
+    """Routed ensemble prediction: the transposed-domain predict kernel
+    must match per-tree XLA routing exactly (incl. +inf dead-split
+    thresholds and tree weights folded into the leaves)."""
+    from transmogrifai_tpu.models import _treefit
+    from transmogrifai_tpu.models._pallas_hist import predict_trees
+
+    n, F, T, D, K = 700, 9, 5, 4, 3
+    X = jnp.asarray(rng.normal(size=(n, F)), jnp.float32)
+    NN, L = (1 << D) - 1, 1 << D
+    feat = jnp.asarray(rng.integers(0, F, (T, NN)), jnp.int32)
+    thr = jnp.asarray(np.where(rng.random((T, NN)) < 0.3, np.inf,
+                               rng.normal(size=(T, NN))), jnp.float32)
+    leaf = jnp.asarray(rng.normal(size=(T, L, K)), jnp.float32)
+    tw = jnp.asarray(rng.random(T), jnp.float32)
+    ref = sum(float(tw[t]) * np.asarray(
+        _treefit.predict_tree(feat[t], thr[t], leaf[t], X, D))
+        for t in range(T))
+    out = predict_trees(X, feat, thr, leaf * tw[:, None, None], D,
+                        interpret=True)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)

@@ -1,0 +1,50 @@
+"""Host-CPU denominator for the bench (VERDICT r3 #3): the same code,
+same sweeps, on the CPU backend — the honest stand-in for the reference's
+Spark ``local[8]`` wall-clock, which BASELINE's "≥20× faster" north star
+needs a measured denominator for.
+
+Run as a SUBPROCESS from bench.py (the axon sitecustomize pins the jax
+platform at interpreter start, so the pin must be overridden before any
+backend init — env vars alone are ignored). Prints ONE JSON line:
+
+    {"titanic_warm_s": ..., "titanic_AuPR": ...,
+     "synth_rows": N, "synth_warm_s": ...}
+"""
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), os.pardir))
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), os.pardir, "examples"))
+    assert jax.default_backend() == "cpu", jax.default_backend()
+
+    out = {"backend": "cpu", "cpu_count": os.cpu_count()}
+
+    from titanic import run as run_titanic
+    run_titanic(num_folds=3, seed=42)                       # cold
+    t0 = time.time()
+    r = run_titanic(num_folds=3, seed=42)
+    out["titanic_warm_s"] = round(r["train_time_s"], 2)
+    out["titanic_total_warm_s"] = round(time.time() - t0, 2)
+    h = r["summary"].holdout_evaluation or {}
+    out["titanic_AuPR"] = round(float(h.get("AuPR", 0.0)), 4)
+
+    synth_rows = int(os.environ.get("BENCH_CPU_SYNTH_ROWS", 200_000))
+    if synth_rows > 0:
+        from synthetic_trees import run as run_synth
+        run_synth(n_rows=synth_rows, num_folds=3, seed=42)  # cold
+        r = run_synth(n_rows=synth_rows, num_folds=3, seed=42)
+        out["synth_rows"] = synth_rows
+        out["synth_warm_s"] = round(r["train_time_s"], 2)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -50,92 +50,186 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["cumhist", "route_level", "pallas_histograms_enabled"]
+__all__ = ["cumhist", "route_level", "pallas_histograms_enabled",
+           "ROW_ALIGN"]
 
 _PROBE: Optional[bool] = None
+
+#: Kernel row alignment. **Rows live in the LANE dimension**: per-row
+#: vectors (slot/g/stats channels) travel as rows of a small [k ≤ 8, n]
+#: f32 pack and the bin matrix travels TRANSPOSED ([F, n]) — both are
+#: lane-compact layouts. The round-4 first cut passed them as [n, 1] /
+#: [n, C] / [n, F]: T(8,128) tiling pads the minor dim to 128 lanes
+#: (128× / 43× / 6.4× physical blowup), and the fold × tree-chunk vmap
+#: turned that into four 10.3 GB HLO temps — an HBM OOM at compile. 1D
+#: refs dodge the padding but reject vmap batching; the transposed
+#: domain supports both, and every kernel op stays elementwise on
+#: [A, lanes] tiles plus an NT-form MXU dot contracting lanes. Callers
+#: pre-pad rows once (device_prep / grow_tree) to this multiple so the
+#: kernels never materialize per-level padded copies.
+ROW_ALIGN = 1024
 
 
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _kernel(xb_ref, node_ref, stats_ref, o_ref, *, n_nodes, n_bins,
+def _pad_lanes(v, n_pad, fill):
+    """Pad the trailing (row/lane) axis of [..., n] to n_pad."""
+    n = v.shape[-1]
+    if n == n_pad:
+        return v
+    return jnp.concatenate(
+        [v, jnp.full(v.shape[:-1] + (n_pad - n,), fill, v.dtype)], axis=-1)
+
+
+def _kernel(xbt_ref, pack_ref, o_ref, *, n_nodes, n_bins, n_chan,
             mm_dtype):
-    """Everything stays rank-2: Mosaic's vector layouts reject
-    shape-changing reshapes whose minor dim is not 128-aligned, so the
-    [bn, B, Fc] bin indicator is built flat ([bn, B·Fc] with threshold
-    j // Fc and a B-fold column tile of Xb) and the channel axis is a
-    static Python loop over C per-channel dots writing row slices."""
+    """Transposed domain (rows = lanes). ``pack_ref`` [1+C, bnl]: row 0
+    the node slot, rows 1.. the stats channels. ``xbt_ref`` [Fc, bnl].
+    The bin indicator is built flat along the SUBLANE axis ([B·Fc, bnl]
+    with threshold i // Fc and a B-fold sublane tile of XbT), the node
+    one-hot is an elementwise compare against a sublane iota, and each
+    channel's histogram is one NT-form dot contracting lanes."""
     rb = pl.program_id(1)
 
     @pl.when(rb == 0)
     def _init():
         o_ref[:] = jnp.zeros_like(o_ref)
 
-    bn, Fc = xb_ref.shape
-    C = stats_ref.shape[1]
+    Fc, bnl = xbt_ref.shape
     A, B = n_nodes, n_bins
-    node = node_ref[:, 0]                                  # [bn]
-    # one_hot(node): padded rows carry node = A → all-false → zero rows.
-    oh = (node[:, None] == lax.broadcasted_iota(jnp.int32, (bn, A), 1)
-          ).astype(jnp.float32).astype(stats_ref.dtype)
-    # Bc = lower-triangular bin indicator (bin ≤ t) → left-cumulative sums
-    # fall straight out of the dot; column j = t·Fc + f.
-    xb_tile = jnp.concatenate([xb_ref[:]] * B, axis=1)     # [bn, B·Fc]
-    thr = lax.broadcasted_iota(jnp.int32, (bn, B * Fc), 1) // Fc
-    bc = (xb_tile <= thr).astype(jnp.float32).astype(mm_dtype)
-    for c in range(C):
-        ohc = (oh * stats_ref[:, c:c + 1]).astype(mm_dtype)
+    node = pack_ref[0, :].astype(jnp.int32)                # [bnl]
+    # one_hot(node): padded rows carry node = A → all-false → zero cols.
+    ohT = (node[None, :] ==
+           lax.broadcasted_iota(jnp.int32, (A, bnl), 0)
+           ).astype(jnp.float32).astype(o_ref.dtype)       # [A, bnl]
+    # BcT = lower-triangular bin indicator (bin ≤ t) → left-cumulative
+    # sums fall straight out of the dot; sublane i = t·Fc + f.
+    xb_tile = jnp.concatenate([xbt_ref[:]] * B, axis=0)    # [B·Fc, bnl]
+    thr = lax.broadcasted_iota(jnp.int32, (B * Fc, bnl), 0) // Fc
+    bcT = (xb_tile <= thr).astype(jnp.float32).astype(mm_dtype)
+    for c in range(n_chan):
+        ohcT = (ohT * pack_ref[1 + c, :][None, :]).astype(mm_dtype)
         o_ref[c * A:(c + 1) * A, :] += lax.dot_general(
-            ohc, bc, (((0,), (0,)), ((), ())),
+            ohcT, bcT, (((1,), (1,)), ((), ())),
             preferred_element_type=o_ref.dtype)
 
 
-def cumhist(stats: jnp.ndarray, node: jnp.ndarray, Xb: jnp.ndarray,
-            n_nodes: int, n_bins: int, *, block_rows: int = 256,
-            max_cols: int = 2048, interpret: Optional[bool] = None
-            ) -> jnp.ndarray:
-    """[n, C] stats + [n] node slots + [n, F] bins → [A, C, B, F] cumulative
-    histograms. Drop-in replacement for the XLA matmul path in
-    ``_treefit._level_cumhist`` (idle rows: node == n_nodes → zero)."""
-    n, F = Xb.shape
+def _kernel_prebc(bc_ref, pack_ref, o_ref, *, n_nodes, n_chan, mm_dtype):
+    """cumhist with the bin indicator STREAMED instead of built: the
+    [B·Fc, bnl] lower-triangular compare depends only on Xb, yet the
+    in-kernel build (tile + iota compare, ~B·F·bnl VPU ops per block)
+    re-runs per level × tree × fold and dominates shallow levels where
+    the dot itself is tiny. Callers precompute it once per fit (XLA
+    hoists it out of the tree/round scans) when it fits HBM."""
+    rb = pl.program_id(1)
+
+    @pl.when(rb == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    A = n_nodes
+    bnl = pack_ref.shape[1]
+    node = pack_ref[0, :].astype(jnp.int32)
+    ohT = (node[None, :] ==
+           lax.broadcasted_iota(jnp.int32, (A, bnl), 0)
+           ).astype(jnp.float32).astype(o_ref.dtype)
+    bcT = bc_ref[:].astype(mm_dtype)
+    for c in range(n_chan):
+        ohcT = (ohT * pack_ref[1 + c, :][None, :]).astype(mm_dtype)
+        o_ref[c * A:(c + 1) * A, :] += lax.dot_general(
+            ohcT, bcT, (((1,), (1,)), ((), ())),
+            preferred_element_type=o_ref.dtype)
+
+
+def make_bc(XbT: jnp.ndarray, n_bins: int, dtype) -> jnp.ndarray:
+    """[F, n] bins → [B·F, n] lower-triangular bin indicator (sublane
+    i = t·F + f ⇒ bin[f] ≤ t), the precomputed operand for
+    ``cumhist(..., bc=...)``. bf16 for f32 stats (counts stay exact —
+    sums of exact 1.0s in an f32 accumulator)."""
+    F, n = XbT.shape
+    tiles = jnp.concatenate([XbT] * n_bins, axis=0)        # [B·F, n]
+    thr = (jnp.arange(n_bins * F, dtype=jnp.int32) // F)[:, None]
+    return (tiles <= thr).astype(dtype)
+
+
+def bc_cache_ok(n: int, F: int, n_bins: int,
+                max_bytes: float = 3e9) -> bool:
+    """Precompute the bin indicator only when it fits comfortably in HBM
+    (2 bytes/entry) and a single feature chunk covers it (the chunked
+    layout interleaves (t, f) rows per chunk)."""
+    return (isinstance(n, int) and n_bins * F <= 1024
+            and 2.0 * n * n_bins * F <= max_bytes)
+
+
+def cumhist(stats: jnp.ndarray, node: jnp.ndarray, XbT: jnp.ndarray,
+            n_nodes: int, n_bins: int, *, block_lanes: int = ROW_ALIGN,
+            max_sub: int = 1024, interpret: Optional[bool] = None,
+            bc: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """[n, C] stats + [n] node slots + [F, n] TRANSPOSED bins →
+    [A, C, B, F] cumulative histograms (idle rows: node == n_nodes →
+    zero). Drop-in replacement for the XLA matmul path in
+    ``_treefit._level_cumhist``.
+
+    Per-row operands enter as a [1+C, n] f32 pack and the bin matrix
+    feature-major — both lane-compact (see ROW_ALIGN). Callers at scale
+    pre-pad rows (device_prep); unaligned small-n calls pad here."""
+    F, n = XbT.shape
     C = stats.shape[1]
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    bn = min(block_rows, _round_up(n, 8))
-    Fc = max(1, min(F, max_cols // n_bins))
-    n_pad = _round_up(n, bn)
+    bnl = block_lanes
+    Fc = max(1, min(F, max_sub // n_bins))
+    n_pad = _round_up(n, bnl)
     F_pad = _round_up(F, Fc)
-    if n_pad != n:
-        pad = n_pad - n
-        Xb = jnp.concatenate([Xb, jnp.zeros((pad, F), Xb.dtype)])
-        node = jnp.concatenate(
-            [node, jnp.full((pad,), n_nodes, node.dtype)])
-        stats = jnp.concatenate([stats, jnp.zeros((pad, C), stats.dtype)])
-    if F_pad != F:
-        Xb = jnp.concatenate(
-            [Xb, jnp.zeros((n_pad, F_pad - F), Xb.dtype)], axis=1)
+    pack = jnp.concatenate(
+        [_pad_lanes(node[None, :].astype(stats.dtype), n_pad, n_nodes),
+         _pad_lanes(stats.T, n_pad, 0)])                   # [1+C, n_pad]
     mm_dtype = jnp.bfloat16 if stats.dtype == jnp.float32 else stats.dtype
+    if bc is not None and F_pad == F:
+        # precomputed-indicator path (see _kernel_prebc / make_bc)
+        bc = _pad_lanes(bc, n_pad, 0)
+        kern = functools.partial(_kernel_prebc, n_nodes=n_nodes,
+                                 n_chan=C, mm_dtype=mm_dtype)
+        out = pl.pallas_call(
+            kern,
+            grid=(1, n_pad // bnl),
+            in_specs=[
+                pl.BlockSpec((n_bins * F, bnl), lambda fb, rb: (0, rb)),
+                pl.BlockSpec((1 + C, bnl), lambda fb, rb: (0, rb)),
+            ],
+            out_specs=pl.BlockSpec((C * n_nodes, n_bins * F),
+                                   lambda fb, rb: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((C * n_nodes, n_bins * F),
+                                           stats.dtype),
+            interpret=interpret,
+        )(bc, pack)
+        return out.reshape(C, n_nodes, n_bins, F).transpose(1, 0, 2, 3)
+    XbT = _pad_lanes(XbT, n_pad, 0)
+    if F_pad != F:
+        XbT = jnp.concatenate(
+            [XbT, jnp.zeros((F_pad - F, n_pad), XbT.dtype)])
     kern = functools.partial(_kernel, n_nodes=n_nodes, n_bins=n_bins,
-                             mm_dtype=mm_dtype)
+                             n_chan=C, mm_dtype=mm_dtype)
     nfb = F_pad // Fc
     out = pl.pallas_call(
         kern,
-        grid=(nfb, n_pad // bn),                           # rows fastest
+        grid=(nfb, n_pad // bnl),                          # rows fastest
         in_specs=[
-            pl.BlockSpec((bn, Fc), lambda fb, rb: (rb, fb)),
-            pl.BlockSpec((bn, 1), lambda fb, rb: (rb, 0)),
-            pl.BlockSpec((bn, C), lambda fb, rb: (rb, 0)),
+            pl.BlockSpec((Fc, bnl), lambda fb, rb: (fb, rb)),
+            pl.BlockSpec((1 + C, bnl), lambda fb, rb: (0, rb)),
         ],
         out_specs=pl.BlockSpec((C * n_nodes, n_bins * Fc),
                                lambda fb, rb: (0, fb)),
         out_shape=jax.ShapeDtypeStruct((C * n_nodes, nfb * n_bins * Fc),
                                        stats.dtype),
         interpret=interpret,
-    )(Xb, node.reshape(-1, 1).astype(jnp.int32), stats)
+    )(XbT, pack)
     # rows are channel-major (c·A + a), columns (fb, t, f_local): restore
     # the channel-minor [A, C, B, F] layout the tree engine expects.
     out = out.reshape(C, n_nodes, nfb, n_bins, Fc)
@@ -144,9 +238,10 @@ def cumhist(stats: jnp.ndarray, node: jnp.ndarray, Xb: jnp.ndarray,
     return out[..., :F]
 
 
-def _route_kernel(xb_ref, slot_ref, g_ref, tab_ref, slot_out, g_out, *,
-                  A_parent, A_child):
-    """Per-row level routing, one streamed pass over [bn, F] bin rows.
+def _route_kernel(xbt_ref, pack_ref, tab_ref, o_ref, xv_ref, *,
+                  A_parent, A_child, Fc, nfb):
+    """Per-row level routing, one streamed pass over [Fc, bnl] bin blocks
+    (transposed domain, rows = lanes).
 
     The XLA routing path materializes ~3 [n, A] f32 tensors per level
     (one-hot slot masks, per-row split-feature values, child selects) —
@@ -154,75 +249,225 @@ def _route_kernel(xb_ref, slot_ref, g_ref, tab_ref, slot_out, g_out, *,
     tree, and it showed up as ~42% of device time in the round-3 profile
     (``BENCH_r03.json`` top ops are %while routing/binning state). Here
     the whole lookup chain (slot → split feature/threshold/children →
-    compare → child slot) runs in VMEM with only [n, F] streamed in and
-    two [n] vectors out.
+    compare → child slot) runs in VMEM with only [F, n] streamed in and
+    one [2, n] pack out.
 
-    ``tab_ref`` rows: 0=f_idx, 1=t_idx(bin), 2=lchild, 3=rchild,
-    4=do_split — all int32, one column per parent slot.
+    Grid = (row blocks, feature blocks), features fastest: each row
+    block accumulates its selected split-feature value in a VMEM scratch
+    across feature blocks (bounds VMEM for wide matrices), then routes on
+    the last feature step.
+
+    ``pack_ref`` [2, bnl]: row 0 slot, row 1 g. ``tab_ref`` [Ap, 8] f32
+    columns: 0=f_idx, 1=t_idx(bin), 2=lchild, 3=rchild, 4=do_split —
+    slot-major so table values broadcast along lanes without transposes.
     """
-    bn, F = xb_ref.shape
-    slot = slot_ref[:, 0]                                   # [bn] i32
-    g = g_ref[:, 0]
-    oh = (slot[:, None] ==
-          lax.broadcasted_iota(jnp.int32, (bn, A_parent), 1)
-          ).astype(jnp.float32)                             # [bn, Ap]
+    fb = pl.program_id(1)
+    bnl = xbt_ref.shape[1]
+    slot = pack_ref[0, :]                                   # [bnl] f32
+    ohT = (slot.astype(jnp.int32)[None, :] ==
+           lax.broadcasted_iota(jnp.int32, (A_parent, bnl), 0)
+           ).astype(jnp.float32)                            # [Ap, bnl]
 
-    def sel(row):                                           # [bn] f32
-        return jnp.sum(oh * tab_ref[row, :][None, :].astype(jnp.float32),
-                       axis=1)
-    f_sel = sel(0)
-    t_sel = sel(1)
-    l_sel = sel(2)
-    r_sel = sel(3)
-    ds_sel = sel(4)
-    fiota = lax.broadcasted_iota(jnp.int32, (bn, F), 1)
-    xv = jnp.sum(jnp.where(fiota == f_sel.astype(jnp.int32)[:, None],
-                           xb_ref[:].astype(jnp.float32), 0.0), axis=1)
-    right = ((xv > t_sel) & (ds_sel > 0.5)
-             & (slot < A_parent)).astype(jnp.int32)
-    child = jnp.where(right > 0, r_sel, l_sel).astype(jnp.int32)
-    slot_out[:, 0] = jnp.where(slot >= A_parent, A_child, child)
-    g_out[:, 0] = 2 * g + right
+    def sel(col):                                           # [bnl] f32
+        return jnp.sum(ohT * tab_ref[:, col:col + 1].astype(jnp.float32),
+                       axis=0)
+
+    @pl.when(fb == 0)
+    def _init():
+        xv_ref[:] = jnp.zeros_like(xv_ref)
+
+    f_sel = sel(0).astype(jnp.int32)
+    fiota = fb * Fc + lax.broadcasted_iota(jnp.int32, (Fc, bnl), 0)
+    xv_ref[0, :] += jnp.sum(
+        jnp.where(fiota == f_sel[None, :],
+                  xbt_ref[:].astype(jnp.float32), 0.0), axis=0)
+
+    @pl.when(fb == nfb - 1)
+    def _route():
+        g = pack_ref[1, :]
+        t_sel = sel(1)
+        l_sel = sel(2)
+        r_sel = sel(3)
+        ds_sel = sel(4)
+        right = ((xv_ref[0, :] > t_sel) & (ds_sel > 0.5)
+                 & (slot < A_parent)).astype(jnp.float32)
+        child = jnp.where(right > 0.5, r_sel, l_sel)
+        o_ref[0, :] = jnp.where(slot >= A_parent,
+                                jnp.float32(A_child), child)
+        o_ref[1, :] = 2.0 * g + right
 
 
-def route_level(Xb: jnp.ndarray, slot: jnp.ndarray, g: jnp.ndarray,
+def route_level(XbT: jnp.ndarray, slot: jnp.ndarray, g: jnp.ndarray,
                 f_idx, t_idx, lchild, rchild, do_split,
                 A_parent: int, A_child: int, *,
                 interpret: Optional[bool] = None):
-    """(slot, g) → (slot', g') for one tree level (see ``_route_kernel``)."""
-    n, F = Xb.shape
+    """(slot, g) → (slot', g') for one tree level over [F, n] transposed
+    bins (see ``_route_kernel``). slot/g values stay exact in f32 (< 2^24:
+    slots ≤ 128, g < 2^maxdepth)."""
+    F, n = XbT.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    bn = max(8, min(512, (1 << 21) // max(4 * F, 1) // 8 * 8))
-    n_pad = _round_up(n, bn)
-    if n_pad != n:
-        pad = n_pad - n
-        Xb = jnp.concatenate([Xb, jnp.zeros((pad, F), Xb.dtype)])
-        slot = jnp.concatenate(
-            [slot, jnp.full((pad,), A_parent, slot.dtype)])
-        g = jnp.concatenate([g, jnp.zeros((pad,), g.dtype)])
-    tab = jnp.stack([f_idx.astype(jnp.int32), t_idx.astype(jnp.int32),
-                     lchild.astype(jnp.int32), rchild.astype(jnp.int32),
-                     do_split.astype(jnp.int32)])           # [5, Ap]
+    bnl = ROW_ALIGN
+    Fc = max(1, min(F, 512))
+    n_pad = _round_up(n, bnl)
+    F_pad = _round_up(F, Fc)
+    XbT = _pad_lanes(XbT, n_pad, 0)
+    pack = jnp.concatenate(
+        [_pad_lanes(slot[None, :].astype(jnp.float32), n_pad, A_parent),
+         _pad_lanes(g[None, :].astype(jnp.float32), n_pad, 0)])
+    if F_pad != F:
+        XbT = jnp.concatenate(
+            [XbT, jnp.zeros((F_pad - F, n_pad), XbT.dtype)])
+    tab = jnp.stack(
+        [f_idx.astype(jnp.float32), t_idx.astype(jnp.float32),
+         lchild.astype(jnp.float32), rchild.astype(jnp.float32),
+         do_split.astype(jnp.float32),
+         jnp.zeros((A_parent,), jnp.float32),
+         jnp.zeros((A_parent,), jnp.float32),
+         jnp.zeros((A_parent,), jnp.float32)], axis=1)      # [Ap, 8]
+    nfb = F_pad // Fc
     kern = functools.partial(_route_kernel, A_parent=A_parent,
-                             A_child=A_child)
-    slot2, g2 = pl.pallas_call(
+                             A_child=A_child, Fc=Fc, nfb=nfb)
+    out = pl.pallas_call(
         kern,
-        grid=(n_pad // bn,),
+        grid=(n_pad // bnl, nfb),                       # features fastest
         in_specs=[
-            pl.BlockSpec((bn, F), lambda rb: (rb, 0)),
-            pl.BlockSpec((bn, 1), lambda rb: (rb, 0)),
-            pl.BlockSpec((bn, 1), lambda rb: (rb, 0)),
-            pl.BlockSpec((5, A_parent), lambda rb: (0, 0)),
+            pl.BlockSpec((Fc, bnl), lambda rb, fb: (fb, rb)),
+            pl.BlockSpec((2, bnl), lambda rb, fb: (0, rb)),
+            pl.BlockSpec((A_parent, 8), lambda rb, fb: (0, 0)),
         ],
-        out_specs=[pl.BlockSpec((bn, 1), lambda rb: (rb, 0)),
-                   pl.BlockSpec((bn, 1), lambda rb: (rb, 0))],
-        out_shape=[jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
-                   jax.ShapeDtypeStruct((n_pad, 1), jnp.int32)],
+        out_specs=pl.BlockSpec((2, bnl), lambda rb, fb: (0, rb)),
+        out_shape=jax.ShapeDtypeStruct((2, n_pad), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((8, bnl), jnp.float32)],
         interpret=interpret,
-    )(Xb, slot.reshape(-1, 1).astype(jnp.int32),
-      g.reshape(-1, 1).astype(jnp.int32), tab)
-    return slot2[:n, 0], g2[:n, 0]
+    )(XbT, pack, tab)
+    return (out[0, :n].astype(jnp.int32), out[1, :n].astype(jnp.int32))
+
+
+def _predict_kernel(xt_ref, feat_ref, thr_ref, leaf_ref, o_ref, *,
+                    depth, n_classes):
+    """Route one tree over a lane-block of rows and accumulate its
+    (pre-weighted) leaf values into the output pack.
+
+    Routed ensemble prediction through XLA is per-row gathers on the TPU
+    scalar core (feat[node] table lookups + per-row column selects) —
+    ~44 s of the round-4 2M profile across the workflow's train-store
+    transform and the full-store eval. Here the whole descent is
+    elementwise VPU work in the transposed domain: per level a one-hot
+    over that level's ≤ 2^d nodes selects the split feature/threshold
+    ([2^d, bnl] masks), a feature-iota compare selects the row's value,
+    and the leaf lookup is one [2^D, bnl] one-hot reduce per class.
+
+    Grid = (row blocks, trees), trees fastest: the output block is
+    revisited and accumulates across trees. The node-major tables
+    (``feat_ref``/``thr_ref`` [NN, T], ``leaf_ref`` [2^D, T·K]) are tiny
+    and ride whole in VMEM; the running tree's column is a dynamic lane
+    slice.
+    """
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    F, bnl = xt_ref.shape
+    T = feat_ref.shape[1]
+    # dynamic lane slices need 128-aligned indices; select the running
+    # tree's column with a lane mask + reduce instead (tables are tiny)
+    tmask = (lax.broadcasted_iota(jnp.int32, (1, T), 1)
+             == t).astype(jnp.float32)                     # [1, T]
+    node = jnp.zeros((bnl,), jnp.int32)
+    off = 0
+    for d in range(depth):
+        sz = 1 << d
+        ohn = (node[None, :] ==
+               lax.broadcasted_iota(jnp.int32, (sz, bnl), 0)
+               ).astype(jnp.float32)                       # [2^d, bnl]
+        fcol = jnp.sum(feat_ref[off:off + sz, :] * tmask,
+                       axis=1, keepdims=True)              # [2^d, 1]
+        tcol = jnp.sum(thr_ref[off:off + sz, :] * tmask,
+                       axis=1, keepdims=True)
+        f_sel = jnp.sum(ohn * fcol, axis=0)
+        t_sel = jnp.sum(ohn * tcol, axis=0)
+        fio = lax.broadcasted_iota(jnp.int32, (F, bnl), 0)
+        xv = jnp.sum(jnp.where(fio == f_sel.astype(jnp.int32)[None, :],
+                               xt_ref[:], 0.0), axis=0)
+        node = 2 * node + (xv > t_sel).astype(jnp.int32)
+        off += sz
+    ohl = (node[None, :] ==
+           lax.broadcasted_iota(jnp.int32, (1 << depth, bnl), 0)
+           ).astype(jnp.float32)                           # [2^D, bnl]
+    kmask = lax.broadcasted_iota(jnp.int32, (1, leaf_ref.shape[1]), 1)
+    for k in range(n_classes):
+        lcol = jnp.sum(
+            leaf_ref[:] * (kmask == t * n_classes + k).astype(jnp.float32),
+            axis=1, keepdims=True)                         # [2^D, 1]
+        o_ref[k, :] += jnp.sum(ohl * lcol, axis=0)
+
+
+#: routed-predict kernel limits: feature block must fit VMEM in one shot
+#: (per-level accumulation across feature blocks would need per-level
+#: scratch), and the leaf one-hot is [2^D, bnl]
+PREDICT_KERNEL_MAX_F = 1024
+PREDICT_KERNEL_MAX_DEPTH = 10
+PREDICT_KERNEL_MAX_CLASSES = 8
+
+
+def predict_trees(X, feat, thr, leaf_w, max_depth: int, *,
+                  interpret: Optional[bool] = None):
+    """[n, F] raw rows through [T, 2^D−1] stacked trees → [n, K] summed
+    (tree-weight-scaled) leaf values. See ``_predict_kernel``; callers
+    gate on ``predict_kernel_ok``."""
+    n, F = X.shape
+    T, NN = feat.shape
+    K = leaf_w.shape[-1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bnl = ROW_ALIGN
+    n_pad = _round_up(n, bnl)
+    XT = _pad_lanes(X.T.astype(jnp.float32), n_pad, 0)     # [F, n_pad]
+    featT = feat.T.astype(jnp.float32)                     # [NN, T]
+    # dead splits carry +inf thresholds; the kernel's lane-mask select
+    # multiplies them by 0 (inf·0 = NaN), so clip to a huge finite value
+    # — any real feature value still compares below it
+    thrT = jnp.clip(thr.T.astype(jnp.float32), -1e30, 1e30)
+    # [2^D, T·K]: per-tree (0, t) block is that tree's [2^D, K] leaves
+    leafR = leaf_w.transpose(1, 0, 2).reshape(1 << max_depth, T * K)
+    kern = functools.partial(_predict_kernel, depth=max_depth,
+                             n_classes=K)
+    out = pl.pallas_call(
+        kern,
+        grid=(n_pad // bnl, T),                            # trees fastest
+        in_specs=[
+            pl.BlockSpec((F, bnl), lambda rb, t: (0, rb)),
+            pl.BlockSpec((NN, T), lambda rb, t: (0, 0)),
+            pl.BlockSpec((NN, T), lambda rb, t: (0, 0)),
+            pl.BlockSpec((1 << max_depth, T * K), lambda rb, t: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, bnl), lambda rb, t: (0, rb)),
+        out_shape=jax.ShapeDtypeStruct((8, n_pad), jnp.float32),
+        interpret=interpret,
+    )(XT, featT, thrT, jnp.asarray(leafR, jnp.float32))
+    return out[:K, :n].T                                   # [n, K]
+
+
+def predict_kernel_ok(n: int, F: int, max_depth: int, K: int,
+                      T: int = 0, min_rows: int = 65_536) -> bool:
+    """Gate for the routed-predict kernel: large row counts on the
+    kernel path, everything else (tiny batches, very deep/wide models,
+    huge ensembles, serving exports with symbolic batch dims) on the XLA
+    gather path. The whole-table VMEM residency bounds T: feat/thr
+    [NN, T] ×2 + leaf [2^D, T·K] must stay a few MB (there is no pallas
+    fallback wrapper around predict, so the gate must be sufficient)."""
+    nn = (1 << max_depth) - 1
+    table_bytes = 4 * (2 * nn * max(T, 1)
+                       + (1 << max_depth) * max(T, 1) * max(K, 1))
+    return (pallas_histograms_enabled()
+            and isinstance(n, int) and n >= min_rows
+            and F <= PREDICT_KERNEL_MAX_F
+            and max_depth <= PREDICT_KERNEL_MAX_DEPTH
+            and K <= PREDICT_KERNEL_MAX_CLASSES
+            and table_bytes <= 4e6)
 
 
 def disable_pallas_histograms(exc: BaseException) -> bool:
@@ -297,7 +542,7 @@ def pallas_histograms_enabled() -> bool:
             out = cumhist(
                 jnp.ones((16, 3), jnp.float32),
                 jnp.zeros((16,), jnp.int32),
-                jnp.zeros((16, 4), jnp.int32),
+                jnp.zeros((4, 16), jnp.int32),     # XbT: [F, n]
                 2, 2, interpret=False)
             _PROBE = bool(np.asarray(out).shape == (2, 3, 2, 4))
             logger.info("pallas histogram kernel %s (compile probe)",

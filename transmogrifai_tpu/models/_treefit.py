@@ -34,7 +34,7 @@ Tree layout: level-order arrays ``feat``/``thr`` of length 2^D − 1 and
 from __future__ import annotations
 
 import functools
-from typing import Callable, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -237,11 +237,6 @@ def _level_cumhist(stats, node, Xb, n_nodes, n_bins,
     """
     n, F = Xb.shape
     C = stats.shape[1]
-    from ._pallas_hist import cumhist, pallas_histograms_enabled
-    if pallas_histograms_enabled():
-        # Pallas path: operand construction fused into the matmul in VMEM —
-        # NS/Bc never hit HBM (see _pallas_hist module docstring).
-        return cumhist(stats, node, Xb, n_nodes, n_bins)
     # f32 matmuls run at a fraction of MXU bf16 throughput; bf16 operands
     # with f32 accumulation keep COUNT channels exact (sums of exact 1.0s
     # in an f32 accumulator) and only add ~1e-3 relative rounding to the
@@ -268,8 +263,10 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
               n_bins: int, min_instances, min_info_gain,
               depth_limit=None, feat_mask=None, max_active_nodes: int = 128,
               col_blocks=None, node_feat_key=None, node_feat_k=None,
-              unroll: bool = False
-              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+              unroll: bool = False, XbT: Optional[jnp.ndarray] = None,
+              prepared=None
+              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                         jnp.ndarray, jnp.ndarray]:
     """Grow one tree level-wise; returns (feat [2^D−1], thr [2^D−1],
     leaf [2^D, K], node [n] final sample→leaf assignment, gain [2^D−1]).
 
@@ -319,23 +316,30 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
     total stats are already in its cumulative histogram): the previous
     design's ``one_hot(g, 2^D)ᵀ @ stats`` matmul materialized an
     [n, 2^D] bf16 operand — 1.8 GB per tree at 2M rows, depth 9.
+
+    ``XbT`` — optional TRANSPOSED [F, n] bin matrix (lane-compact, the
+    layout the Pallas kernels stream; device_prep provides it pre-padded
+    at scale). Either Xb or XbT must be given; the other orientation is
+    derived only when the active path needs it.
     """
-    n, F = Xb.shape
+    from ._pallas_hist import cumhist, route_level
+    if prepared is None:
+        prepared = prepare_blocks(Xb, XbT, edges, n_bins, col_blocks,
+                                  stats.dtype)
+    use_pallas, Xmat_full, blocks = prepared
+    if use_pallas:
+        XbT_full = Xmat_full
+        F, n = XbT_full.shape
+    else:
+        Xb_full = Xmat_full
+        n, F = Xb_full.shape
     B = n_bins
     C = stats.shape[1]
     D = max_depth
     cap = max(2, min(max_active_nodes, 1 << max(D - 1, 1)))
     mmd = jnp.bfloat16 if stats.dtype == jnp.float32 else stats.dtype
-    if col_blocks is None:
-        col_blocks = [(np.arange(F), B,
-                       lambda fl, tl: edges[fl, tl])]
-    blocks = [(np.asarray(cols), nb, thr_fn, Xb[:, np.asarray(cols)])
-              for cols, nb, thr_fn in col_blocks]
     total_nodes = (1 << D) - 1
     n_leaves = 1 << D
-
-    from ._pallas_hist import pallas_histograms_enabled, route_level
-    use_pallas_route = pallas_histograms_enabled()
 
     def level(d, A, A_next, slot, g, gpos, alive, feat, thr, gain, leafS):
         """One level at A parent slots → A_next child slots. ``d`` may be
@@ -353,8 +357,13 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
         # per-block cumulative histograms over slots; idle (slot == A) → 0.
         # Candidate axis = concat of every block's (bins−1)·F_b pairs.
         flats, oks, cums = [], [], []
-        for cols, nb, _thr_fn, Xblk in blocks:
-            cumb = _level_cumhist(stats, slot, Xblk, A, nb)  # [A,C,nb,Fb]
+        for cols, nb, _thr_fn, Xblk, bc in blocks:
+            if use_pallas:
+                # fused VMEM kernel over the transposed block [Fb, n]
+                cumb = cumhist(stats, slot, Xblk, A, nb, bc=bc)
+            else:
+                cumb = _level_cumhist(stats, slot, Xblk, A, nb)
+            # [A, C, nb, Fb]
             sb = crit.score(cumb)                     # [A, nb-1, Fb]
             lcb = cumb[:, -1, :-1, :]
             tcb = cumb[:, -1, -1:, :]
@@ -380,7 +389,7 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
         thr_v = jnp.zeros((A,), edges.dtype)
         lstats = jnp.zeros((A, C), stats.dtype)
         off = 0
-        for (cols, nb, thr_fn, _Xblk), cumb in zip(blocks, cums):
+        for (cols, nb, thr_fn, _Xblk, _bc), cumb in zip(blocks, cums):
             fb_n = len(cols)
             size = (nb - 1) * fb_n
             inb = (best >= off) & (best < off + size)
@@ -412,10 +421,10 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
         lchild = jnp.where(parent_ok, 2 * inv, A_next)
         rchild = jnp.where(parent_ok, 2 * inv + 1, A_next)
 
-        if use_pallas_route:
+        if use_pallas:
             # single streamed VMEM pass (see _pallas_hist._route_kernel);
             # the XLA alternative below materializes ~3 [n, A] tensors
-            slot2, g2 = route_level(Xb, slot, g, f_idx, t_idx,
+            slot2, g2 = route_level(XbT_full, slot, g, f_idx, t_idx,
                                     lchild, rchild, do_split, A, A_next)
         else:
             # gather-free sample routing: per-sample table lookups run on
@@ -424,7 +433,7 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
             # values with masked [n, A] reductions (VPU).
             oh = jax.nn.one_hot(slot, A, dtype=mmd)   # [n, A]; idle → 0-row
             sel = jax.nn.one_hot(f_idx, F, dtype=mmd)  # [A, F]
-            xf = jnp.matmul(Xb.astype(mmd), sel.T,
+            xf = jnp.matmul(Xb_full.astype(mmd), sel.T,
                             preferred_element_type=stats.dtype)   # [n, A]
             Q = (xf > t_idx[None, :].astype(xf.dtype)) \
                 & do_split[None, :]                   # [n, A]
@@ -520,13 +529,21 @@ def predict_ensemble(feat, thr, leaf, tree_w, X, max_depth: int,
                      tree_chunk: int = 16) -> jnp.ndarray:
     """Weighted sum over [T, …] stacked trees → [n, K].
 
-    Trees are routed in vmapped chunks (one batched fori_loop routes
+    Large row counts route through the Pallas predict kernel (the whole
+    descent as VPU mask math — XLA's per-row gathers ran on the scalar
+    core and dominated eval/scoring at 2M rows). Otherwise trees are
+    routed in vmapped chunks (one batched fori_loop routes
     ``tree_chunk`` trees at once) under a scan that bounds the [chunk, n, K]
     intermediate — a per-tree scan would serialize T × max_depth tiny
     gather steps. The chunk also shrinks with n: the [c, n, K] leaf tensor
     tile-pads K→128 on TPU, so c is capped at ~1GB of padded transient."""
     T = feat.shape[0]
     n = X.shape[0]
+    from ._pallas_hist import predict_kernel_ok, predict_trees
+    if isinstance(n, int) and predict_kernel_ok(
+            n, X.shape[1], max_depth, leaf.shape[-1], T=T):
+        return predict_trees(X, feat, thr,
+                             leaf * tree_w[:, None, None], max_depth)
     if isinstance(n, int):
         byte_cap = max(1, int(1e9 // (max(n, 1) * 128 * 4)))
     else:   # symbolic batch dim (jax.export serving artifact): no shrink
@@ -562,6 +579,30 @@ def predict_ensemble(feat, thr, leaf, tree_w, X, max_depth: int,
 # ---------------------------------------------------------------------------
 # Random forest
 # ---------------------------------------------------------------------------
+
+def poisson_bootstrap_weights(key, rate, n: int, dtype,
+                              k_max: int = 8) -> jnp.ndarray:
+    """Poisson(rate) bootstrap draws via inverse-CDF over ONE uniform.
+
+    ``jax.random.poisson``'s Knuth/rejection machinery runs a while loop
+    whose threefry pair transients have a 2-minor layout that TPU tiling
+    pads 64× — a 10 GB HLO temp at 2M rows under the CV fold×chunk vmap.
+    Spark's subsamplingRate keeps rate ≤ 1, where truncating the inverse
+    CDF at k_max=8 loses < 1e-8 of mass (the tail lands on k_max); every
+    intermediate here is a lane-compact [n] vector. ``rate`` may be a
+    traced scalar (grid hyperparameter)."""
+    ks = jnp.arange(k_max + 1, dtype=jnp.float32)
+    fact = jnp.asarray(
+        np.cumprod(np.concatenate([[1.0], np.arange(1.0, k_max + 1)])),
+        jnp.float32)
+    r = jnp.maximum(jnp.asarray(rate, jnp.float32), 1e-9)
+    cdf = jnp.cumsum(jnp.power(r, ks) * jnp.exp(-r) / fact)
+    u = jax.random.uniform(key, (n,), jnp.float32)
+    w = jnp.zeros((n,), jnp.float32)
+    for i in range(k_max):
+        w = w + (u > cdf[i]).astype(jnp.float32)
+    return w.astype(dtype)
+
 
 def _feature_masks(key, n_trees: int, n_feat: int, k: int) -> jnp.ndarray:
     """[T, F] bool, exactly-k random features per tree (featureSubsetStrategy
@@ -616,6 +657,62 @@ def prepare_bins(X, n_bins, binary_mask=None):
     return Xb, edges, make_col_blocks(edges, n_bins, binary_mask)
 
 
+def prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, stats_dtype):
+    """(use_pallas, full matrix in the active orientation, blocks) —
+    each block is (cols, bins, thr_fn, block matrix, bc|None).
+
+    Called ONCE per fit, OUTSIDE the tree/round scans: the precomputed
+    bin indicator ``bc`` ([B·Fb, n] — see _pallas_hist.make_bc) is a
+    multi-GB fit-invariant and must not rely on XLA hoisting it out of a
+    while body."""
+    from ._pallas_hist import (bc_cache_ok, make_bc,
+                               pallas_histograms_enabled)
+    use_pallas = pallas_histograms_enabled()
+    if use_pallas:
+        Xmat = XbT if XbT is not None else Xb.T
+        F, n = Xmat.shape
+    else:
+        Xmat = Xb if Xb is not None else XbT.T
+        n, F = Xmat.shape
+    if col_blocks is None:
+        B = n_bins
+        col_blocks = [(np.arange(F), B, lambda fl, tl: edges[fl, tl])]
+    bc_dt = jnp.bfloat16 if stats_dtype == jnp.float32 else stats_dtype
+    blocks = []
+    for cols, nb, thr_fn in col_blocks:
+        cols = np.asarray(cols)
+        if use_pallas:
+            blk = Xmat[cols, :]
+            bc = (make_bc(blk, nb, bc_dt)
+                  if bc_cache_ok(n, len(cols), nb) else None)
+        else:
+            blk = Xmat[:, cols]
+            bc = None
+        blocks.append((cols, nb, thr_fn, blk, bc))
+    return use_pallas, Xmat, blocks
+
+
+def _resolve_prebinned(X, y, w, n_bins, binary_mask, prebinned):
+    """(Xb|None, XbT|None, edges, col_blocks, n, padded y, padded w).
+
+    ``prebinned`` is (mat, edges, col_blocks, transposed) — transposed
+    mats are the lane-compact [F, n] layout the Pallas kernels stream
+    (device_prep may also have ROW_ALIGN-padded their rows; y/w are
+    zero-padded here to follow)."""
+    if prebinned is not None:
+        mat, edges, col_blocks, is_T = prebinned
+        Xb, XbT = (None, mat) if is_T else (mat, None)
+        n = mat.shape[1] if is_T else mat.shape[0]
+    else:
+        Xb, edges, col_blocks = prepare_bins(X, n_bins, binary_mask)
+        XbT, n = None, Xb.shape[0]
+    if n != y.shape[0]:
+        pad = n - y.shape[0]
+        y = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)])
+        w = jnp.concatenate([w, jnp.zeros((pad,), w.dtype)])
+    return Xb, XbT, edges, col_blocks, n, y, w
+
+
 def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
                max_depth: int, n_bins: int, min_instances, min_info_gain,
                num_trees_used, subsample_rate, depth_limit=None,
@@ -645,12 +742,11 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
     2M rows."""
     key = jax.random.PRNGKey(seed)
     k_boot, k_feat = jax.random.split(key)
-    if prebinned is not None:
-        Xb, edges, col_blocks = prebinned
-    else:
-        Xb, edges, col_blocks = prepare_bins(X, n_bins, binary_mask)
-    n, F = Xb.shape
+    Xb, XbT, edges, col_blocks, n, y, w = _resolve_prebinned(
+        X, y, w, n_bins, binary_mask, prebinned)
+    F = Xb.shape[1] if Xb is not None else XbT.shape[0]
     dt = w.dtype
+    prepared = prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, dt)
     rate = jnp.broadcast_to(jnp.asarray(subsample_rate, jnp.float32), ())
     per_node = False
     feat_k = F
@@ -685,8 +781,8 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
         if n_trees == 1:
             bw = jnp.ones((n,), dt)             # single DT: no bootstrap
         else:
-            bw = jax.random.poisson(
-                jax.random.fold_in(k_boot, tid), rate, (n,)).astype(dt)
+            bw = poisson_bootstrap_weights(
+                jax.random.fold_in(k_boot, tid), rate, n, dt)
         wt = w * bw
         feat, thr, leaf, node, gain = grow_tree(
             Xb, edges, make_stats(wt), crit, leaf_fn, max_depth,
@@ -695,7 +791,7 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
             max_active_nodes=max_active_nodes,
             col_blocks=col_blocks,
             node_feat_key=fk if per_node else None,
-            node_feat_k=feat_k, unroll=unroll)
+            node_feat_k=feat_k, unroll=unroll, prepared=prepared)
         return feat, thr, leaf, node, gain
 
     c = max(1, min(tree_chunk, n_trees))
@@ -738,12 +834,10 @@ def fit_gbt(X, y, w, *, task: str, n_rounds: int, max_depth: int,
     """Spark-style GBT: each round fits a weighted regression tree to the
     pseudo-residuals; classification uses logloss on y' ∈ {−1,+1} with
     margin F, prob = σ(2F) (GBTClassificationModel semantics)."""
-    if prebinned is not None:
-        Xb, edges, col_blocks = prebinned
-    else:
-        Xb, edges, col_blocks = prepare_bins(X, n_bins, binary_mask)
-    n = Xb.shape[0]
+    Xb, XbT, edges, col_blocks, n, y, w = _resolve_prebinned(
+        X, y, w, n_bins, binary_mask, prebinned)
     dt = w.dtype
+    prepared = prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, dt)
     ypm = 2.0 * y - 1.0
 
     def residual(Fm):
@@ -759,7 +853,7 @@ def fit_gbt(X, y, w, *, task: str, n_rounds: int, max_depth: int,
             Xb, edges, stats, VarianceCriterion(), variance_leaf, max_depth,
             n_bins, min_instances, min_info_gain, depth_limit=depth_limit,
             max_active_nodes=max_active_nodes, col_blocks=col_blocks,
-            unroll=unroll)
+            unroll=unroll, prepared=prepared)
         use = (t < num_rounds_used).astype(dt)
         scale = use * step_size
         Fm = Fm + scale * leaf[node][:, 0]
@@ -784,12 +878,10 @@ def fit_xgb(X, y, w, *, task: str, n_rounds: int, max_depth: int,
     """Second-order boosting: g/h from logistic (classification) or squared
     (regression) loss; leaf = −G/(H+λ) (xgboost4j replacement — Rabit's
     histogram allreduce becomes psum under a sharded batch axis)."""
-    if prebinned is not None:
-        Xb, edges, col_blocks = prebinned
-    else:
-        Xb, edges, col_blocks = prepare_bins(X, n_bins, binary_mask)
-    n = Xb.shape[0]
+    Xb, XbT, edges, col_blocks, n, y, w = _resolve_prebinned(
+        X, y, w, n_bins, binary_mask, prebinned)
     dt = w.dtype
+    prepared = prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, dt)
     crit = XGBCriterion(lam, min_child_weight)
     leaf_fn = make_xgb_leaf(lam)
 
@@ -806,7 +898,7 @@ def fit_xgb(X, y, w, *, task: str, n_rounds: int, max_depth: int,
             Xb, edges, stats, crit, leaf_fn, max_depth, n_bins,
             jnp.asarray(0.0, dt), jnp.asarray(-1e29, dt),
             depth_limit=depth_limit, max_active_nodes=max_active_nodes,
-            col_blocks=col_blocks, unroll=unroll)
+            col_blocks=col_blocks, unroll=unroll, prepared=prepared)
         use = (t < num_rounds_used).astype(dt)
         scale = use * eta
         Fm = Fm + scale * leaf[node][:, 0]

@@ -199,10 +199,13 @@ class ModelSelector(PredictorEstimator):
         X, y = extract_xy(store, self.label_name, self.features_name)
         if self.splitter is not None:
             # estimate BEFORE dropping (DataBalancer.estimate sees full
-            # counts), then drop rare labels and re-index contiguously
+            # counts), then drop rare labels and re-index contiguously.
+            # keep-all skips the boolean-index copy so the matrix keeps
+            # its identity for the device-upload cache (device_put_f32)
             self.splitter.pre_validation_prepare(y)
             keep = self.splitter.keep_mask(y)
-            X, y = X[keep], y[keep]
+            if not keep.all():
+                X, y = X[keep], y[keep]
             y = self.splitter.relabel(y)
             base_w = self.splitter.sample_weights(y)
         else:
@@ -252,21 +255,34 @@ class ModelSelector(PredictorEstimator):
         # final refit on the full prepared train (ModelSelector.scala:158-159)
         if self.splitter is not None:
             keep = self.splitter.keep_mask(y)
-            Xk = X[keep]
-            yk = self.splitter.relabel(y[keep])
+            Xk = X if keep.all() else X[keep]
+            yk = self.splitter.relabel(y if keep.all() else y[keep])
             w = self.splitter.sample_weights(yk)
         else:
             Xk, yk = X, y
             w = np.ones_like(yk)
+        import logging as _logging
+        import time as _time
+        _log = _logging.getLogger(__name__)
+        tr0 = _time.time()
         single = best_family.clone_single(best_hparams)
-        Xd = jnp.asarray(Xk)
+        from .base import device_put_f32
+        Xd = device_put_f32(Xk)
         if hasattr(single, "fit_prepared"):
             # tree refit: bin once, static-depth unrolled fit at large n,
-            # train predictions straight from the fit-time caches
-            params, Xarg = single.fit_prepared(
-                Xd, jnp.asarray(yk), jnp.asarray(w))
-            pred_d, _raw_d, prob_d = single.predict_batch(params, Xarg,
-                                                          on_train=True)
+            # train predictions straight from the fit-time caches. Same
+            # Mosaic fallback as the sweep — the refit compiles a fresh
+            # width-1 program the sweep's shapes never exercised, and a
+            # kernel rejection here must not kill the run after the
+            # sweep succeeded.
+            from ._pallas_hist import with_pallas_fallback
+
+            def _refit():
+                params, Xarg = single.fit_prepared(
+                    Xd, jnp.asarray(yk), jnp.asarray(w))
+                return (params, single.predict_batch(params, Xarg,
+                                                     on_train=True))
+            params, (pred_d, _raw_d, prob_d) = with_pallas_fallback(_refit)
         else:
             grid = single.stack_grid()
             params = jax.jit(lambda X, y, w: single.fit_batch(
@@ -275,12 +291,18 @@ class ModelSelector(PredictorEstimator):
         # ONE batched pull for fitted params + train predictions (per-array
         # pulls each pay the device link's round-trip latency)
         params, pred, prob = jax.device_get((params, pred_d, prob_d))
+        _log.info("final refit (fit+train-predict+pull): %.2fs",
+                  _time.time() - tr0)
         inner = single.realize(_index_pytree(params, 0), best_hparams)
 
         # train evaluation over the rows the model was actually trained on
-        # (DataCutter-dropped labels are out of scope for the model)
-        train_eval = _task_metrics(self.task, yk, np.asarray(pred)[0],
-                                   np.asarray(prob)[0])
+        # (DataCutter-dropped labels are out of scope for the model);
+        # prebinned tree predictions may carry ROW_ALIGN padding — slice
+        pred0 = np.asarray(pred)[0][:len(yk)]
+        prob0 = np.asarray(prob)[0]
+        if prob0.ndim == 2 and prob0.shape[0] > len(yk):
+            prob0 = prob0[:len(yk)]
+        train_eval = _task_metrics(self.task, yk, pred0, prob0)
 
         mapping = (self.splitter.original_labels() if self.splitter
                    else None)

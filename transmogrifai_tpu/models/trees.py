@@ -62,7 +62,21 @@ def _tree_dtype(X) -> Any:
 
 
 def _tree_rows(X) -> int:
-    return (X["Xb"] if isinstance(X, dict) else X).shape[0]
+    if isinstance(X, dict):
+        return (X["XbT"].shape[1] if "XbT" in X else X["Xb"].shape[0])
+    return X.shape[0]
+
+
+def pad_rows_to(n_pad: int, *arrs):
+    """Zero-pad leading (row) axis to ``n_pad`` — device_prep may have
+    ROW_ALIGN-padded the binned matrix; y/weights/masks must follow.
+    Zero weights keep pad rows out of every histogram and metric."""
+    out = []
+    for a in arrs:
+        n = a.shape[0]
+        out.append(a if n == n_pad else jnp.concatenate(
+            [a, jnp.zeros((n_pad - n,) + a.shape[1:], a.dtype)]))
+    return out
 
 
 def detect_binary_columns(X: np.ndarray) -> Optional[np.ndarray]:
@@ -123,10 +137,6 @@ class TreeEnsembleModel(PredictorModel):
         else:   # gbt_regression / xgb_regression
             out = TF.predict_margin_regression(p, Xd, self.max_depth)
         return out
-
-    def predict_arrays(self, X):
-        from .base import pull_f64
-        return pull_f64(self.predict_device(jnp.asarray(X)))
 
     def get_model_state(self):
         state = {f"tree_{k}": np.asarray(v) for k, v in self.trees.items()}
@@ -231,28 +241,50 @@ class _TreeFamilyBase(ModelFamily):
         same device array (strong ref keeps ``id`` stable)."""
         import functools
         import weakref
+
+        from ._pallas_hist import ROW_ALIGN, pallas_histograms_enabled
         bm = self.binary_mask
+        pallas_on = pallas_histograms_enabled()
         mkey = None if bm is None else np.asarray(bm, bool).tobytes()
-        key = (id(Xd), tuple(Xd.shape), str(Xd.dtype), self.n_bins, mkey)
+        key = (id(Xd), tuple(Xd.shape), str(Xd.dtype), self.n_bins, mkey,
+               pallas_on)
         hit = _PREP_CACHE.get(key)
         if hit is not None and hit[0]() is not None:
             return hit[1]
-        fkey = (self.n_bins, mkey)
+
+        def bins_padded(X, n_bins=self.n_bins, binary_mask=bm):
+            Xb, edges = TF.compute_bins(X, n_bins, binary_mask)
+            if not pallas_on:
+                return {"Xb": Xb, "edges": edges}
+            # kernel path: TRANSPOSED feature-major bins (lane-compact —
+            # a [n, 20] i32 matrix is 6.4× larger physically than its
+            # [20, n] transpose under TPU (8,128) tiling), rows padded to
+            # ROW_ALIGN once so the kernels never re-pad per level. Pad
+            # rows carry zero weights downstream, so they never reach a
+            # histogram; edges come from the real rows above.
+            XbT = Xb.T
+            n = XbT.shape[1]
+            n_pad = -(-n // ROW_ALIGN) * ROW_ALIGN
+            if n_pad != n:
+                XbT = jnp.concatenate(
+                    [XbT, jnp.zeros((XbT.shape[0], n_pad - n),
+                                    XbT.dtype)], axis=1)
+            return {"XbT": XbT, "edges": edges}
+
+        fkey = (self.n_bins, mkey, pallas_on)
         fn = _BIN_FNS.get(fkey)
         if fn is None:
-            fn = jax.jit(functools.partial(
-                TF.compute_bins, n_bins=self.n_bins, binary_mask=bm))
+            fn = jax.jit(bins_padded)
             while len(_BIN_FNS) >= 16:
                 _BIN_FNS.pop(next(iter(_BIN_FNS)))
             _BIN_FNS[fkey] = fn
-        Xb, edges = fn(Xd)
-        prep = {"Xb": Xb, "edges": edges}
+        prep = fn(Xd)
         while len(_PREP_CACHE) >= 4:
             _PREP_CACHE.pop(next(iter(_PREP_CACHE)))    # FIFO evict
         try:
             ref = weakref.ref(Xd, lambda _r, k=key: _PREP_CACHE.pop(k, None))
-        except TypeError:       # non-weakref-able input (plain ndarray)
-            ref = lambda: Xd
+        except TypeError:       # non-weakref-able input: don't cache —
+            return prep         # a strong ref would pin X + Xb for life
         _PREP_CACHE[key] = (ref, prep)
         return prep
 
@@ -263,6 +295,8 @@ class _TreeFamilyBase(ModelFamily):
         Returns (params, Xarg) with Xarg reusable for on_train predicts."""
         grid = grid if grid is not None else self.stack_grid()
         Xarg = self.device_prep(Xd)
+        y, w = pad_rows_to(_tree_rows(Xarg), jnp.asarray(y),
+                           jnp.asarray(w))
         dflt = self.param_defaults().get("maxDepth", 0)
         depths = {int(g.get("maxDepth", dflt)) for g in self.grid}
         sd = (depths.pop() if len(depths) == 1
@@ -273,12 +307,14 @@ class _TreeFamilyBase(ModelFamily):
 
     def _prebinned_of(self, X):
         """(prebinned tuple or None, raw-X or None) from a fit input that
-        is either raw [n, F] or a device_prep dict."""
+        is either raw [n, F] or a device_prep dict (whose bin matrix may
+        be the transposed kernel layout)."""
         if isinstance(X, dict):
             edges = X["edges"]
-            return (X["Xb"], edges,
-                    TF.make_col_blocks(edges, self.n_bins,
-                                       self.binary_mask)), None
+            cb = TF.make_col_blocks(edges, self.n_bins, self.binary_mask)
+            if "XbT" in X:
+                return (X["XbT"], edges, cb, True), None
+            return (X["Xb"], edges, cb, False), None
         return None, X
 
     def fit_batch(self, X, y, w, stacked, static_depth: Optional[int] = None):
@@ -323,13 +359,15 @@ class _TreeFamilyBase(ModelFamily):
             n = _tree_rows(X)
 
             def fn(p):
-                # trees accumulate in byte-capped chunks, K-MAJOR: a
-                # [c, n, K] gather tensor would tile-pad K→128 on TPU
-                # (64× physical blowup for binary K=2); gathering from
-                # [K, L] leaves keeps n in the lane dimension — unpadded
+                # trees accumulate in byte-capped chunks, one CLASS
+                # CHANNEL at a time: gathering [c, L, K] leaves in one op
+                # emits a K-minor result that TPU tiling pads 64× for
+                # binary K=2 (a 10 GB HLO temp under the fold×chunk vmap
+                # at 2M rows); per-channel [L]-table gathers keep every
+                # intermediate [c, n] lane-compact
                 leaf, node, tw = p["leaf"], p["train_node"], p["tree_w"]
                 T_, L, K = leaf.shape
-                c = max(1, min(T_, int(64e6 // max(n * K * 4, 1))))
+                c = max(1, min(T_, int(64e6 // max(n * 4, 1))))
                 pad = (-T_) % c
                 if pad:
                     leaf = jnp.concatenate(
@@ -339,17 +377,35 @@ class _TreeFamilyBase(ModelFamily):
                     tw = jnp.concatenate(
                         [tw, jnp.zeros((pad,), tw.dtype)])
                 nc = (T_ + pad) // c
-                leafT = leaf.transpose(0, 2, 1)         # [T, K, L]
 
-                def body(acc, tl):
-                    lf, nd, w_t = tl           # [c, K, L], [c, n], [c]
-                    vals = jax.vmap(lambda l, m: l[:, m])(lf, nd)
-                    return acc + jnp.einsum("t,tkn->kn", w_t, vals), None
-                acc, _ = lax.scan(
-                    body, jnp.zeros((K, n), leaf.dtype),
-                    (leafT.reshape(nc, c, K, L), node.reshape(nc, c, n),
+                # classification leaves are per-leaf probabilities that
+                # sum to 1, and on the TRAIN matrix every row lands in a
+                # non-empty leaf — so the last class needs no gather:
+                # acc_{K-1} = Σ tree_w − Σ_{k<K-1} acc_k. The [n]-table
+                # gathers run on the scalar core; this cuts them by 1/K.
+                k_gather = K - 1 if self.task == "classification" else K
+
+                def body(accs, tl):
+                    lf, nd, w_t = tl           # [c, L, K], [c, n], [c]
+                    outs = []
+                    for k in range(k_gather):
+                        vals = jax.vmap(
+                            lambda l1, m, k=k: l1[:, k][m])(lf, nd)
+                        outs.append(accs[k]
+                                    + jnp.einsum("t,tn->n", w_t, vals))
+                    return tuple(outs), None
+                accs, _ = lax.scan(
+                    body,
+                    tuple(jnp.zeros((n,), leaf.dtype)
+                          for _ in range(k_gather)),
+                    (leaf.reshape(nc, c, L, K), node.reshape(nc, c, n),
                      tw.reshape(nc, c)))
-                return TF.rf_head(acc.T, dt, self.task)
+                accs = list(accs)
+                if k_gather < K:
+                    accs.append(jnp.sum(tw) - sum(accs))
+                # the stack below folds away in the fused metric program
+                # (the device metric slices one class column back out)
+                return TF.rf_head(jnp.stack(accs, axis=-1), dt, self.task)
             return jax.vmap(fn)(params)
         if on_train and head in ("gbt", "xgb") and "train_margin" in params:
             scale = 2.0 if head == "gbt" else 1.0

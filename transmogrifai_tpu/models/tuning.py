@@ -522,7 +522,8 @@ class _ValidatorBase:
             Xd, yd, wd, vwd, n_orig = shard_cv_inputs(mesh, X, y, train_w,
                                                       extra=val_w)
         else:
-            Xd, yd = jnp.asarray(X), jnp.asarray(y)
+            from .base import device_put_f32
+            Xd, yd = device_put_f32(X), jnp.asarray(y)
             wd = jnp.asarray(train_w)
             vwd = jnp.asarray(val_w)
 
@@ -551,6 +552,21 @@ class _ValidatorBase:
         # async back-to-back, and bound peak memory to one chunk.
         def make_fit_eval(family, metric_fn, static_depth=None):
             def fit_eval(X, y, w_folds, v_folds, stacked):
+                if isinstance(X, dict):
+                    # device_prep may ROW_ALIGN-pad the binned matrix;
+                    # follow with zero-weighted label/mask rows so the
+                    # pads stay out of every histogram and metric
+                    from .trees import _tree_rows, pad_rows_to
+                    n_pad = _tree_rows(X)
+                    if n_pad != y.shape[0]:
+                        (y,) = pad_rows_to(n_pad, y)
+                        w_folds, v_folds = [
+                            jnp.concatenate(
+                                [a, jnp.zeros((a.shape[0],
+                                               n_pad - a.shape[1]),
+                                              a.dtype)], axis=1)
+                            for a in (w_folds, v_folds)]
+
                 def per_fold(w, v):
                     if static_depth is not None:
                         params = family.fit_batch(
@@ -694,6 +710,8 @@ class _ValidatorBase:
         # queues them back-to-back), then ONE batched metrics pull: per-
         # chunk synchronous pulls would pay a full link round-trip each
         # AND serialize device execution against host latency
+        import time as _time
+        td0 = _time.time()
         fused_out: Dict[int, Any] = {}
         for fi in fused:
             fc, chunks = plans[fi]
@@ -706,6 +724,7 @@ class _ValidatorBase:
                                     vwd[i0:i0 + fc], st))
             fused_out[fi] = outs
         fused_np = jax.device_get(fused_out)
+        logger.info("sweep dispatch+execute+pull: %.2fs", _time.time() - td0)
 
         for fi, family in enumerate(families):
             k, g = len(splits), family.grid_size()
@@ -855,6 +874,18 @@ class _ValidatorBase:
                         if hasattr(family, "device_prep") else Xd)
 
                 def fit_eval(X, y, w_folds, v_folds, stacked):
+                    if isinstance(X, dict):
+                        from .trees import _tree_rows, pad_rows_to
+                        n_pad = _tree_rows(X)
+                        if n_pad != y.shape[0]:
+                            (y,) = pad_rows_to(n_pad, y)
+                            w_folds, v_folds = [
+                                jnp.concatenate(
+                                    [a, jnp.zeros((a.shape[0],
+                                                   n_pad - a.shape[1]),
+                                                  a.dtype)], axis=1)
+                                for a in (w_folds, v_folds)]
+
                     def per_fold(w, v):
                         params = family.fit_batch(X, y, w, stacked)
                         pred, _raw, prob = family.predict_batch(

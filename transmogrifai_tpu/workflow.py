@@ -148,6 +148,17 @@ def device_roundtrip_mbps() -> float:
     return _DEVICE_BW_MBPS
 
 
+def fusion_state() -> Dict[str, Any]:
+    """Layer-fusion gate state for benchmark recording: the measured
+    link bandwidth and whether fused device transforms are ON — probed
+    once per process (VERDICT r3: every benched number must say whether
+    feature engineering ran fused-on-device or on host)."""
+    bw = device_roundtrip_mbps()
+    return {"fusion": "ON" if bw >= FUSE_MIN_BANDWIDTH_MBPS else "OFF",
+            "mbps": round(bw, 1),
+            "gate_mbps": FUSE_MIN_BANDWIDTH_MBPS}
+
+
 def _is_coordinator() -> bool:
     """Shared-filesystem writes (checkpoints) happen on one process only
     — multi-host runs compute identical state on every host."""
