@@ -1,0 +1,292 @@
+"""Offline trainer for the vendored POS / NER / sentence taggers.
+
+The reference ships pretrained OpenNLP binaries as package resources
+(``/root/reference/models/README.md:1-5``); this repo vendors its own
+learned weights instead, produced by THIS script (reproducible, seeded).
+There is no network egress in the build image, so no external treebank:
+the supervision comes from a template-grammar corpus generator over
+curated lexicons (names / organizations / locations / vocabulary with
+authored POS tags). The taggers are averaged perceptrons
+(``transmogrifai_tpu/utils/taggers.py``) — the same model family NLTK's
+default English POS tagger uses.
+
+Run from the repo root:  python tools/train_taggers.py
+Writes transmogrifai_tpu/resources/taggers/{pos,ner,sent}.json.gz
+"""
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), os.pardir))
+
+from transmogrifai_tpu.utils.taggers import (AveragedPerceptron, NERTagger,
+                                             POSTagger, SentenceSplitter,
+                                             resource_dir)
+
+FIRST_NAMES = """james mary john patricia robert jennifer michael linda
+william elizabeth david barbara richard susan joseph jessica thomas sarah
+charles karen christopher nancy daniel lisa matthew betty anthony helen
+mark sandra donald ashley steven kimberly paul donna andrew carol joshua
+michelle kenneth emily kevin amanda brian melissa george deborah timothy
+stephanie ronald rebecca edward laura jason sharon jeffrey cynthia ryan
+kathleen jacob amy gary angela nicholas anna eric ruth jonathan brenda
+stephen pamela larry nicole justin katherine scott samantha brandon
+christine benjamin catherine samuel virginia gregory rachel frank carolyn
+alexander janet raymond maria patrick heather jack diane dennis julie
+jerry joyce tyler victoria aaron kelly jose christina adam joan henry
+evelyn nathan judith douglas megan zachary cheryl peter andrea kyle hannah
+walter jacqueline ethan martha jeremy gloria harold teresa keith ann roger
+madison noah olivia carl sophia arthur isabella terry emma sean ava austin
+mia wei li ming chen yuki hiroshi keiko ravi priya arjun ananya omar fatima
+ahmed layla carlos sofia diego valentina pierre claire luca giulia""".split()
+
+SURNAMES = """smith johnson williams brown jones garcia miller davis
+rodriguez martinez hernandez lopez gonzalez wilson anderson thomas taylor
+moore jackson martin lee perez thompson white harris sanchez clark ramirez
+lewis robinson walker young allen king wright scott torres nguyen hill
+flores green adams nelson baker hall rivera campbell mitchell carter
+roberts gomez phillips evans turner diaz parker cruz edwards collins
+reyes stewart morris morales murphy cook rogers gutierrez ortiz morgan
+cooper peterson bailey reed kelly howard ramos kim cox ward richardson
+watson brooks chavez wood james bennett gray mendoza ruiz hughes price
+alvarez castillo sanders patel myers long ross foster jimenez tanaka sato
+suzuki wang zhang liu singh kumar khan ali hassan silva santos rossi
+ferrari mueller schmidt fischer weber dubois laurent moreau""".split()
+
+ORG_BASES = """acme globex initech umbrella stark wayne cyberdyne tyrell
+wonka oscorp aperture vandelay hooli gringotts monarch pinnacle vertex
+quantum nimbus zenith apex titan orion atlas nova polaris summit cascade
+horizon beacon crescent sterling granite cobalt ember harbor meridian
+catalyst fusion vector helix """.split()
+
+ORG_SUFFIXES = ["inc", "corp", "ltd", "llc", "group", "labs",
+                "industries", "systems", "holdings", "partners",
+                "technologies", "bank", "university", "institute"]
+
+LOCATIONS = """london paris tokyo berlin madrid rome moscow beijing
+shanghai mumbai delhi cairo lagos nairobi sydney melbourne toronto
+vancouver chicago boston seattle austin denver atlanta miami dallas
+houston phoenix portland detroit memphis nashville oakland sacramento
+brazil france germany spain italy russia china india egypt nigeria kenya
+australia canada mexico argentina chile peru japan korea vietnam thailand
+singapore malaysia indonesia texas california florida ohio georgia
+washington oregon arizona colorado utah nevada montana idaho maine
+amsterdam brussels vienna prague budapest warsaw lisbon dublin oslo
+stockholm helsinki copenhagen zurich geneva munich hamburg lyon
+barcelona seville naples milان""".replace("milان", "milan").split()
+
+MULTI_LOCS = ["new york", "san francisco", "los angeles", "hong kong",
+              "new delhi", "cape town", "buenos aires", "mexico city",
+              "new orleans", "san diego", "las vegas", "kuala lumpur",
+              "tel aviv", "abu dhabi", "new jersey", "south africa",
+              "new zealand", "costa rica", "sri lanka", "saudi arabia"]
+
+#: (word, PTB-ish tag) vocabulary for template slots
+NOUNS = """report meeting contract budget project team engineer manager
+customer product market quarter revenue profit system network model data
+analysis review plan strategy launch deadline office warehouse factory
+shipment invoice order payment account balance survey result study
+platform service feature release update issue ticket request response
+pipeline cluster server database index query table schema record""".split()
+VERBS_PAST = """announced approved reviewed signed shipped launched
+delivered acquired visited joined left opened closed moved hired promoted
+presented finished started completed submitted rejected audited merged
+deployed migrated benchmarked profiled optimized""".split()
+VERBS_PRES = """announces approves reviews signs ships launches delivers
+acquires visits joins opens closes moves hires promotes presents finishes
+starts completes submits rejects audits merges deploys migrates""".split()
+ADJECTIVES = """new big small quarterly annual final initial major minor
+strategic critical strong weak early late global local technical detailed
+preliminary responsive efficient reliable scalable robust""".split()
+ADVERBS = """quickly slowly carefully recently finally early late soon
+yesterday today tomorrow internally externally formally jointly""".split()
+PREPS = "in at on for with from to of by near under over after before".split()
+DETS = "the a this that each every its their our".split()
+MONTHS = """january february march april may june july august september
+october november december""".split()
+
+ABBREVS = ["Dr.", "Mr.", "Mrs.", "Ms.", "Prof.", "Jr.", "Sr.", "St.",
+           "Jan.", "Feb.", "Mar.", "Apr.", "Jun.", "Jul.", "Aug.", "Sep.",
+           "Oct.", "Nov.", "Dec.", "U.S.", "U.K.", "Inc.", "Corp.", "Ltd.",
+           "Co.", "vs.", "etc.", "e.g.", "i.e.", "No.", "Dept.", "Ave.",
+           "Blvd.", "Rd."]
+
+
+def _cap(w: str) -> str:
+    return w[:1].upper() + w[1:]
+
+
+def gen_sentence(rng: random.Random):
+    """One synthetic sentence → (tokens, pos tags, ner BIO tags)."""
+    toks, pos, ner = [], [], []
+
+    def add(ts, ps, ns="O"):
+        for j, t in enumerate(ts):
+            toks.append(t)
+            pos.append(ps[j] if isinstance(ps, list) else ps)
+            if ns == "O":
+                ner.append("O")
+            else:
+                ner.append(("B-" if j == 0 else "I-") + ns)
+
+    def person():
+        if rng.random() < 0.15:
+            # honorific titles precede the name and are NOT part of it
+            add([rng.choice(["Dr.", "Mr.", "Mrs.", "Ms.", "Prof."])], "NNP")
+            add([_cap(rng.choice(SURNAMES))], "NNP", "PER")
+            return
+        parts = [_cap(rng.choice(FIRST_NAMES))]
+        if rng.random() < 0.7:
+            parts.append(_cap(rng.choice(SURNAMES)))
+        add(parts, "NNP", "PER")
+
+    def org():
+        parts = [_cap(rng.choice(ORG_BASES))]
+        if rng.random() < 0.8:
+            parts.append(_cap(rng.choice(ORG_SUFFIXES)))
+        add(parts, "NNP", "ORG")
+
+    def loc():
+        if rng.random() < 0.25:
+            parts = [_cap(p) for p in rng.choice(MULTI_LOCS).split()]
+            add(parts, "NNP", "LOC")
+        else:
+            add([_cap(rng.choice(LOCATIONS))], "NNP", "LOC")
+
+    def np():
+        if rng.random() < 0.6:
+            add([rng.choice(DETS)], "DT")
+        if rng.random() < 0.5:
+            add([rng.choice(ADJECTIVES)], "JJ")
+        add([rng.choice(NOUNS)], "NN")
+
+    def date():
+        add([_cap(rng.choice(MONTHS))], "NNP")
+        if rng.random() < 0.6:          # standalone "in March" is common
+            add([str(rng.randint(1, 28))], "CD")
+
+    def pp(inner):
+        add([rng.choice(PREPS)], "IN")
+        inner()
+
+    if rng.random() < 0.2:
+        # sentence-initial adverb: capitalized non-entities must appear
+        # at position 0 in training or the NER reads them as names
+        add([rng.choice(ADVERBS)], "RB")
+        if rng.random() < 0.5:
+            add([","], ",")
+    def pronoun():
+        add([rng.choice(["he", "she", "they", "we", "it"])], "PRP")
+
+    subj = rng.choice([person, org, np, np, pronoun])
+    subj()
+    if rng.random() < 0.25:
+        add([rng.choice(ADVERBS)], "RB")
+    if rng.random() < 0.7:
+        add([rng.choice(VERBS_PAST)], "VBD")
+    else:
+        add([rng.choice(VERBS_PRES)], "VBZ")
+    obj = rng.choice([np, person, org])
+    obj()
+    for extra in (loc, np, date):
+        if rng.random() < 0.4:
+            pp(extra if extra is not loc else rng.choice([loc, org, person]))
+    end = rng.choice([".", ".", ".", "?", "!"])
+    add([end], ".")
+    # real text capitalizes sentence starts: without this the taggers
+    # read ANY sentence-initial capital as a proper noun / entity
+    if toks and toks[0][:1].isalpha():
+        toks[0] = _cap(toks[0])
+    return toks, pos, ner
+
+
+def main(seed: int = 7, n_sents: int = 6000, epochs: int = 6) -> None:
+    rng = random.Random(seed)
+    corpus = [gen_sentence(rng) for _ in range(n_sents)]
+    os.makedirs(resource_dir(), exist_ok=True)
+
+    # -- POS --------------------------------------------------------------
+    pos_classes = {t for _, ps, _ in corpus for t in ps}
+    model = AveragedPerceptron(classes=sorted(pos_classes))
+    data = list(corpus)
+    for _ in range(epochs):
+        rng.shuffle(data)
+        for toks, tags, _ in data:
+            prev, prev2 = POSTagger.START[1], POSTagger.START[0]
+            for i in range(len(toks)):
+                feats = POSTagger.features(toks, i, prev, prev2)
+                guess = model.predict(feats)
+                model.update(tags[i], guess, feats)
+                prev2, prev = prev, tags[i]   # gold history (teacher forcing)
+    model.average()
+    model.save(os.path.join(resource_dir(), "pos.json.gz"))
+    print("pos tagger:", len(model.weights), "features")
+
+    # -- NER --------------------------------------------------------------
+    loc_words = LOCATIONS + [w for m in MULTI_LOCS for w in m.split()]
+    lexicons = {"first": FIRST_NAMES, "last": SURNAMES,
+                "orgsfx": ORG_SUFFIXES, "loc": loc_words,
+                "month": MONTHS}
+    ner_stub = NERTagger(AveragedPerceptron(), lexicons)
+    ner_classes = {t for _, _, ns in corpus for t in ns}
+    model = AveragedPerceptron(classes=sorted(ner_classes))
+    for _ in range(epochs):
+        rng.shuffle(data)
+        for toks, tags, bio in data:
+            prev = "O"
+            for i in range(len(toks)):
+                feats = ner_stub.features(toks, i, prev, tags)
+                guess = model.predict(feats)
+                model.update(bio[i], guess, feats)
+                prev = bio[i]
+    model.average()
+    model.save(os.path.join(resource_dir(), "ner.json.gz"),
+               extra={"lexicons": lexicons})
+    print("ner tagger:", len(model.weights), "features")
+
+    # -- sentence splitter ------------------------------------------------
+    # documents: sentences joined, with abbreviation/decimal/initials noise
+    docs = []
+    for _ in range(2500):
+        n = rng.randint(2, 5)
+        parts, bounds = [], []
+        for _ in range(n):
+            toks, _, _ = gen_sentence(rng)
+            body = toks[:-1]
+            if rng.random() < 0.5:
+                pos_j = rng.randint(0, max(len(body) - 1, 0))
+                body.insert(pos_j, rng.choice(ABBREVS))
+            if rng.random() < 0.3:
+                body.insert(rng.randint(0, max(len(body) - 1, 0)),
+                            f"{rng.randint(1, 99)}.{rng.randint(0, 99)}")
+            sent = " ".join(body) + toks[-1]
+            parts.append(sent)
+        text = " ".join(parts)
+        # boundary positions = ends of each part
+        off, marks = 0, set()
+        for p in parts:
+            off += len(p)
+            marks.add(off - 1)
+            off += 1
+        docs.append((text, marks))
+    model = AveragedPerceptron(classes=["0", "1"])
+    for _ in range(epochs):
+        rng.shuffle(docs)
+        for text, marks in docs:
+            for i, ch in enumerate(text):
+                if ch not in SentenceSplitter.CANDIDATES:
+                    continue
+                if i + 1 < len(text) and not text[i + 1].isspace():
+                    continue
+                feats = SentenceSplitter.features(text, i)
+                truth = "1" if i in marks else "0"
+                guess = model.predict(feats)
+                model.update(truth, guess, feats)
+    model.average()
+    model.save(os.path.join(resource_dir(), "sent.json.gz"))
+    print("sentence splitter:", len(model.weights), "features")
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,7 @@ from .indexers import (OpStringIndexerNoFilter, OpStringIndexerModel,  # noqa: F
                        PredictionDeIndexerModel)
 from .text_suite import (OpCountVectorizer, CountVectorizerModel,  # noqa: F401
                          NGramSimilarity, EmailParser, PhoneNumberParser,
-                         UrlParser, MimeTypeDetector)
+                         UrlParser, MimeTypeDetector, NameEntityRecognizer,
+                         OpSentenceSplitter, OpPOSTagger)
 from .collections import (OPMapTransformer, OPListTransformer,  # noqa: F401
                           OPSetTransformer, lift_to_collection)

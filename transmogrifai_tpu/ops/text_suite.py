@@ -404,33 +404,60 @@ class LanguageDetector(Transformer):
 
 @register_stage
 class NameEntityRecognizer(Transformer):
-    """Text → MultiPickList of detected proper-noun spans.
+    """Text → MultiPickList of detected entity spans.
 
     The reference tags tokens with OpenNLP's pretrained NER models
-    (``NameEntityRecognizer.scala:1``, binaries under ``models/``).
-    Shipping those binaries isn't possible here, so this is a documented
-    table-driven heuristic with the same stage interface: runs of
-    capitalized tokens (ignoring sentence-initial position and a stopword
-    table) become entity spans. Swap in a real tagger by overriding
-    ``tag_sentence``.
+    (``NameEntityRecognizer.scala:1``, binaries under ``models/``). This
+    build vendors its own learned weights the same way: an averaged-
+    perceptron BIO tagger (PER/ORG/LOC, lexicon + shape + context
+    features; trained offline by ``tools/train_taggers.py``, weights
+    under ``resources/taggers/``) — see ``utils/taggers.py`` for the
+    model and its training-data provenance. ``entity_types`` filters the
+    emitted spans (None → all). If the weight resources are missing the
+    stage degrades to the round-2 capitalized-run heuristic. Override
+    ``tag_sentence`` to swap in another tagger.
     """
 
     operation_name = "ner"
     output_type = MultiPickList
 
-    def __init__(self, min_span_tokens: int = 1, uid: Optional[str] = None):
+    def __init__(self, min_span_tokens: int = 1,
+                 entity_types: Optional[List[str]] = None,
+                 uid: Optional[str] = None):
         super().__init__(uid=uid)
         self.min_span_tokens = min_span_tokens
+        self.entity_types = entity_types
 
     @property
     def input_spec(self) -> InputSpec:
         return FixedArity(Text)
 
     def tag_sentence(self, tokens: List[str]) -> List[str]:
-        """→ entity spans found in one sentence's tokens. The sentence's
-        first token is always skipped: sentence-initial capitalization is
-        ambiguous, so a leading name loses its first word (documented
-        heuristic limitation)."""
+        """→ entity spans found in one sentence's tokens (model-based;
+        heuristic fallback documented above)."""
+        from ..utils.taggers import load_tagger
+        ner = load_tagger("ner")
+        if ner is not None:
+            pos_tagger = load_tagger("pos")
+            pos = pos_tagger.tag(tokens) if pos_tagger else None
+            spans = ner.spans(tokens, ner.tag(tokens, pos))
+            return [text for text, etype in spans
+                    if (self.entity_types is None
+                        or etype in self.entity_types)
+                    and len(text.split()) >= self.min_span_tokens]
+        if self.entity_types is not None \
+                and not getattr(self, "_warned_types", False):
+            self._warned_types = True
+            import logging
+            logging.getLogger(__name__).warning(
+                "NameEntityRecognizer %s: entity_types filter requires "
+                "the vendored NER weights (missing) — the heuristic "
+                "fallback returns UNTYPED spans unfiltered", self.uid)
+        return self._heuristic_spans(tokens)
+
+    def _heuristic_spans(self, tokens: List[str]) -> List[str]:
+        """Capitalized-run fallback (skips the ambiguous sentence-initial
+        token) — only used when the vendored weights are absent."""
         spans: List[str] = []
         run: List[str] = []
         for i, tok in enumerate(tokens):
@@ -456,10 +483,93 @@ class NameEntityRecognizer(Transformer):
                 out.append(set())
                 continue
             ents: set = set()
-            for sent in _SENT_SPLIT.split(v):
-                ents.update(self.tag_sentence(sent.split()))
+            for sent in split_sentences(v):
+                ents.update(self.tag_sentence(_ner_tokenize(sent)))
             out.append(ents)
         return TextSetColumn(MultiPickList, out)
+
+
+#: light word tokenizer for tagging: splits trailing/leading punctuation
+#: into their own tokens while keeping internal dots/apostrophes/hyphens
+#: ("U.S.", "3.5", "O'Brien", "state-of-the-art") together
+_NER_TOK = re.compile(r"[A-Za-z0-9]+(?:['’.\-][A-Za-z0-9]+)*|[^\sA-Za-z0-9]")
+
+
+def _ner_tokenize(sent: str) -> List[str]:
+    return _NER_TOK.findall(sent)
+
+
+def split_sentences(text: str) -> List[str]:
+    """Model-based sentence splitting (``OpenNLPSentenceSplitter.scala:1``
+    analog); regex fallback when the vendored weights are absent."""
+    from ..utils.taggers import load_tagger
+    splitter = load_tagger("sent")
+    if splitter is not None:
+        return splitter.split(text)
+    return [s for s in _SENT_SPLIT.split(text) if s]
+
+
+@register_stage
+class OpSentenceSplitter(Transformer):
+    """Text → TextList of sentences (the reference's ``SentenceSplitter``
+    interface backed by OpenNLP's ``en-sent`` model; here an averaged-
+    perceptron boundary classifier over punctuation contexts —
+    abbreviations, initials and decimals stay inside their sentence).
+    Weights vendored by ``tools/train_taggers.py``."""
+
+    operation_name = "sentSplit"
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(Text)
+
+    @property
+    def output_type(self):
+        from ..types.feature_types import TextList
+        return TextList
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        from ..columns import TextListColumn
+        col = store[self.input_features[0].name]
+        rows = [split_sentences(str(v)) if v else []
+                for v in col.values]
+        return TextListColumn(self.output_type, rows)
+
+
+@register_stage
+class OpPOSTagger(Transformer):
+    """Text → TextList of "token/TAG" pairs (OpenNLP POSTagger analog —
+    the reference vendors ``en-pos-maxent.bin``; here the vendored
+    averaged-perceptron tagger, see ``utils/taggers.py``)."""
+
+    operation_name = "posTag"
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(Text)
+
+    @property
+    def output_type(self):
+        from ..types.feature_types import TextList
+        return TextList
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        from ..columns import TextListColumn
+        from ..utils.taggers import load_tagger
+        tagger = load_tagger("pos")
+        rows = []
+        col = store[self.input_features[0].name]
+        for v in col.values:
+            if not v:
+                rows.append([])
+                continue
+            # same tokenization the model was trained on (punctuation as
+            # its own token) — whitespace splitting would feed it unseen
+            # "word." forms
+            toks = _ner_tokenize(str(v))
+            tags = tagger.tag(toks) if tagger else ["UNK"] * len(toks)
+            rows.append([f"{t}/{g}" for t, g in zip(toks, tags)])
+        return TextListColumn(self.output_type, rows)
 
 
 @register_stage
