@@ -134,3 +134,45 @@ def test_dsl_defaults_match_estimator_defaults():
     lda = t2.lda()
     assert lda.origin_stage.n_topics == \
         inspect.signature(OpLDA.__init__).parameters["n_topics"].default
+
+
+def test_rich_list_tf_tfidf_ngram_stopwords():
+    """RichListFeature long tail (RichListFeature.scala:59-186): tf /
+    tfidf / ngram / removeStopWords through the DSL."""
+    docs = [["the", "cat", "sat"], ["the", "dog", "sat", "still"],
+            ["a", "cat"], []]
+    store = ColumnStore.from_dict({"t": (ft.TextList, docs)})
+
+    t = FeatureBuilder.TextList("t").from_column().as_predictor()
+    cleaned = t.remove_stop_words()
+    grams = t.ngram(2)
+    tfv = t.tf(num_terms=32)
+    tfidf = t.tfidf(num_terms=32)
+    model, out = _train(store, cleaned, grams, tfv, tfidf)
+
+    assert out[cleaned.name].get_raw(0) == ["cat", "sat"]  # "the" dropped
+    assert out[grams.name].get_raw(0) == ["the cat", "cat sat"]
+    assert out[grams.name].get_raw(3) == []
+    tf_row0 = np.asarray(out[tfv.name].values[0])
+    assert tf_row0.sum() == 3.0                  # one bucket hit per token
+    # tf-idf: a term present in EVERY doc ("sat" rows 0,1) scales below a
+    # rarer term's weight; all-zero row stays zero
+    assert np.asarray(out[tfidf.name].values[3]).sum() == 0.0
+
+
+def test_rich_set_jaccard_and_pivot():
+    """RichSetFeature (RichSetFeature.scala:65-142): MultiPickList pivot
+    via vectorize + jaccardSimilarity."""
+    a = [{"x", "y"}, {"x"}, set()]
+    b = [{"x", "y"}, {"z"}, set()]
+    store = ColumnStore.from_dict({"a": (ft.MultiPickList, a),
+                                   "b": (ft.MultiPickList, b)})
+    fa = FeatureBuilder.MultiPickList("a").from_column().as_predictor()
+    fb = FeatureBuilder.MultiPickList("b").from_column().as_predictor()
+    sim = fa.jaccard_similarity(fb)
+    vec = fa.vectorize(top_k=5, min_support=1)
+    model, out = _train(store, sim, vec)
+    got = [float(out[sim.name].get_raw(i)) for i in range(3)]
+    assert got[0] == 1.0 and got[1] == 0.0 and got[2] == 1.0
+    cols = out[vec.name].metadata.columns
+    assert any(c.indicator_value == "x" for c in cols)

@@ -107,3 +107,26 @@ def test_pos_tagger_basic_accuracy():
     gold = ["DT", "JJ", "NN", "VBD", "DT", "JJ", "NN", "IN", "NNP", "."]
     acc = np.mean([t == g for t, g in zip(tags, gold)])
     assert acc >= 0.8, list(zip(toks, tags))
+
+
+def test_ner_production_path_with_honorifics():
+    """The PRODUCTION tokenization path (split_sentences + _ner_tokenize
+    inside transform_columns) must not emit honorific titles as entities
+    — a train/inference tokenization mismatch did exactly that."""
+    from transmogrifai_tpu import FeatureBuilder
+
+    store = ColumnStore({
+        "t": column_from_values(ft.Text, [
+            "Dr. Smith met Maria Garcia in Paris.",
+            "Mr. Jones visited Wayne Industries near Toronto."]),
+    })
+    t = FeatureBuilder.Text("t").from_column().as_predictor()
+    stage = NameEntityRecognizer().set_input(t)
+    out = stage.transform_columns(store)
+    for i in range(2):
+        ents = out.values[i]
+        assert not any(e in {"Dr", "Mr", "Mrs", "Ms", "Prof"}
+                       for e in ents), ents
+    assert "Maria Garcia" in out.values[0]
+    assert "Smith" in out.values[0]
+    assert "Wayne Industries" in out.values[1]

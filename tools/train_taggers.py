@@ -132,8 +132,12 @@ def gen_sentence(rng: random.Random):
 
     def person():
         if rng.random() < 0.15:
-            # honorific titles precede the name and are NOT part of it
-            add([rng.choice(["Dr.", "Mr.", "Mrs.", "Ms.", "Prof."])], "NNP")
+            # honorific titles precede the name and are NOT part of it.
+            # Emitted as TWO tokens ("Dr" ".") — the production
+            # tokenizer (_ner_tokenize) splits trailing periods, and the
+            # model must train on the token shapes it will see
+            add([rng.choice(["Dr", "Mr", "Mrs", "Ms", "Prof"])], "NNP")
+            add(["."], ".")
             add([_cap(rng.choice(SURNAMES))], "NNP", "PER")
             return
         parts = [_cap(rng.choice(FIRST_NAMES))]

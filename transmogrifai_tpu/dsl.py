@@ -391,6 +391,50 @@ def _word2vec(self: Feature, **kw):
     return self.transform_with(OpWord2Vec(**kw))
 
 
+def _tf(self: Feature, num_terms: int = 512, binary: bool = False):
+    """TextList → hashed term-frequency OPVector
+    (RichListFeature.tf :59)."""
+    from .ops.list_ops import OpHashingTF
+    return self.transform_with(OpHashingTF(num_terms=num_terms,
+                                           binary=binary))
+
+
+def _idf(self: Feature, min_doc_freq: int = 0):
+    """OPVector → IDF-scaled OPVector (Spark IDF wrap)."""
+    from .ops.list_ops import OpIDF
+    return self.transform_with(OpIDF(min_doc_freq=min_doc_freq))
+
+
+def _tfidf(self: Feature, num_terms: int = 512, binary: bool = False,
+           min_doc_freq: int = 0):
+    """TextList → TF-IDF OPVector (RichListFeature.tfidf :76)."""
+    return _idf(_tf(self, num_terms=num_terms, binary=binary),
+                min_doc_freq=min_doc_freq)
+
+
+def _ngram(self: Feature, n: int = 2):
+    """TextList → TextList of space-joined n-grams
+    (RichListFeature.ngram :153)."""
+    from .ops.list_ops import OpNGram
+    return self.transform_with(OpNGram(n=n))
+
+
+def _remove_stop_words(self: Feature, stop_words=None,
+                       case_sensitive: bool = False):
+    """TextList → TextList without stop words
+    (RichListFeature.removeStopWords :168)."""
+    from .ops.list_ops import OpStopWordsRemover
+    return self.transform_with(OpStopWordsRemover(
+        stop_words=stop_words, case_sensitive=case_sensitive))
+
+
+def _jaccard_similarity(self: Feature, other: Feature):
+    """(MultiPickList, MultiPickList) → RealNN Jaccard overlap
+    (RichSetFeature.jaccardSimilarity :124)."""
+    from .ops.list_ops import JaccardSimilarity
+    return self.transform_with(JaccardSimilarity(), other)
+
+
 def _indexed(self: Feature, **kw):
     from .ops.indexers import OpStringIndexerNoFilter
     return self.transform_with(OpStringIndexerNoFilter(**kw))
@@ -629,6 +673,12 @@ Feature.combine = _combine
 Feature.to_percentile = _to_percentile
 Feature.lda = _lda
 Feature.word2vec = _word2vec
+Feature.tf = _tf
+Feature.idf = _idf
+Feature.tfidf = _tfidf
+Feature.ngram = _ngram
+Feature.remove_stop_words = _remove_stop_words
+Feature.jaccard_similarity = _jaccard_similarity
 Feature.filter_keys = _filter_keys
 Feature.extract_key = _extract_key
 Feature.vectorize = _vectorize

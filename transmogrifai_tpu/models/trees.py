@@ -210,6 +210,14 @@ class _TreeFamilyBase(ModelFamily):
         from ._pallas_hist import pallas_histograms_enabled
         return (("__pallas__", pallas_histograms_enabled()),)
 
+    def _cache_bytes_per_row(self) -> int:
+        """Per-row bytes of fit-time prediction caches an in-flight
+        instance holds (budget input for _auto_chunks): RF keeps the
+        [T, n] train-node routing, boosting one [n] margin."""
+        if self._head() == "rf":
+            return 4 * self._static_trees()
+        return 4
+
     def _fit_single(self, X, y, w, depth: int, n_trees: int,
                     traced: Dict[str, Any], prebinned=None,
                     unroll: bool = False) -> Dict[str, Any]:

@@ -338,16 +338,24 @@ def _auto_chunks(family, n_rows: int, n_shards: int, n_folds: int,
     n_bins = getattr(family, "n_bins", 32)
     C_est = max(getattr(family, "n_classes", 2) + 1, 4)
     from ._pallas_hist import pallas_histograms_enabled
+    cache_bpr = 0
+    try:
+        cache_bpr = int(family._cache_bytes_per_row())
+    except Exception:
+        pass
     if pallas_histograms_enabled():
         # prebinned + fused-kernel path (round 4): the [n, A] routing
         # tensors and the NS/Bc matmul operands never hit HBM, so an
         # in-flight instance carries only its [n] slot/g/margin vectors,
-        # [n, C] stats, the per-chunk bootstrap draw, and the K-major
-        # train-predict gather chunk (~64 MB cap in trees.predict_batch)
-        per_instance = rows * (24 + 4 * C_est) + 96e6
+        # [n, C] stats, the per-chunk bootstrap draw, its fit-time
+        # prediction caches (RF: [T, n] train-node routing — 1.9 GB per
+        # fold at 9M rows, undercounting it OOMed the 10M config), and
+        # the K-major train-predict gather chunk (~64 MB cap)
+        per_instance = rows * (24 + 4 * C_est + cache_bpr) + 96e6
     else:
         per_instance = rows * A * 4 * 3 \
-            + rows * (A * C_est + n_bins * max(n_features, 1)) * 2
+            + rows * (A * C_est + n_bins * max(n_features, 1)) * 2 \
+            + rows * cache_bpr
     max_instances = max(int(CHUNK_MEM_BUDGET_BYTES // per_instance), 1)
     g = family.grid_size()
     if getattr(family, "tree_chunk", 1) is None:
@@ -687,15 +695,37 @@ class _ValidatorBase:
             import concurrent.futures as cf
             import time as _time
             tc0 = _time.time()
-            logger.info("compiling %d fused fit+predict+metric program(s) "
-                        "concurrently", len(to_compile))
-            with cf.ThreadPoolExecutor(len(to_compile)) as ex:
+            # concurrency shrinks with row count: at 10M-row shapes, 8
+            # parallel compiles crashed the (remote) compile service
+            workers = max(1, min(len(to_compile),
+                                 int(24_000_000 // max(len(y), 1)) or 1))
+            logger.info("compiling %d fused fit+predict+metric program(s), "
+                        "%d concurrent", len(to_compile), workers)
+
+            def compile_one(jf, x, w, v, st):
+                try:
+                    return jf.lower(x, yd, w, v, st).compile()
+                except Exception as e:
+                    # one retry for transient compile-SERVICE failures
+                    # only — deterministic XLA errors routinely mention
+                    # while-"body" computations, so match the service's
+                    # specific signatures, not loose substrings
+                    txt = repr(e).lower()
+                    if not any(s in txt for s in
+                               ("remote_compile", "response body closed",
+                                "http 5", "connection reset",
+                                "connection refused")):
+                        raise
+                    logger.warning("compile failed (%r); retrying once",
+                                   str(e)[:200])
+                    _time.sleep(5.0)
+                    return jf.lower(x, yd, w, v, st).compile()
+            with cf.ThreadPoolExecutor(workers) as ex:
                 futs = []
                 for fi, ek, key, jf, st in to_compile:
                     fc, chunks = plans[fi]
                     futs.append((fi, ek, key, ex.submit(
-                        lambda jf=jf, x=xargs[fi], w=wd[:fc], v=vwd[:fc],
-                        st=st: jf.lower(x, yd, w, v, st).compile())))
+                        compile_one, jf, xargs[fi], wd[:fc], vwd[:fc], st)))
                 for fi, ek, key, fut in futs:
                     exe = fut.result()
                     fused[fi][ek] = exe
@@ -703,8 +733,7 @@ class _ValidatorBase:
                         _FUSED_EXE_CACHE.pop(
                             next(iter(_FUSED_EXE_CACHE)))   # FIFO evict
                     _FUSED_EXE_CACHE[key] = exe
-            logger.info("compile phase done in %.2fs (max over families, "
-                        "not sum — concurrent)", _time.time() - tc0)
+            logger.info("compile phase done in %.2fs", _time.time() - tc0)
 
         # dispatch every chunk of every family FIRST (async — the device
         # queues them back-to-back), then ONE batched metrics pull: per-
