@@ -42,7 +42,17 @@ def make_records(n=4000, seed=7):
                 int(rng.integers(0, 3))]
         churn = float((usage.get("phone", 0) > 4)
                       or (attrs["plan"] == "free" and rng.random() < 0.4))
-        recs.append({"usage": usage, "attrs": attrs, "churned": churn})
+        # churners complain: their notes carry "cancel"-flavored terms
+        terms = (["cancel", "refund", "slow"] if churn and rng.random() < .8
+                 else ["thanks", "great", "question"])
+        note = ["the", "customer", "said"] + [
+            str(rng.choice(terms)) for _ in range(3)]
+        wants = {str(rng.choice(["api", "sso", "export", "audit"]))
+                 for _ in range(int(rng.integers(1, 3)))}
+        has = {str(rng.choice(["api", "sso", "export"]))
+               for _ in range(int(rng.integers(1, 3)))}
+        recs.append({"usage": usage, "attrs": attrs, "churned": churn,
+                     "note": note, "wants": wants, "has": has})
     return recs
 
 
@@ -52,11 +62,17 @@ def run(n=4000, seed=7):
         "usage": (ft.RealMap, [r["usage"] for r in recs]),
         "attrs": (ft.TextMap, [r["attrs"] for r in recs]),
         "churned": (ft.RealNN, [r["churned"] for r in recs]),
+        "note": (ft.TextList, [r["note"] for r in recs]),
+        "wants": (ft.MultiPickList, [r["wants"] for r in recs]),
+        "has": (ft.MultiPickList, [r["has"] for r in recs]),
     })
 
     churned = FeatureBuilder.RealNN("churned").from_column().as_response()
     usage = FeatureBuilder.RealMap("usage").from_column().as_predictor()
     attrs = FeatureBuilder.TextMap("attrs").from_column().as_predictor()
+    note = FeatureBuilder.TextList("note").from_column().as_predictor()
+    wants = FeatureBuilder.MultiPickList("wants").from_column().as_predictor()
+    has = FeatureBuilder.MultiPickList("has").from_column().as_predictor()
 
     # RichMapFeature surface: blacklist the leaky key, pivot the rest
     usage_vec = usage.vectorize(block_keys=["internal_audit"])
@@ -65,8 +81,13 @@ def run(n=4000, seed=7):
     attrs_vec = attrs.smart_vectorize(max_cardinality=10, num_features=64)
     # label-aware bucketing of one numeric key
     phone_buckets = usage.extract_key("phone").auto_bucketize(churned)
+    # RichListFeature surface: stop-word removal → TF-IDF of the notes
+    note_vec = note.remove_stop_words().tfidf(num_terms=32)
+    # RichSetFeature surface: requested-vs-owned feature overlap
+    fit_score = wants.jaccard_similarity(has)
 
-    features = transmogrify([usage_vec, attrs_vec, phone_buckets])
+    features = transmogrify([usage_vec, attrs_vec, phone_buckets,
+                             note_vec, fit_score])
     selector = BinaryClassificationModelSelector.with_cross_validation(
         num_folds=3, families=[LogisticRegressionFamily()], seed=seed)
     pred = churned.transform_with(selector, features)

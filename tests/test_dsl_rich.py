@@ -176,3 +176,45 @@ def test_rich_set_jaccard_and_pivot():
     assert got[0] == 1.0 and got[1] == 0.0 and got[2] == 1.0
     cols = out[vec.name].metadata.columns
     assert any(c.indicator_value == "x" for c in cols)
+
+
+def test_rich_numeric_unary_math_and_scaling():
+    """RichNumericFeature unary tail (abs/ceil/floor/round/exp/log/sqrt/
+    power) + scale/descale (ScalerTransformer.scala)."""
+    store = ColumnStore.from_dict({
+        "x": (ft.Real, [4.0, -2.25, None, 0.0])})
+    x = FeatureBuilder.Real("x").from_column().as_predictor()
+    feats = [x.abs(), x.ceil(), x.floor(), x.round_to(1), x.sqrt(),
+             x.log(), x.power(2.0), x.exp()]
+    sc = x.scaled(slope=2.0, intercept=1.0)
+    de = sc.descaled(sc)      # a value in scaled space, inverted back
+    model, out = _train(store, *feats, sc, de)
+    g = lambda f, i: out[f.name].get_raw(i)
+    assert g(feats[0], 1) == 2.25            # abs
+    assert g(feats[1], 1) == -2.0            # ceil
+    assert g(feats[2], 1) == -3.0            # floor
+    assert g(feats[3], 1) == -2.2            # round
+    assert g(feats[4], 0) == 2.0             # sqrt(4)
+    assert g(feats[4], 1) is None            # sqrt(-2.25) -> null
+    assert abs(g(feats[5], 0) - np.log(4.0)) < 1e-12
+    assert g(feats[5], 3) is None            # log(0) -> null
+    assert g(feats[6], 1) == 2.25 ** 2       # power
+    assert g(feats[0], 2) is None            # null propagates
+    assert g(sc, 0) == 9.0                   # 2x+1
+    assert g(de, 0) == 4.0                   # descale round-trips
+
+
+def test_rich_numeric_isotonic_calibration():
+    rng = np.random.default_rng(0)
+    n = 300
+    score = np.sort(rng.random(n))
+    y = (rng.random(n) < score).astype(float)   # monotone in score
+    store = ColumnStore.from_dict({
+        "y": (ft.RealNN, y.tolist()), "s": (ft.Real, score.tolist())})
+    ybl = FeatureBuilder.RealNN("y").from_column().as_response()
+    s = FeatureBuilder.Real("s").from_column().as_predictor()
+    cal = s.to_isotonic_calibrated(ybl)
+    model, out = _train(store, cal)
+    vals = np.asarray([out[cal.name].get_raw(i) for i in range(n)], float)
+    assert np.all(np.diff(vals) >= -1e-9)       # monotone output
+    assert 0.0 <= vals.min() and vals.max() <= 1.0

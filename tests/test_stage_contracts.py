@@ -442,6 +442,65 @@ def _cases():
         return stage, feats, store
     cases["OpWord2Vec"] = w2v_case
 
+    from transmogrifai_tpu.ops.list_ops import (JaccardSimilarity,
+                                                OpHashingTF, OpIDF,
+                                                OpNGram, OpStopWordsRemover)
+
+    def _textlist_case(mk):
+        def case():
+            stage = mk()
+            feats = [_f("a", ft.TextList)]
+            store = ColumnStore({"a": RandomData.text_lists(max_len=6)
+                                 .column(ft.TextList, N)})
+            return stage, feats, store
+        return case
+    cases["OpHashingTF"] = _textlist_case(
+        lambda: OpHashingTF(num_terms=16))
+    cases["OpNGram"] = _textlist_case(lambda: OpNGram(n=2))
+    cases["OpStopWordsRemover"] = _textlist_case(OpStopWordsRemover)
+
+    def idf_case():
+        stage = OpIDF(min_doc_freq=1)
+        feats = [_f("a", ft.OPVector)]
+        store = ColumnStore({"a": RandomData.vectors(dim=6)
+                             .column(ft.OPVector, N)})
+        return stage, feats, store
+    cases["OpIDF"] = idf_case
+
+    def jaccard_case():
+        stage = JaccardSimilarity()
+        feats = [_f("a", ft.MultiPickList), _f("b", ft.MultiPickList)]
+        store = ColumnStore({
+            "a": RandomData.multi_picklists().column(ft.MultiPickList, N),
+            "b": RandomData.multi_picklists().column(ft.MultiPickList, N)})
+        return stage, feats, store
+    cases["JaccardSimilarity"] = jaccard_case
+
+    from transmogrifai_tpu.dsl import MathUnaryTransformer
+    from transmogrifai_tpu.ops.text_suite import (OpPOSTagger,
+                                                  OpSentenceSplitter)
+
+    def unary_math_case():
+        stage = MathUnaryTransformer(op="abs")
+        feats = [_f("a", ft.Real)]
+        store = ColumnStore({"a": RandomData.reals().with_prob_empty(0.2)
+                             .column(ft.Real, N)})
+        return stage, feats, store
+    cases["MathUnaryTransformer"] = unary_math_case
+
+    def _text_case(mk):
+        def case():
+            stage = mk()
+            feats = [_f("a", ft.Text)]
+            vals = ["Dr. Lee met Anna Cole in Paris. They left early.",
+                    "the quick brown fox", None, "Acme Corp shipped it."
+                    ] * (N // 4)
+            store = ColumnStore({"a": column_from_values(ft.Text, vals)})
+            return stage, feats, store
+        return case
+    cases["OpSentenceSplitter"] = _text_case(OpSentenceSplitter)
+    cases["OpPOSTagger"] = _text_case(OpPOSTagger)
+
     # indexers --------------------------------------------------------------
     def indexer_case():
         stage = OpStringIndexerNoFilter()
@@ -516,7 +575,7 @@ _PRODUCED = {
     "StandardScalerModel", "LogisticRegressionModel", "LinearRegressionModel",
     "NaiveBayesModel", "LinearSVCModel", "MLPModel", "TreeEnsembleModel",
     "OpStringIndexerModel", "CountVectorizerModel", "GLMRegressionModel",
-    "LDAModel", "Word2VecModel",
+    "LDAModel", "Word2VecModel", "OpIDFModel",
 }
 
 

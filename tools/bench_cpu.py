@@ -36,13 +36,36 @@ def main() -> None:
     h = r["summary"].holdout_evaluation or {}
     out["titanic_AuPR"] = round(float(h.get("AuPR", 0.0)), 4)
 
-    synth_rows = int(os.environ.get("BENCH_CPU_SYNTH_ROWS", 200_000))
+    # the synthetic tree sweep is BRUTALLY slow on the CPU backend (the
+    # XLA fallback path, largely single-core — 100k rows exceeded 30
+    # minutes); run ONE pass at a small row count under an alarm so the
+    # titanic numbers always survive, and let the caller extrapolate
+    # (linearly — a conservative floor) or report the timeout as a bound
+    synth_rows = int(os.environ.get("BENCH_CPU_SYNTH_ROWS", 5_000))
+    budget_s = int(os.environ.get("BENCH_CPU_SYNTH_TIMEOUT_S", 900))
     if synth_rows > 0:
-        from synthetic_trees import run as run_synth
-        run_synth(n_rows=synth_rows, num_folds=3, seed=42)  # cold
-        r = run_synth(n_rows=synth_rows, num_folds=3, seed=42)
-        out["synth_rows"] = synth_rows
-        out["synth_warm_s"] = round(r["train_time_s"], 2)
+        import signal
+
+        class _Timeout(Exception):
+            pass
+
+        def _raise(*_a):
+            raise _Timeout()
+        signal.signal(signal.SIGALRM, _raise)
+        signal.alarm(budget_s)
+        try:
+            from synthetic_trees import run as run_synth
+            t0 = time.time()
+            r = run_synth(n_rows=synth_rows, num_folds=3, seed=42)
+            out["synth_rows"] = synth_rows
+            # single pass: includes CPU compile (small next to execution
+            # at these ratios); labeled accordingly
+            out["synth_s_incl_compile"] = round(r["train_time_s"], 2)
+        except _Timeout:
+            out["synth_rows"] = synth_rows
+            out["synth_timeout_s"] = budget_s
+        finally:
+            signal.alarm(0)
     print(json.dumps(out))
 
 

@@ -103,6 +103,49 @@ class MathScalarTransformer(Transformer):
 
 
 @register_stage
+class MathUnaryTransformer(Transformer):
+    """Unary numeric math (abs/ceil/floor/round/exp/log/sqrt/power —
+    ``RichNumericFeature.scala`` unary surface + ``MathTransformers``).
+    Domain violations (log of ≤0, sqrt of <0, non-finite results) null
+    the row, matching the reference's Option-returning transformers."""
+
+    output_type = ft.Real
+
+    def __init__(self, op: str = "abs", arg: float = 0.0,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        self.op = op
+        self.arg = float(arg)
+        self.operation_name = {
+            "abs": "abs", "ceil": "ceil", "floor": "floor",
+            "round": "round", "exp": "exp", "log": "logN",
+            "sqrt": "sqrt", "power": "power"}[op]
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(ft.OPNumeric)
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        a = _num_col(store, self.input_features[0])
+        av = a.values.astype(np.float64)
+        with np.errstate(divide="ignore", invalid="ignore", over="ignore"):
+            vals = {
+                "abs": lambda: np.abs(av),
+                "ceil": lambda: np.ceil(av),
+                "floor": lambda: np.floor(av),
+                "round": lambda: np.round(av, int(self.arg)),
+                "exp": lambda: np.exp(av),
+                # log base arg (reference log(base); default natural)
+                "log": lambda: (np.log(av) if self.arg in (0.0, np.e)
+                                else np.log(av) / np.log(self.arg)),
+                "sqrt": lambda: np.sqrt(av),
+                "power": lambda: np.power(av, self.arg),
+            }[self.op]()
+        mask = a.mask & np.isfinite(vals)
+        return NumericColumn(ft.Real, np.where(mask, vals, 0.0), mask)
+
+
+@register_stage
 class FillMissingWithMean(Estimator):
     """Real → RealNN imputing train mean (RichNumericFeature.fillMissingWithMean)."""
 
@@ -435,6 +478,38 @@ def _jaccard_similarity(self: Feature, other: Feature):
     return self.transform_with(JaccardSimilarity(), other)
 
 
+def _unary_math(op):
+    def method(self: Feature, arg: float = 0.0):
+        return self.transform_with(MathUnaryTransformer(op=op, arg=arg))
+    method.__name__ = f"_{op}"
+    method.__doc__ = (f"Numeric → Real {op} "
+                      "(RichNumericFeature unary math surface).")
+    return method
+
+
+def _scaled(self: Feature, scaling_type: str = "linear", **kw):
+    """Real → Real via ScalerTransformer (ScalerTransformer.scala);
+    ``descaled`` inverts using the recorded scaler metadata."""
+    from .ops.scalers import ScalerTransformer
+    return self.transform_with(ScalerTransformer(
+        scaling_type=scaling_type, **kw))
+
+
+def _descaled(self: Feature, scaled: "Feature", **kw):
+    from .ops.scalers import DescalerTransformer
+    return self.transform_with(DescalerTransformer(**kw), scaled)
+
+
+def _to_isotonic_calibrated(self: Feature, label: "Feature",
+                            isotonic: bool = True):
+    """RealNN score → isotonic-calibrated score
+    (RichNumericFeature.toIsotonicCalibrated →
+    IsotonicRegressionCalibrator.scala)."""
+    from .ops.calibrators import IsotonicRegressionCalibrator
+    return label.transform_with(
+        IsotonicRegressionCalibrator(isotonic=isotonic), self)
+
+
 def _indexed(self: Feature, **kw):
     from .ops.indexers import OpStringIndexerNoFilter
     return self.transform_with(OpStringIndexerNoFilter(**kw))
@@ -679,6 +754,17 @@ Feature.tfidf = _tfidf
 Feature.ngram = _ngram
 Feature.remove_stop_words = _remove_stop_words
 Feature.jaccard_similarity = _jaccard_similarity
+Feature.abs = _unary_math("abs")
+Feature.ceil = _unary_math("ceil")
+Feature.floor = _unary_math("floor")
+Feature.round_to = _unary_math("round")
+Feature.exp = _unary_math("exp")
+Feature.log = _unary_math("log")
+Feature.sqrt = _unary_math("sqrt")
+Feature.power = _unary_math("power")
+Feature.scaled = _scaled
+Feature.descaled = _descaled
+Feature.to_isotonic_calibrated = _to_isotonic_calibrated
 Feature.filter_keys = _filter_keys
 Feature.extract_key = _extract_key
 Feature.vectorize = _vectorize

@@ -17,3 +17,5 @@ from .text_suite import (OpCountVectorizer, CountVectorizerModel,  # noqa: F401
                          OpSentenceSplitter, OpPOSTagger)
 from .collections import (OPMapTransformer, OPListTransformer,  # noqa: F401
                           OPSetTransformer, lift_to_collection)
+from .list_ops import (OpHashingTF, OpIDF, OpIDFModel, OpNGram,  # noqa: F401
+                       OpStopWordsRemover, JaccardSimilarity)
