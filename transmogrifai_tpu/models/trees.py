@@ -218,6 +218,26 @@ class _TreeFamilyBase(ModelFamily):
             return 4 * self._static_trees()
         return 4
 
+    def analytic_flops(self, n_rows: int, n_features: int,
+                       static_depth=None) -> float:
+        """Estimated MXU FLOPs of ONE (fold, grid-point) fit — the
+        histogram dots run inside Pallas custom calls, which XLA cost
+        analysis cannot see, so the MFU accounting (tuning.DEVICE_FLOPS)
+        adds this analytic term per dispatch. Dominant term only: per
+        tree per level, the [A_d·C, n] × [n, B·F] dot = 2·n·A_d·C·B·F
+        (mixed-bin col_blocks make B an upper bound; routing/predict
+        kernels are comparatively negligible)."""
+        D = int(static_depth) if static_depth else self.global_depth()
+        cap = max(2, min(self.max_active_nodes, 1 << max(D - 1, 1)))
+        a_sum = sum(min(1 << d, cap) for d in range(D))
+        T = self._static_trees()
+        return (2.0 * n_rows * a_sum * self._stat_channels()
+                * self.n_bins * n_features * T)
+
+    def _stat_channels(self) -> int:
+        # RF/DT: per-class weights + count (gini) or variance stats
+        return (self.n_classes + 1 if self.task == "classification" else 4)
+
     def _fit_single(self, X, y, w, depth: int, n_trees: int,
                     traced: Dict[str, Any], prebinned=None,
                     unroll: bool = False) -> Dict[str, Any]:
@@ -558,6 +578,9 @@ class GBTFamily(_TreeFamilyBase):
                 "minInfoGain": 0.001, "maxIter": self.max_iter,
                 "stepSize": 0.1}
 
+    def _stat_channels(self) -> int:
+        return 4                 # variance stats on residuals, any task
+
     def _head(self) -> str:
         return "gbt"
 
@@ -602,6 +625,9 @@ class XGBoostFamily(_TreeFamilyBase):
     def param_defaults(self):
         return {"maxDepth": 6, "eta": 0.3, "minChildWeight": 1.0,
                 "numRound": 100}
+
+    def _stat_channels(self) -> int:
+        return 3                 # (g, h, count), any task
 
     def _head(self) -> str:
         return "xgb"

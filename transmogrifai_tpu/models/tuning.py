@@ -449,12 +449,21 @@ def _register_exe_flops(exe) -> None:
         _EXE_FLOPS[id(exe)] = 0.0
 
 
-def _count_dispatch(exe) -> None:
+def _count_dispatch(exe, extra_flops: float = 0.0) -> None:
+    """Accumulate one dispatch's FLOPs: XLA cost analysis of the
+    executable PLUS ``extra_flops`` — the analytic estimate of work
+    inside Pallas custom calls, which cost analysis cannot see (without
+    it the round-4 kernel migration made the MFU numerator collapse)."""
     f = _EXE_FLOPS.get(id(exe))
     if f is None:
         _register_exe_flops(exe)
         f = _EXE_FLOPS[id(exe)]
-    DEVICE_FLOPS["total"] += f
+    DEVICE_FLOPS["total"] += f + extra_flops
+
+
+def _pallas_on() -> bool:
+    from ._pallas_hist import pallas_histograms_enabled
+    return pallas_histograms_enabled()
 
 
 _NO_CHUNK_ATTR = object()
@@ -744,11 +753,22 @@ class _ValidatorBase:
         fused_out: Dict[int, Any] = {}
         for fi in fused:
             fc, chunks = plans[fi]
+            fam = families[fi]
             outs = []
             for i0 in range(0, k_folds, fc):
                 for ix, st, sd in chunks:
                     exe = fused[fi][(len(ix), sd)]
-                    _count_dispatch(exe)
+                    kflops = 0.0
+                    if hasattr(fam, "analytic_flops") \
+                            and isinstance(xargs[fi], dict) \
+                            and _pallas_on():
+                        # kernel path only: histogram dots live inside
+                        # custom calls (invisible to cost analysis); on
+                        # the XLA path they ARE counted — adding the
+                        # analytic term there would double-count
+                        kflops = fc * len(ix) * fam.analytic_flops(
+                            len(y), X.shape[1], sd)
+                    _count_dispatch(exe, kflops)
                     outs.append(exe(xargs[fi], yd, wd[i0:i0 + fc],
                                     vwd[i0:i0 + fc], st))
             fused_out[fi] = outs
@@ -942,7 +962,11 @@ class _ValidatorBase:
                         _FUSED_EXE_CACHE[key] = exe
                     exe_by_width[gw] = exe
                 for gw, _st in zip(g_sizes, st_chunks):
-                    _count_dispatch(exe_by_width[gw])
+                    kflops = (gw * family.analytic_flops(len(y), X.shape[1])
+                              if hasattr(family, "analytic_flops")
+                              and isinstance(Xarg, dict)
+                              and _pallas_on() else 0.0)
+                    _count_dispatch(exe_by_width[gw], kflops)
                 outs = [exe_by_width[gw](Xarg, yd, wd, vwd, st)
                         for gw, st in zip(g_sizes, st_chunks)]
                 per_grid[:, ki] = np.concatenate(
