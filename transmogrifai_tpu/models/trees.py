@@ -224,15 +224,31 @@ class _TreeFamilyBase(ModelFamily):
         histogram dots run inside Pallas custom calls, which XLA cost
         analysis cannot see, so the MFU accounting (tuning.DEVICE_FLOPS)
         adds this analytic term per dispatch. Dominant term only: per
-        tree per level, the [A_d·C, n] × [n, B·F] dot = 2·n·A_d·C·B·F
-        (mixed-bin col_blocks make B an upper bound; routing/predict
-        kernels are comparatively negligible)."""
+        tree per level, the [A_d·C, n] × [n, Σ_b nb·F_b] dot — 2-bin
+        indicator blocks counted at their true width via binary_mask;
+        kernel lane padding excluded (unpadded n, errs low at small n);
+        routing/predict kernels are comparatively negligible."""
         D = int(static_depth) if static_depth else self.global_depth()
         cap = max(2, min(self.max_active_nodes, 1 << max(D - 1, 1)))
-        a_sum = sum(min(1 << d, cap) for d in range(D))
+        if static_depth:
+            # unrolled driver: per-level slot growth
+            a_sum = sum(min(1 << d, cap) for d in range(D))
+        else:
+            # scan driver: constant cap slots at every level
+            a_sum = cap * D
+        # mixed-bin col_blocks: indicator columns get 2-bin histograms
+        # (Titanic: 470 of 498 columns — treating them at n_bins
+        # overestimated the dispatched FLOPs ~9×)
+        bm = self.binary_mask
+        if bm is not None:
+            nb_bin = int(np.asarray(bm, bool).sum())
+            bin_feat = (self.n_bins * (n_features - nb_bin)
+                        + 2 * nb_bin)
+        else:
+            bin_feat = self.n_bins * n_features
         T = self._static_trees()
         return (2.0 * n_rows * a_sum * self._stat_channels()
-                * self.n_bins * n_features * T)
+                * bin_feat * T)
 
     def _stat_channels(self) -> int:
         # RF/DT: per-class weights + count (gini) or variance stats
