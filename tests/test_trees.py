@@ -272,3 +272,40 @@ def test_per_node_feature_subsampling(rng):
     used_node = len(np.unique(np.asarray(p_node["feat"])))
     used_tree = len(np.unique(np.asarray(p_tree["feat"])))
     assert used_node >= used_tree - 1, (used_node, used_tree)
+
+
+def test_sibling_subtraction_exact_parity(monkeypatch):
+    """The unrolled driver histograms only LEFT children and derives each
+    right sibling as parent − left (LightGBM's subtraction trick). In
+    f64 (the CPU test dtype) the subtraction is exact, so the grown
+    trees must match the scan driver's full-histogram build EXACTLY,
+    with and without the TMOG_SIBLING escape hatch."""
+    import jax.numpy as jnp
+
+    from transmogrifai_tpu.models import _treefit as TF
+
+    rng = np.random.default_rng(5)
+    n, F = 2500, 7
+    X = jnp.asarray(rng.normal(size=(n, F)))
+    y = jnp.asarray((rng.normal(size=n) + np.asarray(X)[:, 0] > 0)
+                    .astype(np.float64))
+    w = jnp.ones((n,))
+    kw = dict(task="classification", n_classes=2, n_trees=5, max_depth=5,
+              n_bins=16, min_instances=jnp.asarray(2.0),
+              min_info_gain=jnp.asarray(0.001),
+              num_trees_used=jnp.asarray(5.0),
+              subsample_rate=jnp.asarray(1.0), seed=5)
+    scan = TF.fit_forest(X, y, w, **kw)
+    pre = TF.prepare_bins(X, 16, None)
+    prebinned = (pre[0], pre[1], pre[2], False)
+    monkeypatch.delenv("TMOG_SIBLING", raising=False)
+    sib = TF.fit_forest(None, y, w, prebinned=prebinned, unroll=True, **kw)
+    monkeypatch.setenv("TMOG_SIBLING", "0")
+    nosib = TF.fit_forest(None, y, w, prebinned=prebinned, unroll=True,
+                          **kw)
+    for k in ("feat", "thr", "leaf", "train_node", "gain"):
+        np.testing.assert_allclose(np.asarray(scan[k]), np.asarray(sib[k]),
+                                   rtol=0, atol=1e-9)
+        np.testing.assert_allclose(np.asarray(sib[k]),
+                                   np.asarray(nosib[k]),
+                                   rtol=0, atol=1e-9)

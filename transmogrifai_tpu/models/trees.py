@@ -67,6 +67,14 @@ def _tree_rows(X) -> int:
     return X.shape[0]
 
 
+def _sibling_on() -> bool:
+    """Normalized sibling-subtraction gate (grow_tree's semantics:
+    anything but '0' enables) — keying the raw string would fragment the
+    executable cache across equivalent spellings."""
+    import os
+    return os.environ.get("TMOG_SIBLING", "1") != "0"
+
+
 def pad_rows_to(n_pad: int, *arrs):
     """Zero-pad leading (row) axis to ``n_pad`` — device_prep may have
     ROW_ALIGN-padded the binned matrix; y/weights/masks must follow.
@@ -205,10 +213,13 @@ class _TreeFamilyBase(ModelFamily):
     supports_static_depth = True
 
     def _trace_extras(self):
-        # the Pallas histogram gate changes the tree engine's emitted
-        # program, so it must key this family's executable cache entries
+        # trace-time toggles that change the tree engine's emitted
+        # program must key this family's executable cache entries
+        import os
+
         from ._pallas_hist import pallas_histograms_enabled
-        return (("__pallas__", pallas_histograms_enabled()),)
+        return (("__pallas__", pallas_histograms_enabled()),
+                ("__sibling__", _sibling_on()))
 
     def _cache_bytes_per_row(self) -> int:
         """Per-row bytes of fit-time prediction caches an in-flight
@@ -231,8 +242,13 @@ class _TreeFamilyBase(ModelFamily):
         D = int(static_depth) if static_depth else self.global_depth()
         cap = max(2, min(self.max_active_nodes, 1 << max(D - 1, 1)))
         if static_depth:
-            # unrolled driver: per-level slot growth
-            a_sum = sum(min(1 << d, cap) for d in range(D))
+            # unrolled driver: per-level slot growth; with sibling
+            # subtraction (the default) levels ≥ 1 histogram only the
+            # LEFT children — half the slots
+            cap -= cap % 2
+            scale = 0.5 if _sibling_on() else 1.0
+            a_sum = 1 + scale * sum(min(1 << d, cap)
+                                    for d in range(1, D))
         else:
             # scan driver: constant cap slots at every level
             a_sum = cap * D
