@@ -10,6 +10,9 @@
 
 #include <cstdint>
 #include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -85,6 +88,106 @@ void murmur3_bucket_batch(const char* blob, const int64_t* offsets,
                             seed);
     out[i] = static_cast<int64_t>(h % num_features);
   }
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Fused tokenize + hash + count scatter (ops/smart_text.py hashing path).
+//
+// The Python path at 300k rows spent ~10 s per transform in re.findall,
+// list plumbing and object-array uniques before the first hash; this
+// kernel streams each string once: maximal runs of [A-Za-z0-9_']
+// (the ASCII fast path of the tokenizer's [\w']+ with lower()) are
+// lowercased in place, murmur3-hashed and scattered straight into the
+// caller's [n, row_stride] f32 matrix. Any string containing a byte
+// >= 0x80 is flagged and left untouched so the caller can route just
+// those rows through the exact (unicode-aware) Python tokenizer.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline bool token_byte(uint8_t c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '_' || c == '\'';
+}
+
+void tokenize_rows(const uint8_t* base, const int64_t* offsets,
+                   int64_t row_begin, int64_t row_end, uint32_t seed,
+                   uint32_t num_features, int32_t min_token_len,
+                   int32_t binary_freq, float* out, int64_t row_stride,
+                   int64_t col_offset, uint8_t* flags) {
+  // token scratch: lowercased bytes (grown on demand for long tokens);
+  // std::vector, not basic_string<uint8_t> — char_traits<uint8_t> is a
+  // non-standard specialization libc++ rejects outright
+  std::vector<uint8_t> tok;
+  tok.reserve(64);
+  for (int64_t i = row_begin; i < row_end; i++) {
+    const uint8_t* s = base + offsets[i];
+    const int64_t len = offsets[i + 1] - offsets[i];
+    bool ascii = true;
+    for (int64_t j = 0; j < len; j++) {
+      if (s[j] >= 0x80) { ascii = false; break; }
+    }
+    if (!ascii) {
+      flags[i] = 1;  // caller re-does this row in Python (exact \w)
+      continue;
+    }
+    float* row = out + i * row_stride + col_offset;
+    int64_t j = 0;
+    while (j < len) {
+      while (j < len && !token_byte(s[j])) j++;
+      int64_t start = j;
+      while (j < len && token_byte(s[j])) j++;
+      if (j - start >= min_token_len) {
+        tok.clear();
+        for (int64_t k = start; k < j; k++) {
+          uint8_t c = s[k];
+          tok.push_back(c >= 'A' && c <= 'Z' ? c + 32 : c);
+        }
+        uint32_t h = murmur3_32(tok.data(),
+                                static_cast<int64_t>(tok.size()), seed);
+        uint32_t b = h % num_features;
+        if (binary_freq) {
+          row[b] = 1.0f;
+        } else {
+          row[b] += 1.0f;
+        }
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// See tokenize_rows. Threads split the row range; each writes disjoint
+// output rows, so the pass is race-free. n_threads <= 1 runs inline.
+void tokenized_hash_counts(const char* blob, const int64_t* offsets,
+                           int64_t n, uint32_t seed, uint32_t num_features,
+                           int32_t min_token_len, int32_t binary_freq,
+                           float* out, int64_t row_stride,
+                           int64_t col_offset, uint8_t* flags,
+                           int32_t n_threads) {
+  const uint8_t* base = reinterpret_cast<const uint8_t*>(blob);
+  if (n_threads <= 1 || n < 4096) {
+    tokenize_rows(base, offsets, 0, n, seed, num_features, min_token_len,
+                  binary_freq, out, row_stride, col_offset, flags);
+    return;
+  }
+  std::vector<std::thread> workers;
+  workers.reserve(n_threads);
+  const int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; t++) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = lo + chunk < n ? lo + chunk : n;
+    if (lo >= hi) break;
+    workers.emplace_back(tokenize_rows, base, offsets, lo, hi, seed,
+                         num_features, min_token_len, binary_freq, out,
+                         row_stride, col_offset, flags);
+  }
+  for (auto& w : workers) w.join();
 }
 
 }  // extern "C"

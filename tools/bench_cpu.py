@@ -5,15 +5,26 @@ needs a measured denominator for.
 
 Run as a SUBPROCESS from bench.py (the axon sitecustomize pins the jax
 platform at interpreter start, so the pin must be overridden before any
-backend init — env vars alone are ignored). Prints ONE JSON line:
+backend init — env vars alone are ignored). Prints a JSON line after
+EVERY completed stage (cumulative), so a caller that kills the process
+on a timeout still gets whatever finished:
 
     {"titanic_warm_s": ..., "titanic_AuPR": ...,
-     "synth_rows": N, "synth_warm_s": ...}
+     "synth_rows": N, "synth_s_incl_compile": ...}
 """
 import json
 import os
+import signal
 import sys
 import time
+
+
+class _Timeout(Exception):
+    pass
+
+
+def _raise(*_a):
+    raise _Timeout()
 
 
 def main() -> None:
@@ -26,32 +37,35 @@ def main() -> None:
     assert jax.default_backend() == "cpu", jax.default_backend()
 
     out = {"backend": "cpu", "cpu_count": os.cpu_count()}
+    signal.signal(signal.SIGALRM, _raise)
 
-    from titanic import run as run_titanic
-    run_titanic(num_folds=3, seed=42)                       # cold
-    t0 = time.time()
-    r = run_titanic(num_folds=3, seed=42)
-    out["titanic_warm_s"] = round(r["train_time_s"], 2)
-    out["titanic_total_warm_s"] = round(time.time() - t0, 2)
-    h = r["summary"].holdout_evaluation or {}
-    out["titanic_AuPR"] = round(float(h.get("AuPR", 0.0)), 4)
+    # titanic under its own alarm so a partial line always lands even if
+    # the CPU backend is slower than the caller's whole budget
+    tit_budget = int(os.environ.get("BENCH_CPU_TITANIC_TIMEOUT_S", 180))
+    signal.alarm(tit_budget)
+    try:
+        from titanic import run as run_titanic
+        run_titanic(num_folds=3, seed=42)                   # cold
+        t0 = time.time()
+        r = run_titanic(num_folds=3, seed=42)
+        out["titanic_warm_s"] = round(r["train_time_s"], 2)
+        out["titanic_total_warm_s"] = round(time.time() - t0, 2)
+        h = r["summary"].holdout_evaluation or {}
+        out["titanic_AuPR"] = round(float(h.get("AuPR", 0.0)), 4)
+    except _Timeout:
+        out["titanic_timeout_s"] = tit_budget
+    finally:
+        signal.alarm(0)
+    print(json.dumps(out), flush=True)
 
     # the synthetic tree sweep is BRUTALLY slow on the CPU backend (the
     # XLA fallback path, largely single-core — 100k rows exceeded 30
-    # minutes); run ONE pass at a small row count under an alarm so the
-    # titanic numbers always survive, and let the caller extrapolate
-    # (linearly — a conservative floor) or report the timeout as a bound
+    # minutes); run ONE pass at a small row count under an alarm and let
+    # the caller extrapolate (linearly — a conservative floor) or report
+    # the timeout as a bound
     synth_rows = int(os.environ.get("BENCH_CPU_SYNTH_ROWS", 5_000))
     budget_s = int(os.environ.get("BENCH_CPU_SYNTH_TIMEOUT_S", 900))
     if synth_rows > 0:
-        import signal
-
-        class _Timeout(Exception):
-            pass
-
-        def _raise(*_a):
-            raise _Timeout()
-        signal.signal(signal.SIGALRM, _raise)
         signal.alarm(budget_s)
         try:
             from synthetic_trees import run as run_synth
@@ -66,7 +80,7 @@ def main() -> None:
             out["synth_timeout_s"] = budget_s
         finally:
             signal.alarm(0)
-    print(json.dumps(out))
+        print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -159,12 +159,15 @@ def make_bc(XbT: jnp.ndarray, n_bins: int, dtype) -> jnp.ndarray:
 
 
 def bc_cache_ok(n: int, F: int, n_bins: int,
-                max_bytes: float = 3e9) -> bool:
+                max_bytes: float = 3e9, itemsize: int = 2) -> bool:
     """Precompute the bin indicator only when it fits comfortably in HBM
-    (2 bytes/entry) and a single feature chunk covers it (the chunked
-    layout interleaves (t, f) rows per chunk)."""
+    and a single feature chunk covers it (the chunked layout interleaves
+    (t, f) rows per chunk). ``itemsize`` must be the byte width of the
+    dtype ``make_bc`` will actually build (bf16 for f32 stats, else the
+    stats dtype — e.g. 8 on the f64 CPU/x64 path), or the budget check
+    undercounts the cached indicator (ADVICE r4)."""
     return (isinstance(n, int) and n_bins * F <= 1024
-            and 2.0 * n * n_bins * F <= max_bytes)
+            and float(itemsize) * n * n_bins * F <= max_bytes)
 
 
 def cumhist(stats: jnp.ndarray, node: jnp.ndarray, XbT: jnp.ndarray,
@@ -457,8 +460,10 @@ def predict_kernel_ok(n: int, F: int, max_depth: int, K: int,
     kernel path, everything else (tiny batches, very deep/wide models,
     huge ensembles, serving exports with symbolic batch dims) on the XLA
     gather path. The whole-table VMEM residency bounds T: feat/thr
-    [NN, T] ×2 + leaf [2^D, T·K] must stay a few MB (there is no pallas
-    fallback wrapper around predict, so the gate must be sufficient)."""
+    [NN, T] ×2 + leaf [2^D, T·K] must stay a few MB. A gate miss at
+    scoring time is no longer fatal — TreeEnsembleModel.predict_arrays
+    wraps the dispatch in with_pallas_fallback (ADVICE r4), so a Mosaic/
+    VMEM rejection at gate-passing shapes retraces onto the XLA path."""
     nn = (1 << max_depth) - 1
     table_bytes = 4 * (2 * nn * max(T, 1)
                        + (1 << max_depth) * max(T, 1) * max(K, 1))

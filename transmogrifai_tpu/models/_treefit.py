@@ -353,10 +353,16 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
         (even slots, half of A) are histogrammed and each right sibling
         is the parent minus the left (LightGBM's subtraction trick —
         children partition their parent's rows). Counts stay exact
-        (integer sums in an f32/f64 accumulator), weighted channels pick
-        up only accumulation-order rounding. Halves the dominant
-        histogram FLOPs; used by the unrolled driver (the scan driver
-        would pay the level-0 special case as a traced branch).
+        (integer sums in an f32/f64 accumulator); weighted channels pick
+        up accumulation-order rounding, and for strongly UNBALANCED
+        splits the parent's bf16-operand rounding can dominate a small
+        right child's weighted sums (ADVICE r4) — LightGBM refines this
+        by histogramming the smaller child directly, which needs a
+        data-dependent branch this static-shape jit deliberately avoids.
+        ``TMOG_SIBLING=0`` disables subtraction where that noise matters
+        more than the 2× histogram-FLOP saving. Used by the unrolled
+        driver (the scan driver would pay the level-0 special case as a
+        traced branch).
         """
         if node_feat_key is not None:
             # per-node candidate draw: exactly node_feat_k features per
@@ -693,7 +699,8 @@ def prepare_bins(X, n_bins, binary_mask=None):
     return Xb, edges, make_col_blocks(edges, n_bins, binary_mask)
 
 
-def prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, stats_dtype):
+def prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, stats_dtype,
+                   max_depth: Optional[int] = None):
     """(use_pallas, full matrix in the active orientation, blocks) —
     each block is (cols, bins, thr_fn, block matrix, bc|None).
 
@@ -704,6 +711,11 @@ def prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, stats_dtype):
     from ._pallas_hist import (bc_cache_ok, make_bc,
                                pallas_histograms_enabled)
     use_pallas = pallas_histograms_enabled()
+    if use_pallas and max_depth is not None and max_depth > 24:
+        # route_level carries the per-sample leaf path g in f32 lanes —
+        # exact only below 2^24. Spark allows maxDepth up to 30; deeper
+        # grids take the int32 XLA path instead of mis-routing (ADVICE r4).
+        use_pallas = False
     if use_pallas:
         Xmat = XbT if XbT is not None else Xb.T
         F, n = Xmat.shape
@@ -720,7 +732,9 @@ def prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, stats_dtype):
         if use_pallas:
             blk = Xmat[cols, :]
             bc = (make_bc(blk, nb, bc_dt)
-                  if bc_cache_ok(n, len(cols), nb) else None)
+                  if bc_cache_ok(n, len(cols), nb,
+                                 itemsize=jnp.dtype(bc_dt).itemsize)
+                  else None)
         else:
             blk = Xmat[:, cols]
             bc = None
@@ -782,7 +796,8 @@ def fit_forest(X, y, w, *, task: str, n_classes: int, n_trees: int,
         X, y, w, n_bins, binary_mask, prebinned)
     F = Xb.shape[1] if Xb is not None else XbT.shape[0]
     dt = w.dtype
-    prepared = prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, dt)
+    prepared = prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, dt,
+                              max_depth=max_depth)
     rate = jnp.broadcast_to(jnp.asarray(subsample_rate, jnp.float32), ())
     per_node = False
     feat_k = F
@@ -873,7 +888,8 @@ def fit_gbt(X, y, w, *, task: str, n_rounds: int, max_depth: int,
     Xb, XbT, edges, col_blocks, n, y, w = _resolve_prebinned(
         X, y, w, n_bins, binary_mask, prebinned)
     dt = w.dtype
-    prepared = prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, dt)
+    prepared = prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, dt,
+                              max_depth=max_depth)
     ypm = 2.0 * y - 1.0
 
     def residual(Fm):
@@ -917,7 +933,8 @@ def fit_xgb(X, y, w, *, task: str, n_rounds: int, max_depth: int,
     Xb, XbT, edges, col_blocks, n, y, w = _resolve_prebinned(
         X, y, w, n_bins, binary_mask, prebinned)
     dt = w.dtype
-    prepared = prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, dt)
+    prepared = prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, dt,
+                              max_depth=max_depth)
     crit = XGBCriterion(lam, min_child_weight)
     leaf_fn = make_xgb_leaf(lam)
 

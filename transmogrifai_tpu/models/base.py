@@ -40,37 +40,43 @@ def pull_f64(out) -> Tuple[np.ndarray, ...]:
                  for o in jax.device_get(out))
 
 
-#: id-keyed device uploads of feature matrices: (id, shape, dtype) →
-#: (weakref to the host array — keeps the id honest and lets the entry
-#: die with it —, f32 device array). A 2M×20 matrix is ~150 MB on a
-#: tunnelled link; validate → refit → final transform touched the same
-#: rows three times.
+#: content-keyed device uploads of feature matrices: (shape, dtype,
+#: crc32, adler32) → (weakref to the host array, f32 device array). A
+#: 2M×20 matrix is ~150 MB on a tunnelled link; validate → refit → final
+#: transform touch the same CONTENT through different host objects
+#: (boolean-index copies, per-run re-extracts), so identity must not be
+#: part of the key. The weakref only scopes the entry's lifetime.
 _DEVICE_PUT_CACHE: dict = {}
 
 
-def _content_tag(X: np.ndarray) -> bytes:
-    """Cheap mutation detector: hash a strided ~4k-element sample. An
-    id-only key would return stale device data if the caller mutates the
-    host array in place between predicts."""
-    flat = X.reshape(-1)
-    stride = max(1, flat.size // 4096)
-    return flat[::stride].tobytes()
+def _content_tag(X: np.ndarray) -> Tuple[int, int]:
+    """Full-buffer content fingerprint (crc32, adler32 — 64 bits total).
+    A strided sample misses most small in-place edits (ADVICE r4), and an
+    id-based key misses content-equal re-uploads; hashing the whole
+    buffer is ~ms-scale even at 150 MB, vs seconds to re-ship it over a
+    tunnelled link."""
+    import zlib
+    try:
+        view = memoryview(X).cast("B")      # zero-copy when contiguous
+    except (TypeError, ValueError, BufferError):
+        view = X.tobytes()
+    return zlib.crc32(view), zlib.adler32(view)
 
 
 def device_put_f32(X: np.ndarray):
-    """``jnp.asarray(X)`` with an identity+content-sample keyed weakref
-    cache. The dtype follows jax's default conversion (f32 under x64-off
-    — the production setting; the f64 CPU test path stays exact)."""
+    """``jnp.asarray(X)`` with a content-keyed weakref cache. The dtype
+    follows jax's default conversion (f32 under x64-off — the production
+    setting; the f64 CPU test path stays exact)."""
     import weakref
 
     import jax.numpy as jnp
-    key = (id(X), getattr(X, "shape", None), str(getattr(X, "dtype", "")),
+    key = (getattr(X, "shape", None), str(getattr(X, "dtype", "")),
            _content_tag(X))
     hit = _DEVICE_PUT_CACHE.get(key)
     if hit is not None and hit[0]() is not None:
         return hit[1]
     dev = jnp.asarray(X)
-    while len(_DEVICE_PUT_CACHE) >= 4:
+    while len(_DEVICE_PUT_CACHE) >= 8:
         _DEVICE_PUT_CACHE.pop(next(iter(_DEVICE_PUT_CACHE)))
     try:
         ref = weakref.ref(X, lambda _r, k=key:
@@ -83,11 +89,19 @@ def device_put_f32(X: np.ndarray):
 
 def extract_xy(store: ColumnStore, label_name: str, features_name: str
                ) -> Tuple[np.ndarray, np.ndarray]:
+    import jax
     ycol = store[label_name]
     xcol = store[features_name]
     assert isinstance(xcol, VectorColumn), f"{features_name} must be a vector"
     y = np.asarray(ycol.values, dtype=np.float64)
-    X = np.asarray(xcol.values, dtype=np.float64)
+    # under x64 (CPU test path) fits run in f64 — cast the stored f32
+    # matrix up (exact embedding). With x64 off (production TPU) the
+    # device converts to f32 anyway; skipping the cast avoids a full f64
+    # copy of the feature matrix per fit.
+    if jax.config.jax_enable_x64:
+        X = np.asarray(xcol.values, dtype=np.float64)
+    else:
+        X = np.asarray(xcol.values)
     return X, y
 
 
@@ -141,8 +155,10 @@ class PredictorModel(FittedModel, AllowLabelAsInput):
     def transform_columns(self, store: ColumnStore) -> Column:
         xcol = store[self.input_features[1].name]
         assert isinstance(xcol, VectorColumn)
-        pred, raw, prob = self.predict_arrays(
-            np.asarray(xcol.values, dtype=np.float64))
+        # pass the stored (f32) matrix straight through: device_put
+        # converts to the device dtype anyway, and a f64 round-trip here
+        # copied the full matrix twice per scoring pass
+        pred, raw, prob = self.predict_arrays(np.asarray(xcol.values))
         return PredictionColumn(np.asarray(pred, dtype=np.float64),
                                 np.asarray(raw, dtype=np.float64),
                                 np.asarray(prob, dtype=np.float64))

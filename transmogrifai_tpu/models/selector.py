@@ -208,6 +208,12 @@ class ModelSelector(PredictorEstimator):
                 X, y = X[keep], y[keep]
             y = self.splitter.relabel(y)
             base_w = self.splitter.sample_weights(y)
+            # physical sampling (Spark's rebalance/maxTrainingSample): the
+            # sweep trains on the rows Spark would, not 10× them (see
+            # Splitter.physical_sample)
+            sub, base_w = self.splitter.physical_sample(y, base_w)
+            if sub is not None:
+                X, y = X[sub], y[sub]
         else:
             base_w = None
         self._maybe_set_classes(y)
@@ -252,12 +258,16 @@ class ModelSelector(PredictorEstimator):
             best_family, best_hparams, vsummary = \
                 self.find_best_estimator(store)
 
-        # final refit on the full prepared train (ModelSelector.scala:158-159)
+        # final refit on the full prepared train (ModelSelector.scala:158-159
+        # — "prepared" = after the splitter's sampling, same as the sweep)
         if self.splitter is not None:
             keep = self.splitter.keep_mask(y)
             Xk = X if keep.all() else X[keep]
             yk = self.splitter.relabel(y if keep.all() else y[keep])
             w = self.splitter.sample_weights(yk)
+            sub, w = self.splitter.physical_sample(yk, w)
+            if sub is not None:
+                Xk, yk = Xk[sub], yk[sub]
         else:
             Xk, yk = X, y
             w = np.ones_like(yk)

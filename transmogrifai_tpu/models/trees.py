@@ -128,6 +128,18 @@ class TreeEnsembleModel(PredictorModel):
         self.max_depth = int(max_depth)
         self.trees: Dict[str, np.ndarray] = {}
 
+    def predict_arrays(self, X):
+        """Scoring-path Pallas fallback (ADVICE r4): the routed-predict
+        kernel is gated by ``predict_kernel_ok`` but has no fit-style
+        retry wrapper — a Mosaic/VMEM rejection at gate-passing
+        production shapes would otherwise fail scoring outright after a
+        successful (possibly hours-long) fit. On a kernel-shaped compile
+        failure the gate flips off process-wide and the predict retraces
+        onto the XLA gather path."""
+        from ._pallas_hist import with_pallas_fallback
+        base = super().predict_arrays
+        return with_pallas_fallback(lambda: base(X))
+
     def predict_device(self, Xd):
         """Device-side Prediction triple (pure jax; export/serving path)."""
         p = {k: jnp.asarray(v) for k, v in self.trees.items()}

@@ -75,6 +75,23 @@ class Splitter:
         """Rows admitted to training at all (DataCutter label dropping)."""
         return np.ones_like(y, dtype=bool)
 
+    def physical_sample(self, y: np.ndarray, w: np.ndarray
+                        ) -> Tuple[Optional[np.ndarray], np.ndarray]:
+        """(keep mask | None, kept weights): physically drop rows whose
+        sampling fraction is below 1 — what the reference's splitters DO
+        (``DataBalancer.scala rebalance`` Bernoulli-samples the majority
+        class; ``maxTrainingSample`` caps the physical training size).
+
+        The round-1..4 design kept every row and carried the fraction as
+        a weight — statistically the exact expectation of the reference's
+        sample and fully static-shaped, but at the 10M BASELINE config it
+        histograms 10× the rows Spark trains on (maxTrainingSample=1M).
+        Physically sampling once, host-side, BEFORE the sweep keeps
+        shapes static per validate() call and deterministic per seed.
+        Rows with fraction ≥ 1 (minority up-weighting) keep their weight.
+        Default: no sampling (weights already uniform)."""
+        return None, w
+
     def relabel(self, y: np.ndarray) -> np.ndarray:
         """Map kept labels to contiguous model classes (DataCutter only)."""
         return y
@@ -156,6 +173,19 @@ class DataBalancer(Splitter):
     def sample_weights(self, y: np.ndarray) -> np.ndarray:
         return np.where(y == 1, self._pos_weight, self._neg_weight).astype(
             np.float64)
+
+    def physical_sample(self, y: np.ndarray, w: np.ndarray
+                        ) -> Tuple[Optional[np.ndarray], np.ndarray]:
+        """Bernoulli(fraction) row drop for fractions < 1 (the reference's
+        ``rebalance``/``maxTrainingSample`` sampling); up-weights (> 1)
+        stay as weights — deterministic per seed, so repeated sweeps see
+        identical shapes and the executable cache still hits."""
+        frac = np.minimum(w, 1.0)
+        if bool((frac >= 1.0 - 1e-12).all()):
+            return None, w
+        rng = np.random.default_rng(self.seed + 0x5EED)
+        keep = rng.random(len(w)) < frac
+        return keep, np.maximum(w, 1.0)[keep]
 
 
 class DataCutter(Splitter):

@@ -28,7 +28,7 @@ from .vectorizer_base import VEC_DTYPE
 
 __all__ = ["string_codes", "onehot_block", "multihot_block",
            "hashed_count_block", "hashed_count_flat", "flatten_ragged",
-           "value_counts"]
+           "value_counts", "hashed_text_block"]
 
 #: sentinel that cannot collide with real values (contains a NUL byte)
 _NULL = "\0\0null"
@@ -142,6 +142,78 @@ def hashed_count_block(row_tokens: Sequence[Sequence[str]], num_features: int,
     return hashed_count_flat(flat, rows, lengths == 0, n, num_features,
                              seed, binary_freq, out=out,
                              col_offset=col_offset)
+
+
+def hashed_text_block(values: Sequence[Optional[str]], num_features: int,
+                      seed: int, binary_freq: bool,
+                      out: np.ndarray, col_offset: int = 0) -> np.ndarray:
+    """Free-text column → hashed token counts, written in place into
+    ``out[:, col_offset:col_offset+num_features]``. Returns the [n] null
+    mask (f32).
+
+    Fast path: the fused C++ tokenize+hash+scatter kernel
+    (``native/fasthash.cc tokenized_hash_counts``) streams every string
+    once — tokens are ASCII runs of ``[\\w']`` lowercased in place,
+    bit-exact with ``tokenize_simple`` + murmur3 for ASCII text; rows
+    containing non-ASCII bytes are flagged by the kernel and re-done
+    here through the exact unicode-aware Python tokenizer. At 300k rows
+    this replaces ~10 s of re.findall/list/np.unique host work per
+    transform with a ~0.3 s pass. Fallback (no native lib): tokenize
+    per UNIQUE value, then one bulk hashed scatter."""
+    import ctypes
+
+    from .hashing import _load_native
+    from .text import tokenize_simple
+
+    n = len(values)
+    null_mask = np.fromiter((v is None for v in values), bool, count=n)
+    lib = _load_native()
+    kern = getattr(lib, "tokenized_hash_counts", None) if lib else None
+    if kern is not None and out.flags.c_contiguous \
+            and out.dtype == np.float32:
+        encoded = [b"" if v is None else v.encode("utf-8") for v in values]
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(e) for e in encoded], out=offsets[1:])
+        blob = b"".join(encoded)
+        flags = np.zeros(n, dtype=np.uint8)
+        import os
+        n_threads = min(os.cpu_count() or 1, 16)
+        kern(blob,
+             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+             n, np.uint32(seed), np.uint32(num_features), 1,
+             1 if binary_freq else 0,
+             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+             out.shape[1], col_offset,
+             flags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+             n_threads)
+        redo = np.nonzero(flags)[0]
+        if redo.size:
+            # exact Python tokenizer for the non-ASCII rows only
+            from .hashing import hash_tokens
+            region = out[:, col_offset:col_offset + num_features]
+            for i in redo:
+                toks = tokenize_simple(values[i])
+                if not toks:
+                    continue
+                buckets = (hash_tokens(toks, seed)
+                           % np.uint32(num_features)).astype(np.int64)
+                if binary_freq:
+                    region[i, buckets] = 1.0
+                else:
+                    np.add.at(region[i], buckets, 1.0)
+        return np.asarray(null_mask, VEC_DTYPE)
+
+    # fallback: tokenize per UNIQUE text (short fields repeat plenty),
+    # then one bulk hashed scatter
+    vals = np.array([v if v is not None else "" for v in values],
+                    dtype=object)
+    uniq, inv = _unique_object(vals, return_inverse=True)
+    toks = [tokenize_simple(u) for u in uniq.tolist()]
+    row_tokens = [[] if null_mask[r] else toks[i]
+                  for r, i in enumerate(inv)]
+    hashed_count_block(row_tokens, num_features, seed, binary_freq,
+                       out=out, col_offset=col_offset)
+    return np.asarray(null_mask, VEC_DTYPE)
 
 
 def hashed_count_flat(flat: Sequence[str], rows: np.ndarray,

@@ -77,6 +77,18 @@ def _load_native():
                 ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
                 ctypes.c_int64, ctypes.c_uint32,
                 ctypes.POINTER(ctypes.c_uint32)]
+            try:
+                lib.tokenized_hash_counts.argtypes = [
+                    ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                    ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint32,
+                    ctypes.c_int32, ctypes.c_int32,
+                    ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+                    ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8),
+                    ctypes.c_int32]
+            except AttributeError:
+                # stale .so from before the fused kernel: rebuild lazily
+                # next process; this one uses the Python tokenizer path
+                lib.tokenized_hash_counts = None
             _native_lib = lib
             return lib
         except OSError:

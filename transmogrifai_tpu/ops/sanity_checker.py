@@ -93,10 +93,14 @@ class SanityCheckerModel(FittedModel, AllowLabelAsInput):
     def transform_columns(self, store: ColumnStore) -> Column:
         col = store[self.input_features[1].name]
         assert isinstance(col, VectorColumn)
-        idx = np.asarray(self.keep_indices, dtype=np.int64)
         meta = col.metadata.select(self.keep_indices) if col.metadata else None
         if meta is not None:
             meta.name = self.output_name
+        if self.keep_indices == list(range(col.values.shape[1])):
+            # nothing dropped: reuse the input matrix (the fancy-index
+            # below always copies — 1.3 GB at the 300k big_text config)
+            return VectorColumn(OPVector, col.values, meta)
+        idx = np.asarray(self.keep_indices, dtype=np.int64)
         return VectorColumn(OPVector, col.values[:, idx], meta)
 
     def get_model_state(self):

@@ -22,7 +22,6 @@ from ..types.feature_types import Text
 from ..vector_metadata import VectorColumnMetadata, VectorMetadata
 from .hashing import HashingVectorizerModel, hash_tokens
 from .onehot import OneHotModel, _sorted_topk
-from .text import tokenize_simple
 from .vectorizer_base import (TransmogrifierDefaults, VEC_DTYPE,
                               VectorizerEstimator,
                               VectorizerModel, null_indicator_meta)
@@ -97,7 +96,7 @@ class SmartTextVectorizerModel(VectorizerModel):
         """One full-width matrix written in place — per-feature sections are
         views, so no concat copy ever happens (a full copy of a 512-wide
         hash block costs seconds on one host core)."""
-        from ._hostvec import hashed_count_block, onehot_block
+        from ._hostvec import hashed_text_block, onehot_block
         names = self._names()
         n = store.n_rows
         widths = self._widths()
@@ -111,26 +110,17 @@ class SmartTextVectorizerModel(VectorizerModel):
                 vocab = next(vocab_iter)
                 onehot_block(col.values, vocab, self.track_nulls, out=sect)
             else:
-                # tokenize per UNIQUE text (free-form text repeats less than
-                # categoricals, but short fields repeat plenty), then one
-                # bulk hashed scatter
-                vals = np.array([v if v is not None else "" for v in
-                                 col.values], dtype=object)
-                null_mask = np.fromiter((v is None for v in col.values),
-                                        bool, count=n)
-                uniq, inv = np.unique(vals, return_inverse=True)
-                toks = [tokenize_simple(u) for u in uniq.tolist()]
-                row_tokens = [
-                    [] if null_mask[r] else toks[i]
-                    for r, i in enumerate(inv)]
-                hashed_count_block(
-                    row_tokens, self.num_features, self.seed, False,
+                # fused C++ tokenize+hash+scatter (Python-tokenizer
+                # fallback inside) — see _hostvec.hashed_text_block
+                nullf = hashed_text_block(
+                    col.values, self.num_features, self.seed, False,
                     out=mat, col_offset=off)
+                null_mask = nullf > 0
                 if self.track_text_len:
-                    lens = np.fromiter((len(v) for v in vals), np.float64,
-                                       count=n)
-                    sect[:, self.num_features] = np.where(null_mask, 0.0,
-                                                          lens)
+                    lens = np.fromiter(
+                        (0.0 if v is None else len(v) for v in col.values),
+                        np.float64, count=n)
+                    sect[:, self.num_features] = lens
                 if self.track_nulls:
                     sect[null_mask, -1] = 1.0
             off += widths[j]

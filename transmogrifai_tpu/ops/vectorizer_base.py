@@ -133,7 +133,11 @@ class VectorizerModel(FittedModel):
     def transform_columns(self, store: ColumnStore) -> Column:
         prepared = canonicalize_prepared(self.host_prepare(store))
         mat = self.device_compute(np, prepared)
-        mat = np.asarray(mat, dtype=np.float64)
+        # store the pipeline dtype (f32): device_compute already ran on
+        # f32-canonicalized inputs, so an f64 copy holds no extra
+        # information — it only doubled every downstream copy/transfer
+        # (a [300k, 550] layer is 660 MB in f32, 1.3 GB in f64)
+        mat = np.asarray(mat, dtype=VEC_DTYPE)
         meta = self.vector_metadata()
         assert mat.ndim == 2 and mat.shape[1] == meta.size, \
             (type(self).__name__, mat.shape, meta.size)

@@ -253,7 +253,7 @@ def apply_layer_vectorized(models: Sequence[Transformer], store: ColumnStore,
             _LAYER_JIT_CACHE.pop(next(iter(_LAYER_JIT_CACHE)))
         outs = jax.device_get(jitted(preps))   # one batched pull
         for m, mat in zip(vecs, outs):
-            mat = np.asarray(mat, dtype=np.float64)
+            mat = np.asarray(mat)              # already the pipeline f32
             meta = m.vector_metadata()
             assert mat.ndim == 2 and mat.shape[1] == meta.size, \
                 (type(m).__name__, mat.shape, meta.size)
