@@ -23,7 +23,7 @@ perf work with no captured numbers. This bench therefore:
   growing artifact even if the process is killed mid-run;
 * installs SIGTERM/SIGALRM handlers that dump the current state before
   dying;
-* budgets itself: ``BENCH_BUDGET_S`` (default 900 s) is a soft
+* budgets itself: ``BENCH_BUDGET_S`` (default 1050 s) is a soft
   wall-clock cap — optional stages (10M pass, CPU denominator) are
   skipped with a structured reason when the remaining budget cannot
   cover their estimated cost, never silently.
@@ -90,12 +90,26 @@ def _mfu_fields(warm_flops: float, train_s: float) -> dict:
             "mfu_f32_pct": round(100.0 * fps / V5E_PEAK_F32, 3)}
 
 
+def _std_config(warm, cold, st) -> dict:
+    """Shared per-config fields (the three small configs differ only in
+    their metric keys)."""
+    return {
+        "cv_warm_s": st.get("train_s_median",
+                            round(warm["train_time_s"], 2)),
+        "cv_warm_s_reps": st.get("train_s_reps", st["warm_s_all"]),
+        "cv_cold_s": round(cold["train_time_s"], 2),
+        "compile_clock_s": st["compile_clock_s"],
+        "best_model": warm["summary"].best_model_name,
+        **_mfu_fields(st["warm_flops"], warm["train_time_s"]),
+    }
+
+
 class Bench:
     """Cumulative result document with incremental emission + budget."""
 
     def __init__(self) -> None:
         self.t0 = time.time()
-        self.budget_s = float(os.environ.get("BENCH_BUDGET_S", 900))
+        self.budget_s = float(os.environ.get("BENCH_BUDGET_S", 1050))
         self.doc = {"metric": "titanic_holdout_AuPR", "value": None,
                     "unit": "AuPR", "vs_baseline": None, "configs": {},
                     "partial": True}
@@ -187,13 +201,7 @@ def main() -> None:
     configs["titanic"] = {
         "AuPR": round(aupr, 4),
         "vs_reference": round(aupr / REFERENCE_AUPR, 4),
-        "cv_warm_s": st.get("train_s_median",
-                            round(warm["train_time_s"], 2)),
-        "cv_warm_s_reps": st.get("train_s_reps", st["warm_s_all"]),
-        "cv_cold_s": round(cold["train_time_s"], 2),
-        "compile_clock_s": st["compile_clock_s"],
-        "best_model": warm["summary"].best_model_name,
-        **_mfu_fields(st["warm_flops"], warm["train_time_s"]),
+        **_std_config(warm, cold, st),
     }
     doc["value"] = configs["titanic"]["AuPR"]
     doc["vs_baseline"] = round(aupr / REFERENCE_AUPR, 4)
@@ -207,13 +215,7 @@ def main() -> None:
         "iris", lambda: run_iris(num_folds=3, seed=42), reps=reps)
     configs["iris"] = {
         "F1": round(float(warm["metrics"]["F1"]), 4),
-        "cv_warm_s": st.get("train_s_median",
-                            round(warm["train_time_s"], 2)),
-        "cv_warm_s_reps": st.get("train_s_reps", st["warm_s_all"]),
-        "cv_cold_s": round(cold["train_time_s"], 2),
-        "compile_clock_s": st["compile_clock_s"],
-        "best_model": warm["summary"].best_model_name,
-        **_mfu_fields(st["warm_flops"], warm["train_time_s"]),
+        **_std_config(warm, cold, st),
     }
     bench.emit()
 
@@ -224,13 +226,7 @@ def main() -> None:
     configs["boston"] = {
         "RMSE": round(float(warm["metrics"]["RootMeanSquaredError"]), 4),
         "R2": round(float(warm["metrics"]["R2"]), 4),
-        "cv_warm_s": st.get("train_s_median",
-                            round(warm["train_time_s"], 2)),
-        "cv_warm_s_reps": st.get("train_s_reps", st["warm_s_all"]),
-        "cv_cold_s": round(cold["train_time_s"], 2),
-        "compile_clock_s": st["compile_clock_s"],
-        "best_model": warm["summary"].best_model_name,
-        **_mfu_fields(st["warm_flops"], warm["train_time_s"]),
+        **_std_config(warm, cold, st),
     }
     bench.emit()
 
@@ -364,11 +360,15 @@ def main() -> None:
                 f0 = _flops_total()
                 t0 = time.time()
                 signal.alarm(alarm_s)
-                out_full = run_synth(n_rows=full_rows, num_folds=3, seed=42)
+                full_eval_rows = int(os.environ.get(
+                    "BENCH_SYNTH_FULL_EVAL_ROWS", 2_000_000))
+                out_full = run_synth(n_rows=full_rows, num_folds=3,
+                                     seed=42, eval_rows=full_eval_rows)
                 signal.alarm(0)
                 full_total = time.time() - t0
                 configs["synthetic_trees_full"] = {
                     "rows": full_rows,
+                    "eval_rows": min(full_eval_rows, full_rows),
                     "AuPR": round(float(out_full["metrics"]["AuPR"]), 4),
                     "train_s_incl_compile": round(
                         out_full["train_time_s"], 2),
@@ -399,11 +399,11 @@ def main() -> None:
     # the platform per process); budget-gated, small synthetic config,
     # linear extrapolation = conservative floor (CPU throughput degrades
     # with rows). BENCH_CPU=0 disables.
-    cpu_budget = int(os.environ.get("BENCH_CPU_TIMEOUT_S", 300))
+    cpu_budget = int(os.environ.get("BENCH_CPU_TIMEOUT_S", 240))
     if os.environ.get("BENCH_CPU", "1") != "0" and backend == "tpu":
         if bench.remaining() < cpu_budget + 30:
             cpu_budget = max(int(bench.remaining()) - 30, 0)
-        if cpu_budget < 60:
+        if cpu_budget < 120:
             configs["cpu_host_denominator"] = {
                 "status": "skipped_budget",
                 "remaining_budget_s": round(bench.remaining(), 1)}
@@ -412,13 +412,17 @@ def main() -> None:
             env = dict(os.environ)
             env.pop("PALLAS_AXON_POOL_IPS", None)
             env["JAX_PLATFORMS"] = "cpu"
-            # the child's per-stage alarms must fit inside the parent's
-            # kill budget, or the sanctioned work exceeds the timeout and
-            # the salvage path becomes the EXPECTED path
-            tit_s = min(180, max(cpu_budget - 90, 60))
+            # the child's per-stage alarms + ~40s of interpreter/compile
+            # overhead must fit inside the parent's kill budget, or the
+            # sanctioned work exceeds the timeout and the salvage path
+            # becomes the EXPECTED path
+            tit_s = min(180, cpu_budget - 60)
             env.setdefault("BENCH_CPU_TITANIC_TIMEOUT_S", str(tit_s))
+            synth_s = cpu_budget - tit_s - 40
             env.setdefault("BENCH_CPU_SYNTH_TIMEOUT_S",
-                           str(max(cpu_budget - tit_s - 40, 30)))
+                           str(max(synth_s, 0)))
+            if synth_s < 30:
+                env.setdefault("BENCH_CPU_SYNTH_ROWS", "0")  # skip stage
             try:
                 t0 = time.time()
                 proc = subprocess.run(

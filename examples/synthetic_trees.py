@@ -57,7 +57,12 @@ def synthesize_store(n_rows: int, n_features: int = 20, seed: int = 11):
 
 
 def run(n_rows: int = 2_000_000, n_features: int = 20, num_folds: int = 5,
-        families=None, mesh=None, seed: int = 42):
+        families=None, mesh=None, seed: int = 42,
+        eval_rows: int = 0):
+    """``eval_rows > 0`` evaluates AuPR on that many rows instead of the
+    full store — at the 10M config the full-store eval is ~3 minutes of
+    pure link transfer for a quality anchor a 2M slice pins equally
+    well; the bench records the slice size it used."""
     import jax
 
     from transmogrifai_tpu.models.trees import (GBTFamily, RandomForestFamily,
@@ -107,7 +112,10 @@ def run(n_rows: int = 2_000_000, n_features: int = 20, num_folds: int = 5,
     te0 = time.time()
     evaluator = Evaluators.BinaryClassification.auPR().set_columns(
         label, prediction)
-    metrics = model.evaluate(store, evaluator)
+    eval_store = store
+    if eval_rows and eval_rows < store.n_rows:
+        eval_store = store.take(np.arange(eval_rows))
+    metrics = model.evaluate(eval_store, evaluator)
     eval_s = time.time() - te0
     selected = model.fitted_stages[selector.uid]
     return {"model": model, "metrics": metrics,

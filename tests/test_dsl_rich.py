@@ -218,3 +218,78 @@ def test_rich_numeric_isotonic_calibration():
     vals = np.asarray([out[cal.name].get_raw(i) for i in range(n)], float)
     assert np.all(np.diff(vals) >= -1e-9)       # monotone output
     assert 0.0 <= vals.min() and vals.max() <= 1.0
+
+
+def test_location_vectorize_pivot():
+    """RichLocationFeature.vectorize: location-text types pivot top-K +
+    OTHER (+ null)."""
+    vals = ["CA", "NY", "CA", None, "TX", "CA", "NY", "WA"]
+    store = ColumnStore.from_dict({"st": (ft.State, vals)})
+    st = FeatureBuilder.State("st").from_column().as_predictor()
+    vec = st.vectorize_location(top_k=2, min_support=1)
+    _, out = _train(store, vec)
+    meta = out[vec.name].metadata
+    indicators = [c.indicator_value for c in meta.columns]
+    assert "CA" in indicators and "NY" in indicators
+    assert "TX" not in indicators            # beyond top_k → OTHER
+    mat = out[vec.name].values
+    assert mat.shape == (len(vals), len(meta.columns))
+    # row 3 is null → null-indicator column set
+    null_idx = [i for i, c in enumerate(meta.columns)
+                if c.indicator_value == "NullIndicatorValue"]
+    assert mat[3, null_idx[0]] == 1.0
+
+
+def test_email_url_phone_map_surfaces():
+    rows_email = [{"w": "a@gmail.com", "h": "b@yahoo.com"},
+                  {"w": "c@gmail.com"}, {"h": "not-an-email"}]
+    rows_url = [{"s": "https://example.com/x", "b": "nope"},
+                {"s": "http://foo.org"}, {}]
+    rows_phone = [{"m": "(555) 123-4567", "o": "12"},
+                  {"m": "+44 7700 900123"}, {}]
+    store = ColumnStore.from_dict({
+        "em": (ft.EmailMap, rows_email),
+        "um": (ft.URLMap, rows_url),
+        "pm": (ft.PhoneMap, rows_phone)})
+    em = FeatureBuilder.EmailMap("em").from_column().as_predictor()
+    um = FeatureBuilder.URLMap("um").from_column().as_predictor()
+    pm = FeatureBuilder.PhoneMap("pm").from_column().as_predictor()
+    dom = em.to_email_domain_map()
+    ud = um.to_url_domain_map()
+    pv = pm.is_valid_phone_map()
+    _, out = _train(store, dom, ud, pv)
+    assert out[dom.name].get_raw(0) == {"w": "gmail.com", "h": "yahoo.com"}
+    assert out[dom.name].get_raw(2) == {}    # invalid email dropped
+    assert out[ud.name].get_raw(0) == {"s": "example.com"}  # invalid dropped
+    assert out[ud.name].get_raw(1) == {"s": "foo.org"}
+    assert out[pv.name].get_raw(0) == {"m": True, "o": False}
+    assert out[pv.name].get_raw(1) == {"m": True}
+
+
+def test_prediction_tupled():
+    """RichPredictionFeature.tupled: Prediction → 3 plain features."""
+    from transmogrifai_tpu.models import BinaryClassificationModelSelector
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=120)
+    y = (x > 0).astype(float)
+    store = ColumnStore.from_dict({
+        "y": (ft.RealNN, y.tolist()), "x": (ft.Real, x.tolist())})
+    ybl = FeatureBuilder.RealNN("y").from_column().as_response()
+    xf = FeatureBuilder.Real("x").from_column().as_predictor()
+    vec = xf.vectorize()
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, validation_metric="AuPR",
+        families=[LogisticRegressionFamily(
+            grid=[{"regParam": 0.01, "elasticNetParam": 0.0}])], seed=3)
+    pred = ybl.transform_with(sel, vec)
+    p, raw, prob = pred.tupled()
+    _, out = _train(store, p, raw, prob)
+    n = len(y)
+    pv = np.asarray([out[p.name].get_raw(i) for i in range(n)], float)
+    assert set(np.unique(pv)) <= {0.0, 1.0}
+    probm = out[prob.name].values
+    assert probm.shape == (n, 2)
+    np.testing.assert_allclose(probm.sum(axis=1), 1.0, atol=1e-5)
+    rawm = out[raw.name].values
+    assert rawm.shape == (n, 2)

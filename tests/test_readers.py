@@ -164,3 +164,35 @@ def test_conditional_dataprep_example():
     assert store.n_rows == 2             # user b dropped (never purchased)
     by_minutes = {r["minutes"] for r in rows.values()}
     assert 10.0 in by_minutes            # user a: 3 + 7 before first buy
+
+
+def test_directory_stream_reader(tmp_path):
+    """DirectoryStreamReader (StreamingReaders analog): each new file is
+    one micro-batch; new_files_only skips the backlog; avro + csv route
+    by extension."""
+    from transmogrifai_tpu.readers import DirectoryStreamReader
+    from transmogrifai_tpu.readers.avro import write_avro_records
+
+    d = tmp_path / "incoming"
+    d.mkdir()
+    (d / "a.csv").write_text("x,y\n1,one\n2,two\n")
+    write_avro_records(str(d / "b.avro"),
+                       [{"x": 3, "y": "three"}, {"x": 4, "y": None}])
+
+    r = DirectoryStreamReader(str(d), pattern="*", settle_s=0.0)
+    batches = list(r.stream(max_batches=2))
+    assert len(batches) == 2             # one batch per file, sorted order
+    assert batches[0][0]["y"] == "one"   # a.csv first
+    assert batches[1][0] == {"x": 3, "y": "three"}
+    # nothing new -> poll_once drains empty
+    assert r.poll_once() == []
+    # a THIRD file appears mid-stream and is picked up
+    (d / "c.csv").write_text("x,y\n9,nine\n")
+    more = list(r.stream(max_batches=1, timeout_s=5.0))
+    assert more == [[{"x": "9", "y": "nine"}]]
+
+    # new_files_only: the existing backlog is invisible
+    r2 = DirectoryStreamReader(str(d), new_files_only=True, settle_s=0.0)
+    assert r2.poll_once() == []
+    (d / "d.csv").write_text("x,y\n5,five\n")
+    assert r2.read_records() == [{"x": "5", "y": "five"}]

@@ -712,6 +712,94 @@ def _to_multi_pick_list(self: Feature):
                    "toMultiPickList")
 
 
+def _vectorize_location(self: Feature, *others: Feature,
+                        top_k: Optional[int] = None,
+                        min_support: Optional[int] = None,
+                        track_nulls: bool = True):
+    """Location-text pivot (RichLocationFeature.vectorize :50-76):
+    Country/State/City/PostalCode/Street (Text + Location marker types)
+    pivot into top-K one-hot + OTHER (+ null) columns. The numeric
+    Geolocation type instead routes through ``vectorize`` →
+    GeolocationVectorizer ((lat, lon, accuracy) with geo-mean fill)."""
+    from .ops.onehot import OneHotVectorizer
+    from .ops.vectorizer_base import TransmogrifierDefaults as TD
+    stage = OneHotVectorizer(
+        top_k=TD.TOP_K if top_k is None else top_k,
+        min_support=TD.MIN_SUPPORT if min_support is None else min_support,
+        track_nulls=track_nulls)
+    return self.transform_with(stage, *others)
+
+
+def _to_email_domain_map(self: Feature):
+    """EmailMap → PickListMap of email domains — the extraction half of
+    RichEmailMapFeature.vectorize (:968-1004); feed the result to
+    ``vectorize``/``smart_vectorize`` to finish the reference's chain."""
+    from .ops.text_suite import parse_email
+
+    def f(m):
+        out = {}
+        for k, v in (m or {}).items():
+            d = parse_email(v)[1]
+            if d is not None:
+                out[k] = d
+        return out
+    return _map_to(self, f, _ft().PickListMap, "emailMapToPickListMap")
+
+
+def _to_url_domain_map(self: Feature):
+    """URLMap → PickListMap of domains of VALID urls — the extraction
+    half of RichURLMapFeature.vectorize (:1040-1096)."""
+    from .ops.text_suite import parse_url
+
+    def f(m):
+        out = {}
+        for k, v in (m or {}).items():
+            proto, domain = parse_url(v)[:2]
+            if proto is not None and domain is not None:
+                out[k] = domain
+        return out
+    return _map_to(self, f, _ft().PickListMap, "urlMapToPickListMap")
+
+
+def _is_valid_phone_map(self: Feature, default_region: str = "US"):
+    """PhoneMap → BinaryMap of per-key phone validity
+    (RichPhoneMapFeature.isValidPhoneDefaultCountryMap :945-958)."""
+    from .ops.text_suite import parse_phone
+
+    def f(m):
+        return {k: parse_phone(v, default_region)[0]
+                for k, v in (m or {}).items()}
+    return _map_to(self, f, _ft().BinaryMap, "isValidPhoneMapDefaultCountry")
+
+
+def _tupled(self: Feature):
+    """Prediction → (prediction RealNN, rawPrediction OPVector,
+    probability OPVector) (RichPredictionFeature.tupled :1098-1111)."""
+    from .columns import PredictionColumn, VectorColumn
+    from .stages.base import LambdaTransformer
+    ftx = _ft()
+
+    def mk(name, fn, otype):
+        st = LambdaTransformer(name, fn, [ftx.Prediction], otype)
+        st.set_input(self)
+        return st.get_output()
+
+    def _pred(c: PredictionColumn):
+        return NumericColumn(ftx.RealNN, np.asarray(c.prediction),
+                             np.ones(len(c), bool))
+    return (
+        mk("predictionValue", _pred, ftx.RealNN),
+        mk("rawPrediction",
+           lambda c: VectorColumn(ftx.OPVector,
+                                  np.asarray(c.raw_prediction)),
+           ftx.OPVector),
+        mk("probability",
+           lambda c: VectorColumn(ftx.OPVector,
+                                  np.asarray(c.probability)),
+           ftx.OPVector),
+    )
+
+
 def _ft():
     from .types import feature_types
     return feature_types
@@ -777,5 +865,10 @@ Feature.is_valid_email = _is_valid_email
 Feature.is_valid_url = _is_valid_url
 Feature.parse_phone = _parse_phone
 Feature.to_multi_pick_list = _to_multi_pick_list
+Feature.vectorize_location = _vectorize_location
+Feature.to_email_domain_map = _to_email_domain_map
+Feature.to_url_domain_map = _to_url_domain_map
+Feature.is_valid_phone_map = _is_valid_phone_map
+Feature.tupled = _tupled
 
 transmogrify = _vectorize_collection

@@ -41,11 +41,13 @@ def pull_f64(out) -> Tuple[np.ndarray, ...]:
 
 
 #: content-keyed device uploads of feature matrices: (shape, dtype,
-#: crc32, adler32) → (weakref to the host array, f32 device array). A
-#: 2M×20 matrix is ~150 MB on a tunnelled link; validate → refit → final
-#: transform touch the same CONTENT through different host objects
-#: (boolean-index copies, per-run re-extracts), so identity must not be
-#: part of the key. The weakref only scopes the entry's lifetime.
+#: crc32, adler32) → f32 device array. A 2M×20 matrix is ~150 MB on a
+#: tunnelled link; validate → refit → final transform → repeat scoring
+#: touch the same CONTENT through different host objects (boolean-index
+#: copies, per-run re-extracts), so identity is not part of the key and
+#: entries deliberately OUTLIVE their host arrays — a content-hash match
+#: is a content match, whoever holds the bytes now. Bounded FIFO caps
+#: device memory (6 × a big matrix ≲ 4 GB HBM on a 16 GB v5e).
 _DEVICE_PUT_CACHE: dict = {}
 
 
@@ -64,26 +66,19 @@ def _content_tag(X: np.ndarray) -> Tuple[int, int]:
 
 
 def device_put_f32(X: np.ndarray):
-    """``jnp.asarray(X)`` with a content-keyed weakref cache. The dtype
+    """``jnp.asarray(X)`` with a content-keyed FIFO cache. The dtype
     follows jax's default conversion (f32 under x64-off — the production
     setting; the f64 CPU test path stays exact)."""
-    import weakref
-
     import jax.numpy as jnp
     key = (getattr(X, "shape", None), str(getattr(X, "dtype", "")),
            _content_tag(X))
     hit = _DEVICE_PUT_CACHE.get(key)
-    if hit is not None and hit[0]() is not None:
-        return hit[1]
+    if hit is not None:
+        return hit
     dev = jnp.asarray(X)
-    while len(_DEVICE_PUT_CACHE) >= 8:
+    while len(_DEVICE_PUT_CACHE) >= 6:
         _DEVICE_PUT_CACHE.pop(next(iter(_DEVICE_PUT_CACHE)))
-    try:
-        ref = weakref.ref(X, lambda _r, k=key:
-                          _DEVICE_PUT_CACHE.pop(k, None))
-    except TypeError:
-        return dev                      # non-weakref-able: no caching
-    _DEVICE_PUT_CACHE[key] = (ref, dev)
+    _DEVICE_PUT_CACHE[key] = dev
     return dev
 
 
@@ -130,12 +125,24 @@ class PredictorModel(FittedModel, AllowLabelAsInput):
                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(prediction [n], raw [n,k], prob [n,k]) as host float64 — ONE
         batched device pull around predict_device by default (upload
-        cached by array identity: scoring + evaluating the same store
-        must not re-ship the feature matrix over the link)."""
+        content-cached: scoring + evaluating the same store must not
+        re-ship the feature matrix over the link).
+
+        Models exposing ``predict_host`` (cheap matvec heads: linear/
+        logistic/NB) run it instead when the matrix is big and the
+        measured link is slow — on a tunnelled TPU the [n, d] upload
+        costs tens of seconds for a prediction the host computes in
+        milliseconds (same bandwidth gate as the layer-fusion decision)."""
         import logging
         import time
 
         import jax
+        host = getattr(self, "predict_host", None)
+        if host is not None and getattr(X, "size", 0) >= 2e6:
+            from ..workflow import (FUSE_MIN_BANDWIDTH_MBPS,
+                                    device_roundtrip_mbps)
+            if device_roundtrip_mbps() < FUSE_MIN_BANDWIDTH_MBPS:
+                return host(X)
         log = logging.getLogger(__name__)
         if log.isEnabledFor(logging.INFO) and getattr(X, "size", 0) > 1e6:
             t0 = time.time()

@@ -54,8 +54,29 @@ class LogisticRegressionModel(PredictorModel):
         return JF.predict_multinomial_logistic(
             jnp.asarray(self.coefficients), jnp.asarray(self.intercept), X)
 
-    def predict_arrays(self, X):
-        return pull_f64(self.predict_device(jnp.asarray(X)))
+    def predict_host(self, X):
+        """numpy mirror of predict_device — the slow-link fast path (see
+        PredictorModel.predict_arrays): X @ coef is milliseconds on the
+        host vs tens of seconds to ship X over a tunnelled device link."""
+        Xf = np.asarray(X, dtype=np.float64)
+        if self.n_classes == 2 and self.coefficients.ndim == 1:
+            margin = Xf @ np.asarray(self.coefficients, np.float64) \
+                + float(np.asarray(self.intercept))
+            with np.errstate(over="ignore"):
+                p1 = np.where(margin >= 0, 1.0 / (1.0 + np.exp(-margin)),
+                              np.exp(np.minimum(margin, 0.0))
+                              / (1.0 + np.exp(np.minimum(margin, 0.0))))
+            prob = np.stack([1.0 - p1, p1], axis=1)
+            raw = np.stack([-margin, margin], axis=1)
+            return (p1 > 0.5).astype(np.float64), raw, prob
+        W = np.asarray(self.coefficients, np.float64)
+        b = np.asarray(self.intercept, np.float64)
+        logits = Xf @ W.T + b
+        z = logits - logits.max(axis=1, keepdims=True)
+        e = np.exp(z)
+        prob = e / e.sum(axis=1, keepdims=True)
+        pred = np.argmax(prob, axis=1).astype(np.float64)
+        return pred, logits, prob
 
     def get_model_state(self):
         return {"coefficients": self.coefficients, "intercept": self.intercept,
@@ -159,8 +180,12 @@ class LinearRegressionModel(PredictorModel):
         return JF.predict_linear(
             jnp.asarray(self.coefficients), self.intercept, X)
 
-    def predict_arrays(self, X):
-        return pull_f64(self.predict_device(jnp.asarray(X)))
+    def predict_host(self, X):
+        """numpy mirror of predict_device (slow-link fast path)."""
+        pred = np.asarray(X, np.float64) @ np.asarray(
+            self.coefficients, np.float64) + self.intercept
+        empty = np.zeros((pred.shape[0], 0))
+        return pred, empty, empty
 
     def get_model_state(self):
         return {"coefficients": self.coefficients, "intercept": self.intercept}
@@ -239,8 +264,15 @@ class NaiveBayesModel(PredictorModel):
         return JF.predict_naive_bayes(
             jnp.asarray(self.log_prior), jnp.asarray(self.log_likelihood), X)
 
-    def predict_arrays(self, X):
-        return pull_f64(self.predict_device(jnp.asarray(X)))
+    def predict_host(self, X):
+        """numpy mirror of predict_device (slow-link fast path)."""
+        logits = np.maximum(np.asarray(X, np.float64), 0.0) \
+            @ np.asarray(self.log_likelihood, np.float64).T \
+            + np.asarray(self.log_prior, np.float64)
+        z = logits - logits.max(axis=1, keepdims=True)
+        e = np.exp(z)
+        prob = e / e.sum(axis=1, keepdims=True)
+        return logits.argmax(axis=1).astype(np.float64), logits, prob
 
     def get_model_state(self):
         return {"log_prior": self.log_prior,

@@ -164,7 +164,10 @@ class SanityChecker(Estimator, AllowLabelAsInput):
         ycol = store[label_name]
         xcol = store[feat_name]
         assert isinstance(xcol, VectorColumn)
-        X = np.asarray(xcol.values, dtype=np.float64)
+        import jax as _jax
+        _f64 = _jax.config.jax_enable_x64
+        X = np.asarray(xcol.values,
+                       dtype=np.float64 if _f64 else np.float32)
         y = np.asarray(ycol.values, dtype=np.float64)
         n, d = X.shape
         meta = xcol.metadata or VectorMetadata(feat_name, [])
@@ -182,9 +185,21 @@ class SanityChecker(Estimator, AllowLabelAsInput):
         # Dispatch EVERY device computation first (moments, optional
         # Spearman over ranks, per-group contingencies) and fetch them in
         # ONE device_get at the end: each separate pull pays the device
-        # link's round-trip latency (~200ms on a tunnelled TPU).
-        moments_dev = _moments_kernel(jnp.asarray(X), jnp.asarray(y),
-                                      self.feature_label_corr_only)
+        # link's round-trip latency (~200ms on a tunnelled TPU). On a
+        # SLOW link (the fusion gate's bandwidth probe) and a big matrix
+        # the upload costs more than the gram — the host-BLAS twin runs
+        # instead (utils.stats.moments_host).
+        from ..utils.stats import moments_host as _moments_host
+        from ..workflow import (FUSE_MIN_BANDWIDTH_MBPS,
+                                device_roundtrip_mbps)
+        use_host = (X.size >= 20e6
+                    and device_roundtrip_mbps() < FUSE_MIN_BANDWIDTH_MBPS)
+        if use_host:
+            moments_dev = _moments_host(X, y,
+                                        self.feature_label_corr_only)
+        else:
+            moments_dev = _moments_kernel(jnp.asarray(X), jnp.asarray(y),
+                                          self.feature_label_corr_only)
 
         # Spearman = Pearson over average ranks (MLlib Statistics.corr
         # "spearman"); ranks built per column on host, correlations in the

@@ -5,3 +5,4 @@ from .data_readers import (DataReader, CSVReader, CSVAutoReader,  # noqa: F401
                            TimeBasedFilter, FilteredReader, CutOffTime,
                            stream_score)
 from .avro import read_avro_records  # noqa: F401
+from .streaming import DirectoryStreamReader  # noqa: F401

@@ -176,12 +176,25 @@ class OpWorkflowRunner:
             # DROPPED, so peak memory is one batch — not the dataset
             from .readers import stream_score
             reader = self.scoring_reader
-            data = reader.read_records()
-            batch = int(params.custom_params.get("batchSize", 1024))
-            if batch <= 0:
-                raise ValueError(
-                    f"customParams.batchSize must be positive, got {batch}")
-            batches = (data[i:i + batch] for i in range(0, len(data), batch))
+            if hasattr(reader, "stream"):
+                # directory-watching reader (StreamingReaders analog):
+                # each NEW file is one micro-batch; maxBatches/timeoutS
+                # bound the loop for non-daemon runs
+                mb = params.custom_params.get("maxBatches")
+                ts = params.custom_params.get("timeoutS")
+                batch = "per-file"
+                batches = reader.stream(
+                    max_batches=int(mb) if mb is not None else None,
+                    timeout_s=float(ts) if ts is not None else None)
+            else:
+                data = reader.read_records()
+                batch = int(params.custom_params.get("batchSize", 1024))
+                if batch <= 0:
+                    raise ValueError(
+                        f"customParams.batchSize must be positive, "
+                        f"got {batch}")
+                batches = (data[i:i + batch]
+                           for i in range(0, len(data), batch))
             rows = 0
             n_batches = 0
             sink = (_make_sink(params.write_location)

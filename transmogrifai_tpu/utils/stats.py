@@ -58,6 +58,33 @@ def contingency(Y_onehot, Xg):
     return Y_onehot.T @ Xg
 
 
+def moments_host(X: np.ndarray, y: np.ndarray,
+                 label_corr_only: bool = False):
+    """Host-BLAS twin of :func:`moments` for slow-link deployments: on a
+    network-tunnelled TPU the [n, d] upload costs more than the gram
+    itself (a 270k×550 f32 matrix is ~0.6 GB — ~30 s at tunnel rates for
+    a ~160 GFLOP sgemm the host does in seconds). Same math, f32 gram
+    with f64 mean subtraction; callers gate on the measured link
+    bandwidth (the fusion gate's device_roundtrip_mbps)."""
+    n = X.shape[0]
+    Z = np.concatenate(
+        [np.asarray(X, dtype=np.float32),
+         np.asarray(y, dtype=np.float32)[:, None]], axis=1)
+    mean = Z.mean(axis=0, dtype=np.float64)
+    Zc = Z - mean.astype(np.float32)
+    cov = (Zc.T @ Zc).astype(np.float64) / max(n - 1, 1)
+    var = np.diagonal(cov)
+    std = np.sqrt(np.maximum(var, 0.0))
+    denom = np.maximum(np.outer(std, std), 1e-30)
+    if label_corr_only:
+        corr_label = cov[:-1, -1] / denom[:-1, -1]
+        corr = None
+    else:
+        corr = cov / denom
+        corr_label = corr[:-1, -1]
+    return (mean, var, corr_label, corr, Z.min(axis=0), Z.max(axis=0))
+
+
 def cramers_v_stats(cont: np.ndarray
                     ) -> Tuple[float, np.ndarray, np.ndarray]:
     """Cramér's V (bias-uncorrected, MLlib chi2 semantics) + per-category

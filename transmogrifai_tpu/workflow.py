@@ -416,7 +416,7 @@ class Workflow:
                 result_features, dag, train_store, test_store)
         else:
             fitted, train_time, _, _ = self._fit_dag(
-                dag, train_store, test_store)
+                dag, train_store, test_store, transform_last=False)
         logger.info("train: done in %.2fs (%d fitted stages)",
                     train_time, len(fitted))
         return WorkflowModel(
@@ -433,11 +433,17 @@ class Workflow:
     def _fit_dag(self, dag: StagesDAG, train: ColumnStore,
                  test: Optional[ColumnStore],
                  fitted: Optional[Dict[str, FittedModel]] = None,
-                 checkpoint: bool = True
+                 checkpoint: bool = True,
+                 transform_last: bool = True
                  ) -> Tuple[Dict[str, FittedModel], float,
                             ColumnStore, Optional[ColumnStore]]:
         """Fold layers: fit estimators, holdout-eval, transform both splits
-        (FitStagesUtil.fitAndTransformDAG/Layer)."""
+        (FitStagesUtil.fitAndTransformDAG/Layer).
+
+        ``transform_last=False`` skips transforming the TERMINAL layer:
+        callers that discard the returned stores (plain ``train()``) pay
+        a full scoring pass — 97 s of pure upload at the 10M config —
+        for predictions nothing consumes (scoring re-runs the DAG)."""
         t0 = time.time()
         _ensure_compile_listener()
         fitted = {} if fitted is None else fitted
@@ -491,18 +497,24 @@ class Workflow:
                     raise WorkflowError(f"Unfittable stage {stage!r}")
             # transform both splits with the fully fitted layer — the
             # layer's vectorizers fuse into one XLA program per split
-            tt = time.time()
-            train = apply_layer_vectorized(models, train)
-            if test is not None:
-                test = apply_layer_vectorized(models, test)
-            layer_transform_s = time.time() - tt
-            if models:
-                logger.info("layer %d: transformed %d stage(s) in %.2fs",
-                            li, len(models), layer_transform_s)
-            for m in models:
-                self._stage_metrics.setdefault(
-                    m.uid, {"stageName": m.stage_name()})[
-                    "layerTransformSeconds"] = round(layer_transform_s, 4)
+            if not transform_last and li == len(dag) - 1:
+                if models:
+                    logger.info("layer %d: transform skipped "
+                                "(terminal layer, outputs unconsumed)", li)
+            else:
+                tt = time.time()
+                train = apply_layer_vectorized(models, train)
+                if test is not None:
+                    test = apply_layer_vectorized(models, test)
+                layer_transform_s = time.time() - tt
+                if models:
+                    logger.info("layer %d: transformed %d stage(s) in "
+                                "%.2fs", li, len(models), layer_transform_s)
+                for m in models:
+                    self._stage_metrics.setdefault(
+                        m.uid, {"stageName": m.stage_name()})[
+                        "layerTransformSeconds"] = round(layer_transform_s,
+                                                         4)
             if checkpoint and self._checkpoint_dir \
                     and len(fitted) > n_fitted_before \
                     and _is_coordinator():
@@ -540,7 +552,8 @@ class Workflow:
         t0 = time.time()
         ms, before, during, after = cut_dag(result_features)
         if ms is None or not during:
-            fitted, _, _, _ = self._fit_dag(dag, train, test)
+            fitted, _, _, _ = self._fit_dag(dag, train, test,
+                                            transform_last=False)
             return fitted, time.time() - t0
 
         fitted: Dict[str, FittedModel] = {}
@@ -571,7 +584,8 @@ class Workflow:
             tr_idx = np.nonzero(train_mask > 0)[0]
             fold_fit: Dict[str, FittedModel] = {}
             _, _, _, _ = self._fit_dag(during, store_kept.take(tr_idx),
-                                       None, fold_fit, checkpoint=False)
+                                       None, fold_fit, checkpoint=False,
+                                       transform_last=False)
             # transform the FULL kept split with fold-fitted during stages
             fold_store = store_kept
             for layer in during:
@@ -595,7 +609,8 @@ class Workflow:
             rest = [s for s in layer if s.uid not in done]
             if rest:
                 remaining.append(rest)
-        fitted, _, _, _ = self._fit_dag(remaining, train_b, test_b, fitted)
+        fitted, _, _, _ = self._fit_dag(remaining, train_b, test_b, fitted,
+                                        transform_last=False)
         return fitted, time.time() - t0
 
 
