@@ -183,6 +183,11 @@ def main() -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     backend = jax.default_backend()
+    if backend == "tpu":
+        # overlap the one-time Pallas probe compile (~10-15 s over a
+        # tunnelled compile service) with the first config's data load
+        from transmogrifai_tpu.models._pallas_hist import warm_probe_async
+        warm_probe_async()
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, os.path.join(here, "examples"))
     bench = Bench()
@@ -274,15 +279,11 @@ def main() -> None:
     synth_compile_s = _compile_s() - c0
     _log(f"[bench] synthetic_trees cold {cold_s:.1f}s "
          f"(compile clock {synth_compile_s:.1f}s)")
+    # warm rep 1: CLEAN (the official cv_warm_s — profiler capture adds
+    # measurable overhead at 2M)
     f0 = _flops_total()
     t1 = time.time()
-    if do_profile:
-        import shutil
-        shutil.rmtree(trace_dir, ignore_errors=True)
-        with jax.profiler.trace(trace_dir):
-            warm = run_synth(n_rows=synth_rows, num_folds=3, seed=42)
-    else:
-        warm = run_synth(n_rows=synth_rows, num_folds=3, seed=42)
+    warm = run_synth(n_rows=synth_rows, num_folds=3, seed=42)
     warm_s = time.time() - t1
     warm_flops = _flops_total() - f0
     _log(f"[bench] synthetic_trees warm {warm_s:.1f}s "
@@ -293,12 +294,37 @@ def main() -> None:
         "cv_warm_s": round(warm["train_time_s"], 2),
         "cv_cold_s": round(cold["train_time_s"], 2),
         "compile_clock_s": round(synth_compile_s, 2),
-        "warm_profiled": bool(do_profile),
         "best_model": warm["summary"].best_model_name,
         "phases": warm.get("phases"),
         **_mfu_fields(warm_flops, warm["train_time_s"]),
     }
     bench.emit()
+
+    # warm rep 2 runs under jax.profiler.trace (device-busy MFU + top
+    # ops); its wall clock is recorded separately so profiler overhead
+    # never contaminates the headline — and it doubles as the second
+    # warm rep for the variance record. Budget-gated.
+    if do_profile and bench.remaining() < warm_s * 1.4 + 60:
+        do_profile = False
+        _log("[bench] profile pass skipped (budget)")
+    warm_prof_s = None
+    if do_profile:
+        import shutil
+        shutil.rmtree(trace_dir, ignore_errors=True)
+        f0 = _flops_total()
+        t1 = time.time()
+        with jax.profiler.trace(trace_dir):
+            warm2 = run_synth(n_rows=synth_rows, num_folds=3, seed=42)
+        warm_prof_s = time.time() - t1
+        warm_flops = _flops_total() - f0
+        _log(f"[bench] synthetic_trees warm(profiled) {warm_prof_s:.1f}s")
+        configs["synthetic_trees"]["cv_warm_s_reps"] = [
+            round(warm["train_time_s"], 2),
+            round(warm2["train_time_s"], 2)]
+        configs["synthetic_trees"]["profiled_rep_train_s"] = round(
+            warm2["train_time_s"], 2)
+        warm_s = warm_prof_s                  # profile window below
+        bench.emit()
 
     if do_profile:
         sys.path.insert(0, os.path.join(here, "tools"))
@@ -399,7 +425,7 @@ def main() -> None:
     # the platform per process); budget-gated, small synthetic config,
     # linear extrapolation = conservative floor (CPU throughput degrades
     # with rows). BENCH_CPU=0 disables.
-    cpu_budget = int(os.environ.get("BENCH_CPU_TIMEOUT_S", 240))
+    cpu_budget = int(os.environ.get("BENCH_CPU_TIMEOUT_S", 300))
     if os.environ.get("BENCH_CPU", "1") != "0" and backend == "tpu":
         if bench.remaining() < cpu_budget + 30:
             cpu_budget = max(int(bench.remaining()) - 30, 0)
@@ -421,8 +447,9 @@ def main() -> None:
             synth_s = cpu_budget - tit_s - 40
             env.setdefault("BENCH_CPU_SYNTH_TIMEOUT_S",
                            str(max(synth_s, 0)))
-            if synth_s < 30:
-                env.setdefault("BENCH_CPU_SYNTH_ROWS", "0")  # skip stage
+            cpu_synth_skipped = synth_s < 30
+            if cpu_synth_skipped:
+                env.setdefault("BENCH_CPU_SYNTH_ROWS", "0")
             try:
                 t0 = time.time()
                 proc = subprocess.run(
@@ -434,6 +461,8 @@ def main() -> None:
                         if ln.startswith("{")][-1]
                 cpu = json.loads(line)
                 cpu["wall_s"] = round(time.time() - t0, 1)
+                if cpu_synth_skipped:
+                    cpu["synth_status"] = "skipped_budget"
                 configs["cpu_host_denominator"] = cpu
                 tw = configs["titanic"]["cv_warm_s"]
                 if tw > 0 and cpu.get("titanic_warm_s"):

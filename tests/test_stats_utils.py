@@ -51,3 +51,23 @@ def test_spearman_monotone_invariance(rng):
     X = x[:, None]
     corr_label, _ = stats.spearman_with_label(X, y)
     assert abs(float(corr_label[0]) - 1.0) < 1e-9
+
+
+def test_moments_host_matches_device_kernel():
+    """moments_host (the slow-link host-BLAS twin) agrees with the jitted
+    device kernel to f32 accuracy on identical inputs."""
+    import numpy as np
+    from transmogrifai_tpu.utils.stats import moments, moments_host
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(500, 7)).astype(np.float32)
+    X[:, 3] = (X[:, 0] > 0)          # binary col
+    y = (X[:, 0] + 0.1 * rng.normal(size=500) > 0).astype(np.float64)
+    m_dev = [np.asarray(v) for v in moments(X.astype(np.float64), y)]
+    m_host = list(moments_host(X, y))
+    for dev, host, tol in zip(m_dev, m_host,
+                              (1e-6, 1e-4, 1e-4, 1e-4, 1e-6, 1e-6)):
+        if dev is None or host is None:
+            assert dev is None and host is None
+            continue
+        np.testing.assert_allclose(np.asarray(host), dev, rtol=tol,
+                                   atol=1e-5)

@@ -57,7 +57,33 @@ logger = logging.getLogger(__name__)
 __all__ = ["cumhist", "route_level", "pallas_histograms_enabled",
            "ROW_ALIGN"]
 
+import threading as _threading
+
 _PROBE: Optional[bool] = None
+#: created at import — a lazy check-then-assign could hand two racing
+#: threads two different locks, defeating the double-compile guard
+_PROBE_LOCK = _threading.Lock()
+
+
+def _probe_lock():
+    return _PROBE_LOCK
+
+
+def warm_probe_async() -> None:
+    """Kick the one-time kernel compile probe on a background thread —
+    XLA compilation releases the GIL, so callers with a cold process
+    (bench.py before its first config) overlap the ~10-15 s tunnel
+    compile with data loading instead of paying it inside the first
+    tree-family sweep."""
+    import threading
+
+    def _go():
+        try:
+            pallas_histograms_enabled()
+        except Exception:           # probe failures fall back at consult
+            pass
+    threading.Thread(target=_go, name="pallas-probe-warm",
+                     daemon=True).start()
 
 #: Kernel row alignment. **Rows live in the LANE dimension**: per-row
 #: vectors (slot/g/stats channels) travel as rows of a small [k ≤ 8, n]
@@ -537,11 +563,20 @@ def pallas_histograms_enabled() -> bool:
         # (ModelFamily._trace_extras during trace_signature), which caches
         # the result; if a direct fit consults it mid-trace before any
         # host-side call, fall back to XLA for that trace WITHOUT caching
-        # so a later eager call can still probe.
+        # so a later eager call can still probe. The lock keeps a
+        # concurrent warm_probe_async from compiling the probe twice.
         from jax._src import core as _core
         detector = getattr(_core, "trace_state_clean", None)
         if detector is not None and not detector():
             return False
+        with _probe_lock():
+            return _probe_locked(detector)
+    return _PROBE
+
+
+def _probe_locked(detector) -> bool:
+    global _PROBE
+    if _PROBE is None:
         try:
             import numpy as np
             out = cumhist(

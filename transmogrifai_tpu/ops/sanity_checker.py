@@ -192,7 +192,12 @@ class SanityChecker(Estimator, AllowLabelAsInput):
         from ..utils.stats import moments_host as _moments_host
         from ..workflow import (FUSE_MIN_BANDWIDTH_MBPS,
                                 device_roundtrip_mbps)
-        use_host = (X.size >= 20e6
+        # slow link + production (x64-off) dtype → host for ANY size:
+        # big matrices because the upload dwarfs the gram, small ones
+        # because the moments-kernel COMPILE alone costs seconds over a
+        # tunnelled compile service. The x64 test path stays on the
+        # device kernel (exact f64).
+        use_host = (not _f64
                     and device_roundtrip_mbps() < FUSE_MIN_BANDWIDTH_MBPS)
         if use_host:
             moments_dev = _moments_host(X, y,
@@ -209,7 +214,8 @@ class SanityChecker(Estimator, AllowLabelAsInput):
         # is real money on wide hashed-text vectors.
         spearman_dev = None
         if self.correlation_type == "spearman":
-            spearman_dev, _full = _spearman_with_label(X, y)
+            spearman_dev, _full = _spearman_with_label(X, y,
+                                                       host=use_host)
 
         groups: Dict[Tuple[str, str], List[int]] = {}
         if meta.size == d:
@@ -221,10 +227,18 @@ class SanityChecker(Estimator, AllowLabelAsInput):
         conts_dev = []
         if ordered:
             classes = np.unique(y)
-            Y1d = jnp.asarray(
-                (y[:, None] == classes[None, :]).astype(np.float64))
-            conts_dev = [_contingency_kernel(Y1d, jnp.asarray(X[:, idxs]))
-                         for _g, idxs in ordered]
+            Y1 = (y[:, None] == classes[None, :]).astype(np.float64)
+            if use_host:
+                # same gate as moments: per-group widths mean one device
+                # compile EACH over a slow compile service for a matmul
+                # the host does in microseconds
+                conts_dev = [Y1.T @ np.asarray(X[:, idxs], np.float64)
+                             for _g, idxs in ordered]
+            else:
+                Y1d = jnp.asarray(Y1)
+                conts_dev = [_contingency_kernel(Y1d,
+                                                 jnp.asarray(X[:, idxs]))
+                             for _g, idxs in ordered]
 
         (mean, var, corr_label, corr, zmin, zmax), spearman_out, conts = \
             jax.device_get((moments_dev, spearman_dev, conts_dev))

@@ -24,6 +24,10 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 __all__ = ["DirectoryStreamReader"]
 
 
+class _NoReaderError(ValueError):
+    """Unknown file extension — a configuration error, not a bad file."""
+
+
 class DirectoryStreamReader:
     """Poll a directory and yield each new data file's records as a batch.
 
@@ -39,15 +43,13 @@ class DirectoryStreamReader:
                  = None,
                  new_files_only: bool = False,
                  poll_interval_s: float = 1.0,
-                 settle_s: float = 0.5,
-                 key_fn: Optional[Callable[[Dict], str]] = None):
+                 settle_s: float = 0.5):
         self.path = path
         self.pattern = pattern
         self.reader_for = reader_for
         self.new_files_only = new_files_only
         self.poll_interval_s = poll_interval_s
         self.settle_s = settle_s
-        self.key_fn = key_fn
         self._seen: set = set()
         if new_files_only:
             self._seen.update(self._snapshot())
@@ -63,7 +65,7 @@ class DirectoryStreamReader:
         if ext == ".csv":
             from .data_readers import CSVAutoReader
             return CSVAutoReader(fp).read_records()
-        raise ValueError(
+        raise _NoReaderError(
             f"no reader for {fp!r} — pass reader_for= for custom formats "
             "(StreamingReaders.customStream analog)")
 
@@ -79,14 +81,27 @@ class DirectoryStreamReader:
     # -- the stream --------------------------------------------------------
     def _take_next(self) -> Optional[List[Dict[str, Any]]]:
         """Consume ONE settled unseen file (oldest first) — files are
-        marked seen one at a time, so a consumer that stops at
-        ``max_batches`` leaves later files unread and re-offered on the
-        next poll, never silently dropped."""
+        marked seen one at a time AFTER a successful read, so a consumer
+        that stops at ``max_batches`` leaves later files re-offered on
+        the next poll, never silently dropped. A file whose read RAISES
+        (corrupt, vanished mid-read) is logged, marked seen and skipped
+        — retrying it every poll would wedge the stream forever."""
+        import logging
         for fp in self._snapshot():
             if fp in self._seen or not self._ready(fp):
                 continue
+            try:
+                recs = self._read_file(fp)
+            except _NoReaderError:
+                raise               # unknown extension: caller error
+            except Exception:
+                logging.getLogger(__name__).warning(
+                    "stream reader skipping unreadable file %s",
+                    fp, exc_info=True)
+                self._seen.add(fp)
+                continue
             self._seen.add(fp)
-            return self._read_file(fp)
+            return recs
         return None
 
     def poll_once(self) -> List[List[Dict[str, Any]]]:

@@ -135,13 +135,16 @@ def average_ranks(v: np.ndarray) -> np.ndarray:
 
 
 def spearman_with_label(X: np.ndarray, y: np.ndarray,
-                        label_corr_only: bool = True):
+                        label_corr_only: bool = True,
+                        host: bool = False):
     """Spearman rank correlation of each column with the label: ranks are
     built per column on host (ties averaged), then the Pearson moments of
     the ranks run on device (``Statistics.corr(..., "spearman")``
     semantics, SanityChecker.scala:634-638). Returns device arrays
     (corr_label, corr) — fetch lazily/batched with ``jax.device_get``.
-    The SanityChecker's spearman gate routes through this function."""
+    ``host=True`` runs the rank gram through :func:`moments_host`
+    instead (the SanityChecker's slow-link gate applies here too — the
+    rank matrix is as big as X)."""
     Xn = np.asarray(X)
     dtype = (Xn.dtype if np.issubdtype(Xn.dtype, np.floating)
              else np.float64)
@@ -149,6 +152,10 @@ def spearman_with_label(X: np.ndarray, y: np.ndarray,
     for j in range(Xn.shape[1]):
         Xr[:, j] = average_ranks(Xn[:, j])
     yr = average_ranks(np.asarray(y)).astype(dtype)
+    if host:
+        _mean, _var, corr_label, corr, _zmin, _zmax = moments_host(
+            Xr, yr, label_corr_only=label_corr_only)
+        return corr_label, corr
     _mean, _var, corr_label, corr, _zmin, _zmax = moments(
         jnp.asarray(Xr), jnp.asarray(yr), label_corr_only=label_corr_only)
     return corr_label, corr
