@@ -262,3 +262,49 @@ def test_ragged_grid_chunk_parity(rng):
         return np.array([r.mean_metric for r in summ.results])
 
     np.testing.assert_allclose(sweep(None), sweep(3), rtol=1e-5)
+
+
+def test_balancer_physical_sample():
+    """physical_sample drops rows Bernoulli(fraction) for fractions < 1
+    (Spark's rebalance/maxTrainingSample), deterministically per seed;
+    up-weights stay weights; balanced small data is untouched."""
+    import numpy as np
+    rng = np.random.default_rng(1)
+
+    # big balanced data beyond max_training_sample: uniform downsample
+    b = DataBalancer(sample_fraction=0.1, max_training_sample=50_000,
+                     seed=9)
+    y = (rng.random(200_000) < 0.4).astype(float)
+    b.pre_validation_prepare(y)
+    w = b.sample_weights(y)
+    keep, w2 = b.physical_sample(y, w)
+    assert keep is not None
+    # expected mass preserved: kept rows ~= frac * n, weights reset to 1
+    assert abs(keep.sum() - w.sum()) < 4 * np.sqrt(w.sum())
+    assert np.all(w2 == 1.0)
+    # deterministic per seed
+    b2 = DataBalancer(sample_fraction=0.1, max_training_sample=50_000,
+                      seed=9)
+    b2.pre_validation_prepare(y)
+    keep2, _ = b2.physical_sample(y, b2.sample_weights(y))
+    assert np.array_equal(keep, keep2)
+
+    # imbalanced: minority up-weight survives as a weight on ALL its rows
+    b3 = DataBalancer(sample_fraction=0.3, seed=9)
+    y3 = np.zeros(10_000); y3[:200] = 1.0
+    b3.pre_validation_prepare(y3)
+    w3 = b3.sample_weights(y3)
+    up = b3._pos_weight
+    assert up > 1.0
+    keep3, w3k = b3.physical_sample(y3, w3)
+    y3k = y3[keep3]
+    assert (y3k == 1).sum() == 200              # minority fully kept
+    assert np.all(w3k[y3k == 1] == up)          # ... at its up-weight
+    assert np.all(w3k[y3k == 0] == 1.0)
+
+    # small balanced data: no sampling at all
+    b4 = DataBalancer(sample_fraction=0.1)
+    y4 = (rng.random(1_000) < 0.4).astype(float)
+    b4.pre_validation_prepare(y4)
+    keep4, _ = b4.physical_sample(y4, b4.sample_weights(y4))
+    assert keep4 is None

@@ -65,25 +65,19 @@ _PROBE: Optional[bool] = None
 _PROBE_LOCK = _threading.Lock()
 
 
-def _probe_lock():
-    return _PROBE_LOCK
-
-
 def warm_probe_async() -> None:
     """Kick the one-time kernel compile probe on a background thread —
     XLA compilation releases the GIL, so callers with a cold process
     (bench.py before its first config) overlap the ~10-15 s tunnel
     compile with data loading instead of paying it inside the first
     tree-family sweep."""
-    import threading
-
     def _go():
         try:
             pallas_histograms_enabled()
         except Exception:           # probe failures fall back at consult
             pass
-    threading.Thread(target=_go, name="pallas-probe-warm",
-                     daemon=True).start()
+    _threading.Thread(target=_go, name="pallas-probe-warm",
+                      daemon=True).start()
 
 #: Kernel row alignment. **Rows live in the LANE dimension**: per-row
 #: vectors (slot/g/stats channels) travel as rows of a small [k ≤ 8, n]
@@ -569,7 +563,7 @@ def pallas_histograms_enabled() -> bool:
         detector = getattr(_core, "trace_state_clean", None)
         if detector is not None and not detector():
             return False
-        with _probe_lock():
+        with _PROBE_LOCK:
             return _probe_locked(detector)
     return _PROBE
 

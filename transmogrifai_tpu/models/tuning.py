@@ -176,15 +176,39 @@ class DataBalancer(Splitter):
 
     def physical_sample(self, y: np.ndarray, w: np.ndarray
                         ) -> Tuple[Optional[np.ndarray], np.ndarray]:
-        """Bernoulli(fraction) row drop for fractions < 1 (the reference's
-        ``rebalance``/``maxTrainingSample`` sampling); up-weights (> 1)
-        stay as weights — deterministic per seed, so repeated sweeps see
-        identical shapes and the executable cache still hits."""
+        """EXACT-count per-class row sampling for fractions < 1 (the
+        reference's ``rebalance``/``maxTrainingSample`` sampling);
+        up-weights (> 1) stay as weights.
+
+        Exact counts (not Bernoulli draws) make the sampled row count a
+        deterministic function of the class fractions: every config in
+        the uniform-downsample branch lands on EXACTLY
+        ``round(Σ fraction) ≈ maxTrainingSample`` rows, so a 2M-row and
+        a 10M-row sweep share identical array shapes — and therefore
+        every compiled (fold × grid) executable. That turned the 10M
+        BASELINE config's fresh ~250 s compile bill into cache hits."""
         frac = np.minimum(w, 1.0)
         if bool((frac >= 1.0 - 1e-12).all()):
             return None, w
         rng = np.random.default_rng(self.seed + 0x5EED)
-        keep = rng.random(len(w)) < frac
+        keep = np.zeros(len(w), dtype=bool)
+        target = int(round(float(frac.sum())))
+        classes = [c for c in (0.0, 1.0) if (y == c).any()] or [None]
+        remaining = target
+        for ci, cls in enumerate(classes):
+            idx = (np.nonzero(y == cls)[0] if cls is not None
+                   else np.arange(len(y)))
+            f = float(frac[idx[0]])
+            if f >= 1.0 - 1e-12:
+                keep[idx] = True
+                remaining -= len(idx)
+                continue
+            k = (int(round(f * len(idx))) if ci < len(classes) - 1
+                 else remaining)            # last class absorbs rounding
+            k = int(np.clip(k, 0, len(idx)))
+            sel = rng.choice(len(idx), size=k, replace=False)
+            keep[idx[sel]] = True
+            remaining -= k
         return keep, np.maximum(w, 1.0)[keep]
 
 

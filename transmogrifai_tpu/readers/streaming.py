@@ -93,7 +93,12 @@ class DirectoryStreamReader:
             try:
                 recs = self._read_file(fp)
             except _NoReaderError:
-                raise               # unknown extension: caller error
+                # unknown extension: a CONFIGURATION gap, but the file
+                # must still be marked seen before raising or it wedges
+                # the stream (every later poll re-hits it) and blocks
+                # the readable files behind it
+                self._seen.add(fp)
+                raise
             except Exception:
                 logging.getLogger(__name__).warning(
                     "stream reader skipping unreadable file %s",
