@@ -138,3 +138,31 @@ def test_evaluator_factory():
     ev2 = Evaluators.Regression.rmse()
     assert ev2.metric_name == "RootMeanSquaredError"
     assert not ev2.is_larger_better
+
+
+def test_predict_host_matches_device(monkeypatch):
+    """The slow-link host predict mirrors the device math: force the
+    bandwidth gate low and compare the triples on a big-enough matrix."""
+    import numpy as np
+    from transmogrifai_tpu.models.linear import (LogisticRegressionModel,
+                                                 LinearRegressionModel,
+                                                 NaiveBayesModel)
+    from transmogrifai_tpu import workflow as wf
+
+    rng = np.random.default_rng(0)
+    n, d = 4000, 520                     # n*d >= 2e6 engages the gate
+    X = rng.normal(size=(n, d)).astype(np.float32)
+
+    lr = LogisticRegressionModel(rng.normal(size=d), 0.3, 2)
+    mlr = LogisticRegressionModel(rng.normal(size=(3, d)),
+                                  rng.normal(size=3), 3)
+    lin = LinearRegressionModel(rng.normal(size=d), -0.7)
+    nb = NaiveBayesModel(np.log([0.2, 0.8]),
+                         -np.abs(rng.normal(size=(2, d))))
+
+    device = [m.predict_arrays(X) for m in (lr, mlr, lin, nb)]
+    monkeypatch.setattr(wf, "_DEVICE_BW_MBPS", 1.0)   # force slow link
+    host = [m.predict_arrays(X) for m in (lr, mlr, lin, nb)]
+    for dev, hst in zip(device, host):
+        for a, b in zip(dev, hst):
+            np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)
