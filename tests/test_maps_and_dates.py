@@ -185,3 +185,60 @@ def test_extract_key_through_workflow(rng):
     out = model.score(store)[filled.name]
     assert out.mask.all() or not np.isnan(
         np.asarray(out.values, dtype=float)).any()
+
+
+def test_map_vectorize_fill_options():
+    """RichMapFeature.vectorize's fill surface: default_value fills
+    missing keys when fillWithMean/-Mode are off; per-key mean is the
+    default (RichMapFeature.scala:497-540,665-696)."""
+    import numpy as np
+    from transmogrifai_tpu import FeatureBuilder, Workflow
+    from transmogrifai_tpu.columns import ColumnStore
+    from transmogrifai_tpu.ops.maps import MapVectorizer
+    from transmogrifai_tpu.types import feature_types as ft
+
+    rows = [{"a": 1.0, "b": 10.0}, {"a": 3.0}, {"b": 20.0}]
+    store = ColumnStore.from_dict({"m": (ft.RealMap, rows)})
+
+    def run(**kw):
+        m = FeatureBuilder.RealMap("m").from_column().as_predictor()
+        stage = MapVectorizer(track_nulls=False, **kw)
+        stage.set_input(m)
+        vec = stage.get_output()
+        model = (Workflow().set_input_store(store)
+                 .set_result_features(vec).train())
+        out = model.transform(store)
+        meta = out[vec.name].metadata
+        cols = {c.grouping: i for i, c in enumerate(meta.columns)}
+        return out[vec.name].values, cols
+
+    vals, cols = run()                                 # mean fill default
+    assert vals[2, cols["a"]] == 2.0                   # mean of 1, 3
+    vals2, cols2 = run(fill_with_mean=False, default_value=-5.0)
+    assert vals2[2, cols2["a"]] == -5.0
+    assert vals2[1, cols2["b"]] == -5.0
+
+
+def test_map_vectorize_integral_mode_fill():
+    """fill_with_mode on IntegralMap: mode fill by default, fixed fill
+    when disabled."""
+    from transmogrifai_tpu import FeatureBuilder, Workflow
+    from transmogrifai_tpu.columns import ColumnStore
+    from transmogrifai_tpu.ops.maps import MapVectorizer
+    from transmogrifai_tpu.types import feature_types as ft
+
+    rows = [{"k": 7}, {"k": 7}, {"k": 2}, {}]
+    store = ColumnStore.from_dict({"m": (ft.IntegralMap, rows)})
+
+    def run(**kw):
+        m = FeatureBuilder.IntegralMap("m").from_column().as_predictor()
+        stage = MapVectorizer(track_nulls=False, **kw)
+        stage.set_input(m)
+        vec = stage.get_output()
+        model = (Workflow().set_input_store(store)
+                 .set_result_features(vec).train())
+        return model.transform(store)[vec.name].values
+
+    assert run()[3, 0] == 7.0                       # mode fill
+    assert run(fill_with_mode=False,
+               default_value=42.0)[3, 0] == 42.0    # fixed fill

@@ -564,6 +564,8 @@ def _vectorize(self: Feature, *others: Feature,
                track_invalid: Optional[bool] = None,
                num_features: Optional[int] = None,
                fill_with_mean: Optional[bool] = None,
+               fill_with_mode: Optional[bool] = None,
+               default_value: Optional[float] = None,
                allow_keys: Optional[Sequence[str]] = None,
                block_keys: Sequence[str] = ()):
     """One-call vectorization of this feature (+ same-typed ``others``)
@@ -585,7 +587,9 @@ def _vectorize(self: Feature, *others: Feature,
                     ("TRACK_NULLS", track_nulls),
                     ("TRACK_INVALID", track_invalid),
                     ("HASH_SIZE", num_features),
-                    ("FILL_WITH_MEAN", fill_with_mean)):
+                    ("FILL_WITH_MEAN", fill_with_mean),
+                    ("FILL_WITH_MODE", fill_with_mode),
+                    ("FILL_VALUE", default_value)):
         if v is not None:
             setattr(_Defaults, attr, v)
     return Transmogrifier.vectorize(feats, _Defaults)

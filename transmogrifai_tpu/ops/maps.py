@@ -135,11 +135,23 @@ class MapVectorizer(VectorizerEstimator):
     def __init__(self, top_k: int = TransmogrifierDefaults.TOP_K,
                  min_support: int = TransmogrifierDefaults.MIN_SUPPORT,
                  track_nulls: bool = TransmogrifierDefaults.TRACK_NULLS,
+                 default_value: Optional[float] = None,
+                 fill_with_mean: bool = True,
+                 fill_with_mode: bool = True,
                  uid: Optional[str] = None):
+        """``default_value`` / ``fill_with_mean`` / ``fill_with_mode``
+        mirror RichMapFeature.vectorize's per-call fill surface
+        (``core/.../dsl/RichMapFeature.scala:497-540,665-696``): a fixed
+        fill for missing keys, or the per-key train mean (Real maps) /
+        mode (Integral maps) when the respective flag is on (the
+        reference's ``fillWithMean``/``fillWithMode`` semantics)."""
         super().__init__(uid=uid)
         self.top_k = top_k
         self.min_support = min_support
         self.track_nulls = track_nulls
+        self.default_value = default_value
+        self.fill_with_mean = fill_with_mean
+        self.fill_with_mode = fill_with_mode
 
     def _discover_keys(self, store: ColumnStore) -> List[List[str]]:
         out = []
@@ -163,18 +175,22 @@ class MapVectorizer(VectorizerEstimator):
                     "periods": TransmogrifierDefaults.CIRCULAR_DATE_REPRESENTATIONS,
                     "track_nulls": self.track_nulls}
             else:
+                base_fill = (0.0 if self.default_value is None
+                             else float(self.default_value))
                 fills = []
                 for n in exploded_names:
                     col = exploded[n]
-                    if elem == ft.ColumnKind.REAL and col.mask.any():
+                    if (elem == ft.ColumnKind.REAL and self.fill_with_mean
+                            and col.mask.any()):
                         fills.append(float(
                             col.values[col.mask].astype(np.float64).mean()))
-                    elif elem == ft.ColumnKind.INTEGRAL and col.mask.any():
+                    elif (elem == ft.ColumnKind.INTEGRAL
+                            and self.fill_with_mode and col.mask.any()):
                         vals, counts = np.unique(col.values[col.mask],
                                                  return_counts=True)
                         fills.append(float(vals[np.argmax(counts)]))
                     else:
-                        fills.append(0.0)
+                        fills.append(base_fill)
                 delegate_cls, params = "NumericVectorizerModel", {
                     "fill_values": fills, "track_nulls": self.track_nulls,
                     "ftype_name": ftype.__name__}
@@ -283,7 +299,10 @@ def vectorize_maps(features: Sequence[Feature],
     for ftype, feats in sorted(by_type.items(), key=lambda kv: kv[0].__name__):
         stage = MapVectorizer(top_k=defaults.TOP_K,
                               min_support=defaults.MIN_SUPPORT,
-                              track_nulls=defaults.TRACK_NULLS)
+                              track_nulls=defaults.TRACK_NULLS,
+                              default_value=defaults.FILL_VALUE,
+                              fill_with_mean=defaults.FILL_WITH_MEAN,
+                              fill_with_mode=defaults.FILL_WITH_MODE)
         out.append(feats[0].transform_with(stage, *feats[1:]))
     return out
 

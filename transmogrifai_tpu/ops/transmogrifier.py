@@ -101,12 +101,19 @@ class Transmogrifier:
             blocks.append(feats[0].transform_with(stage, *feats[1:]))
 
         if "real" in groups:
-            wire(RealVectorizer(track_nulls=defaults.TRACK_NULLS), groups["real"])
+            wire(RealVectorizer(track_nulls=defaults.TRACK_NULLS,
+                                fill_with_mean=defaults.FILL_WITH_MEAN,
+                                fill_value=defaults.FILL_VALUE),
+                 groups["real"])
         if "integral" in groups:
-            wire(IntegralVectorizer(track_nulls=defaults.TRACK_NULLS),
+            wire(IntegralVectorizer(track_nulls=defaults.TRACK_NULLS,
+                                    fill_with_mode=defaults.FILL_WITH_MODE,
+                                    fill_value=defaults.FILL_VALUE),
                  groups["integral"])
         if "binary" in groups:
-            wire(BinaryVectorizer(track_nulls=defaults.TRACK_NULLS),
+            wire(BinaryVectorizer(
+                track_nulls=defaults.TRACK_NULLS,
+                fill_value=defaults.BINARY_FILL_VALUE),
                  groups["binary"])
         if "date" in groups:
             wire(DateToUnitCircleVectorizer(
