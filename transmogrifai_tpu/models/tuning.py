@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -422,7 +423,11 @@ def _auto_chunks(family, n_rows: int, n_shards: int, n_folds: int,
         # Titanic scale (~900 rows) loses ~20%; the crossover gate is
         # per-shard by construction.
         family._max_instances = max_instances
-        family._tree_chunk_cap = 1 if rows < 32_768 else 4
+        # TMOG_TREE_CHUNK_CAP overrides the bootstrap batch cap for
+        # perf experiments (HBM budget still bounds the realized chunk)
+        _cap_env = os.environ.get("TMOG_TREE_CHUNK_CAP")
+        family._tree_chunk_cap = (int(_cap_env) if _cap_env
+                                  else (1 if rows < 32_768 else 4))
         family._tree_chunk_auto = 1
     if max_instances >= g * n_folds:
         family.grid_chunk = None
