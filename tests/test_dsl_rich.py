@@ -293,3 +293,67 @@ def test_prediction_tupled():
     np.testing.assert_allclose(probm.sum(axis=1), 1.0, atol=1e-5)
     rawm = out[raw.name].values
     assert rawm.shape == (n, 2)
+
+
+def test_rich_feature_value_surface():
+    """RichFeature residue: replaceWith / filter / filterNot / collect /
+    exists / occurs (RichFeature.scala:61-205)."""
+    vals = ["a", "b", None, "a", "c"]
+    store = ColumnStore.from_dict({"t": (ft.PickList, vals)})
+    t = FeatureBuilder.PickList("t").from_column().as_predictor()
+    rep = t.replace_with("a", "z")
+    fil = t.filter_values(lambda v: v in ("a", "b"), "OTHER")
+    fnot = t.filter_not(lambda v: v == "a", "X")
+    col = t.collect(lambda v: v.upper() if v == "b" else None, "D")
+    ex = t.exists(lambda v: v == "c")
+    oc = t.occurs()
+    _, out = _train(store, rep, fil, fnot, col, ex, oc)
+    g = lambda f: [out[f.name].get_raw(i) for i in range(len(vals))]
+    assert g(rep) == ["z", "b", None, "z", "c"]
+    assert g(fil) == ["a", "b", "OTHER", "a", "OTHER"]
+    # None: p(None) is False, so filter_not KEEPS it (matches the
+    # reference where the predicate sees the empty value)
+    assert g(fnot) == ["X", "b", None, "X", "c"]
+    assert g(col) == ["D", "B", "D", "D", "D"]
+    assert g(ex) == [0.0, 0.0, 0.0, 0.0, 1.0]
+    assert g(oc) == [1.0, 1.0, 0.0, 1.0, 1.0]
+
+
+def test_drop_indices_by():
+    """RichVectorFeature.dropIndicesBy: metadata-predicate column drop."""
+    vals = ["x", "y", "x", None]
+    store = ColumnStore.from_dict({"p": (ft.PickList, vals)})
+    p = FeatureBuilder.PickList("p").from_column().as_predictor()
+    vec = p.pivot(top_k=5, min_support=1)
+    dropped = vec.drop_indices_by(
+        lambda cm: cm.indicator_value == "NullIndicatorValue")
+    _, out = _train(store, vec, dropped)
+    full = out[vec.name]
+    slim = out[dropped.name]
+    assert slim.values.shape[1] == full.values.shape[1] - 1
+    assert not any(c.indicator_value == "NullIndicatorValue"
+                   for c in slim.metadata.columns)
+
+
+def test_date_list_conversions_and_value_op_io(tmp_path):
+    """to_date_list/to_date_time_list (RichDateFeature :54,:124) + the
+    value-op surface survives model save/load (fn_io round-trip)."""
+    from transmogrifai_tpu.model_io import (load_workflow_model,
+                                            save_workflow_model)
+    ts = [1471046600000, None, 1471046700000]
+    store = ColumnStore.from_dict({
+        "d": (ft.Date, ts), "t": (ft.PickList, ["a", "b", None])})
+    d = FeatureBuilder.Date("d").from_column().as_predictor()
+    t = FeatureBuilder.PickList("t").from_column().as_predictor()
+    dl = d.to_date_list()
+    oc = t.occurs()
+    ex = t.exists(lambda v: v == "b")
+    model, out = _train(store, dl, oc, ex)
+    assert out[dl.name].get_raw(0) == [ts[0]]
+    assert out[dl.name].get_raw(1) == []
+    save_workflow_model(model, str(tmp_path / "m"))
+    loaded = load_workflow_model(str(tmp_path / "m"))
+    out2 = loaded.transform(store)
+    assert [out2[oc.name].get_raw(i) for i in range(3)] == [1.0, 1.0, 0.0]
+    assert [out2[ex.name].get_raw(i) for i in range(3)] == [0.0, 1.0, 0.0]
+    assert out2[dl.name].get_raw(2) == [ts[2]]

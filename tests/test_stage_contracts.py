@@ -558,6 +558,8 @@ EXEMPT = {
     "PredictionDeIndexer": "needs labelled metadata; test_vectorizers",
     "PredictionDeIndexerModel": "fitted product of PredictionDeIndexer",
     "MapTransformer": "lambda-carrying; covered in test_workflow_io",
+    "ValueOpTransformer": "lambda-carrying; covered in test_dsl_rich "
+                          "(value surface + save/load round-trip)",
     "SanityChecker": "label-aware column selection; test_sanity_checker",
     "SanityCheckerModel": "fitted product of SanityChecker",
     "RecordInsightsCorr": "needs a PredictionColumn input; test_insights",

@@ -776,6 +776,163 @@ def _is_valid_phone_map(self: Feature, default_region: str = "US"):
     return _map_to(self, f, _ft().BinaryMap, "isValidPhoneMapDefaultCountry")
 
 
+@register_stage
+class ValueOpTransformer(Transformer):
+    """RichFeature value-surface ops (replaceWith / filter / filterNot /
+    collect / exists / occurs, ``RichFeature.scala:61-205``) as ONE
+    registered stage: the op's semantics live here, and only the USER's
+    predicate/partial function is serialized (via utils.fn_io, exactly
+    like MapTransformer) — wrapping the user fn in a closure would make
+    every such model unpersistable (fn_io cannot marshal captured
+    function objects)."""
+
+    def __init__(self, op: str = "exists", fn: Callable[[Any], Any] = None,
+                 default: Any = None, old_val: Any = None,
+                 new_val: Any = None,
+                 input_type: Type[ft.FeatureType] = ft.FeatureType,
+                 output_type: Type[ft.FeatureType] = ft.FeatureType,
+                 uid: Optional[str] = None):
+        super().__init__(uid=uid)
+        if isinstance(fn, dict):        # decoded from model.json
+            from .utils.fn_io import decode_fn
+            fn = decode_fn(fn)
+        self.op = op
+        self.fn = fn
+        self.default = default
+        self.old_val = old_val
+        self.new_val = new_val
+        self._input_type = input_type
+        self.output_type = output_type
+        self.operation_name = op
+
+    @property
+    def input_spec(self) -> InputSpec:
+        return FixedArity(self._input_type)
+
+    def get_params(self):
+        from .utils.fn_io import encode_fn
+        p = super().get_params()
+        p["fn"] = encode_fn(self.fn) if self.fn is not None else None
+        p["input_type"] = self._input_type
+        return p
+
+    @staticmethod
+    def _present(v) -> bool:
+        if v is None:
+            return False
+        if isinstance(v, (list, set, dict, str)):
+            return len(v) > 0
+        return True
+
+    def _apply(self, v):
+        op, fn = self.op, self.fn
+        if op == "replaceWith":
+            return self.new_val if v == self.old_val else v
+        if op == "filter":
+            return v if fn(v) else self.default
+        if op == "filterNot":
+            return self.default if fn(v) else v
+        if op == "collect":
+            out = fn(v)
+            return self.default if out is None else out
+        if op == "exists":
+            return bool(v is not None and fn(v))
+        if op == "occurs":
+            if fn is None:
+                return 1.0 if self._present(v) else 0.0
+            return 1.0 if (v is not None and fn(v)) else 0.0
+        raise ValueError(f"unknown value op {op!r}")
+
+    def transform_columns(self, store: ColumnStore) -> Column:
+        col = store[self.input_features[0].name]
+        return column_from_values(
+            self.output_type,
+            [self._apply(col.get_raw(i)) for i in range(len(col))])
+
+
+def _value_op(self: Feature, output_type, **kw):
+    stage = ValueOpTransformer(input_type=self.ftype,
+                               output_type=output_type, **kw)
+    stage.set_input(self)
+    return stage.get_output()
+
+
+def _to_date_list(self: Feature):
+    """Date → DateList of the single timestamp (RichDateFeature.toDateList
+    :54-60); empty date → empty list."""
+    return _map_to(self, lambda v: [] if v is None else [int(v)],
+                   _ft().DateList, "toDateList")
+
+
+def _to_date_time_list(self: Feature):
+    """DateTime → DateTimeList (RichDateFeature.toDateTimeList :124-130)."""
+    return _map_to(self, lambda v: [] if v is None else [int(v)],
+                   _ft().DateTimeList, "toDateTimeList")
+
+
+def _replace_with(self: Feature, old_val, new_val):
+    """Swap one value for another, same type (RichFeature.replaceWith
+    :75-77)."""
+    return _value_op(self, self.ftype, op="replaceWith",
+                     old_val=old_val, new_val=new_val)
+
+
+def _filter_values(self: Feature, predicate, default):
+    """Keep values passing ``predicate``; others become ``default``
+    (RichFeature.filter :134-140)."""
+    return _value_op(self, self.ftype, op="filter", fn=predicate,
+                     default=default)
+
+
+def _filter_not(self: Feature, predicate, default):
+    """RichFeature.filterNot (:148-150)."""
+    return _value_op(self, self.ftype, op="filterNot", fn=predicate,
+                     default=default)
+
+
+def _collect(self: Feature, fn, default, output_type=None):
+    """Partial transform: ``fn(value)`` where it returns non-None, else
+    ``default`` (RichFeature.collect :160-168 — Python spells a partial
+    function as an fn returning None off-domain)."""
+    return _value_op(self, output_type or self.ftype, op="collect",
+                     fn=fn, default=default)
+
+
+def _exists(self: Feature, predicate):
+    """Binary: does the (non-null) value satisfy ``predicate``
+    (RichFeature.exists :176-182)."""
+    return _value_op(self, _ft().Binary, op="exists", fn=predicate)
+
+
+def _occurs(self: Feature, match_fn=None):
+    """RealNN 1.0/0.0 occurrence indicator (RichFeature.occurs
+    :190-205): default = value is present/non-empty."""
+    return _value_op(self, _ft().RealNN, op="occurs", fn=match_fn)
+
+
+def _drop_indices_by(self: Feature, match_fn):
+    """OPVector → OPVector with the metadata-matched columns dropped
+    (RichVectorFeature.dropIndicesBy :139 → DropIndicesByTransformer):
+    ``match_fn(VectorColumnMetadata) -> bool`` selects columns to DROP.
+    Requires vector metadata (vectorizer outputs always carry it)."""
+    from .columns import VectorColumn
+    from .stages.base import LambdaTransformer
+    ftx = _ft()
+
+    def fn(col):
+        assert isinstance(col, VectorColumn) and col.metadata is not None, \
+            "dropIndicesBy needs a metadata-carrying OPVector"
+        keep = [i for i, cm in enumerate(col.metadata.columns)
+                if not match_fn(cm)]
+        meta = col.metadata.select(keep)
+        return VectorColumn(ftx.OPVector, col.values[:, keep], meta)
+
+    stage = LambdaTransformer("dropIndicesBy", fn, [ftx.OPVector],
+                              ftx.OPVector)
+    stage.set_input(self)
+    return stage.get_output()
+
+
 def _tupled(self: Feature):
     """Prediction → (prediction RealNN, rawPrediction OPVector,
     probability OPVector) (RichPredictionFeature.tupled :1098-1111)."""
@@ -869,6 +1026,15 @@ Feature.is_valid_email = _is_valid_email
 Feature.is_valid_url = _is_valid_url
 Feature.parse_phone = _parse_phone
 Feature.to_multi_pick_list = _to_multi_pick_list
+Feature.to_date_list = _to_date_list
+Feature.to_date_time_list = _to_date_time_list
+Feature.replace_with = _replace_with
+Feature.filter_values = _filter_values
+Feature.filter_not = _filter_not
+Feature.collect = _collect
+Feature.exists = _exists
+Feature.occurs = _occurs
+Feature.drop_indices_by = _drop_indices_by
 Feature.vectorize_location = _vectorize_location
 Feature.to_email_domain_map = _to_email_domain_map
 Feature.to_url_domain_map = _to_url_domain_map

@@ -426,8 +426,15 @@ def _auto_chunks(family, n_rows: int, n_shards: int, n_folds: int,
         # TMOG_TREE_CHUNK_CAP overrides the bootstrap batch cap for
         # perf experiments (HBM budget still bounds the realized chunk)
         _cap_env = os.environ.get("TMOG_TREE_CHUNK_CAP")
-        family._tree_chunk_cap = (int(_cap_env) if _cap_env
-                                  else (1 if rows < 32_768 else 4))
+        if _cap_env:
+            try:
+                family._tree_chunk_cap = max(1, int(_cap_env))
+            except ValueError:
+                raise ValueError(
+                    f"TMOG_TREE_CHUNK_CAP must be an integer, "
+                    f"got {_cap_env!r}") from None
+        else:
+            family._tree_chunk_cap = 1 if rows < 32_768 else 4
         family._tree_chunk_auto = 1
     if max_instances >= g * n_folds:
         family.grid_chunk = None
