@@ -196,3 +196,32 @@ def test_directory_stream_reader(tmp_path):
     assert r2.poll_once() == []
     (d / "d.csv").write_text("x,y\n5,five\n")
     assert r2.read_records() == [{"x": "5", "y": "five"}]
+
+
+def test_directory_stream_reader_error_paths(tmp_path, caplog):
+    """Corrupt files are logged + skipped (not retried forever, not
+    stream-fatal); files behind them still flow; unknown extensions
+    raise a configuration error."""
+    import logging
+
+    import pytest
+
+    from transmogrifai_tpu.readers import DirectoryStreamReader
+
+    d = tmp_path / "in"
+    d.mkdir()
+    (d / "a.avro").write_bytes(b"not an avro container at all")
+    (d / "b.csv").write_text("x\n1\n")
+    r = DirectoryStreamReader(str(d), pattern="*", settle_s=0.0)
+    with caplog.at_level(logging.WARNING):
+        batches = list(r.stream(max_batches=1, timeout_s=3.0))
+    assert batches == [[{"x": "1"}]]          # corrupt a.avro skipped
+    assert any("skipping unreadable" in rec.message
+               for rec in caplog.records)
+    assert r.poll_once() == []                # corrupt file not retried
+
+    (d / "c.weird").write_text("zzz")
+    r2 = DirectoryStreamReader(str(d), new_files_only=False, settle_s=0.0)
+    with pytest.raises(ValueError, match="no reader"):
+        with caplog.at_level(logging.WARNING):
+            list(r2.stream(max_batches=5, timeout_s=1.0))

@@ -197,3 +197,48 @@ def test_word2vec_embeddings(rng):
     assert cos(vec["king"], vec["queen"]) > cos(vec["king"], vec["banana"])
     out = model.transform(store)
     assert np.asarray(out[model.output_name].values).shape == (400, 16)
+
+
+def test_hashed_text_block_native_parity(rng):
+    """The fused C++ tokenize+hash+scatter kernel is bit-exact with the
+    Python tokenize_simple+murmur3 path for ASCII text, and routes
+    non-ASCII rows through the exact Python fallback (the parity claim
+    native/fasthash.cc makes)."""
+    import numpy as np
+    from transmogrifai_tpu.ops._hostvec import hashed_text_block
+    from transmogrifai_tpu.ops.hashing import _load_native, hash_tokens
+    from transmogrifai_tpu.ops.text import tokenize_simple
+
+    import pytest
+    lib = _load_native()
+    if not lib or getattr(lib, "tokenized_hash_counts", None) is None:
+        pytest.skip("native kernel unavailable: the comparison would be "
+                    "the Python path against itself")
+
+    alphabet = list("abcXYZ0189_'() .,-!@é漢")
+    texts = []
+    for i in range(600):
+        n_tok = int(rng.integers(0, 8))
+        texts.append(" ".join(
+            "".join(rng.choice(alphabet, size=int(rng.integers(1, 10))))
+            for _ in range(n_tok)))
+    texts += [None, "", "don't stop", "a_b c3", "Ümlaut mixé", "…", "x"]
+    n, W, seed = len(texts), 64, 7
+
+    out = np.zeros((n, W + 3), np.float32)      # wider mat + offset slice
+    nullf = hashed_text_block(texts, W, seed, False, out=out, col_offset=2)
+    ref = np.zeros((n, W), np.float32)
+    for i, t in enumerate(texts):
+        for tok in tokenize_simple(t or ""):
+            ref[i, int(hash_tokens([tok], seed)[0]) % W] += 1
+    np.testing.assert_array_equal(out[:, 2:2 + W], ref)
+    assert out[:, :2].sum() == 0 and out[:, 2 + W:].sum() == 0
+    np.testing.assert_array_equal(
+        nullf, np.asarray([t is None for t in texts], np.float32))
+
+    # binary_freq: presence flags, idempotent across repeated calls on
+    # the SAME buffer (assignment, not accumulation)
+    out_b = np.zeros((n, W), np.float32)
+    hashed_text_block(texts, W, seed, True, out=out_b)
+    hashed_text_block(texts, W, seed, True, out=out_b)
+    np.testing.assert_array_equal(out_b, (ref > 0).astype(np.float32))
