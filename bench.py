@@ -211,6 +211,113 @@ def _apply_cpu_denominator(cpu: dict, configs: dict,
             cpu_rows
 
 
+def _scoring_throughput() -> dict:
+    """Serving-path benchmark: one fitted LR workflow scored three ways —
+    the per-layer reference path (one host↔device crossing per DAG
+    layer), the compiled batched engine (ONE fused program per bucket),
+    and the engine's overlapped streaming mode (host prep of micro-batch
+    k+1 concurrent with batch k's device compute). Reports rows/s; every
+    number states whether the engine's bandwidth gate was open."""
+    import numpy as np
+
+    from transmogrifai_tpu import (ColumnStore, FeatureBuilder, Workflow,
+                                   column_from_values)
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import \
+        BinaryClassificationModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.readers import stream_score
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.workflow import fusion_state
+
+    rows = int(os.environ.get("BENCH_SCORE_ROWS", 200_000))
+    train_rows = min(20_000, rows)
+    rng = np.random.default_rng(11)
+    y = rng.integers(0, 2, rows).astype(float)
+    xs = {f"x{j}": rng.normal(size=rows) + (0.3 * j) * y for j in range(6)}
+    cats = np.array(["a", "b", "c", "d", None], dtype=object)[
+        rng.integers(0, 5, rows)]
+
+    def store_of(sl):
+        cols = {"label": column_from_values(ft.RealNN, y[sl])}
+        for k, v in xs.items():
+            cols[k] = column_from_values(ft.Real, list(v[sl]))
+        cols["cat"] = column_from_values(ft.PickList, list(cats[sl]))
+        return ColumnStore(cols, len(y[sl]))
+
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = [FeatureBuilder.Real(f"x{j}").from_column().as_predictor()
+             for j in range(6)]
+    feats.append(FeatureBuilder.PickList("cat").from_column().as_predictor())
+    vec = transmogrify(feats)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily(
+            grid=[{"regParam": 0.01, "elasticNetParam": 0.0}])],
+        splitter=None, seed=5)
+    pred = label.transform_with(selector, vec)
+    model = (Workflow().set_input_store(store_of(slice(0, train_rows)))
+             .set_result_features(pred).train())
+    full = store_of(slice(0, rows))
+
+    out: dict = {"rows": rows, "fusion_gate": fusion_state()}
+
+    def _rate(fn, reps=2):
+        fn()                                   # warm-up (compile) pass
+        secs = []
+        for _ in range(reps):
+            t0 = time.time()
+            fn()
+            secs.append(time.time() - t0)
+        return rows / statistics.median(secs), statistics.median(secs)
+
+    rate, s = _rate(lambda: model.score(full, engine=False))
+    out["per_layer_rows_per_s"] = round(rate)
+    out["per_layer_s"] = round(s, 3)
+
+    eng = model.scoring_engine()
+    if eng is not None and eng.enabled():
+        # use_cache=False: fresh host_prepare every rep — the honest
+        # apples-to-apples number against the per-layer path above
+        rate, s = _rate(lambda: eng.score_store(full, use_cache=False))
+        out["engine_rows_per_s"] = round(rate)
+        out["engine_s"] = round(s, 3)
+        out["engine_speedup"] = round(
+            out["engine_rows_per_s"] / out["per_layer_rows_per_s"], 2)
+        # repeat-call rate: host_prepare amortized across calls on the
+        # same store (score → evaluate pattern) — device path only
+        rate, s = _rate(lambda: eng.score_store(full))
+        out["engine_repeat_rows_per_s"] = round(rate)
+        out["engine_compiles"] = eng.compile_count
+        out["bucket_cap"] = eng.bucket_cap
+
+        # streaming: record batches through the same reader contract the
+        # StreamingScore run type uses; the host record→column conversion
+        # is part of the measured (and overlapped) host work
+        records = [
+            {"label": float(y[i]), "cat": cats[i],
+             **{f"x{j}": float(xs[f"x{j}"][i]) for j in range(6)}}
+            for i in range(rows)]
+        bs = 8192
+        batches = [records[i:i + bs] for i in range(0, rows, bs)]
+
+        def drain(overlap):
+            def go():
+                for _ in stream_score(model, batches, overlap=overlap):
+                    pass
+            return go
+        rate, s = _rate(drain(False), reps=1)
+        out["stream_rows_per_s"] = round(rate)
+        rate, s = _rate(drain(True), reps=1)
+        out["stream_overlap_rows_per_s"] = round(rate)
+        out["stream_overlap_speedup"] = round(
+            out["stream_overlap_rows_per_s"] / out["stream_rows_per_s"], 2)
+        out["stream_batch_size"] = bs
+    else:
+        out["engine"] = ("gated_off: link below FUSE_MIN_BANDWIDTH_MBPS"
+                         if eng is not None else "unavailable")
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -298,6 +405,25 @@ def main() -> None:
         "phases": warm.get("phases"),
         **_mfu_fields(st["warm_flops"], warm["train_time_s"]),
     }
+    bench.emit()
+
+    # 4b. Scoring throughput (serving path): rows/s of the compiled
+    #     batched scoring engine and the overlapped streaming mode vs the
+    #     per-layer reference path, on a synthetic LR workflow. Optional
+    #     stage: budget-gated like the 10M pass (the training cost is a
+    #     small fixed 20k-row fit; measurement is pure scoring).
+    if bench.remaining() < 120:
+        configs["scoring_throughput"] = {
+            "status": "skipped_budget",
+            "remaining_budget_s": round(bench.remaining(), 1)}
+        _log(f"[bench] scoring_throughput skipped: remaining "
+             f"{bench.remaining():.0f}s < 120s")
+    else:
+        try:
+            configs["scoring_throughput"] = _scoring_throughput()
+        except Exception as e:
+            _log(f"[bench] scoring_throughput failed: {e!r}")
+            configs["scoring_throughput"] = {"error": repr(e)[:400]}
     bench.emit()
 
     # 5. Synthetic tree grid at scale (the BASELINE scale config: default

@@ -1,0 +1,440 @@
+"""Compiled batched scoring engine tests (scoring.py).
+
+Parity discipline: the engine's single-program output must match the
+per-layer reference path (``WorkflowModel._transform_layers``) and the
+row-level ``score_fn`` closure on every model family — binary,
+multiclass incl. DataCutter label de-mapping, regression — within f32
+tolerance. Plus the bucket-ladder compile guard: arbitrary batch sizes
+must never compile more programs than the ladder holds.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (ColumnStore, FeatureBuilder, Workflow,
+                               column_from_values)
+from transmogrifai_tpu.columns import VectorColumn
+from transmogrifai_tpu.models import (BinaryClassificationModelSelector,
+                                      DataCutter,
+                                      LinearRegressionFamily,
+                                      LogisticRegressionFamily,
+                                      MultiClassificationModelSelector,
+                                      RegressionModelSelector)
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.scoring import (SCORING_MIN_ROWS, ScoringEngine,
+                                       bucket_for, bucket_ladder)
+from transmogrifai_tpu.types import feature_types as ft
+
+
+def _records(n, rng, n_classes=2, labels=None):
+    y_vals = labels if labels is not None else list(range(n_classes))
+    y = np.asarray([y_vals[i % len(y_vals)] for i in range(n)], float)
+    rng.shuffle(y)
+    x1 = rng.normal(size=n) + y
+    x2 = rng.normal(size=n)
+    cats = ["a", "b", "c", None]
+    return [{"label": float(y[i]), "x1": float(x1[i]), "x2": float(x2[i]),
+             "cat": cats[i % 4]} for i in range(n)], y
+
+
+def _features():
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x1").from_column().as_predictor()
+    f2 = FeatureBuilder.Real("x2").from_column().as_predictor()
+    f3 = FeatureBuilder.PickList("cat").from_column().as_predictor()
+    return label, [f1, f2, f3]
+
+
+def _binary_model(rng, n=300, with_sanity=True):
+    records, _ = _records(n, rng)
+    label, feats = _features()
+    vec = transmogrify(feats)
+    if with_sanity:
+        checker = SanityChecker(remove_bad_features=True,
+                                remove_feature_group=False)
+        label.transform_with(checker, vec)
+        vec = checker.get_output()
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None,
+        seed=7)
+    pred = label.transform_with(selector, vec)
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    return model, records, pred
+
+
+def _assert_store_parity(engine_store, classic_store, pred_name,
+                         rtol=1e-5, atol=1e-6):
+    assert sorted(engine_store.names()) == sorted(classic_store.names())
+    for nm in classic_store.names():
+        ce, cc = engine_store[nm], classic_store[nm]
+        if nm == pred_name:
+            np.testing.assert_allclose(ce.prediction, cc.prediction,
+                                       rtol=rtol, atol=atol)
+            np.testing.assert_allclose(ce.raw_prediction, cc.raw_prediction,
+                                       rtol=rtol, atol=atol)
+            np.testing.assert_allclose(ce.probability, cc.probability,
+                                       rtol=rtol, atol=atol)
+        elif isinstance(cc, VectorColumn):
+            np.testing.assert_allclose(np.asarray(ce.values, np.float64),
+                                       np.asarray(cc.values, np.float64),
+                                       rtol=rtol, atol=atol)
+
+
+def test_engine_parity_binary_full_chain(rng):
+    """vec + combine + sanity-select + predict fuse into ONE program whose
+    outputs match the per-layer path column-for-column."""
+    model, records, pred = _binary_model(rng)
+    eng = model.scoring_engine(gate_bandwidth=False)
+    assert eng.covers_prediction
+    kinds = {it.kind for it in eng._plan}
+    assert {"vec", "combine", "select", "predict"} <= kinds
+
+    classic = model._transform_layers(records)
+    engined = eng.transform_store(records)
+    _assert_store_parity(engined, classic, pred.name)
+
+    # score mode pulls only results and matches the forced-classic score
+    s_classic = model.score(records, engine=False)
+    s_engine = eng.score_store(records)
+    assert s_engine.names() == s_classic.names()
+    np.testing.assert_allclose(s_engine[pred.name].probability,
+                               s_classic[pred.name].probability,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_engine_matches_score_fn_rows(rng):
+    """Row-serving closure and batched engine agree row-by-row."""
+    model, records, pred = _binary_model(rng, n=200)
+    eng = model.scoring_engine(gate_bandwidth=False)
+    fn = model.score_fn()
+    batch = eng.score_store(records[:9])
+    col = batch[pred.name]
+    for i in range(9):
+        row_out = fn(records[i])[pred.name]
+        assert row_out["prediction"] == pytest.approx(
+            float(col.prediction[i]), rel=1e-5, abs=1e-6)
+        assert row_out["probability_1"] == pytest.approx(
+            float(col.probability[i, 1]), rel=1e-4, abs=1e-5)
+
+
+def test_engine_parity_multiclass_label_demapping(rng):
+    """DataCutter re-indexes {0,2,7}; the fused program must de-map class
+    ids back to the original label values, matching the host path."""
+    records, y = _records(240, rng, labels=[0.0, 2.0, 7.0])
+    label, feats = _features()
+    vec = transmogrify(feats)
+    selector = MultiClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()],
+        splitter=DataCutter(min_label_fraction=0.05), seed=3)
+    pred = label.transform_with(selector, vec)
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    sel = model.stage_of(pred)
+    assert sel.label_mapping == [0.0, 2.0, 7.0]
+
+    eng = model.scoring_engine(gate_bandwidth=False)
+    assert eng.covers_prediction
+    classic = model.score(records, engine=False)
+    engined = eng.score_store(records)
+    np.testing.assert_allclose(engined[pred.name].prediction,
+                               classic[pred.name].prediction,
+                               rtol=1e-5, atol=1e-6)
+    assert set(np.unique(engined[pred.name].prediction)) <= {0.0, 2.0, 7.0}
+    np.testing.assert_allclose(engined[pred.name].probability,
+                               classic[pred.name].probability,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_engine_parity_regression(rng):
+    n = 200
+    X = rng.normal(size=(n, 3))
+    y = X @ np.array([1.0, 2.0, -1.0]) + 0.5
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "features": VectorColumn(ft.OPVector, X.astype(np.float32)),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = FeatureBuilder.OPVector("features").from_column().as_predictor()
+    sel = RegressionModelSelector.with_train_validation_split(
+        families=[LinearRegressionFamily(
+            grid=[{"regParam": 0.0, "elasticNetParam": 0.0}])])
+    pred = label.transform_with(sel, feats)
+    model = (Workflow().set_input_store(store)
+             .set_result_features(pred).train())
+    eng = model.scoring_engine(gate_bandwidth=False)
+    assert eng.covers_prediction      # direct-vector upload feeds predict
+    classic = model.score(store, engine=False)
+    engined = eng.score_store(store)
+    np.testing.assert_allclose(engined[pred.name].prediction,
+                               classic[pred.name].prediction,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bucket_ladder_shapes():
+    assert bucket_for(1, 64) == 8
+    assert bucket_for(8, 64) == 8
+    assert bucket_for(9, 64) == 16
+    assert bucket_for(64, 64) == 64
+    assert bucket_for(1000, 64) == 64          # cap-clamped; chunking covers
+    assert bucket_ladder(64) == [8, 16, 32, 64]
+
+
+def test_compile_count_bounded_by_ladder(rng):
+    """≥6 distinct batch sizes must stay within the bucket ladder's
+    program budget — no per-shape recompiles."""
+    model, records, pred = _binary_model(rng, n=140, with_sanity=False)
+    eng = model.scoring_engine(gate_bandwidth=False, bucket_cap=64)
+    sizes = [1, 5, 9, 17, 33, 50, 64]
+    for k in sizes:
+        out = eng.score_store(records[:k])
+        assert out.n_rows == k
+    assert len(set(sizes)) >= 6
+    assert eng.compile_count <= len(bucket_ladder(64))
+    # same-bucket reuse: a size inside an already-compiled bucket is free
+    before = eng.compile_count
+    eng.score_store(records[:6])      # bucket 8, already compiled
+    eng.score_store(records[:30])     # bucket 32, already compiled
+    assert eng.compile_count == before
+
+
+def test_chunking_beyond_bucket_cap(rng):
+    """Batches larger than the cap stream through the largest bucket in
+    chunks; stitched output matches the classic path."""
+    model, records, pred = _binary_model(rng, n=150, with_sanity=False)
+    eng = model.scoring_engine(gate_bandwidth=False, bucket_cap=64)
+    classic = model.score(records, engine=False)
+    engined = eng.score_store(records)
+    assert engined.n_rows == 150
+    np.testing.assert_allclose(engined[pred.name].probability,
+                               classic[pred.name].probability,
+                               rtol=1e-5, atol=1e-6)
+    assert eng.compile_count <= len(bucket_ladder(64))
+
+
+def test_stream_score_overlapped_parity(rng):
+    """Overlapped streaming (host prep of batch k+1 concurrent with batch
+    k's device compute) yields the same stores as per-batch scoring."""
+    from transmogrifai_tpu.readers import stream_score
+
+    model, records, pred = _binary_model(rng, n=160, with_sanity=False)
+    batches = [records[i:i + 40] for i in range(0, 160, 40)]
+    plain = [model.score(list(b), engine=False) for b in batches]
+    overlapped = list(stream_score(model, batches, overlap=True))
+    assert len(overlapped) == len(plain)
+    for po, pp in zip(overlapped, plain):
+        assert po.n_rows == pp.n_rows
+        np.testing.assert_allclose(po[pred.name].probability,
+                                   pp[pred.name].probability,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_stream_score_auto_stays_classic_for_tiny_batches(rng):
+    """overlap='auto' must not pay engine compilation for toy batches."""
+    from transmogrifai_tpu.readers import stream_score
+
+    model, records, pred = _binary_model(rng, n=80, with_sanity=False)
+    batches = [records[i:i + 20] for i in range(0, 80, 20)]
+    assert 20 < SCORING_MIN_ROWS
+    outs = list(stream_score(model, batches))
+    assert sum(o.n_rows for o in outs) == 80
+
+
+def test_auto_routing_thresholds(rng):
+    """score(engine='auto') stays on the per-layer path under
+    SCORING_MIN_ROWS and can be forced either way."""
+    model, records, pred = _binary_model(rng, n=60, with_sanity=False)
+    eng = model.scoring_engine()
+    assert eng is not None
+    # tiny batch + auto → no engine programs compiled via score()
+    before = eng.compile_count
+    model.score(records)
+    assert eng.compile_count == before
+    # forced → engine path runs (compiles its program)
+    out = model.score(records, engine=True)
+    assert out.n_rows == 60
+    assert eng.compile_count > before
+
+
+def test_export_scoring_fn_roundtrip(rng, tmp_path):
+    """Full-chain StableHLO artifact reproduces the engine's outputs from
+    host-prepared blocks, batch-size polymorphically."""
+    from transmogrifai_tpu.serving import export_scoring_fn, load_scoring_fn
+
+    model, records, pred = _binary_model(rng, n=200, with_sanity=False)
+    meta = export_scoring_fn(model, str(tmp_path), records[:8])
+    assert meta["coverage"] == "fused_chain"
+    assert meta["resultFeatures"] == [pred.name]
+
+    fn = load_scoring_fn(str(tmp_path))
+    eng = model.scoring_engine(gate_bandwidth=False)
+    for n in (3, 17):
+        sub = records[:n]
+        store, prepared, uploads = eng.host_blocks(eng._raw_store(sub))
+        blocks = {}
+        for uid, d in prepared.items():
+            for k, v in d.items():
+                blocks[f"{uid}/{k}"] = v
+        blocks.update(uploads)
+        out = fn(blocks)
+        ref = eng.score_store(sub)[pred.name]
+        np.testing.assert_allclose(
+            np.asarray(out[f"{pred.name}.probability"], np.float64),
+            ref.probability, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(out[f"{pred.name}.prediction"], np.float64),
+            ref.prediction, rtol=1e-5, atol=1e-6)
+
+
+def test_evaluate_routes_identically(rng):
+    """score_and_evaluate through the engine-backed transform matches the
+    forced-classic metrics (the evaluator reads label + prediction from
+    the transformed store)."""
+    from transmogrifai_tpu.evaluators import Evaluators
+
+    model, records, pred = _binary_model(rng, n=250, with_sanity=False)
+    label_f = pred.origin_stage.input_features[0]
+    ev = Evaluators.BinaryClassification.auPR().set_columns(
+        label_f.name, pred.name)
+    m_classic = model.evaluate(records, ev)
+    # force the engine path by dropping the row threshold
+    import transmogrifai_tpu.scoring as scoring
+    old = scoring.SCORING_MIN_ROWS
+    scoring.SCORING_MIN_ROWS = 1
+    try:
+        m_engine = model.evaluate(records, ev)
+    finally:
+        scoring.SCORING_MIN_ROWS = old
+    for k, v in m_classic.items():
+        if isinstance(v, float):
+            assert m_engine[k] == pytest.approx(v, rel=1e-6, abs=1e-8)
+
+
+def test_metadata_less_vector_input_combines_cleanly(rng):
+    """A raw OPVector without metadata (e.g. an embedding column) through
+    combine + sanity-select: the engine must mirror the host combiner's
+    provenance-lost guard (metadata → None, data kept correct) instead of
+    attaching undersized metadata and crashing the select."""
+    n = 200
+    y = rng.integers(0, 2, n).astype(float)
+    emb = (rng.normal(size=(n, 4)) + y[:, None]).astype(np.float32)
+    x1 = rng.normal(size=n) + y
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "x1": column_from_values(ft.Real, list(x1)),
+        "emb": VectorColumn(ft.OPVector, emb, None),      # no metadata
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x1").from_column().as_predictor()
+    fe = FeatureBuilder.OPVector("emb").from_column().as_predictor()
+    vec = transmogrify([f1, fe])
+    checker = SanityChecker(remove_bad_features=False)
+    label.transform_with(checker, vec)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None,
+        seed=2)
+    pred = label.transform_with(selector, checker.get_output())
+    model = (Workflow().set_input_store(store)
+             .set_result_features(pred).train())
+    eng = model.scoring_engine(gate_bandwidth=False)
+    classic = model._transform_layers(store)
+    engined = eng.transform_store(store)       # must not raise
+    np.testing.assert_allclose(engined[pred.name].probability,
+                               classic[pred.name].probability,
+                               rtol=1e-5, atol=1e-6)
+    cname = checker.get_output().name
+    assert engined[cname].metadata is None \
+        or engined[cname].metadata.size == engined[cname].values.shape[1]
+
+
+def test_host_prepare_amortized_across_calls(rng):
+    """Repeat scoring of the SAME ColumnStore skips the host half (the
+    score → evaluate pattern); distinct stores and opt-out never hit."""
+    model, records, pred = _binary_model(rng, n=120, with_sanity=False)
+    eng = model.scoring_engine(gate_bandwidth=False)
+    store = eng._raw_store(records)
+    pb1 = eng.prepare_batch(store)
+    assert eng.prepare_batch(store) is pb1              # amortized
+    pb_fresh = eng.prepare_batch(store, use_cache=False)
+    assert pb_fresh is not pb1                          # opt-out
+    store2 = eng._raw_store(records)
+    assert eng.prepare_batch(store2) is not pb1         # identity-keyed
+    out_cached = eng.run_batch(pb1)
+    out_fresh = eng.run_batch(pb_fresh)
+    np.testing.assert_allclose(out_cached[pred.name].probability,
+                               out_fresh[pred.name].probability)
+
+
+# -- satellite coverage ----------------------------------------------------
+
+def test_drop_indices_by_validates_without_asserts(rng):
+    """dsl._drop_indices_by raises ValueError (not AssertionError), so the
+    validation survives ``python -O``."""
+    from transmogrifai_tpu.stages.base import LambdaTransformer
+
+    f = FeatureBuilder.OPVector("v").from_column().as_predictor()
+    out = f.drop_indices_by(lambda cm: False)
+    stage = out.origin_stage
+    store = ColumnStore({
+        "v": VectorColumn(ft.OPVector, np.zeros((3, 2), np.float32), None),
+    })
+    with pytest.raises(ValueError, match="metadata-carrying"):
+        stage.transform(store)
+    store2 = ColumnStore({"v": column_from_values(ft.Real, [1.0, 2.0])})
+    with pytest.raises(ValueError, match="OPVector"):
+        stage.transform(store2)
+
+
+def test_device_put_cache_blake2b_content_keyed():
+    """Content-equal arrays held by different objects hit the same cache
+    entry; different content misses."""
+    from transmogrifai_tpu.models.base import (_DEVICE_PUT_CACHE,
+                                               _content_tag, device_put_f32)
+
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = a.copy()
+    assert _content_tag(a) == _content_tag(b)
+    assert len(_content_tag(a)) == 16          # blake2b digest_size=16
+    da = device_put_f32(a)
+    db = device_put_f32(b)
+    assert da is db
+    c = a.copy()
+    c[0, 0] += 1.0
+    assert _content_tag(c) != _content_tag(a)
+    assert device_put_f32(c) is not da
+
+
+def test_native_so_staleness_gate(tmp_path):
+    """_stale: .so older than fasthash.cc ⇒ rebuild wanted."""
+    import os
+    import time as _time
+
+    from transmogrifai_tpu.ops.hashing import _stale
+
+    src = tmp_path / "fasthash.cc"
+    so = tmp_path / "lib.so"
+    src.write_text("// src")
+    so.write_text("so")
+    now = _time.time()
+    os.utime(src, (now - 100, now - 100))
+    os.utime(so, (now, now))
+    assert not _stale(str(so), str(src))
+    os.utime(src, (now + 100, now + 100))
+    assert _stale(str(so), str(src))
+    assert not _stale(str(so), str(tmp_path / "missing.cc"))
+
+
+def test_committed_native_binary_gone():
+    """The prebuilt .so must not ride in git (it rebuilds lazily from
+    fasthash.cc; the freshness gate keeps it current)."""
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        tracked = subprocess.run(
+            ["git", "ls-files", "native/"], cwd=repo, capture_output=True,
+            text=True, timeout=30).stdout
+    except (OSError, subprocess.SubprocessError):
+        pytest.skip("git unavailable")
+    assert "libtmogtpu.so" not in tracked

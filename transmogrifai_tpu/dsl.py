@@ -920,8 +920,16 @@ def _drop_indices_by(self: Feature, match_fn):
     ftx = _ft()
 
     def fn(col):
-        assert isinstance(col, VectorColumn) and col.metadata is not None, \
-            "dropIndicesBy needs a metadata-carrying OPVector"
+        # explicit ValueErrors, not asserts: input validation must
+        # survive ``python -O`` (asserts are stripped under -O)
+        if not isinstance(col, VectorColumn):
+            raise ValueError(
+                f"dropIndicesBy needs an OPVector column, got "
+                f"{type(col).__name__}")
+        if col.metadata is None:
+            raise ValueError(
+                "dropIndicesBy needs a metadata-carrying OPVector "
+                "(vectorizer outputs always carry metadata)")
         keep = [i for i, cm in enumerate(col.metadata.columns)
                 if not match_fn(cm)]
         meta = col.metadata.select(keep)

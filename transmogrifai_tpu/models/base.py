@@ -41,7 +41,7 @@ def pull_f64(out) -> Tuple[np.ndarray, ...]:
 
 
 #: content-keyed device uploads of feature matrices: (shape, dtype,
-#: crc32, adler32) → f32 device array. A 2M×20 matrix is ~150 MB on a
+#: blake2b-128) → f32 device array. A 2M×20 matrix is ~150 MB on a
 #: tunnelled link; validate → refit → final transform → repeat scoring
 #: touch the same CONTENT through different host objects (boolean-index
 #: copies, per-run re-extracts), so identity is not part of the key and
@@ -51,18 +51,21 @@ def pull_f64(out) -> Tuple[np.ndarray, ...]:
 _DEVICE_PUT_CACHE: dict = {}
 
 
-def _content_tag(X: np.ndarray) -> Tuple[int, int]:
-    """Full-buffer content fingerprint (crc32, adler32 — 64 bits total).
-    A strided sample misses most small in-place edits (ADVICE r4), and an
-    id-based key misses content-equal re-uploads; hashing the whole
-    buffer is ~ms-scale even at 150 MB, vs seconds to re-ship it over a
-    tunnelled link."""
-    import zlib
+def _content_tag(X: np.ndarray) -> bytes:
+    """Full-buffer content fingerprint: blake2b, 128-bit digest. A
+    strided sample misses most small in-place edits (ADVICE r4), an
+    id-based key misses content-equal re-uploads, and the previous
+    crc32+adler32 pair (64 bits of non-cryptographic checksum) left a
+    real collision budget for a cache whose hits skip a device upload —
+    blake2b-128 makes accidental collision astronomically unlikely at
+    the same ~ms full-buffer pass (it is the fast keyed BLAKE2 path in
+    hashlib, no allocation beyond the 16-byte digest)."""
+    import hashlib
     try:
         view = memoryview(X).cast("B")      # zero-copy when contiguous
     except (TypeError, ValueError, BufferError):
         view = X.tobytes()
-    return zlib.crc32(view), zlib.adler32(view)
+    return hashlib.blake2b(view, digest_size=16).digest()
 
 
 def device_put_f32(X: np.ndarray):

@@ -163,7 +163,7 @@ def hashed_text_block(values: Sequence[Optional[str]], num_features: int,
     import ctypes
 
     from .hashing import _load_native
-    from .text import tokenize_simple
+    from .text import _MIN_TOKEN_LENGTH, tokenize_simple
 
     n = len(values)
     null_mask = np.fromiter((v is None for v in values), bool, count=n)
@@ -178,9 +178,13 @@ def hashed_text_block(values: Sequence[Optional[str]], num_features: int,
         flags = np.zeros(n, dtype=np.uint8)
         import os
         n_threads = min(os.cpu_count() or 1, 16)
+        # min_token_len threads the tokenizer module's constant through —
+        # the native kernel and the Python fallback (tokenize_simple's
+        # default, same constant) must tokenize in lockstep
         kern(blob,
              offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-             n, np.uint32(seed), np.uint32(num_features), 1,
+             n, np.uint32(seed), np.uint32(num_features),
+             np.int32(_MIN_TOKEN_LENGTH),
              1 if binary_freq else 0,
              out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
              out.shape[1], col_offset,

@@ -43,6 +43,16 @@ class HashSpaceStrategy:
 _native_lib = None
 
 
+def _stale(so_path: str, src_path: str) -> bool:
+    """The cached .so predates the source — rebuild. mtime is the
+    freshness gate: a fresh build always lands with mtime >= the source's
+    (os.replace preserves the just-written time)."""
+    try:
+        return os.path.getmtime(src_path) > os.path.getmtime(so_path)
+    except OSError:
+        return False
+
+
 def _load_native():
     global _native_lib
     if _native_lib is not None:
@@ -50,26 +60,35 @@ def _load_native():
     native_dir = os.path.abspath(os.path.join(
         os.path.dirname(__file__), os.pardir, os.pardir, "native"))
     path = os.path.join(native_dir, "libtmogtpu.so")
-    if not os.path.exists(path):
-        # lazy one-time build from source (no wheel/packaging step in this
-        # repo); failures fall back to the pure-Python hasher silently.
-        # Compile to a per-pid temp file + atomic rename so concurrent
-        # processes never see (or permanently keep) a half-written .so.
-        src = os.path.join(native_dir, "fasthash.cc")
-        if os.path.exists(src):
-            import subprocess
-            tmp = f"{path}.{os.getpid()}.tmp"
+    src = os.path.join(native_dir, "fasthash.cc")
+    if os.path.exists(src) and (not os.path.exists(path)
+                                or _stale(path, src)):
+        # lazy build from source (no wheel/packaging step in this repo;
+        # the binary is NOT committed — it is always built here or via
+        # native/Makefile); failures fall back to the pure-Python hasher
+        # silently. Compile to a per-pid temp file + atomic rename so
+        # concurrent processes never see (or permanently keep) a
+        # half-written .so. CXX/CXXFLAGS honor the same env overrides as
+        # the Makefile, with identical defaults — one flag source, two
+        # build entry points. -pthread is load-bearing: the kernel spawns
+        # std::thread, and glibc<2.34/musl abort at first thread creation
+        # without it.
+        import shlex
+        import subprocess
+        tmp = f"{path}.{os.getpid()}.tmp"
+        cxx = os.environ.get("CXX", "g++")
+        flags = shlex.split(os.environ.get(
+            "CXXFLAGS", "-O3 -std=c++17 -fPIC -Wall -pthread"))
+        try:
+            subprocess.run(
+                [cxx, *flags, "-shared", "-o", tmp, src],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, path)
+        except (OSError, subprocess.SubprocessError):
             try:
-                subprocess.run(
-                    ["g++", "-O3", "-std=c++17", "-fPIC", "-shared",
-                     "-o", tmp, src],
-                    check=True, capture_output=True, timeout=120)
-                os.replace(tmp, path)
-            except (OSError, subprocess.SubprocessError):
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
+                os.unlink(tmp)
+            except OSError:
+                pass
     if os.path.exists(path):
         try:
             lib = ctypes.CDLL(path)

@@ -336,12 +336,38 @@ class JoinedAggregateDataReader(AggregateReader):
 
 
 def stream_score(model, batches: Iterable[Sequence[Mapping[str, Any]]],
-                 keep_intermediate: bool = False):
+                 keep_intermediate: bool = False, overlap: Any = "auto"):
     """Incremental scoring over record batches (StreamingScore run type /
     StreamingReaders.scala analog): yields one scored ColumnStore per
     batch, reusing the fitted DAG — jitted transforms recompile only when
-    a batch size changes shape buckets."""
-    for batch in batches:
+    a batch size changes shape buckets.
+
+    ``overlap`` engages the compiled scoring engine's software-pipelined
+    mode (scoring.stream_score_overlapped): host feature extraction of
+    batch k+1 runs in a worker thread while batch k computes on device.
+    ``"auto"`` (default) turns it on when the engine is available, the
+    link clears the bandwidth gate and the first batch is big enough to
+    pay for compilation; ``True``/``False`` force/forbid it."""
+    import itertools
+
+    it = iter(batches)
+    first = next(it, None)
+    if first is None:
+        return
+    chained = itertools.chain([first], it)
+    use_overlap = False
+    if overlap is not False and hasattr(model, "scoring_engine"):
+        from ..scoring import SCORING_MIN_ROWS
+        eng = model.scoring_engine()
+        ok = eng is not None and eng.enabled()
+        use_overlap = ok and (overlap is True
+                              or len(first) >= SCORING_MIN_ROWS)
+    if use_overlap:
+        from ..scoring import stream_score_overlapped
+        yield from stream_score_overlapped(
+            model, chained, keep_intermediate=keep_intermediate)
+        return
+    for batch in chained:
         yield model.score(list(batch), keep_intermediate=keep_intermediate)
 
 

@@ -195,12 +195,20 @@ class OpWorkflowRunner:
                         f"got {batch}")
                 batches = (data[i:i + batch]
                            for i in range(0, len(data), batch))
+            # overlapped streaming (tf.data-style software pipelining):
+            # host feature extraction of batch k+1 runs concurrently with
+            # batch k's device compute when the scoring engine is active.
+            # customParams.overlap: true/false force/forbid; default auto.
+            overlap = params.custom_params.get("overlap", "auto")
+            if isinstance(overlap, str) and overlap.lower() in (
+                    "true", "false"):
+                overlap = overlap.lower() == "true"
             rows = 0
             n_batches = 0
             sink = (_make_sink(params.write_location)
                     if params.write_location else None)
             try:
-                for scored in stream_score(model, batches):
+                for scored in stream_score(model, batches, overlap=overlap):
                     rows += scored.n_rows
                     n_batches += 1
                     if sink is not None:
@@ -213,7 +221,7 @@ class OpWorkflowRunner:
                 if sink is not None:
                     sink.close()
             metrics = {"rowsScored": rows, "batches": n_batches,
-                       "batchSize": batch,
+                       "batchSize": batch, "overlap": overlap,
                        "appSeconds": round(time.time() - t0, 3)}
             self._write_metrics(params.metrics_location, metrics)
             return RunnerResult(run_type, metrics=metrics)

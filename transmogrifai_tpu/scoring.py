@@ -1,0 +1,682 @@
+"""Compiled batched scoring engine — one device program per model.
+
+Training got five rounds of perf work; scoring still crossed the
+host↔device link once per DAG layer (``apply_layer_vectorized`` called
+per layer from ``WorkflowModel.transform``) and re-ran ``host_prepare``
+bookkeeping every call. This module compiles a fitted model's
+transform→predict chain into **one jitted XLA computation**: every
+vectorizer's ``device_compute`` across every layer, the vector combiner's
+concat, the sanity checker's column gather, and the predictor's
+``predict_device`` fuse into a single program, so a scoring batch crosses
+the link once — prepared host blocks in, result columns out.
+
+KeystoneML (PAPERS.md) makes the case for whole-pipeline compilation over
+per-stage execution for exactly this pipeline shape; tf.data makes the
+case for overlapping host-side input preparation with accelerator compute.
+Both live here:
+
+* **Bucketed batch shapes** — incoming batches are zero-padded up to a
+  small power-of-two ladder (``bucket_ladder``), so arbitrary request
+  sizes hit at most O(log(cap)) compiled programs instead of one per
+  shape. Batches beyond the cap are chunked through the largest bucket.
+  Padding is safe because every fused stage is row-independent (the
+  vectorizer/predictor contract); padded rows are sliced off after the
+  single device pull.
+* **Per-model program cache** — compiled executables live in a bounded
+  LRU keyed by (bucket, block signature, outputs), the same discipline as
+  ``workflow._LAYER_JIT_CACHE``. Model weights are closed over, so they
+  upload once per program, not once per call; the DAG classification
+  (host/device split, output metadata wiring) happens once per engine.
+* **Overlapped streaming** — :func:`stream_score_overlapped` runs host
+  feature extraction of micro-batch k+1 in a worker thread while batch k
+  computes on device (tf.data-style software pipelining).
+
+The engine honors the same bandwidth gate as layer fusion
+(``workflow.FUSE_MIN_BANDWIDTH_MBPS``): on a slow tunnelled link the
+numpy host path stays the right answer, and ``enabled()`` says so.
+
+Host/device split rules
+-----------------------
+
+A fitted stage is *device-capable* when the engine knows its pure-array
+form: ``VectorizerModel`` (``host_prepare`` → ``device_compute``),
+``VectorsCombiner`` (concat), ``SanityCheckerModel`` (static column
+gather), ``StandardScalerModel`` (affine), and any ``PredictorModel``
+implementing ``predict_device``. The fused set is the largest
+consumer-closed subset of device-capable stages — a device stage whose
+output any host stage consumes is demoted to host, so device values never
+have to cross back mid-program. Everything else (row transformers,
+lambda stages, text taggers) runs on host first; their columns feed
+``host_prepare`` and any direct vector uploads.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ScoringEngine", "bucket_for", "bucket_ladder",
+           "stream_score_overlapped", "SCORING_MIN_ROWS",
+           "DEFAULT_BUCKET_CAP", "BUCKET_MIN"]
+
+#: smallest padded batch — below it, padding overhead is noise anyway
+BUCKET_MIN = 8
+
+#: default largest compiled batch shape; bigger batches chunk through it
+DEFAULT_BUCKET_CAP = 8192
+
+#: ``WorkflowModel.score/transform`` route through the engine only from
+#: this many rows (same reasoning as ``workflow.FUSE_MIN_ROWS``: below
+#: it, numpy beats compile+pad for one-shot calls). Explicit
+#: ``engine=True`` or direct engine use ignores it — a serving loop
+#: scoring small batches repeatedly amortizes the compile immediately.
+SCORING_MIN_ROWS = 2048
+
+#: compiled programs kept per engine (LRU) — ladder size bounds live
+#: entries in practice; the cap guards pathological bucket_cap choices
+PROGRAM_CACHE_CAP = 32
+
+
+def bucket_for(n: int, cap: int = DEFAULT_BUCKET_CAP) -> int:
+    """Smallest ladder bucket holding ``n`` rows (cap-clamped; a
+    non-power-of-two cap is itself the top rung, so the result never
+    exceeds it)."""
+    if n <= BUCKET_MIN:
+        return BUCKET_MIN
+    if n >= cap:
+        return cap
+    return min(cap, 1 << (n - 1).bit_length())
+
+
+def bucket_ladder(cap: int = DEFAULT_BUCKET_CAP) -> List[int]:
+    """The full bucket ladder: powers of two from BUCKET_MIN to cap."""
+    out = [BUCKET_MIN]
+    while out[-1] < cap:
+        out.append(min(out[-1] * 2, cap))
+    return out
+
+
+class _FusedStage:
+    """One device-resident step of the compiled program."""
+
+    __slots__ = ("model", "kind", "out", "ins")
+
+    def __init__(self, model, kind: str, out: str, ins: List[str]):
+        self.model = model
+        self.kind = kind      # vec | combine | select | scale | predict
+        self.out = out
+        self.ins = ins        # env/upload names consumed (no label slots)
+
+
+def _has_predict_device(m) -> bool:
+    """True when ``m.predict_device`` is a real implementation (not the
+    PredictorModel stub), following SelectedModel delegation."""
+    from .models.base import PredictorModel
+    from .models.selector import SelectedModel
+    if isinstance(m, SelectedModel):
+        return m.inner is not None and _has_predict_device(m.inner)
+    fn = type(m).predict_device
+    return fn is not PredictorModel.predict_device
+
+
+def _classify(m) -> Optional[str]:
+    """Device-capable kind of a fitted stage, or None (host)."""
+    from .models.base import PredictorModel
+    from .ops.sanity_checker import SanityCheckerModel
+    from .ops.vectorizer_base import VectorizerModel
+    from .ops.vectors import StandardScalerModel, VectorsCombiner
+    if isinstance(m, VectorizerModel):
+        return "vec"
+    if isinstance(m, VectorsCombiner):
+        return "combine"
+    if isinstance(m, SanityCheckerModel):
+        return "select"
+    if isinstance(m, StandardScalerModel):
+        return "scale"
+    if isinstance(m, PredictorModel) and _has_predict_device(m):
+        return "predict"
+    return None
+
+
+class _PreparedBatch:
+    """Host-side output of :meth:`ScoringEngine.prepare_batch`: everything
+    the device program needs, already padded to its bucket. Chunked when
+    the batch exceeds the bucket cap."""
+
+    __slots__ = ("chunks", "n_rows")
+
+    def __init__(self, chunks, n_rows: int):
+        self.chunks = chunks      # [(host_store, prepared, uploads, n, bucket)]
+        self.n_rows = n_rows
+
+
+class ScoringEngine:
+    """Compiled batched scorer for one fitted :class:`WorkflowModel`.
+
+    Build once per model (``model.scoring_engine()`` memoizes); every
+    ``score_store``/``transform_store`` call reuses the plan and the
+    per-bucket compiled programs.
+    """
+
+    def __init__(self, model, bucket_cap: int = DEFAULT_BUCKET_CAP,
+                 gate_bandwidth: bool = True):
+        self.model = model
+        self.bucket_cap = int(bucket_cap)
+        self.gate_bandwidth = gate_bandwidth
+        self._programs: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._compile_count = 0
+        self._lock = threading.Lock()
+        #: host_prepare amortization: repeat calls on the SAME ColumnStore
+        #: (score → evaluate, warm benchmark reps) skip the whole host
+        #: half. Weakref-validated identity keys — a dead or different
+        #: store at the same address can never serve stale blocks.
+        self._prep_cache: "OrderedDict[Tuple, Tuple[Any, _PreparedBatch]]" \
+            = OrderedDict()
+        self._build_plan()
+
+    # -- plan --------------------------------------------------------------
+    def _build_plan(self) -> None:
+        from .workflow import _raw_features_of
+        layers = self.model._resolved_dag()
+        flat = [m for layer in layers for m in layer]
+        kinds = {m.uid: _classify(m) for m in flat}
+
+        # consumer map over output names (host stages read via the store,
+        # fused stages via the device env — both count as consumption)
+        consumers: Dict[str, List[Any]] = {}
+        for m in flat:
+            for f in m.input_features:
+                consumers.setdefault(f.name, []).append(m)
+
+        # largest consumer-closed fused set: walk shallow→deep demoting
+        # device-capable stages any of whose consumers stayed on host
+        fused: Dict[str, bool] = {}
+        for m in reversed(flat):
+            ok = kinds[m.uid] is not None
+            if ok:
+                for c in consumers.get(m.output_name, []):
+                    if not fused.get(c.uid, False):
+                        ok = False
+                        break
+            fused[m.uid] = ok
+
+        plan: List[_FusedStage] = []
+        host_layers: List[List[Any]] = []
+        for layer in layers:
+            host_row = []
+            for m in layer:
+                if not fused[m.uid]:
+                    host_row.append(m)
+                    continue
+                kind = kinds[m.uid]
+                if kind == "vec":
+                    ins: List[str] = []
+                elif kind in ("select", "predict"):
+                    # (label, vector) arity: only the vector crosses
+                    ins = [m.input_features[1].name]
+                else:
+                    ins = [f.name for f in m.input_features]
+                plan.append(_FusedStage(m, kind, m.output_name, ins))
+            host_layers.append(host_row)
+
+        produced = {it.out for it in plan}
+        upload_names: List[str] = []
+        for it in plan:
+            for nm in it.ins:
+                if nm not in produced and nm not in upload_names:
+                    upload_names.append(nm)
+
+        self._host_layers = host_layers
+        self._plan = plan
+        self._fused_out = produced
+        self._upload_names = upload_names
+        self._result_names = [f.name for f in self.model.result_features]
+        self._raw_features = _raw_features_of(self.model.result_features)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def fused_stage_count(self) -> int:
+        return len(self._plan)
+
+    @property
+    def covers_prediction(self) -> bool:
+        """True when a predictor is inside the fused program (the full
+        transform→predict chain runs as one device computation)."""
+        return any(it.kind == "predict" for it in self._plan)
+
+    @property
+    def compile_count(self) -> int:
+        """Programs compiled so far — the bucket-ladder guard metric."""
+        return self._compile_count
+
+    def program_budget(self, modes: int = 1) -> int:
+        """Max distinct programs the ladder permits per output mode."""
+        return len(bucket_ladder(self.bucket_cap)) * modes
+
+    def enabled(self) -> bool:
+        """Engine pays off: something fused AND the link clears the same
+        bandwidth gate as layer fusion (a memory-bound transform chain on
+        a tunnelled device costs more than host numpy)."""
+        if not self._plan:
+            return False
+        if not self.gate_bandwidth:
+            return True
+        from .workflow import FUSE_MIN_BANDWIDTH_MBPS, device_roundtrip_mbps
+        return device_roundtrip_mbps() >= FUSE_MIN_BANDWIDTH_MBPS
+
+    # -- host half ---------------------------------------------------------
+    def host_blocks(self, store) -> Tuple[Any, Dict[str, Dict[str, np.ndarray]],
+                                          Dict[str, np.ndarray]]:
+        """Run every host stage, then every fused vectorizer's
+        ``host_prepare`` (canonicalized) + direct vector uploads.
+        Returns (host_store, prepared, uploads) — unpadded."""
+        from .ops.vectorizer_base import canonicalize_prepared
+        for layer in self._host_layers:
+            for m in layer:
+                store = m.transform(store)
+        prepared = {}
+        for it in self._plan:
+            if it.kind == "vec":
+                prepared[it.model.uid] = canonicalize_prepared(
+                    it.model.host_prepare(store))
+        uploads = {}
+        for nm in self._upload_names:
+            uploads[nm] = np.asarray(store[nm].values)
+        return store, prepared, uploads
+
+    def _raw_store(self, data):
+        from .workflow import _generate_raw_store
+        from .columns import ColumnStore
+        if isinstance(data, ColumnStore):
+            # tolerate stores that already carry engineered columns
+            missing = [f for f in self._raw_features if f.name not in data]
+            if not missing:
+                return _generate_raw_store(data, self._raw_features)
+            return data
+        return _generate_raw_store(data, self._raw_features)
+
+    # -- padding -----------------------------------------------------------
+    @staticmethod
+    def _pad_rows(a: np.ndarray, n: int, bucket: int) -> np.ndarray:
+        """Zero-pad the leading (row) axis from n to bucket. Blocks whose
+        leading dim is not the row count (fitted constants riding in
+        prepared dicts) pass through untouched."""
+        a = np.asarray(a)
+        if a.ndim == 0 or a.shape[0] != n or n == bucket:
+            return a
+        pad = np.zeros((bucket - n,) + a.shape[1:], dtype=a.dtype)
+        return np.concatenate([a, pad], axis=0)
+
+    def prepare_batch(self, data, use_cache: bool = True) -> _PreparedBatch:
+        """Host half of a scoring call, padded to the bucket ladder —
+        safe to run in a worker thread (numpy/python only).
+
+        ColumnStore inputs are amortized: re-scoring the same store
+        object (score → evaluate, repeated warm calls) reuses the
+        prepared blocks instead of re-running host transforms +
+        host_prepare. Stores are treated as immutable (the ColumnStore
+        API is copy-on-write); ``use_cache=False`` opts out."""
+        import weakref
+
+        from .columns import ColumnStore
+        cache_key = None
+        if use_cache and isinstance(data, ColumnStore):
+            cache_key = (id(data), data.n_rows)
+            with self._lock:
+                hit = self._prep_cache.get(cache_key)
+            if hit is not None and hit[0]() is data:
+                return hit[1]
+        store = self._raw_store(data)
+        n_total = store.n_rows
+        chunks = []
+        for lo in range(0, max(n_total, 1), self.bucket_cap):
+            sub = store
+            if n_total > self.bucket_cap:
+                hi = min(lo + self.bucket_cap, n_total)
+                sub = store.take(np.arange(lo, hi))
+            n = sub.n_rows
+            bucket = bucket_for(n, self.bucket_cap)
+            host_store, prepared, uploads = self.host_blocks(sub)
+            prepared = {uid: {k: self._pad_rows(v, n, bucket)
+                              for k, v in blocks.items()}
+                        for uid, blocks in prepared.items()}
+            uploads = {k: self._pad_rows(v, n, bucket)
+                       for k, v in uploads.items()}
+            chunks.append((host_store, prepared, uploads, n, bucket))
+            if n_total <= self.bucket_cap:
+                break
+        pb = _PreparedBatch(chunks, n_total)
+        if cache_key is not None:
+            with self._lock:
+                self._prep_cache[cache_key] = (weakref.ref(data), pb)
+                while len(self._prep_cache) > 4:
+                    self._prep_cache.popitem(last=False)
+        return pb
+
+    # -- device program ----------------------------------------------------
+    def _signature(self, prepared, uploads, out_names) -> Tuple:
+        sig = []
+        for uid in sorted(prepared):
+            for k in sorted(prepared[uid]):
+                a = prepared[uid][k]
+                sig.append((uid, k, tuple(np.shape(a)), str(np.asarray(a).dtype)))
+        for k in sorted(uploads):
+            a = uploads[k]
+            sig.append(("", k, tuple(np.shape(a)), str(np.asarray(a).dtype)))
+        return (tuple(sig), tuple(out_names))
+
+    def _program_body(self, jnp, prepared, uploads, out_names):
+        env: Dict[str, Any] = dict(uploads)
+        for it in self._plan:
+            if it.kind == "vec":
+                env[it.out] = it.model.device_compute(jnp, prepared[it.model.uid])
+            elif it.kind == "combine":
+                mats = [env[nm] for nm in it.ins]
+                env[it.out] = jnp.concatenate(mats, axis=1)
+            elif it.kind == "select":
+                keep = it.model.keep_indices
+                x = env[it.ins[0]]
+                if keep == list(range(x.shape[1])):
+                    env[it.out] = x
+                else:
+                    env[it.out] = x[:, np.asarray(keep, dtype=np.int32)]
+            elif it.kind == "scale":
+                m = it.model
+                env[it.out] = ((env[it.ins[0]] - m.mean[None, :])
+                               / m.std[None, :])
+            elif it.kind == "predict":
+                env[it.out] = it.model.predict_device(env[it.ins[0]])
+        return {nm: env[nm] for nm in out_names}
+
+    def _program(self, prepared, uploads, out_names):
+        import jax
+
+        key = self._signature(prepared, uploads, out_names)
+        with self._lock:
+            fn = self._programs.pop(key, None)
+            if fn is not None:
+                self._programs[key] = fn      # LRU re-insert
+                return fn
+
+        def run(prepared_, uploads_):
+            import jax.numpy as jnp
+            return self._program_body(jnp, prepared_, uploads_, out_names)
+
+        fn = jax.jit(run)
+        with self._lock:
+            self._programs[key] = fn
+            self._compile_count += 1
+            while len(self._programs) > PROGRAM_CACHE_CAP:
+                self._programs.popitem(last=False)
+        return fn
+
+    # -- output wiring -----------------------------------------------------
+    def _out_names(self, results_only: bool) -> List[str]:
+        if results_only:
+            return [nm for nm in self._result_names if nm in self._fused_out]
+        return [it.out for it in self._plan]
+
+    def _meta_for(self, it: _FusedStage, store, meta_env: Dict[str, Any],
+                  width_env: Dict[str, Optional[int]]):
+        """Mirror the host stages' vector-metadata wiring (plan shapes are
+        model state, so this is pure bookkeeping — no data touched).
+        ``width_env`` carries each env value's column count so the
+        combiner's provenance-lost guard (metadata size != matrix width →
+        metadata None, data kept correct) holds here too."""
+        from .vector_metadata import VectorMetadata
+
+        def in_meta(nm):
+            if nm in meta_env:
+                return meta_env[nm]
+            col = store[nm] if nm in store else None
+            return getattr(col, "metadata", None)
+
+        if it.kind == "vec":
+            return it.model.vector_metadata()
+        if it.kind == "combine":
+            metas = []
+            for f, nm in zip(it.model.input_features, it.ins):
+                metas.append(in_meta(nm) or VectorMetadata(f.name, []))
+            meta = VectorMetadata.flatten(it.out, metas)
+            width = width_env.get(it.out)
+            if width is not None and meta.size != width:
+                return None      # provenance lost for some inputs
+            return meta
+        if it.kind == "select":
+            meta = in_meta(it.ins[0])
+            if meta is None:
+                return None
+            meta = meta.select(it.model.keep_indices)
+            meta.name = it.out
+            return meta
+        if it.kind == "scale":
+            return in_meta(it.ins[0])
+        return None
+
+    def _width_env(self, store) -> Dict[str, Optional[int]]:
+        """Column count of every fused env value, derived from model
+        state + upload shapes (None = unknown)."""
+        w: Dict[str, Optional[int]] = {}
+        for nm in self._upload_names:
+            vals = getattr(store[nm], "values", None) if nm in store else None
+            w[nm] = (int(vals.shape[1])
+                     if vals is not None and np.ndim(vals) == 2 else None)
+        for it in self._plan:
+            if it.kind == "vec":
+                w[it.out] = it.model.vector_metadata().size
+            elif it.kind == "combine":
+                ins = [w.get(nm) for nm in it.ins]
+                w[it.out] = (sum(ins) if all(x is not None for x in ins)
+                             else None)
+            elif it.kind == "select":
+                w[it.out] = len(it.model.keep_indices)
+            elif it.kind == "scale":
+                w[it.out] = w.get(it.ins[0])
+            else:
+                w[it.out] = None
+        return w
+
+    def run_batch(self, prep: _PreparedBatch, results_only: bool = True):
+        """Device half: one jitted dispatch + one pull per chunk, then
+        column wrapping. Returns a ColumnStore."""
+        import jax
+
+        from .columns import ColumnStore, PredictionColumn, VectorColumn
+        from .types.feature_types import OPVector
+
+        out_names = self._out_names(results_only)
+        stores = []
+        for host_store, prepared, uploads, n, bucket in prep.chunks:
+            t0 = time.time()
+            if out_names:
+                fn = self._program(prepared, uploads, out_names)
+                outs = jax.device_get(fn(prepared, uploads))   # one pull
+            else:
+                outs = {}
+            store = host_store
+            meta_env: Dict[str, Any] = {}
+            width_env = self._width_env(host_store)
+            by_out = {it.out: it for it in self._plan}
+            for it in self._plan:
+                if it.out in out_names or it.kind in ("vec", "combine",
+                                                      "select", "scale"):
+                    meta_env[it.out] = self._meta_for(it, host_store,
+                                                      meta_env, width_env)
+            for nm in out_names:
+                it = by_out[nm]
+                val = outs[nm]
+                if it.kind == "predict":
+                    pred, raw, prob = (np.asarray(v, dtype=np.float64)[:n]
+                                       for v in val)
+                    store = store.with_column(
+                        nm, PredictionColumn(pred, raw, prob))
+                else:
+                    mat = np.asarray(val)[:n]
+                    store = store.with_column(
+                        nm, VectorColumn(OPVector, mat, meta_env.get(nm)))
+            logger.debug("scoring engine: %d rows (bucket %d) in %.1fms",
+                         n, bucket, 1e3 * (time.time() - t0))
+            if results_only and len(prep.chunks) > 1:
+                # chunk-stitching only needs the result columns — raw
+                # host columns (maps, ragged lists) never concatenate
+                store = store.select([nm for nm in self._result_names
+                                      if nm in store])
+            stores.append(store)
+        if len(stores) == 1:
+            return stores[0]
+        return _concat_stores(stores)
+
+    # -- public scoring ----------------------------------------------------
+    def transform_store(self, data, use_cache: bool = True):
+        """Engine analog of ``WorkflowModel.transform``: every DAG column
+        materialized (host columns + all fused outputs), one crossing."""
+        return self.run_batch(self.prepare_batch(data, use_cache=use_cache),
+                              results_only=False)
+
+    def score_store(self, data, keep_intermediate: bool = False,
+                    use_cache: bool = True):
+        """Engine analog of ``WorkflowModel.score``: only result columns
+        are pulled off the device."""
+        if keep_intermediate:
+            return self.transform_store(data, use_cache=use_cache)
+        store = self.run_batch(self.prepare_batch(data, use_cache=use_cache),
+                               results_only=True)
+        return store.select([nm for nm in self._result_names
+                             if nm in store])
+
+    # -- export ------------------------------------------------------------
+    def export_manifest(self, sample_data):
+        """Flat input manifest for StableHLO export: per-block tail
+        shapes/dtypes in a fixed order, from one sample host pass. All
+        blocks must be row-leading (batch-polymorphic export pads
+        nothing)."""
+        store = self._raw_store(sample_data)
+        n = store.n_rows
+        _, prepared, uploads = self.host_blocks(store)
+        manifest = []
+        for uid in sorted(prepared):
+            for k in sorted(prepared[uid]):
+                a = np.asarray(prepared[uid][k])
+                if a.ndim == 0 or a.shape[0] != n:
+                    raise ValueError(
+                        f"prepared block {uid}/{k} is not row-leading "
+                        f"(shape {a.shape}); full-chain export needs every "
+                        "input batch-polymorphic")
+                manifest.append({"kind": "prepared", "uid": uid, "name": k,
+                                 "tail": list(a.shape[1:]),
+                                 "dtype": str(a.dtype)})
+        for k in sorted(uploads):
+            a = np.asarray(uploads[k])
+            if a.ndim == 0 or a.shape[0] != n:
+                raise ValueError(f"upload {k} is not row-leading")
+            manifest.append({"kind": "upload", "uid": "", "name": k,
+                             "tail": list(a.shape[1:]),
+                             "dtype": str(a.dtype)})
+        return manifest
+
+    def export_callable(self, manifest, out_names):
+        """Flat-arg callable over ``manifest`` order, for jax.export."""
+        def flat_fn(*blocks):
+            import jax.numpy as jnp
+            prepared: Dict[str, Dict[str, Any]] = {}
+            uploads: Dict[str, Any] = {}
+            for spec, a in zip(manifest, blocks):
+                if spec["kind"] == "prepared":
+                    prepared.setdefault(spec["uid"], {})[spec["name"]] = a
+                else:
+                    uploads[spec["name"]] = a
+            return self._program_body(jnp, prepared, uploads, out_names)
+        return flat_fn
+
+
+def _concat_stores(stores):
+    """Row-concatenate per-chunk stores. Covers the column kinds the
+    engine emits (prediction/vector) plus the dense host kinds; exotic
+    host columns (maps) raise — the workflow's transform routing catches
+    that and replays the per-layer path."""
+    from .columns import (ColumnStore, GeoColumn, NumericColumn,
+                          PredictionColumn, RaggedColumn, TextColumn,
+                          TextListColumn, TextSetColumn, VectorColumn)
+    first = stores[0]
+    cols = {}
+    for nm in first.names():
+        parts = [s[nm] for s in stores]
+        c0 = parts[0]
+        if isinstance(c0, PredictionColumn):
+            cols[nm] = PredictionColumn(
+                np.concatenate([p.prediction for p in parts]),
+                np.concatenate([p.raw_prediction for p in parts]),
+                np.concatenate([p.probability for p in parts]))
+        elif isinstance(c0, VectorColumn):
+            cols[nm] = VectorColumn(
+                c0.ftype, np.concatenate([p.values for p in parts]),
+                c0.metadata)
+        elif isinstance(c0, NumericColumn):
+            cols[nm] = NumericColumn(
+                c0.ftype, np.concatenate([p.values for p in parts]),
+                np.concatenate([p.mask for p in parts]), c0.labels)
+        elif isinstance(c0, TextColumn):
+            cols[nm] = TextColumn(
+                c0.ftype, np.concatenate([p.values for p in parts]))
+        elif isinstance(c0, (TextListColumn, TextSetColumn)):
+            vals = [v for p in parts for v in p.values]
+            cols[nm] = type(c0)(c0.ftype, vals)
+        elif isinstance(c0, GeoColumn):
+            cols[nm] = GeoColumn(
+                c0.ftype, np.concatenate([p.values for p in parts]),
+                np.concatenate([p.mask for p in parts]))
+        elif isinstance(c0, RaggedColumn):
+            flat = np.concatenate([p.flat for p in parts])
+            lengths = np.concatenate(
+                [np.diff(p.offsets) for p in parts])
+            offsets = np.concatenate([[0], np.cumsum(lengths)])
+            cols[nm] = RaggedColumn(c0.ftype, flat,
+                                    offsets.astype(np.int64))
+        else:
+            raise TypeError(
+                f"cannot row-concatenate column {nm!r} "
+                f"({type(c0).__name__}) across scoring chunks")
+    return ColumnStore(cols, sum(s.n_rows for s in stores))
+
+
+def stream_score_overlapped(model, batches, keep_intermediate: bool = False,
+                            engine: Optional[ScoringEngine] = None):
+    """Software-pipelined streaming score: host feature extraction of
+    micro-batch k+1 (record→columns, host transforms, host_prepare,
+    padding) runs in a worker thread while batch k computes on device —
+    the tf.data overlap model on the serving path. Yields one scored
+    ColumnStore per batch, same contract as ``readers.stream_score``.
+
+    Falls back to the plain per-batch path when the engine is missing or
+    gated off (slow link)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    eng = engine if engine is not None else model.scoring_engine()
+    if eng is None or not eng.enabled():
+        for batch in batches:
+            yield model.score(list(batch), keep_intermediate=keep_intermediate)
+        return
+
+    it = iter(batches)
+    first = next(it, None)
+    if first is None:
+        return
+    with ThreadPoolExecutor(max_workers=1,
+                            thread_name_prefix="score-prep") as ex:
+        fut = ex.submit(eng.prepare_batch, list(first))
+        while fut is not None:
+            prep = fut.result()
+            nxt = next(it, None)
+            fut = (ex.submit(eng.prepare_batch, list(nxt))
+                   if nxt is not None else None)
+            store = eng.run_batch(prep, results_only=not keep_intermediate)
+            if not keep_intermediate:
+                store = store.select([nm for nm in eng._result_names
+                                      if nm in store])
+            yield store

@@ -8,22 +8,33 @@ a **StableHLO artifact** via ``jax.export`` — loadable from any JAX
 process (CPU serving included) without this framework installed, and
 batch-size polymorphic so one artifact serves any request size.
 
-The full row→features path stays host-side Python (``score_fn``); this
-export covers the device half (feature vector → Prediction triple), which
-is what model-serving infrastructure typically wants hardware-portable.
+Two export granularities:
+
+* :func:`export_prediction_fn` — the prediction head alone (feature
+  vector → Prediction triple), the original contract.
+* :func:`export_scoring_fn` — the compiled scoring engine's WHOLE fused
+  chain (every vectorizer ``device_compute``, the combiner concat, the
+  sanity-checker gather, scalers, the predictor) as one batch-polymorphic
+  StableHLO program. The host half (string hashing, vocab lookups —
+  ``host_prepare``) stays host-side Python by design; the artifact covers
+  everything that runs on the device, so serving infrastructure re-homes
+  the full device computation, not just the head.
 """
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["export_prediction_fn", "load_prediction_fn"]
+__all__ = ["export_prediction_fn", "load_prediction_fn",
+           "export_scoring_fn", "load_scoring_fn"]
 
 _BLOB = "prediction_fn.stablehlo"
 _META = "export.json"
+_SCORE_BLOB = "scoring_fn.stablehlo"
+_SCORE_META = "scoring_export.json"
 
 
 def export_prediction_fn(model, path: str,
@@ -77,6 +88,7 @@ def export_prediction_fn(model, path: str,
         fh.write(exp.serialize())
     meta = {"featureDim": feature_dim,
             "predFeature": pred_feature.name,
+            "coverage": "prediction_head",
             "outputs": ["prediction", "rawPrediction", "probability"]}
     with open(os.path.join(path, _META), "w") as fh:
         json.dump(meta, fh, indent=1)
@@ -98,5 +110,107 @@ def load_prediction_fn(path: str) -> Callable[[np.ndarray], Dict[str, Any]]:
             raise ValueError(
                 f"Expected [n, {meta['featureDim']}] input, got {X.shape}")
         return {k: np.asarray(v) for k, v in exp.call(X).items()}
+
+    return call
+
+
+def _block_key(spec: Dict[str, Any]) -> str:
+    return (f"{spec['uid']}/{spec['name']}" if spec["kind"] == "prepared"
+            else spec["name"])
+
+
+def export_scoring_fn(model, path: str, sample_data,
+                      bucket_cap: Optional[int] = None) -> Dict[str, Any]:
+    """Export the FULL fused transform→predict chain as StableHLO.
+
+    Requires every stage between the prepared host blocks and the result
+    features to be device-capable (scoring.ScoringEngine's fused set must
+    include the predictor); raises ``ValueError`` otherwise — callers
+    wanting the head-only artifact use :func:`export_prediction_fn`.
+
+    ``sample_data`` (records or a raw ColumnStore) supplies one host pass
+    to discover the prepared-block manifest; the exported program is
+    batch-size polymorphic over the row dimension. Returns the metadata
+    dict (manifest + outputs) written alongside the artifact.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    from .scoring import ScoringEngine
+
+    eng = ScoringEngine(model, gate_bandwidth=False,
+                        **({"bucket_cap": bucket_cap} if bucket_cap else {}))
+    if not eng.covers_prediction:
+        raise ValueError(
+            "full-chain export needs the predictor inside the fused "
+            "program; a host-only stage consumes a device output "
+            "(export_prediction_fn covers the head alone)")
+    out_names = eng._out_names(results_only=True)
+    if not out_names:
+        raise ValueError("no fused result features to export")
+    manifest = eng.export_manifest(sample_data)
+    flat_fn = eng.export_callable(manifest, out_names)
+
+    def predict(*blocks):
+        outs = flat_fn(*blocks)
+        flat: Dict[str, Any] = {}
+        for nm, v in outs.items():
+            if isinstance(v, tuple):    # Prediction triple
+                flat[f"{nm}.prediction"] = v[0]
+                flat[f"{nm}.rawPrediction"] = v[1]
+                flat[f"{nm}.probability"] = v[2]
+            else:
+                flat[nm] = v
+        return flat
+
+    b = jexport.symbolic_shape("b")[0]
+    args = [jax.ShapeDtypeStruct((b, *spec["tail"]),
+                                 jnp.dtype(spec["dtype"]))
+            for spec in manifest]
+    exp = jexport.export(jax.jit(predict))(*args)
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, _SCORE_BLOB), "wb") as fh:
+        fh.write(exp.serialize())
+    meta = {"coverage": "fused_chain",
+            "fusedStages": eng.fused_stage_count,
+            "inputs": manifest,
+            "resultFeatures": out_names}
+    with open(os.path.join(path, _SCORE_META), "w") as fh:
+        json.dump(meta, fh, indent=1)
+    return meta
+
+
+def load_scoring_fn(path: str) -> Callable[[Dict[str, np.ndarray]],
+                                           Dict[str, np.ndarray]]:
+    """Load a full-chain artifact → callable({block key: array}) → dict of
+    output arrays. Block keys are ``"<stage uid>/<block name>"`` for
+    prepared vectorizer blocks and the bare column name for direct vector
+    uploads (see ``meta["inputs"]``). Needs only jax, not this package —
+    the caller supplies host-prepared blocks (every row-leading array,
+    one consistent batch size)."""
+    from jax import export as jexport
+
+    with open(os.path.join(path, _SCORE_BLOB), "rb") as fh:
+        exp = jexport.deserialize(fh.read())
+    with open(os.path.join(path, _SCORE_META)) as fh:
+        meta = json.load(fh)
+    manifest: List[Dict[str, Any]] = meta["inputs"]
+
+    def call(blocks: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        args = []
+        for spec in manifest:
+            key = _block_key(spec)
+            if key not in blocks:
+                raise ValueError(f"missing input block {key!r}")
+            args.append(np.asarray(blocks[key], dtype=spec["dtype"]))
+        ns = {a.shape[0] for a in args}
+        if len(ns) > 1:
+            raise ValueError(f"inconsistent batch sizes across blocks: {ns}")
+        out = exp.call(*args)
+        flat: Dict[str, np.ndarray] = {}
+        for k, v in out.items():
+            flat[k] = np.asarray(v)
+        return flat
 
     return call

@@ -635,6 +635,9 @@ class WorkflowModel:
         self.train_time_s = train_time_s
         #: per-stage fit/transform timings (OpSparkListener analog)
         self.stage_metrics = stage_metrics or {}
+        #: lazily built compiled scoring engine (scoring.ScoringEngine);
+        #: False = not yet attempted, None = attempted and unusable
+        self._scoring_engine: Any = False
 
     # -- stage access (OpWorkflowModel.getOriginStageOf analog) ------------
     def _resolved_dag(self) -> List[List[Transformer]]:
@@ -660,9 +663,42 @@ class WorkflowModel:
         return self.fitted_stages.get(st.uid, st)
 
     # -- scoring -----------------------------------------------------------
-    def transform(self, data, up_to: Optional[Feature] = None) -> ColumnStore:
-        """Apply the fitted DAG (optionally only ancestors of ``up_to`` —
-        computeDataUpTo, OpWorkflowModel.scala:106)."""
+    def scoring_engine(self, rebuild: bool = False, **engine_kw):
+        """The compiled batched scoring engine for this model
+        (scoring.ScoringEngine), built once and memoized. Returns None
+        when the plan cannot be built (nothing fusable is not an error —
+        the engine still runs, it just reports ``enabled() == False``)."""
+        if rebuild or self._scoring_engine is False or engine_kw:
+            from .scoring import ScoringEngine
+            try:
+                eng = ScoringEngine(self, **engine_kw)
+            except Exception:
+                logger.exception("scoring engine build failed; "
+                                 "per-layer path stays active")
+                eng = None
+            if engine_kw and not rebuild:
+                return eng          # custom engines aren't memoized
+            self._scoring_engine = eng
+        return self._scoring_engine
+
+    def _use_engine(self, n_rows: int, engine) -> bool:
+        """Routing decision for score/transform: ``engine=True`` forces,
+        ``False`` forbids, ``"auto"`` requires a worthwhile batch (same
+        reasoning as FUSE_MIN_ROWS) plus the bandwidth gate."""
+        if engine is False:
+            return False
+        from .scoring import SCORING_MIN_ROWS
+        eng = self.scoring_engine()
+        if eng is None or not eng.enabled():
+            return False
+        if engine is True:
+            return True
+        return n_rows >= SCORING_MIN_ROWS
+
+    def _transform_layers(self, data,
+                          up_to: Optional[Feature] = None) -> ColumnStore:
+        """The per-layer reference path (one host↔device crossing per
+        DAG layer) — the engine's fallback and parity oracle."""
         targets = (up_to,) if up_to is not None else self.result_features
         raw_features = _raw_features_of(targets)
         store = _generate_raw_store(data, raw_features)
@@ -674,10 +710,45 @@ class WorkflowModel:
             store = apply_layer_vectorized(wanted, store)
         return store
 
-    def score(self, data, keep_intermediate: bool = False) -> ColumnStore:
+    def transform(self, data, up_to: Optional[Feature] = None,
+                  engine: Any = "auto") -> ColumnStore:
+        """Apply the fitted DAG (optionally only ancestors of ``up_to`` —
+        computeDataUpTo, OpWorkflowModel.scala:106).
+
+        With ``up_to=None`` big batches route through the compiled
+        scoring engine (scoring.py): the whole device-capable chain runs
+        as ONE jitted program instead of one crossing per layer.
+        ``engine=True/False`` force/forbid the engine path."""
+        if up_to is None:
+            n = (data.n_rows if isinstance(data, ColumnStore)
+                 else len(data) if hasattr(data, "__len__") else 0)
+            if self._use_engine(n, engine):
+                try:
+                    return self.scoring_engine().transform_store(data)
+                except Exception:
+                    logger.exception(
+                        "scoring engine transform failed; falling back "
+                        "to the per-layer path")
+        return self._transform_layers(data, up_to)
+
+    def score(self, data, keep_intermediate: bool = False,
+              engine: Any = "auto") -> ColumnStore:
         """Score: returns result feature columns (+ key columns)
-        (OpWorkflowModel.score, :254-268)."""
-        store = self.transform(data)
+        (OpWorkflowModel.score, :254-268). Routes through the compiled
+        scoring engine for worthwhile batches (see ``transform``); the
+        engine path pulls ONLY the result columns off the device."""
+        if not keep_intermediate:
+            n = (data.n_rows if isinstance(data, ColumnStore)
+                 else len(data) if hasattr(data, "__len__") else 0)
+            if self._use_engine(n, engine):
+                try:
+                    return self.scoring_engine().score_store(data)
+                except Exception:
+                    logger.exception(
+                        "scoring engine score failed; falling back to "
+                        "the per-layer path")
+                    engine = False      # don't re-attempt via transform
+        store = self.transform(data, engine=engine)
         if keep_intermediate:
             return store
         return store.select([f.name for f in self.result_features
