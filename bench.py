@@ -121,7 +121,10 @@ class Bench:
 
     def _die(self, signum, _frame) -> None:
         self.doc["killed_by_signal"] = int(signum)
-        self.emit()
+        # enrich=False: no imports / jax calls inside a signal handler
+        # (import-lock deadlock, non-reentrant runtime) — the cumulative
+        # doc already carries the last emit's gate/clock/cache fields
+        self.emit(enrich=False)
         os._exit(1)
 
     def elapsed(self) -> float:
@@ -130,8 +133,25 @@ class Bench:
     def remaining(self) -> float:
         return self.budget_s - self.elapsed()
 
-    def emit(self, final: bool = False) -> None:
+    def emit(self, final: bool = False, enrich: bool = True) -> None:
         self.doc["elapsed_s"] = round(self.elapsed(), 1)
+        # every emitted doc carries the fusion gate state, the cumulative
+        # compile clock and the scoring-engine cache tallies (VERDICT r3
+        # asked every benched number to say whether fusion was on; the
+        # compile/cache counters explain cold-vs-warm deltas in place).
+        # enrich=False is the signal-handler path: dump as-is.
+        if enrich:
+            try:
+                from transmogrifai_tpu.workflow import fusion_state
+                self.doc["fusion_gate"] = fusion_state()
+            except Exception:
+                self.doc.setdefault("fusion_gate", None)
+            self.doc["compile_clock_s"] = round(_compile_s(), 2)
+            try:
+                from transmogrifai_tpu.scoring import engine_cache_stats
+                self.doc["scoring_cache"] = engine_cache_stats()
+            except Exception:
+                self.doc.setdefault("scoring_cache", None)
         if final:
             self.doc.pop("partial", None)
         print(json.dumps(self.doc), flush=True)
@@ -654,13 +674,7 @@ def main() -> None:
                 configs["cpu_host_denominator"] = {"error": repr(e)[:200]}
         bench.emit()
 
-    # fusion gate state (process-wide probe; VERDICT r3 #4)
-    try:
-        from transmogrifai_tpu.workflow import fusion_state
-        doc["fusion_gate"] = fusion_state()
-    except Exception:
-        doc["fusion_gate"] = None
-
+    # fusion gate / compile clock / cache tallies ride on EVERY emit now
     bench.emit(final=True)
 
 

@@ -1,0 +1,453 @@
+"""Run telemetry tests (telemetry.py) — the OpSparkListener analog.
+
+Covers the tentpole contract: span nesting + thread safety, Chrome
+trace-event JSON validity, counter/gauge/histogram math, Prometheus
+text exposition, RunListener event ordering over a tiny fit+score run,
+and the disabled-path guard (zero spans, zero listeners, no extra
+jax.monitoring registrations when telemetry is off). Satellites: the
+runner's atomic metrics sink and the CLI --trace-out/--metrics-format
+surface.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (FeatureBuilder, Workflow, telemetry)
+from transmogrifai_tpu.models import (BinaryClassificationModelSelector,
+                                      LogisticRegressionFamily)
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.runner import (OpApp, OpParams, OpWorkflowRunner,
+                                      RunType)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _records(rng, n=200):
+    y = rng.integers(0, 2, n).astype(float)
+    x = rng.normal(size=n) + y
+    return [{"label": float(y[i]), "x": float(x[i])} for i in range(n)]
+
+
+def _flow():
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    vec = transmogrify([fx])
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily(
+            grid=[{"regParam": 0.01, "elasticNetParam": 0.0}])],
+        splitter=None, seed=5)
+    pred = label.transform_with(selector, vec)
+    return Workflow().set_result_features(pred), pred
+
+
+# -- span tracer -----------------------------------------------------------
+
+def test_span_nesting_and_chrome_trace_validity(tmp_path):
+    telemetry.enable()
+    with telemetry.span("outer", kind="test"):
+        assert telemetry.current_span_stack() == ("outer",)
+        with telemetry.span("inner", depth=2):
+            assert telemetry.current_span_stack() == ("outer", "inner")
+    assert telemetry.current_span_stack() == ()
+
+    events = [e for e in telemetry.trace_events() if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in events}
+    assert set(by_name) == {"outer", "inner"}
+    inner, outer = by_name["inner"], by_name["outer"]
+    # the child span nests inside the parent on the same track
+    assert inner["tid"] == outer["tid"]
+    assert inner["ts"] >= outer["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert inner["args"] == {"depth": 2}
+
+    p = tmp_path / "trace.json"
+    assert telemetry.write_trace(str(p))
+    doc = json.load(open(p))            # valid JSON, Perfetto-loadable keys
+    assert doc["displayTimeUnit"] == "ms"
+    for e in doc["traceEvents"]:
+        assert {"name", "ph", "pid", "tid"} <= set(e)
+        if e["ph"] == "X":
+            assert e["dur"] >= 0 and e["ts"] >= 0
+
+
+def test_span_thread_safety_and_per_thread_tracks():
+    telemetry.enable()
+    n_threads, n_spans = 4, 50
+    barrier = threading.Barrier(n_threads)
+
+    def work(k):
+        barrier.wait()
+        for i in range(n_spans):
+            with telemetry.span("worker", thread=k, i=i):
+                pass
+
+    threads = [threading.Thread(target=work, args=(k,), name=f"w{k}")
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    spans = [e for e in telemetry.trace_events()
+             if e["ph"] == "X" and e["name"] == "worker"]
+    assert len(spans) == n_threads * n_spans      # none lost to races
+    assert len({e["tid"] for e in spans}) == n_threads
+    # each worker thread announced its name on its own track
+    metas = [e for e in telemetry.trace_events() if e["ph"] == "M"]
+    names = {e["args"]["name"] for e in metas}
+    assert {f"w{k}" for k in range(n_threads)} <= names
+
+
+def test_disabled_path_records_nothing():
+    """The guard the tentpole demands: telemetry off ⇒ shared no-op
+    singletons, zero spans, zero listeners, no metrics registered."""
+    assert not telemetry.enabled()
+    s = telemetry.span("x", big=list(range(3)))
+    assert s is telemetry.span("y")               # shared null span
+    with s:
+        pass
+    c = telemetry.counter("scoring.cache_hits")
+    assert c is telemetry.gauge("g") is telemetry.histogram("h")
+    c.inc()
+    telemetry.gauge("g").set(5)
+    telemetry.emit("run_start", run_type="Train")
+    assert telemetry.trace_events() == []
+    assert telemetry.metrics_json() == {}
+    assert telemetry.listeners() == []
+
+
+# -- metrics registry ------------------------------------------------------
+
+def test_counter_gauge_histogram_math():
+    telemetry.enable()
+    c = telemetry.counter("scoring.cache_hits")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    assert telemetry.counter("scoring.cache_hits") is c   # get-or-create
+
+    g = telemetry.gauge("stream.queue_depth")
+    g.set(3)
+    g.inc()
+    g.dec(2)
+    assert g.value == 2
+
+    h = telemetry.histogram("lat", buckets=(0.001, 0.01, 1.0))
+    for v in (0.0004, 0.005, 0.5, 30.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(30.5054)
+    assert h.bucket_counts() == {0.001: 1, 0.01: 2, 1.0: 3}   # cumulative
+
+    doc = telemetry.metrics_json()
+    assert doc["scoring.cache_hits"] == 5
+    assert doc["stream.queue_depth"] == 2
+    assert doc["lat"]["count"] == 4
+    assert doc["lat"]["buckets"]["0.01"] == 2
+
+    with pytest.raises(TypeError):
+        telemetry.gauge("scoring.cache_hits")     # kind mismatch caught
+
+
+def test_prometheus_exposition_format():
+    telemetry.enable()
+    telemetry.counter("scoring.cache_hits").inc(3)
+    telemetry.gauge("stream.overlap_efficiency").set(0.75)
+    h = telemetry.histogram("scoring.batch_seconds", buckets=(0.01, 1.0))
+    h.observe(0.005)
+    h.observe(2.0)
+    text = telemetry.render_prometheus(extra={"run_appSeconds": 1.5})
+    lines = text.splitlines()
+    assert "# TYPE scoring_cache_hits counter" in lines
+    assert "scoring_cache_hits 3" in lines
+    assert "# TYPE stream_overlap_efficiency gauge" in lines
+    assert "stream_overlap_efficiency 0.75" in lines
+    assert "# TYPE scoring_batch_seconds histogram" in lines
+    assert 'scoring_batch_seconds_bucket{le="0.01"} 1' in lines
+    assert 'scoring_batch_seconds_bucket{le="+Inf"} 2' in lines
+    assert "scoring_batch_seconds_count 2" in lines
+    assert any(l.startswith("scoring_batch_seconds_sum") for l in lines)
+    assert "run_appSeconds 1.5" in lines
+    assert text.endswith("\n")
+
+
+# -- listeners over a real run ---------------------------------------------
+
+class _Recorder(telemetry.RunListener):
+    def __init__(self):
+        self.events = []
+
+    def on_run_start(self, run_type, **_):
+        self.events.append(("run_start", run_type))
+
+    def on_run_end(self, run_type, seconds=0.0, **_):
+        self.events.append(("run_end", run_type))
+
+    def on_layer_start(self, index, n_stages, **_):
+        self.events.append(("layer_start", index))
+
+    def on_stage_fit(self, uid, stage_name, fit_s, **_):
+        self.events.append(("stage_fit", uid))
+
+    def on_score_batch(self, n_rows, bucket, seconds, **_):
+        self.events.append(("score_batch", n_rows))
+
+
+def test_listener_event_ordering_over_fit_and_score(rng, tmp_path):
+    telemetry.enable()
+    rec = telemetry.add_listener(_Recorder())
+    records = _records(rng)
+    wf, pred = _flow()
+
+    class _Reader:
+        def read_records(self):
+            return list(records)
+
+    runner = OpWorkflowRunner(wf, training_reader=_Reader(),
+                              scoring_reader=_Reader())
+    params = OpParams(model_location=str(tmp_path / "m"))
+    result = runner.run(RunType.TRAIN, params)
+    names = [e[0] for e in rec.events]
+    assert names[0] == "run_start" and rec.events[0][1] == "Train"
+    assert names[-1] == "run_end"
+    layer_idx = [e[1] for e in rec.events if e[0] == "layer_start"]
+    assert layer_idx == sorted(layer_idx) and layer_idx[0] == 0
+    # stage fits happen after their layer opened, before run_end
+    assert names.index("layer_start") < names.index("stage_fit") \
+        < names.index("run_end")
+    assert names.count("stage_fit") >= 2        # vectorizer + selector
+
+    # engine-scored batches land as score_batch events after the train run
+    from transmogrifai_tpu.workflow import WorkflowModel
+    model = WorkflowModel.load(str(tmp_path / "m"))
+    eng = model.scoring_engine(gate_bandwidth=False)
+    eng.score_store(records)
+    assert ("score_batch", len(records)) in rec.events
+    assert names.index("run_end") < rec.events.index(
+        ("score_batch", len(records)))
+
+    # the runner's own collecting listener rode into the metrics doc
+    tel = result.metrics["telemetry"]
+    assert tel["runType"] == "Train"
+    assert tel["layers"] >= 2 and tel["fittedStages"] >= 2
+    assert tel["appSeconds"] > 0
+
+
+def test_listener_exceptions_do_not_break_the_run():
+    telemetry.enable()
+
+    class _Bomb(telemetry.RunListener):
+        def on_layer_start(self, index, n_stages, **_):
+            raise RuntimeError("boom")
+
+    rec = _Recorder()
+    telemetry.add_listener(_Bomb())
+    telemetry.add_listener(rec)
+    telemetry.emit("layer_start", index=0, n_stages=1)   # must not raise
+    assert rec.events == [("layer_start", 0)]
+
+
+# -- acceptance: fit + engine-scored run -----------------------------------
+
+def test_enabled_run_traces_layers_stages_and_buckets(rng, tmp_path):
+    """Acceptance: a fit + engine-scored run with telemetry on writes a
+    valid Chrome trace with spans for every DAG layer, every fitted
+    stage, and every scoring bucket execution, plus nonzero compile and
+    cache-hit counters in the metrics doc."""
+    telemetry.enable()
+    records = _records(rng)
+    wf, pred = _flow()
+    model = wf.set_input_records(records).train()
+    eng = model.scoring_engine(gate_bandwidth=False)
+    eng.score_store(records)
+    eng.score_store(list(records))      # same shapes → program cache hit
+
+    spans = [e for e in telemetry.trace_events() if e["ph"] == "X"]
+    layers = [e for e in spans if e["name"] == "fit:layer"]
+    assert len(layers) == len(model.dag)
+    assert {e["args"]["layer"] for e in layers} == set(range(len(model.dag)))
+    stage_uids = {e["args"]["uid"] for e in spans
+                  if e["name"] == "fit:stage"}
+    assert stage_uids == set(model.fitted_stages)
+    buckets = [e for e in spans if e["name"] == "score:bucket"]
+    assert len(buckets) == 2
+    assert buckets[0]["args"]["compiled"] is True
+    assert buckets[1]["args"]["compiled"] is False
+
+    metrics = telemetry.metrics_json()
+    assert metrics["scoring.compile_count"] >= 1
+    assert metrics["scoring.cache_hits"] >= 1
+    assert metrics["device.bytes_h2d"] > 0
+
+    p = tmp_path / "trace.json"
+    telemetry.write_trace(str(p))
+    assert len(json.load(open(p))["traceEvents"]) == len(
+        telemetry.trace_events())
+
+
+def test_disabled_run_registers_nothing(rng):
+    """Acceptance flip side: the same run with telemetry off records zero
+    spans, keeps the listener registry empty, and registers no extra
+    jax.monitoring listeners (only the single shared compile-clock one,
+    installed once per process whether telemetry is on or off)."""
+    assert not telemetry.enabled()
+    records = _records(rng, n=120)
+    wf, pred = _flow()
+    model = wf.set_input_records(records).train()
+    eng = model.scoring_engine(gate_bandwidth=False)
+    eng.score_store(records)
+    assert telemetry.trace_events() == []
+    assert telemetry.metrics_json() == {}
+    assert telemetry.listeners() == []
+    assert telemetry._COMPILE_LISTENER_REGISTRATIONS[0] <= 1
+    # the compile clock itself still works when telemetry is off (bench
+    # and the stage compile/execute split depend on it)
+    assert telemetry.compile_clock_s() >= 0.0
+    # enabling+disabling telemetry must not add monitoring listeners
+    telemetry.enable()
+    telemetry.disable()
+    assert telemetry._COMPILE_LISTENER_REGISTRATIONS[0] <= 1
+
+
+def test_workflow_reexports_share_state():
+    """Satellite: workflow keeps the public compile-clock names as thin
+    re-exports over telemetry's single implementation."""
+    from transmogrifai_tpu import workflow as wf
+    assert wf._COMPILE_CLOCK is telemetry._COMPILE_CLOCK
+    assert wf.compile_clock_s is telemetry.compile_clock_s
+    assert wf._ensure_compile_listener is telemetry._ensure_compile_listener
+
+
+# -- runner satellites -----------------------------------------------------
+
+def test_write_metrics_atomic(tmp_path, monkeypatch):
+    p = tmp_path / "metrics.json"
+    OpWorkflowRunner._write_metrics(str(p), {"a": 1})
+    assert json.load(open(p)) == {"a": 1}
+    assert not os.path.exists(str(p) + ".tmp")
+
+    # a crash mid-write must leave the previous good file intact
+    def boom(*a, **kw):
+        raise RuntimeError("disk full")
+    monkeypatch.setattr(json, "dump", boom)
+    with pytest.raises(RuntimeError):
+        OpWorkflowRunner._write_metrics(str(p), {"a": 2})
+    monkeypatch.undo()
+    assert json.load(open(p)) == {"a": 1}
+
+
+def test_write_metrics_prometheus_format(tmp_path):
+    telemetry.enable()
+    telemetry.counter("scoring.cache_hits").inc(7)
+    p = tmp_path / "metrics.prom"
+    OpWorkflowRunner._write_metrics(
+        str(p), {"appSeconds": 1.25, "rowsScored": 10, "tag": "x"},
+        fmt="prometheus")
+    text = open(p).read()
+    assert "# TYPE scoring_cache_hits counter" in text
+    assert "scoring_cache_hits 7" in text
+    assert "run_appSeconds 1.25" in text
+    assert "run_rowsScored 10" in text
+    assert "tag" not in text            # non-numeric doc fields dropped
+
+
+def test_cli_trace_out_and_metrics_format(rng, tmp_path):
+    records = _records(rng)
+    wf, pred = _flow()
+
+    class _Reader:
+        def read_records(self):
+            return list(records)
+
+    class _App(OpApp):
+        def runner(self, params):
+            return OpWorkflowRunner(wf, training_reader=_Reader(),
+                                    scoring_reader=_Reader())
+
+    trace = tmp_path / "trace.json"
+    metrics = tmp_path / "metrics.prom"
+    out = _App().main([
+        "--run-type", "Train", "--quiet",
+        "--model-location", str(tmp_path / "m"),
+        "--metrics-location", str(metrics),
+        "--trace-out", str(trace),
+        "--metrics-format", "prometheus"])
+    assert out.run_type == "Train"
+    doc = json.load(open(trace))
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "run:Train" in names and "fit:stage" in names
+    text = open(metrics).read()
+    assert "# TYPE" in text and "run_appSeconds" in text
+    # the collecting listener's AppMetrics summary rode in the result
+    assert out.metrics["telemetry"]["fittedStages"] >= 2
+
+
+def test_runner_telemetry_is_run_scoped(rng, tmp_path):
+    """OpParams-driven telemetry must not stay sticky: later runs of a
+    long-lived process that never asked for it record nothing."""
+    records = _records(rng, n=120)
+    wf, pred = _flow()
+
+    class _Reader:
+        def read_records(self):
+            return list(records)
+
+    runner = OpWorkflowRunner(wf, training_reader=_Reader(),
+                              scoring_reader=_Reader())
+    trace = tmp_path / "trace.json"
+    params = OpParams(model_location=str(tmp_path / "m"),
+                      trace_location=str(trace))
+    assert not telemetry.enabled()
+    out = runner.run(RunType.TRAIN, params)
+    assert trace.exists() and "telemetry" in out.metrics
+    assert not telemetry.enabled()        # switched back off after the run
+    # a later run WITHOUT telemetry params records nothing new
+    n_before = len(telemetry.trace_events())
+    runner.run(RunType.SCORE, OpParams(model_location=str(tmp_path / "m")))
+    assert len(telemetry.trace_events()) == n_before
+    # and a later telemetry-enabled run gets a CLEAN per-run trace
+    trace2 = tmp_path / "trace2.json"
+    runner.run(RunType.SCORE, OpParams(model_location=str(tmp_path / "m"),
+                                       trace_location=str(trace2)))
+    names2 = {e["name"] for e in json.load(open(trace2))["traceEvents"]}
+    assert "run:Score" in names2 and "fit:stage" not in names2
+
+
+def test_crashed_run_still_writes_partial_trace(tmp_path):
+    """The failing run is the one you most want a trace of: spans up to
+    the failure are flushed, and run-scoped telemetry is still torn
+    down."""
+    wf, pred = _flow()
+    runner = OpWorkflowRunner(wf)
+    trace = tmp_path / "trace.json"
+    params = OpParams(trace_location=str(trace))   # no modelLocation
+    with pytest.raises(ValueError, match="requires modelLocation"):
+        runner.run(RunType.SCORE, params)
+    doc = json.load(open(trace))
+    assert any(e["name"] == "run:Score" for e in doc["traceEvents"])
+    assert not telemetry.enabled()
+
+
+def test_opparams_telemetry_roundtrip(tmp_path):
+    p = tmp_path / "params.json"
+    p.write_text(json.dumps({
+        "traceLocation": "/tmp/trace.json",
+        "metricsFormat": "prometheus",
+        "customParams": {"telemetry": True}}))
+    params = OpParams.from_file(str(p))
+    assert params.trace_location == "/tmp/trace.json"
+    assert params.metrics_format == "prometheus"
+    assert params.telemetry_requested()
+    doc = params.to_json()
+    assert doc["traceLocation"] == "/tmp/trace.json"
+    assert doc["metricsFormat"] == "prometheus"
+    assert not OpParams().telemetry_requested()

@@ -350,6 +350,8 @@ def stream_score(model, batches: Iterable[Sequence[Mapping[str, Any]]],
     pay for compilation; ``True``/``False`` force/forbid it."""
     import itertools
 
+    from .. import telemetry
+
     it = iter(batches)
     first = next(it, None)
     if first is None:
@@ -362,13 +364,19 @@ def stream_score(model, batches: Iterable[Sequence[Mapping[str, Any]]],
         ok = eng is not None and eng.enabled()
         use_overlap = ok and (overlap is True
                               or len(first) >= SCORING_MIN_ROWS)
+    # routing evidence: which streaming mode actually served the batches
+    telemetry.counter("stream.overlapped_streams" if use_overlap
+                      else "stream.plain_streams").inc()
     if use_overlap:
         from ..scoring import stream_score_overlapped
         yield from stream_score_overlapped(
             model, chained, keep_intermediate=keep_intermediate)
         return
     for batch in chained:
-        yield model.score(list(batch), keep_intermediate=keep_intermediate)
+        with telemetry.span("stream:score_batch", rows=len(batch)):
+            out = model.score(list(batch),
+                              keep_intermediate=keep_intermediate)
+        yield out
 
 
 class DataReaders:

@@ -87,7 +87,17 @@ class DirectoryStreamReader:
         (corrupt, vanished mid-read) is logged, marked seen and skipped
         — retrying it every poll would wedge the stream forever."""
         import logging
-        for fp in self._snapshot():
+
+        from .. import telemetry
+        snapshot = self._snapshot()
+        if telemetry.enabled():
+            # unconsumed files visible right now (including ones still
+            # settling): the ingest backlog — a growing value means
+            # scoring can't keep up with arrivals. Pure set arithmetic
+            # off the listing this poll already does; no extra stat I/O.
+            telemetry.gauge("stream.file_backlog").set(
+                sum(1 for fp in snapshot if fp not in self._seen))
+        for fp in snapshot:
             if fp in self._seen or not self._ready(fp):
                 continue
             try:

@@ -22,6 +22,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
+from . import telemetry
+
 logger = logging.getLogger(__name__)
 
 __all__ = ["OpParams", "RunType", "RunnerResult", "OpWorkflowRunner",
@@ -39,6 +41,12 @@ class OpParams:
     model_location: Optional[str] = None
     write_location: Optional[str] = None
     metrics_location: Optional[str] = None
+    #: Chrome trace-event JSON sink; setting it turns telemetry on
+    trace_location: Optional[str] = None
+    #: metrics sink format: "json" (the run doc) or "prometheus" (the
+    #: telemetry registry in text exposition + run doc numerics);
+    #: "prometheus" turns telemetry on
+    metrics_format: str = "json"
     custom_params: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
@@ -55,6 +63,8 @@ class OpParams:
             model_location=doc.get("modelLocation"),
             write_location=doc.get("writeLocation"),
             metrics_location=doc.get("metricsLocation"),
+            trace_location=doc.get("traceLocation"),
+            metrics_format=doc.get("metricsFormat", "json"),
             custom_params=doc.get("customParams", {}))
 
     def to_json(self) -> Dict[str, Any]:
@@ -63,7 +73,16 @@ class OpParams:
                 "modelLocation": self.model_location,
                 "writeLocation": self.write_location,
                 "metricsLocation": self.metrics_location,
+                "traceLocation": self.trace_location,
+                "metricsFormat": self.metrics_format,
                 "customParams": self.custom_params}
+
+    def telemetry_requested(self) -> bool:
+        """True when this config asks for run telemetry (trace sink,
+        Prometheus metrics, or ``customParams.telemetry``)."""
+        return bool(self.trace_location
+                    or self.metrics_format == "prometheus"
+                    or self.custom_params.get("telemetry"))
 
     def apply_to_workflow(self, workflow) -> None:
         """Reflectively push stage params into the workflow's DAG stages
@@ -116,14 +135,29 @@ class OpWorkflowRunner:
 
     # -- metrics sink ------------------------------------------------------
     @staticmethod
-    def _write_metrics(location: Optional[str], doc: Dict[str, Any]) -> None:
+    def _write_metrics(location: Optional[str], doc: Dict[str, Any],
+                       fmt: str = "json") -> None:
+        """Crash-consistent metrics sink: write a sibling temp file and
+        ``os.replace`` it in (the ``_atomic_checkpoint`` discipline), so
+        a kill mid-write can never leave a truncated metrics file.
+        ``fmt="prometheus"`` writes the telemetry registry in text
+        exposition format with the run doc's numeric scalars appended as
+        ``run_*`` gauges; the default writes the run doc as JSON."""
         # multi-host: every process computes identical metrics; one writer
         from .parallel.multihost import is_coordinator
         if not location or not is_coordinator():
             return
         os.makedirs(os.path.dirname(location) or ".", exist_ok=True)
-        with open(location, "w") as fh:
-            json.dump(doc, fh, indent=1, default=str)
+        tmp = f"{location}.tmp"
+        with open(tmp, "w") as fh:
+            if fmt == "prometheus":
+                extra = {f"run_{k}": float(v) for k, v in doc.items()
+                         if isinstance(v, (int, float))
+                         and not isinstance(v, bool)}
+                fh.write(telemetry.render_prometheus(extra))
+            else:
+                json.dump(doc, fh, indent=1, default=str)
+        os.replace(tmp, location)
 
     def run(self, run_type: str, params: Optional[OpParams] = None
             ) -> RunnerResult:
@@ -132,9 +166,65 @@ class OpWorkflowRunner:
             raise ValueError(
                 f"Unknown run type {run_type!r}; expected one of "
                 f"{RunType.ALL}")
+        # run-scoped enablement: a config that asks for telemetry turns it
+        # on for THIS run only — recording must not stay sticky for later
+        # runs of a long-lived process that never asked (a user-level
+        # telemetry.enable() before the run stays in force, untouched)
+        run_scoped = False
+        if params.telemetry_requested() and not telemetry.enabled():
+            telemetry.enable()
+            run_scoped = True
+        # one collecting listener per run (OpSparkListener analog): its
+        # AppMetrics summary rides in the metrics doc/sink below
+        collector = None
+        if telemetry.enabled():
+            collector = telemetry.add_listener(
+                telemetry.CollectingRunListener())
         logger.info("run type=%s model=%s write=%s", run_type,
                     params.model_location, params.write_location)
-        t0 = time.time()
+        t0 = time.perf_counter()
+        telemetry.emit("run_start", run_type=run_type)
+        ok = False
+        try:
+            with telemetry.span(f"run:{run_type}"):
+                result = self._execute(run_type, params, t0)
+            ok = True
+        finally:
+            telemetry.emit("run_end", run_type=run_type,
+                           seconds=time.perf_counter() - t0)
+            if collector is not None:
+                telemetry.remove_listener(collector)
+            try:
+                if ok:
+                    if collector is not None:
+                        result.metrics["telemetry"] = collector.summary()
+                        result.metrics["telemetryMetrics"] = \
+                            telemetry.metrics_json()
+                    self._write_metrics(params.metrics_location,
+                                        result.metrics,
+                                        fmt=params.metrics_format)
+                    if params.trace_location:
+                        telemetry.write_trace(params.trace_location)
+                elif params.trace_location:
+                    # a crashed run is the run you most want the trace
+                    # of: flush the spans recorded up to the failure
+                    # (best-effort — never mask the run's exception)
+                    try:
+                        telemetry.write_trace(params.trace_location)
+                    except Exception:
+                        logger.exception("trace write failed")
+            finally:
+                if run_scoped:
+                    # run-scoped teardown, even when a sink write fails:
+                    # recording stops AND this run's events/metrics are
+                    # dropped, so the next requested run gets a clean
+                    # per-run trace (user-registered listeners survive)
+                    telemetry.disable()
+                    telemetry.reset(keep_listeners=True)
+        return result
+
+    def _execute(self, run_type: str, params: OpParams,
+                 t0: float) -> RunnerResult:
         if run_type == RunType.TRAIN:
             params.apply_to_workflow(self.workflow)
             if self.training_reader is not None:
@@ -146,10 +236,8 @@ class OpWorkflowRunner:
             if params.model_location and is_coordinator():
                 model.save(params.model_location, overwrite=True)
             metrics = model.summary()
-            metrics["appSeconds"] = round(time.time() - t0, 3)
+            metrics["appSeconds"] = round(time.perf_counter() - t0, 3)
             metrics["process"] = process_summary()
-            if is_coordinator():
-                self._write_metrics(params.metrics_location, metrics)
             return RunnerResult(run_type, metrics=metrics,
                                 model_location=params.model_location)
 
@@ -165,8 +253,7 @@ class OpWorkflowRunner:
             if params.write_location:
                 _write_store_csv(scores, params.write_location)
             metrics = {"rowsScored": scores.n_rows,
-                       "appSeconds": round(time.time() - t0, 3)}
-            self._write_metrics(params.metrics_location, metrics)
+                       "appSeconds": round(time.perf_counter() - t0, 3)}
             return RunnerResult(run_type, metrics=metrics, scores=scores)
 
         if run_type == RunType.STREAMING_SCORE:
@@ -222,8 +309,7 @@ class OpWorkflowRunner:
                     sink.close()
             metrics = {"rowsScored": rows, "batches": n_batches,
                        "batchSize": batch, "overlap": overlap,
-                       "appSeconds": round(time.time() - t0, 3)}
-            self._write_metrics(params.metrics_location, metrics)
+                       "appSeconds": round(time.perf_counter() - t0, 3)}
             return RunnerResult(run_type, metrics=metrics)
 
         if run_type == RunType.EVALUATE:
@@ -231,8 +317,7 @@ class OpWorkflowRunner:
             data = reader.read_records()
             metrics = model.evaluate(data, self.evaluator)
             metrics = dict(metrics)
-            metrics["appSeconds"] = round(time.time() - t0, 3)
-            self._write_metrics(params.metrics_location, metrics)
+            metrics["appSeconds"] = round(time.perf_counter() - t0, 3)
             return RunnerResult(run_type, metrics=metrics)
 
         # FEATURES: materialize the engineered features only.
@@ -248,8 +333,7 @@ class OpWorkflowRunner:
         if params.write_location:
             _write_store_csv(store, params.write_location)
         metrics = {"rows": store.n_rows,
-                   "appSeconds": round(time.time() - t0, 3)}
-        self._write_metrics(params.metrics_location, metrics)
+                   "appSeconds": round(time.perf_counter() - t0, 3)}
         return RunnerResult(run_type, metrics=metrics, scores=store)
 
 
@@ -380,6 +464,13 @@ class OpApp:
         ap.add_argument("--model-location")
         ap.add_argument("--write-location")
         ap.add_argument("--metrics-location")
+        ap.add_argument("--trace-out", metavar="PATH",
+                        help="enable telemetry and write a Chrome "
+                             "trace-event JSON here (Perfetto-loadable)")
+        ap.add_argument("--metrics-format", choices=("json", "prometheus"),
+                        help="metrics sink format; prometheus enables "
+                             "telemetry and writes the registry in text "
+                             "exposition format")
         ap.add_argument("--quiet", action="store_true",
                         help="suppress INFO progress logging")
         args = ap.parse_args(argv)
@@ -394,4 +485,8 @@ class OpApp:
             params.write_location = args.write_location
         if args.metrics_location:
             params.metrics_location = args.metrics_location
+        if args.trace_out:
+            params.trace_location = args.trace_out
+        if args.metrics_format:
+            params.metrics_format = args.metrics_format
         return self.runner(params).run(args.run_type, params)

@@ -59,11 +59,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from . import telemetry
+
 logger = logging.getLogger(__name__)
 
 __all__ = ["ScoringEngine", "bucket_for", "bucket_ladder",
            "stream_score_overlapped", "SCORING_MIN_ROWS",
-           "DEFAULT_BUCKET_CAP", "BUCKET_MIN"]
+           "DEFAULT_BUCKET_CAP", "BUCKET_MIN", "engine_cache_stats"]
 
 #: smallest padded batch — below it, padding overhead is noise anyway
 BUCKET_MIN = 8
@@ -81,6 +83,21 @@ SCORING_MIN_ROWS = 2048
 #: compiled programs kept per engine (LRU) — ladder size bounds live
 #: entries in practice; the cap guards pathological bucket_cap choices
 PROGRAM_CACHE_CAP = 32
+
+#: process-wide program-cache tallies across every engine. Always on
+#: (cost is noise next to a device dispatch) so the bench can stamp
+#: cache hit/miss evidence on every emitted doc without forcing full
+#: telemetry on; the telemetry registry mirrors them when enabled. The
+#: module lock keeps concurrent engines' read-modify-writes exact.
+_CACHE_STATS = {"hits": 0, "misses": 0}
+_CACHE_STATS_LOCK = threading.Lock()
+
+
+def engine_cache_stats() -> Dict[str, int]:
+    """Cumulative scoring-engine program-cache hits/misses (and compiles
+    == misses) across all engines in this process."""
+    return {"hits": _CACHE_STATS["hits"], "misses": _CACHE_STATS["misses"],
+            "compiles": _CACHE_STATS["misses"]}
 
 
 def bucket_for(n: int, cap: int = DEFAULT_BUCKET_CAP) -> int:
@@ -331,26 +348,37 @@ class ScoringEngine:
             with self._lock:
                 hit = self._prep_cache.get(cache_key)
             if hit is not None and hit[0]() is data:
+                telemetry.counter("scoring.prep_cache_hits").inc()
                 return hit[1]
+            telemetry.counter("scoring.prep_cache_misses").inc()
         store = self._raw_store(data)
         n_total = store.n_rows
         chunks = []
-        for lo in range(0, max(n_total, 1), self.bucket_cap):
-            sub = store
-            if n_total > self.bucket_cap:
-                hi = min(lo + self.bucket_cap, n_total)
-                sub = store.take(np.arange(lo, hi))
-            n = sub.n_rows
-            bucket = bucket_for(n, self.bucket_cap)
-            host_store, prepared, uploads = self.host_blocks(sub)
-            prepared = {uid: {k: self._pad_rows(v, n, bucket)
-                              for k, v in blocks.items()}
-                        for uid, blocks in prepared.items()}
-            uploads = {k: self._pad_rows(v, n, bucket)
-                       for k, v in uploads.items()}
-            chunks.append((host_store, prepared, uploads, n, bucket))
-            if n_total <= self.bucket_cap:
-                break
+        with telemetry.span("score:prepare", rows=n_total):
+            for lo in range(0, max(n_total, 1), self.bucket_cap):
+                sub = store
+                if n_total > self.bucket_cap:
+                    hi = min(lo + self.bucket_cap, n_total)
+                    sub = store.take(np.arange(lo, hi))
+                n = sub.n_rows
+                bucket = bucket_for(n, self.bucket_cap)
+                host_store, prepared, uploads = self.host_blocks(sub)
+                prepared = {uid: {k: self._pad_rows(v, n, bucket)
+                                  for k, v in blocks.items()}
+                            for uid, blocks in prepared.items()}
+                uploads = {k: self._pad_rows(v, n, bucket)
+                           for k, v in uploads.items()}
+                if telemetry.enabled():
+                    # padded bytes about to cross the host→device link
+                    nbytes = sum(int(np.asarray(v).nbytes)
+                                 for blocks in prepared.values()
+                                 for v in blocks.values())
+                    nbytes += sum(int(np.asarray(v).nbytes)
+                                  for v in uploads.values())
+                    telemetry.counter("device.bytes_h2d").inc(nbytes)
+                chunks.append((host_store, prepared, uploads, n, bucket))
+                if n_total <= self.bucket_cap:
+                    break
         pb = _PreparedBatch(chunks, n_total)
         if cache_key is not None:
             with self._lock:
@@ -402,6 +430,9 @@ class ScoringEngine:
             fn = self._programs.pop(key, None)
             if fn is not None:
                 self._programs[key] = fn      # LRU re-insert
+                with _CACHE_STATS_LOCK:
+                    _CACHE_STATS["hits"] += 1
+                telemetry.counter("scoring.cache_hits").inc()
                 return fn
 
         def run(prepared_, uploads_):
@@ -412,6 +443,10 @@ class ScoringEngine:
         with self._lock:
             self._programs[key] = fn
             self._compile_count += 1
+            with _CACHE_STATS_LOCK:
+                _CACHE_STATS["misses"] += 1
+            telemetry.counter("scoring.cache_misses").inc()
+            telemetry.counter("scoring.compile_count").inc()
             while len(self._programs) > PROGRAM_CACHE_CAP:
                 self._programs.popitem(last=False)
         return fn
@@ -493,10 +528,15 @@ class ScoringEngine:
         out_names = self._out_names(results_only)
         stores = []
         for host_store, prepared, uploads, n, bucket in prep.chunks:
-            t0 = time.time()
+            t0 = time.perf_counter()
+            was_compile = False
             if out_names:
+                before = self._compile_count
                 fn = self._program(prepared, uploads, out_names)
-                outs = jax.device_get(fn(prepared, uploads))   # one pull
+                was_compile = self._compile_count > before
+                with telemetry.span("score:bucket", rows=n, bucket=bucket,
+                                    compiled=was_compile):
+                    outs = jax.device_get(fn(prepared, uploads))  # one pull
             else:
                 outs = {}
             store = host_store
@@ -520,8 +560,14 @@ class ScoringEngine:
                     mat = np.asarray(val)[:n]
                     store = store.with_column(
                         nm, VectorColumn(OPVector, mat, meta_env.get(nm)))
+            chunk_s = time.perf_counter() - t0
+            if telemetry.enabled():
+                telemetry.counter("scoring.rows_scored").inc(n)
+                telemetry.histogram("scoring.batch_seconds").observe(chunk_s)
+                telemetry.emit("score_batch", n_rows=n, bucket=bucket,
+                               seconds=chunk_s, compiled=was_compile)
             logger.debug("scoring engine: %d rows (bucket %d) in %.1fms",
-                         n, bucket, 1e3 * (time.time() - t0))
+                         n, bucket, 1e3 * chunk_s)
             if results_only and len(prep.chunks) > 1:
                 # chunk-stitching only needs the result columns — raw
                 # host columns (maps, ragged lists) never concatenate
@@ -654,7 +700,15 @@ def stream_score_overlapped(model, batches, keep_intermediate: bool = False,
     ColumnStore per batch, same contract as ``readers.stream_score``.
 
     Falls back to the plain per-batch path when the engine is missing or
-    gated off (slow link)."""
+    gated off (slow link).
+
+    Telemetry (when enabled): the worker's host prep and the consumer's
+    device compute land on separate trace tracks (the overlap is visible
+    in Perfetto), and the run records occupancy gauges —
+    ``stream.host_occupancy`` / ``stream.device_occupancy`` (busy
+    fraction of the stream's wall-clock per side) and
+    ``stream.overlap_efficiency`` (achieved fraction of the ideal
+    overlap: ``(host_s + device_s - wall) / min(host_s, device_s)``)."""
     from concurrent.futures import ThreadPoolExecutor
 
     eng = engine if engine is not None else model.scoring_engine()
@@ -667,16 +721,56 @@ def stream_score_overlapped(model, batches, keep_intermediate: bool = False,
     first = next(it, None)
     if first is None:
         return
-    with ThreadPoolExecutor(max_workers=1,
-                            thread_name_prefix="score-prep") as ex:
-        fut = ex.submit(eng.prepare_batch, list(first))
-        while fut is not None:
-            prep = fut.result()
-            nxt = next(it, None)
-            fut = (ex.submit(eng.prepare_batch, list(nxt))
-                   if nxt is not None else None)
-            store = eng.run_batch(prep, results_only=not keep_intermediate)
-            if not keep_intermediate:
-                store = store.select([nm for nm in eng._result_names
-                                      if nm in store])
-            yield store
+    tel = telemetry.enabled()
+    host_s = [0.0]      # accumulated on the worker thread
+    device_s = 0.0
+    n_batches = 0
+    t_start = time.perf_counter()
+
+    def _prep(batch):
+        if not tel:
+            return eng.prepare_batch(batch)
+        t0 = time.perf_counter()
+        with telemetry.span("stream:host_prep", rows=len(batch)):
+            try:
+                return eng.prepare_batch(batch)
+            finally:
+                host_s[0] += time.perf_counter() - t0
+
+    try:
+        with ThreadPoolExecutor(max_workers=1,
+                                thread_name_prefix="score-prep") as ex:
+            fut = ex.submit(_prep, list(first))
+            while fut is not None:
+                prep = fut.result()
+                nxt = next(it, None)
+                fut = (ex.submit(_prep, list(nxt))
+                       if nxt is not None else None)
+                if tel:
+                    telemetry.gauge("stream.queue_depth").set(
+                        1 if fut is not None else 0)
+                t0 = time.perf_counter()
+                with telemetry.span("stream:device_compute",
+                                    rows=prep.n_rows):
+                    store = eng.run_batch(
+                        prep, results_only=not keep_intermediate)
+                device_s += time.perf_counter() - t0
+                n_batches += 1
+                if not keep_intermediate:
+                    store = store.select([nm for nm in eng._result_names
+                                          if nm in store])
+                yield store
+    finally:
+        if tel:
+            wall = max(time.perf_counter() - t_start, 1e-9)
+            telemetry.counter("stream.batches").inc(n_batches)
+            telemetry.gauge("stream.queue_depth").set(0)
+            telemetry.gauge("stream.host_occupancy").set(
+                min(host_s[0] / wall, 1.0))
+            telemetry.gauge("stream.device_occupancy").set(
+                min(device_s / wall, 1.0))
+            ideal = min(host_s[0], device_s)
+            eff = ((host_s[0] + device_s - wall) / ideal
+                   if ideal > 0 else 0.0)
+            telemetry.gauge("stream.overlap_efficiency").set(
+                max(0.0, min(eff, 1.0)))

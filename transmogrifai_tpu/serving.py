@@ -28,6 +28,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from . import telemetry
+
 __all__ = ["export_prediction_fn", "load_prediction_fn",
            "export_scoring_fn", "load_scoring_fn"]
 
@@ -81,11 +83,14 @@ def export_prediction_fn(model, path: str,
 
     # batch-polymorphic: one artifact serves any request size
     b = jexport.symbolic_shape("b")[0]
-    exp = jexport.export(jax.jit(predict))(
-        jax.ShapeDtypeStruct((b, feature_dim), jnp.float32))
-    os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, _BLOB), "wb") as fh:
-        fh.write(exp.serialize())
+    with telemetry.span("serving:export_prediction_fn",
+                        feature_dim=feature_dim):
+        exp = jexport.export(jax.jit(predict))(
+            jax.ShapeDtypeStruct((b, feature_dim), jnp.float32))
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, _BLOB), "wb") as fh:
+            fh.write(exp.serialize())
+    telemetry.counter("serving.exports").inc()
     meta = {"featureDim": feature_dim,
             "predFeature": pred_feature.name,
             "coverage": "prediction_head",
@@ -100,9 +105,11 @@ def load_prediction_fn(path: str) -> Callable[[np.ndarray], Dict[str, Any]]:
     prediction/raw/probability arrays. Needs only jax, not this package."""
     from jax import export as jexport
 
-    with open(os.path.join(path, _BLOB), "rb") as fh:
-        exp = jexport.deserialize(fh.read())
-    meta = json.load(open(os.path.join(path, _META)))
+    with telemetry.span("serving:load_prediction_fn"):
+        with open(os.path.join(path, _BLOB), "rb") as fh:
+            exp = jexport.deserialize(fh.read())
+        meta = json.load(open(os.path.join(path, _META)))
+    telemetry.counter("serving.loads").inc()
 
     def call(X: np.ndarray) -> Dict[str, Any]:
         X = np.asarray(X, dtype=np.float32)
@@ -168,10 +175,14 @@ def export_scoring_fn(model, path: str, sample_data,
     args = [jax.ShapeDtypeStruct((b, *spec["tail"]),
                                  jnp.dtype(spec["dtype"]))
             for spec in manifest]
-    exp = jexport.export(jax.jit(predict))(*args)
-    os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, _SCORE_BLOB), "wb") as fh:
-        fh.write(exp.serialize())
+    with telemetry.span("serving:export_scoring_fn",
+                        fused_stages=eng.fused_stage_count,
+                        inputs=len(manifest)):
+        exp = jexport.export(jax.jit(predict))(*args)
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, _SCORE_BLOB), "wb") as fh:
+            fh.write(exp.serialize())
+    telemetry.counter("serving.exports").inc()
     meta = {"coverage": "fused_chain",
             "fusedStages": eng.fused_stage_count,
             "inputs": manifest,
@@ -191,10 +202,12 @@ def load_scoring_fn(path: str) -> Callable[[Dict[str, np.ndarray]],
     one consistent batch size)."""
     from jax import export as jexport
 
-    with open(os.path.join(path, _SCORE_BLOB), "rb") as fh:
-        exp = jexport.deserialize(fh.read())
-    with open(os.path.join(path, _SCORE_META)) as fh:
-        meta = json.load(fh)
+    with telemetry.span("serving:load_scoring_fn"):
+        with open(os.path.join(path, _SCORE_BLOB), "rb") as fh:
+            exp = jexport.deserialize(fh.read())
+        with open(os.path.join(path, _SCORE_META)) as fh:
+            meta = json.load(fh)
+    telemetry.counter("serving.loads").inc()
     manifest: List[Dict[str, Any]] = meta["inputs"]
 
     def call(blocks: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:

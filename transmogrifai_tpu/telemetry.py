@@ -1,0 +1,714 @@
+"""TPU-native run telemetry — span tracer, metrics registry, RunListener.
+
+The reference ships a dedicated observability layer: ``OpSparkListener``
+(``utils/.../spark/OpSparkListener.scala:56``) subscribes to Spark's event
+bus and folds per-stage timings into an ``AppMetrics`` document the runner
+writes next to its results. This module is that layer for the TPU-native
+runtime, where the interesting events are not Spark stages but XLA
+compiles, bucketed device dispatches, host↔device transfers and the
+host-prep/device-compute overlap of the streaming scorer:
+
+* **Span tracer** — ``with span("fit:stage", uid=...)``: thread-safe,
+  nested, per-thread track ids, exported as Chrome trace-event JSON
+  (``write_trace``) loadable in Perfetto / ``chrome://tracing``. The
+  overlapped streaming scorer's worker thread shows up as its own track,
+  so the overlap is *visible*, not just summarized.
+* **Metrics registry** — counters / gauges / histograms
+  (``counter("scoring.cache_hits").inc()``) with JSON
+  (``metrics_json``) and Prometheus text-exposition
+  (``render_prometheus``) export. See docs/observability.md for the
+  metric name catalog.
+* **RunListener protocol** — ``on_run_start / on_layer_start /
+  on_stage_fit / on_score_batch / on_compile / on_run_end`` mirroring
+  OpSparkListener's callbacks; :class:`CollectingRunListener` folds them
+  into an AppMetrics-style summary the runner embeds in its metrics doc.
+
+Telemetry is **off by default and near-zero-cost when off**: every entry
+point checks the module-level ``_ENABLED`` flag before allocating
+anything — ``span()`` returns a shared no-op singleton, ``counter()`` /
+``gauge()`` / ``histogram()`` return shared null instruments, and
+``emit()`` returns immediately. Enable with :func:`enable`, via
+``OpParams`` (``customParams.telemetry`` / ``traceLocation`` /
+``metricsFormat``) or the runner CLI (``--trace-out`` /
+``--metrics-format prometheus``).
+
+This module also owns two probes that predate it (absorbed from
+``workflow.py``, which keeps thin re-exports):
+
+* the process-wide **XLA compile clock** fed by ``jax.monitoring``
+  duration events (``compile_clock_s``). Exactly ONE monitoring listener
+  is ever registered per process, whether telemetry is on or off — the
+  same callback feeds the clock always and the registry/listeners only
+  when enabled;
+* the **host↔device bandwidth probe** (``probe_device_roundtrip_mbps``)
+  behind the layer-fusion and scoring-engine gates.
+
+Multi-host: every process computes identical state, so trace/metrics
+files follow the one-writer rule — ``write_trace`` / ``write_metrics``
+no-op on non-coordinator processes (same discipline as checkpoints and
+the runner's metrics sink).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "enable", "disable", "enabled", "reset",
+    "span", "trace_events", "write_trace",
+    "counter", "gauge", "histogram", "metrics_json", "render_prometheus",
+    "write_metrics",
+    "RunListener", "CollectingRunListener",
+    "add_listener", "remove_listener", "listeners", "emit",
+    "compile_clock_s", "probe_device_roundtrip_mbps",
+]
+
+# ---------------------------------------------------------------------------
+# enabled flag — checked before ANY allocation on every hot path
+# ---------------------------------------------------------------------------
+
+_ENABLED = False
+
+#: relative-time epoch for trace timestamps (monotonic; NTP steps cannot
+#: corrupt recorded durations — the reason every timer here is
+#: ``perf_counter``, never ``time.time``)
+_EPOCH = time.perf_counter()
+
+_PID = os.getpid()
+
+_LOCK = threading.RLock()
+
+#: recorded Chrome trace events (dicts, ph "X" for spans + "M" metadata)
+_EVENTS: List[Dict[str, Any]] = []
+
+#: hard cap so a forgotten enable() in a long-lived server cannot eat the
+#: heap; overflow is counted, never silent
+_MAX_EVENTS = 1_000_000
+_DROPPED_EVENTS = [0]
+
+#: thread ident -> small stable track id for the trace
+_TRACKS: Dict[int, int] = {}
+
+_TLS = threading.local()
+
+
+def enabled() -> bool:
+    """True when telemetry is recording."""
+    return _ENABLED
+
+
+def enable() -> None:
+    """Turn telemetry on (spans recorded, metrics counted, listeners
+    dispatched). Idempotent; does NOT register any ``jax.monitoring``
+    listener — the single shared compile-clock listener is installed
+    lazily by the workflow/bench paths whether telemetry is on or off."""
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable() -> None:
+    """Stop recording. Already-recorded events/metrics stay exportable."""
+    global _ENABLED
+    _ENABLED = False
+
+
+def reset(keep_listeners: bool = False) -> None:
+    """Drop all recorded events and metrics — and, unless
+    ``keep_listeners``, the listener registry too (tests, a long-lived
+    server rotating its trace files, or the runner's run-scoped teardown,
+    which keeps user-registered listeners alive)."""
+    with _LOCK:
+        _EVENTS.clear()
+        _DROPPED_EVENTS[0] = 0
+        # forget track assignments so live threads re-announce their
+        # thread_name metadata in the NEXT trace file too
+        _TRACKS.clear()
+        _REGISTRY.clear()
+        if not keep_listeners:
+            del _LISTENERS[:]
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+
+def _track_id() -> int:
+    ident = threading.get_ident()
+    tid = _TRACKS.get(ident)
+    if tid is None:
+        with _LOCK:
+            tid = _TRACKS.setdefault(ident, len(_TRACKS))
+            _EVENTS.append({
+                "name": "thread_name", "ph": "M", "pid": _PID, "tid": tid,
+                "args": {"name": threading.current_thread().name}})
+    return tid
+
+
+def _span_stack() -> List[str]:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+class _NullSpan:
+    """Shared no-op context manager returned while telemetry is off."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "_t0")
+
+    def __init__(self, name: str, attrs: Dict[str, Any]):
+        self.name = name
+        self.attrs = attrs
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Span":
+        _span_stack().append(self.name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t1 = time.perf_counter()
+        stack = _span_stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        tid = _track_id()
+        with _LOCK:
+            if len(_EVENTS) >= _MAX_EVENTS:
+                _DROPPED_EVENTS[0] += 1
+                return False
+            _EVENTS.append({
+                "name": self.name, "ph": "X", "pid": _PID, "tid": tid,
+                "ts": round((self._t0 - _EPOCH) * 1e6, 3),
+                "dur": round((t1 - self._t0) * 1e6, 3),
+                "args": self.attrs})
+        return False
+
+
+def span(name: str, **attrs: Any):
+    """Context manager timing a named span; no-op singleton when off.
+
+    Spans nest (the per-thread stack tracks the current path) and land on
+    the calling thread's own track in the exported trace, so concurrent
+    work — the streaming scorer's prep worker, CV threads — renders as
+    parallel lanes in Perfetto."""
+    if not _ENABLED:
+        return _NULL_SPAN
+    return _Span(name, attrs)
+
+
+def current_span_stack() -> Tuple[str, ...]:
+    """Names of the calling thread's open spans, outermost first."""
+    return tuple(_span_stack())
+
+
+def trace_events() -> List[Dict[str, Any]]:
+    """Copy of the recorded Chrome trace events."""
+    with _LOCK:
+        return list(_EVENTS)
+
+
+def write_trace(path: str) -> bool:
+    """Write the Chrome trace-event JSON (open in Perfetto or
+    ``chrome://tracing``). Multi-host one-writer rule: only the
+    coordinator writes (every process records identical structure);
+    returns False when skipped."""
+    if not _is_coordinator():
+        return False
+    doc = {"traceEvents": trace_events(), "displayTimeUnit": "ms"}
+    if _DROPPED_EVENTS[0]:
+        doc["droppedEvents"] = _DROPPED_EVENTS[0]
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, path)
+    return True
+
+
+def _is_coordinator() -> bool:
+    try:
+        from .parallel.multihost import is_coordinator
+        return is_coordinator()
+    except Exception:
+        return True      # no jax runtime yet — single process by definition
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+#: Prometheus-style default histogram ladder (seconds-ish scale)
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+_REGISTRY: "OrderedDict[str, Any]" = OrderedDict()
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("name", "_v")
+    kind = "counter"
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with _LOCK:
+            self._v += n
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def to_json(self) -> float:
+        return self._v
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("name", "_v")
+    kind = "gauge"
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        self._v = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with _LOCK:
+            self._v += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def to_json(self) -> float:
+        return self._v
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket
+    counts observations ``<= le``; ``+Inf`` equals ``count``)."""
+
+    __slots__ = ("name", "buckets", "_counts", "_sum", "_count")
+    kind = "histogram"
+
+    def __init__(self, name: str,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * len(self.buckets)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with _LOCK:
+            self._sum += v
+            self._count += 1
+            for i, le in enumerate(self.buckets):
+                if v <= le:
+                    self._counts[i] += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def bucket_counts(self) -> Dict[float, int]:
+        """Cumulative count per upper bound (``le``)."""
+        return dict(zip(self.buckets, self._counts))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"count": self._count, "sum": self._sum,
+                "buckets": {str(le): c for le, c
+                            in zip(self.buckets, self._counts)}}
+
+
+class _NullInstrument:
+    """Shared no-op instrument returned while telemetry is off."""
+
+    __slots__ = ()
+    name = ""
+    value = 0.0
+    count = 0
+    sum = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        pass
+
+    def dec(self, n: float = 1.0) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+
+_NULL_INSTRUMENT = _NullInstrument()
+
+
+def _instrument(name: str, cls, **kw):
+    if not _ENABLED:
+        return _NULL_INSTRUMENT
+    inst = _REGISTRY.get(name)
+    if inst is None:
+        with _LOCK:
+            inst = _REGISTRY.get(name)
+            if inst is None:
+                inst = _REGISTRY[name] = cls(name, **kw)
+    if not isinstance(inst, cls):
+        raise TypeError(f"metric {name!r} already registered as "
+                        f"{type(inst).__name__}, not {cls.__name__}")
+    return inst
+
+
+def counter(name: str) -> Counter:
+    """Get-or-create the named counter (null instrument when off)."""
+    return _instrument(name, Counter)
+
+
+def gauge(name: str) -> Gauge:
+    """Get-or-create the named gauge (null instrument when off)."""
+    return _instrument(name, Gauge)
+
+
+def histogram(name: str,
+              buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+    """Get-or-create the named histogram (null instrument when off)."""
+    return _instrument(name, Histogram, buckets=buckets)
+
+
+def metrics_json() -> Dict[str, Any]:
+    """Registry snapshot: ``{name: value}`` for counters/gauges,
+    ``{name: {count, sum, buckets}}`` for histograms."""
+    with _LOCK:
+        return {name: inst.to_json() for name, inst in _REGISTRY.items()}
+
+
+_PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    out = _PROM_NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _prom_value(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def render_prometheus(extra: Optional[Dict[str, float]] = None) -> str:
+    """Registry in Prometheus text exposition format (0.0.4). ``extra``
+    appends scalar gauges (the runner folds its run doc numerics in)."""
+    lines: List[str] = []
+    with _LOCK:
+        items = list(_REGISTRY.items())
+    for name, inst in items:
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} {inst.kind}")
+        if isinstance(inst, Histogram):
+            cum_pairs = zip(inst.buckets, inst._counts)
+            for le, c in cum_pairs:
+                lines.append(f'{pn}_bucket{{le="{_prom_value(le)}"}} {c}')
+            lines.append(f'{pn}_bucket{{le="+Inf"}} {inst.count}')
+            lines.append(f"{pn}_sum {_prom_value(inst.sum)}")
+            lines.append(f"{pn}_count {inst.count}")
+        else:
+            lines.append(f"{pn} {_prom_value(inst.value)}")
+    for name, v in (extra or {}).items():
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_prom_value(float(v))}")
+    return "\n".join(lines) + "\n"
+
+
+def write_metrics(path: str, fmt: str = "json",
+                  extra: Optional[Dict[str, float]] = None) -> bool:
+    """Write the registry to ``path`` as JSON or Prometheus text.
+    Coordinator-only (one-writer rule); atomic (temp + replace)."""
+    if fmt not in ("json", "prometheus"):
+        raise ValueError(f"unknown metrics format {fmt!r}")
+    if not _is_coordinator():
+        return False
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as fh:
+        if fmt == "json":
+            doc = metrics_json()
+            if extra:
+                doc.update(extra)
+            json.dump(doc, fh, indent=1, default=str)
+        else:
+            fh.write(render_prometheus(extra))
+    os.replace(tmp, path)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# RunListener protocol (OpSparkListener analog)
+# ---------------------------------------------------------------------------
+
+
+class RunListener:
+    """Callback protocol over run lifecycle events. Subclass and override
+    what you need; every hook is emitted with keyword arguments and must
+    tolerate future additions (``**_``)."""
+
+    def on_run_start(self, run_type: str, **_: Any) -> None:
+        pass
+
+    def on_run_end(self, run_type: str, seconds: float = 0.0,
+                   **_: Any) -> None:
+        pass
+
+    def on_layer_start(self, index: int, n_stages: int, **_: Any) -> None:
+        pass
+
+    def on_stage_fit(self, uid: str, stage_name: str, fit_s: float,
+                     compile_s: float = 0.0, execute_s: float = 0.0,
+                     warm_started: bool = False, **_: Any) -> None:
+        pass
+
+    def on_score_batch(self, n_rows: int, bucket: int, seconds: float,
+                       compiled: bool = False, **_: Any) -> None:
+        pass
+
+    def on_compile(self, event: str, seconds: float, **_: Any) -> None:
+        pass
+
+
+_LISTENERS: List[RunListener] = []
+
+
+def add_listener(listener: RunListener) -> RunListener:
+    """Register a listener (dispatched only while telemetry is on)."""
+    with _LOCK:
+        if listener not in _LISTENERS:
+            _LISTENERS.append(listener)
+    return listener
+
+
+def remove_listener(listener: RunListener) -> None:
+    with _LOCK:
+        try:
+            _LISTENERS.remove(listener)
+        except ValueError:
+            pass
+
+
+def listeners() -> List[RunListener]:
+    return list(_LISTENERS)
+
+
+def emit(event: str, /, **info: Any) -> None:
+    """Dispatch ``on_<event>(**info)`` to every listener. A listener that
+    raises is logged and skipped — observability must never take down the
+    run it observes. (``event`` is positional-only: the compile hook's
+    payload reuses the name as a keyword.)"""
+    if not _ENABLED or not _LISTENERS:
+        return
+    for l in list(_LISTENERS):
+        fn = getattr(l, "on_" + event, None)
+        if fn is None:
+            continue
+        try:
+            fn(**info)
+        except Exception:
+            logger.exception("telemetry listener %r failed on %s",
+                             l, event)
+
+
+class CollectingRunListener(RunListener):
+    """Default listener folding events into an AppMetrics-style summary
+    (OpSparkListener.AppMetrics analog). The runner registers one per run
+    when telemetry is on and embeds ``summary()`` in its metrics doc."""
+
+    def __init__(self):
+        self.events: List[str] = []      # ordered event names (tests/debug)
+        self.run_type: Optional[str] = None
+        self.app_seconds = 0.0
+        self.layers = 0
+        self.stages: Dict[str, Dict[str, Any]] = {}
+        self.score_batches = 0
+        self.rows_scored = 0
+        self.compiled_batches = 0
+        self.compile_events = 0
+        self.compile_seconds = 0.0
+        self._lock = threading.Lock()
+
+    def on_run_start(self, run_type: str, **_: Any) -> None:
+        with self._lock:
+            self.events.append("run_start")
+            self.run_type = run_type
+
+    def on_run_end(self, run_type: str, seconds: float = 0.0,
+                   **_: Any) -> None:
+        with self._lock:
+            self.events.append("run_end")
+            self.app_seconds = seconds
+
+    def on_layer_start(self, index: int, n_stages: int, **_: Any) -> None:
+        with self._lock:
+            self.events.append("layer_start")
+            self.layers = max(self.layers, index + 1)
+
+    def on_stage_fit(self, uid: str, stage_name: str, fit_s: float,
+                     compile_s: float = 0.0, execute_s: float = 0.0,
+                     warm_started: bool = False, **_: Any) -> None:
+        with self._lock:
+            self.events.append("stage_fit")
+            self.stages[uid] = {
+                "stageName": stage_name, "fitSeconds": round(fit_s, 4),
+                "compileSeconds": round(compile_s, 4),
+                "executeSeconds": round(execute_s, 4),
+                "warmStarted": warm_started}
+
+    def on_score_batch(self, n_rows: int, bucket: int, seconds: float,
+                       compiled: bool = False, **_: Any) -> None:
+        with self._lock:
+            self.events.append("score_batch")
+            self.score_batches += 1
+            self.rows_scored += int(n_rows)
+            if compiled:
+                self.compiled_batches += 1
+
+    def on_compile(self, event: str, seconds: float, **_: Any) -> None:
+        with self._lock:
+            self.events.append("compile")
+            self.compile_events += 1
+            self.compile_seconds += seconds
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "runType": self.run_type,
+                "appSeconds": round(self.app_seconds, 3),
+                "layers": self.layers,
+                "fittedStages": len(self.stages),
+                "stages": dict(self.stages),
+                "scoreBatches": self.score_batches,
+                "rowsScored": self.rows_scored,
+                "compiledBatches": self.compiled_batches,
+                "compileEvents": self.compile_events,
+                "compileSeconds": round(self.compile_seconds, 4),
+            }
+
+
+# ---------------------------------------------------------------------------
+# XLA compile clock (absorbed from workflow.py — same single listener)
+# ---------------------------------------------------------------------------
+
+#: process-wide XLA compile-time clock fed by jax.monitoring duration
+#: events; stage timers snapshot it to split fit wall-clock into
+#: compile-vs-execute (OpSparkListener's stage breakdown analog).
+#: NOTE this sums compile WORK: concurrent compiles (the CV engine's
+#: thread-pool phase) can make the delta exceed wall-clock, so consumers
+#: clamp to the stage's elapsed time.
+_COMPILE_CLOCK = {"s": 0.0}
+_COMPILE_LISTENER_ON = [False]
+#: how many times a jax.monitoring listener was actually registered —
+#: must never exceed 1 per process, telemetry on OR off (the disabled
+#: path registers nothing extra; the enabled path reuses the same one)
+_COMPILE_LISTENER_REGISTRATIONS = [0]
+_COMPILE_CLOCK_LOCK = threading.Lock()
+
+
+def _ensure_compile_listener() -> None:
+    """Install the single shared ``jax.monitoring`` compile listener.
+    Idempotent; called lazily from fit/bench paths. The one callback
+    always feeds the compile clock and ADDITIONALLY feeds the metrics
+    registry + RunListeners only while telemetry is enabled."""
+    if _COMPILE_LISTENER_ON[0]:
+        return
+    from jax import monitoring
+
+    def on_event(event: str, duration: float, **_kw) -> None:
+        if not event.startswith("/jax/core/compile/"):
+            return
+        with _COMPILE_CLOCK_LOCK:
+            _COMPILE_CLOCK["s"] += duration
+        if _ENABLED:
+            counter("xla.compile_events").inc()
+            counter("xla.compile_seconds").inc(duration)
+            emit("compile", event=event, seconds=duration)
+
+    monitoring.register_event_duration_secs_listener(on_event)
+    _COMPILE_LISTENER_ON[0] = True
+    _COMPILE_LISTENER_REGISTRATIONS[0] += 1
+
+
+def compile_clock_s() -> float:
+    """Cumulative XLA trace+lower+compile seconds in this process."""
+    return _COMPILE_CLOCK["s"]
+
+
+# ---------------------------------------------------------------------------
+# host<->device bandwidth probe (absorbed from workflow.py)
+# ---------------------------------------------------------------------------
+
+
+def probe_device_roundtrip_mbps() -> float:
+    """Measure host→device→host bandwidth (MB/s) with a 4MB buffer.
+    Measures on every call — ``workflow.device_roundtrip_mbps`` owns the
+    once-per-process cache (the single gate-consumer entry point, which
+    tests pin to force fusion either way). Monotonic timer — a wall-clock
+    step mid-probe cannot fabricate an absurd gate decision."""
+    import jax
+    import numpy as np
+
+    buf = np.zeros((1 << 20,), np.float32)  # 4 MB
+    best = 0.0
+    with span("telemetry:bandwidth_probe", bytes=buf.nbytes):
+        for _ in range(2):  # first pass absorbs backend/dispatch warm-up
+            t0 = time.perf_counter()
+            np.asarray(jax.block_until_ready(jax.device_put(buf)))
+            dt = max(time.perf_counter() - t0, 1e-9)
+            best = max(best, (2 * buf.nbytes / 1e6) / dt)
+    gauge("device.roundtrip_mbps").set(best)
+    logger.info("host<->device bandwidth probe: %.0f MB/s (%s)",
+                best, jax.devices()[0].platform)
+    return best

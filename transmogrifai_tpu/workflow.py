@@ -31,6 +31,7 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+from . import telemetry
 from .columns import Column, ColumnStore
 from .features import Feature, copy_dag
 from .graph import StagesDAG, compute_dag
@@ -92,59 +93,27 @@ _DEVICE_BW_MBPS: Optional[float] = None
 #: jitted per-layer programs keyed by (model ids, prepared shapes)
 _LAYER_JIT_CACHE: Dict[Any, Any] = {}
 
-#: process-wide XLA compile-time clock fed by jax.monitoring duration
-#: events; stage timers snapshot it to split fit wall-clock into
-#: compile-vs-execute (OpSparkListener's stage breakdown analog).
-#: NOTE this sums compile WORK: concurrent compiles (the CV engine's
-#: thread-pool phase) can make the delta exceed wall-clock, so consumers
-#: clamp to the stage's elapsed time.
-_COMPILE_CLOCK = {"s": 0.0}
-_COMPILE_LISTENER_ON = [False]
-_COMPILE_CLOCK_LOCK = None
-
-
-def _ensure_compile_listener() -> None:
-    global _COMPILE_CLOCK_LOCK
-    if _COMPILE_LISTENER_ON[0]:
-        return
-    import threading
-
-    from jax import monitoring
-    _COMPILE_CLOCK_LOCK = threading.Lock()
-
-    def on_event(event: str, duration: float, **_kw) -> None:
-        if event.startswith("/jax/core/compile/"):
-            with _COMPILE_CLOCK_LOCK:
-                _COMPILE_CLOCK["s"] += duration
-    monitoring.register_event_duration_secs_listener(on_event)
-    _COMPILE_LISTENER_ON[0] = True
-
-
-def compile_clock_s() -> float:
-    """Cumulative XLA trace+lower+compile seconds in this process."""
-    return _COMPILE_CLOCK["s"]
+# the XLA compile clock and its single jax.monitoring listener live in
+# telemetry now (absorbed there along with the bandwidth probe); these
+# re-exports keep the long-standing public/bench names working, sharing
+# the SAME underlying clock object.
+_COMPILE_CLOCK = telemetry._COMPILE_CLOCK
+_ensure_compile_listener = telemetry._ensure_compile_listener
+compile_clock_s = telemetry.compile_clock_s
 
 
 def device_roundtrip_mbps() -> float:
-    """Measured host→device→host bandwidth (MB/s); probed once per process
-    with a 4MB buffer and cached."""
+    """Measured host→device→host bandwidth (MB/s); probed once per
+    process (telemetry.probe_device_roundtrip_mbps) and cached here —
+    tests pin ``_DEVICE_BW_MBPS`` to force the fusion gate either way."""
     global _DEVICE_BW_MBPS
     if _DEVICE_BW_MBPS is None:
-        import jax
-
-        buf = np.zeros((1 << 20,), np.float32)  # 4 MB
-        best = 0.0
-        for _ in range(2):  # first pass absorbs backend/dispatch warm-up
-            t0 = time.time()
-            np.asarray(jax.block_until_ready(jax.device_put(buf)))
-            dt = max(time.time() - t0, 1e-9)
-            best = max(best, (2 * buf.nbytes / 1e6) / dt)
-        _DEVICE_BW_MBPS = best
+        _DEVICE_BW_MBPS = telemetry.probe_device_roundtrip_mbps()
         logger.info(
-            "host<->device bandwidth: %.0f MB/s (%s) -> layer fusion %s",
-            best, jax.devices()[0].platform,
-            "ON" if best >= FUSE_MIN_BANDWIDTH_MBPS else
-            "OFF (tunnelled/slow link: transforms stay on host)")
+            "layer fusion %s (gate %.0f MB/s)",
+            "ON" if _DEVICE_BW_MBPS >= FUSE_MIN_BANDWIDTH_MBPS else
+            "OFF (tunnelled/slow link: transforms stay on host)",
+            FUSE_MIN_BANDWIDTH_MBPS)
     return _DEVICE_BW_MBPS
 
 
@@ -242,16 +211,22 @@ def apply_layer_vectorized(models: Sequence[Transformer], store: ColumnStore,
                      for p in preps for k, v in sorted(p.items())))
         jitted = _LAYER_JIT_CACHE.pop(key, None)
         if jitted is None:
+            telemetry.counter("fusion.cache_misses").inc()
+
             def layer_fn(prepared_list):
                 return tuple(m.device_compute(jnp, p)
                              for m, p in zip(vecs, prepared_list))
             jitted = jax.jit(layer_fn)
+        else:
+            telemetry.counter("fusion.cache_hits").inc()
         # LRU: re-insert on use, evict oldest beyond cap (stale entries pin
         # their model objects + compiled executables otherwise)
         _LAYER_JIT_CACHE[key] = jitted
         while len(_LAYER_JIT_CACHE) > 32:
             _LAYER_JIT_CACHE.pop(next(iter(_LAYER_JIT_CACHE)))
-        outs = jax.device_get(jitted(preps))   # one batched pull
+        with telemetry.span("layer:fused_dispatch", rows=store.n_rows,
+                            vectorizers=len(vecs)):
+            outs = jax.device_get(jitted(preps))   # one batched pull
         for m, mat in zip(vecs, outs):
             mat = np.asarray(mat)              # already the pipeline f32
             meta = m.vector_metadata()
@@ -411,12 +386,15 @@ class Workflow:
             test_store.n_rows if test_store is not None else 0,
             len(dag), sum(len(l) for l in dag),
             " [workflow-level CV]" if self._workflow_cv else "")
-        if self._workflow_cv:
-            fitted, train_time = self._fit_dag_workflow_cv(
-                result_features, dag, train_store, test_store)
-        else:
-            fitted, train_time, _, _ = self._fit_dag(
-                dag, train_store, test_store, transform_last=False)
+        with telemetry.span("workflow:train", layers=len(dag),
+                            rows=train_store.n_rows,
+                            workflow_cv=self._workflow_cv):
+            if self._workflow_cv:
+                fitted, train_time = self._fit_dag_workflow_cv(
+                    result_features, dag, train_store, test_store)
+            else:
+                fitted, train_time, _, _ = self._fit_dag(
+                    dag, train_store, test_store, transform_last=False)
         logger.info("train: done in %.2fs (%d fitted stages)",
                     train_time, len(fitted))
         return WorkflowModel(
@@ -444,94 +422,124 @@ class Workflow:
         callers that discard the returned stores (plain ``train()``) pay
         a full scoring pass — 97 s of pure upload at the 10M config —
         for predictions nothing consumes (scoring re-runs the DAG)."""
-        t0 = time.time()
+        t0 = time.perf_counter()
         _ensure_compile_listener()
         fitted = {} if fitted is None else fitted
         for li, layer in enumerate(dag):
-            models: List[Transformer] = []
-            n_fitted_before = len(fitted)
-            for stage in layer:
-                metrics = self._stage_metrics.setdefault(
-                    stage.uid, {"stageName": stage.stage_name()})
-                if isinstance(stage, Estimator):
-                    warm = self._warm_stages.get(stage.uid)
-                    if warm is not None:
-                        # warm start: substitute the previously fitted model
-                        # by uid. Shallow-copy before rebinding wiring so
-                        # the donor WorkflowModel's stages stay intact
-                        # (fitted state/arrays are shared read-only).
-                        import copy as _copy
-                        model = _copy.copy(warm)
-                        model.input_features = stage.input_features
-                        model._output_feature = stage.get_output()
-                        metrics["warmStarted"] = True
-                        metrics["fitSeconds"] = 0.0
-                        logger.info("layer %d: %s [%s] warm-started",
-                                    li, stage.stage_name(), stage.uid)
-                    else:
-                        logger.info("layer %d: fitting %s [%s] on %d rows",
-                                    li, stage.stage_name(), stage.uid,
-                                    train.n_rows)
-                        tf = time.time()
-                        c0 = _COMPILE_CLOCK["s"]
-                        model = stage.fit(train)
-                        fit_s = time.time() - tf
-                        # clamp: concurrent compiles sum WORK > wall-clock
-                        compile_s = min(_COMPILE_CLOCK["s"] - c0, fit_s)
-                        metrics["fitSeconds"] = round(fit_s, 4)
-                        metrics["compileSeconds"] = round(compile_s, 4)
-                        metrics["executeSeconds"] = round(
-                            max(fit_s - compile_s, 0.0), 4)
-                        logger.info(
-                            "layer %d: %s fit in %.2fs "
-                            "(compile %.2fs, execute %.2fs)",
-                            li, stage.stage_name(), fit_s, compile_s,
-                            max(fit_s - compile_s, 0.0))
-                    fitted[stage.uid] = model
-                    if model.has_test_eval() and test is not None:
-                        model.evaluate_model(test)
-                    models.append(model)
-                elif isinstance(stage, Transformer):
-                    models.append(stage)
+            telemetry.emit("layer_start", index=li, n_stages=len(layer))
+            with telemetry.span("fit:layer", layer=li, stages=len(layer),
+                                rows=train.n_rows):
+                train, test = self._fit_layer(
+                    li, layer, dag, train, test, fitted, checkpoint,
+                    transform_last)
+        return fitted, time.perf_counter() - t0, train, test
+
+    def _fit_layer(self, li: int, layer: Sequence[OpPipelineStage],
+                   dag: StagesDAG, train: ColumnStore,
+                   test: Optional[ColumnStore],
+                   fitted: Dict[str, FittedModel], checkpoint: bool,
+                   transform_last: bool
+                   ) -> Tuple[ColumnStore, Optional[ColumnStore]]:
+        """One layer of :meth:`_fit_dag`: fit/warm-start its estimators,
+        transform both splits, checkpoint. Mutates ``fitted`` in place and
+        returns the transformed (train, test) stores."""
+        models: List[Transformer] = []
+        n_fitted_before = len(fitted)
+        for stage in layer:
+            metrics = self._stage_metrics.setdefault(
+                stage.uid, {"stageName": stage.stage_name()})
+            if isinstance(stage, Estimator):
+                warm = self._warm_stages.get(stage.uid)
+                if warm is not None:
+                    # warm start: substitute the previously fitted model
+                    # by uid. Shallow-copy before rebinding wiring so
+                    # the donor WorkflowModel's stages stay intact
+                    # (fitted state/arrays are shared read-only).
+                    import copy as _copy
+                    model = _copy.copy(warm)
+                    model.input_features = stage.input_features
+                    model._output_feature = stage.get_output()
+                    metrics["warmStarted"] = True
+                    metrics["fitSeconds"] = 0.0
+                    telemetry.emit(
+                        "stage_fit", uid=stage.uid,
+                        stage_name=stage.stage_name(), fit_s=0.0,
+                        warm_started=True)
+                    logger.info("layer %d: %s [%s] warm-started",
+                                li, stage.stage_name(), stage.uid)
                 else:
-                    raise WorkflowError(f"Unfittable stage {stage!r}")
-            # transform both splits with the fully fitted layer — the
-            # layer's vectorizers fuse into one XLA program per split
-            if not transform_last and li == len(dag) - 1:
-                if models:
-                    logger.info("layer %d: transform skipped "
-                                "(terminal layer, outputs unconsumed)", li)
+                    logger.info("layer %d: fitting %s [%s] on %d rows",
+                                li, stage.stage_name(), stage.uid,
+                                train.n_rows)
+                    tf = time.perf_counter()
+                    c0 = _COMPILE_CLOCK["s"]
+                    with telemetry.span("fit:stage", uid=stage.uid,
+                                        stage=stage.stage_name(),
+                                        layer=li):
+                        model = stage.fit(train)
+                    fit_s = time.perf_counter() - tf
+                    # clamp: concurrent compiles sum WORK > wall-clock
+                    compile_s = min(_COMPILE_CLOCK["s"] - c0, fit_s)
+                    metrics["fitSeconds"] = round(fit_s, 4)
+                    metrics["compileSeconds"] = round(compile_s, 4)
+                    metrics["executeSeconds"] = round(
+                        max(fit_s - compile_s, 0.0), 4)
+                    telemetry.emit(
+                        "stage_fit", uid=stage.uid,
+                        stage_name=stage.stage_name(), fit_s=fit_s,
+                        compile_s=compile_s,
+                        execute_s=max(fit_s - compile_s, 0.0))
+                    logger.info(
+                        "layer %d: %s fit in %.2fs "
+                        "(compile %.2fs, execute %.2fs)",
+                        li, stage.stage_name(), fit_s, compile_s,
+                        max(fit_s - compile_s, 0.0))
+                fitted[stage.uid] = model
+                if model.has_test_eval() and test is not None:
+                    model.evaluate_model(test)
+                models.append(model)
+            elif isinstance(stage, Transformer):
+                models.append(stage)
             else:
-                tt = time.time()
+                raise WorkflowError(f"Unfittable stage {stage!r}")
+        # transform both splits with the fully fitted layer — the
+        # layer's vectorizers fuse into one XLA program per split
+        if not transform_last and li == len(dag) - 1:
+            if models:
+                logger.info("layer %d: transform skipped "
+                            "(terminal layer, outputs unconsumed)", li)
+        else:
+            tt = time.perf_counter()
+            with telemetry.span("fit:transform_layer", layer=li,
+                                stages=len(models)):
                 train = apply_layer_vectorized(models, train)
                 if test is not None:
                     test = apply_layer_vectorized(models, test)
-                layer_transform_s = time.time() - tt
-                if models:
-                    logger.info("layer %d: transformed %d stage(s) in "
-                                "%.2fs", li, len(models), layer_transform_s)
-                for m in models:
-                    self._stage_metrics.setdefault(
-                        m.uid, {"stageName": m.stage_name()})[
-                        "layerTransformSeconds"] = round(layer_transform_s,
-                                                         4)
-            if checkpoint and self._checkpoint_dir \
-                    and len(fitted) > n_fitted_before \
-                    and _is_coordinator():
-                # the ACTIVE graph (post-RawFeatureFilter pruning), written
-                # crash-consistently: a preemption mid-save must not
-                # destroy the previous good checkpoint. Transformer-only
-                # layers add no fitted state, so they skip the write.
-                feats = getattr(self, "_active_result_features",
-                                self.result_features)
-                if feats:
-                    _atomic_checkpoint(WorkflowModel(
-                        result_features=feats, fitted_stages=fitted),
-                        self._checkpoint_dir)
-                    logger.info(
-                        "layer %d: checkpointed %d fitted stage(s) to %s",
-                        li, len(fitted), self._checkpoint_dir)
-        return fitted, time.time() - t0, train, test
+            layer_transform_s = time.perf_counter() - tt
+            if models:
+                logger.info("layer %d: transformed %d stage(s) in "
+                            "%.2fs", li, len(models), layer_transform_s)
+            for m in models:
+                self._stage_metrics.setdefault(
+                    m.uid, {"stageName": m.stage_name()})[
+                    "layerTransformSeconds"] = round(layer_transform_s, 4)
+        if checkpoint and self._checkpoint_dir \
+                and len(fitted) > n_fitted_before \
+                and _is_coordinator():
+            # the ACTIVE graph (post-RawFeatureFilter pruning), written
+            # crash-consistently: a preemption mid-save must not
+            # destroy the previous good checkpoint. Transformer-only
+            # layers add no fitted state, so they skip the write.
+            feats = getattr(self, "_active_result_features",
+                            self.result_features)
+            if feats:
+                _atomic_checkpoint(WorkflowModel(
+                    result_features=feats, fitted_stages=fitted),
+                    self._checkpoint_dir)
+                logger.info(
+                    "layer %d: checkpointed %d fitted stage(s) to %s",
+                    li, len(fitted), self._checkpoint_dir)
+        return train, test
 
     def _fit_dag_workflow_cv(self, result_features, dag: StagesDAG,
                              train: ColumnStore,
@@ -549,12 +557,12 @@ class Workflow:
         """
         from .graph import cut_dag
 
-        t0 = time.time()
+        t0 = time.perf_counter()
         ms, before, during, after = cut_dag(result_features)
         if ms is None or not during:
             fitted, _, _, _ = self._fit_dag(dag, train, test,
                                             transform_last=False)
-            return fitted, time.time() - t0
+            return fitted, time.perf_counter() - t0
 
         fitted: Dict[str, FittedModel] = {}
         _, _, train_b, test_b = self._fit_dag(before, train, test, fitted)
@@ -611,7 +619,7 @@ class Workflow:
                 remaining.append(rest)
         fitted, _, _, _ = self._fit_dag(remaining, train_b, test_b, fitted,
                                         transform_last=False)
-        return fitted, time.time() - t0
+        return fitted, time.perf_counter() - t0
 
 
 class WorkflowModel:
