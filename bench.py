@@ -152,6 +152,13 @@ class Bench:
                 self.doc["scoring_cache"] = engine_cache_stats()
             except Exception:
                 self.doc.setdefault("scoring_cache", None)
+            # fused fit-statistics tallies (layers fused, passes saved,
+            # bytes scanned) ride on EVERY doc, like the scoring cache
+            try:
+                from transmogrifai_tpu import fitstats
+                self.doc["fitstats"] = fitstats.fitstats_stats()
+            except Exception:
+                self.doc.setdefault("fitstats", None)
         if final:
             self.doc.pop("partial", None)
         print(json.dumps(self.doc), flush=True)
@@ -338,6 +345,86 @@ def _scoring_throughput() -> dict:
     return out
 
 
+def _fit_stats() -> dict:
+    """Fit-path statistics engine benchmark: ONE wide DAG layer of
+    opted-in estimators (mean imputers + pivots + a bucketizer over the
+    same synthetic store) trained with the fused fit-statistics pass
+    (fitstats.py) vs the sequential per-stage loop. Reports the train
+    wall-clock and the data-prep split of both modes plus the pass-count
+    math the engine is about: k estimators = k full scans sequentially,
+    exactly 1 fused."""
+    import numpy as np
+
+    from transmogrifai_tpu import (ColumnStore, FeatureBuilder, Workflow,
+                                   column_from_values, fitstats)
+    from transmogrifai_tpu.types import feature_types as ft
+
+    rows = int(os.environ.get("BENCH_FITSTATS_ROWS", 1_000_000))
+    n_num = 6
+    rng = np.random.default_rng(17)
+    t0 = time.time()
+    cols = {}
+    for j in range(n_num):
+        v = rng.normal(size=rows) * (j + 1)
+        v[rng.random(rows) < 0.1] = np.nan
+        cols[f"x{j}"] = column_from_values(ft.Real, v)
+    cat_pool = np.array([f"c{i}" for i in range(24)] + [None],
+                        dtype=object)
+    for j in range(2):
+        cols[f"cat{j}"] = column_from_values(
+            ft.PickList, list(cat_pool[rng.integers(0, 25, rows)]))
+    store = ColumnStore(cols, rows)
+    prep_s = time.time() - t0
+
+    def build():
+        feats = [FeatureBuilder.Real(f"x{j}").from_column().as_predictor()
+                 for j in range(n_num)]
+        cats = [FeatureBuilder.PickList(f"cat{j}").from_column()
+                .as_predictor() for j in range(2)]
+        outs = [f.fill_missing_with_mean() for f in feats[:3]]
+        outs += [f.z_normalize() for f in feats[3:5]]
+        outs += [feats[5].bucketize(num_buckets=6)]
+        outs += [c.pivot(top_k=10) for c in cats]
+        return outs
+
+    def train(fused: bool):
+        old = fitstats.FITSTATS_ENABLED
+        fitstats.FITSTATS_ENABLED = fused
+        before = fitstats.fitstats_stats()
+        try:
+            t1 = time.time()
+            Workflow().set_input_store(store) \
+                .set_result_features(*build()).train()
+            dt = time.time() - t1
+        finally:
+            fitstats.FITSTATS_ENABLED = old
+        after = fitstats.fitstats_stats()
+        return dt, {k: after[k] - before[k] for k in after}
+
+    # untimed warmup compiles the transform-layer AND fitstats fold
+    # programs, so neither timed mode inherits the other's compile
+    # amortization (A/B discipline, docs/performance.md gotchas)
+    train(fused=True)
+    seq_s, _ = train(fused=False)
+    fused_s, delta = train(fused=True)
+    n_opted = 8                  # 3 mean + 2 norm + 1 bucketize + 2 pivot
+    return {
+        "rows": rows,
+        "opted_in_estimators": n_opted,
+        "data_prep_s": round(prep_s, 2),
+        "sequential": {"train_s": round(seq_s, 2),
+                       "fit_passes_per_layer": n_opted},
+        "fused": {"train_s": round(fused_s, 2),
+                  "fit_passes_per_layer": delta["layers_fused"],
+                  "passes_saved": delta["passes_saved"],
+                  "bytes_scanned_mb": round(
+                      delta["bytes_scanned"] / 1e6, 1),
+                  "device_passes": delta["device_passes"],
+                  "host_passes": delta["host_passes"]},
+        "speedup": round(seq_s / fused_s, 2) if fused_s > 0 else None,
+    }
+
+
 def main() -> None:
     import jax
 
@@ -444,6 +531,23 @@ def main() -> None:
         except Exception as e:
             _log(f"[bench] scoring_throughput failed: {e!r}")
             configs["scoring_throughput"] = {"error": repr(e)[:400]}
+    bench.emit()
+
+    # 4c. Fit-statistics engine (fit path): one-pass-per-layer fused
+    #     sufficient statistics vs the sequential per-stage loop on a
+    #     wide synthetic layer. Budget-gated like scoring_throughput.
+    if bench.remaining() < 100:
+        configs["fit_stats"] = {
+            "status": "skipped_budget",
+            "remaining_budget_s": round(bench.remaining(), 1)}
+        _log(f"[bench] fit_stats skipped: remaining "
+             f"{bench.remaining():.0f}s < 100s")
+    else:
+        try:
+            configs["fit_stats"] = _fit_stats()
+        except Exception as e:
+            _log(f"[bench] fit_stats failed: {e!r}")
+            configs["fit_stats"] = {"error": repr(e)[:400]}
     bench.emit()
 
     # 5. Synthetic tree grid at scale (the BASELINE scale config: default

@@ -1,0 +1,472 @@
+"""Fused fit-statistics engine tests (fitstats.py — the
+SequenceAggregators analog).
+
+Parity discipline: for every opted-in estimator the fused layer pass
+must produce a model whose state is BIT-IDENTICAL to the sequential
+``fit_columns`` path (the host execution tier computes the exact same
+numpy expressions on the same compressed arrays). The device tier is a
+numerically-close twin (Chan-combined chunk folds) behind the same
+bandwidth gate as layer fusion, with its own chunked-vs-one-shot parity
+and one-program-per-layer-shape compile guard.
+"""
+import numpy as np
+import pytest
+
+import transmogrifai_tpu.fitstats as fitstats
+import transmogrifai_tpu.workflow as wf
+from transmogrifai_tpu import (ColumnStore, FeatureBuilder, Workflow,
+                               column_from_values, telemetry)
+from transmogrifai_tpu.columns import NumericColumn
+from transmogrifai_tpu.dsl import FillMissingWithMean, ScalarNormalizer
+from transmogrifai_tpu.fitstats import LayerStatsPlan, StatRequest
+from transmogrifai_tpu.ops.numeric import (BinaryVectorizer,
+                                           IntegralVectorizer,
+                                           NumericBucketizer,
+                                           RealVectorizer)
+from transmogrifai_tpu.ops.onehot import OneHotVectorizer, SetVectorizer
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.scalers import OpScalarStandardScaler
+from transmogrifai_tpu.stages.base import Estimator, FittedModel
+from transmogrifai_tpu.types import feature_types as ft
+
+
+@pytest.fixture(autouse=True)
+def _host_gate(monkeypatch):
+    """Pin the bandwidth gate LOW: the fused pass's host tier runs (the
+    bit-exact one); device-tier tests force device=True explicitly."""
+    monkeypatch.setattr(wf, "_DEVICE_BW_MBPS", 1.0)
+    yield
+
+
+@pytest.fixture
+def store(rng):
+    n = 400
+    cols = {}
+    for j in range(3):
+        v = rng.normal(size=n) * 10 ** j + j
+        vals = [None if rng.random() < 0.15 else float(x) for x in v]
+        cols[f"x{j}"] = column_from_values(ft.Real, vals)
+    ints = [None if rng.random() < 0.2 else int(rng.integers(0, 5))
+            for _ in range(n)]
+    cols["i0"] = column_from_values(ft.Integral, ints)
+    bools = [None if rng.random() < 0.3 else bool(rng.integers(0, 2))
+             for _ in range(n)]
+    cols["b0"] = column_from_values(ft.Binary, bools)
+    cats = ["a", "b", "c", "d", None]
+    cols["cat"] = column_from_values(
+        ft.PickList, [cats[int(rng.integers(0, 5))] for _ in range(n)])
+    sets = [set(np.random.default_rng(i).choice(
+        ["u", "v", "w"], size=i % 3).tolist()) for i in range(n)]
+    cols["set0"] = column_from_values(ft.MultiPickList, sets)
+    return ColumnStore(cols, n)
+
+
+def _fused_fit(stage, store, device=False):
+    reqs = stage.stat_requests(store)
+    assert reqs is not None
+    plan = LayerStatsPlan(list(reqs), n_stages=1)
+    stats = plan.run(store, device=device)
+    return stage.fit(store, stats=stats)
+
+
+def _feat(name, ftype=ft.Real):
+    return getattr(FeatureBuilder, ftype.__name__)(name) \
+        .from_column().as_predictor()
+
+
+def _assert_state_identical(m1, m2):
+    s1, s2 = m1.get_model_state(), m2.get_model_state()
+    assert repr(sorted(s1.items())) == repr(sorted(s2.items())), (s1, s2)
+
+
+def test_fused_parity_bit_identical_every_stage(store):
+    """Every opted-in estimator: fused (host tier) == sequential,
+    bit for bit."""
+    cases = []
+    for st in (FillMissingWithMean(), ScalarNormalizer(),
+               OpScalarStandardScaler()):
+        st.set_input(_feat("x1"))
+        cases.append(st)
+    rv = RealVectorizer()
+    rv.set_input(_feat("x0"), _feat("x1"), _feat("x2"))
+    cases.append(rv)
+    iv = IntegralVectorizer()
+    iv.set_input(_feat("i0", ft.Integral))
+    cases.append(iv)
+    bv = BinaryVectorizer()
+    bv.set_input(_feat("b0", ft.Binary))
+    cases.append(bv)
+    nb = NumericBucketizer(num_buckets=4)
+    nb.set_input(_feat("x2"))
+    cases.append(nb)
+    oh = OneHotVectorizer(top_k=3, min_support=1)
+    oh.set_input(_feat("cat", ft.PickList))
+    cases.append(oh)
+    sv = SetVectorizer(top_k=2, min_support=1)
+    sv.set_input(_feat("set0", ft.MultiPickList))
+    cases.append(sv)
+
+    for stage in cases:
+        seq = stage.fit(store)
+        fused = _fused_fit(stage, store)
+        _assert_state_identical(seq, fused)
+
+
+def test_fused_parity_sanity_checker(rng):
+    """SanityChecker: fused and sequential fits share one compute path —
+    identical keep indices AND identical summary statistics."""
+    n = 300
+    y = rng.integers(0, 2, n).astype(float)
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    X[:, 0] = y + rng.normal(size=n) * 1e-4       # leaky column
+    X[:, 1] = 0.0                                 # zero variance
+    from transmogrifai_tpu.columns import VectorColumn
+    from transmogrifai_tpu.vector_metadata import (VectorColumnMetadata,
+                                                   VectorMetadata)
+    meta = VectorMetadata("vec", [
+        VectorColumnMetadata(parent_feature_name=f"f{i}",
+                             parent_feature_type="Real")
+        for i in range(5)])
+    store = ColumnStore({
+        "label": column_from_values(ft.RealNN, y),
+        "vec": VectorColumn(ft.OPVector, X, meta),
+    })
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    vecf = FeatureBuilder.OPVector("vec").from_column().as_predictor()
+
+    checker = SanityChecker(remove_bad_features=True,
+                            remove_feature_group=False)
+    checker.set_input(label, vecf)
+    seq = checker.fit(store)
+    fused = _fused_fit(checker, store)
+    assert seq.keep_indices == fused.keep_indices
+    assert repr(seq.summary_.to_json()) == repr(fused.summary_.to_json())
+
+
+class _NoStatsEstimator(Estimator):
+    """Minimal estimator that does NOT opt in (stat_requests → None)."""
+
+    operation_name = "noStats"
+    output_type = ft.RealNN
+
+    @property
+    def input_spec(self):
+        from transmogrifai_tpu.stages.base import FixedArity
+        return FixedArity(ft.OPNumeric)
+
+    def fit_columns(self, store):
+        from transmogrifai_tpu.dsl import FillMissingWithMeanModel
+        col = store[self.input_features[0].name]
+        return FillMissingWithMeanModel(mean=float(
+            col.values[col.mask].mean()))
+
+
+def _layer_workflow(store, n_fill=3, with_pivot=True):
+    outs = []
+    for j in range(n_fill):
+        outs.append(_feat(f"x{j}").fill_missing_with_mean())
+    if with_pivot:
+        outs.append(_feat("cat", ft.PickList).pivot(top_k=3, min_support=1))
+    return Workflow().set_input_store(store).set_result_features(*outs)
+
+
+def test_layer_with_three_estimators_scans_once(store, monkeypatch):
+    """ISSUE acceptance: a layer with ≥3 opted-in estimators scans the
+    train store EXACTLY once — fit_columns never runs (the per-stage
+    scan path), and fitstats.bytes_scanned equals one visit per unique
+    input column."""
+    telemetry.reset()
+    telemetry.enable()
+    fitstats.reset_fitstats_stats()
+
+    def _boom(self, store):
+        raise AssertionError("sequential fit_columns ran on the fused path")
+    monkeypatch.setattr(FillMissingWithMean, "fit_columns", _boom)
+    monkeypatch.setattr(OneHotVectorizer, "fit_columns", _boom)
+    try:
+        model = _layer_workflow(store).train()
+        expected = 0
+        for name in ("x0", "x1", "x2"):
+            col = store[name]
+            expected += col.values.nbytes + col.mask.nbytes
+        expected += store["cat"].values.nbytes   # object ptrs; no mask attr
+        assert telemetry.counter(
+            "fitstats.bytes_scanned").value == expected
+        assert telemetry.counter("fitstats.passes_saved").value == 3
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+    tallies = fitstats.fitstats_stats()
+    assert tallies["layers_fused"] == 1
+    assert tallies["passes_saved"] == 3       # 4 estimators, one pass
+    assert tallies["bytes_scanned"] == expected
+    assert len(model.fitted_stages) == 4
+    for m in model.fitted_stages.values():
+        assert m.get_model_state()            # real fitted state
+
+
+def test_fused_counters_reach_telemetry(store):
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        collector = telemetry.add_listener(telemetry.CollectingRunListener())
+        _layer_workflow(store).train()
+        assert telemetry.counter("fitstats.layers_fused").value == 1
+        assert telemetry.counter("fitstats.passes_saved").value == 3
+        assert telemetry.counter("fitstats.bytes_scanned").value > 0
+        s = collector.summary()
+        assert s["statsPasses"] == 1 and s["fitPassesSaved"] == 3
+        names = [e["name"] for e in telemetry.trace_events()
+                 if e.get("ph") == "X"]
+        assert "fit:stats_pass" in names
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_fallback_layer_without_opted_estimators(store):
+    """A layer whose estimators don't opt in fits sequentially — no
+    fused pass recorded, models still correct."""
+    fitstats.reset_fitstats_stats()
+    st = _NoStatsEstimator()
+    st.set_input(_feat("x0"))
+    out = st.get_output()
+    model = Workflow().set_input_store(store) \
+        .set_result_features(out).train()
+    assert fitstats.fitstats_stats()["layers_fused"] == 0
+    assert len(model.fitted_stages) == 1
+
+
+def test_single_opted_estimator_stays_sequential(store):
+    """One opted-in estimator saves no pass → no fused plan runs
+    (FITSTATS_MIN_STAGES)."""
+    fitstats.reset_fitstats_stats()
+    _layer_workflow(store, n_fill=1, with_pivot=False).train()
+    assert fitstats.fitstats_stats()["layers_fused"] == 0
+
+
+def test_disabled_flag_restores_sequential(store, monkeypatch):
+    monkeypatch.setattr(fitstats, "FITSTATS_ENABLED", False)
+    fitstats.reset_fitstats_stats()
+    model = _layer_workflow(store).train()
+    assert fitstats.fitstats_stats()["layers_fused"] == 0
+    assert len(model.fitted_stages) == 4
+
+
+def test_chunked_vs_oneshot_device_parity(store, monkeypatch):
+    """The device fold's Chan combine: tiny chunks == one chunk (counts
+    and extrema exactly, f-moments to f64 tolerance)."""
+    reqs = []
+    for j in range(3):
+        name = f"x{j}"
+        reqs += [StatRequest("count", name), StatRequest("mean", name),
+                 StatRequest("variance", name), StatRequest("std", name),
+                 StatRequest("std", name, params=(1,)),
+                 StatRequest("min", name), StatRequest("max", name)]
+    plan = LayerStatsPlan(reqs, n_stages=3)
+    oneshot = plan.run(store, device=True)
+    monkeypatch.setattr(fitstats, "FITSTATS_CHUNK_ROWS", 128)
+    # force the pow2 floor down so chunking actually happens at n=400
+    monkeypatch.setattr(fitstats, "_chunk_rows", lambda n: 128)
+    chunked = plan.run(store, device=True)
+    for r in plan.requests:
+        a, b = oneshot.for_request(r), chunked.for_request(r)
+        if r.kind in ("count", "min", "max"):
+            assert a == b, (r, a, b)
+        else:
+            assert np.isclose(a, b, rtol=1e-10), (r, a, b)
+
+
+def test_device_vs_host_close(store):
+    """Device tier tracks the bit-exact host tier to f64 tolerance."""
+    reqs = [StatRequest(k, "x2") for k in
+            ("count", "mean", "variance", "std", "min", "max")]
+    plan = LayerStatsPlan(reqs, n_stages=2)
+    host = plan.run(store, device=False)
+    dev = plan.run(store, device=True)
+    for r in plan.requests:
+        a, b = host.for_request(r), dev.for_request(r)
+        if r.kind in ("count", "min", "max"):
+            assert a == b
+        else:
+            assert np.isclose(a, b, rtol=1e-9), (r.kind, a, b)
+
+
+def test_compile_count_one_program_per_layer_shape(rng, monkeypatch):
+    """Mirror of the scoring engine's bucket-budget guard: distinct row
+    counts within one chunk shape share ONE compiled fold program; a
+    different column width adds exactly one more."""
+    monkeypatch.setattr(fitstats, "_chunk_rows", lambda n: 512)
+    fitstats._PROGRAM_CACHE.clear()
+    fitstats.reset_fitstats_stats()
+
+    def _store(n, k):
+        cols = {f"c{j}": column_from_values(
+            ft.Real, list(rng.normal(size=n))) for j in range(k)}
+        return ColumnStore(cols, n)
+
+    def _plan(k):
+        return LayerStatsPlan(
+            [StatRequest("mean", f"c{j}") for j in range(k)], n_stages=k)
+
+    for n in (100, 300, 500, 512):
+        _plan(2).run(_store(n, 2), device=True)
+    assert fitstats.fitstats_stats()["programs_compiled"] == 1
+    _plan(3).run(_store(200, 3), device=True)
+    assert fitstats.fitstats_stats()["programs_compiled"] == 2
+
+
+def test_scalar_normalizer_f64_at_1e7_scale(rng):
+    """Satellite regression: 1e7-scale values in an f32-BACKED column
+    must normalize without fp32 mean/variance skew — fit accumulates in
+    f64 on both the sequential and the fused path."""
+    n = 20_000
+    base = 1e7
+    noise = rng.normal(size=n)
+    vals32 = (base + noise).astype(np.float32)
+    col = NumericColumn(ft.Real, vals32, np.ones(n, bool))
+    store = ColumnStore({"big": col}, n)
+
+    stage = ScalarNormalizer()
+    stage.set_input(_feat("big"))
+    seq = stage.fit(store)
+    fused = _fused_fit(stage, store)
+    _assert_state_identical(seq, fused)
+
+    # reference: exact f64 two-pass over the (f32-rounded) values
+    ref = vals32.astype(np.float64)
+    assert seq.mean == pytest.approx(float(ref.mean()), rel=1e-12)
+    assert seq.std == pytest.approx(float(ref.std()), rel=1e-12)
+    # the std of unit-ish noise survives (an fp32 accumulation collapses
+    # it: eps(1e7) in f32 is ~1, the same order as the signal)
+    assert 0.5 < seq.std < 2.0
+    out = seq.transform(store)[seq.output_name]
+    assert abs(float(out.values.mean())) < 0.05
+    assert float(out.values.std()) == pytest.approx(1.0, rel=0.05)
+
+    # fused DEVICE tier too (f64 under the x64 test config)
+    dev = _fused_fit(stage, store, device=True)
+    assert dev.std == pytest.approx(seq.std, rel=1e-9)
+    assert dev.mean == pytest.approx(seq.mean, rel=1e-12)
+
+
+def test_stats_value_mismatch_raises(store):
+    plan = LayerStatsPlan([StatRequest("mean", "x0")], n_stages=1)
+    stats = plan.run(store)
+    with pytest.raises(KeyError, match="not computed"):
+        stats.value("mean", "x1")
+
+
+def test_shared_request_dedup(store):
+    """Two stages needing the same column's counts share one request."""
+    a = OneHotVectorizer(top_k=2, min_support=1)
+    a.set_input(_feat("cat", ft.PickList))
+    b = OneHotVectorizer(top_k=4, min_support=1)
+    b.set_input(_feat("cat", ft.PickList))
+    plan = LayerStatsPlan(list(a.stat_requests(store))
+                          + list(b.stat_requests(store)), n_stages=2)
+    assert plan.n_requests == 1
+    stats = plan.run(store)
+    ma = a.fit(store, stats=stats)
+    mb = b.fit(store, stats=stats)
+    assert ma.vocabs != mb.vocabs       # per-stage top-K cut still applies
+    _assert_state_identical(ma, a.fit(store))
+    _assert_state_identical(mb, b.fit(store))
+
+
+def test_warm_started_stages_excluded_from_plan(store):
+    """Warm-started estimators must not be re-scanned OR re-finalized:
+    a layer with 3 fills where 2 are warm leaves only 1 opted-in stage
+    → below FITSTATS_MIN_STAGES, sequential."""
+    model = _layer_workflow(store, with_pivot=False).train()
+    fitstats.reset_fitstats_stats()
+    wf2 = _layer_workflow(store, with_pivot=False)
+    # reuse the SAME features so uids match
+    wf2.result_features = model.result_features
+    wf2.set_input_store(store).with_model_stages(model)
+    model2 = wf2.train()
+    assert fitstats.fitstats_stats()["layers_fused"] == 0
+    for uid in model.fitted_stages:
+        _assert_state_identical(model.fitted_stages[uid],
+                                model2.fitted_stages[uid])
+
+
+# -- satellite coverage ----------------------------------------------------
+
+
+def test_runner_compile_cache_dir(rng, tmp_path):
+    """customParams.compileCacheDir wires jax's persistent compilation
+    cache and its presence is stamped into the metrics doc."""
+    import jax
+
+    from transmogrifai_tpu.runner import OpParams, OpWorkflowRunner, RunType
+
+    y = rng.integers(0, 2, 120).astype(float)
+    x = rng.normal(size=120) + y
+    records = [{"label": float(y[i]), "x": float(x[i])} for i in range(120)]
+
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    out = fx.fill_missing_with_mean()
+    flow = Workflow().set_result_features(out)
+
+    class _Reader:
+        def read_records(self):
+            return list(records)
+
+    cache = tmp_path / "xla-cache"
+    old = jax.config.jax_compilation_cache_dir
+    try:
+        runner = OpWorkflowRunner(flow, training_reader=_Reader())
+        params = OpParams(
+            metrics_location=str(tmp_path / "metrics.json"),
+            custom_params={"compileCacheDir": str(cache)})
+        res = runner.run(RunType.TRAIN, params)
+        assert res.metrics["compileCacheDir"] == str(cache)
+        assert cache.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(cache)
+        # absent config → stamped None (presence is always recorded)
+        res2 = OpWorkflowRunner(flow, training_reader=_Reader()).run(
+            RunType.TRAIN, OpParams())
+        assert res2.metrics["compileCacheDir"] is None
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+
+
+def test_op_app_compile_cache_flag(rng, tmp_path):
+    import jax
+
+    from transmogrifai_tpu.runner import OpApp, OpWorkflowRunner
+
+    y = rng.integers(0, 2, 60).astype(float)
+    records = [{"label": float(y[i]), "x": float(i)} for i in range(60)]
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    flow = Workflow().set_result_features(fx.fill_missing_with_mean())
+
+    class _Reader:
+        def read_records(self):
+            return list(records)
+
+    class _App(OpApp):
+        def runner(self, params):
+            return OpWorkflowRunner(flow, training_reader=_Reader())
+
+    cache = tmp_path / "cli-cache"
+    old = jax.config.jax_compilation_cache_dir
+    try:
+        out = _App().main(["--run-type", "Train", "--quiet",
+                           "--compile-cache-dir", str(cache)])
+        assert out.metrics["compileCacheDir"] == str(cache)
+        assert cache.is_dir()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
+
+
+def test_hoisted_copy_import():
+    """workflow's warm-start copy import lives at module scope now."""
+    import transmogrifai_tpu.workflow as w
+    assert hasattr(w, "_copy")
+    import inspect
+    src = inspect.getsource(w.Workflow._fit_layer)
+    assert "import copy" not in src

@@ -1,0 +1,548 @@
+"""Fused fit-statistics engine — the ``SequenceAggregators`` analog.
+
+The reference computes every estimator's sufficient statistics for a
+stage layer in ONE Spark pass over the data
+(``utils/.../spark/SequenceAggregators.scala:41``: a single
+``Dataset.select(aggregates...)`` job feeds all vectorizers' fill
+values, modes and top-K counts). Our reproduction used to loop
+``for stage in layer: stage.fit(train)`` — every estimator re-scanning
+the full train store on host numpy.
+
+This module restores the one-pass-per-layer discipline for the TPU
+runtime:
+
+* Estimators declare what they need through a small **StatRequest
+  protocol** (``Estimator.stat_requests(store)`` — count / masked mean /
+  variance / std / min / max / quantile sketch / mode / top-K category
+  counts / histogram / the sanity checker's per-column label co-moments).
+* ``Workflow._fit_layer`` collects every request in the layer,
+  deduplicates them (two stages needing the mean of the same column
+  share one reduction), and runs them as **one pass** over the train
+  store (``LayerStatsPlan.run``).
+* Each opted-in stage then fits from the finalized stats
+  (``Estimator.fit(store, stats=...)`` → ``fit_columns_from_stats``) —
+  a cheap host-side finalize, no data scan.
+
+Execution has the same two-tier structure as the transform-side layer
+fusion (``workflow.apply_layer_vectorized``):
+
+* **Host execution** (default below the fusion gate) computes each
+  requested stat with *exactly the numpy expressions the sequential
+  ``fit_columns`` implementations use* on the identical compressed
+  arrays — fused and per-stage fits are **bit-identical** on this path.
+* **Device execution** (rows ≥ ``workflow.FUSE_MIN_ROWS`` and measured
+  link bandwidth ≥ ``workflow.FUSE_MIN_BANDWIDTH_MBPS``) streams the
+  scalar-moment columns through the device in fixed-shape chunks — one
+  jitted fold program per (chunk, width, dtype) shape (bounded cache, a
+  compile-count guard test mirrors the scoring engine's budget test),
+  uploads via the content-keyed ``device_put_f32`` cache, and combines
+  per-chunk partials on host in f64 (Chan's parallel-variance merge —
+  the same count/mean/M2 merge Spark's aggregators run per partition).
+  With >1 device the chunk rows shard over the ``data`` axis of a
+  ``parallel/mesh.py`` mesh and XLA inserts the psum. Counts, minima
+  and maxima are exact on both tiers; f-moment low bits can differ from
+  numpy's pairwise summation, which is why the bit-exactness guarantee
+  is stated for the host tier (the one the gate picks on slow links —
+  and the one CI exercises for the parity suite).
+
+String statistics (top-K counts, modes) and exact order statistics
+(quantiles) are host work by design — strings never reach the device
+(the one-hot vectorizer discipline) and ``np.quantile`` is the
+sequential path's exact sketch. They still ride the same single pass:
+each column is materialized once, whatever mix of stages needs it.
+
+Pass-count math: a layer with k opted-in estimators used to cost k full
+scans of the train store; fused it costs exactly one (asserted via the
+``fitstats.bytes_scanned`` counter in tests/test_fitstats.py). The
+module keeps an always-on tally (``fitstats_stats()``) that bench.py
+stamps on every emitted doc, and mirrors it into telemetry counters
+(``fitstats.bytes_scanned``, ``fitstats.passes_saved``, ...) when
+telemetry is enabled.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "StatRequest", "StatResults", "LayerStatsPlan",
+    "FITSTATS_ENABLED", "FITSTATS_MIN_STAGES", "FITSTATS_CHUNK_ROWS",
+    "fitstats_stats", "reset_fitstats_stats", "program_cache_stats",
+]
+
+#: master switch (``TMOG_FITSTATS=0`` disables; tests/bench toggle the
+#: module attribute directly)
+FITSTATS_ENABLED = os.environ.get("TMOG_FITSTATS", "1") != "0"
+
+#: fuse a layer only when at least this many of its estimators opt in —
+#: below it there is no pass to save
+FITSTATS_MIN_STAGES = 2
+
+#: row chunk of the device fold (bounds device memory for stores larger
+#: than HBM; the last chunk zero-mask-pads to the full chunk shape so a
+#: layer compiles ONE program regardless of row count)
+FITSTATS_CHUNK_ROWS = 262_144
+
+#: stat kinds computed together from one per-column moment bundle —
+#: the device-foldable family
+_MOMENT_KINDS = frozenset(
+    {"count", "mean", "variance", "std", "min", "max"})
+
+# ---------------------------------------------------------------------------
+# always-on tallies (bench stamps these on every doc, telemetry mirrors)
+# ---------------------------------------------------------------------------
+
+_TALLY_LOCK = threading.Lock()
+_TALLY = {"layers_fused": 0, "passes_saved": 0, "bytes_scanned": 0,
+          "host_passes": 0, "device_passes": 0, "programs_compiled": 0}
+
+
+def fitstats_stats() -> Dict[str, int]:
+    """Snapshot of the engine's process-wide tallies (always on, cheap —
+    the ``scoring.engine_cache_stats`` discipline)."""
+    with _TALLY_LOCK:
+        return dict(_TALLY)
+
+
+def reset_fitstats_stats() -> None:
+    with _TALLY_LOCK:
+        for k in _TALLY:
+            _TALLY[k] = 0
+
+
+def _tally(key: str, n: int = 1) -> None:
+    with _TALLY_LOCK:
+        _TALLY[key] += n
+
+
+# ---------------------------------------------------------------------------
+# requests and results
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class StatRequest:
+    """One declared sufficient statistic over a named column.
+
+    ``kind``: ``count | mean | variance | std | min | max | quantile |
+    mode | value_counts | set_value_counts | histogram | sanity``.
+    ``label`` names the label column for label-aware kinds (``sanity``);
+    ``params`` carries kind-specific knobs (ddof, bucket count, edges,
+    the sanity config) and is part of the dedup key.
+    """
+
+    kind: str
+    column: str
+    label: Optional[str] = None
+    params: Tuple = ()
+
+    def key(self) -> Tuple:
+        return (self.kind, self.column, self.label, self.params)
+
+
+class StatResults:
+    """Finalized stats keyed by request — what stages consume in
+    ``fit_columns_from_stats``. Missing lookups raise with the full key
+    so a stage/engine mismatch fails loudly, never silently."""
+
+    def __init__(self, values: Dict[Tuple, Any]):
+        self._values = values
+
+    def value(self, kind: str, column: str, label: Optional[str] = None,
+              params: Tuple = ()) -> Any:
+        key = (kind, column, label, tuple(params))
+        if key not in self._values:
+            raise KeyError(
+                f"stat {key} was not computed by the layer plan — the "
+                "stage's stat_requests and fit_columns_from_stats disagree")
+        return self._values[key]
+
+    def for_request(self, req: StatRequest) -> Any:
+        return self.value(req.kind, req.column, req.label, req.params)
+
+    def __contains__(self, key: Tuple) -> bool:
+        return tuple(key) in self._values
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+
+# ---------------------------------------------------------------------------
+# host execution — the bit-exact twin of the sequential fit_columns code
+# ---------------------------------------------------------------------------
+
+
+def _host_moment_bundle(col, kinds: Dict[str, List[Tuple]]) -> Dict[Tuple, Any]:
+    """All moment-family stats of one column, computed with the exact
+    expressions the sequential fits use: one compressed
+    ``values[mask].astype(f64)`` materialization, then numpy's own
+    ``mean/std/var/min/max`` on it."""
+    vals = col.values[col.mask].astype(np.float64)
+    count = int(vals.size)
+    out: Dict[Tuple, Any] = {}
+    for kind, params_list in kinds.items():
+        for params in params_list:
+            if kind == "count":
+                v: Any = count
+            elif count == 0:
+                v = None
+            elif kind == "mean":
+                v = float(vals.mean())
+            elif kind == "variance":
+                v = float(vals.var())
+            elif kind == "std":
+                ddof = params[0] if params else 0
+                v = (float(vals.std(ddof=ddof))
+                     if count > ddof else None)
+            elif kind == "min":
+                v = float(vals.min())
+            elif kind == "max":
+                v = float(vals.max())
+            else:  # pragma: no cover - guarded by _MOMENT_KINDS
+                raise ValueError(f"unknown moment kind {kind!r}")
+            out[(kind, params)] = v
+    return out
+
+
+def _exec_quantile(store, req: StatRequest):
+    """Quantile sketch: the sequential NumericBucketizer's exact
+    ``np.quantile`` over masked f64 values (None when the column is
+    empty — the caller applies its own default splits)."""
+    col = store[req.column]
+    present = col.values[col.mask].astype(np.float64)
+    if present.size == 0:
+        return None
+    num_buckets = int(req.params[0])
+    return np.quantile(present, np.linspace(0, 1, num_buckets + 1))
+
+
+def _exec_mode(store, req: StatRequest):
+    """Most frequent value, ties → smallest
+    (SequenceAggregators.ModeSeqNullInt semantics; unique is sorted)."""
+    col = store[req.column]
+    if not col.mask.any():
+        return None
+    vals, counts = np.unique(col.values[col.mask], return_counts=True)
+    return float(vals[np.argmax(counts)])
+
+
+def _exec_value_counts(store, req: StatRequest):
+    from .ops._hostvec import value_counts
+    return value_counts(store[req.column].values)
+
+
+def _exec_set_value_counts(store, req: StatRequest):
+    from .ops._hostvec import flatten_ragged, value_counts
+    flat, _rows, _lengths = flatten_ragged(store[req.column].values)
+    return value_counts(flat)
+
+
+def _exec_histogram(store, req: StatRequest):
+    col = store[req.column]
+    vals = col.values[col.mask].astype(np.float64)
+    edges = np.asarray(req.params, dtype=np.float64)
+    hist, _ = np.histogram(vals, bins=edges)
+    return hist
+
+
+def _exec_sanity(store, req: StatRequest):
+    """The sanity checker's moments + contingency sweep — delegated to
+    the SAME compute function its sequential ``fit_columns`` calls, so
+    the two paths are one code path (bit-identical by construction;
+    the device-vs-host gram gate lives inside it)."""
+    from .ops.sanity_checker import compute_sanity_stats
+    cfg = dict(req.params)
+    return compute_sanity_stats(store, req.label, req.column, **cfg)
+
+
+_HOST_EXEC = {
+    "quantile": _exec_quantile,
+    "mode": _exec_mode,
+    "value_counts": _exec_value_counts,
+    "set_value_counts": _exec_set_value_counts,
+    "histogram": _exec_histogram,
+    "sanity": _exec_sanity,
+}
+
+
+# ---------------------------------------------------------------------------
+# device execution — chunked fold program + Chan combine
+# ---------------------------------------------------------------------------
+
+#: jitted per-chunk moment programs keyed by (chunk, k, dtype, sharded);
+#: bounded like workflow._LAYER_JIT_CACHE
+_PROGRAM_CACHE: Dict[Tuple, Any] = {}
+_PROGRAM_CACHE_CAP = 32
+
+
+def program_cache_stats() -> Dict[str, int]:
+    return {"size": len(_PROGRAM_CACHE),
+            "compiles": fitstats_stats()["programs_compiled"]}
+
+
+def _pow2_ceil(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _chunk_rows(n: int) -> int:
+    """Fixed-shape chunk for the fold: power-of-two with a floor (tiny
+    stores pad up rather than compiling a program per row count — the
+    scoring engine's bucket-ladder discipline) and the module cap."""
+    return min(FITSTATS_CHUNK_ROWS, max(_pow2_ceil(n), 1024))
+
+
+def _moment_program(chunk: int, k: int, dtype: str):
+    """ONE jitted fold step per (chunk, width, dtype) shape: per-column
+    count, sum, chunk-local mean and centered M2, min, max. Masked and
+    padded rows are inert (value 0, mask False)."""
+    key = (chunk, k, dtype)
+    prog = _PROGRAM_CACHE.pop(key, None)
+    if prog is None:
+        import jax
+        import jax.numpy as jnp
+
+        def step(v, b):
+            bf = b.astype(v.dtype)
+            cnt = bf.sum(axis=0)
+            s = (v * bf).sum(axis=0)
+            mean_c = s / jnp.maximum(cnt, 1.0)
+            d = (v - mean_c[None, :]) * bf
+            m2 = (d * d).sum(axis=0)
+            mn = jnp.where(b, v, jnp.inf).min(axis=0)
+            mx = jnp.where(b, v, -jnp.inf).max(axis=0)
+            return cnt, s, mean_c, m2, mn, mx
+
+        prog = jax.jit(step)
+        _tally("programs_compiled")
+    _PROGRAM_CACHE[key] = prog          # LRU re-insert on use
+    while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_CAP:
+        _PROGRAM_CACHE.pop(next(iter(_PROGRAM_CACHE)))
+    return prog
+
+
+def _chan_combine(parts: List[Tuple]) -> Tuple[np.ndarray, ...]:
+    """Merge per-chunk (count, sum, mean, M2, min, max) partials in f64
+    — Chan's parallel variance combination (exact for counts/min/max;
+    the same merge Spark runs across partitions)."""
+    cnt, _s, mean, m2, mn, mx = [np.asarray(a, np.float64)
+                                 for a in parts[0]]
+    for p in parts[1:]:
+        c2, _s2, me2, m22, mn2, mx2 = [np.asarray(a, np.float64)
+                                       for a in p]
+        tot = cnt + c2
+        safe = np.maximum(tot, 1.0)
+        delta = me2 - mean
+        mean = np.where(tot > 0, (cnt * mean + c2 * me2) / safe, 0.0)
+        m2 = m2 + m22 + delta * delta * cnt * c2 / safe
+        cnt = tot
+        mn = np.minimum(mn, mn2)
+        mx = np.maximum(mx, mx2)
+    return cnt, mean, m2, mn, mx
+
+
+_MESH_OFF = os.environ.get("TMOG_FITSTATS_MESH", "1") == "0"
+
+
+def _device_moment_bundles(store, col_kinds: Dict[str, Dict[str, List[Tuple]]]
+                           ) -> Dict[str, Dict[Tuple, Any]]:
+    """Device tier: stack the requested scalar columns into [n, k],
+    stream fixed-shape row chunks through ONE jitted fold program, and
+    combine the per-chunk partials on host in f64.
+
+    Uploads go through the content-keyed ``device_put_f32`` cache; with
+    more than one device the chunk's rows shard over the mesh's ``data``
+    axis (GSPMD inserts the psum for the column reductions)."""
+    import jax
+
+    names = sorted(col_kinds)
+    n, k = store.n_rows, len(names)
+    f64 = jax.config.jax_enable_x64
+    dtype = np.float64 if f64 else np.float32
+    V = np.empty((n, k), dtype)
+    B = np.empty((n, k), bool)
+    for j, nm in enumerate(names):
+        col = store[nm]
+        B[:, j] = col.mask
+        # zero-fill masked slots so padded/masked rows are inert in the
+        # fold (the pad_rows zero-weight discipline)
+        V[:, j] = np.where(col.mask, col.values.astype(np.float64), 0.0)
+
+    chunk = _chunk_rows(n)
+    one_chunk = n <= chunk
+    sharding = None
+    if not _MESH_OFF and len(jax.devices()) > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .parallel.mesh import make_mesh
+        mesh = make_mesh(grid_size=1)
+        if chunk % mesh.shape["data"] == 0:
+            sharding = NamedSharding(mesh, P("data", None))
+
+    prog = _moment_program(chunk, k, str(dtype))
+    parts = []
+    for off in range(0, n, chunk):
+        v = V[off:off + chunk]
+        b = B[off:off + chunk]
+        if v.shape[0] < chunk:
+            pad = chunk - v.shape[0]
+            v = np.concatenate([v, np.zeros((pad, k), dtype)])
+            b = np.concatenate([b, np.zeros((pad, k), bool)])
+        if sharding is not None:
+            vd = jax.device_put(v, sharding)
+            bd = jax.device_put(b, sharding)
+        elif one_chunk:
+            # single-chunk pass: content-keyed upload cache — repeat
+            # fits of the same store (bench warm reps, CV re-fits)
+            # skip the transfer entirely
+            from .models.base import device_put_f32
+            vd = device_put_f32(v)
+            bd = device_put_f32(b)
+        else:
+            # multi-chunk stream: contents never repeat within the
+            # pass, so the content hash would be pure overhead and the
+            # insertions would flush genuinely reusable cache entries
+            vd = jax.device_put(v)
+            bd = jax.device_put(b)
+        parts.append(jax.device_get(prog(vd, bd)))
+
+    cnt, mean, m2, mn, mx = _chan_combine(parts)
+    out: Dict[str, Dict[Tuple, Any]] = {}
+    for j, nm in enumerate(names):
+        c = int(cnt[j])
+        vals: Dict[Tuple, Any] = {}
+        for kind, params_list in col_kinds[nm].items():
+            for params in params_list:
+                if kind == "count":
+                    v: Any = c
+                elif c == 0:
+                    v = None
+                elif kind == "mean":
+                    v = float(mean[j])
+                elif kind == "variance":
+                    v = float(m2[j] / c)
+                elif kind == "std":
+                    ddof = params[0] if params else 0
+                    v = (float(np.sqrt(m2[j] / (c - ddof)))
+                         if c > ddof else None)
+                elif kind == "min":
+                    v = float(mn[j])
+                elif kind == "max":
+                    v = float(mx[j])
+                else:  # pragma: no cover
+                    raise ValueError(f"unknown moment kind {kind!r}")
+                vals[(kind, params)] = v
+        out[nm] = vals
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the layer plan
+# ---------------------------------------------------------------------------
+
+
+def _col_bytes(col) -> int:
+    """Host bytes backing one column (values + explicit mask) — the
+    unit of the ``fitstats.bytes_scanned`` counter."""
+    b = 0
+    v = getattr(col, "values", None)
+    if isinstance(v, np.ndarray):
+        b += v.nbytes
+    elif isinstance(v, list):
+        b += 8 * len(v)
+    m = col.__dict__.get("mask")        # NOT TextColumn's computed property
+    if isinstance(m, np.ndarray):
+        b += m.nbytes
+    return b
+
+
+class LayerStatsPlan:
+    """All of one DAG layer's StatRequests, deduplicated, executed as a
+    single pass over the train store."""
+
+    def __init__(self, requests: Sequence[StatRequest], n_stages: int = 1):
+        dedup: Dict[Tuple, StatRequest] = {}
+        for r in requests:
+            dedup.setdefault(r.key(), r)
+        self.requests: List[StatRequest] = list(dedup.values())
+        self.n_stages = n_stages
+
+    @property
+    def n_requests(self) -> int:
+        return len(self.requests)
+
+    def _gate_device(self, store) -> bool:
+        from .workflow import (FUSE_MIN_BANDWIDTH_MBPS, FUSE_MIN_ROWS,
+                               device_roundtrip_mbps)
+        return (store.n_rows >= FUSE_MIN_ROWS
+                and device_roundtrip_mbps() >= FUSE_MIN_BANDWIDTH_MBPS)
+
+    def run(self, store, device: Optional[bool] = None) -> StatResults:
+        """Execute every request in one pass; ``device`` overrides the
+        bandwidth/row gate (tests pin it either way)."""
+        from . import telemetry
+
+        moment_cols: Dict[str, Dict[str, List[Tuple]]] = {}
+        other: List[StatRequest] = []
+        for r in self.requests:
+            if r.kind in _MOMENT_KINDS:
+                moment_cols.setdefault(r.column, {}) \
+                    .setdefault(r.kind, []).append(tuple(r.params))
+            else:
+                other.append(r)
+
+        use_device = (self._gate_device(store) if device is None
+                      else bool(device)) and bool(moment_cols)
+
+        values: Dict[Tuple, Any] = {}
+        touched: Dict[str, int] = {}
+
+        if moment_cols:
+            if use_device:
+                bundles = _device_moment_bundles(store, moment_cols)
+            else:
+                bundles = {nm: _host_moment_bundle(store[nm], kinds)
+                           for nm, kinds in moment_cols.items()}
+            for r in self.requests:
+                if r.kind in _MOMENT_KINDS:
+                    touched.setdefault(r.column, _col_bytes(store[r.column]))
+                    values[r.key()] = \
+                        bundles[r.column][(r.kind, tuple(r.params))]
+
+        for r in other:
+            exec_fn = _HOST_EXEC.get(r.kind)
+            if exec_fn is None:
+                raise ValueError(f"unknown stat kind {r.kind!r}")
+            values[r.key()] = exec_fn(store, r)
+            touched.setdefault(r.column, _col_bytes(store[r.column]))
+            if r.label is not None:
+                touched.setdefault(r.label, _col_bytes(store[r.label]))
+
+        scanned = sum(touched.values())
+        saved = max(self.n_stages - 1, 0)
+        _tally("layers_fused")
+        _tally("passes_saved", saved)
+        _tally("bytes_scanned", scanned)
+        _tally("device_passes" if use_device else "host_passes")
+        telemetry.counter("fitstats.layers_fused").inc()
+        telemetry.counter("fitstats.passes_saved").inc(saved)
+        telemetry.counter("fitstats.bytes_scanned").inc(scanned)
+        telemetry.counter(
+            "fitstats.device_passes" if use_device
+            else "fitstats.host_passes").inc()
+        logger.info(
+            "fitstats: %d request(s) for %d stage(s) in one %s pass "
+            "(%d column(s), %.1f MB scanned, %d pass(es) saved)",
+            self.n_requests, self.n_stages,
+            "device" if use_device else "host", len(touched),
+            scanned / 1e6, saved)
+        return StatResults(values)

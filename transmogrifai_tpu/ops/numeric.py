@@ -105,6 +105,26 @@ class _NumericVectorizerBase(VectorizerEstimator):
             input_names=self.input_names,
             ftype_name=self.seq_type.__name__)
 
+    # -- fused fit-statistics opt-in (fitstats.py) -------------------------
+    def _stat_request_for(self, name: str):
+        """Per-column StatRequest, or None when the fill is a constant
+        (no data needed). Subclasses override."""
+        return None
+
+    def stat_requests(self, store):
+        return [r for r in (self._stat_request_for(n)
+                            for n in self.input_names) if r is not None]
+
+    def _fill_from_stats(self, name: str, stats) -> float:
+        return float(self.fill_value)
+
+    def fit_columns_from_stats(self, store, stats):
+        fills = [self._fill_from_stats(n, stats) for n in self.input_names]
+        return NumericVectorizerModel(
+            fill_values=fills, track_nulls=self.track_nulls,
+            input_names=self.input_names,
+            ftype_name=self.seq_type.__name__)
+
 
 @register_stage
 class RealVectorizer(_NumericVectorizerBase):
@@ -123,6 +143,19 @@ class RealVectorizer(_NumericVectorizerBase):
     def _fill_for(self, col) -> float:
         if self.fill_with_mean and col.mask.any():
             return float(col.values[col.mask].astype(np.float64).mean())
+        return float(self.fill_value)
+
+    def _stat_request_for(self, name: str):
+        if not self.fill_with_mean:
+            return None
+        from ..fitstats import StatRequest
+        return StatRequest("mean", name)
+
+    def _fill_from_stats(self, name: str, stats) -> float:
+        if self.fill_with_mean:
+            mean = stats.value("mean", name)
+            if mean is not None:
+                return mean
         return float(self.fill_value)
 
 
@@ -145,6 +178,19 @@ class IntegralVectorizer(_NumericVectorizerBase):
         if self.fill_with_mode and col.mask.any():
             vals, counts = np.unique(col.values[col.mask], return_counts=True)
             return float(vals[np.argmax(counts)])  # unique is sorted → ties to min
+        return float(self.fill_value)
+
+    def _stat_request_for(self, name: str):
+        if not self.fill_with_mode:
+            return None
+        from ..fitstats import StatRequest
+        return StatRequest("mode", name)
+
+    def _fill_from_stats(self, name: str, stats) -> float:
+        if self.fill_with_mode:
+            mode = stats.value("mode", name)
+            if mode is not None:
+                return mode
         return float(self.fill_value)
 
 
@@ -267,6 +313,17 @@ class NumericBucketizer(VectorizerEstimator):
         self.track_nulls = track_nulls
         self.track_invalid = track_invalid
 
+    @staticmethod
+    def _splits_of(qs) -> List[float]:
+        """Quantile sketch → final split edges (dedup'd, degenerate
+        columns padded) — shared by the sequential and fused paths."""
+        if qs is None:
+            return [0.0, 1.0]
+        qs = np.unique(qs)
+        if qs.size < 2:
+            qs = np.array([qs[0], qs[0] + 1.0])
+        return qs.tolist()
+
     def fit_columns(self, store: ColumnStore) -> NumericBucketizerModel:
         per_feature = []
         for name in self.input_names:
@@ -275,14 +332,32 @@ class NumericBucketizer(VectorizerEstimator):
                 continue
             col = store[name]
             present = col.values[col.mask].astype(np.float64)
-            if present.size == 0:
-                per_feature.append([0.0, 1.0])
+            qs = (np.quantile(present,
+                              np.linspace(0, 1, self.num_buckets + 1))
+                  if present.size else None)
+            per_feature.append(self._splits_of(qs))
+        return NumericBucketizerModel(
+            splits=per_feature, track_nulls=self.track_nulls,
+            track_invalid=self.track_invalid, input_names=self.input_names,
+            ftype_name=self.seq_type.__name__)
+
+    # -- fused fit-statistics opt-in (fitstats.py) -------------------------
+    def stat_requests(self, store):
+        if self.splits is not None:
+            return []           # fixed splits: nothing to scan
+        from ..fitstats import StatRequest
+        return [StatRequest("quantile", n, params=(self.num_buckets,))
+                for n in self.input_names]
+
+    def fit_columns_from_stats(self, store, stats):
+        per_feature = []
+        for name in self.input_names:
+            if self.splits is not None:
+                per_feature.append(self.splits)
                 continue
-            qs = np.quantile(present, np.linspace(0, 1, self.num_buckets + 1))
-            qs = np.unique(qs)
-            if qs.size < 2:
-                qs = np.array([qs[0], qs[0] + 1.0])
-            per_feature.append(qs.tolist())
+            qs = stats.value("quantile", name,
+                             params=(self.num_buckets,))
+            per_feature.append(self._splits_of(qs))
         return NumericBucketizerModel(
             splits=per_feature, track_nulls=self.track_nulls,
             track_invalid=self.track_invalid, input_names=self.input_names,

@@ -129,18 +129,42 @@ class OneHotVectorizer(VectorizerEstimator):
         self.min_support = min_support
         self.track_nulls = track_nulls
 
+    #: vocab-count source for the fused engine + model flavor; the set
+    #: pivot overrides both knobs and inherits every fit body
+    _count_kind = "value_counts"
+    _is_set = False
+
     def _count(self, col) -> Counter:
         from ._hostvec import value_counts
         return value_counts(col.values)
 
-    def fit_columns(self, store: ColumnStore) -> OneHotModel:
-        vocabs = [_sorted_topk(self._count(store[n]), self.top_k,
-                               self.min_support)
-                  for n in self.input_names]
+    def _model_of(self, vocabs) -> OneHotModel:
+        # is_set/ftype_name must ride the ctor so save/load preserves them
         return OneHotModel(
             vocabs=vocabs, track_nulls=self.track_nulls,
             input_names=self.input_names,
-            ftype_name=self.seq_type.__name__)
+            ftype_name=self.seq_type.__name__, is_set=self._is_set)
+
+    def fit_columns(self, store: ColumnStore) -> OneHotModel:
+        return self._model_of(
+            [_sorted_topk(self._count(store[n]), self.top_k,
+                          self.min_support)
+             for n in self.input_names])
+
+    # -- fused fit-statistics opt-in (fitstats.py) -------------------------
+    # Two pivot stages over the same column (different top_k) share ONE
+    # value-count pass: the request is the raw Counter, the per-stage
+    # top-K cut happens in the finalize.
+    def stat_requests(self, store):
+        from ..fitstats import StatRequest
+        return [StatRequest(self._count_kind, n)
+                for n in self.input_names]
+
+    def fit_columns_from_stats(self, store, stats):
+        return self._model_of(
+            [_sorted_topk(stats.value(self._count_kind, n),
+                          self.top_k, self.min_support)
+             for n in self.input_names])
 
 
 @register_stage
@@ -150,17 +174,10 @@ class SetVectorizer(OneHotVectorizer):
     operation_name = "pivotSet"
     seq_type = OPSet
 
+    _count_kind = "set_value_counts"
+    _is_set = True
+
     def _count(self, col) -> Counter:
         from ._hostvec import flatten_ragged, value_counts
         flat, _rows, _lengths = flatten_ragged(col.values)
         return value_counts(flat)
-
-    def fit_columns(self, store: ColumnStore) -> OneHotModel:
-        vocabs = [_sorted_topk(self._count(store[n]), self.top_k,
-                               self.min_support)
-                  for n in self.input_names]
-        # is_set/ftype_name must ride the ctor so save/load preserves them
-        return OneHotModel(
-            vocabs=vocabs, track_nulls=self.track_nulls,
-            input_names=self.input_names,
-            ftype_name=self.seq_type.__name__, is_set=True)

@@ -31,7 +31,8 @@ from ..types.feature_types import OPVector, RealNN
 from ..vector_metadata import VectorMetadata
 from .vectorizer_base import VectorizerModel
 
-__all__ = ["SanityChecker", "SanityCheckerModel", "SanityCheckerSummary"]
+__all__ = ["SanityChecker", "SanityCheckerModel", "SanityCheckerSummary",
+           "compute_sanity_stats"]
 
 # defaults (SanityChecker.scala:720-739)
 CHECK_SAMPLE = 1.0
@@ -159,96 +160,49 @@ class SanityChecker(Estimator, AllowLabelAsInput):
         return FixedArity(RealNN, OPVector)
 
     def fit_columns(self, store: ColumnStore) -> SanityCheckerModel:
-        label_name = self.input_features[0].name
-        feat_name = self.input_features[1].name
-        ycol = store[label_name]
-        xcol = store[feat_name]
-        assert isinstance(xcol, VectorColumn)
-        import jax as _jax
-        _f64 = _jax.config.jax_enable_x64
-        X = np.asarray(xcol.values,
-                       dtype=np.float64 if _f64 else np.float32)
-        y = np.asarray(ycol.values, dtype=np.float64)
-        n, d = X.shape
-        meta = xcol.metadata or VectorMetadata(feat_name, [])
+        stats = compute_sanity_stats(
+            store, self.input_features[0].name,
+            self.input_features[1].name,
+            feature_label_corr_only=self.feature_label_corr_only,
+            correlation_type=self.correlation_type,
+            check_sample=self.check_sample,
+            sample_seed=self.sample_seed)
+        return self._finalize_from_stats(stats)
 
-        # sampling (SanityChecker.scala:552-560): bounded row sample
-        if n > SAMPLE_UPPER_LIMIT or self.check_sample < 1.0:
-            rng = np.random.default_rng(self.sample_seed)
-            target = int(min(max(n * self.check_sample, SAMPLE_LOWER_LIMIT),
-                             SAMPLE_UPPER_LIMIT))
-            if target < n:
-                idx = rng.choice(n, size=target, replace=False)
-                X, y = X[idx], y[idx]
-                n = target
+    # -- fused fit-statistics opt-in (fitstats.py) -------------------------
+    def _stats_params(self) -> Tuple:
+        return (("feature_label_corr_only", self.feature_label_corr_only),
+                ("correlation_type", self.correlation_type),
+                ("check_sample", self.check_sample),
+                ("sample_seed", self.sample_seed))
 
-        # Dispatch EVERY device computation first (moments, optional
-        # Spearman over ranks, per-group contingencies) and fetch them in
-        # ONE device_get at the end: each separate pull pays the device
-        # link's round-trip latency (~200ms on a tunnelled TPU). On a
-        # SLOW link (the fusion gate's bandwidth probe) and a big matrix
-        # the upload costs more than the gram — the host-BLAS twin runs
-        # instead (utils.stats.moments_host).
-        from ..utils.stats import moments_host as _moments_host
-        from ..workflow import (FUSE_MIN_BANDWIDTH_MBPS,
-                                device_roundtrip_mbps)
-        # slow link + production (x64-off) dtype → host for ANY size:
-        # big matrices because the upload dwarfs the gram, small ones
-        # because the moments-kernel COMPILE alone costs seconds over a
-        # tunnelled compile service. The x64 test path stays on the
-        # device kernel (exact f64).
-        use_host = (not _f64
-                    and device_roundtrip_mbps() < FUSE_MIN_BANDWIDTH_MBPS)
-        if use_host:
-            moments_dev = _moments_host(X, y,
-                                        self.feature_label_corr_only)
-        else:
-            moments_dev = _moments_kernel(jnp.asarray(X), jnp.asarray(y),
-                                          self.feature_label_corr_only)
+    def stat_requests(self, store):
+        from ..fitstats import StatRequest
+        return [StatRequest("sanity", self.input_features[1].name,
+                            label=self.input_features[0].name,
+                            params=self._stats_params())]
 
-        # Spearman = Pearson over average ranks (MLlib Statistics.corr
-        # "spearman"); ranks built per column on host, correlations in the
-        # same fused gram kernel. Only computed when it drives the gate —
-        # the reference computes just the configured CorrelationType
-        # (SanityChecker.scala:634-638) and the O(d·n log n) host ranking
-        # is real money on wide hashed-text vectors.
-        spearman_dev = None
-        if self.correlation_type == "spearman":
-            spearman_dev, _full = _spearman_with_label(X, y,
-                                                       host=use_host)
+    def fit_columns_from_stats(self, store, stats):
+        return self._finalize_from_stats(stats.value(
+            "sanity", self.input_features[1].name,
+            label=self.input_features[0].name,
+            params=self._stats_params()))
 
-        groups: Dict[Tuple[str, str], List[int]] = {}
-        if meta.size == d:
-            for i, cm in enumerate(meta.columns):
-                if cm.indicator_value is not None and cm.grouping is not None:
-                    groups.setdefault((cm.parent_feature_name, cm.grouping),
-                                      []).append(i)
-        ordered = sorted(groups.items())
-        conts_dev = []
-        if ordered:
-            classes = np.unique(y)
-            Y1 = (y[:, None] == classes[None, :]).astype(np.float64)
-            if use_host:
-                # same gate as moments: per-group widths mean one device
-                # compile EACH over a slow compile service for a matmul
-                # the host does in microseconds
-                conts_dev = [Y1.T @ np.asarray(X[:, idxs], np.float64)
-                             for _g, idxs in ordered]
-            else:
-                Y1d = jnp.asarray(Y1)
-                conts_dev = [_contingency_kernel(Y1d,
-                                                 jnp.asarray(X[:, idxs]))
-                             for _g, idxs in ordered]
-
-        (mean, var, corr_label, corr, zmin, zmax), spearman_out, conts = \
-            jax.device_get((moments_dev, spearman_dev, conts_dev))
-        spearman_label = spearman_out  # corr-with-label vector or None
-
-        names = meta.column_names() if meta.size == d else \
-            [f"{feat_name}_{i}" for i in range(d)]
-        is_hash = [meta.size == d and
-                   (meta.columns[i].descriptor_value or "").startswith("hash_")
-                   for i in range(d)]
+    def _finalize_from_stats(self, stats: Dict[str, Any]
+                             ) -> SanityCheckerModel:
+        """Host-side finalize: thresholds, reasons, summary and the
+        keep-index model from the computed statistics. Shared verbatim
+        by the sequential fit and the fused layer pass — the two paths
+        cannot drift."""
+        d = stats["d"]
+        meta = stats["meta"]
+        names = stats["names"]
+        is_hash = stats["is_hash"]
+        mean, var = stats["mean"], stats["var"]
+        corr_label = stats["corr_label"]
+        zmin, zmax = stats["zmin"], stats["zmax"]
+        spearman_label = stats["spearman_label"]
+        ordered, conts = stats["ordered"], stats["conts"]
 
         gate_corr = (spearman_label if self.correlation_type == "spearman"
                      else corr_label)
@@ -341,3 +295,110 @@ class SanityChecker(Estimator, AllowLabelAsInput):
         model = SanityCheckerModel(keep_indices=keep)
         model.summary_ = summary
         return model
+
+
+def compute_sanity_stats(store: ColumnStore, label_name: str,
+                         feat_name: str, *,
+                         feature_label_corr_only: bool = False,
+                         correlation_type: str = "pearson",
+                         check_sample: float = CHECK_SAMPLE,
+                         sample_seed: int = 42) -> Dict[str, Any]:
+    """The SanityChecker's statistics sweep as a standalone computation:
+    bounded row sample, fused moments/correlation gram (device kernel or
+    host-BLAS twin behind the bandwidth gate), optional Spearman ranks,
+    and per-group contingency tables — everything ``fit_columns``
+    consumes in its finalize. Exposed at module level so the layer-wide
+    fused fit-statistics engine (``fitstats.py``) computes the identical
+    values in its single pass: sequential and fused sanity fits share
+    this one code path."""
+    ycol = store[label_name]
+    xcol = store[feat_name]
+    assert isinstance(xcol, VectorColumn)
+    import jax as _jax
+    _f64 = _jax.config.jax_enable_x64
+    X = np.asarray(xcol.values,
+                   dtype=np.float64 if _f64 else np.float32)
+    y = np.asarray(ycol.values, dtype=np.float64)
+    n, d = X.shape
+    meta = xcol.metadata or VectorMetadata(feat_name, [])
+
+    # sampling (SanityChecker.scala:552-560): bounded row sample
+    if n > SAMPLE_UPPER_LIMIT or check_sample < 1.0:
+        rng = np.random.default_rng(sample_seed)
+        target = int(min(max(n * check_sample, SAMPLE_LOWER_LIMIT),
+                         SAMPLE_UPPER_LIMIT))
+        if target < n:
+            idx = rng.choice(n, size=target, replace=False)
+            X, y = X[idx], y[idx]
+            n = target
+
+    # Dispatch EVERY device computation first (moments, optional
+    # Spearman over ranks, per-group contingencies) and fetch them in
+    # ONE device_get at the end: each separate pull pays the device
+    # link's round-trip latency (~200ms on a tunnelled TPU). On a
+    # SLOW link (the fusion gate's bandwidth probe) and a big matrix
+    # the upload costs more than the gram — the host-BLAS twin runs
+    # instead (utils.stats.moments_host).
+    from ..utils.stats import moments_host as _moments_host
+    from ..workflow import (FUSE_MIN_BANDWIDTH_MBPS,
+                            device_roundtrip_mbps)
+    # slow link + production (x64-off) dtype → host for ANY size:
+    # big matrices because the upload dwarfs the gram, small ones
+    # because the moments-kernel COMPILE alone costs seconds over a
+    # tunnelled compile service. The x64 test path stays on the
+    # device kernel (exact f64).
+    use_host = (not _f64
+                and device_roundtrip_mbps() < FUSE_MIN_BANDWIDTH_MBPS)
+    if use_host:
+        moments_dev = _moments_host(X, y, feature_label_corr_only)
+    else:
+        moments_dev = _moments_kernel(jnp.asarray(X), jnp.asarray(y),
+                                      feature_label_corr_only)
+
+    # Spearman = Pearson over average ranks (MLlib Statistics.corr
+    # "spearman"); ranks built per column on host, correlations in the
+    # same fused gram kernel. Only computed when it drives the gate —
+    # the reference computes just the configured CorrelationType
+    # (SanityChecker.scala:634-638) and the O(d·n log n) host ranking
+    # is real money on wide hashed-text vectors.
+    spearman_dev = None
+    if correlation_type == "spearman":
+        spearman_dev, _full = _spearman_with_label(X, y, host=use_host)
+
+    groups: Dict[Tuple[str, str], List[int]] = {}
+    if meta.size == d:
+        for i, cm in enumerate(meta.columns):
+            if cm.indicator_value is not None and cm.grouping is not None:
+                groups.setdefault((cm.parent_feature_name, cm.grouping),
+                                  []).append(i)
+    ordered = sorted(groups.items())
+    conts_dev = []
+    if ordered:
+        classes = np.unique(y)
+        Y1 = (y[:, None] == classes[None, :]).astype(np.float64)
+        if use_host:
+            # same gate as moments: per-group widths mean one device
+            # compile EACH over a slow compile service for a matmul
+            # the host does in microseconds
+            conts_dev = [Y1.T @ np.asarray(X[:, idxs], np.float64)
+                         for _g, idxs in ordered]
+        else:
+            Y1d = jnp.asarray(Y1)
+            conts_dev = [_contingency_kernel(Y1d,
+                                             jnp.asarray(X[:, idxs]))
+                         for _g, idxs in ordered]
+
+    (mean, var, corr_label, _corr, zmin, zmax), spearman_label, conts = \
+        jax.device_get((moments_dev, spearman_dev, conts_dev))
+
+    names = meta.column_names() if meta.size == d else \
+        [f"{feat_name}_{i}" for i in range(d)]
+    is_hash = [meta.size == d and
+               (meta.columns[i].descriptor_value or "").startswith("hash_")
+               for i in range(d)]
+
+    return {"d": d, "meta": meta, "names": names, "is_hash": is_hash,
+            "mean": mean, "var": var, "corr_label": corr_label,
+            "zmin": zmin, "zmax": zmax,
+            "spearman_label": spearman_label,
+            "ordered": ordered, "conts": conts}

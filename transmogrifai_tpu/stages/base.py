@@ -281,10 +281,25 @@ class FittedModel(Transformer):
 
 
 class Estimator(OpPipelineStage):
-    """Stage that must be fit on data, producing a :class:`FittedModel`."""
+    """Stage that must be fit on data, producing a :class:`FittedModel`.
 
-    def fit(self, store: ColumnStore) -> FittedModel:
-        model = self.fit_columns(store)
+    Estimators may additionally opt into the layer-wide fused
+    fit-statistics engine (``fitstats.py``, the SequenceAggregators
+    analog) by overriding :meth:`stat_requests` and
+    :meth:`fit_columns_from_stats`: the workflow then computes every
+    opted-in estimator's sufficient statistics for a DAG layer in ONE
+    pass over the train store and hands each stage its finalized stats,
+    instead of every ``fit_columns`` re-scanning the full store. The
+    plain ``fit_columns`` stays as the sequential fallback and the two
+    paths must produce identical models.
+    """
+
+    def fit(self, store: ColumnStore,
+            stats: Optional[Any] = None) -> FittedModel:
+        if stats is None:
+            model = self.fit_columns(store)
+        else:
+            model = self.fit_columns_from_stats(store, stats)
         model.uid = self.uid
         model.parent_estimator_uid = self.uid
         model.input_features = self.input_features
@@ -295,6 +310,22 @@ class Estimator(OpPipelineStage):
 
     def fit_columns(self, store: ColumnStore) -> FittedModel:
         raise NotImplementedError
+
+    # -- fused fit-statistics protocol (fitstats.py) -----------------------
+    def stat_requests(self, store: ColumnStore):
+        """Sufficient statistics this estimator needs to fit, as a list
+        of ``fitstats.StatRequest`` — or None to stay on the sequential
+        ``fit_columns`` path (the default). An EMPTY list is a valid
+        opt-in meaning "no data needed" (constant-fill vectorizers)."""
+        return None
+
+    def fit_columns_from_stats(self, store: ColumnStore,
+                               stats: Any) -> FittedModel:
+        """Finalize a fitted model from the layer pass's stats — must
+        produce the identical model ``fit_columns`` would."""
+        raise NotImplementedError(
+            f"{type(self).__name__} declares stat_requests but not "
+            "fit_columns_from_stats")
 
 
 class LambdaTransformer(Transformer):

@@ -513,6 +513,13 @@ class RunListener:
                      warm_started: bool = False, **_: Any) -> None:
         pass
 
+    def on_stats_pass(self, layer: int, n_stages: int, n_requests: int,
+                      passes_saved: int, seconds: float = 0.0,
+                      **_: Any) -> None:
+        """One fused fit-statistics pass fed a whole DAG layer's
+        estimators (fitstats.py, the SequenceAggregators analog)."""
+        pass
+
     def on_score_batch(self, n_rows: int, bucket: int, seconds: float,
                        compiled: bool = False, **_: Any) -> None:
         pass
@@ -578,6 +585,8 @@ class CollectingRunListener(RunListener):
         self.compiled_batches = 0
         self.compile_events = 0
         self.compile_seconds = 0.0
+        self.stats_passes = 0
+        self.fit_passes_saved = 0
         self._lock = threading.Lock()
 
     def on_run_start(self, run_type: str, **_: Any) -> None:
@@ -607,6 +616,14 @@ class CollectingRunListener(RunListener):
                 "executeSeconds": round(execute_s, 4),
                 "warmStarted": warm_started}
 
+    def on_stats_pass(self, layer: int, n_stages: int, n_requests: int,
+                      passes_saved: int, seconds: float = 0.0,
+                      **_: Any) -> None:
+        with self._lock:
+            self.events.append("stats_pass")
+            self.stats_passes += 1
+            self.fit_passes_saved += int(passes_saved)
+
     def on_score_batch(self, n_rows: int, bucket: int, seconds: float,
                        compiled: bool = False, **_: Any) -> None:
         with self._lock:
@@ -635,6 +652,8 @@ class CollectingRunListener(RunListener):
                 "compiledBatches": self.compiled_batches,
                 "compileEvents": self.compile_events,
                 "compileSeconds": round(self.compile_seconds, 4),
+                "statsPasses": self.stats_passes,
+                "fitPassesSaved": self.fit_passes_saved,
             }
 
 

@@ -21,6 +21,7 @@ Re-designs ``OpWorkflow`` / ``OpWorkflowModel`` / ``FitStagesUtil``
 """
 from __future__ import annotations
 
+import copy as _copy
 import json
 import logging
 import os
@@ -434,6 +435,66 @@ class Workflow:
                     transform_last)
         return fitted, time.perf_counter() - t0, train, test
 
+    def _layer_stats_pass(self, li: int, layer: Sequence[OpPipelineStage],
+                          train: ColumnStore):
+        """The fused fit-statistics pass (fitstats.py, the
+        SequenceAggregators analog): collect every opted-in estimator's
+        StatRequests for this layer and compute them in ONE pass over
+        the train store, so each ``fit`` becomes a host-side finalize.
+        Returns (StatResults | None, set of fused stage uids). Any
+        failure degrades to the sequential per-stage fits — the fused
+        pass is an optimization, never a correctness dependency."""
+        from . import fitstats
+        if not fitstats.FITSTATS_ENABLED:
+            return None, set()
+        requests: Dict[str, list] = {}
+        for stage in layer:
+            if not isinstance(stage, Estimator) \
+                    or self._warm_stages.get(stage.uid) is not None:
+                continue
+            try:
+                reqs = stage.stat_requests(train)
+            except Exception:
+                logger.exception(
+                    "stat_requests failed for %s; it fits sequentially",
+                    stage.stage_name())
+                reqs = None
+            if reqs is not None:
+                requests[stage.uid] = list(reqs)
+        # only stages whose requests actually SCAN data count toward the
+        # pass math — an empty opt-in (constant-fill vectorizers) never
+        # scanned sequentially either, so it saves nothing and must not
+        # inflate the passes_saved/layers_fused tallies
+        n_scanning = sum(1 for reqs in requests.values() if reqs)
+        if n_scanning < fitstats.FITSTATS_MIN_STAGES:
+            return None, set()
+        try:
+            plan = fitstats.LayerStatsPlan(
+                [r for reqs in requests.values() for r in reqs],
+                n_stages=n_scanning)
+            tp = time.perf_counter()
+            with telemetry.span("fit:stats_pass", layer=li,
+                                stages=n_scanning,
+                                requests=plan.n_requests,
+                                rows=train.n_rows):
+                stats = plan.run(train)
+            telemetry.emit("stats_pass", layer=li,
+                           n_stages=n_scanning,
+                           n_requests=plan.n_requests,
+                           passes_saved=n_scanning - 1,
+                           seconds=time.perf_counter() - tp)
+            logger.info(
+                "layer %d: fused stats pass fed %d estimator(s) "
+                "(%d request(s)) in %.2fs",
+                li, len(requests), plan.n_requests,
+                time.perf_counter() - tp)
+            return stats, set(requests)
+        except Exception:
+            logger.exception(
+                "layer %d: fused fit-stats pass failed; estimators fit "
+                "sequentially", li)
+            return None, set()
+
     def _fit_layer(self, li: int, layer: Sequence[OpPipelineStage],
                    dag: StagesDAG, train: ColumnStore,
                    test: Optional[ColumnStore],
@@ -445,6 +506,7 @@ class Workflow:
         returns the transformed (train, test) stores."""
         models: List[Transformer] = []
         n_fitted_before = len(fitted)
+        layer_stats, fused_uids = self._layer_stats_pass(li, layer, train)
         for stage in layer:
             metrics = self._stage_metrics.setdefault(
                 stage.uid, {"stageName": stage.stage_name()})
@@ -455,7 +517,6 @@ class Workflow:
                     # by uid. Shallow-copy before rebinding wiring so
                     # the donor WorkflowModel's stages stay intact
                     # (fitted state/arrays are shared read-only).
-                    import copy as _copy
                     model = _copy.copy(warm)
                     model.input_features = stage.input_features
                     model._output_feature = stage.get_output()
@@ -473,10 +534,18 @@ class Workflow:
                                 train.n_rows)
                     tf = time.perf_counter()
                     c0 = _COMPILE_CLOCK["s"]
+                    fused = layer_stats is not None \
+                        and stage.uid in fused_uids
                     with telemetry.span("fit:stage", uid=stage.uid,
                                         stage=stage.stage_name(),
-                                        layer=li):
-                        model = stage.fit(train)
+                                        layer=li, fused=fused):
+                        # positional call when not fused: stages that
+                        # override fit(store) (dt_bucketizer) never see
+                        # the stats kwarg
+                        model = (stage.fit(train, stats=layer_stats)
+                                 if fused else stage.fit(train))
+                    if fused:
+                        metrics["fusedStats"] = True
                     fit_s = time.perf_counter() - tf
                     # clamp: concurrent compiles sum WORK > wall-clock
                     compile_s = min(_COMPILE_CLOCK["s"] - c0, fit_s)
