@@ -25,6 +25,16 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 "
+        "suite (-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection tests (seeded "
+        "resilience.FaultPlan, no real sleeps > 0.1s — tier-1 safe; "
+        "run just these with -m chaos)")
+
+
 @pytest.fixture(autouse=True)
 def _reset_uids():
     from transmogrifai_tpu.utils import uid

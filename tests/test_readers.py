@@ -216,7 +216,7 @@ def test_directory_stream_reader_error_paths(tmp_path, caplog):
     with caplog.at_level(logging.WARNING):
         batches = list(r.stream(max_batches=1, timeout_s=3.0))
     assert batches == [[{"x": "1"}]]          # corrupt a.avro skipped
-    assert any("skipping unreadable" in rec.message
+    assert any("quarantining unreadable" in rec.message
                for rec in caplog.records)
     assert r.poll_once() == []                # corrupt file not retried
 

@@ -1,0 +1,908 @@
+"""Resilience layer tests — fault injection, retries, breakers,
+quarantine, resumable fits (transmogrifai_tpu/resilience.py + wiring).
+
+The ``chaos`` subset is deterministic (seeded FaultPlan, no real sleeps
+over 0.1s) and tier-1 safe; run just it with ``-m chaos``.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, Workflow, resilience
+from transmogrifai_tpu.columns import ColumnStore, column_from_values
+from transmogrifai_tpu.types import feature_types as ft
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    """Every test starts from a clean plan/breaker/sink/tally state and
+    leaves none behind (the module state is process-wide)."""
+    resilience.clear_plan()
+    resilience.reset_breakers()
+    prev = resilience.set_quarantine(None)
+    resilience.reset_resilience_stats()
+    yield
+    resilience.clear_plan()
+    resilience.reset_breakers()
+    resilience.set_quarantine(prev)
+    resilience.reset_resilience_stats()
+
+
+# ---------------------------------------------------------------------------
+# fault-injection harness
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_at_indices_and_times():
+    plan = resilience.FaultPlan(seed=1).on(
+        "site.a", error=ValueError, at=[0, 2])
+    with resilience.fault_plan(plan):
+        with pytest.raises(ValueError, match="site.a"):
+            resilience.inject("site.a")
+        resilience.inject("site.a")          # call 1: clean
+        with pytest.raises(ValueError):
+            resilience.inject("site.a")
+        resilience.inject("site.a")          # call 3: clean
+        resilience.inject("site.unknown")    # unarmed site: no-op
+    assert plan.calls("site.a") == 4
+    assert plan.fired("site.a") == 2
+    assert resilience.resilience_stats()["faults_injected"] == 2
+    # uninstalled plan: inject is a no-op even for armed sites
+    resilience.inject("site.a")
+    assert plan.calls("site.a") == 4
+
+
+def test_fault_plan_probability_is_seed_deterministic():
+    fires = []
+    for _ in range(2):
+        plan = resilience.FaultPlan(seed=77).on(
+            "s", error=OSError, probability=0.5)
+        fires.append([plan.check("s") is not None for _ in range(40)])
+    assert fires[0] == fires[1]
+    assert 0 < sum(fires[0]) < 40          # actually probabilistic
+    # times= caps fires even at probability 1
+    plan = resilience.FaultPlan(seed=0).on("s", probability=1.0, times=2)
+    assert sum(plan.check("s") is not None for _ in range(10)) == 2
+
+
+def test_fault_plan_error_instance_is_raised_verbatim():
+    sentinel = RuntimeError("the exact instance")
+    plan = resilience.FaultPlan().on("s", error=sentinel, at=[0])
+    with resilience.fault_plan(plan):
+        with pytest.raises(RuntimeError) as ei:
+            resilience.inject("s")
+    assert ei.value is sentinel
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+
+def test_retry_succeeds_after_transient_failures():
+    sleeps = []
+    pol = resilience.RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                                 seed=5, sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert pol.call("t", flaky) == "ok"
+    assert calls["n"] == 3 and len(sleeps) == 2
+    stats = resilience.resilience_stats()
+    assert stats["retries"] == 2 and stats["retry_exhausted"] == 0
+
+
+def test_retry_exhausts_and_reraises_original():
+    pol = resilience.RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                                 sleep=lambda _d: None)
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise OSError("persistent")
+
+    with pytest.raises(OSError, match="persistent"):
+        pol.call("t", always)
+    assert calls["n"] == 3
+    assert resilience.resilience_stats()["retry_exhausted"] == 1
+
+
+def test_retry_filter_skips_nonretryable():
+    pol = resilience.RetryPolicy(max_attempts=5, retryable=(OSError,),
+                                 sleep=lambda _d: None)
+    calls = {"n": 0}
+
+    def corrupt():
+        calls["n"] += 1
+        raise ValueError("decode error — not transient")
+
+    with pytest.raises(ValueError):
+        pol.call("t", corrupt)
+    assert calls["n"] == 1                   # no retry for a decode error
+
+
+def test_retry_backoff_is_exponential_capped_and_seeded():
+    pol = resilience.RetryPolicy(max_attempts=9, base_delay_s=0.1,
+                                 max_delay_s=0.9, multiplier=2.0,
+                                 jitter=0.5, seed=11)
+    pol2 = resilience.RetryPolicy(max_attempts=9, base_delay_s=0.1,
+                                  max_delay_s=0.9, multiplier=2.0,
+                                  jitter=0.5, seed=11)
+    d1 = [pol.delay_s(a) for a in range(6)]
+    assert d1 == [pol2.delay_s(a) for a in range(6)]     # seeded = replay
+    for a, d in enumerate(d1):
+        raw = min(0.1 * 2 ** a, 0.9)
+        assert 0.5 * raw <= d <= 1.5 * raw               # jitter bounds
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_trips_after_threshold_and_half_open_recovers():
+    b = resilience.CircuitBreaker("t", failure_threshold=3,
+                                  reset_timeout_s=0.02)
+    for _ in range(2):
+        b.record_failure()
+    assert b.state == b.CLOSED and b.allow()
+    b.record_failure()
+    assert b.state == b.OPEN
+    assert not b.allow()                       # open: fallback serves
+    import time
+    time.sleep(0.03)
+    assert b.allow()                           # the half-open probe
+    assert b.state == b.HALF_OPEN
+    assert not b.allow()                       # only ONE probe in flight
+    b.record_success()
+    assert b.state == b.CLOSED and b.allow()
+    stats = resilience.resilience_stats()
+    assert stats["breaker_trips"] == 1
+    assert stats["breaker_open_skips"] >= 2
+
+
+def test_breaker_half_open_probe_timeout_rearms():
+    """A probe handed out but never reported (its caller bailed on a
+    later gate) must not wedge the tier: after another reset period the
+    next caller becomes the probe."""
+    import time
+    b = resilience.CircuitBreaker("t", failure_threshold=1,
+                                  reset_timeout_s=0.01)
+    b.record_failure()
+    time.sleep(0.02)
+    assert b.allow()                           # probe 1: never reports
+    assert not b.allow()                       # in flight: held
+    time.sleep(0.02)
+    assert b.allow()                           # re-armed probe
+    b.record_success()
+    assert b.state == b.CLOSED
+
+
+def test_breaker_half_open_failure_reopens():
+    b = resilience.CircuitBreaker("t", failure_threshold=1,
+                                  reset_timeout_s=0.01)
+    b.record_failure()
+    assert b.state == b.OPEN
+    import time
+    time.sleep(0.02)
+    assert b.allow()
+    b.record_failure()                         # probe failed
+    assert b.state == b.OPEN
+    assert resilience.resilience_stats()["breaker_trips"] == 2
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = resilience.CircuitBreaker("t", failure_threshold=3)
+    b.record_failure(); b.record_failure()
+    b.record_success()
+    b.record_failure(); b.record_failure()
+    assert b.state == b.CLOSED                 # never 3 consecutive
+
+
+# ---------------------------------------------------------------------------
+# quarantine sink
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_jsonl_format_and_counters(tmp_path):
+    sink = resilience.set_quarantine(str(tmp_path / "dead.jsonl"))
+    assert sink is None                        # returns previous
+    resilience.quarantine("stream.read_file", "AvroDecodeError('x')",
+                          kind="files", path="/data/a.avro")
+    resilience.quarantine("stream.score_batch", "OSError('y')",
+                          kind="batches", index=3, rows=128)
+    entries = resilience.get_quarantine().entries()
+    assert len(entries) == 2
+    assert entries[0]["site"] == "stream.read_file"
+    assert entries[0]["kind"] == "files"
+    assert entries[0]["path"] == "/data/a.avro"
+    assert entries[0]["reason"].startswith("AvroDecodeError")
+    assert entries[1]["index"] == 3 and entries[1]["rows"] == 128
+    assert all("ts" in e for e in entries)
+    stats = resilience.resilience_stats()
+    assert stats["quarantined_files"] == 1
+    assert stats["quarantined_batches"] == 1
+    # every line is standalone JSON (the contract downstream tooling has)
+    with open(tmp_path / "dead.jsonl") as fh:
+        for line in fh:
+            json.loads(line)
+
+
+def test_quarantine_counts_without_sink():
+    resilience.quarantine("s", "r", kind="records", count=5)
+    assert resilience.resilience_stats()["quarantined_records"] == 5
+
+
+# ---------------------------------------------------------------------------
+# streaming reader wiring (satellite: streaming.py:112)
+# ---------------------------------------------------------------------------
+
+
+def _write_csv(path, rows):
+    with open(path, "w") as fh:
+        fh.write("label,x\n")
+        for r in rows:
+            fh.write(f"{r[0]},{r[1]}\n")
+
+
+@pytest.mark.chaos
+def test_stream_reader_quarantines_unreadable_file(tmp_path):
+    from transmogrifai_tpu.readers import DirectoryStreamReader
+    d = tmp_path / "in"
+    d.mkdir()
+    _write_csv(d / "a.csv", [(1, 2.0)])
+    (d / "b.avro").write_bytes(b"Obj\x01garbage-not-avro")   # poison
+    _write_csv(d / "c.csv", [(0, 3.0)])
+    resilience.set_quarantine(str(tmp_path / "dead.jsonl"))
+    rdr = DirectoryStreamReader(str(d), settle_s=0.0)
+    batches = rdr.poll_once()
+    assert len(batches) == 2                   # both good files served
+    stats = resilience.resilience_stats()
+    assert stats["quarantined_files"] == 1
+    entries = resilience.get_quarantine().entries()
+    assert entries[0]["path"].endswith("b.avro")
+    assert "b.avro" in entries[0]["reason"]    # decode error names file
+    # the poison file is marked seen: a later poll does not re-offer it
+    assert rdr.poll_once() == []
+    assert resilience.resilience_stats()["quarantined_files"] == 1
+
+
+@pytest.mark.chaos
+def test_stream_reader_retries_transient_io_then_succeeds(tmp_path):
+    from transmogrifai_tpu.readers import DirectoryStreamReader
+    d = tmp_path / "in"
+    d.mkdir()
+    _write_csv(d / "a.csv", [(1, 2.0)])
+    plan = resilience.FaultPlan(seed=2).on(
+        "stream.read_file", error=OSError, at=[0])   # transient: once
+    with resilience.fault_plan(plan):
+        rdr = DirectoryStreamReader(str(d), settle_s=0.0)
+        batches = rdr.poll_once()
+    assert len(batches) == 1                   # retry absorbed the fault
+    stats = resilience.resilience_stats()
+    assert stats["retries"] == 1
+    assert stats["quarantined_files"] == 0
+
+
+def test_avro_decode_error_names_file(tmp_path):
+    """Truncated container → AvroDecodeError carrying the path, whatever
+    low-level exception the cursor hit (satellite: descriptive decode
+    errors)."""
+    from transmogrifai_tpu.readers.avro import (AvroDecodeError,
+                                                read_avro_records,
+                                                write_avro_records)
+    p = str(tmp_path / "t.avro")
+    write_avro_records(p, [{"a": 1, "b": "x"}] * 20)
+    whole = open(p, "rb").read()
+    for cut in (10, len(whole) // 2, len(whole) - 3):
+        bad = str(tmp_path / f"cut{cut}.avro")
+        with open(bad, "wb") as fh:
+            fh.write(whole[:cut])
+        with pytest.raises(AvroDecodeError, match=f"cut{cut}"):
+            read_avro_records(bad)
+
+
+# ---------------------------------------------------------------------------
+# a small 3-layer workflow shared by the chaos tests
+# ---------------------------------------------------------------------------
+
+
+def _records(n=120, seed=42):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, 2, n).astype(float)
+    x = rng.normal(size=n) + y
+    z = rng.normal(size=n) - y
+    return [{"label": float(y[i]), "x": float(x[i]), "z": float(z[i])}
+            for i in range(n)]
+
+
+def _three_layer_workflow():
+    """vectorize → sanity-check → selector: three fitted DAG layers."""
+    from transmogrifai_tpu.dsl import transmogrify
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import (
+        BinaryClassificationModelSelector)
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    fz = FeatureBuilder.Real("z").from_column().as_predictor()
+    vec = transmogrify([fx, fz])
+    checked = label.sanity_check(vec)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None)
+    pred = label.transform_with(selector, checked)
+    return pred
+
+
+def _train(records, pred):
+    return (Workflow().set_input_records(records)
+            .set_result_features(pred).train())
+
+
+# ---------------------------------------------------------------------------
+# chaos: IO fault on batch k of stream_score → quarantined, rest exact
+# (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_stream_score_quarantines_faulted_batch_rest_bit_identical(
+        tmp_path):
+    from transmogrifai_tpu.readers import stream_score
+    records = _records()
+    pred = _three_layer_workflow()
+    model = _train(records, pred)
+    batches = [records[i:i + 30] for i in range(0, len(records), 30)]
+
+    clean = [s[pred.name].prediction.copy()
+             for s in stream_score(model, batches)]
+    assert len(clean) == 4
+
+    resilience.set_quarantine(str(tmp_path / "dead.jsonl"))
+    k = 2
+    plan = resilience.FaultPlan(seed=13).on(
+        "stream.score_batch", error=IOError, at=[k])
+    with resilience.fault_plan(plan):
+        faulted = [s[pred.name].prediction.copy()
+                   for s in stream_score(model, batches)]
+
+    # the stream completed with exactly the bad batch missing...
+    assert len(faulted) == len(clean) - 1
+    survivors = [c for i, c in enumerate(clean) if i != k]
+    # ...and every good batch's scores are bit-identical
+    for got, want in zip(faulted, survivors):
+        np.testing.assert_array_equal(got, want)
+    stats = resilience.resilience_stats()
+    assert stats["quarantined_batches"] == 1
+    entry = resilience.get_quarantine().entries()[0]
+    assert entry["site"] == "stream.score_batch"
+    assert entry["index"] == k and entry["rows"] == 30
+    # the dead letter is replayable: the batch's records ride in it (a
+    # consumed stream batch exists nowhere else)
+    assert entry["records"] == batches[k]
+
+
+def test_stream_score_on_error_raise_propagates():
+    from transmogrifai_tpu.readers import stream_score
+    records = _records(60)
+    pred = _three_layer_workflow()
+    model = _train(records, pred)
+    batches = [records[i:i + 20] for i in range(0, 60, 20)]
+    plan = resilience.FaultPlan().on("stream.score_batch",
+                                     error=IOError, at=[1])
+    with resilience.fault_plan(plan):
+        with pytest.raises(IOError):
+            list(stream_score(model, batches, on_error="raise"))
+
+
+def test_stream_score_first_batch_failure_always_raises(tmp_path):
+    """A head-of-stream failure is a configuration error, not poison —
+    quarantining every batch of a misconfigured stream would be silence
+    at scale. Holds even with a sink installed (quarantine mode)."""
+    from transmogrifai_tpu.readers import stream_score
+    records = _records(60)
+    pred = _three_layer_workflow()
+    model = _train(records, pred)
+    batches = [records[i:i + 20] for i in range(0, 60, 20)]
+    resilience.set_quarantine(str(tmp_path / "dead.jsonl"))
+    plan = resilience.FaultPlan().on("stream.score_batch",
+                                     error=IOError, at=[0])
+    with resilience.fault_plan(plan):
+        with pytest.raises(IOError):
+            list(stream_score(model, batches))   # sink → quarantine mode
+    assert resilience.resilience_stats()["quarantined_batches"] == 0
+
+
+def test_stream_score_without_sink_stays_loud():
+    """The sink-aware default: with NO dead-letter sink installed a
+    poison batch re-raises even mid-stream — a quarantined batch whose
+    records land nowhere would be silent data loss."""
+    from transmogrifai_tpu.readers import stream_score
+    records = _records(60)
+    pred = _three_layer_workflow()
+    model = _train(records, pred)
+    batches = [records[i:i + 20] for i in range(0, 60, 20)]
+    assert resilience.get_quarantine() is None
+    plan = resilience.FaultPlan().on("stream.score_batch",
+                                     error=IOError, at=[1])
+    with resilience.fault_plan(plan):
+        with pytest.raises(IOError):
+            list(stream_score(model, batches))
+    assert resilience.resilience_stats()["quarantined_batches"] == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos: preemption after layer 1 of a 3-layer fit → resumable
+# (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_preempted_fit_resumes_and_matches_uninterrupted(tmp_path):
+    records = _records()
+    pred = _three_layer_workflow()
+    baseline = _train(records, pred)
+    store = baseline.score(records)
+    want = store[pred.name].prediction.copy()
+
+    ckpt = str(tmp_path / "ckpt")
+    # preempt DURING the second layer's checkpoint swap: layer 0's
+    # checkpoint completed, layer 1 is fitted but its swap is mid-rename
+    # — the worst window (target dir renamed away, .tmp complete)
+    plan = resilience.FaultPlan(seed=4).on(
+        "checkpoint.rename", error=RuntimeError("preempted"), at=[1])
+    wf = (Workflow().set_input_records(records)
+          .set_result_features(pred).with_checkpointing(ckpt))
+    with resilience.fault_plan(plan):
+        with pytest.raises(RuntimeError, match="preempted"):
+            wf.train()
+    assert os.path.exists(ckpt + ".tmp")       # the mid-swap state
+
+    # resume: recovers the mid-swap checkpoint, skips layers 0-1, refits
+    # only what the preemption interrupted
+    wf2 = (Workflow().set_input_records(records)
+           .set_result_features(pred))
+    resumed = wf2.fit(resume_from=ckpt)
+    warm = [uid for uid, m in resumed.stage_metrics.items()
+            if m.get("warmStarted")]
+    assert warm                                # something was skipped
+    got = resumed.score(records)[pred.name].prediction
+    np.testing.assert_array_equal(got, want)
+    assert resilience.resilience_stats()["resumed_fits"] == 1
+
+
+@pytest.mark.chaos
+def test_fit_resume_from_missing_checkpoint_is_fresh_fit(tmp_path):
+    records = _records(80)
+    pred = _three_layer_workflow()
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred)
+             .fit(resume_from=str(tmp_path / "never_written")))
+    assert model.score(records).n_rows == 80
+    assert resilience.resilience_stats()["resumed_fits"] == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint robustness (satellite: rename race + leftover .tmp cleanup)
+# ---------------------------------------------------------------------------
+
+
+def _save_small_model(tmp_path, name="m"):
+    store = ColumnStore({"x": column_from_values(
+        ft.Real, [0.1, 0.2, 0.3, 0.4])})
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    model = (Workflow().set_input_store(store)
+             .set_result_features(fx).train())
+    path = str(tmp_path / name)
+    model.save(path)
+    return model, path
+
+
+def test_concurrent_recover_checkpoint_rename_race(tmp_path):
+    """Two recoverers racing on one mid-swap dir: exactly one wins the
+    rename, both resolve to a loadable target (satellite: the
+    FileNotFoundError retry branch in _recover_checkpoint)."""
+    import shutil
+
+    from transmogrifai_tpu import model_io
+
+    for round_ in range(5):
+        _model, path = _save_small_model(tmp_path, f"m{round_}")
+        # mid-swap: target renamed away, complete .tmp waiting
+        shutil.copytree(path, path + ".old")
+        os.rename(path, path + ".tmp")
+
+        results, errors = [], []
+        barrier = threading.Barrier(2)
+
+        def recover():
+            try:
+                barrier.wait()
+                results.append(model_io._recover_checkpoint(path))
+            except Exception as e:      # pragma: no cover - the failure
+                errors.append(e)
+
+        ts = [threading.Thread(target=recover) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors
+        assert results == [path, path]
+        assert os.path.exists(os.path.join(path, model_io.MODEL_JSON))
+        from transmogrifai_tpu.workflow import WorkflowModel
+        assert WorkflowModel.load(path).result_features[0].name == "x"
+
+
+@pytest.mark.chaos
+def test_crash_mid_checkpoint_leftover_tmp_is_cleaned(tmp_path):
+    """A kill between the save into .tmp and the swap leaves a complete
+    .tmp next to the intact target; the NEXT checkpoint cycle must adopt
+    nothing stale, clean the leftover and land the new save (satellite:
+    crash-mid-_atomic_checkpoint cleanup)."""
+    from transmogrifai_tpu import model_io
+    from transmogrifai_tpu.workflow import WorkflowModel, _atomic_checkpoint
+
+    model, path = _save_small_model(tmp_path)
+
+    # crash AFTER the tmp save, BEFORE any rename: rename(directory, old)
+    # never ran, so the target is intact and .tmp is a complete orphan
+    plan = resilience.FaultPlan().on(
+        "checkpoint.rename", error=RuntimeError("killed"), at=[0])
+    with resilience.fault_plan(plan):
+        with pytest.raises(RuntimeError, match="killed"):
+            _atomic_checkpoint(model, path)
+    # the fault fired between rename(directory, old) and rename(tmp,
+    # directory): mid-swap, .tmp complete — recoverable by load
+    assert os.path.exists(path + ".tmp")
+    assert WorkflowModel.load(path).result_features[0].name == "x"
+
+    # ALSO: a torn .tmp (no model.json — crash mid-save) must never be
+    # adopted, and the next full checkpoint clears every leftover
+    import shutil
+    shutil.rmtree(path + ".tmp", ignore_errors=True)
+    os.makedirs(path + ".tmp")
+    with open(os.path.join(path + ".tmp", "weights-torn.npz"), "wb") as fh:
+        fh.write(b"partial")
+    _atomic_checkpoint(model, path)
+    assert not os.path.exists(path + ".tmp")
+    assert not os.path.exists(path + ".old")
+    assert os.path.exists(os.path.join(path, model_io.MODEL_JSON))
+
+
+@pytest.mark.chaos
+def test_checkpoint_write_retries_transient_io(tmp_path):
+    from transmogrifai_tpu.workflow import WorkflowModel, _atomic_checkpoint
+
+    model, path = _save_small_model(tmp_path)
+    plan = resilience.FaultPlan().on(
+        "checkpoint.write", error=OSError, at=[0])    # transient
+    with resilience.fault_plan(plan):
+        _atomic_checkpoint(model, path)               # absorbed by retry
+    assert resilience.resilience_stats()["retries"] == 1
+    assert WorkflowModel.load(path).result_features[0].name == "x"
+
+
+# ---------------------------------------------------------------------------
+# device-tier breakers (workflow engine + fitstats)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_engine_breaker_trips_to_host_tier_and_scores_survive():
+    """Persistent device-dispatch faults: every score still succeeds via
+    the per-layer host fallback, and after the threshold the breaker
+    stops routing through the failing engine at all."""
+    records = _records()
+    pred = _three_layer_workflow()
+    model = _train(records, pred)
+    store_fn = lambda: model.score(records, engine=True)  # noqa: E731
+
+    want = store_fn()[pred.name].prediction.copy()
+    plan = resilience.FaultPlan().on("scoring.device_dispatch",
+                                     error=IOError, probability=1.0)
+    # per-model breaker held on the instance: one model's failing
+    # engine must not downgrade other models in the process
+    brk = model._engine_breaker()
+    with resilience.fault_plan(plan):
+        for _ in range(4):
+            got = store_fn()[pred.name].prediction
+            np.testing.assert_array_equal(got, want)
+    assert brk.state == brk.OPEN
+    fired_while_open = plan.fired("scoring.device_dispatch")
+    # breaker open: the engine is not even attempted any more
+    with resilience.fault_plan(plan):
+        np.testing.assert_array_equal(
+            store_fn()[pred.name].prediction, want)
+    assert plan.fired("scoring.device_dispatch") == fired_while_open
+    assert resilience.resilience_stats()["breaker_trips"] == 1
+    # faults gone + breaker reset: the device tier serves again
+    brk.reset()
+    np.testing.assert_array_equal(store_fn()[pred.name].prediction, want)
+    assert brk.state == brk.CLOSED
+
+
+@pytest.mark.chaos
+def test_failed_engine_build_retries_under_breaker(monkeypatch):
+    """A failed engine BUILD is a breaker-governed attempt, not a
+    permanent death sentence: attempts stop once the breaker opens, and
+    the half-open probe rebuilds after the reset timeout."""
+    import time
+
+    import transmogrifai_tpu.scoring as sc
+
+    records = _records()
+    pred = _three_layer_workflow()
+    model = _train(records, pred)
+    real = sc.ScoringEngine
+    builds = {"n": 0}
+
+    class Boom:
+        def __init__(self, *a, **k):
+            builds["n"] += 1
+            raise RuntimeError("transient build failure")
+
+    monkeypatch.setattr(sc, "ScoringEngine", Boom)
+    brk = model._engine_breaker()
+    brk.reset_timeout_s = 0.02
+    for _ in range(6):
+        assert model.score(records, engine=True).n_rows == len(records)
+    assert builds["n"] == 3            # no more builds once OPEN
+    assert brk.state == brk.OPEN
+    time.sleep(0.03)
+    monkeypatch.setattr(sc, "ScoringEngine", real)
+    model.score(records, engine=True)  # the probe rebuilds + dispatches
+    assert brk.state == brk.CLOSED
+    assert model.scoring_engine() is not None
+
+
+@pytest.mark.chaos
+def test_overlapped_device_failure_falls_back_to_host_not_quarantine(
+        tmp_path):
+    """In the overlapped scorer a device compute failure is a TIER
+    failure: the batch retries on the per-layer host path and nothing is
+    quarantined — every row still gets scored."""
+    from transmogrifai_tpu.readers import stream_score
+    records = _records()
+    pred = _three_layer_workflow()
+    model = _train(records, pred)
+    batches = [records[i:i + 30] for i in range(0, len(records), 30)]
+    clean = [s[pred.name].probability.copy()
+             for s in stream_score(model, batches, overlap=True)]
+    resilience.set_quarantine(str(tmp_path / "dead.jsonl"))
+    plan = resilience.FaultPlan().on("scoring.device_dispatch",
+                                     error=IOError, probability=1.0)
+    with resilience.fault_plan(plan):
+        faulted = [s[pred.name].probability.copy()
+                   for s in stream_score(model, batches, overlap=True)]
+    assert len(faulted) == len(clean)          # no batch lost
+    for got, want in zip(faulted, clean):
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    stats = resilience.resilience_stats()
+    assert stats["quarantined_batches"] == 0
+    assert stats["breaker_trips"] == 1         # tier reported, not hidden
+
+
+@pytest.mark.chaos
+def test_fitstats_device_fault_degrades_to_host_within_pass(monkeypatch):
+    """A failing fitstats device pass must not lose the fused scan: the
+    SAME pass re-runs on the host tier and the fitted stats match the
+    clean run bit-for-bit (host tier is the bit-exact twin)."""
+    from transmogrifai_tpu import fitstats
+
+    rng = np.random.default_rng(3)
+    store = ColumnStore({
+        "a": column_from_values(ft.Real, list(rng.normal(size=500))),
+        "b": column_from_values(ft.Real, list(rng.normal(size=500))),
+    })
+    reqs = [fitstats.StatRequest("mean", "a"),
+            fitstats.StatRequest("std", "a", params=(0,)),
+            fitstats.StatRequest("mean", "b"),
+            fitstats.StatRequest("count", "b")]
+    plan_clean = fitstats.LayerStatsPlan(reqs, n_stages=2)
+    clean = plan_clean.run(store, device=False)
+
+    fault = resilience.FaultPlan().on("fitstats.device_pass",
+                                      error=IOError, probability=1.0)
+    with resilience.fault_plan(fault):
+        faulted = fitstats.LayerStatsPlan(reqs, n_stages=2).run(
+            store, device=True)
+    for r in reqs:
+        assert faulted.for_request(r) == clean.for_request(r)
+    assert resilience.breaker("fitstats.device").consecutive_failures == 1
+    # two more failures trip the breaker; the gate then refuses device
+    for _ in range(2):
+        with resilience.fault_plan(fault):
+            fitstats.LayerStatsPlan(reqs, n_stages=2).run(store,
+                                                          device=True)
+    assert resilience.breaker("fitstats.device").state == "open"
+    monkeypatch.setattr("transmogrifai_tpu.workflow._DEVICE_BW_MBPS",
+                        1e9)
+    monkeypatch.setattr("transmogrifai_tpu.workflow.FUSE_MIN_ROWS", 1)
+    assert not fitstats.LayerStatsPlan(reqs)._gate_device(store)
+
+
+# ---------------------------------------------------------------------------
+# runner satellites: numeric param validation, quarantine sink, run doc
+# ---------------------------------------------------------------------------
+
+
+def _score_runner(records, pred, model_dir):
+    from transmogrifai_tpu.readers import DataReaders
+    from transmogrifai_tpu.runner import OpWorkflowRunner
+    wf = Workflow().set_result_features(pred)
+    return OpWorkflowRunner(
+        wf, training_reader=DataReaders.simple.records(records),
+        scoring_reader=DataReaders.simple.records(records))
+
+
+def test_runner_validates_numeric_custom_params(tmp_path):
+    from transmogrifai_tpu.runner import OpParams, RunType
+
+    records = _records(60)
+    pred = _three_layer_workflow()
+    model = _train(records, pred)
+    mdir = str(tmp_path / "model")
+    model.save(mdir)
+    runner = _score_runner(records, pred, mdir)
+
+    for key, val, match in [
+            ("timeoutS", "soon", "customParams.timeoutS"),
+            ("maxBatches", "many", "customParams.maxBatches"),
+            ("maxBatches", 2.5, "customParams.maxBatches"),
+            ("maxBatches", 0, "customParams.maxBatches"),
+            ("batchSize", -5, "customParams.batchSize"),
+            ("batchSize", "lots", "customParams.batchSize"),
+            # NaN slips past any `v < minimum` check and an inf/nan
+            # timeoutS hangs the stream's exit test forever
+            ("timeoutS", float("nan"), "customParams.timeoutS"),
+            ("timeoutS", float("inf"), "customParams.timeoutS"),
+            # int(1e400) raises OverflowError, not ValueError — JSON
+            # happily parses huge floats
+            ("maxBatches", float("inf"), "customParams.maxBatches")]:
+        params = OpParams(model_location=mdir, custom_params={key: val})
+        with pytest.raises(ValueError, match=match):
+            runner.run(RunType.STREAMING_SCORE, params)
+    # valid values still work, including numeric strings; an explicit
+    # JSON null means "use the default", same as omitting the key
+    for cp in ({"batchSize": "30"}, {"batchSize": None},
+               {"timeoutS": None, "maxBatches": None}):
+        params = OpParams(model_location=mdir, custom_params=cp)
+        res = runner.run("StreamingScore", params)
+        assert res.metrics["rowsScored"] == 60
+
+
+@pytest.mark.chaos
+def test_runner_streaming_score_stamps_quarantine_counts(tmp_path):
+    from transmogrifai_tpu.runner import OpParams, RunType
+
+    records = _records()
+    pred = _three_layer_workflow()
+    model = _train(records, pred)
+    mdir = str(tmp_path / "model")
+    model.save(mdir)
+    runner = _score_runner(records, pred, mdir)
+    qfile = str(tmp_path / "dead.jsonl")
+    plan = resilience.FaultPlan(seed=6).on(
+        "stream.score_batch", error=IOError, at=[1])
+    params = OpParams(model_location=mdir, quarantine_location=qfile,
+                      custom_params={"batchSize": 30})
+    with resilience.fault_plan(plan):
+        res = runner.run(RunType.STREAMING_SCORE, params)
+    assert res.metrics["batches"] == 3             # 4 - 1 quarantined
+    assert res.metrics["rowsScored"] == 90
+    assert res.metrics["quarantinedBatches"] == 1
+    assert res.metrics["resilience"]["quarantined_batches"] == 1
+    entries = resilience.Quarantine(qfile).entries()
+    assert len(entries) == 1 and entries[0]["index"] == 1
+    # run-scoped: the sink is uninstalled after the run
+    assert resilience.get_quarantine() is None
+    # the run doc reports THIS run's events, not the process totals: a
+    # clean follow-up run must stamp zeros
+    res2 = runner.run(
+        RunType.STREAMING_SCORE,
+        OpParams(model_location=mdir, custom_params={"batchSize": 30}))
+    assert res2.metrics["quarantinedBatches"] == 0
+    assert res2.metrics["resilience"]["quarantined_batches"] == 0
+    # without a quarantineLocation the runner follows the sink-aware
+    # default too: the poison batch fails LOUDLY (its records would
+    # land nowhere)
+    plan3 = resilience.FaultPlan(seed=6).on(
+        "stream.score_batch", error=IOError, at=[1])
+    with resilience.fault_plan(plan3):
+        with pytest.raises(IOError):
+            runner.run(RunType.STREAMING_SCORE,
+                       OpParams(model_location=mdir,
+                                custom_params={"batchSize": 30}))
+
+
+# ---------------------------------------------------------------------------
+# serving + model_io artifact integrity (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_load_scoring_fn_rejects_truncated_and_tampered(tmp_path):
+    from transmogrifai_tpu import serving
+
+    records = _records()
+    pred = _three_layer_workflow()
+    model = _train(records, pred)
+    art = str(tmp_path / "art")
+    meta = serving.export_scoring_fn(model, art, records[:8])
+    assert meta["blobBytes"] > 0 and meta["blobDigest"]
+    serving.load_scoring_fn(art)                   # intact: loads
+
+    blob = os.path.join(art, "scoring_fn.stablehlo")
+    whole = open(blob, "rb").read()
+    with open(blob, "wb") as fh:
+        fh.write(whole[:len(whole) // 2])
+    with pytest.raises(ValueError, match="truncated serving artifact"):
+        serving.load_scoring_fn(art)
+
+    with open(blob, "wb") as fh:                   # same size, bit flip
+        fh.write(bytes([whole[0] ^ 0xFF]) + whole[1:])
+    with pytest.raises(ValueError, match="digest"):
+        serving.load_scoring_fn(art)
+
+    with open(blob, "wb") as fh:                   # restore for meta test
+        fh.write(whole)
+    meta_path = os.path.join(art, "scoring_export.json")
+    doc = json.load(open(meta_path))
+    doc["blobBytes"] = "12a34"                     # damaged metadata
+    json.dump(doc, open(meta_path, "w"))
+    with pytest.raises(ValueError, match="non-numeric blobBytes"):
+        serving.load_scoring_fn(art)
+
+    os.remove(blob)
+    with pytest.raises(ValueError, match="missing"):
+        serving.load_scoring_fn(art)
+    with pytest.raises(ValueError, match="no serving artifact"):
+        serving.load_scoring_fn(str(tmp_path / "nowhere"))
+
+
+def test_load_prediction_fn_rejects_corrupt_blob(tmp_path):
+    from transmogrifai_tpu import serving
+
+    records = _records()
+    pred = _three_layer_workflow()
+    model = _train(records, pred)
+    art = str(tmp_path / "art")
+    serving.export_prediction_fn(model, art)
+    blob = os.path.join(art, "prediction_fn.stablehlo")
+    with open(blob, "wb") as fh:
+        fh.write(b"not stablehlo")
+    with pytest.raises(ValueError, match="truncated serving artifact"):
+        serving.load_prediction_fn(art)
+
+
+def test_load_model_rejects_corrupt_weights_and_json(tmp_path):
+    from transmogrifai_tpu.workflow import WorkflowModel
+
+    _model, path = _save_small_model(tmp_path)
+    doc = json.load(open(os.path.join(path, "model.json")))
+    wf_file = os.path.join(path, doc["weightsFile"])
+
+    whole = open(wf_file, "rb").read()
+    with open(wf_file, "wb") as fh:
+        fh.write(b"garbage, not a zip archive")
+    with pytest.raises(ValueError, match="corrupt model weights"):
+        WorkflowModel.load(path)
+    with open(wf_file, "wb") as fh:                # empty file
+        pass
+    with pytest.raises(ValueError, match="corrupt model weights"):
+        WorkflowModel.load(path)
+    with open(wf_file, "wb") as fh:
+        fh.write(whole)
+    WorkflowModel.load(path)                       # restored: loads
+
+    with open(os.path.join(path, "model.json"), "w") as fh:
+        fh.write('{"uid": "trunc')
+    with pytest.raises(ValueError, match="not valid JSON"):
+        WorkflowModel.load(path)

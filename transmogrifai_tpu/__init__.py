@@ -32,6 +32,7 @@ def enable_logging(level: int = _logging.INFO) -> None:
         root.addHandler(h)
 
 
+from . import resilience  # noqa: F401  (faults/retries/breakers/quarantine)
 from . import telemetry  # noqa: F401  (run tracing/metrics/listeners)
 from . import types  # noqa: F401
 from .columns import Column, ColumnStore, column_from_values  # noqa: F401

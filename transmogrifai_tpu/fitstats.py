@@ -481,10 +481,19 @@ class LayerStatsPlan:
         return len(self.requests)
 
     def _gate_device(self, store) -> bool:
+        # the breaker is deliberately process-wide (unlike the
+        # per-model scoring.engine breaker): the moment-fold program is
+        # model-independent — (chunk, width, dtype) shapes, no plan —
+        # so a device-pass failure is a backend/link property every
+        # workflow in the process shares. allow() goes LAST in the
+        # chain: it may consume the half-open probe, and short-circuit
+        # guarantees a device attempt (which reports back) follows.
+        from . import resilience
         from .workflow import (FUSE_MIN_BANDWIDTH_MBPS, FUSE_MIN_ROWS,
                                device_roundtrip_mbps)
         return (store.n_rows >= FUSE_MIN_ROWS
-                and device_roundtrip_mbps() >= FUSE_MIN_BANDWIDTH_MBPS)
+                and device_roundtrip_mbps() >= FUSE_MIN_BANDWIDTH_MBPS
+                and resilience.breaker("fitstats.device").allow())
 
     def run(self, store, device: Optional[bool] = None) -> StatResults:
         """Execute every request in one pass; ``device`` overrides the
@@ -500,16 +509,37 @@ class LayerStatsPlan:
             else:
                 other.append(r)
 
-        use_device = (self._gate_device(store) if device is None
-                      else bool(device)) and bool(moment_cols)
+        # moment_cols first: _gate_device's breaker allow() may consume
+        # the open breaker's single half-open probe, so it must only be
+        # asked when a device pass (which reports the probe's outcome)
+        # would actually run
+        use_device = bool(moment_cols) and (
+            self._gate_device(store) if device is None else bool(device))
 
         values: Dict[Tuple, Any] = {}
         touched: Dict[str, int] = {}
 
         if moment_cols:
             if use_device:
-                bundles = _device_moment_bundles(store, moment_cols)
-            else:
+                # device tier behind its fault site + breaker: a failed
+                # device pass degrades to the host tier WITHIN this pass
+                # (the fused scan still happens — failure costs the
+                # layer nothing but the tier), and after N consecutive
+                # failures the breaker stops even attempting the device
+                from . import resilience
+                brk = resilience.breaker("fitstats.device")
+                try:
+                    resilience.inject("fitstats.device_pass",
+                                      rows=store.n_rows)
+                    bundles = _device_moment_bundles(store, moment_cols)
+                    brk.record_success()
+                except Exception:
+                    brk.record_failure()
+                    logger.exception(
+                        "fitstats device pass failed; computing this "
+                        "pass on the host tier")
+                    use_device = False
+            if not use_device:
                 bundles = {nm: _host_moment_bundle(store[nm], kinds)
                            for nm, kinds in moment_cols.items()}
             for r in self.requests:

@@ -413,6 +413,34 @@ def _recover_checkpoint(path: str) -> str:
     return path
 
 
+def _load_weights_npz(npz_path: str) -> Dict[str, np.ndarray]:
+    """Load a weights archive with a magic/size check first: a truncated
+    copy or torn write fails with a descriptive error naming the file
+    instead of a raw ``zipfile.BadZipFile`` traceback (npz IS a zip —
+    the ``PK\\x03\\x04`` magic is the cheapest integrity gate)."""
+    import zipfile
+    with open(npz_path, "rb") as fh:
+        head = fh.read(4)
+    # PK\x03\x04 = local file header; PK\x05\x06 = the empty-archive
+    # end record (a model with no weight arrays saves an empty zip)
+    if head[:2] != b"PK":
+        raise ValueError(
+            f"corrupt model weights at {npz_path!r}: "
+            f"{'empty file' if not head else 'bad magic ' + repr(head)} "
+            "— the archive is truncated or was not written by np.savez "
+            "(partial copy or torn write; re-save or re-copy the model)")
+    try:
+        with np.load(npz_path, allow_pickle=False) as npz:
+            return {k: npz[k] for k in npz.files}
+    except (zipfile.BadZipFile, OSError, ValueError) as e:
+        if isinstance(e, FileNotFoundError):
+            raise
+        raise ValueError(
+            f"corrupt model weights at {npz_path!r}: {type(e).__name__}: "
+            f"{e} (truncated archive — re-save or re-copy the model)"
+        ) from e
+
+
 def load_workflow_model(path: str):
     from .workflow import WorkflowModel
 
@@ -422,8 +450,17 @@ def load_workflow_model(path: str):
     for attempt in range(3):
         resolved = _recover_checkpoint(path)
         try:
-            with open(os.path.join(resolved, MODEL_JSON)) as fh:
-                doc = json.load(fh)
+            mj = os.path.join(resolved, MODEL_JSON)
+            try:
+                with open(mj) as fh:
+                    doc = json.load(fh)
+            except json.JSONDecodeError as e:
+                # model.json lands via atomic replace, so a decode error
+                # is real corruption, not a torn concurrent write —
+                # fail now, descriptively (no point retrying)
+                raise ValueError(
+                    f"corrupt model at {path!r}: {MODEL_JSON} is not "
+                    f"valid JSON ({e})") from e
             if int(doc.get("formatVersion", 1)) > FORMAT_VERSION:
                 raise ValueError(
                     f"Model at {path} uses format "
@@ -436,14 +473,12 @@ def load_workflow_model(path: str):
                 # cleanup won the race; raising re-enters the retry with a
                 # fresh marker read instead of crashing later on a missing
                 # array ref
-                with np.load(os.path.join(resolved, doc["weightsFile"]),
-                             allow_pickle=False) as npz:
-                    arrays = {k: npz[k] for k in npz.files}
+                arrays = _load_weights_npz(
+                    os.path.join(resolved, doc["weightsFile"]))
             else:
                 npz_path = os.path.join(resolved, WEIGHTS_NPZ)  # legacy
                 if os.path.exists(npz_path):
-                    with np.load(npz_path, allow_pickle=False) as npz:
-                        arrays = {k: npz[k] for k in npz.files}
+                    arrays = _load_weights_npz(npz_path)
             break
         except FileNotFoundError:
             if attempt == 2:

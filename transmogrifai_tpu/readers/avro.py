@@ -142,11 +142,30 @@ def _register(schema: Dict[str, Any], named: Dict[str, Any]) -> None:
 
 
 def read_avro_records(path: str) -> List[Dict[str, Any]]:
-    """Decode every record of an Avro container file into dicts."""
+    """Decode every record of an Avro container file into dicts.
+
+    Decode failures always surface as :class:`AvroDecodeError` naming the
+    file — a truncated varint (``IndexError``), a short struct read or a
+    bad deflate stream are all the same poison-file condition to the
+    caller (the streaming reader's quarantine routes on it)."""
+    from .. import resilience
+    resilience.inject("avro.decode", path=path)
     with open(path, "rb") as fh:
         data = fh.read()
     if data[:4] != _MAGIC:
         raise AvroDecodeError(f"{path} is not an Avro container file")
+    try:
+        return _decode_container(data)
+    except AvroDecodeError as e:
+        raise AvroDecodeError(f"{path}: {e}") from e
+    except (IndexError, struct.error, KeyError, zlib.error,
+            UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise AvroDecodeError(
+            f"{path}: truncated or corrupt avro container "
+            f"({type(e).__name__}: {e})") from e
+
+
+def _decode_container(data: bytes) -> List[Dict[str, Any]]:
     cur = _Cursor(data, 4)
 
     meta: Dict[str, bytes] = {}

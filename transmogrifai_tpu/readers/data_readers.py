@@ -92,6 +92,8 @@ class CSVReader(DataReader):
         self.delimiter = delimiter
 
     def read_records(self) -> List[Dict[str, Any]]:
+        from .. import resilience
+        resilience.inject("csv.decode", path=self.path)
         out = []
         with open(self.path, newline="") as fh:
             for row in _csv.reader(fh, delimiter=self.delimiter):
@@ -336,7 +338,8 @@ class JoinedAggregateDataReader(AggregateReader):
 
 
 def stream_score(model, batches: Iterable[Sequence[Mapping[str, Any]]],
-                 keep_intermediate: bool = False, overlap: Any = "auto"):
+                 keep_intermediate: bool = False, overlap: Any = "auto",
+                 on_error: Optional[str] = None):
     """Incremental scoring over record batches (StreamingScore run type /
     StreamingReaders.scala analog): yields one scored ColumnStore per
     batch, reusing the fitted DAG — jitted transforms recompile only when
@@ -347,11 +350,27 @@ def stream_score(model, batches: Iterable[Sequence[Mapping[str, Any]]],
     batch k+1 runs in a worker thread while batch k computes on device.
     ``"auto"`` (default) turns it on when the engine is available, the
     link clears the bandwidth gate and the first batch is big enough to
-    pay for compilation; ``True``/``False`` force/forbid it."""
+    pay for compilation; ``True``/``False`` force/forbid it.
+
+    ``on_error`` governs poison batches (tf.data's graceful-degradation
+    contract): ``"quarantine"`` routes a batch whose scoring raises to
+    the dead-letter sink (JSONL + reason + the records themselves,
+    ``resilience.quarantined_batches`` counter) and continues the
+    stream; ``"raise"`` propagates, killing the stream (the
+    pre-resilience behavior). The default (``None``) is sink-aware:
+    quarantine when a dead-letter sink is installed
+    (``resilience.set_quarantine`` / the runner's
+    ``quarantineLocation``), raise when none is — a dropped batch whose
+    records land nowhere would be silent data loss, so without a sink
+    the failure stays loud. The FIRST batch always raises either way —
+    a head-of-stream failure is a configuration error (wrong features,
+    missing model state), not data poison, and quarantining every batch
+    of a misconfigured stream would be silence at scale."""
     import itertools
 
-    from .. import telemetry
+    from .. import resilience, telemetry
 
+    on_error = resilience.resolve_on_error(on_error)
     it = iter(batches)
     first = next(it, None)
     if first is None:
@@ -370,12 +389,22 @@ def stream_score(model, batches: Iterable[Sequence[Mapping[str, Any]]],
     if use_overlap:
         from ..scoring import stream_score_overlapped
         yield from stream_score_overlapped(
-            model, chained, keep_intermediate=keep_intermediate)
+            model, chained, keep_intermediate=keep_intermediate,
+            on_error=on_error)
         return
-    for batch in chained:
-        with telemetry.span("stream:score_batch", rows=len(batch)):
-            out = model.score(list(batch),
-                              keep_intermediate=keep_intermediate)
+    for i, batch in enumerate(chained):
+        try:
+            resilience.inject("stream.score_batch", index=i,
+                              rows=len(batch))
+            with telemetry.span("stream:score_batch", rows=len(batch)):
+                out = model.score(list(batch),
+                                  keep_intermediate=keep_intermediate)
+        except Exception as e:
+            # the records ride in the dead letter: unlike a quarantined
+            # FILE (still on disk), a consumed stream batch exists
+            # nowhere else — without them the sink is only a tombstone
+            resilience.quarantine_batch_or_raise(on_error, i, e, batch)
+            continue
         yield out
 
 

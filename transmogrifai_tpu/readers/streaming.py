@@ -56,6 +56,8 @@ class DirectoryStreamReader:
 
     # -- format routing ----------------------------------------------------
     def _read_file(self, fp: str) -> List[Dict[str, Any]]:
+        from .. import resilience
+        resilience.inject("stream.read_file", path=fp)
         if self.reader_for is not None:
             return self.reader_for(fp)
         ext = os.path.splitext(fp)[1].lower()
@@ -72,6 +74,14 @@ class DirectoryStreamReader:
     def _snapshot(self) -> List[str]:
         return sorted(glob.glob(os.path.join(self.path, self.pattern)))
 
+    def _poll_snapshot(self) -> List[str]:
+        """One directory poll behind its fault site — a transient listing
+        failure (network mount blip) rides ``READER_RETRY`` instead of
+        killing the stream."""
+        from .. import resilience
+        resilience.inject("stream.poll", path=self.path)
+        return self._snapshot()
+
     def _ready(self, fp: str) -> bool:
         try:
             return (time.time() - os.path.getmtime(fp)) >= self.settle_s
@@ -84,12 +94,18 @@ class DirectoryStreamReader:
         marked seen one at a time AFTER a successful read, so a consumer
         that stops at ``max_batches`` leaves later files re-offered on
         the next poll, never silently dropped. A file whose read RAISES
-        (corrupt, vanished mid-read) is logged, marked seen and skipped
-        — retrying it every poll would wedge the stream forever."""
+        gets the reader retry policy for transient IO (``OSError``);
+        when retries exhaust — or the failure is non-transient (corrupt
+        container) — the file is QUARANTINED to the dead-letter sink
+        with its reason, counted (``resilience.quarantined_files``),
+        marked seen and skipped: retrying it every poll would wedge the
+        stream forever, and dropping it without trace loses data
+        silently (the pre-resilience behavior)."""
         import logging
 
-        from .. import telemetry
-        snapshot = self._snapshot()
+        from .. import resilience, telemetry
+        snapshot = resilience.READER_RETRY.call(
+            "stream.poll", self._poll_snapshot)
         if telemetry.enabled():
             # unconsumed files visible right now (including ones still
             # settling): the ingest backlog — a growing value means
@@ -101,7 +117,8 @@ class DirectoryStreamReader:
             if fp in self._seen or not self._ready(fp):
                 continue
             try:
-                recs = self._read_file(fp)
+                recs = resilience.READER_RETRY.call(
+                    "stream.read_file", self._read_file, fp)
             except _NoReaderError:
                 # unknown extension: a CONFIGURATION gap, but the file
                 # must still be marked seen before raising or it wedges
@@ -109,10 +126,12 @@ class DirectoryStreamReader:
                 # the readable files behind it
                 self._seen.add(fp)
                 raise
-            except Exception:
+            except Exception as e:
                 logging.getLogger(__name__).warning(
-                    "stream reader skipping unreadable file %s",
+                    "stream reader quarantining unreadable file %s",
                     fp, exc_info=True)
+                resilience.quarantine("stream.read_file", repr(e),
+                                      kind="files", path=fp)
                 self._seen.add(fp)
                 continue
             self._seen.add(fp)

@@ -22,12 +22,46 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
-from . import telemetry
+from . import resilience, telemetry
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["OpParams", "RunType", "RunnerResult", "OpWorkflowRunner",
            "OpApp"]
+
+
+def _numeric_custom_param(params: "OpParams", key: str, cast=float,
+                          default: Any = None,
+                          minimum: Optional[float] = None) -> Any:
+    """Validated numeric ``customParams`` lookup: a malformed value
+    raises a ``ValueError`` NAMING the key instead of an uncaught
+    ``float(ts)`` traceback deep in the run. ``None``/absent returns
+    ``default`` (an explicit JSON ``null`` means "use the default", same
+    as omitting the key); ``cast=int`` additionally rejects silent float
+    truncation (``maxBatches: 2.5`` is a config error, not 2)."""
+    raw = params.custom_params.get(key)
+    if raw is None:
+        return default
+    kind = "an integer" if cast is int else "a number"
+    try:
+        if isinstance(raw, bool):
+            raise TypeError
+        v = cast(raw)
+        if cast is int and float(raw) != v:
+            raise TypeError
+        import math
+        if not math.isfinite(v):
+            # NaN slips past any `v < minimum` comparison and an
+            # inf/nan timeoutS would hang the stream's exit test forever
+            raise TypeError
+    except (TypeError, ValueError, OverflowError):
+        # OverflowError: int(1e400) — JSON happily parses huge floats
+        raise ValueError(
+            f"customParams.{key} must be {kind}, got {raw!r}") from None
+    if minimum is not None and v < minimum:
+        raise ValueError(
+            f"customParams.{key} must be >= {minimum:g}, got {raw!r}")
+    return v
 
 
 @dataclass
@@ -47,6 +81,10 @@ class OpParams:
     #: telemetry registry in text exposition + run doc numerics);
     #: "prometheus" turns telemetry on
     metrics_format: str = "json"
+    #: poison-record dead-letter sink (JSONL, resilience.Quarantine):
+    #: unreadable stream files and failed scoring batches land here with
+    #: a reason instead of vanishing; installed run-scoped
+    quarantine_location: Optional[str] = None
     custom_params: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
@@ -65,6 +103,7 @@ class OpParams:
             metrics_location=doc.get("metricsLocation"),
             trace_location=doc.get("traceLocation"),
             metrics_format=doc.get("metricsFormat", "json"),
+            quarantine_location=doc.get("quarantineLocation"),
             custom_params=doc.get("customParams", {}))
 
     def to_json(self) -> Dict[str, Any]:
@@ -75,6 +114,7 @@ class OpParams:
                 "metricsLocation": self.metrics_location,
                 "traceLocation": self.trace_location,
                 "metricsFormat": self.metrics_format,
+                "quarantineLocation": self.quarantine_location,
                 "customParams": self.custom_params}
 
     def telemetry_requested(self) -> bool:
@@ -199,6 +239,14 @@ class OpWorkflowRunner:
         cache_dir = params.custom_params.get("compileCacheDir")
         if cache_dir:
             _enable_compile_cache(str(cache_dir))
+        # run-scoped dead-letter sink (quarantineLocation / CLI
+        # --quarantine-out): poison files/batches route there for THIS
+        # run; the previous sink is restored on exit (a user-level
+        # resilience.set_quarantine stays in force otherwise)
+        qloc = (params.quarantine_location
+                or params.custom_params.get("quarantineLocation"))
+        prev_sink = (resilience.set_quarantine(str(qloc)) if qloc
+                     else None)
         # one collecting listener per run (OpSparkListener analog): its
         # AppMetrics summary rides in the metrics doc/sink below
         collector = None
@@ -207,6 +255,9 @@ class OpWorkflowRunner:
                 telemetry.CollectingRunListener())
         logger.info("run type=%s model=%s write=%s", run_type,
                     params.model_location, params.write_location)
+        # the tallies are process-cumulative; the run doc must report
+        # THIS run's events, not a predecessor's quarantines
+        res_before = resilience.resilience_stats()
         t0 = time.perf_counter()
         telemetry.emit("run_start", run_type=run_type)
         ok = False
@@ -219,12 +270,21 @@ class OpWorkflowRunner:
                            seconds=time.perf_counter() - t0)
             if collector is not None:
                 telemetry.remove_listener(collector)
+            if qloc:
+                resilience.set_quarantine(prev_sink)
             try:
                 if ok:
                     # compile-cache presence rides in every metrics doc
                     # (None when no persistent cache was configured)
                     result.metrics["compileCacheDir"] = (
                         str(cache_dir) if cache_dir else None)
+                    # quarantine / retry / breaker evidence rides too —
+                    # the always-on tallies make silent data loss
+                    # visible in every run doc, telemetry on or off
+                    result.metrics["resilience"] = {
+                        k: v - res_before.get(k, 0)
+                        for k, v in
+                        resilience.resilience_stats().items()}
                     if collector is not None:
                         result.metrics["telemetry"] = collector.summary()
                         result.metrics["telemetryMetrics"] = \
@@ -292,23 +352,24 @@ class OpWorkflowRunner:
             # DROPPED, so peak memory is one batch — not the dataset
             from .readers import stream_score
             reader = self.scoring_reader
+            # maxBatches/timeoutS bound the directory-stream loop for
+            # non-daemon runs. Validated up front WHATEVER the reader —
+            # a malformed value must name its key now, not crash as an
+            # uncaught float(ts) mid-stream (or pass silently until the
+            # reader is swapped for a streaming one)
+            mb = _numeric_custom_param(params, "maxBatches", int,
+                                       minimum=1)
+            ts = _numeric_custom_param(params, "timeoutS", float,
+                                       minimum=0)
             if hasattr(reader, "stream"):
                 # directory-watching reader (StreamingReaders analog):
-                # each NEW file is one micro-batch; maxBatches/timeoutS
-                # bound the loop for non-daemon runs
-                mb = params.custom_params.get("maxBatches")
-                ts = params.custom_params.get("timeoutS")
+                # each NEW file is one micro-batch
                 batch = "per-file"
-                batches = reader.stream(
-                    max_batches=int(mb) if mb is not None else None,
-                    timeout_s=float(ts) if ts is not None else None)
+                batches = reader.stream(max_batches=mb, timeout_s=ts)
             else:
                 data = reader.read_records()
-                batch = int(params.custom_params.get("batchSize", 1024))
-                if batch <= 0:
-                    raise ValueError(
-                        f"customParams.batchSize must be positive, "
-                        f"got {batch}")
+                batch = _numeric_custom_param(params, "batchSize", int,
+                                              default=1024, minimum=1)
                 batches = (data[i:i + batch]
                            for i in range(0, len(data), batch))
             # overlapped streaming (tf.data-style software pipelining):
@@ -319,12 +380,19 @@ class OpWorkflowRunner:
             if isinstance(overlap, str) and overlap.lower() in (
                     "true", "false"):
                 overlap = overlap.lower() == "true"
+            # sink-aware default (resilience.resolve_on_error): with a
+            # quarantineLocation configured, poison batches quarantine;
+            # without one their records would land nowhere, so the run
+            # fails loudly instead. customParams.onBatchError overrides.
+            on_error = params.custom_params.get("onBatchError")
             rows = 0
             n_batches = 0
+            q_before = resilience.resilience_stats()
             sink = (_make_sink(params.write_location)
                     if params.write_location else None)
             try:
-                for scored in stream_score(model, batches, overlap=overlap):
+                for scored in stream_score(model, batches, overlap=overlap,
+                                           on_error=on_error):
                     rows += scored.n_rows
                     n_batches += 1
                     if sink is not None:
@@ -336,8 +404,15 @@ class OpWorkflowRunner:
             finally:
                 if sink is not None:
                     sink.close()
+            q_after = resilience.resilience_stats()
             metrics = {"rowsScored": rows, "batches": n_batches,
                        "batchSize": batch, "overlap": overlap,
+                       "quarantinedBatches":
+                           q_after["quarantined_batches"]
+                           - q_before["quarantined_batches"],
+                       "quarantinedFiles":
+                           q_after["quarantined_files"]
+                           - q_before["quarantined_files"],
                        "appSeconds": round(time.perf_counter() - t0, 3)}
             return RunnerResult(run_type, metrics=metrics)
 
@@ -505,6 +580,12 @@ class OpApp:
                              "(jax_compilation_cache_dir): repeat cold "
                              "runs reload compiled programs instead of "
                              "re-paying the compile clock")
+        ap.add_argument("--quarantine-out", metavar="PATH",
+                        help="poison-record dead-letter sink (JSONL): "
+                             "unreadable stream files and failed "
+                             "scoring batches land here with a reason "
+                             "instead of being dropped (see "
+                             "docs/robustness.md)")
         ap.add_argument("--quiet", action="store_true",
                         help="suppress INFO progress logging")
         args = ap.parse_args(argv)
@@ -525,4 +606,6 @@ class OpApp:
             params.metrics_format = args.metrics_format
         if args.compile_cache_dir:
             params.custom_params["compileCacheDir"] = args.compile_cache_dir
+        if args.quarantine_out:
+            params.quarantine_location = args.quarantine_out
         return self.runner(params).run(args.run_type, params)

@@ -59,7 +59,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from . import telemetry
+from . import resilience, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -530,6 +530,8 @@ class ScoringEngine:
         for host_store, prepared, uploads, n, bucket in prep.chunks:
             t0 = time.perf_counter()
             was_compile = False
+            resilience.inject("scoring.device_dispatch", rows=n,
+                              bucket=bucket)
             if out_names:
                 before = self._compile_count
                 fn = self._program(prepared, uploads, out_names)
@@ -692,7 +694,8 @@ def _concat_stores(stores):
 
 
 def stream_score_overlapped(model, batches, keep_intermediate: bool = False,
-                            engine: Optional[ScoringEngine] = None):
+                            engine: Optional[ScoringEngine] = None,
+                            on_error: Optional[str] = None):
     """Software-pipelined streaming score: host feature extraction of
     micro-batch k+1 (record→columns, host transforms, host_prepare,
     padding) runs in a worker thread while batch k computes on device —
@@ -701,6 +704,17 @@ def stream_score_overlapped(model, batches, keep_intermediate: bool = False,
 
     Falls back to the plain per-batch path when the engine is missing or
     gated off (slow link).
+
+    ``on_error="quarantine"`` routes a batch whose prep raises to the
+    resilience dead-letter sink and keeps the pipeline flowing (same
+    contract as ``readers.stream_score``, including the sink-aware
+    ``None`` default and the first-batch-always-raises rule). A DEVICE
+    compute failure is handled as a tier failure, not data poison: it
+    reports to the model's scoring-engine circuit breaker and the batch
+    retries on the per-layer host path — only a batch that BOTH tiers
+    reject is quarantined. With the breaker open, remaining batches
+    route straight to the host path (the stream keeps scoring, without
+    re-paying a failing dispatch per batch).
 
     Telemetry (when enabled): the worker's host prep and the consumer's
     device compute land on separate trace tracks (the overlap is visible
@@ -711,10 +725,16 @@ def stream_score_overlapped(model, batches, keep_intermediate: bool = False,
     overlap: ``(host_s + device_s - wall) / min(host_s, device_s)``)."""
     from concurrent.futures import ThreadPoolExecutor
 
+    on_error = resilience.resolve_on_error(on_error)
     eng = engine if engine is not None else model.scoring_engine()
     if eng is None or not eng.enabled():
-        for batch in batches:
-            yield model.score(list(batch), keep_intermediate=keep_intermediate)
+        for i, batch in enumerate(batches):
+            try:
+                yield model.score(list(batch),
+                                  keep_intermediate=keep_intermediate)
+            except Exception as e:
+                resilience.quarantine_batch_or_raise(on_error, i, e,
+                                                     batch)
         return
 
     it = iter(batches)
@@ -728,6 +748,7 @@ def stream_score_overlapped(model, batches, keep_intermediate: bool = False,
     t_start = time.perf_counter()
 
     def _prep(batch):
+        resilience.inject("stream.score_batch", rows=len(batch))
         if not tel:
             return eng.prepare_batch(batch)
         t0 = time.perf_counter()
@@ -740,21 +761,65 @@ def stream_score_overlapped(model, batches, keep_intermediate: bool = False,
     try:
         with ThreadPoolExecutor(max_workers=1,
                                 thread_name_prefix="score-prep") as ex:
-            fut = ex.submit(_prep, list(first))
+            idx = 0
+            fut_batch = list(first)
+            fut = ex.submit(_prep, fut_batch)
             while fut is not None:
-                prep = fut.result()
+                cur_batch = fut_batch
+                try:
+                    prep = fut.result()
+                except Exception as e:
+                    resilience.quarantine_batch_or_raise(on_error, idx,
+                                                         e, cur_batch)
+                    prep = None
                 nxt = next(it, None)
-                fut = (ex.submit(_prep, list(nxt))
+                fut_batch = list(nxt) if nxt is not None else []
+                fut = (ex.submit(_prep, fut_batch)
                        if nxt is not None else None)
                 if tel:
                     telemetry.gauge("stream.queue_depth").set(
                         1 if fut is not None else 0)
-                t0 = time.perf_counter()
-                with telemetry.span("stream:device_compute",
-                                    rows=prep.n_rows):
-                    store = eng.run_batch(
-                        prep, results_only=not keep_intermediate)
-                device_s += time.perf_counter() - t0
+                cur = idx
+                idx += 1
+                if prep is None:
+                    continue
+                # a device failure is a TIER failure, not data poison:
+                # report it to the model's engine breaker and retry the
+                # batch on the per-layer host path; with the breaker
+                # open, skip the failing dispatch entirely
+                brk_fn = getattr(model, "_engine_breaker", None)
+                brk = brk_fn() if callable(brk_fn) else None
+                store = None
+                if brk is None or brk.allow():
+                    t0 = time.perf_counter()
+                    try:
+                        with telemetry.span("stream:device_compute",
+                                            rows=prep.n_rows):
+                            store = eng.run_batch(
+                                prep,
+                                results_only=not keep_intermediate)
+                        if brk is not None:
+                            brk.record_success()
+                    except Exception:
+                        if brk is not None:
+                            brk.record_failure()
+                        logger.exception(
+                            "overlapped device compute failed; batch "
+                            "%d retries on the host path", cur)
+                    finally:
+                        device_s += time.perf_counter() - t0
+                if store is None:
+                    try:
+                        store = model.score(
+                            cur_batch,
+                            keep_intermediate=keep_intermediate,
+                            engine=False)
+                    except Exception as e:
+                        # both tiers rejected it: now it is poison
+                        resilience.quarantine_batch_or_raise(
+                            on_error, cur, e, cur_batch,
+                            rows=prep.n_rows)
+                        continue
                 n_batches += 1
                 if not keep_intermediate:
                     store = store.select([nm for nm in eng._result_names

@@ -39,6 +39,81 @@ _SCORE_BLOB = "scoring_fn.stablehlo"
 _SCORE_META = "scoring_export.json"
 
 
+def _blob_fingerprint(payload: bytes) -> Dict[str, Any]:
+    """Integrity fields written into the export metadata: byte size and
+    a blake2b-128 digest of the serialized module."""
+    import hashlib
+    return {"blobBytes": len(payload),
+            "blobDigest": hashlib.blake2b(payload, digest_size=16)
+                                 .hexdigest()}
+
+
+def _load_verified_blob(path: str, blob_name: str, meta_name: str
+                        ) -> tuple:
+    """Read (meta, blob bytes), failing with a DESCRIPTIVE error on a
+    truncated or corrupt artifact instead of a raw deserialization
+    traceback: the metadata's recorded size and digest are checked
+    before the bytes ever reach ``jax.export.deserialize``. Artifacts
+    from older exports (no fingerprint fields) skip the checks."""
+    import hashlib
+    meta_path = os.path.join(path, meta_name)
+    blob_path = os.path.join(path, blob_name)
+    try:
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+    except FileNotFoundError:
+        raise ValueError(
+            f"no serving artifact at {path!r}: missing {meta_name} "
+            "(was this directory written by export_*_fn?)") from None
+    except json.JSONDecodeError as e:
+        raise ValueError(
+            f"corrupt serving artifact at {path!r}: {meta_name} is not "
+            f"valid JSON ({e})") from e
+    try:
+        with open(blob_path, "rb") as fh:
+            payload = fh.read()
+    except FileNotFoundError:
+        raise ValueError(
+            f"corrupt serving artifact at {path!r}: {meta_name} present "
+            f"but {blob_name} missing") from None
+    expect = meta.get("blobBytes")
+    if expect is not None:
+        try:
+            expect = int(expect)
+        except (TypeError, ValueError):
+            # the metadata itself is damaged — still a descriptive
+            # failure, never a raw int() traceback
+            raise ValueError(
+                f"corrupt serving artifact at {path!r}: {meta_name} "
+                f"records a non-numeric blobBytes ({expect!r})") from None
+        if len(payload) != expect:
+            raise ValueError(
+                f"truncated serving artifact at {path!r}: {blob_name} is "
+                f"{len(payload)} bytes, export recorded {expect} (partial "
+                "copy or torn write — re-export or re-copy the artifact)")
+    digest = meta.get("blobDigest")
+    if digest is not None:
+        got = hashlib.blake2b(payload, digest_size=16).hexdigest()
+        if got != digest:
+            raise ValueError(
+                f"corrupt serving artifact at {path!r}: {blob_name} "
+                f"digest {got} does not match the export's {digest} "
+                "(bytes were altered in transit)")
+    return meta, payload
+
+
+def _deserialize_blob(payload: bytes, path: str):
+    from jax import export as jexport
+    try:
+        return jexport.deserialize(payload)
+    except Exception as e:
+        raise ValueError(
+            f"corrupt serving artifact at {path!r}: StableHLO "
+            f"deserialization failed ({type(e).__name__}: {e}); the "
+            "size/digest checks passed, so this usually means a jax "
+            "version mismatch between export and load") from e
+
+
 def export_prediction_fn(model, path: str,
                          pred_feature=None,
                          feature_dim: Optional[int] = None) -> Dict[str, Any]:
@@ -87,14 +162,16 @@ def export_prediction_fn(model, path: str,
                         feature_dim=feature_dim):
         exp = jexport.export(jax.jit(predict))(
             jax.ShapeDtypeStruct((b, feature_dim), jnp.float32))
+        payload = exp.serialize()
         os.makedirs(path, exist_ok=True)
         with open(os.path.join(path, _BLOB), "wb") as fh:
-            fh.write(exp.serialize())
+            fh.write(payload)
     telemetry.counter("serving.exports").inc()
     meta = {"featureDim": feature_dim,
             "predFeature": pred_feature.name,
             "coverage": "prediction_head",
-            "outputs": ["prediction", "rawPrediction", "probability"]}
+            "outputs": ["prediction", "rawPrediction", "probability"],
+            **_blob_fingerprint(payload)}
     with open(os.path.join(path, _META), "w") as fh:
         json.dump(meta, fh, indent=1)
     return meta
@@ -102,13 +179,13 @@ def export_prediction_fn(model, path: str,
 
 def load_prediction_fn(path: str) -> Callable[[np.ndarray], Dict[str, Any]]:
     """Load an exported artifact → callable(X [n, d] f32) → dict of
-    prediction/raw/probability arrays. Needs only jax, not this package."""
-    from jax import export as jexport
-
+    prediction/raw/probability arrays. Needs only jax, not this package.
+    A truncated or corrupt artifact raises a descriptive ``ValueError``
+    (size + digest checked against the export metadata) instead of a raw
+    deserialization traceback."""
     with telemetry.span("serving:load_prediction_fn"):
-        with open(os.path.join(path, _BLOB), "rb") as fh:
-            exp = jexport.deserialize(fh.read())
-        meta = json.load(open(os.path.join(path, _META)))
+        meta, payload = _load_verified_blob(path, _BLOB, _META)
+        exp = _deserialize_blob(payload, path)
     telemetry.counter("serving.loads").inc()
 
     def call(X: np.ndarray) -> Dict[str, Any]:
@@ -179,14 +256,16 @@ def export_scoring_fn(model, path: str, sample_data,
                         fused_stages=eng.fused_stage_count,
                         inputs=len(manifest)):
         exp = jexport.export(jax.jit(predict))(*args)
+        payload = exp.serialize()
         os.makedirs(path, exist_ok=True)
         with open(os.path.join(path, _SCORE_BLOB), "wb") as fh:
-            fh.write(exp.serialize())
+            fh.write(payload)
     telemetry.counter("serving.exports").inc()
     meta = {"coverage": "fused_chain",
             "fusedStages": eng.fused_stage_count,
             "inputs": manifest,
-            "resultFeatures": out_names}
+            "resultFeatures": out_names,
+            **_blob_fingerprint(payload)}
     with open(os.path.join(path, _SCORE_META), "w") as fh:
         json.dump(meta, fh, indent=1)
     return meta
@@ -199,14 +278,13 @@ def load_scoring_fn(path: str) -> Callable[[Dict[str, np.ndarray]],
     prepared vectorizer blocks and the bare column name for direct vector
     uploads (see ``meta["inputs"]``). Needs only jax, not this package —
     the caller supplies host-prepared blocks (every row-leading array,
-    one consistent batch size)."""
-    from jax import export as jexport
-
+    one consistent batch size). A truncated or corrupt artifact raises a
+    descriptive ``ValueError`` (size + digest checked against the export
+    metadata) instead of a raw deserialization traceback."""
     with telemetry.span("serving:load_scoring_fn"):
-        with open(os.path.join(path, _SCORE_BLOB), "rb") as fh:
-            exp = jexport.deserialize(fh.read())
-        with open(os.path.join(path, _SCORE_META)) as fh:
-            meta = json.load(fh)
+        meta, payload = _load_verified_blob(path, _SCORE_BLOB,
+                                            _SCORE_META)
+        exp = _deserialize_blob(payload, path)
     telemetry.counter("serving.loads").inc()
     manifest: List[Dict[str, Any]] = meta["inputs"]
 

@@ -527,6 +527,23 @@ class RunListener:
     def on_compile(self, event: str, seconds: float, **_: Any) -> None:
         pass
 
+    def on_retry(self, site: str, attempt: int, error: str = "",
+                 delay_s: float = 0.0, **_: Any) -> None:
+        """A RetryPolicy-governed operation failed transiently and is
+        about to back off (resilience.py)."""
+        pass
+
+    def on_quarantine(self, site: str, kind: str, count: int,
+                      reason: str = "", **_: Any) -> None:
+        """Poison item(s) routed to the dead-letter sink
+        (resilience.quarantine)."""
+        pass
+
+    def on_breaker_trip(self, name: str, failures: int, **_: Any) -> None:
+        """A circuit breaker opened: its device tier is now served by
+        the host fallback until the reset timeout (resilience.py)."""
+        pass
+
 
 _LISTENERS: List[RunListener] = []
 
@@ -587,6 +604,9 @@ class CollectingRunListener(RunListener):
         self.compile_seconds = 0.0
         self.stats_passes = 0
         self.fit_passes_saved = 0
+        self.retries = 0
+        self.quarantined: Dict[str, int] = {}
+        self.breaker_trips = 0
         self._lock = threading.Lock()
 
     def on_run_start(self, run_type: str, **_: Any) -> None:
@@ -639,6 +659,23 @@ class CollectingRunListener(RunListener):
             self.compile_events += 1
             self.compile_seconds += seconds
 
+    def on_retry(self, site: str, attempt: int, error: str = "",
+                 delay_s: float = 0.0, **_: Any) -> None:
+        with self._lock:
+            self.events.append("retry")
+            self.retries += 1
+
+    def on_quarantine(self, site: str, kind: str, count: int,
+                      reason: str = "", **_: Any) -> None:
+        with self._lock:
+            self.events.append("quarantine")
+            self.quarantined[kind] = self.quarantined.get(kind, 0) + count
+
+    def on_breaker_trip(self, name: str, failures: int, **_: Any) -> None:
+        with self._lock:
+            self.events.append("breaker_trip")
+            self.breaker_trips += 1
+
     def summary(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -654,6 +691,9 @@ class CollectingRunListener(RunListener):
                 "compileSeconds": round(self.compile_seconds, 4),
                 "statsPasses": self.stats_passes,
                 "fitPassesSaved": self.fit_passes_saved,
+                "retries": self.retries,
+                "quarantined": dict(self.quarantined),
+                "breakerTrips": self.breaker_trips,
             }
 
 

@@ -32,7 +32,7 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-from . import telemetry
+from . import resilience, telemetry
 from .columns import Column, ColumnStore
 from .features import Feature, copy_dag
 from .graph import StagesDAG, compute_dag
@@ -145,7 +145,12 @@ def _atomic_checkpoint(model: "WorkflowModel", directory: str) -> None:
     from both (preferring ``.tmp``, which is always fully written before
     any rename starts). Names are pid-free so a resumed process cleans up
     a crashed predecessor's leftovers instead of leaking full-size copies
-    (concurrent writers to one checkpoint dir are not supported)."""
+    (concurrent writers to one checkpoint dir are not supported).
+
+    The write itself rides ``resilience.CHECKPOINT_RETRY`` (a transient
+    shared-filesystem hiccup must not kill a multi-hour fit) and the
+    swap carries the ``checkpoint.write``/``checkpoint.rename`` fault
+    sites — the kill-and-resume chaos tests preempt exactly here."""
     import shutil
 
     from .model_io import _recover_checkpoint
@@ -155,13 +160,19 @@ def _atomic_checkpoint(model: "WorkflowModel", directory: str) -> None:
     # the target dir missing) so the cleanup below only ever deletes a
     # torn .tmp or a superseded .old — never the sole loadable save
     _recover_checkpoint(directory)
-    shutil.rmtree(tmp, ignore_errors=True)
-    model.save(tmp, overwrite=True)
+
+    def _save_tmp() -> None:
+        resilience.inject("checkpoint.write", directory=directory)
+        shutil.rmtree(tmp, ignore_errors=True)
+        model.save(tmp, overwrite=True)
+
+    resilience.CHECKPOINT_RETRY.call("checkpoint.write", _save_tmp)
     # the new save is complete on disk; stale .old is now safe to drop
     # (and must be, for the rename below to succeed)
     shutil.rmtree(old, ignore_errors=True)
     if os.path.exists(directory):
         os.rename(directory, old)
+    resilience.inject("checkpoint.rename", directory=directory)
     os.rename(tmp, directory)
     shutil.rmtree(old, ignore_errors=True)
 
@@ -408,6 +419,41 @@ class Workflow:
             train_time_s=train_time,
             stage_metrics=self._stage_metrics,
         )
+
+    def fit(self, resume_from: Optional[str] = None) -> "WorkflowModel":
+        """:meth:`train` with preemption recovery.
+
+        ``resume_from`` names a layer-checkpoint directory (the one a
+        previous run's ``with_checkpointing`` wrote — including one left
+        mid-swap by a kill, which ``model_io._recover_checkpoint``
+        repairs on load): its fitted stages warm-start this fit, so
+        every already-completed DAG layer is skipped and only the layers
+        the preemption interrupted re-fit. A missing or empty checkpoint
+        degrades to a fresh fit — ``fit(resume_from=d)`` is safe to use
+        unconditionally as the restart entry point. Checkpointing
+        continues into the same directory unless one was already
+        configured."""
+        if resume_from:
+            from .model_io import MODEL_JSON
+            if self._checkpoint_dir is None:
+                self.with_checkpointing(resume_from)
+            partial = None
+            if any(os.path.exists(os.path.join(p, MODEL_JSON))
+                   for p in (resume_from, f"{resume_from}.tmp",
+                             f"{resume_from}.old")):
+                try:
+                    partial = WorkflowModel.load(resume_from)
+                except Exception:
+                    logger.exception(
+                        "checkpoint at %s is unusable; fitting from "
+                        "scratch", resume_from)
+            if partial is not None and partial.fitted_stages:
+                self.with_model_stages(partial)
+                resilience.record_resumed_fit()
+                logger.info(
+                    "resuming fit from %s: %d fitted stage(s) warm-start",
+                    resume_from, len(partial.fitted_stages))
+        return self.train()
 
     def _fit_dag(self, dag: StagesDAG, train: ColumnStore,
                  test: Optional[ColumnStore],
@@ -740,6 +786,26 @@ class WorkflowModel:
         return self.fitted_stages.get(st.uid, st)
 
     # -- scoring -----------------------------------------------------------
+
+    def _engine_breaker(self):
+        """THIS model's device-tier circuit breaker, shared by its
+        engine routes (scoring_engine build, transform, score): one
+        policy object instead of three independent ``except Exception``
+        fallbacks. Per-model and held ON the instance (not the process
+        registry): a broken plan or compile is a property of one model,
+        must not downgrade other models served by the same process, and
+        the breaker should die with its model rather than accumulate in
+        a registry a long-lived server never empties. After
+        ``failure_threshold`` consecutive device failures the per-layer
+        host path serves WITHOUT re-attempting the failing engine each
+        call, until the reset timeout lets a probe through."""
+        brk = getattr(self, "_engine_breaker_obj", None)
+        if brk is None:
+            brk = self._engine_breaker_obj = resilience.CircuitBreaker(
+                f"scoring.engine[{self.uid}]", failure_threshold=3,
+                reset_timeout_s=60.0)
+        return brk
+
     def scoring_engine(self, rebuild: bool = False, **engine_kw):
         """The compiled batched scoring engine for this model
         (scoring.ScoringEngine), built once and memoized. Returns None
@@ -752,6 +818,7 @@ class WorkflowModel:
             except Exception:
                 logger.exception("scoring engine build failed; "
                                  "per-layer path stays active")
+                self._engine_breaker().record_failure()
                 eng = None
             if engine_kw and not rebuild:
                 return eng          # custom engines aren't memoized
@@ -761,16 +828,43 @@ class WorkflowModel:
     def _use_engine(self, n_rows: int, engine) -> bool:
         """Routing decision for score/transform: ``engine=True`` forces,
         ``False`` forbids, ``"auto"`` requires a worthwhile batch (same
-        reasoning as FUSE_MIN_ROWS) plus the bandwidth gate."""
+        reasoning as FUSE_MIN_ROWS) plus the bandwidth gate — and a
+        closed (or probing) device-tier breaker either way. The breaker
+        ``allow()`` may consume the open breaker's single half-open
+        probe, so it only runs once every cheap gate has said yes and an
+        engine ATTEMPT (build or dispatch, both of which report back via
+        record_success/failure) follows. A failed build is such an
+        attempt: it is retried under the same probe discipline rather
+        than memoized as dead forever, so a transient build failure
+        heals after the reset timeout."""
         if engine is False:
             return False
         from .scoring import SCORING_MIN_ROWS
-        eng = self.scoring_engine()
-        if eng is None or not eng.enabled():
+        brk = self._engine_breaker()
+        eng = self._scoring_engine
+        if eng is not False and eng is not None:
+            # engine already built: cheap gates first, breaker last —
+            # a score/transform dispatch attempt follows a True
+            if not eng.enabled():
+                return False
+            if engine is not True and n_rows < SCORING_MIN_ROWS:
+                return False
+            return brk.allow()
+        # unbuilt (False) or a previously failed build (None): the
+        # build itself is the breaker-governed attempt
+        if engine is not True and n_rows < SCORING_MIN_ROWS:
             return False
-        if engine is True:
-            return True
-        return n_rows >= SCORING_MIN_ROWS
+        if not brk.allow():
+            return False
+        eng = self.scoring_engine(rebuild=(eng is None))
+        if eng is None:
+            return False        # build failed; record_failure already ran
+        if not eng.enabled():
+            # the probe (the build) succeeded but no dispatch follows —
+            # report it so the breaker doesn't idle in half-open
+            brk.record_success()
+            return False
+        return True
 
     def _transform_layers(self, data,
                           up_to: Optional[Feature] = None) -> ColumnStore:
@@ -795,14 +889,19 @@ class WorkflowModel:
         With ``up_to=None`` big batches route through the compiled
         scoring engine (scoring.py): the whole device-capable chain runs
         as ONE jitted program instead of one crossing per layer.
-        ``engine=True/False`` force/forbid the engine path."""
+        ``engine=True/False`` force/forbid the engine path (force is
+        still subject to this model's device-tier circuit breaker —
+        a known-bad engine serves from the host path, docs/robustness.md)."""
         if up_to is None:
             n = (data.n_rows if isinstance(data, ColumnStore)
                  else len(data) if hasattr(data, "__len__") else 0)
             if self._use_engine(n, engine):
                 try:
-                    return self.scoring_engine().transform_store(data)
+                    out = self.scoring_engine().transform_store(data)
+                    self._engine_breaker().record_success()
+                    return out
                 except Exception:
+                    self._engine_breaker().record_failure()
                     logger.exception(
                         "scoring engine transform failed; falling back "
                         "to the per-layer path")
@@ -819,8 +918,11 @@ class WorkflowModel:
                  else len(data) if hasattr(data, "__len__") else 0)
             if self._use_engine(n, engine):
                 try:
-                    return self.scoring_engine().score_store(data)
+                    out = self.scoring_engine().score_store(data)
+                    self._engine_breaker().record_success()
+                    return out
                 except Exception:
+                    self._engine_breaker().record_failure()
                     logger.exception(
                         "scoring engine score failed; falling back to "
                         "the per-layer path")
