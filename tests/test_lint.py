@@ -1,0 +1,502 @@
+"""Pre-flight static analysis tests (lint.py + tools/tmoglint.py).
+
+Covers every rule id in the catalog (one positive + one clean fixture
+each), the eval_shape device pre-flight on a representative
+binary-classification workflow, runner pre-flight gating (``--fail-on``
+behavior, no reader I/O on rejection — the compile-time type-safety
+acceptance), the CLI ``check`` subcommand, and the meta-test asserting
+the repo itself is clean under the AST self-lint.
+"""
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, Workflow, lint, telemetry
+from transmogrifai_tpu.features import Feature
+from transmogrifai_tpu.graph import compute_dag
+from transmogrifai_tpu.lint import Finding, LintError, Severity
+from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+from transmogrifai_tpu.models.selector import (
+    BinaryClassificationModelSelector)
+from transmogrifai_tpu.ops.smart_text import SmartTextVectorizer
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.ops.vectorizer_base import VectorizerModel
+from transmogrifai_tpu.runner import OpParams, OpWorkflowRunner, RunType
+from transmogrifai_tpu.stages.base import (Estimator, LambdaTransformer,
+                                           VarArity)
+from transmogrifai_tpu.types.feature_types import (FeatureType, OPVector,
+                                                   Prediction, Real)
+from transmogrifai_tpu.vector_metadata import (VectorColumnMetadata,
+                                               VectorMetadata)
+from transmogrifai_tpu.workflow import WorkflowError, WorkflowModel
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tmoglint():
+    spec = importlib.util.spec_from_file_location(
+        "tmoglint", os.path.join(_REPO, "tools", "tmoglint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _records(rng, n=200):
+    y = rng.integers(0, 2, n).astype(float)
+    x = rng.normal(size=n) + y
+    return [{"label": float(y[i]), "x": float(x[i])} for i in range(n)]
+
+
+def _binary_flow():
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    vec = transmogrify([fx])
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None,
+        seed=5)
+    pred = label.transform_with(selector, vec)
+    return Workflow().set_result_features(pred), label, fx, vec, pred
+
+
+def _mistyped_workflow():
+    """A text vectorizer fed an OPVector by direct wiring (bypassing
+    set_input — the hole the static checker exists to close)."""
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    vec = transmogrify([fx])
+    tv = SmartTextVectorizer()
+    tv.input_features = (vec,)
+    return Workflow().set_result_features(tv.get_output()), tv, vec
+
+
+class _CountingReader:
+    """Reader that records whether any I/O happened."""
+
+    def __init__(self, records):
+        self._records = records
+        self.calls = 0
+
+    def read_records(self):
+        self.calls += 1
+        return list(self._records)
+
+
+# ---------------------------------------------------------------------------
+# TMG1xx graph rules
+# ---------------------------------------------------------------------------
+
+
+def test_tmg101_mistyped_edge_names_both_sides():
+    wf, tv, vec = _mistyped_workflow()
+    findings = lint.check_workflow(wf)
+    f = next(f for f in findings if f.rule == "TMG101")
+    assert f.severity == Severity.ERROR
+    assert f.stage == tv.uid
+    assert vec.name in f.message          # the offending feature
+    assert "OPVector" in f.message and "Text" in f.message
+    assert "SmartTextVectorizer" in f.message
+
+
+def test_tmg101_clean_binary_workflow():
+    wf, *_ = _binary_flow()
+    assert lint.check_workflow(wf) == []
+    assert wf.validate() == []            # the method form
+
+
+def test_tmg102_duplicate_uid_detected_and_dag_raises():
+    a = FeatureBuilder.Real("a").from_column().as_predictor()
+    b = FeatureBuilder.Real("b").from_column().as_predictor()
+    from transmogrifai_tpu.ops.numeric import RealVectorizer
+    dup = "RealVectorizer_00000000beef"
+    f1 = RealVectorizer(uid=dup).set_input(a).get_output()
+    f2 = RealVectorizer(uid=dup).set_input(b).get_output()
+    findings = lint.check_workflow([f1, f2])
+    f = next(f for f in findings if f.rule == "TMG102")
+    assert dup in (f.stage or "") and f.severity == Severity.ERROR
+    # the silent dict-overwrite collapse is gone: compute_dag raises,
+    # naming both stages
+    with pytest.raises(ValueError, match="distinct stages sharing"):
+        compute_dag([f1, f2])
+    with pytest.raises(WorkflowError, match="duplicate stage uid"):
+        Workflow().set_result_features(f1, f2)
+    # distinct uids stay clean
+    g1 = RealVectorizer().set_input(a).get_output()
+    g2 = RealVectorizer().set_input(b).get_output()
+    assert not [x for x in lint.check_workflow([g1, g2])
+                if x.rule == "TMG102"]
+
+
+def test_tmg103_cycle_reported_not_crashed():
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    vec = transmogrify([fx])
+    vec.parents = (vec,)                  # self-ancestry by force
+    findings = lint.check_workflow([vec])
+    assert any(f.rule == "TMG103" and f.severity == Severity.ERROR
+               for f in findings)
+
+
+def test_tmg104_dead_fitted_stage_in_model():
+    rng = np.random.default_rng(0)
+    wf, *_ = _binary_flow()
+    model = wf.set_input_records(_records(rng)).train()
+    model.fitted_stages["Ghost_00000000dead"] = object()
+    findings = lint.check_model(model, device=False)
+    f = next(f for f in findings if f.rule == "TMG104")
+    assert "Ghost_00000000dead" in f.message
+    assert f.severity == Severity.WARNING
+
+
+def test_tmg105_response_leakage_via_laundered_feature():
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    # a plain transformer mixing label with predictors, wired directly
+    # (set_input would reject the direct mix — the static check catches
+    # graphs that bypassed it)
+    leak = LambdaTransformer("leak", lambda a, b: a,
+                             [Real, Real], Real)
+    leak.input_features = (label, fx)
+    leaked = leak.get_output()
+    vec = transmogrify([leaked])
+    findings = lint.check_workflow([vec])
+    f = next(f for f in findings if f.rule == "TMG105")
+    assert f.severity == Severity.ERROR and f.stage == leak.uid
+    assert "label" in f.message
+
+
+def test_tmg105_sanctioned_label_consumers_stay_clean():
+    # SanityChecker / ModelSelector are AllowLabelAsInput — the whole
+    # representative DAG (label feeds both) must produce zero findings
+    from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+    wf, label, fx, vec, pred = _binary_flow()
+    checked = label.transform_with(SanityChecker(), vec)
+    assert not [f for f in lint.check_workflow([checked, pred])
+                if f.rule == "TMG105"]
+
+
+class _AnyInputEstimator(Estimator):
+    operation_name = "dummyEst"
+    output_type = OPVector
+
+    @property
+    def input_spec(self):
+        return VarArity(FeatureType)
+
+    def fit_columns(self, store):          # pragma: no cover
+        raise NotImplementedError
+
+
+def test_tmg106_estimator_consuming_prediction_warns():
+    p = FeatureBuilder.of(Prediction, "p").from_column().as_predictor()
+    est = _AnyInputEstimator().set_input(p)
+    findings = lint.check_workflow([est.get_output()])
+    f = next(f for f in findings if f.rule == "TMG106")
+    assert f.severity == Severity.WARNING and f.stage == est.uid
+
+
+def test_tmg106_unfitted_estimator_in_scored_dag_errors():
+    wf, label, fx, vec, pred = _binary_flow()
+    model = WorkflowModel(result_features=[pred], fitted_stages={})
+    findings = lint.check_model(model, device=False)
+    bad = [f for f in findings if f.rule == "TMG106"
+           and f.severity == Severity.ERROR]
+    assert bad and any("unfitted estimator" in f.message for f in bad)
+
+
+# ---------------------------------------------------------------------------
+# TMG2xx device pre-flight (eval_shape — no data, no device)
+# ---------------------------------------------------------------------------
+
+
+class _BadVec(VectorizerModel):
+    """Deliberately broken vectorizer: wrong width (TMG201), f64
+    promotion (TMG202), scalar prepared block + batch-size-dependent
+    signature (TMG203)."""
+
+    operation_name = "badVec"
+    seq_type = Real
+
+    def host_prepare(self, store):
+        col = store[self.input_features[0].name]
+        n = len(col)
+        out = {"x": np.nan_to_num(col.astype_float()),
+               "n": float(n)}                      # bare Python scalar
+        if n % 2 == 0:                             # signature flaps with n
+            out["pad"] = np.zeros(3, dtype=np.float32)
+        return out
+
+    def device_compute(self, xp, prepared):
+        x = xp.asarray(prepared["x"], dtype=xp.float64)   # f64 promotion
+        return xp.stack([x, x, x], axis=1)                # width 3 != 2
+
+    def vector_metadata(self):
+        return VectorMetadata("bad", [VectorColumnMetadata("x", "Real"),
+                                      VectorColumnMetadata("x", "Real")])
+
+
+def test_tmg201_202_203_seeded_violation_fixture():
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    out = _BadVec().set_input(fx).get_output()
+    model = WorkflowModel(result_features=[out], fitted_stages={})
+    findings = lint.preflight_device(model)
+    rules = {f.rule for f in findings}
+    assert {"TMG201", "TMG202", "TMG203"} <= rules
+    shape = next(f for f in findings if f.rule == "TMG201")
+    assert "(8, 3)" in shape.message and "(8, 2)" in shape.message
+    scalar = [f for f in findings if f.rule == "TMG203"]
+    assert any("'n'" in f.message for f in scalar)      # the scalar block
+    assert any("batch size" in f.message for f in scalar)
+
+
+def test_tmg202_fires_under_x32_production_config():
+    """Under x32 (the production TPU config) jax silently truncates an
+    f64 request before eval_shape can see the dtype — the rule must
+    still fire, via the truncation warning itself."""
+    import jax
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    out = _BadVec().set_input(fx).get_output()
+    model = WorkflowModel(result_features=[out], fitted_stages={})
+    jax.config.update("jax_enable_x64", False)
+    try:
+        findings = lint.preflight_device(model)
+    finally:
+        jax.config.update("jax_enable_x64", True)
+    assert any(f.rule == "TMG202" for f in findings)
+
+
+def test_suppressed_graph_error_does_not_skip_device_pass():
+    """Suppressing a known/accepted graph error must re-enable the
+    TMG2xx shape analysis, not silently return a clean verdict."""
+    p = FeatureBuilder.of(Prediction, "p").from_column().as_predictor()
+    est = _AnyInputEstimator().set_input(p)
+    model = WorkflowModel(result_features=[est.get_output()],
+                          fitted_stages={})
+    # unsuppressed: the TMG106 error gates the device pass, with a
+    # TMG204 note saying so rather than a silent skip
+    findings = lint.check_model(model, device=True)
+    assert any(f.rule == "TMG106" and f.severity == Severity.ERROR
+               for f in findings)
+    assert any(f.rule == "TMG204" and "skipped" in f.message
+               for f in findings)
+    # suppressed: the device pass runs (and reports the unresolvable
+    # estimator as coverage info, not a crash)
+    findings = lint.check_model(model, device=True, suppress=["TMG106"])
+    assert not any(f.severity == Severity.ERROR for f in findings)
+    assert any(f.rule == "TMG204" for f in findings)
+
+
+def test_suppress_accepts_bare_string():
+    wf, tv, _vec = _mistyped_workflow()
+    # "TMG101" (the easy JSON mistake for ["TMG101"]) must not be
+    # iterated character-by-character
+    assert lint.check_workflow(wf, suppress="TMG101") == []
+
+
+def test_tmg204_host_stage_without_static_form_halts_with_info():
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+
+    def boom(col):
+        raise RuntimeError("no static form")
+
+    t = LambdaTransformer("boom", boom, [Real], Real).set_input(fx)
+    model = WorkflowModel(result_features=[t.get_output()],
+                          fitted_stages={})
+    findings = lint.preflight_device(model)
+    f = next(f for f in findings if f.rule == "TMG204")
+    assert f.severity == Severity.INFO and "no static form" in f.message
+
+
+def test_preflight_clean_on_fitted_binary_workflow(rng):
+    """Representative end-to-end: transmogrify → selector, trained, then
+    shape-propagated through eval_shape with zero findings."""
+    wf, *_ = _binary_flow()
+    model = wf.set_input_records(_records(rng)).train()
+    assert model.validate(device=True) == []
+
+
+def test_suppress_and_enforce_semantics():
+    wf, tv, _vec = _mistyped_workflow()
+    assert lint.check_workflow(wf, suppress=["TMG101"]) == []
+    with pytest.raises(ValueError, match="unknown lint rule"):
+        lint.check_workflow(wf, suppress=["TMG999"])
+    findings = [Finding("TMG203", "warn-only")]
+    lint.enforce(findings, fail_on="error")          # warnings pass
+    with pytest.raises(LintError):
+        lint.enforce(findings, fail_on="warning")
+    with pytest.raises(ValueError):
+        lint.enforce(findings, fail_on="info")
+
+
+# ---------------------------------------------------------------------------
+# runner pre-flight gating (the acceptance criterion: no reader I/O)
+# ---------------------------------------------------------------------------
+
+
+def test_runner_rejects_mistyped_workflow_before_any_reader_io(rng):
+    wf, tv, vec = _mistyped_workflow()
+    reader = _CountingReader(_records(rng))
+    runner = OpWorkflowRunner(wf, training_reader=reader)
+    with pytest.raises(LintError) as ei:
+        runner.run(RunType.TRAIN, OpParams())
+    # the error names the rule, the stage and both features' types
+    msg = str(ei.value)
+    assert "TMG101" in msg and tv.uid in msg and "OPVector" in msg
+    assert reader.calls == 0, "pre-flight must run before data loading"
+
+
+def test_runner_fail_on_warning_gates_warnings():
+    p = FeatureBuilder.of(Prediction, "p").from_column().as_predictor()
+    est = _AnyInputEstimator().set_input(p)
+    wf = Workflow().set_result_features(est.get_output())
+    runner = OpWorkflowRunner(wf)
+    # default gate (error): warnings log but pass
+    summary = runner._preflight(OpParams(), workflow=wf)
+    assert summary["warning"] == 1 and summary["failOn"] == "error"
+    with pytest.raises(LintError):
+        runner._preflight(
+            OpParams(custom_params={"failOn": "warning"}), workflow=wf)
+    # validate: false skips entirely
+    assert runner._preflight(
+        OpParams(custom_params={"validate": False}), workflow=wf) is None
+    # lintSuppress mutes the rule
+    summary = runner._preflight(
+        OpParams(custom_params={"failOn": "warning",
+                                "lintSuppress": ["TMG106"]}), workflow=wf)
+    assert summary["findings"] == 0
+
+
+def test_runner_train_stamps_preflight_in_metrics(rng, tmp_path):
+    wf, *_ = _binary_flow()
+    reader = _CountingReader(_records(rng))
+    params = OpParams(model_location=str(tmp_path / "model"),
+                      metrics_location=str(tmp_path / "metrics.json"))
+    out = OpWorkflowRunner(wf, training_reader=reader).run(
+        RunType.TRAIN, params)
+    assert out.metrics["preflight"] == {"findings": 0, "failOn": "error"}
+    sunk = json.load(open(params.metrics_location))
+    assert sunk["preflight"]["findings"] == 0
+
+
+def test_lint_findings_mirror_into_telemetry():
+    telemetry.enable()
+    try:
+        telemetry.reset()
+        collector = telemetry.add_listener(
+            telemetry.CollectingRunListener())
+        lint.emit_findings([Finding("TMG101", "boom"),
+                            Finding("TMG203", "hazard")])
+        assert telemetry.counter("lint.errors").value == 1
+        assert telemetry.counter("lint.warnings").value == 1
+        assert collector.lint_findings == {"error": 1, "warning": 1}
+        assert collector.summary()["lintFindings"] == {"error": 1,
+                                                       "warning": 1}
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# CLI: check subcommand + gen default
+# ---------------------------------------------------------------------------
+
+
+def test_cli_check_rejects_malformed_params(tmp_path, capsys):
+    from transmogrifai_tpu.cli import run_check
+    p = tmp_path / "params.json"
+    p.write_text(json.dumps({"customParams": {"maxBatches": 2.5}}))
+    assert run_check(str(p)) == 1
+    out = capsys.readouterr().out
+    assert "maxBatches" in out and "TMG001" in out
+    p.write_text(json.dumps({"customParams": {"maxBatches": 3}}))
+    assert run_check(str(p)) == 0
+
+
+def test_cli_check_model_directory(rng, tmp_path, capsys):
+    from transmogrifai_tpu.cli import run_check
+    wf, *_ = _binary_flow()
+    model = wf.set_input_records(_records(rng)).train()
+    model.save(str(tmp_path / "model"), overwrite=True)
+    assert run_check(model_location=str(tmp_path / "model")) == 0
+    assert "0 error(s)" in capsys.readouterr().out
+
+
+def test_cli_gen_emits_validate_by_default(tmp_path):
+    from transmogrifai_tpu.cli import generate_project
+    csv = tmp_path / "data.csv"
+    csv.write_text("label,x\n1,0.5\n0,0.1\n1,0.9\n0,0.2\n")
+    files = generate_project(str(csv), "label", str(tmp_path / "proj"))
+    params = json.load(open(files["params.json"]))
+    assert params["customParams"]["validate"] is True
+    assert params["customParams"]["failOn"] == "error"
+
+
+# ---------------------------------------------------------------------------
+# TMG3xx repo self-lint (tools/tmoglint.py)
+# ---------------------------------------------------------------------------
+
+
+def test_tmg301_time_time_flagged_and_allowlisted():
+    tm = _load_tmoglint()
+    bad = "import time\nt0 = time.time()\n"
+    assert [f.rule for f in tm.lint_source(bad)] == ["TMG301"]
+    aliased = "import time as _time\nt0 = _time.time()\n"
+    assert [f.rule for f in tm.lint_source(aliased)] == ["TMG301"]
+    ok = "import time\nt0 = time.perf_counter()\n"
+    assert tm.lint_source(ok) == []
+    allowed = "import time\nnow = time.time()  # lint: wall-clock\n"
+    assert tm.lint_source(allowed) == []
+
+
+def test_tmg302_broad_except_flagged_and_allowlisted():
+    tm = _load_tmoglint()
+    bad = "try:\n    x = 1\nexcept Exception:\n    pass\n"
+    assert [f.rule for f in tm.lint_source(bad)] == ["TMG302"]
+    allowed = ("try:\n    x = 1\n"
+               "except Exception:  # lint: broad-except — fallback site\n"
+               "    pass\n")
+    assert tm.lint_source(allowed) == []
+    narrow = "try:\n    x = 1\nexcept ValueError:\n    pass\n"
+    assert tm.lint_source(narrow) == []
+
+
+def test_tmg303_unregistered_inject_site():
+    tm = _load_tmoglint()
+    bad = ("from transmogrifai_tpu import resilience\n"
+           "resilience.inject('stream.raed_file')\n")       # typo'd site
+    assert [f.rule for f in tm.lint_source(bad)] == ["TMG303"]
+    ok = ("from transmogrifai_tpu import resilience\n"
+          "resilience.inject('stream.read_file', path='x')\n")
+    assert tm.lint_source(ok) == []
+
+
+def test_tmg304_span_outside_with():
+    tm = _load_tmoglint()
+    bad = ("from transmogrifai_tpu import telemetry\n"
+           "s = telemetry.span('fit:stage')\n")
+    assert [f.rule for f in tm.lint_source(bad)] == ["TMG304"]
+    ok = ("from transmogrifai_tpu import telemetry\n"
+          "with telemetry.span('fit:stage'):\n    pass\n")
+    assert tm.lint_source(ok) == []
+
+
+def test_repo_is_clean_under_self_lint():
+    """The meta-test: the package itself reports zero findings — the
+    project invariants PRs 1-4 introduced by convention are now CI
+    law. Regressions (a new time.time() duration, an unmarked broad
+    except, a typo'd fault site, a bare span) fail HERE."""
+    tm = _load_tmoglint()
+    findings = tm.lint_paths(
+        [os.path.join(_REPO, "transmogrifai_tpu")])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_tmoglint_cli_exit_codes(tmp_path, capsys):
+    tm = _load_tmoglint()
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nt0 = time.time()\n")
+    assert tm.main([str(bad)]) == 1
+    assert "TMG301" in capsys.readouterr().out
+    good = tmp_path / "good.py"
+    good.write_text("import time\nt0 = time.perf_counter()\n")
+    assert tm.main([str(good)]) == 0

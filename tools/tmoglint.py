@@ -1,0 +1,273 @@
+#!/usr/bin/env python
+"""tmoglint — AST-based repo self-lint enforcing project invariants.
+
+The runtime invariants PRs 1-4 introduced by convention are enforced
+here as rules (the TMG3xx family of the catalog in
+``transmogrifai_tpu/lint.py`` / docs/static-analysis.md):
+
+* **TMG301** — monotonic timing must use ``time.perf_counter()``, never
+  ``time.time()`` (the PR-2 rule: an NTP step mid-run corrupts every
+  ``time.time()`` duration). Legitimate wall-clock uses — mtime
+  comparisons, epoch timestamps written to sinks — carry a
+  ``# lint: wall-clock`` marker on the offending line.
+* **TMG302** — ``except Exception`` (or ``BaseException``) appears only
+  at allowlisted breaker/fallback/quarantine sites marked
+  ``# lint: broad-except`` (ideally with a dash-reason). Everything
+  else must catch the specific exceptions it can actually handle.
+* **TMG303** — every ``resilience.inject(site)`` marker names a site
+  registered in ``resilience.FAULT_SITES``: a typo'd site is a chaos
+  test that silently never fires.
+* **TMG304** — telemetry spans open via context managers
+  (``with telemetry.span(...)``): a bare ``span(...)`` call is an
+  unpaired begin/end that never records and silently corrupts the
+  per-thread span stack.
+
+Runs as a CLI over one or more paths (default: the ``transmogrifai_tpu``
+package next to this script) and as a tier-1 pytest
+(``tests/test_lint.py`` asserts the repo itself is clean), so invariant
+regressions fail CI::
+
+    python tools/tmoglint.py                    # lint the package
+    python tools/tmoglint.py path/ --fail-on warning
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+if _REPO not in sys.path:                       # direct script execution
+    sys.path.insert(0, _REPO)
+
+from transmogrifai_tpu.lint import Finding, Severity, enforce  # noqa: E402
+
+__all__ = ["lint_source", "lint_file", "lint_paths", "main",
+           "ALLOW_WALLCLOCK", "ALLOW_BROAD_EXCEPT"]
+
+#: suppression markers, checked on the finding's own source line
+ALLOW_WALLCLOCK = "lint: wall-clock"
+ALLOW_BROAD_EXCEPT = "lint: broad-except"
+
+
+def _fault_sites() -> frozenset:
+    from transmogrifai_tpu.resilience import FAULT_SITES
+    return FAULT_SITES
+
+
+class _Visitor(ast.NodeVisitor):
+    """One file's AST walk. Collects import aliases first (so ``import
+    time as _time`` still triggers TMG301) and the set of Call nodes
+    used as ``with``-item context expressions (TMG304)."""
+
+    def __init__(self, path: str, lines: Sequence[str]):
+        self.path = path
+        self.lines = lines
+        self.findings: List[Finding] = []
+        #: local names bound to the time module / telemetry module /
+        #: resilience module / their relevant functions
+        self.time_modules: Set[str] = set()
+        self.time_funcs: Set[str] = set()       # from time import time [as x]
+        self.telemetry_modules: Set[str] = set()
+        self.span_funcs: Set[str] = set()
+        self.resilience_modules: Set[str] = set()
+        self.inject_funcs: Set[str] = set()
+        self.with_contexts: Set[int] = set()
+
+    # -- helpers -----------------------------------------------------------
+    def _marked(self, lineno: int, marker: str) -> bool:
+        if 1 <= lineno <= len(self.lines):
+            return marker in self.lines[lineno - 1]
+        return False
+
+    def _add(self, rule: str, lineno: int, message: str,
+             severity: Optional[str] = None) -> None:
+        self.findings.append(Finding(
+            rule, message, severity=severity or "",
+            location=f"{self.path}:{lineno}"))
+
+    # -- imports -----------------------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            local = alias.asname or alias.name.split(".")[0]
+            if alias.name == "time":
+                self.time_modules.add(local)
+            if alias.name.endswith("telemetry"):
+                self.telemetry_modules.add(local)
+            if alias.name.endswith("resilience"):
+                self.resilience_modules.add(local)
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        mod = node.module or ""
+        for alias in node.names:
+            local = alias.asname or alias.name
+            if mod == "time" and alias.name == "time":
+                self.time_funcs.add(local)
+            if alias.name == "telemetry":
+                self.telemetry_modules.add(local)
+            if alias.name == "resilience":
+                self.resilience_modules.add(local)
+            if mod.endswith("telemetry") and alias.name == "span":
+                self.span_funcs.add(local)
+            if mod.endswith("resilience") and alias.name == "inject":
+                self.inject_funcs.add(local)
+        self.generic_visit(node)
+
+    # -- with: remember sanctioned context-manager calls -------------------
+    def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            if isinstance(item.context_expr, ast.Call):
+                self.with_contexts.add(id(item.context_expr))
+        self.generic_visit(node)
+
+    visit_AsyncWith = visit_With
+
+    # -- except Exception --------------------------------------------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        names = []
+        t = node.type
+        if isinstance(t, ast.Tuple):
+            names = [getattr(e, "id", getattr(e, "attr", "")) for e
+                     in t.elts]
+        elif t is not None:
+            names = [getattr(t, "id", getattr(t, "attr", ""))]
+        if any(n in ("Exception", "BaseException") for n in names) \
+                and not self._marked(node.lineno, ALLOW_BROAD_EXCEPT):
+            self._add(
+                "TMG302", node.lineno,
+                "broad 'except Exception' outside the allowlist — catch "
+                "the specific exceptions or mark the line "
+                f"'# {ALLOW_BROAD_EXCEPT} — <reason>' if this is a "
+                "deliberate breaker/fallback/quarantine site")
+        self.generic_visit(node)
+
+    # -- calls: time.time / inject / span ----------------------------------
+    def _is_time_time(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "time" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in self.time_modules:
+            return True
+        return isinstance(f, ast.Name) and f.id in self.time_funcs
+
+    def _is_inject(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "inject" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in self.resilience_modules:
+            return True
+        return isinstance(f, ast.Name) and f.id in self.inject_funcs
+
+    def _is_span(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "span" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in self.telemetry_modules:
+            return True
+        return isinstance(f, ast.Name) and f.id in self.span_funcs
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._is_time_time(node) \
+                and not self._marked(node.lineno, ALLOW_WALLCLOCK):
+            self._add(
+                "TMG301", node.lineno,
+                "time.time() — durations must use time.perf_counter() "
+                "(NTP steps corrupt wall-clock deltas); true wall-clock "
+                "uses (mtime comparisons, sink timestamps) carry "
+                f"'# {ALLOW_WALLCLOCK}'")
+        elif self._is_inject(node):
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                site = node.args[0].value
+                if site not in _fault_sites():
+                    self._add(
+                        "TMG303", node.lineno,
+                        f"inject site {site!r} is not registered in "
+                        "resilience.FAULT_SITES — a typo'd site is a "
+                        "chaos test that never fires; register it (and "
+                        "document it in docs/robustness.md)")
+            elif node.args:
+                self._add(
+                    "TMG303", node.lineno,
+                    "inject() must name its site as a string literal so "
+                    "the catalog check (and grep) can see it",
+                    severity=Severity.WARNING)
+        elif self._is_span(node) and id(node) not in self.with_contexts:
+            self._add(
+                "TMG304", node.lineno,
+                "telemetry span opened outside a 'with' statement — a "
+                "span only records on __exit__, so an unpaired call "
+                "never lands in the trace and corrupts the per-thread "
+                "span stack")
+        self.generic_visit(node)
+
+
+def lint_source(src: str, path: str = "<string>") -> List[Finding]:
+    """Lint one module's source text; returns TMG3xx findings."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("TMG305", f"file does not parse: {e}",
+                        location=f"{path}:{e.lineno or 0}")]
+    v = _Visitor(path, src.splitlines())
+    v.visit(tree)
+    return sorted(v.findings, key=lambda f: f.location or "")
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        return lint_source(fh.read(), path)
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    """Lint every ``.py`` file under the given files/directories
+    (``__pycache__`` skipped), findings sorted by location."""
+    findings: List[Finding] = []
+    for p in paths:
+        if os.path.isfile(p):
+            findings.extend(lint_file(p))
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    findings.extend(lint_file(os.path.join(root, fn)))
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tmoglint",
+        description="AST self-lint for project invariants (TMG3xx)")
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(_REPO, "transmogrifai_tpu")],
+                    help="files/directories to lint (default: the "
+                         "transmogrifai_tpu package)")
+    ap.add_argument("--fail-on", choices=("error", "warning"),
+                    default="error",
+                    help="exit non-zero when findings reach this "
+                         "severity (default: error)")
+    args = ap.parse_args(argv)
+    findings = lint_paths(args.paths)
+    for f in findings:
+        print(f.format())
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    summary = ", ".join(f"{counts.get(s, 0)} {s}(s)"
+                        for s in (Severity.ERROR, Severity.WARNING,
+                                  Severity.INFO))
+    print(f"tmoglint: {summary}")
+    try:
+        enforce(findings, fail_on=args.fail_on)
+    except Exception:   # lint: broad-except — CLI boundary: findings already printed
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

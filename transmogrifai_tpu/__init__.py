@@ -32,6 +32,7 @@ def enable_logging(level: int = _logging.INFO) -> None:
         root.addHandler(h)
 
 
+from . import lint  # noqa: F401  (pre-flight static checks, rule catalog)
 from . import resilience  # noqa: F401  (faults/retries/breakers/quarantine)
 from . import telemetry  # noqa: F401  (run tracing/metrics/listeners)
 from . import types  # noqa: F401

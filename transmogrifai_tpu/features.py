@@ -113,6 +113,15 @@ class Feature:
             if st is None:
                 return
             key = st.uid
+            prev = stages.get(key)
+            if prev is not None and prev is not st:
+                # distinct stages sharing a uid would collapse into one
+                # node (and one would silently vanish from the DAG) —
+                # see graph.compute_dag / lint rule TMG102
+                raise ValueError(
+                    f"duplicate stage uid {key!r}: {prev.stage_name()} "
+                    f"and {st.stage_name()} are distinct stages sharing "
+                    "one uid")
             stages[key] = st
             if dist.get(key, -1) < d:
                 dist[key] = d

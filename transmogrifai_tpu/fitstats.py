@@ -533,7 +533,7 @@ class LayerStatsPlan:
                                       rows=store.n_rows)
                     bundles = _device_moment_bundles(store, moment_cols)
                     brk.record_success()
-                except Exception:
+                except Exception:  # lint: broad-except — breaker-governed device-tier fallback
                     brk.record_failure()
                     logger.exception(
                         "fitstats device pass failed; computing this "

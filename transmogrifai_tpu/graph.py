@@ -28,6 +28,17 @@ def compute_dag(result_features: Sequence[Feature],
     for f in result_features:
         for stage, d in f.parent_stages().items():
             key = stage.uid
+            existing = stages.get(key)
+            if existing is not None and existing is not stage:
+                # two DISTINCT stages sharing one uid used to silently
+                # collapse into a single DAG node here (the dict
+                # overwrite), dropping one of them from the fit plan.
+                # Surfaced statically as lint rule TMG102.
+                raise ValueError(
+                    f"duplicate stage uid {key!r}: "
+                    f"{existing.stage_name()} and {stage.stage_name()} "
+                    f"are distinct stages sharing one uid — every stage "
+                    "needs its own uid (pass uid=None to autogenerate)")
             stages[key] = stage
             if distances.get(key, -1) < d:
                 distances[key] = d

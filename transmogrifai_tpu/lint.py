@@ -1,0 +1,698 @@
+"""Pre-flight workflow checker — static type-flow + device shape analysis.
+
+TransmogrifAI's headline feature is *compile-time* pipeline type safety:
+a mis-wired workflow fails at compile, before Spark ever reads a byte.
+The Python port discovers the same mistakes at fit time, deep inside a
+``TypeError`` in ``stages/base.py``, after data loading has already been
+paid for. This module is the runtime's "compiler front-end": it treats
+the feature DAG as an analyzable dataflow graph (the KeystoneML framing,
+PAPERS.md) and checks it **before any data is read and without touching
+a device**.
+
+Two analysis passes share one :class:`Finding` vocabulary:
+
+* **Graph checker** (``check_workflow`` / ``check_model``, rules
+  ``TMG1xx``) — walks the feature DAG edge-by-edge and re-validates
+  every stage's declared input contract (``input_spec``) against the
+  actual wired features, plus structural invariants the wiring helpers
+  enforce only by convention: duplicate stage/feature uids, cycles,
+  dead fitted stages, response-leakage reachability (complementing
+  ``filters/raw_feature_filter.py``'s *runtime* leakage statistics) and
+  estimator-after-model misuse.
+* **Device pre-flight** (``preflight_device``, rules ``TMG2xx``) —
+  propagates ``jax.ShapeDtypeStruct``s through each layer's
+  ``device_compute``/``predict_device`` via ``jax.eval_shape`` over a
+  tiny synthetic store (no dataset, no device dispatch — the tf.data
+  static-analysis motivation): shape mismatches against the declared
+  vector metadata, unintended f64 promotion under the f32 pipeline, and
+  retrace/recompile hazards (per-batch-varying prepared signatures,
+  bare Python scalars traced by value) that feed the existing
+  ``scoring.compile_count`` guard story.
+
+A third rule family, ``TMG3xx``, enforces *repo* invariants via the
+AST-based self-lint in ``tools/tmoglint.py`` (monotonic timing uses
+``time.perf_counter``, ``resilience.inject`` sites come from the
+``FAULT_SITES`` catalog, telemetry spans open via context managers,
+``except Exception`` only at allowlisted sites). It reuses this
+module's :class:`Finding`/severity vocabulary and rule registry.
+
+The runner executes the graph + device passes as an on-by-default
+pre-flight step (``OpParams.customParams.validate``, CLI
+``python -m transmogrifai_tpu check params.json`` and
+``--fail-on {error,warning}``); findings mirror into telemetry
+(``lint.*`` counters and the ``on_lint`` RunListener hook). See
+docs/static-analysis.md for the full rule catalog with examples and
+suppression syntax.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import (Any, Dict, Iterable, List, Optional, Sequence, Set,
+                    Tuple)
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "Severity", "Finding", "LintError", "RULES",
+    "check_workflow", "check_model", "preflight_device",
+    "enforce", "emit_findings", "max_severity",
+]
+
+
+# ---------------------------------------------------------------------------
+# rule catalog
+# ---------------------------------------------------------------------------
+
+
+class Severity:
+    """Finding severities, orderable via :data:`_SEVERITY_RANK`."""
+
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+    ALL = (ERROR, WARNING, INFO)
+
+
+_SEVERITY_RANK = {Severity.ERROR: 2, Severity.WARNING: 1, Severity.INFO: 0}
+
+
+#: rule id -> (default severity, one-line description). The stable
+#: contract: ids never change meaning, new rules get new ids
+#: (docs/static-analysis.md is the narrative catalog).
+RULES: Dict[str, Tuple[str, str]] = {
+    # -- TMG0xx: configuration rules (params files, CLI inputs) ------------
+    "TMG001": (Severity.ERROR,
+               "malformed configuration value (params file / customParams)"),
+    # -- TMG1xx: graph rules (static type-flow over the feature DAG) -------
+    "TMG101": (Severity.ERROR,
+               "input/output FeatureType incompatibility on a DAG edge"),
+    "TMG102": (Severity.ERROR,
+               "duplicate stage/feature uid (distinct objects collide)"),
+    "TMG103": (Severity.ERROR, "cycle in the feature graph"),
+    "TMG104": (Severity.WARNING,
+               "orphan/dead stage unreachable from the result features"),
+    "TMG105": (Severity.ERROR,
+               "response leakage: label-derived feature reaches a "
+               "predictor-side stage"),
+    "TMG106": (Severity.ERROR,
+               "estimator-after-model misuse (unfitted estimator in a "
+               "scored DAG / estimator consuming a Prediction)"),
+    # -- TMG2xx: device pre-flight (eval_shape, no data, no device) --------
+    "TMG201": (Severity.ERROR,
+               "device compute shape mismatch vs declared vector metadata"),
+    "TMG202": (Severity.WARNING,
+               "unintended dtype promotion: f64 output under the f32 "
+               "pipeline (x32 would silently downcast / emulate)"),
+    "TMG203": (Severity.WARNING,
+               "retrace/recompile risk: per-batch-varying prepared "
+               "signature or bare Python scalar traced by value"),
+    "TMG204": (Severity.INFO,
+               "pre-flight stopped: stage has no static (eval_shape) form"),
+    # -- TMG3xx: repo rules (tools/tmoglint.py AST self-lint) --------------
+    "TMG301": (Severity.ERROR,
+               "time.time() used for a duration — monotonic timing must "
+               "use time.perf_counter() (allow: '# lint: wall-clock')"),
+    "TMG302": (Severity.ERROR,
+               "broad 'except Exception' outside an allowlisted "
+               "breaker/fallback site (allow: '# lint: broad-except')"),
+    "TMG303": (Severity.ERROR,
+               "resilience.inject() names a site missing from the "
+               "resilience.FAULT_SITES catalog"),
+    "TMG304": (Severity.ERROR,
+               "telemetry span not opened via a context manager "
+               "(unpaired begin/end)"),
+    "TMG305": (Severity.ERROR,
+               "source file does not parse — the self-lint could not "
+               "analyze it"),
+}
+
+
+@dataclass
+class Finding:
+    """One structured lint finding (stable rule id + severity + subject)."""
+
+    rule: str
+    message: str
+    severity: str = ""
+    #: stage uid (graph/device rules)
+    stage: Optional[str] = None
+    #: feature name (graph rules)
+    feature: Optional[str] = None
+    #: ``file:line`` (repo rules)
+    location: Optional[str] = None
+
+    def __post_init__(self):
+        if not self.severity:
+            self.severity = RULES.get(self.rule, (Severity.WARNING, ""))[0]
+
+    def format(self) -> str:
+        subject = self.location or ""
+        if self.stage:
+            subject = f"stage={self.stage}"
+            if self.feature:
+                subject += f" feature={self.feature}"
+        elif self.feature:
+            subject = f"feature={self.feature}"
+        head = f"{self.rule} {self.severity}"
+        return f"{head} [{subject}] {self.message}" if subject \
+            else f"{head} {self.message}"
+
+    def to_json(self) -> Dict[str, Any]:
+        out = {"rule": self.rule, "severity": self.severity,
+               "message": self.message}
+        for k in ("stage", "feature", "location"):
+            v = getattr(self, k)
+            if v:
+                out[k] = v
+        return out
+
+
+class LintError(Exception):
+    """Pre-flight rejection: carries the findings that crossed the
+    ``fail_on`` threshold (every finding rides in ``self.findings``)."""
+
+    def __init__(self, findings: Sequence[Finding], fail_on: str):
+        self.findings = list(findings)
+        self.fail_on = fail_on
+        over = [f for f in self.findings
+                if _SEVERITY_RANK[f.severity] >= _SEVERITY_RANK[fail_on]]
+        lines = "\n  ".join(f.format() for f in over)
+        super().__init__(
+            f"pre-flight check failed ({len(over)} finding(s) at or above "
+            f"'{fail_on}'):\n  {lines}")
+
+
+def max_severity(findings: Iterable[Finding]) -> Optional[str]:
+    """Highest severity present, or None for an empty/clean list."""
+    best: Optional[str] = None
+    for f in findings:
+        if best is None or _SEVERITY_RANK[f.severity] > _SEVERITY_RANK[best]:
+            best = f.severity
+    return best
+
+
+def enforce(findings: Sequence[Finding], fail_on: str = Severity.ERROR
+            ) -> None:
+    """Raise :class:`LintError` when any finding reaches ``fail_on``
+    (``"error"`` — the default — or ``"warning"``)."""
+    if fail_on not in (Severity.ERROR, Severity.WARNING):
+        raise ValueError(
+            f"fail_on must be 'error' or 'warning', got {fail_on!r}")
+    threshold = _SEVERITY_RANK[fail_on]
+    if any(_SEVERITY_RANK[f.severity] >= threshold for f in findings):
+        raise LintError(findings, fail_on)
+
+
+def emit_findings(findings: Sequence[Finding]) -> None:
+    """Mirror findings into telemetry: ``lint.errors`` / ``lint.warnings``
+    / ``lint.info`` counters plus one ``on_lint`` RunListener event per
+    finding. No-op cost when telemetry is off (null instruments)."""
+    from . import telemetry
+    names = {Severity.ERROR: "lint.errors", Severity.WARNING:
+             "lint.warnings", Severity.INFO: "lint.info"}
+    for f in findings:
+        telemetry.counter(names[f.severity]).inc()
+        telemetry.emit("lint", rule=f.rule, severity=f.severity,
+                       message=f.message, stage=f.stage,
+                       feature=f.feature, location=f.location)
+
+
+def _apply_suppress(findings: List[Finding],
+                    suppress: Iterable[str]) -> List[Finding]:
+    if isinstance(suppress, str):
+        # a lone "TMG104" (easy JSON mistake for ["TMG104"]) must not be
+        # iterated character-by-character
+        suppress = (suppress,)
+    sup = {str(s).upper() for s in (suppress or ())}
+    if not sup:
+        return findings
+    unknown = sup - set(RULES)
+    if unknown:
+        raise ValueError(f"unknown lint rule id(s) in suppress: "
+                         f"{sorted(unknown)}")
+    return [f for f in findings if f.rule not in sup]
+
+
+# ---------------------------------------------------------------------------
+# graph traversal (identity-based — the uid-keyed dicts in graph.py would
+# hide exactly the duplicate-uid collisions TMG102 exists to catch)
+# ---------------------------------------------------------------------------
+
+
+def _walk_features(result_features) -> Tuple[List[Any], List[Any],
+                                             List[Finding]]:
+    """DFS over the feature DAG by object identity.
+
+    Returns ``(features, stages, findings)`` where ``features`` is in
+    topological order (ancestors first), ``stages`` are the distinct
+    origin-stage objects in the same order, and ``findings`` holds any
+    TMG103 cycle findings (traversal stops descending into a cycle but
+    still reports everything reachable)."""
+    findings: List[Finding] = []
+    feats: List[Any] = []
+    stage_ids: Set[int] = set()
+    stages: List[Any] = []
+    done: Set[int] = set()
+    on_path: Set[int] = set()
+    cycles_seen: Set[int] = set()
+
+    def visit(f) -> None:
+        fid = id(f)
+        if fid in done:
+            return
+        if fid in on_path:
+            if fid not in cycles_seen:
+                cycles_seen.add(fid)
+                findings.append(Finding(
+                    "TMG103", f"cycle in the feature graph at "
+                    f"{f.name!r}: the feature is its own ancestor",
+                    feature=f.name))
+            return
+        on_path.add(fid)
+        for p in f.parents:
+            visit(p)
+        on_path.discard(fid)
+        done.add(fid)
+        feats.append(f)
+        st = f.origin_stage
+        if st is not None and id(st) not in stage_ids:
+            stage_ids.add(id(st))
+            stages.append(st)
+
+    for f in result_features:
+        visit(f)
+    return feats, stages, findings
+
+
+def _stage_label(stage) -> str:
+    try:
+        return f"{stage.stage_name()} [{stage.uid}]"
+    except Exception:  # lint: broad-except — labels must never break lint
+        return repr(stage)
+
+
+def _check_graph(result_features, fitted_stages: Optional[Dict[str, Any]]
+                 = None, known_stages: Optional[Sequence[Any]] = None
+                 ) -> List[Finding]:
+    """All TMG1xx rules over a feature DAG.
+
+    ``fitted_stages`` (a WorkflowModel's uid → FittedModel map) arms the
+    TMG104 dead-stage and TMG106 unfitted-estimator rules;
+    ``known_stages`` is an optional extra stage universe checked for
+    reachability (TMG104)."""
+    from .stages.base import AllowLabelAsInput, Estimator, Transformer
+    from .stages.generator import FeatureGeneratorStage
+    from .types.feature_types import Prediction
+
+    feats, stages, findings = _walk_features(result_features)
+    feat_ids = {id(f) for f in feats}
+
+    # TMG102 — duplicate uids: distinct objects sharing one uid collapse
+    # into a single node in every uid-keyed map (graph.compute_dag,
+    # fitted_stages, checkpoints) and silently drop a stage
+    by_uid: Dict[str, List[Any]] = {}
+    for st in stages:
+        by_uid.setdefault(st.uid, []).append(st)
+    for uid, group in by_uid.items():
+        if len(group) > 1:
+            names = ", ".join(s.stage_name() for s in group)
+            findings.append(Finding(
+                "TMG102", f"duplicate stage uid shared by {len(group)} "
+                f"distinct stages: {names}", stage=uid))
+    feat_by_uid: Dict[str, List[Any]] = {}
+    for f in feats:
+        feat_by_uid.setdefault(f.uid, []).append(f)
+    for uid, group in feat_by_uid.items():
+        if len(group) > 1:
+            names = ", ".join(f.name for f in group)
+            findings.append(Finding(
+                "TMG102", f"duplicate feature uid shared by {len(group)} "
+                f"distinct features: {names}", feature=names))
+
+    # per-stage contract checks, ancestors first
+    for st in stages:
+        if isinstance(st, FeatureGeneratorStage):
+            continue
+        label = _stage_label(st)
+        ins = tuple(getattr(st, "input_features", ()) or ())
+        if not ins:
+            findings.append(Finding(
+                "TMG104", f"orphan stage {label}: inputs never set "
+                "(set_input was not called)", stage=st.uid))
+            continue
+
+        # TMG101 — re-run the declared input contract statically. set_input
+        # enforces it at wiring time, but graphs built by hand, loaded from
+        # JSON or rewired (copy_dag, warm start) can bypass it; here it
+        # fails BEFORE data loading instead of as a fit-time TypeError.
+        try:
+            spec = st.input_spec
+        except NotImplementedError:
+            spec = None
+        if spec is not None:
+            try:
+                spec.check(ins)
+            except TypeError as e:
+                feat_names = ", ".join(
+                    f"{f.name}: {f.ftype.__name__}" for f in ins)
+                declared = getattr(spec, "describe", lambda: "?")()
+                findings.append(Finding(
+                    "TMG101", f"{label} declares inputs {declared} but "
+                    f"is wired to ({feat_names}): {e}", stage=st.uid,
+                    feature=ins[0].name))
+
+        # TMG101 — a feature claiming a type its producing stage does not
+        # output (hand-built Feature nodes)
+        try:
+            out = st.get_output()
+        except ValueError:
+            out = None
+        if out is not None and id(out) in feat_ids \
+                and not issubclass(st.output_type, out.ftype) \
+                and not issubclass(out.ftype, st.output_type):
+            findings.append(Finding(
+                "TMG101", f"feature {out.name!r} claims type "
+                f"{out.ftype.__name__} but its origin {label} outputs "
+                f"{st.output_type.__name__}", stage=st.uid,
+                feature=out.name))
+
+        # TMG106 — an estimator consuming a model's Prediction output:
+        # fitting on predictions downstream of the selector is the classic
+        # estimator-after-model misuse (the reference allows at most one
+        # label-aware model chain)
+        if isinstance(st, Estimator) and any(
+                issubclass(f.ftype, Prediction) for f in ins):
+            pf = next(f for f in ins if issubclass(f.ftype, Prediction))
+            findings.append(Finding(
+                "TMG106", f"estimator {label} consumes model output "
+                f"{pf.name!r} (Prediction) — estimators must fit on "
+                "features, not on a downstream model's predictions",
+                severity=Severity.WARNING, stage=st.uid, feature=pf.name))
+
+    # TMG105 — response-leakage reachability. set_input gates DIRECT
+    # label/predictor mixing; this propagates label taint transitively, so
+    # a label-derived feature laundered through an intermediate stage is
+    # still caught. AllowLabelAsInput stages (sanity checker, selectors)
+    # are the sanctioned consumers: their outputs are considered clean.
+    bearing: Dict[int, bool] = {id(f): bool(f.is_response) for f in feats}
+    for f in feats:
+        st = f.origin_stage
+        if st is None or isinstance(st, FeatureGeneratorStage):
+            continue
+        ins = tuple(getattr(st, "input_features", ()) or ())
+        if not ins:
+            continue
+        flags = [bearing.get(id(p), bool(p.is_response)) for p in ins]
+        if isinstance(st, AllowLabelAsInput):
+            out_bearing = all(flags)
+        elif any(flags) and not all(flags):
+            leaked = [p.name for p, b in zip(ins, flags) if b]
+            findings.append(Finding(
+                "TMG105", f"response leakage: {_stage_label(st)} mixes "
+                f"label-derived feature(s) {leaked} with predictors but "
+                "is not AllowLabelAsInput — its output would leak the "
+                "label into the feature matrix", stage=st.uid,
+                feature=leaked[0]))
+            out_bearing = True
+        else:
+            out_bearing = all(flags)
+        bearing[id(f)] = bearing.get(id(f), False) or out_bearing
+
+    # fitted-model rules (WorkflowModel)
+    if fitted_stages is not None:
+        dag_uids = {st.uid for st in stages}
+        for st in stages:
+            if isinstance(st, Estimator) and not isinstance(st, Transformer) \
+                    and st.uid not in fitted_stages:
+                findings.append(Finding(
+                    "TMG106", f"unfitted estimator {_stage_label(st)} in a "
+                    "scored DAG: scoring would raise 'Estimator has no "
+                    "fitted model' at transform time", stage=st.uid))
+        for uid in fitted_stages:
+            if uid not in dag_uids:
+                findings.append(Finding(
+                    "TMG104", f"dead fitted stage [{uid}]: not reachable "
+                    "from the result features (stale checkpoint or pruned "
+                    "graph)", stage=uid))
+
+    if known_stages:
+        dag_ids = {id(st) for st in stages}
+        dag_uids = {st.uid for st in stages}
+        for st in known_stages:
+            if id(st) not in dag_ids and st.uid not in dag_uids:
+                findings.append(Finding(
+                    "TMG104", f"dead stage {_stage_label(st)}: not "
+                    "reachable from the result features", stage=st.uid))
+
+    return findings
+
+
+def check_workflow(workflow, known_stages: Optional[Sequence[Any]] = None,
+                   suppress: Iterable[str] = ()) -> List[Finding]:
+    """Static graph check (TMG1xx) over an untrained :class:`Workflow`
+    (or a bare sequence of result features). Touches no data and no
+    device — the compile-time type-safety analog."""
+    feats = getattr(workflow, "result_features", workflow)
+    return _apply_suppress(
+        _check_graph(tuple(feats), known_stages=known_stages), suppress)
+
+
+def check_model(model, device: bool = True, n_rows: int = 8,
+                suppress: Iterable[str] = ()) -> List[Finding]:
+    """Graph check (TMG1xx, incl. unfitted-estimator/dead-stage rules)
+    plus — when ``device`` — the eval_shape pre-flight (TMG2xx) over a
+    fitted :class:`WorkflowModel`."""
+    # suppression applies BEFORE the device-pass gate: a suppressed
+    # (known/accepted) graph error must not silently disable the TMG2xx
+    # shape analysis
+    findings = _apply_suppress(
+        _check_graph(model.result_features,
+                     fitted_stages=model.fitted_stages), suppress)
+    if device:
+        if any(f.severity == Severity.ERROR for f in findings):
+            # a structurally broken DAG cannot be shape-propagated
+            # meaningfully — say so instead of skipping silently
+            findings.extend(_apply_suppress([Finding(
+                "TMG204", "device pre-flight skipped: the graph rules "
+                "above found errors (fix or suppress them to get shape "
+                "analysis)")], suppress))
+        else:
+            findings.extend(_apply_suppress(
+                preflight_device(model, n_rows=n_rows), suppress))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# device pre-flight (TMG2xx) — ShapeDtypeStructs through eval_shape
+# ---------------------------------------------------------------------------
+
+
+def _placeholder_column(ftype, n: int):
+    """A synthetic n-row column of the feature's type: defaults only, no
+    dataset read. NonNullable numerics get zeros (None would violate the
+    type), raw vectors a width-1 zero matrix, everything else its empty
+    value."""
+    import numpy as np
+
+    from .columns import VectorColumn, column_from_values, column_of_empty
+    from .types.feature_types import (NonNullable, OPNumeric, OPVector,
+                                      Prediction)
+    if issubclass(ftype, OPVector):
+        return VectorColumn(OPVector, np.zeros((n, 1), dtype=np.float32),
+                            None)
+    if issubclass(ftype, Prediction):
+        # Prediction forbids an empty value (the "prediction" key is
+        # mandatory) — a zero prediction is the neutral placeholder
+        return column_from_values(ftype, [{"prediction": 0.0}] * n)
+    if issubclass(ftype, OPNumeric) and issubclass(ftype, NonNullable):
+        return column_from_values(ftype, [0.0] * n)
+    return column_of_empty(ftype, n)
+
+
+def _synthetic_store(result_features, n: int):
+    from .columns import ColumnStore
+    seen: Dict[str, Any] = {}
+    for f in result_features:
+        for raw in f.raw_features():
+            seen.setdefault(raw.name, raw.ftype)
+    return ColumnStore({name: _placeholder_column(ft, n)
+                        for name, ft in seen.items()}, n)
+
+
+def _prepared_signature(prepared: Dict[str, Any], n: int):
+    """Shape signature of a prepared-block dict with the row dimension
+    normalized out, so signatures taken at different batch sizes compare
+    equal iff the program cache would reuse one executable."""
+    import numpy as np
+    sig = []
+    for k in sorted(prepared):
+        a = np.asarray(prepared[k])
+        shape = tuple("N" if d == n else d for d in a.shape)
+        sig.append((k, str(a.dtype), shape))
+    return tuple(sig)
+
+
+def preflight_device(model, n_rows: int = 8) -> List[Finding]:
+    """TMG2xx: propagate shapes/dtypes through every layer's device
+    computes via ``jax.eval_shape`` — no dataset, no device dispatch.
+
+    Host-side stages run for real on a tiny synthetic store (cheap, pure
+    numpy); each :class:`VectorizerModel`'s ``device_compute`` and each
+    predictor's ``predict_device`` are *abstractly* evaluated, so shape
+    mismatches, f64 promotion and retrace hazards surface before the
+    first real batch compiles."""
+    import numpy as np
+
+    findings: List[Finding] = []
+    try:
+        import jax
+        import jax.numpy as jnp
+    except Exception as e:  # lint: broad-except — preflight degrades, never kills
+        findings.append(Finding(
+            "TMG204", f"device pre-flight skipped: jax unavailable ({e})"))
+        return findings
+
+    from .columns import PredictionColumn, VectorColumn
+    from .models.base import PredictorModel
+    from .ops.vectorizer_base import (VEC_DTYPE, VectorizerModel,
+                                      canonicalize_prepared)
+    from .types.feature_types import OPVector
+
+    n2 = n_rows + 3          # second probe size for the retrace check
+    store = _synthetic_store(model.result_features, n_rows)
+    store2 = _synthetic_store(model.result_features, n2)
+
+    def halt(stage, exc) -> None:
+        findings.append(Finding(
+            "TMG204", f"pre-flight stopped at {_stage_label(stage)}: no "
+            f"static form ({type(exc).__name__}: {exc})", stage=stage.uid))
+
+    try:
+        layers = model._resolved_dag()
+    except Exception as e:  # lint: broad-except — an unresolvable DAG is a coverage note here (the graph rules own the error)
+        findings.append(Finding(
+            "TMG204", f"device pre-flight skipped: the model's DAG does "
+            f"not resolve ({e})"))
+        return findings
+    for layer in layers:
+        for m in layer:
+            if isinstance(m, VectorizerModel):
+                try:
+                    raw_prep = m.host_prepare(store)
+                    scalars = sorted(k for k, v in raw_prep.items()
+                                     if isinstance(v, (int, float))
+                                     and not isinstance(v, bool))
+                    prep = canonicalize_prepared(raw_prep)
+                    prep2 = canonicalize_prepared(m.host_prepare(store2))
+                except Exception as e:  # lint: broad-except — report, don't crash pre-flight
+                    halt(m, e)
+                    return findings
+                if scalars:
+                    findings.append(Finding(
+                        "TMG203", f"{_stage_label(m)} host_prepare returns "
+                        f"bare Python scalar(s) {scalars}: a scalar traced "
+                        "by value bakes into the compiled program and a "
+                        "per-call-varying one forces a retrace per call "
+                        "(wrap in np.asarray)", stage=m.uid))
+                sig1 = _prepared_signature(prep, n_rows)
+                sig2 = _prepared_signature(prep2, n2)
+                if sig1 != sig2:
+                    moved = sorted(
+                        {k for k, _, _ in set(sig1) ^ set(sig2)})
+                    findings.append(Finding(
+                        "TMG203", f"{_stage_label(m)} prepared signature "
+                        f"varies with batch size (blocks {moved}): every "
+                        "distinct batch shape recompiles its device "
+                        "program (scoring.compile_count grows per call, "
+                        "not per bucket)", stage=m.uid))
+                structs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                           for k, v in prep.items()}
+                truncated = False
+                try:
+                    # under x32 a requested f64 never reaches the output
+                    # dtype — jax silently truncates it to f32 with a
+                    # UserWarning. Capturing that warning is the ONLY
+                    # static evidence of the promotion in the production
+                    # (TPU/x32) configuration; under x64 the dtype check
+                    # below sees it directly.
+                    import warnings as _warnings
+                    with _warnings.catch_warnings(record=True) as caught:
+                        _warnings.simplefilter("always")
+                        out = jax.eval_shape(
+                            lambda p, _m=m: _m.device_compute(jnp, p),
+                            structs)
+                    truncated = any(
+                        "truncated to dtype float32" in str(w.message)
+                        for w in caught)
+                except Exception as e:  # lint: broad-except — any eval failure IS the finding
+                    findings.append(Finding(
+                        "TMG201", f"{_stage_label(m)} device_compute fails "
+                        f"shape propagation: {type(e).__name__}: {e}",
+                        stage=m.uid))
+                    return findings
+                meta = m.vector_metadata()
+                shape = tuple(out.shape)
+                if len(shape) != 2 or shape[0] != n_rows \
+                        or shape[1] != meta.size:
+                    findings.append(Finding(
+                        "TMG201", f"{_stage_label(m)} device_compute "
+                        f"produces shape {shape}, expected "
+                        f"({n_rows}, {meta.size}) per its vector metadata",
+                        stage=m.uid))
+                    width = shape[1] if len(shape) == 2 else meta.size
+                else:
+                    width = meta.size
+                if out.dtype == np.float64 or truncated:
+                    findings.append(Finding(
+                        "TMG202", f"{_stage_label(m)} device_compute "
+                        "promotes to float64: under x32 this silently "
+                        "downcasts (and on TPU f64 is emulated) — the "
+                        "pipeline dtype is f32", stage=m.uid))
+                store = store.with_column(
+                    m.output_name,
+                    VectorColumn(OPVector,
+                                 np.zeros((n_rows, width), dtype=VEC_DTYPE),
+                                 meta))
+                store2 = store2.with_column(
+                    m.output_name,
+                    VectorColumn(OPVector,
+                                 np.zeros((n2, width), dtype=VEC_DTYPE),
+                                 meta))
+            elif isinstance(m, PredictorModel):
+                fcol = store.get(m.input_features[1].name)
+                if not isinstance(fcol, VectorColumn):
+                    halt(m, TypeError("feature input is not a vector"))
+                    return findings
+                width = fcol.values.shape[1]
+                try:
+                    pred, raw, prob = jax.eval_shape(
+                        m.predict_device,
+                        jax.ShapeDtypeStruct((n_rows, width),
+                                             np.dtype(VEC_DTYPE)))
+                except Exception as e:  # lint: broad-except — report, don't crash pre-flight
+                    halt(m, e)
+                    return findings
+                if tuple(pred.shape) != (n_rows,):
+                    findings.append(Finding(
+                        "TMG201", f"{_stage_label(m)} predict_device "
+                        f"prediction shape {tuple(pred.shape)}, expected "
+                        f"({n_rows},)", stage=m.uid))
+                k = raw.shape[1] if len(raw.shape) == 2 else 0
+                pcol = PredictionColumn(
+                    np.zeros((n_rows,)), np.zeros((n_rows, k)),
+                    np.zeros((n_rows, k)))
+                pcol2 = PredictionColumn(
+                    np.zeros((n2,)), np.zeros((n2, k)), np.zeros((n2, k)))
+                store = store.with_column(m.output_name, pcol)
+                store2 = store2.with_column(m.output_name, pcol2)
+            else:
+                # host-only stage: run it for real on the tiny store
+                try:
+                    store = m.transform(store)
+                    store2 = m.transform(store2)
+                except Exception as e:  # lint: broad-except — report, don't crash pre-flight
+                    halt(m, e)
+                    return findings
+    return findings

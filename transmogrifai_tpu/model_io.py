@@ -290,7 +290,7 @@ def save_workflow_model(model, path: str, overwrite: bool = False) -> None:
     # orphaned weights from prior/torn saves: skip any npz whose .pending
     # sidecar still exists (a live concurrent saver), age-gate the rest;
     # stale sidecars (crashed savers) fall to a 24h gate with their npz
-    now = time.time()
+    now = time.time()   # lint: wall-clock — compared against file mtimes
     for fn in os.listdir(path):
         full = os.path.join(path, fn)
         try:

@@ -74,7 +74,7 @@ def warm_probe_async() -> None:
     def _go():
         try:
             pallas_histograms_enabled()
-        except Exception:           # probe failures fall back at consult
+        except Exception:  # lint: broad-except — probe failures fall back at consult
             pass
     _threading.Thread(target=_go, name="pallas-probe-warm",
                       daemon=True).start()
@@ -531,7 +531,7 @@ def with_pallas_fallback(build):
     with the gate on, flip the gate off and run it once more."""
     try:
         return build()
-    except Exception as e:
+    except Exception as e:  # lint: broad-except — Mosaic/backend rejection falls back to XLA
         if disable_pallas_histograms(e):
             return build()
         raise
@@ -581,7 +581,7 @@ def _probe_locked(detector) -> bool:
             _PROBE = bool(np.asarray(out).shape == (2, 3, 2, 4))
             logger.info("pallas histogram kernel %s (compile probe)",
                         "enabled" if _PROBE else "disabled")
-        except Exception as e:  # Mosaic/backend failure → XLA path
+        except Exception as e:  # lint: broad-except — Mosaic/backend failure → XLA path
             if detector is None:
                 # can't tell an eager failure from a mid-trace one (the
                 # private trace-state API moved): fall back for THIS

@@ -148,17 +148,17 @@ class PredictorModel(FittedModel, AllowLabelAsInput):
                 return host(X)
         log = logging.getLogger(__name__)
         if log.isEnabledFor(logging.INFO) and getattr(X, "size", 0) > 1e6:
-            t0 = time.time()
+            t0 = time.perf_counter()
             Xd = device_put_f32(X)
             jax.block_until_ready(Xd)
-            t1 = time.time()
+            t1 = time.perf_counter()
             dev = self.predict_device(Xd)
             jax.block_until_ready(dev)
-            t2 = time.time()
+            t2 = time.perf_counter()
             out = pull_f64(dev)
             log.info("predict_arrays n=%d: upload %.2fs compute %.2fs "
                      "pull %.2fs", X.shape[0], t1 - t0, t2 - t1,
-                     time.time() - t2)
+                     time.perf_counter() - t2)
             return out
         return pull_f64(self.predict_device(device_put_f32(X)))
 

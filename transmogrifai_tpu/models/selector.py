@@ -274,7 +274,7 @@ class ModelSelector(PredictorEstimator):
         import logging as _logging
         import time as _time
         _log = _logging.getLogger(__name__)
-        tr0 = _time.time()
+        tr0 = _time.perf_counter()
         single = best_family.clone_single(best_hparams)
         from .base import device_put_f32
         Xd = device_put_f32(Xk)
@@ -302,7 +302,7 @@ class ModelSelector(PredictorEstimator):
         # pulls each pay the device link's round-trip latency)
         params, pred, prob = jax.device_get((params, pred_d, prob_d))
         _log.info("final refit (fit+train-predict+pull): %.2fs",
-                  _time.time() - tr0)
+                  _time.perf_counter() - tr0)
         inner = single.realize(_index_pytree(params, 0), best_hparams)
 
         # train evaluation over the rows the model was actually trained on

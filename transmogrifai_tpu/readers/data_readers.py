@@ -399,7 +399,7 @@ def stream_score(model, batches: Iterable[Sequence[Mapping[str, Any]]],
             with telemetry.span("stream:score_batch", rows=len(batch)):
                 out = model.score(list(batch),
                                   keep_intermediate=keep_intermediate)
-        except Exception as e:
+        except Exception as e:  # lint: broad-except — poison batch quarantines, never kills the stream
             # the records ride in the dead letter: unlike a quarantined
             # FILE (still on disk), a consumed stream batch exists
             # nowhere else — without them the sink is only a tombstone

@@ -84,7 +84,9 @@ class DirectoryStreamReader:
 
     def _ready(self, fp: str) -> bool:
         try:
-            return (time.time() - os.path.getmtime(fp)) >= self.settle_s
+            # mtime comparison: MUST stay on the wall clock — file
+            # mtimes and perf_counter share no epoch
+            return (time.time() - os.path.getmtime(fp)) >= self.settle_s  # lint: wall-clock
         except OSError:
             return False        # vanished between glob and stat
 
@@ -126,7 +128,7 @@ class DirectoryStreamReader:
                 # the readable files behind it
                 self._seen.add(fp)
                 raise
-            except Exception as e:
+            except Exception as e:  # lint: broad-except — ANY read failure quarantines, never wedges the stream
                 logging.getLogger(__name__).warning(
                     "stream reader quarantining unreadable file %s",
                     fp, exc_info=True)
@@ -152,7 +154,7 @@ class DirectoryStreamReader:
                timeout_s: Optional[float] = None
                ) -> Iterator[List[Dict[str, Any]]]:
         """Yield per-file record batches as files appear."""
-        t0 = time.time()
+        t0 = time.perf_counter()
         n = 0
         while True:
             recs = self._take_next()
@@ -163,7 +165,8 @@ class DirectoryStreamReader:
                     if max_batches is not None and n >= max_batches:
                         return
                 continue            # drain without sleeping
-            if timeout_s is not None and time.time() - t0 >= timeout_s:
+            if timeout_s is not None \
+                    and time.perf_counter() - t0 >= timeout_s:
                 return
             time.sleep(self.poll_interval_s)
 

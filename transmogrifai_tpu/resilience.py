@@ -54,6 +54,7 @@ from . import telemetry
 logger = logging.getLogger(__name__)
 
 __all__ = [
+    "FAULT_SITES",
     "FaultPlan", "inject", "install_plan", "clear_plan", "fault_plan",
     "active_plan",
     "RetryPolicy", "READER_RETRY", "CHECKPOINT_RETRY",
@@ -98,6 +99,23 @@ def _tally(key: str, n: int = 1) -> None:
 # ---------------------------------------------------------------------------
 # fault-injection harness
 # ---------------------------------------------------------------------------
+
+#: the catalog of registered fault sites — every ``inject(site)`` marker in
+#: product code MUST name one of these (enforced statically by
+#: ``tools/tmoglint.py`` rule TMG303: a typo'd site is a chaos test that
+#: silently never fires). Adding a site = adding it here, placing the
+#: ``inject`` marker, and documenting it in docs/robustness.md.
+FAULT_SITES = frozenset({
+    "stream.poll",               # directory-stream listing (streaming.py)
+    "stream.read_file",          # per-file stream read (streaming.py)
+    "stream.score_batch",        # per-batch scoring (data_readers/scoring)
+    "avro.decode",               # avro container decode (readers/avro.py)
+    "csv.decode",                # csv decode (readers/data_readers.py)
+    "fitstats.device_pass",      # fused fit-stats device tier (fitstats.py)
+    "scoring.device_dispatch",   # compiled engine dispatch (scoring.py)
+    "checkpoint.write",          # layer-checkpoint save (workflow.py)
+    "checkpoint.rename",         # layer-checkpoint swap (workflow.py)
+})
 
 
 class _SiteFault:
@@ -551,7 +569,9 @@ def quarantine(site: str, reason: str, kind: str = "records",
                    or "")
     sink = _SINK
     if sink is not None:
-        sink.write({"ts": time.time(), "site": site, "kind": kind,
+        # dead-letter timestamps are epoch wall-clock BY CONTRACT (the
+        # JSONL is read by humans/replayers, not compared to perf_counter)
+        sink.write({"ts": time.time(), "site": site, "kind": kind,  # lint: wall-clock
                     "count": count, "reason": reason, **payload})
 
 

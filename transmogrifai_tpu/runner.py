@@ -149,7 +149,7 @@ def _enable_compile_cache(path: str) -> str:
     try:
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           0.5)
-    except Exception:       # older jax without the knob
+    except Exception:  # lint: broad-except — older jax without the knob
         logger.debug("persistent-cache min-compile-time knob unavailable")
     logger.info("persistent XLA compile cache at %s", path)
     return path
@@ -190,6 +190,51 @@ class OpWorkflowRunner:
         self.evaluation_reader = evaluation_reader or scoring_reader
         self.evaluator = evaluator
         self.features_to_compute = features_to_compute
+
+    # -- pre-flight (lint.py, on by default) -------------------------------
+    def _preflight(self, params: "OpParams", workflow=None,
+                   model=None) -> Optional[Dict[str, Any]]:
+        """Static pre-flight check BEFORE any reader I/O: the graph rules
+        over an untrained workflow (Train), graph + eval_shape device
+        rules over a loaded model (Score/Evaluate/Features/Streaming).
+
+        On by default; ``customParams.validate: false`` disables,
+        ``customParams.failOn`` (or CLI ``--fail-on``) picks the gating
+        severity (default ``error`` — warnings log but don't block),
+        ``customParams.validateDevice: false`` skips the TMG2xx pass and
+        ``customParams.lintSuppress: [rule, ...]`` mutes specific rules.
+        Findings mirror into telemetry (``lint.*`` counters, ``on_lint``)
+        and the returned summary rides in the run's metrics doc."""
+        from . import lint
+        validate = params.custom_params.get("validate", True)
+        if validate in (False, 0) or str(validate).lower() == "false":
+            return None
+        fail_on = str(params.custom_params.get("failOn", "error")).lower()
+        suppress = params.custom_params.get("lintSuppress", ())
+        device = params.custom_params.get("validateDevice", True)
+        device = not (device in (False, 0)
+                      or str(device).lower() == "false")
+        with telemetry.span("run:preflight"):
+            if workflow is not None:
+                findings = lint.check_workflow(workflow, suppress=suppress)
+            else:
+                findings = lint.check_model(model, device=device,
+                                            suppress=suppress)
+        lint.emit_findings(findings)
+        for f in findings:
+            log = (logger.error if f.severity == "error" else
+                   logger.warning if f.severity == "warning" else
+                   logger.info)
+            log("pre-flight: %s", f.format())
+        counts: Dict[str, int] = {}
+        for f in findings:
+            counts[f.severity] = counts.get(f.severity, 0) + 1
+        summary = {"findings": len(findings), "failOn": fail_on, **counts}
+        if not findings:
+            logger.info("pre-flight: workflow graph clean (0 findings)")
+        lint.enforce(findings, fail_on=fail_on)
+        self._last_preflight = summary
+        return summary
 
     # -- metrics sink ------------------------------------------------------
     @staticmethod
@@ -257,6 +302,7 @@ class OpWorkflowRunner:
                     params.model_location, params.write_location)
         # the tallies are process-cumulative; the run doc must report
         # THIS run's events, not a predecessor's quarantines
+        self._last_preflight = None
         res_before = resilience.resilience_stats()
         t0 = time.perf_counter()
         telemetry.emit("run_start", run_type=run_type)
@@ -278,6 +324,9 @@ class OpWorkflowRunner:
                     # (None when no persistent cache was configured)
                     result.metrics["compileCacheDir"] = (
                         str(cache_dir) if cache_dir else None)
+                    # pre-flight verdict rides in every metrics doc
+                    # (None = validation disabled for this run)
+                    result.metrics["preflight"] = self._last_preflight
                     # quarantine / retry / breaker evidence rides too —
                     # the always-on tallies make silent data loss
                     # visible in every run doc, telemetry on or off
@@ -300,7 +349,7 @@ class OpWorkflowRunner:
                     # (best-effort — never mask the run's exception)
                     try:
                         telemetry.write_trace(params.trace_location)
-                    except Exception:
+                    except Exception:  # lint: broad-except — best-effort crash trace, never mask the run error
                         logger.exception("trace write failed")
             finally:
                 if run_scoped:
@@ -316,6 +365,9 @@ class OpWorkflowRunner:
                  t0: float) -> RunnerResult:
         if run_type == RunType.TRAIN:
             params.apply_to_workflow(self.workflow)
+            # the compile-time-type-safety analog: a mis-wired DAG is
+            # rejected HERE, before the reader touches a byte
+            self._preflight(params, workflow=self.workflow)
             if self.training_reader is not None:
                 self.workflow.set_reader(self.training_reader)
             model = self.workflow.train()
@@ -334,6 +386,9 @@ class OpWorkflowRunner:
         if params.model_location is None:
             raise ValueError(f"{run_type} requires modelLocation")
         model = WorkflowModel.load(params.model_location)
+        # graph + eval_shape device pre-flight on the loaded model,
+        # before the scoring/evaluation reader does any I/O
+        self._preflight(params, model=model)
 
         if run_type == RunType.SCORE:
             reader = self.scoring_reader
@@ -586,6 +641,13 @@ class OpApp:
                              "scoring batches land here with a reason "
                              "instead of being dropped (see "
                              "docs/robustness.md)")
+        ap.add_argument("--fail-on", choices=("error", "warning"),
+                        help="pre-flight gating severity (lint.py): "
+                             "'error' (default) blocks only on errors, "
+                             "'warning' blocks on warnings too")
+        ap.add_argument("--no-validate", action="store_true",
+                        help="skip the on-by-default static pre-flight "
+                             "check (customParams.validate: false)")
         ap.add_argument("--quiet", action="store_true",
                         help="suppress INFO progress logging")
         args = ap.parse_args(argv)
@@ -608,4 +670,8 @@ class OpApp:
             params.custom_params["compileCacheDir"] = args.compile_cache_dir
         if args.quarantine_out:
             params.quarantine_location = args.quarantine_out
+        if args.fail_on:
+            params.custom_params["failOn"] = args.fail_on
+        if args.no_validate:
+            params.custom_params["validate"] = False
         return self.runner(params).run(args.run_type, params)

@@ -732,7 +732,7 @@ def stream_score_overlapped(model, batches, keep_intermediate: bool = False,
             try:
                 yield model.score(list(batch),
                                   keep_intermediate=keep_intermediate)
-            except Exception as e:
+            except Exception as e:  # lint: broad-except — poison batch quarantines (no-engine path)
                 resilience.quarantine_batch_or_raise(on_error, i, e,
                                                      batch)
         return
@@ -768,7 +768,7 @@ def stream_score_overlapped(model, batches, keep_intermediate: bool = False,
                 cur_batch = fut_batch
                 try:
                     prep = fut.result()
-                except Exception as e:
+                except Exception as e:  # lint: broad-except — poison batch quarantines (prep tier)
                     resilience.quarantine_batch_or_raise(on_error, idx,
                                                          e, cur_batch)
                     prep = None
@@ -800,7 +800,7 @@ def stream_score_overlapped(model, batches, keep_intermediate: bool = False,
                                 results_only=not keep_intermediate)
                         if brk is not None:
                             brk.record_success()
-                    except Exception:
+                    except Exception:  # lint: broad-except — breaker-governed device-tier fallback
                         if brk is not None:
                             brk.record_failure()
                         logger.exception(
@@ -814,7 +814,7 @@ def stream_score_overlapped(model, batches, keep_intermediate: bool = False,
                             cur_batch,
                             keep_intermediate=keep_intermediate,
                             engine=False)
-                    except Exception as e:
+                    except Exception as e:  # lint: broad-except — both tiers rejected: batch quarantines
                         # both tiers rejected it: now it is poison
                         resilience.quarantine_batch_or_raise(
                             on_error, cur, e, cur_batch,

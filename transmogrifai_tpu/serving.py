@@ -106,7 +106,7 @@ def _deserialize_blob(payload: bytes, path: str):
     from jax import export as jexport
     try:
         return jexport.deserialize(payload)
-    except Exception as e:
+    except Exception as e:  # lint: broad-except — wrap-and-reraise with artifact context
         raise ValueError(
             f"corrupt serving artifact at {path!r}: StableHLO "
             f"deserialization failed ({type(e).__name__}: {e}); the "

@@ -56,12 +56,21 @@ class InputSpec:
     def check(self, features: Sequence[Feature]) -> None:
         raise NotImplementedError
 
+    def describe(self) -> str:
+        """Human-readable form of the declared contract — the static
+        checker (lint.py TMG101) quotes it next to the actual wired
+        feature types so a mis-typed edge names both sides."""
+        return "?"
+
 
 class FixedArity(InputSpec):
     """Exactly len(types) inputs, positionally typed (OpPipelineStage1..4)."""
 
     def __init__(self, *types: Type[FeatureType]):
         self.types = types
+
+    def describe(self) -> str:
+        return "(" + ", ".join(t.__name__ for t in self.types) + ")"
 
     def check(self, features: Sequence[Feature]) -> None:
         if len(features) != len(self.types):
@@ -83,6 +92,12 @@ class VarArity(InputSpec):
         self.seq_type = seq_type
         self.head_types = tuple(head_types)
         self.min_seq = min_seq
+
+    def describe(self) -> str:
+        seq = (self.seq_type.__name__ if isinstance(self.seq_type, type)
+               else "|".join(t.__name__ for t in self.seq_type))
+        head = ", ".join(t.__name__ for t in self.head_types)
+        return f"({head}{', ' if head else ''}{seq}*)"
 
     def check(self, features: Sequence[Feature]) -> None:
         n_head = len(self.head_types)

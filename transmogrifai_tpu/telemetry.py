@@ -253,7 +253,7 @@ def _is_coordinator() -> bool:
     try:
         from .parallel.multihost import is_coordinator
         return is_coordinator()
-    except Exception:
+    except Exception:  # lint: broad-except — no jax runtime yet: single process by definition
         return True      # no jax runtime yet — single process by definition
 
 
@@ -544,6 +544,14 @@ class RunListener:
         the host fallback until the reset timeout (resilience.py)."""
         pass
 
+    def on_lint(self, rule: str, severity: str, message: str = "",
+                **_: Any) -> None:
+        """One pre-flight lint finding (lint.py / docs/static-analysis.md):
+        ``rule`` is the stable TMGnnn id, ``severity`` is
+        error/warning/info; stage uid / feature name / file location ride
+        in the extra kwargs when the rule has them."""
+        pass
+
 
 _LISTENERS: List[RunListener] = []
 
@@ -581,7 +589,7 @@ def emit(event: str, /, **info: Any) -> None:
             continue
         try:
             fn(**info)
-        except Exception:
+        except Exception:  # lint: broad-except — observability must never take down the run
             logger.exception("telemetry listener %r failed on %s",
                              l, event)
 
@@ -607,6 +615,7 @@ class CollectingRunListener(RunListener):
         self.retries = 0
         self.quarantined: Dict[str, int] = {}
         self.breaker_trips = 0
+        self.lint_findings: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     def on_run_start(self, run_type: str, **_: Any) -> None:
@@ -676,6 +685,13 @@ class CollectingRunListener(RunListener):
             self.events.append("breaker_trip")
             self.breaker_trips += 1
 
+    def on_lint(self, rule: str, severity: str, message: str = "",
+                **_: Any) -> None:
+        with self._lock:
+            self.events.append("lint")
+            self.lint_findings[severity] = \
+                self.lint_findings.get(severity, 0) + 1
+
     def summary(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -694,6 +710,7 @@ class CollectingRunListener(RunListener):
                 "retries": self.retries,
                 "quarantined": dict(self.quarantined),
                 "breakerTrips": self.breaker_trips,
+                "lintFindings": dict(self.lint_findings),
             }
 
 

@@ -323,7 +323,7 @@ class Base64(Text):
         import base64 as _b64
         try:
             return _b64.b64decode(self._value)
-        except Exception:
+        except ValueError:      # binascii.Error: malformed base64
             return None
 
 

@@ -335,17 +335,28 @@ class Workflow:
     # -- validation (OpWorkflow.scala:265-323) -----------------------------
     def _validate_dag(self) -> None:
         from .models.selector import ModelSelector
-        stages = [s for layer in compute_dag(self.result_features, True)
-                  for s in layer]
-        uids = [s.uid for s in stages]
-        if len(uids) != len(set(uids)):
-            dupes = sorted({u for u in uids if uids.count(u) > 1})
-            raise WorkflowError(f"Duplicate stage uids in DAG: {dupes}")
+        try:
+            stages = [s for layer in compute_dag(self.result_features, True)
+                      for s in layer]
+        except ValueError as e:
+            # compute_dag detects distinct stages sharing one uid (the
+            # silent-collapse bug lint rule TMG102 also surfaces)
+            raise WorkflowError(str(e)) from e
         selectors = [s for s in stages if isinstance(s, ModelSelector)]
         if len(selectors) > 1:
             raise WorkflowError(
                 f"Workflow can contain at most 1 ModelSelector "
                 f"(FitStagesUtil.scala:313), found {len(selectors)}")
+
+    def validate(self, suppress=()) -> list:
+        """Static pre-flight check (lint.py TMG1xx graph rules): returns
+        structured :class:`~transmogrifai_tpu.lint.Finding` records for
+        type-flow mismatches, duplicate uids, cycles, response leakage
+        and estimator misuse — BEFORE any data is read. The runner calls
+        this by default (``OpParams.customParams.validate``); callers
+        gate on the result with ``lint.enforce(findings)``."""
+        from . import lint
+        return lint.check_workflow(self, suppress=suppress)
 
     # -- training ----------------------------------------------------------
     def train(self) -> "WorkflowModel":
@@ -443,7 +454,7 @@ class Workflow:
                              f"{resume_from}.old")):
                 try:
                     partial = WorkflowModel.load(resume_from)
-                except Exception:
+                except Exception:  # lint: broad-except — unusable checkpoint degrades to a fresh fit
                     logger.exception(
                         "checkpoint at %s is unusable; fitting from "
                         "scratch", resume_from)
@@ -500,7 +511,7 @@ class Workflow:
                 continue
             try:
                 reqs = stage.stat_requests(train)
-            except Exception:
+            except Exception:  # lint: broad-except — a failing opt-in degrades to the sequential fit
                 logger.exception(
                     "stat_requests failed for %s; it fits sequentially",
                     stage.stage_name())
@@ -535,7 +546,7 @@ class Workflow:
                 li, len(requests), plan.n_requests,
                 time.perf_counter() - tp)
             return stats, set(requests)
-        except Exception:
+        except Exception:  # lint: broad-except — fused pass is an optimization, never a dependency
             logger.exception(
                 "layer %d: fused fit-stats pass failed; estimators fit "
                 "sequentially", li)
@@ -785,6 +796,17 @@ class WorkflowModel:
             raise WorkflowError(f"{feature.name!r} is a raw feature")
         return self.fitted_stages.get(st.uid, st)
 
+    def validate(self, device: bool = True, suppress=()) -> list:
+        """Static pre-flight check over the fitted model (lint.py):
+        TMG1xx graph rules (incl. unfitted-estimator / dead-stage
+        checks) plus — with ``device`` — the TMG2xx eval_shape
+        pre-flight, which propagates ``jax.ShapeDtypeStruct``s through
+        every layer's device computes without reading data or touching a
+        device. Returns :class:`~transmogrifai_tpu.lint.Finding`
+        records; the runner calls this before score-type runs."""
+        from . import lint
+        return lint.check_model(self, device=device, suppress=suppress)
+
     # -- scoring -----------------------------------------------------------
 
     def _engine_breaker(self):
@@ -815,7 +837,7 @@ class WorkflowModel:
             from .scoring import ScoringEngine
             try:
                 eng = ScoringEngine(self, **engine_kw)
-            except Exception:
+            except Exception:  # lint: broad-except — engine build failure falls back to the per-layer path
                 logger.exception("scoring engine build failed; "
                                  "per-layer path stays active")
                 self._engine_breaker().record_failure()
@@ -900,7 +922,7 @@ class WorkflowModel:
                     out = self.scoring_engine().transform_store(data)
                     self._engine_breaker().record_success()
                     return out
-                except Exception:
+                except Exception:  # lint: broad-except — breaker-governed device-tier fallback
                     self._engine_breaker().record_failure()
                     logger.exception(
                         "scoring engine transform failed; falling back "
@@ -921,7 +943,7 @@ class WorkflowModel:
                     out = self.scoring_engine().score_store(data)
                     self._engine_breaker().record_success()
                     return out
-                except Exception:
+                except Exception:  # lint: broad-except — breaker-governed device-tier fallback
                     self._engine_breaker().record_failure()
                     logger.exception(
                         "scoring engine score failed; falling back to "
