@@ -425,6 +425,150 @@ def _fit_stats() -> dict:
     }
 
 
+def _multichip_scaling() -> dict:
+    """Multichip-promotion proof (ROADMAP #1): the SAME fitstats fold
+    pass, CV sweep and engine-scoring batch run at 1 device and at all N
+    visible devices via the process mesh, reporting rows/s per leg and a
+    ``scaling_efficiency`` ratio (rate_N / (N × rate_1); near-linear ≥
+    0.7). The CV leg additionally asserts the sharded sweep picks the
+    SAME winner with the SAME cv_metric as the single-device run — the
+    mesh must buy throughput, never answers."""
+    import statistics as _stats
+
+    import jax
+    import numpy as np
+
+    from transmogrifai_tpu import (ColumnStore, FeatureBuilder, Workflow,
+                                   column_from_values)
+    from transmogrifai_tpu.fitstats import LayerStatsPlan, StatRequest
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import \
+        BinaryClassificationModelSelector
+    from transmogrifai_tpu.models.tuning import CrossValidation
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.parallel import mesh as pmesh
+    from transmogrifai_tpu.types import feature_types as ft
+
+    n_dev = len(jax.devices())
+    out: dict = {"n_devices": n_dev,
+                 "mesh": pmesh.mesh_topology()}
+    if n_dev < 2:
+        out["status"] = "skipped_single_device"
+        return out
+    mesh1 = pmesh.make_mesh(n_devices=1)       # degenerate 1×1
+    meshN = pmesh.process_default_mesh()
+
+    def _rate(fn, rows, reps=3):
+        fn()                                   # warm-up (compile) pass
+        secs = []
+        for _ in range(reps):
+            t0 = time.time()
+            fn()
+            secs.append(time.time() - t0)
+        return rows / _stats.median(secs)
+
+    rng = np.random.default_rng(23)
+
+    # -- fitstats fold pass: rows/s of the device stats tier ------------
+    fs_rows = int(os.environ.get("BENCH_MESH_FITSTATS_ROWS", 2_000_000))
+    k = 8
+    store = ColumnStore(
+        {f"x{j}": column_from_values(ft.Real,
+                                     rng.normal(size=fs_rows) * (j + 1))
+         for j in range(k)}, fs_rows)
+    plan = LayerStatsPlan(
+        [StatRequest(kind, f"x{j}") for j in range(k)
+         for kind in ("count", "mean", "variance", "min", "max")],
+        n_stages=k)
+    r1 = _rate(lambda: plan.run(store, device=True, mesh=mesh1), fs_rows)
+    rN = _rate(lambda: plan.run(store, device=True, mesh=meshN), fs_rows)
+    out["fitstats"] = {
+        "rows": fs_rows,
+        "rows_per_s_1dev": round(r1), "rows_per_s_ndev": round(rN),
+        "scaling_efficiency": round(rN / (n_dev * r1), 3)}
+
+    # -- CV sweep: sharded run must reproduce the single-device answer --
+    cv_rows = int(os.environ.get("BENCH_MESH_CV_ROWS", 200_000))
+    y = rng.integers(0, 2, cv_rows).astype(float)
+    X = rng.normal(size=(cv_rows, 12))
+    X[:, :4] += 0.4 * y[:, None]
+    grid = [{"regParam": r, "elasticNetParam": 0.0}
+            for r in (0.0, 0.01, 0.1, 0.3)]
+
+    def sweep(mesh):
+        cv = CrossValidation(num_folds=3, metric_name="AuROC",
+                             task="binary", seed=7)
+        return cv.validate([LogisticRegressionFamily(grid=list(grid))],
+                           X, y, mesh=mesh)
+    t0 = time.time()
+    _f1, hp1, summ1 = sweep(mesh1)
+    cv_s_1 = time.time() - t0
+    t0 = time.time()
+    _fN, hpN, summN = sweep(meshN)
+    cv_s_n = time.time() - t0
+    m1 = summ1.best.mean_metric
+    mN = summN.best.mean_metric
+    out["cv"] = {
+        "rows": cv_rows, "s_1dev": round(cv_s_1, 3),
+        "s_ndev": round(cv_s_n, 3),
+        "winner_1dev": summ1.best.family_name,
+        "winner_ndev": summN.best.family_name,
+        "winner_match": summ1.best.family_name == summN.best.family_name,
+        "best_params_match": hp1 == hpN,
+        "cv_metric_1dev": m1, "cv_metric_ndev": mN,
+        "cv_metric_match": bool(m1 == mN
+                                or abs(m1 - mN) <= 1e-6 * max(1.0, abs(m1)))}
+
+    # -- engine scoring: data-sharded bucket dispatch -------------------
+    sc_rows = int(os.environ.get("BENCH_MESH_SCORE_ROWS", 200_000))
+    ys = rng.integers(0, 2, sc_rows).astype(float)
+    xs = {f"s{j}": rng.normal(size=sc_rows) + 0.3 * j * ys
+          for j in range(6)}
+
+    def store_of(sl):
+        cols = {"label": column_from_values(ft.RealNN, ys[sl])}
+        for kk, v in xs.items():
+            cols[kk] = column_from_values(ft.Real, list(v[sl]))
+        return ColumnStore(cols, len(ys[sl]))
+
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = [FeatureBuilder.Real(f"s{j}").from_column().as_predictor()
+             for j in range(6)]
+    vec = transmogrify(feats)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily(
+            grid=[{"regParam": 0.01, "elasticNetParam": 0.0}])],
+        splitter=None, seed=5)
+    pred = label.transform_with(sel, vec)
+    model = (Workflow().set_input_store(store_of(slice(0, 20_000)))
+             .set_result_features(pred).train())
+    full = store_of(slice(0, sc_rows))
+    eng1 = model.scoring_engine(mesh=mesh1)
+    engN = model.scoring_engine(mesh=meshN)
+    if eng1 is None or engN is None or not eng1.enabled():
+        out["engine"] = ("unavailable" if eng1 is None or engN is None
+                         else "gated_off: link below "
+                              "FUSE_MIN_BANDWIDTH_MBPS")
+    else:
+        prep1 = eng1.prepare_batch(full)
+        prepN = engN.prepare_batch(full)
+        e1 = _rate(lambda: eng1.run_batch(prep1), sc_rows)
+        eN = _rate(lambda: engN.run_batch(prepN), sc_rows)
+        out["engine"] = {
+            "rows": sc_rows,
+            "rows_per_s_1dev": round(e1), "rows_per_s_ndev": round(eN),
+            "scaling_efficiency": round(eN / (n_dev * e1), 3)}
+
+    eff = [out["fitstats"]["scaling_efficiency"]]
+    if isinstance(out.get("engine"), dict):
+        eff.append(out["engine"]["scaling_efficiency"])
+    out["pass"] = bool(all(e >= 0.7 for e in eff)
+                       and out["cv"]["cv_metric_match"]
+                       and out["cv"]["winner_match"]
+                       and out["cv"]["best_params_match"])
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -445,6 +589,10 @@ def main() -> None:
     doc = bench.doc
     doc["backend"] = backend
     doc["n_devices"] = len(jax.devices())
+    # the process mesh every heavy phase shards over (PR 6: multichip is
+    # the mainline substrate — every benched number states its topology)
+    from transmogrifai_tpu.parallel.mesh import mesh_topology
+    doc["mesh"] = mesh_topology()
     configs = doc["configs"]
     reps = int(os.environ.get("BENCH_WARM_REPS", 3))
 
@@ -548,6 +696,29 @@ def main() -> None:
         except Exception as e:
             _log(f"[bench] fit_stats failed: {e!r}")
             configs["fit_stats"] = {"error": repr(e)[:400]}
+    bench.emit()
+
+    # 4d. Multichip scaling (the mesh-promotion proof): fitstats pass,
+    #     CV sweep and engine scoring at 1 vs N devices — rows/s,
+    #     scaling_efficiency, and single-device answer parity. Budget-
+    #     gated like the other optional stages; trivially skipped on a
+    #     single chip.
+    if len(jax.devices()) < 2:
+        configs["multichip_scaling"] = {
+            "status": "skipped_single_device",
+            "n_devices": len(jax.devices())}
+    elif bench.remaining() < 150:
+        configs["multichip_scaling"] = {
+            "status": "skipped_budget",
+            "remaining_budget_s": round(bench.remaining(), 1)}
+        _log(f"[bench] multichip_scaling skipped: remaining "
+             f"{bench.remaining():.0f}s < 150s")
+    else:
+        try:
+            configs["multichip_scaling"] = _multichip_scaling()
+        except Exception as e:
+            _log(f"[bench] multichip_scaling failed: {e!r}")
+            configs["multichip_scaling"] = {"error": repr(e)[:400]}
     bench.emit()
 
     # 5. Synthetic tree grid at scale (the BASELINE scale config: default

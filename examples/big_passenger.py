@@ -100,12 +100,9 @@ def build_features():
 
 def run(n_rows: int = 30_000, num_folds: int = 3, families=None,
         mesh=None, seed: int = 42):
-    import jax
-
-    if mesh is None and len(jax.devices()) > 1:
-        from transmogrifai_tpu.parallel.mesh import make_mesh
-        mesh = make_mesh()
-    mesh = mesh or None   # mesh=False forces single-device
+    # mesh=None: Workflow.train resolves the process-default mesh
+    # (PR 6 — multichip is the mainline substrate); mesh=False
+    # forces single-device; an explicit Mesh pins the topology.
     survived, checked = build_features()
     if families is None:
         families = [LogisticRegressionFamily()]
@@ -114,7 +111,7 @@ def run(n_rows: int = 30_000, num_folds: int = 3, families=None,
         num_folds=num_folds, validation_metric="AuPR", families=families,
         splitter=DataBalancer(sample_fraction=0.1,
                               reserve_test_fraction=0.1, seed=seed),
-        seed=seed, mesh=mesh)
+        seed=seed, mesh=mesh or None)
     prediction = survived.transform_with(selector, checked)
 
     tp0 = time.time()
@@ -123,6 +120,8 @@ def run(n_rows: int = 30_000, num_folds: int = 3, families=None,
           .set_input_records(records)
           .set_result_features(prediction)
           .set_splitter(selector.splitter))
+    if mesh is not None:
+        wf.set_mesh(mesh)   # Mesh pins topology, False forces off
     prep_s = time.time() - tp0
 
     t0 = time.time()

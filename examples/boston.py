@@ -45,14 +45,11 @@ def build_features():
 
 def run(data_path: str = DEFAULT_DATA, num_folds: int = 3, families=None,
         mesh=None, seed: int = 42):
-    import jax
-
     from transmogrifai_tpu.models.trees import GBTFamily, RandomForestFamily
 
-    if mesh is None and len(jax.devices()) > 1:
-        from transmogrifai_tpu.parallel.mesh import make_mesh
-        mesh = make_mesh()
-    mesh = mesh or None   # mesh=False forces single-device
+    # mesh=None: Workflow.train resolves the process-default mesh
+    # (PR 6 — multichip is the mainline substrate); mesh=False
+    # forces single-device; an explicit Mesh pins the topology.
     medv, features = build_features()
     if families is None:
         families = [RandomForestFamily(task="regression"),
@@ -61,7 +58,7 @@ def run(data_path: str = DEFAULT_DATA, num_folds: int = 3, families=None,
     selector = RegressionModelSelector.with_cross_validation(
         num_folds=num_folds, families=families,
         splitter=DataSplitter(reserve_test_fraction=0.1, seed=seed),
-        seed=seed, mesh=mesh)
+        seed=seed, mesh=mesh or None)
     prediction = medv.transform_with(selector, features)
 
     records = load_records(data_path)
@@ -69,6 +66,8 @@ def run(data_path: str = DEFAULT_DATA, num_folds: int = 3, families=None,
           .set_input_records(records)
           .set_result_features(prediction)
           .set_splitter(selector.splitter))
+    if mesh is not None:
+        wf.set_mesh(mesh)   # Mesh pins topology, False forces off
 
     t0 = time.time()
     model = wf.train()

@@ -44,18 +44,15 @@ def build_features():
 
 def run(csv_path: str = DEFAULT_CSV, num_folds: int = 3, families=None,
         mesh=None, seed: int = 42):
-    import jax
-
-    if mesh is None and len(jax.devices()) > 1:
-        from transmogrifai_tpu.parallel.mesh import make_mesh
-        mesh = make_mesh()
-    mesh = mesh or None   # mesh=False forces single-device
+    # mesh=None: Workflow.train resolves the process-default mesh
+    # (PR 6 — multichip is the mainline substrate); mesh=False
+    # forces single-device; an explicit Mesh pins the topology.
     iris_class, labels, features = build_features()
 
     selector = MultiClassificationModelSelector.with_cross_validation(
         num_folds=num_folds, families=families,
         splitter=DataCutter(reserve_test_fraction=0.2, seed=seed),
-        seed=seed, mesh=mesh)
+        seed=seed, mesh=mesh or None)
     prediction = labels.transform_with(selector, features)
     # species names round-trip: indexed prediction → label strings
     deindexed = labels.transform_with(PredictionDeIndexer(), prediction)
@@ -65,6 +62,8 @@ def run(csv_path: str = DEFAULT_CSV, num_folds: int = 3, families=None,
           .set_reader(reader)
           .set_result_features(prediction, deindexed)
           .set_splitter(selector.splitter))
+    if mesh is not None:
+        wf.set_mesh(mesh)   # Mesh pins topology, False forces off
 
     t0 = time.time()
     model = wf.train()

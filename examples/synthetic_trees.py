@@ -63,15 +63,12 @@ def run(n_rows: int = 2_000_000, n_features: int = 20, num_folds: int = 5,
     full store — at the 10M config the full-store eval is ~3 minutes of
     pure link transfer for a quality anchor a 2M slice pins equally
     well; the bench records the slice size it used."""
-    import jax
-
     from transmogrifai_tpu.models.trees import (GBTFamily, RandomForestFamily,
                                                 XGBoostFamily)
 
-    if mesh is None and len(jax.devices()) > 1:
-        from transmogrifai_tpu.parallel.mesh import make_mesh
-        mesh = make_mesh()
-    mesh = mesh or None   # mesh=False forces single-device
+    # mesh=None: Workflow.train resolves the process-default mesh
+    # (PR 6 — multichip is the mainline substrate); mesh=False
+    # forces single-device; an explicit Mesh pins the topology.
     if families is None:
         # the BASELINE config's three tree families; reduced grid so the
         # sweep is (3 + 3 + 2) × num_folds ensemble fits
@@ -94,7 +91,7 @@ def run(n_rows: int = 2_000_000, n_features: int = 20, num_folds: int = 5,
         num_folds=num_folds, validation_metric="AuPR", families=families,
         splitter=DataBalancer(sample_fraction=0.1,
                               reserve_test_fraction=0.1, seed=seed),
-        seed=seed, mesh=mesh)
+        seed=seed, mesh=mesh or None)
     prediction = label.transform_with(selector, feats)
 
     tp0 = time.time()
@@ -103,6 +100,8 @@ def run(n_rows: int = 2_000_000, n_features: int = 20, num_folds: int = 5,
           .set_input_store(store)
           .set_result_features(prediction)
           .set_splitter(selector.splitter))
+    if mesh is not None:
+        wf.set_mesh(mesh)   # Mesh pins topology, False forces off
     prep_s = time.time() - tp0
 
     t0 = time.time()

@@ -470,3 +470,19 @@ def test_hoisted_copy_import():
     import inspect
     src = inspect.getsource(w.Workflow._fit_layer)
     assert "import copy" not in src
+
+
+def test_mesh_constructions_tally_stays_flat(store):
+    """PR 6 satellite: the device stats pass must reuse the caller's /
+    process-default mesh — repeated passes build ZERO new meshes, and
+    fitstats_stats() surfaces the count so a regression back to a
+    throwaway mesh-per-pass is visible in every bench doc."""
+    from transmogrifai_tpu.parallel.mesh import process_default_mesh
+
+    process_default_mesh()                 # ensure the cached build
+    c0 = fitstats.fitstats_stats()["mesh_constructions"]
+    plan = LayerStatsPlan([StatRequest("mean", "x0"),
+                           StatRequest("variance", "x1")], n_stages=2)
+    plan.run(store, device=True)
+    plan.run(store, device=True)
+    assert fitstats.fitstats_stats()["mesh_constructions"] == c0

@@ -293,6 +293,54 @@ def test_suppress_accepts_bare_string():
     assert lint.check_workflow(wf, suppress="TMG101") == []
 
 
+class _MeshUnsafeVec(VectorizerModel):
+    """Row dimension baked into the program: device_compute statically
+    slices to 8 rows, so a second probe size exposes that zero-weight
+    pad_rows cannot pad it to the mesh's data axis (TMG205)."""
+
+    operation_name = "meshUnsafeVec"
+    seq_type = Real
+
+    def host_prepare(self, store):
+        col = store[self.input_features[0].name]
+        return {"x": np.nan_to_num(col.astype_float())}
+
+    def device_compute(self, xp, prepared):
+        x = xp.asarray(prepared["x"], dtype=xp.float32)
+        return xp.stack([x, x], axis=1)[:8]       # static row count
+
+    def vector_metadata(self):
+        return VectorMetadata("mu", [VectorColumnMetadata("x", "Real"),
+                                     VectorColumnMetadata("x", "Real")])
+
+
+class _MeshSafeVec(_MeshUnsafeVec):
+    """The clean twin: rows track the batch."""
+
+    operation_name = "meshSafeVec"
+
+    def device_compute(self, xp, prepared):
+        x = xp.asarray(prepared["x"], dtype=xp.float32)
+        return xp.stack([x, x], axis=1)
+
+
+def test_tmg205_mesh_unsafe_row_dimension():
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    out = _MeshUnsafeVec().set_input(fx).get_output()
+    model = WorkflowModel(result_features=[out], fitted_stages={})
+    findings = lint.preflight_device(model)
+    f = next(f for f in findings if f.rule == "TMG205")
+    assert f.severity == Severity.ERROR and f.stage is not None
+    assert "mesh" in f.message and "data axis" in f.message
+    # it fires at the FIRST probe size that passes TMG201, i.e. before
+    # any data is read — and the clean twin stays silent
+    fx2 = FeatureBuilder.Real("x").from_column().as_predictor()
+    ok = _MeshSafeVec().set_input(fx2).get_output()
+    clean = WorkflowModel(result_features=[ok], fitted_stages={})
+    assert not [f for f in lint.preflight_device(clean)
+                if f.rule == "TMG205"]
+
+
 def test_tmg204_host_stage_without_static_form_halts_with_info():
     fx = FeatureBuilder.Real("x").from_column().as_predictor()
 
@@ -429,6 +477,10 @@ def test_cli_gen_emits_validate_by_default(tmp_path):
     params = json.load(open(files["params.json"]))
     assert params["customParams"]["validate"] is True
     assert params["customParams"]["failOn"] == "error"
+    # the mesh knobs are discoverable (null = all visible devices) and
+    # their keys ride the validated-numeric path (PR 6)
+    assert params["customParams"]["meshDevices"] is None
+    assert params["customParams"]["meshGridSize"] is None
 
 
 # ---------------------------------------------------------------------------

@@ -1,10 +1,27 @@
-"""Mesh sharding tests — distributed CV on the 8-device virtual mesh."""
+"""Mesh sharding tests — distributed CV on the 8-device virtual mesh,
+plus the PR 6 mainline-mesh promotion suite (process-default mesh,
+degenerate single-device parity, sharded fitstats/scoring parity)."""
 import jax
 import numpy as np
 import pytest
 
 from transmogrifai_tpu.models import CrossValidation, LogisticRegressionFamily
-from transmogrifai_tpu.parallel.mesh import make_mesh, shard_cv_inputs
+from transmogrifai_tpu.parallel import mesh as pmesh
+from transmogrifai_tpu.parallel.mesh import (make_mesh, mesh_if_multi,
+                                             mesh_topology,
+                                             process_default_mesh,
+                                             set_process_mesh,
+                                             shard_cv_inputs)
+
+
+@pytest.fixture
+def _restore_process_mesh():
+    """Tests that pin the process mesh must not leak it to the suite."""
+    prev = set_process_mesh(None)
+    try:
+        yield
+    finally:
+        set_process_mesh(prev)
 
 
 def test_make_mesh_shapes():
@@ -15,6 +32,63 @@ def test_make_mesh_shapes():
     assert mesh2.shape == {"data": 8, "grid": 1}
     mesh3 = make_mesh(n_devices=8, grid_size=2)
     assert mesh3.shape == {"data": 4, "grid": 2}
+
+
+def test_make_mesh_every_power_of_two_split():
+    """The 1/2/4/8-device splits the conftest mesh supports, including
+    the grid_size=1 degenerate (pure data) case per device count."""
+    for d in (1, 2, 4, 8):
+        m = make_mesh(n_devices=d, grid_size=1)
+        assert m.shape == {"data": d, "grid": 1}
+        assert m.devices.size == d
+        m2 = make_mesh(n_devices=d, grid_size=8)
+        assert m2.shape["data"] * m2.shape["grid"] == d
+
+
+def test_make_mesh_rejects_impossible_splits():
+    with pytest.raises(ValueError, match="n_devices must be >= 1"):
+        make_mesh(n_devices=0)
+    # oversubscription must raise, not silently shrink to what exists
+    with pytest.raises(ValueError, match="exceeds the 8 visible"):
+        make_mesh(n_devices=16)
+    with pytest.raises(ValueError, match="impossible \\(data, grid\\)"):
+        make_mesh(n_devices=8, grid_axis=3)
+    with pytest.raises(ValueError, match="impossible"):
+        make_mesh(n_devices=4, grid_axis=8)
+    with pytest.raises(ValueError, match="no devices"):
+        make_mesh(devices=[])
+    # explicit valid split
+    m = make_mesh(n_devices=8, grid_axis=4)
+    assert m.shape == {"data": 2, "grid": 4}
+
+
+def test_process_default_mesh_cached_and_counted(_restore_process_mesh):
+    m1 = process_default_mesh()
+    c0 = pmesh.mesh_constructions()
+    m2 = process_default_mesh()
+    assert m1 is m2, "the process mesh must be built once and cached"
+    assert pmesh.mesh_constructions() == c0
+    assert m1.devices.size == len(jax.devices())
+    # set/restore roundtrip (the runner's run-scoped knob path)
+    small = make_mesh(n_devices=2)
+    prev = set_process_mesh(small)
+    assert prev is m1 and process_default_mesh() is small
+    set_process_mesh(prev)
+    assert process_default_mesh() is m1
+
+
+def test_mesh_if_multi_degenerate_resolves_to_none():
+    assert mesh_if_multi(None) is None
+    assert mesh_if_multi(make_mesh(n_devices=1)) is None
+    m = make_mesh(n_devices=8)
+    assert mesh_if_multi(m) is m
+
+
+def test_mesh_topology_doc():
+    topo = mesh_topology(make_mesh(n_devices=8, grid_axis=2))
+    assert topo["devices"] == 8 and topo["data"] == 4 \
+        and topo["grid"] == 2
+    assert topo["platform"] == "cpu" and topo["enabled"] is True
 
 
 def test_cv_with_mesh_matches_unsharded(rng):
@@ -130,3 +204,226 @@ def test_chunked_sweep_under_mesh_matches_unchunked(rng):
     assert plain.keys() == chunk.keys()
     for k in plain:
         np.testing.assert_allclose(plain[k], chunk[k], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# PR 6: the mesh as the mainline substrate
+# ---------------------------------------------------------------------------
+
+
+def _records(rng, n=300):
+    y = rng.integers(0, 2, n).astype(float)
+    x = rng.normal(size=n) + y
+    x2 = rng.normal(size=n) - 0.5 * y
+    return [{"label": float(y[i]), "x": float(x[i]), "x2": float(x2[i])}
+            for i in range(n)]
+
+
+def _binary_flow(seed=5):
+    from transmogrifai_tpu import FeatureBuilder, Workflow
+    from transmogrifai_tpu.models.selector import \
+        BinaryClassificationModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    fx2 = FeatureBuilder.Real("x2").from_column().as_predictor()
+    vec = transmogrify([fx, fx2])
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None,
+        seed=seed)
+    pred = label.transform_with(selector, vec)
+    return Workflow().set_result_features(pred), selector, pred
+
+
+def test_workflow_train_threads_process_mesh_to_selector(rng):
+    """The tentpole wiring: a plain train() on a multi-device host hands
+    the process-default mesh to the CV sweep — no opt-in anywhere."""
+    wf, selector, _pred = _binary_flow()
+    assert selector.mesh is None
+    wf.set_input_records(_records(rng)).train()
+    assert selector.mesh is process_default_mesh()
+    assert selector.mesh.devices.size == len(jax.devices())
+
+
+def test_workflow_set_mesh_false_forces_unsharded(rng):
+    wf, selector, _pred = _binary_flow()
+    wf.set_mesh(False).set_input_records(_records(rng)).train()
+    assert selector.mesh is None
+
+
+def test_workflow_retrain_re_resolves_auto_assigned_mesh(rng):
+    """A workflow-assigned selector mesh is not a permanent pin: a
+    retrain after set_mesh(False) (or under a different process mesh)
+    re-resolves it, while an explicitly constructed mesh= survives."""
+    from transmogrifai_tpu import Workflow
+
+    records = _records(rng)
+    wf, selector, pred = _binary_flow()
+    wf.set_input_records(records).train()
+    assert selector.mesh is process_default_mesh()
+    wf.set_mesh(False).train()
+    assert selector.mesh is None            # re-resolved, not pinned
+    wf.set_mesh(None).train()
+    assert selector.mesh is process_default_mesh()
+    # a DIFFERENT workflow over the same DAG also re-resolves an
+    # auto-assigned mesh — the marker lives on the stage, so workflow
+    # A's assignment never masquerades as an explicit pin to workflow B
+    wf_b = (Workflow().set_result_features(pred).set_mesh(False)
+            .set_input_records(records))
+    wf_b.train()
+    assert selector.mesh is None
+    # explicit construction-time mesh is never overwritten
+    pinned = make_mesh(n_devices=2)
+    wf2, sel2, _p2 = _binary_flow()
+    sel2.mesh = pinned
+    wf2.set_input_records(records).train()
+    assert sel2.mesh is pinned
+
+
+def test_train_emits_on_mesh_listener_and_gauges(rng):
+    from transmogrifai_tpu import telemetry
+    telemetry.enable()
+    try:
+        telemetry.reset(keep_listeners=False)
+        collector = telemetry.add_listener(
+            telemetry.CollectingRunListener())
+        wf, _sel, _pred = _binary_flow()
+        wf.set_input_records(_records(rng)).train()
+        topo = mesh_topology(process_default_mesh())
+        assert collector.mesh == {
+            "devices": topo["devices"], "data": topo["data"],
+            "grid": topo["grid"], "platform": topo["platform"]}
+        assert collector.summary()["mesh"]["devices"] == topo["devices"]
+        assert telemetry.gauge("mesh.data_axis").value == topo["data"]
+        assert telemetry.gauge("mesh.grid_axis").value == topo["grid"]
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_degenerate_mesh_parity_bit_identical(rng, monkeypatch,
+                                              _restore_process_mesh):
+    """The degenerate-mesh acceptance suite: with the process mesh
+    pinned to ONE device, score/transform/fit results are bit-identical
+    to the pre-promotion (mesh machinery disabled) path — the
+    single-device path is the mesh's special case, not a fork."""
+    records = _records(rng, n=300)
+
+    def train_and_score(store_records):
+        wf, selector, pred = _binary_flow()
+        model = wf.set_input_records(store_records).train()
+        store = model.transform(list(store_records))
+        scores = model.score(list(store_records), engine=False)
+        summ = model.fitted_stages[selector.uid].selector_summary
+        return model, store, scores, summ
+
+    # leg A: mesh promotion ON, degenerate 1-device process mesh
+    set_process_mesh(make_mesh(n_devices=1))
+    model_a, store_a, scores_a, summ_a = train_and_score(records)
+
+    # leg B: mesh machinery disabled entirely (the pre-PR6 behavior)
+    monkeypatch.setattr(pmesh, "MESH_ENABLED", False)
+    model_b, store_b, scores_b, summ_b = train_and_score(records)
+
+    assert summ_a.best_model_name == summ_b.best_model_name
+    assert summ_a.validator_summary.best.mean_metric \
+        == summ_b.validator_summary.best.mean_metric
+    pa = scores_a[scores_a.names()[0]]
+    pb = scores_b[scores_b.names()[0]]
+    assert np.array_equal(pa.prediction, pb.prediction)
+    assert np.array_equal(pa.probability, pb.probability)
+    # column names embed per-flow uids — compare positionally
+    for na, nb in zip(store_a.names(), store_b.names()):
+        ca, cb = store_a[na], store_b[nb]
+        va = getattr(ca, "values", None)
+        if isinstance(va, np.ndarray):
+            assert np.array_equal(
+                np.asarray(va, dtype=np.float64),
+                np.asarray(cb.values, dtype=np.float64),
+            ), (na, nb)
+
+
+def test_degenerate_mesh_fitstats_bit_identical(rng, _restore_process_mesh):
+    """Fit-statistics device tier: a 1-device degenerate mesh computes
+    the exact bytes the unsharded pass computes."""
+    from transmogrifai_tpu import ColumnStore, column_from_values
+    from transmogrifai_tpu.fitstats import LayerStatsPlan, StatRequest
+    from transmogrifai_tpu.types import feature_types as ft
+
+    n = 2048
+    vals = [None if rng.random() < 0.1 else float(v)
+            for v in rng.normal(size=n) * 100]
+    store = ColumnStore({"x": column_from_values(ft.Real, vals)}, n)
+    reqs = [StatRequest(k, "x") for k in
+            ("count", "mean", "variance", "std", "min", "max")]
+    plan = LayerStatsPlan(reqs, n_stages=2)
+    set_process_mesh(make_mesh(n_devices=1))
+    res_deg = plan.run(store, device=True)
+    res_off = plan.run(store, device=True, mesh=False)
+    for r in reqs:
+        assert res_deg.for_request(r) == res_off.for_request(r), r.kind
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="sharded parity needs >= 2 devices")
+def test_sharded_fitstats_merged_moments_parity(rng):
+    """Device-count-gated: the data-axis-sharded stats fold (psum) must
+    reproduce the unsharded merged moments — counts/extrema exactly,
+    f-moments to reassociation tolerance."""
+    from transmogrifai_tpu import ColumnStore, column_from_values
+    from transmogrifai_tpu.fitstats import LayerStatsPlan, StatRequest
+    from transmogrifai_tpu.types import feature_types as ft
+
+    n = 4096
+    cols = {}
+    for j in range(3):
+        vals = [None if rng.random() < 0.1 else float(v)
+                for v in rng.normal(size=n) * 10 ** j]
+        cols[f"x{j}"] = column_from_values(ft.Real, vals)
+    store = ColumnStore(cols, n)
+    reqs = [StatRequest(k, f"x{j}") for j in range(3)
+            for k in ("count", "mean", "variance", "std", "min", "max")]
+    plan = LayerStatsPlan(reqs, n_stages=3)
+    sharded = plan.run(store, device=True,
+                       mesh=process_default_mesh())
+    plain = plan.run(store, device=True, mesh=False)
+    for r in reqs:
+        a, b = sharded.for_request(r), plain.for_request(r)
+        if r.kind in ("count", "min", "max"):
+            assert a == b, (r.kind, r.column, a, b)
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-9,
+                                       err_msg=f"{r.kind}/{r.column}")
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="sharded scoring needs >= 2 devices")
+def test_engine_sharded_scoring_parity_and_cache_keying(rng, monkeypatch):
+    """The scoring engine's data-sharded bucket dispatch must score
+    identically to the unsharded engine, and the program cache must key
+    the two apart (a single-device executable and a sharded one never
+    collide)."""
+    import transmogrifai_tpu.workflow as wfmod
+    from transmogrifai_tpu.scoring import ScoringEngine
+
+    monkeypatch.setattr(wfmod, "_DEVICE_BW_MBPS", 1e9)  # gate open
+    wf, _sel, _pred = _binary_flow()
+    records = _records(rng, n=512)
+    model = wf.set_input_records(records).train()
+
+    eng_plain = ScoringEngine(model, mesh=False)
+    eng_mesh = ScoringEngine(model, mesh=process_default_mesh())
+    # score from raw records both ways
+    sp = eng_plain.score_store(list(records))
+    sm = eng_mesh.score_store(list(records))
+    assert sp.names() == sm.names()
+    pa, pb = sp[sp.names()[0]], sm[sm.names()[0]]
+    assert np.array_equal(pa.prediction, pb.prediction)
+    np.testing.assert_allclose(pa.probability, pb.probability,
+                               rtol=1e-12, atol=0)
+    # distinct cache keys: same block shapes, different mesh
+    k_plain = eng_plain._signature({}, {}, ("p",), None)
+    k_mesh = eng_plain._signature({}, {}, ("p",),
+                                  (("data", 8), ("grid", 1)))
+    assert k_plain != k_mesh

@@ -256,3 +256,74 @@ def test_score_avro_output_roundtrip(rng, tmp_path):
     assert len(back2) == len(records)
     np.testing.assert_allclose(
         [r[pred.name]["prediction"] for r in back2], preds, rtol=1e-12)
+
+
+def test_runner_mesh_knobs_validated_and_stamped(rng, tmp_path):
+    """PR 6 satellites: customParams.meshDevices/meshGridSize bound the
+    run's mesh via the validated numeric path, the topology is stamped
+    in the metrics doc, and the previous process mesh is restored."""
+    from transmogrifai_tpu.parallel.mesh import process_default_mesh
+
+    records = _records(rng)
+    reader = _ListReader(records)
+    wf, label, pred, _sel = _flow()
+    runner = OpWorkflowRunner(wf, training_reader=reader)
+    # malformed values name their key before any data is read
+    with pytest.raises(ValueError, match="meshDevices"):
+        runner.run(RunType.TRAIN, OpParams(
+            custom_params={"meshDevices": 2.5}))
+    with pytest.raises(ValueError, match="meshGridSize"):
+        runner.run(RunType.TRAIN, OpParams(
+            custom_params={"meshGridSize": 0}))
+    # impossible splits fail descriptively up front — and a
+    # meshGridSize the device count cannot divide must RAISE, never
+    # silently round down to a nearby power of two
+    with pytest.raises(ValueError, match="exceeds the 8 visible"):
+        runner.run(RunType.TRAIN, OpParams(
+            custom_params={"meshDevices": 64}))
+    with pytest.raises(ValueError, match="impossible"):
+        runner.run(RunType.TRAIN, OpParams(
+            custom_params={"meshGridSize": 3}))
+
+    before = process_default_mesh()
+    out = runner.run(RunType.TRAIN, OpParams(
+        model_location=str(tmp_path / "m"),
+        custom_params={"meshDevices": 4, "meshGridSize": 2}))
+    assert out.metrics["mesh"]["devices"] == 4
+    assert out.metrics["mesh"]["data"] == 2
+    assert out.metrics["mesh"]["grid"] == 2
+    # run-scoped: the process mesh is back afterwards
+    assert process_default_mesh() is before
+
+
+def test_runner_metrics_doc_always_stamps_mesh(rng, tmp_path):
+    records = _records(rng)
+    reader = _ListReader(records)
+    wf, label, pred, _sel = _flow()
+    runner = OpWorkflowRunner(wf, training_reader=reader,
+                              scoring_reader=reader)
+    params = OpParams(model_location=str(tmp_path / "m"))
+    out = runner.run(RunType.TRAIN, params)
+    topo = out.metrics["mesh"]
+    assert topo["devices"] == 8 and topo["platform"] == "cpu"
+    out2 = runner.run(RunType.SCORE, params)
+    assert out2.metrics["mesh"]["devices"] == 8
+
+
+def test_op_app_mesh_devices_flag(rng, tmp_path):
+    records = _records(rng)
+    reader = _ListReader(records)
+    wf, label, pred, _sel = _flow()
+    runner = OpWorkflowRunner(wf, training_reader=reader)
+    captured = {}
+
+    class _CapturingApp(OpApp):
+        def runner(self, params):
+            captured["params"] = params
+            return runner
+
+    out = _CapturingApp().main(
+        ["--run-type", "Train", "--mesh-devices", "4",
+         "--model-location", str(tmp_path / "m"), "--quiet"])
+    assert captured["params"].custom_params["meshDevices"] == 4
+    assert out.metrics["mesh"]["devices"] == 4

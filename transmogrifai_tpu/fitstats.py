@@ -106,9 +106,16 @@ _TALLY = {"layers_fused": 0, "passes_saved": 0, "bytes_scanned": 0,
 
 def fitstats_stats() -> Dict[str, int]:
     """Snapshot of the engine's process-wide tallies (always on, cheap —
-    the ``scoring.engine_cache_stats`` discipline)."""
+    the ``scoring.engine_cache_stats`` discipline). Includes the
+    process-wide ``mesh_constructions`` count: the steady state is ONE
+    mesh per process, so a regression back to a throwaway
+    mesh-per-pass shows up as a count tracking the pass count in every
+    bench doc."""
+    from .parallel import mesh as _mesh
     with _TALLY_LOCK:
-        return dict(_TALLY)
+        out = dict(_TALLY)
+    out["mesh_constructions"] = _mesh.mesh_constructions()
+    return out
 
 
 def reset_fitstats_stats() -> None:
@@ -353,16 +360,21 @@ def _chan_combine(parts: List[Tuple]) -> Tuple[np.ndarray, ...]:
 _MESH_OFF = os.environ.get("TMOG_FITSTATS_MESH", "1") == "0"
 
 
-def _device_moment_bundles(store, col_kinds: Dict[str, Dict[str, List[Tuple]]]
-                           ) -> Dict[str, Dict[Tuple, Any]]:
+def _device_moment_bundles(store, col_kinds: Dict[str, Dict[str, List[Tuple]]],
+                           mesh=None) -> Dict[str, Dict[Tuple, Any]]:
     """Device tier: stack the requested scalar columns into [n, k],
     stream fixed-shape row chunks through ONE jitted fold program, and
     combine the per-chunk partials on host in f64.
 
     Uploads go through the content-keyed ``device_put_f32`` cache; with
-    more than one device the chunk's rows shard over the mesh's ``data``
-    axis (GSPMD inserts the psum for the column reductions)."""
+    more than one device the chunk's rows shard over the ``data`` axis
+    of the caller's mesh — falling back to the cached process-default
+    mesh, never a private throwaway one (``mesh_constructions`` in
+    ``fitstats_stats()`` keeps that honest) — and GSPMD inserts the
+    psum for the column reductions."""
     import jax
+
+    from . import telemetry
 
     names = sorted(col_kinds)
     n, k = store.n_rows, len(names)
@@ -380,12 +392,15 @@ def _device_moment_bundles(store, col_kinds: Dict[str, Dict[str, List[Tuple]]]
     chunk = _chunk_rows(n)
     one_chunk = n <= chunk
     sharding = None
-    if not _MESH_OFF and len(jax.devices()) > 1:
+    # mesh=False forces the unsharded path; None falls back to the cached
+    # process-default mesh (degenerate 1×1 resolves to no sharding)
+    if not _MESH_OFF and mesh is not False:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from .parallel.mesh import make_mesh
-        mesh = make_mesh(grid_size=1)
-        if chunk % mesh.shape["data"] == 0:
+        from .parallel.mesh import mesh_if_multi, process_default_mesh
+        mesh = mesh_if_multi(mesh if mesh is not None
+                             else process_default_mesh())
+        if mesh is not None and chunk % mesh.shape["data"] == 0:
             sharding = NamedSharding(mesh, P("data", None))
 
     prog = _moment_program(chunk, k, str(dtype))
@@ -415,7 +430,13 @@ def _device_moment_bundles(store, col_kinds: Dict[str, Dict[str, List[Tuple]]]
             bd = jax.device_put(b)
         parts.append(jax.device_get(prog(vd, bd)))
 
-    cnt, mean, m2, mn, mx = _chan_combine(parts)
+    # the per-chunk partials merge on host (Chan); the device-side column
+    # reductions above are the psum GSPMD inserted when `sharding` is set
+    # — the span makes the merge (and so the data-axis fan-in) visible on
+    # the Perfetto timeline next to the per-axis occupancy gauges
+    with telemetry.span("fit:psum_merge", chunks=len(parts), columns=k,
+                        sharded=sharding is not None):
+        cnt, mean, m2, mn, mx = _chan_combine(parts)
     out: Dict[str, Dict[Tuple, Any]] = {}
     for j, nm in enumerate(names):
         c = int(cnt[j])
@@ -495,9 +516,13 @@ class LayerStatsPlan:
                 and device_roundtrip_mbps() >= FUSE_MIN_BANDWIDTH_MBPS
                 and resilience.breaker("fitstats.device").allow())
 
-    def run(self, store, device: Optional[bool] = None) -> StatResults:
+    def run(self, store, device: Optional[bool] = None,
+            mesh=None) -> StatResults:
         """Execute every request in one pass; ``device`` overrides the
-        bandwidth/row gate (tests pin it either way)."""
+        bandwidth/row gate (tests pin it either way). ``mesh`` is the
+        caller's (data, grid) mesh for the device tier's row sharding —
+        None falls back to the cached process default, ``False`` forces
+        the unsharded path."""
         from . import telemetry
 
         moment_cols: Dict[str, Dict[str, List[Tuple]]] = {}
@@ -531,7 +556,8 @@ class LayerStatsPlan:
                 try:
                     resilience.inject("fitstats.device_pass",
                                       rows=store.n_rows)
-                    bundles = _device_moment_bundles(store, moment_cols)
+                    bundles = _device_moment_bundles(store, moment_cols,
+                                                     mesh=mesh)
                     brk.record_success()
                 except Exception:  # lint: broad-except — breaker-governed device-tier fallback
                     brk.record_failure()

@@ -110,6 +110,10 @@ RULES: Dict[str, Tuple[str, str]] = {
                "signature or bare Python scalar traced by value"),
     "TMG204": (Severity.INFO,
                "pre-flight stopped: stage has no static (eval_shape) form"),
+    "TMG205": (Severity.ERROR,
+               "mesh-unsafe stage: device_compute row dimension does not "
+               "track the input batch, so zero-weight pad_rows cannot pad "
+               "it to the mesh's data axis"),
     # -- TMG3xx: repo rules (tools/tmoglint.py AST self-lint) --------------
     "TMG301": (Severity.ERROR,
                "time.time() used for a duration — monotonic timing must "
@@ -644,6 +648,35 @@ def preflight_device(model, n_rows: int = 8) -> List[Finding]:
                     width = shape[1] if len(shape) == 2 else meta.size
                 else:
                     width = meta.size
+                    # TMG205 — the mesh padding contract: a second probe
+                    # size must move the output's row dimension with it.
+                    # A stage that bakes the row count into its program
+                    # (static slice/reshape) cannot be zero-weight-padded
+                    # to the mesh's data axis (parallel/mesh.pad_rows),
+                    # so a multichip run would compute on the wrong rows.
+                    structs2 = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                                for k, v in prep2.items()}
+                    try:
+                        out2 = jax.eval_shape(
+                            lambda p, _m=m: _m.device_compute(jnp, p),
+                            structs2)
+                        shape2 = tuple(out2.shape)
+                    except Exception as e:  # lint: broad-except — a batch-size-dependent failure IS the finding
+                        shape2 = None
+                        findings.append(Finding(
+                            "TMG205", f"{_stage_label(m)} device_compute "
+                            f"fails at a second batch size ({n2} rows: "
+                            f"{type(e).__name__}: {e}) — rows cannot be "
+                            "padded to the mesh data axis", stage=m.uid))
+                    if shape2 is not None and (len(shape2) != 2
+                                               or shape2[0] != n2):
+                        findings.append(Finding(
+                            "TMG205", f"{_stage_label(m)} device_compute "
+                            f"row dimension does not track the batch "
+                            f"({n_rows}→{shape[0]} rows but "
+                            f"{n2}→{shape2[0] if shape2 else '?'}): "
+                            "zero-weight pad_rows cannot pad it to the "
+                            "mesh's data axis", stage=m.uid))
                 if out.dtype == np.float64 or truncated:
                     findings.append(Finding(
                         "TMG202", f"{_stage_label(m)} device_compute "

@@ -592,7 +592,12 @@ class _ValidatorBase:
 
     def _validate_impl(self, families, X, y, base_weights=None, mesh=None):
         from ..evaluators.device_metrics import device_metric_fn
+        from ..parallel.mesh import mesh_if_multi
 
+        # a degenerate (1×1) mesh routes onto the exact single-device
+        # path — content-cached uploads, unsharded executables — so the
+        # pre-mesh behavior is the mesh's special case, not a fork
+        mesh = mesh_if_multi(mesh)
         splits = self._splits(y)
         base_w = (np.ones_like(y, dtype=np.float64)
                   if base_weights is None else base_weights)
@@ -928,7 +933,9 @@ class _ValidatorBase:
 
     def _validate_per_fold_impl(self, families, fold_data, mesh=None):
         from ..evaluators.device_metrics import device_metric_fn
+        from ..parallel.mesh import mesh_if_multi
 
+        mesh = mesh_if_multi(mesh)   # degenerate 1×1 = single-device path
         summary = ValidatorSummary("WorkflowCV:" + self.validation_type,
                                    self.metric_name)
         best: Optional[ValidationResult] = None

@@ -15,38 +15,154 @@ Axes:
 
 ``make_mesh`` splits available devices between the two axes; for CV the grid
 axis gets as many devices as it can fill, the data axis the rest.
+
+Since PR 6 the mesh is the MAINLINE substrate, not a dry-run opt-in: the
+workflow/runner resolve one **process-default mesh** over all visible
+devices at the first train/score and thread it to every heavy phase
+(CV sweep, fused fit-statistics pass, scoring engine). On a single
+device the default mesh is the degenerate ``1×1`` and every consumer
+takes exactly the pre-mesh code path (``mesh_if_multi`` returns None),
+so the single-device behavior is the special case of the mesh, not a
+fork. ``TMOG_MESH=0`` disables the promotion entirely.
 """
 from __future__ import annotations
 
-import math
-from typing import Optional, Sequence, Tuple
+import os
+import threading
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_mesh", "data_sharding", "shard_cv_inputs"]
+__all__ = ["make_mesh", "data_sharding", "shard_cv_inputs", "pad_rows",
+           "process_default_mesh", "set_process_mesh", "mesh_if_multi",
+           "mesh_topology", "mesh_constructions", "mesh_enabled"]
+
+#: master switch for the mainline mesh promotion (``TMOG_MESH=0`` keeps
+#: every consumer on the pre-mesh single-device path)
+MESH_ENABLED = os.environ.get("TMOG_MESH", "1") != "0"
+
+#: process-wide mesh constructions — cheap evidence that nothing builds a
+#: throwaway mesh per pass (fitstats_stats()/bench docs surface it; the
+#: steady state is ONE construction per process)
+_CONSTRUCTIONS = [0]
+
+_PROCESS_MESH: Optional[Mesh] = None
+_PROCESS_MESH_LOCK = threading.Lock()
+
+
+def mesh_enabled() -> bool:
+    """True when the mainline mesh promotion is on (``TMOG_MESH``)."""
+    return MESH_ENABLED
+
+
+def mesh_constructions() -> int:
+    """How many meshes this process has built (``make_mesh`` calls)."""
+    return _CONSTRUCTIONS[0]
 
 
 def make_mesh(n_devices: Optional[int] = None, grid_size: int = 1,
-              devices: Optional[Sequence] = None) -> Mesh:
+              devices: Optional[Sequence] = None,
+              grid_axis: Optional[int] = None) -> Mesh:
     """2-D ``(data, grid)`` mesh over the available devices.
 
     ``grid_size`` is the total (fold × hyperparam) batch the caller wants to
     parallelize; the grid axis is sized to the largest power-of-two divisor
-    of the device count that does not exceed it.
+    of the device count that does not exceed it. An explicit ``grid_axis``
+    overrides the sizing and must divide the device count evenly.
+
+    Impossible splits raise a descriptive ``ValueError`` instead of
+    silently truncating or crashing inside ``reshape``: asking for more
+    devices than exist, a non-positive count, or a ``grid_axis`` that
+    does not divide the device count.
     """
     devs = list(devices) if devices is not None else jax.devices()
     if n_devices is not None:
+        if n_devices < 1:
+            raise ValueError(
+                f"make_mesh: n_devices must be >= 1, got {n_devices}")
+        if n_devices > len(devs):
+            raise ValueError(
+                f"make_mesh: n_devices={n_devices} exceeds the "
+                f"{len(devs)} visible device(s) — an oversized request "
+                "must not silently shrink to what exists")
         devs = devs[:n_devices]
     n = len(devs)
-    grid_axis = 1
-    while (n % (grid_axis * 2) == 0 and grid_axis * 2 <= max(grid_size, 1)
-           and grid_axis * 2 <= n):
-        grid_axis *= 2
+    if n == 0:
+        raise ValueError("make_mesh: no devices to build a mesh over")
+    if grid_axis is not None:
+        if grid_axis < 1 or n % grid_axis != 0:
+            raise ValueError(
+                f"make_mesh: impossible (data, grid) split — grid_axis="
+                f"{grid_axis} does not divide the {n} device(s) evenly "
+                f"(data axis would be {n}/{grid_axis})")
+    else:
+        grid_axis = 1
+        while (n % (grid_axis * 2) == 0 and grid_axis * 2 <= max(grid_size, 1)
+               and grid_axis * 2 <= n):
+            grid_axis *= 2
     data_axis = n // grid_axis
     mesh_devs = np.asarray(devs).reshape(data_axis, grid_axis)
+    _CONSTRUCTIONS[0] += 1
     return Mesh(mesh_devs, axis_names=("data", "grid"))
+
+
+def process_default_mesh() -> Mesh:
+    """The process-wide ``(data, grid)`` mesh over ALL visible devices,
+    built once and cached — the mainline substrate every heavy phase
+    (workflow train, CV sweep, fitstats fold, scoring engine) shares.
+
+    The default split is data-heavy (``grid_axis=1``): row sharding
+    scales every phase's throughput with device count, and the row
+    dimensions all pad to powers of two (``pad_rows``, the scoring
+    bucket ladder, the fitstats chunk) so the power-of-two data axis
+    always divides. A grid axis is opt-in via ``set_process_mesh`` /
+    the runner's ``customParams.meshGridSize``. On one device this is
+    the degenerate ``1×1`` mesh."""
+    global _PROCESS_MESH
+    if _PROCESS_MESH is None:
+        with _PROCESS_MESH_LOCK:
+            if _PROCESS_MESH is None:
+                _PROCESS_MESH = make_mesh(grid_size=1)
+    return _PROCESS_MESH
+
+
+def set_process_mesh(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """Install ``mesh`` as the process default (``None`` resets so the
+    next :func:`process_default_mesh` rebuilds over all devices).
+    Returns the previously installed mesh — the runner's run-scoped
+    ``meshDevices``/``meshGridSize`` knobs restore it on exit."""
+    global _PROCESS_MESH
+    with _PROCESS_MESH_LOCK:
+        prev = _PROCESS_MESH
+        _PROCESS_MESH = mesh
+    return prev
+
+
+def mesh_if_multi(mesh: Optional[Mesh]) -> Optional[Mesh]:
+    """``mesh`` when it actually spans more than one device, else None —
+    the degenerate ``1×1`` mesh routes consumers onto the exact
+    single-device code path (bit-identical, content-cached uploads),
+    making the unsharded path the mesh's special case rather than a
+    separately maintained fork. ``False`` (the explicit force-unsharded
+    sentinel some callers accept) resolves to None too."""
+    if mesh is None or mesh is False or not MESH_ENABLED:
+        return None
+    return mesh if mesh.devices.size > 1 else None
+
+
+def mesh_topology(mesh: Optional[Mesh] = None) -> Dict[str, Any]:
+    """JSON-ready topology of ``mesh`` (default: the process mesh) for
+    metrics docs: device count, per-axis sizes, platform."""
+    if mesh is None:
+        mesh = process_default_mesh()
+    devs = mesh.devices.reshape(-1)
+    return {"devices": int(devs.size),
+            "data": int(mesh.shape.get("data", 1)),
+            "grid": int(mesh.shape.get("grid", 1)),
+            "platform": getattr(devs[0], "platform", "unknown"),
+            "enabled": MESH_ENABLED}
 
 
 def data_sharding(mesh: Mesh, *spec) -> NamedSharding:

@@ -284,6 +284,24 @@ class OpWorkflowRunner:
         cache_dir = params.custom_params.get("compileCacheDir")
         if cache_dir:
             _enable_compile_cache(str(cache_dir))
+        # run-scoped mesh shape (customParams.meshDevices/meshGridSize,
+        # CLI --mesh-devices): bound the (data, grid) mesh the run's
+        # heavy phases shard over; the previous process mesh is restored
+        # on exit. Validated up front — a malformed value names its key
+        # now, and an impossible split fails before any data is read
+        # (meshGridSize is the EXPLICIT grid axis: a non-dividing value
+        # raises rather than silently rounding down).
+        from .parallel import mesh as _mesh
+        mesh_devices = _numeric_custom_param(params, "meshDevices", int,
+                                             minimum=1)
+        mesh_grid = _numeric_custom_param(params, "meshGridSize", int,
+                                          minimum=1)
+        run_mesh_obj = None
+        if mesh_devices is not None or mesh_grid is not None:
+            run_mesh_obj = _mesh.make_mesh(n_devices=mesh_devices,
+                                           grid_axis=mesh_grid)
+        prev_mesh = None
+        run_mesh = False
         # run-scoped dead-letter sink (quarantineLocation / CLI
         # --quarantine-out): poison files/batches route there for THIS
         # run; the previous sink is restored on exit (a user-level
@@ -304,6 +322,12 @@ class OpWorkflowRunner:
         # THIS run's events, not a predecessor's quarantines
         self._last_preflight = None
         res_before = resilience.resilience_stats()
+        # install the run-scoped mesh LAST, immediately before the
+        # try/finally that restores it — an exception in the setup above
+        # must not leak the run's mesh into the process default
+        if run_mesh_obj is not None:
+            prev_mesh = _mesh.set_process_mesh(run_mesh_obj)
+            run_mesh = True
         t0 = time.perf_counter()
         telemetry.emit("run_start", run_type=run_type)
         ok = False
@@ -324,6 +348,10 @@ class OpWorkflowRunner:
                     # (None when no persistent cache was configured)
                     result.metrics["compileCacheDir"] = (
                         str(cache_dir) if cache_dir else None)
+                    # the mesh topology every heavy phase ran on rides in
+                    # every metrics doc (PR 6: multichip is mainline —
+                    # a benched number must say how many chips it used)
+                    result.metrics["mesh"] = _mesh.mesh_topology()
                     # pre-flight verdict rides in every metrics doc
                     # (None = validation disabled for this run)
                     result.metrics["preflight"] = self._last_preflight
@@ -352,6 +380,10 @@ class OpWorkflowRunner:
                     except Exception:  # lint: broad-except — best-effort crash trace, never mask the run error
                         logger.exception("trace write failed")
             finally:
+                if run_mesh:
+                    # run-scoped mesh teardown (after the topology stamp
+                    # above, which must reflect THIS run's mesh)
+                    _mesh.set_process_mesh(prev_mesh)
                 if run_scoped:
                     # run-scoped teardown, even when a sink write fails:
                     # recording stops AND this run's events/metrics are
@@ -635,6 +667,12 @@ class OpApp:
                              "(jax_compilation_cache_dir): repeat cold "
                              "runs reload compiled programs instead of "
                              "re-paying the compile clock")
+        ap.add_argument("--mesh-devices", type=int, metavar="N",
+                        help="devices in the run's (data, grid) mesh "
+                             "(customParams.meshDevices): bound the "
+                             "multichip substrate the heavy phases shard "
+                             "over; default = all visible devices "
+                             "(docs/performance.md 'Multichip execution')")
         ap.add_argument("--quarantine-out", metavar="PATH",
                         help="poison-record dead-letter sink (JSONL): "
                              "unreadable stream files and failed "
@@ -668,6 +706,8 @@ class OpApp:
             params.metrics_format = args.metrics_format
         if args.compile_cache_dir:
             params.custom_params["compileCacheDir"] = args.compile_cache_dir
+        if args.mesh_devices is not None:
+            params.custom_params["meshDevices"] = args.mesh_devices
         if args.quarantine_out:
             params.quarantine_location = args.quarantine_out
         if args.fail_on:

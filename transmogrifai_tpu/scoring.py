@@ -35,6 +35,13 @@ The engine honors the same bandwidth gate as layer fusion
 (``workflow.FUSE_MIN_BANDWIDTH_MBPS``): on a slow tunnelled link the
 numpy host path stays the right answer, and ``enabled()`` says so.
 
+On a multi-device host each bucket's row-leading blocks are sharded
+over the process mesh's ``data`` axis before dispatch (PR 6 — see
+docs/performance.md "Multichip execution"), so streaming/batch score
+throughput scales with device count; the program cache keys on the mesh
+shape so single- and multi-device executables never collide, and the
+degenerate single-device mesh takes the unsharded path untouched.
+
 Host/device split rules
 -----------------------
 
@@ -182,10 +189,13 @@ class ScoringEngine:
     """
 
     def __init__(self, model, bucket_cap: int = DEFAULT_BUCKET_CAP,
-                 gate_bandwidth: bool = True):
+                 gate_bandwidth: bool = True, mesh=None):
         self.model = model
         self.bucket_cap = int(bucket_cap)
         self.gate_bandwidth = gate_bandwidth
+        #: (data, grid) mesh for batch sharding: None resolves to the
+        #: process default per dispatch, False forces unsharded
+        self._mesh = mesh
         self._programs: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._compile_count = 0
         self._lock = threading.Lock()
@@ -388,7 +398,49 @@ class ScoringEngine:
         return pb
 
     # -- device program ----------------------------------------------------
-    def _signature(self, prepared, uploads, out_names) -> Tuple:
+    def _chunk_mesh(self, bucket: int):
+        """The (data, grid) mesh this bucket's dispatch shards over, or
+        None. Resolution order: the engine's pinned mesh (``False``
+        forces unsharded), else the cached process default; the
+        degenerate 1×1 mesh and any bucket the data axis does not divide
+        evenly stay unsharded. Power-of-two buckets over a power-of-two
+        data axis always divide, so streaming/batch score throughput
+        scales with device count on multi-chip hosts."""
+        if self._mesh is False:
+            return None
+        from .parallel.mesh import mesh_if_multi, process_default_mesh
+        mesh = mesh_if_multi(self._mesh if self._mesh is not None
+                             else process_default_mesh())
+        if mesh is None or bucket % mesh.shape["data"] != 0:
+            return None
+        return mesh
+
+    @staticmethod
+    def _mesh_key(mesh) -> Optional[Tuple]:
+        return tuple(sorted(mesh.shape.items())) if mesh is not None \
+            else None
+
+    def _shard_inputs(self, mesh, prepared, uploads, bucket: int):
+        """Row-shard every bucket-leading block over the mesh's ``data``
+        axis (fitted constants riding in prepared dicts stay replicated
+        — jit broadcasts them). Zero-padded rows are inert by the
+        row-independence contract, so sharding them is free."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def place(a):
+            a = np.asarray(a)
+            if a.ndim == 0 or a.shape[0] != bucket:
+                return a
+            spec = P("data", *([None] * (a.ndim - 1)))
+            return jax.device_put(a, NamedSharding(mesh, spec))
+        prepared = {uid: {k: place(v) for k, v in blocks.items()}
+                    for uid, blocks in prepared.items()}
+        uploads = {k: place(v) for k, v in uploads.items()}
+        return prepared, uploads
+
+    def _signature(self, prepared, uploads, out_names,
+                   mesh_key: Optional[Tuple] = None) -> Tuple:
         sig = []
         for uid in sorted(prepared):
             for k in sorted(prepared[uid]):
@@ -397,7 +449,9 @@ class ScoringEngine:
         for k in sorted(uploads):
             a = uploads[k]
             sig.append(("", k, tuple(np.shape(a)), str(np.asarray(a).dtype)))
-        return (tuple(sig), tuple(out_names))
+        # the mesh shape keys the program: a single-device executable and
+        # a data-sharded one must never collide in the cache
+        return (tuple(sig), tuple(out_names), mesh_key)
 
     def _program_body(self, jnp, prepared, uploads, out_names):
         env: Dict[str, Any] = dict(uploads)
@@ -422,10 +476,11 @@ class ScoringEngine:
                 env[it.out] = it.model.predict_device(env[it.ins[0]])
         return {nm: env[nm] for nm in out_names}
 
-    def _program(self, prepared, uploads, out_names):
+    def _program(self, prepared, uploads, out_names,
+                 mesh_key: Optional[Tuple] = None):
         import jax
 
-        key = self._signature(prepared, uploads, out_names)
+        key = self._signature(prepared, uploads, out_names, mesh_key)
         with self._lock:
             fn = self._programs.pop(key, None)
             if fn is not None:
@@ -533,11 +588,21 @@ class ScoringEngine:
             resilience.inject("scoring.device_dispatch", rows=n,
                               bucket=bucket)
             if out_names:
+                mesh = self._chunk_mesh(bucket)
                 before = self._compile_count
-                fn = self._program(prepared, uploads, out_names)
+                # key the program off the HOST blocks (shapes/dtypes are
+                # sharding-invariant) — hashing sharded device arrays
+                # would pull them back across the link
+                fn = self._program(prepared, uploads, out_names,
+                                   self._mesh_key(mesh))
                 was_compile = self._compile_count > before
+                if mesh is not None:
+                    prepared, uploads = self._shard_inputs(
+                        mesh, prepared, uploads, bucket)
                 with telemetry.span("score:bucket", rows=n, bucket=bucket,
-                                    compiled=was_compile):
+                                    compiled=was_compile,
+                                    data_shards=(mesh.shape["data"]
+                                                 if mesh is not None else 1)):
                     outs = jax.device_get(fn(prepared, uploads))  # one pull
             else:
                 outs = {}

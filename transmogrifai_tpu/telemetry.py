@@ -18,7 +18,7 @@ host-prep/device-compute overlap of the streaming scorer:
   (``metrics_json``) and Prometheus text-exposition
   (``render_prometheus``) export. See docs/observability.md for the
   metric name catalog.
-* **RunListener protocol** — ``on_run_start / on_layer_start /
+* **RunListener protocol** — ``on_run_start / on_mesh / on_layer_start /
   on_stage_fit / on_score_batch / on_compile / on_run_end`` mirroring
   OpSparkListener's callbacks; :class:`CollectingRunListener` folds them
   into an AppMetrics-style summary the runner embeds in its metrics doc.
@@ -508,6 +508,13 @@ class RunListener:
     def on_layer_start(self, index: int, n_stages: int, **_: Any) -> None:
         pass
 
+    def on_mesh(self, devices: int, data: int, grid: int,
+                platform: str = "", **_: Any) -> None:
+        """The run resolved its (data, grid) device mesh — the multichip
+        substrate every heavy phase shards over (parallel/mesh.py;
+        emitted once per train, only for a real multi-device mesh)."""
+        pass
+
     def on_stage_fit(self, uid: str, stage_name: str, fit_s: float,
                      compile_s: float = 0.0, execute_s: float = 0.0,
                      warm_started: bool = False, **_: Any) -> None:
@@ -603,6 +610,7 @@ class CollectingRunListener(RunListener):
         self.events: List[str] = []      # ordered event names (tests/debug)
         self.run_type: Optional[str] = None
         self.app_seconds = 0.0
+        self.mesh: Optional[Dict[str, Any]] = None
         self.layers = 0
         self.stages: Dict[str, Dict[str, Any]] = {}
         self.score_batches = 0
@@ -633,6 +641,13 @@ class CollectingRunListener(RunListener):
         with self._lock:
             self.events.append("layer_start")
             self.layers = max(self.layers, index + 1)
+
+    def on_mesh(self, devices: int, data: int, grid: int,
+                platform: str = "", **_: Any) -> None:
+        with self._lock:
+            self.events.append("mesh")
+            self.mesh = {"devices": devices, "data": data, "grid": grid,
+                         "platform": platform}
 
     def on_stage_fit(self, uid: str, stage_name: str, fit_s: float,
                      compile_s: float = 0.0, execute_s: float = 0.0,
@@ -697,6 +712,7 @@ class CollectingRunListener(RunListener):
             return {
                 "runType": self.run_type,
                 "appSeconds": round(self.app_seconds, 3),
+                "mesh": self.mesh,
                 "layers": self.layers,
                 "fittedStages": len(self.stages),
                 "stages": dict(self.stages),

@@ -265,6 +265,10 @@ class Workflow:
         self.raw_feature_filter = None
         self.parameters: Dict[str, Any] = {}
         self.blacklisted_features: List[Feature] = []
+        #: explicit (data, grid) mesh; None resolves to the process
+        #: default over all visible devices at train time (PR 6: the
+        #: mesh is the mainline substrate, 1×1 degenerate on one device)
+        self.mesh = None
         self._workflow_cv = False
         self._checkpoint_dir: Optional[str] = None
         self._warm_stages: Dict[str, FittedModel] = {}
@@ -294,6 +298,16 @@ class Workflow:
 
     def set_splitter(self, splitter) -> "Workflow":
         self.splitter = splitter
+        return self
+
+    def set_mesh(self, mesh) -> "Workflow":
+        """Pin the (data, grid) device mesh for this workflow's heavy
+        phases (CV sweep, fused fit-statistics, layer programs). The
+        default — None — resolves to ``parallel.mesh.process_default_mesh``
+        at train time, so multi-chip hosts shard by default and a single
+        device takes the degenerate 1×1 path. ``mesh=False`` forces the
+        unsharded single-device path on any host."""
+        self.mesh = mesh
         return self
 
     def with_raw_feature_filter(self, rff) -> "Workflow":
@@ -403,6 +417,7 @@ class Workflow:
         # layer checkpoints must record THIS graph, not the original
         self._active_result_features = result_features
         dag = compute_dag(result_features)
+        self._resolve_mesh(dag)
         logger.info(
             "train: %d rows (%d held out), %d DAG layers, %d stages%s",
             train_store.n_rows,
@@ -465,6 +480,52 @@ class Workflow:
                     "resuming fit from %s: %d fitted stage(s) warm-start",
                     resume_from, len(partial.fitted_stages))
         return self.train()
+
+    def _resolve_mesh(self, dag: StagesDAG) -> None:
+        """Resolve the mesh every heavy phase of this fit runs on and
+        thread it to the consumers (PR 6: the process-wide mesh is the
+        mainline substrate, not a dry-run opt-in).
+
+        ``self.mesh`` wins when set (``False`` forces unsharded);
+        otherwise the cached process-default mesh over all visible
+        devices is used. The degenerate 1×1 mesh resolves to None —
+        single-device runs take exactly the pre-mesh code path. Any
+        ModelSelector in the DAG that was not handed an explicit mesh
+        inherits the resolved one, so the CV sweep shards by default —
+        and stays workflow-managed: a RE-train after ``set_mesh(...)``
+        or under a different process mesh re-resolves it instead of
+        keeping the first train's pin."""
+        from .models.selector import ModelSelector
+        from .parallel.mesh import (mesh_if_multi, mesh_topology,
+                                    process_default_mesh)
+        if self.mesh is False:
+            active = None
+        else:
+            active = mesh_if_multi(
+                self.mesh if self.mesh is not None
+                else process_default_mesh())
+        self._active_mesh = active
+        if active is not None:
+            topo = mesh_topology(active)
+            telemetry.gauge("mesh.data_axis").set(topo["data"])
+            telemetry.gauge("mesh.grid_axis").set(topo["grid"])
+            telemetry.emit("mesh", devices=topo["devices"],
+                           data=topo["data"], grid=topo["grid"],
+                           platform=topo["platform"])
+            logger.info("train: mesh %d device(s) (data=%d, grid=%d)",
+                        topo["devices"], topo["data"], topo["grid"])
+        # the auto-assignment marker lives on the STAGE (not a
+        # per-workflow set): a selector one workflow auto-assigned must
+        # stay workflow-managed when another workflow (or a retrain)
+        # resolves a different mesh — only an explicit construction-time
+        # mesh= is never overwritten
+        for layer in dag:
+            for stage in layer:
+                if isinstance(stage, ModelSelector) \
+                        and (stage.mesh is None
+                             or getattr(stage, "_mesh_auto", False)):
+                    stage.mesh = active
+                    stage._mesh_auto = True
 
     def _fit_dag(self, dag: StagesDAG, train: ColumnStore,
                  test: Optional[ColumnStore],
@@ -534,7 +595,10 @@ class Workflow:
                                 stages=n_scanning,
                                 requests=plan.n_requests,
                                 rows=train.n_rows):
-                stats = plan.run(train)
+                stats = plan.run(
+                    train,
+                    mesh=(False if self.mesh is False
+                          else getattr(self, "_active_mesh", None)))
             telemetry.emit("stats_pass", layer=li,
                            n_stages=n_scanning,
                            n_requests=plan.n_requests,
