@@ -159,6 +159,13 @@ class Bench:
                 self.doc["fitstats"] = fitstats.fitstats_stats()
             except Exception:
                 self.doc.setdefault("fitstats", None)
+            # whole-DAG planner tallies (plans built, CSE merges, dead
+            # columns, per-tier stage counts) ride on EVERY doc too
+            try:
+                from transmogrifai_tpu import planner
+                self.doc["planner"] = planner.planner_stats()
+            except Exception:
+                self.doc.setdefault("planner", None)
         if final:
             self.doc.pop("partial", None)
         print(json.dumps(self.doc), flush=True)
@@ -423,6 +430,120 @@ def _fit_stats() -> dict:
                   "host_passes": delta["host_passes"]},
         "speedup": round(seq_s / fused_s, 2) if fused_s > 0 else None,
     }
+
+
+def _planner() -> dict:
+    """Whole-DAG planner benchmark (planner.py): ONE fitted workflow
+    carrying a duplicated vectorizer (CSE bait) and a pruning sanity
+    checker, scored planned (CSE fan-out + dead-column pruning + the
+    measured tier decision from a cost db) vs gate-only. Reports both
+    rows/s, the plan's stats (pruned columns, CSE merges, per-tier
+    stage counts) and a strict bit-parity flag — the planner must
+    change cost, never results."""
+    import statistics as _stats
+    import tempfile
+
+    import numpy as np
+
+    from transmogrifai_tpu import (ColumnStore, FeatureBuilder, Workflow,
+                                   column_from_values, planner)
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import \
+        BinaryClassificationModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+    from transmogrifai_tpu.workflow import fusion_state
+
+    rows = int(os.environ.get("BENCH_PLANNER_ROWS", 200_000))
+    train_rows = min(20_000, rows)
+    rng = np.random.default_rng(29)
+    y = rng.integers(0, 2, rows).astype(float)
+    xs = {f"x{j}": rng.normal(size=rows) + (0.3 * j) * y for j in range(5)}
+    junk = np.zeros(rows)                      # sanity checker drops it
+    cats = np.array(["a", "b", "c", "d", None], dtype=object)[
+        rng.integers(0, 5, rows)]
+
+    def store_of(sl):
+        cols = {"label": column_from_values(ft.RealNN, y[sl])}
+        for k, v in xs.items():
+            cols[k] = column_from_values(ft.Real, list(v[sl]))
+        cols["junk"] = column_from_values(ft.Real, list(junk[sl]))
+        cols["cat"] = column_from_values(ft.PickList, list(cats[sl]))
+        return ColumnStore(cols, len(y[sl]))
+
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = [FeatureBuilder.Real(f"x{j}").from_column().as_predictor()
+             for j in range(5)]
+    feats.append(FeatureBuilder.Real("junk").from_column().as_predictor())
+    fcat = FeatureBuilder.PickList("cat").from_column().as_predictor()
+    # two structurally identical pivots over the same feature: CSE bait
+    vec = transmogrify(feats + [fcat.pivot(), fcat.pivot()])
+    checked = label.sanity_check(vec, remove_bad_features=True)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily(
+            grid=[{"regParam": 0.01, "elasticNetParam": 0.0}])],
+        splitter=None, seed=5)
+    pred = label.transform_with(selector, checked)
+    model = (Workflow().set_input_store(store_of(slice(0, train_rows)))
+             .set_result_features(pred).train())
+    full = store_of(slice(0, rows))
+
+    with tempfile.TemporaryDirectory() as td:
+        db = planner.CostDatabase.load(os.path.join(td, "cost_db.json"))
+        planner.record_fit_costs(model, db)
+        db.save()
+        plan = model.plan(cost_db=db, attach=False)
+        out: dict = {"rows": rows, "fusion_gate": fusion_state(),
+                     "plan": plan.counts(),
+                     "report_bytes": len(plan.report())}
+
+        def _rate(fn, reps=2):
+            fn()                               # warm-up (compile) pass
+            secs = []
+            for _ in range(reps):
+                t0 = time.time()
+                fn()
+                secs.append(time.time() - t0)
+            return rows / _stats.median(secs)
+
+        eng_plain = model.scoring_engine(plan=None)
+        if eng_plain is None or not eng_plain.enabled():
+            out["status"] = ("engine_gated_off: link below "
+                             "FUSE_MIN_BANDWIDTH_MBPS")
+            return out
+        r_host = _rate(lambda: model.score(full, engine=False), reps=1)
+        r_plain = _rate(lambda: eng_plain.score_store(full,
+                                                      use_cache=False))
+        model.attach_plan(plan)
+        eng_planned = model.scoring_engine()
+        r_planned = _rate(lambda: eng_planned.score_store(full,
+                                                          use_cache=False))
+        # BOTH whole-chain halves feed the persisted db — the NEXT
+        # process's plan decides the engine tier from measurements
+        # (planner._engine_tier needs host AND engine cost), and the
+        # fit's drained phase observations complete the per-phase tiers
+        db.record_chain(host_rows_per_s=r_host,
+                        engine_rows_per_s=r_plain)
+        planner.drain_phase_observations(db)
+        db.save()
+        replanned = planner.plan_model(model, cost_db=db)
+        out["next_process_engine_tier"] = replanned.engine_tier
+        s_plain = eng_plain.score_store(full)
+        s_planned = eng_planned.score_store(full)
+        nm = [n for n in s_plain.names()][0]
+        parity = bool(
+            np.array_equal(s_plain[nm].prediction,
+                           s_planned[nm].prediction)
+            and np.array_equal(s_plain[nm].probability,
+                               s_planned[nm].probability))
+        out.update({
+            "host_rows_per_s": round(r_host),
+            "unplanned_rows_per_s": round(r_plain),
+            "planned_rows_per_s": round(r_planned),
+            "planned_speedup": round(r_planned / r_plain, 3),
+            "parity": parity,
+        })
+    return out
 
 
 def _multichip_scaling() -> dict:
@@ -696,6 +817,23 @@ def main() -> None:
         except Exception as e:
             _log(f"[bench] fit_stats failed: {e!r}")
             configs["fit_stats"] = {"error": repr(e)[:400]}
+    bench.emit()
+
+    # 4c2. Whole-DAG planner (the cost-based middle-end proof): planned
+    #      (CSE + pruning + measured tier) vs gate-only scoring on one
+    #      fitted workflow, with bit-parity asserted. Budget-gated.
+    if bench.remaining() < 100:
+        configs["planner"] = {
+            "status": "skipped_budget",
+            "remaining_budget_s": round(bench.remaining(), 1)}
+        _log(f"[bench] planner skipped: remaining "
+             f"{bench.remaining():.0f}s < 100s")
+    else:
+        try:
+            configs["planner"] = _planner()
+        except Exception as e:
+            _log(f"[bench] planner failed: {e!r}")
+            configs["planner"] = {"error": repr(e)[:400]}
     bench.emit()
 
     # 4d. Multichip scaling (the mesh-promotion proof): fitstats pass,

@@ -532,6 +532,33 @@ def test_tmg304_span_outside_with():
     assert tm.lint_source(ok) == []
 
 
+def test_tmg306_direct_make_mesh_outside_parallel():
+    tm = _load_tmoglint()
+    bad = ("from transmogrifai_tpu.parallel.mesh import make_mesh\n"
+           "m = make_mesh(n_devices=1)\n")
+    assert [f.rule for f in tm.lint_source(
+        bad, "transmogrifai_tpu/somewhere.py")] == ["TMG306"]
+    # module-attribute form (the runner's import style) triggers too
+    bad_attr = ("from transmogrifai_tpu.parallel import mesh as _mesh\n"
+                "m = _mesh.make_mesh(grid_size=2)\n")
+    assert [f.rule for f in tm.lint_source(
+        bad_attr, "transmogrifai_tpu/somewhere.py")] == ["TMG306"]
+    # the sanctioned path is clean
+    ok = ("from transmogrifai_tpu.parallel.mesh import "
+          "process_default_mesh\n"
+          "m = process_default_mesh()\n")
+    assert tm.lint_source(ok, "transmogrifai_tpu/somewhere.py") == []
+    # the explicit-mesh marker allows a deliberate construction
+    allowed = ("from transmogrifai_tpu.parallel.mesh import make_mesh\n"
+               "m = make_mesh(n_devices=1)  "
+               "# lint: explicit-mesh — scaling bench pins 1 device\n")
+    assert tm.lint_source(allowed, "transmogrifai_tpu/somewhere.py") == []
+    # parallel/ itself and tests are exempt by path
+    assert tm.lint_source(
+        bad, "transmogrifai_tpu/parallel/mesh.py") == []
+    assert tm.lint_source(bad, "tests/test_whatever.py") == []
+
+
 def test_repo_is_clean_under_self_lint():
     """The meta-test: the package itself reports zero findings — the
     project invariants PRs 1-4 introduced by convention are now CI

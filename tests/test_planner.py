@@ -1,0 +1,696 @@
+"""Whole-DAG planner tests (planner.py + its engine/workflow/runner/CLI
+integration).
+
+Covers: plan determinism (same DAG + same cost db ⇒ byte-identical
+report and JSON), dead-column liveness + TMG402, verified CSE merges +
+bit-identical planned scores on a duplicated-vectorizer workflow,
+dead-column pruning parity on the titanic example, tier hints (engine,
+fitstats, transform-layer), the cost database's atomic writes and
+corrupt-file tolerance (TMG404, never a crash), the TMG401 measured-
+tier contradiction, runner stamping + failOn/suppress flow for TMG4xx,
+and the ``plan`` CLI's no-reader-I/O / no-device-dispatch contract.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, Workflow, lint, planner
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+from transmogrifai_tpu.models.selector import (
+    BinaryClassificationModelSelector)
+from transmogrifai_tpu.planner import CostDatabase, ExecutionPlan
+from transmogrifai_tpu.runner import OpParams, OpWorkflowRunner, RunType
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _records(rng, n=300):
+    y = rng.integers(0, 2, n).astype(float)
+    cats = ["a", "b", "c"]
+    return [{"label": float(y[i]),
+             "x": float(rng.normal() + 2 * y[i]),
+             "junk": 0.0,
+             "c": cats[int(rng.integers(0, 3))]} for i in range(n)]
+
+
+def _pruning_cse_model(rng, dup_pivot=True):
+    """A fitted workflow with a constant 'junk' feature (the sanity
+    checker drops its columns → dead columns) and, optionally, two
+    structurally identical pivots over one feature (CSE bait)."""
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    fj = FeatureBuilder.Real("junk").from_column().as_predictor()
+    fc = FeatureBuilder.PickList("c").from_column().as_predictor()
+    feats = [fx, fj]
+    if dup_pivot:
+        feats += [fc.pivot(), fc.pivot()]
+    else:
+        feats += [fc]
+    vec = transmogrify(feats)
+    checked = label.sanity_check(vec, remove_bad_features=True)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None,
+        seed=5)
+    pred = label.transform_with(sel, checked)
+    recs = _records(rng)
+    model = (Workflow().set_input_records(recs)
+             .set_result_features(pred).train())
+    return model, recs
+
+
+@pytest.fixture
+def fast_link(monkeypatch):
+    """Pin the bandwidth gate OPEN so engine paths run in CI."""
+    from transmogrifai_tpu import workflow as wf
+    monkeypatch.setattr(wf, "_DEVICE_BW_MBPS", 1e9)
+
+
+# ---------------------------------------------------------------------------
+# determinism + report schema
+# ---------------------------------------------------------------------------
+
+
+def test_plan_determinism_byte_identical(rng, tmp_path):
+    model, _ = _pruning_cse_model(rng)
+    db = CostDatabase.load(str(tmp_path / "db.json"))
+    planner.record_fit_costs(model, db)
+    db.save()
+    db2 = CostDatabase.load(str(tmp_path / "db.json"))
+    p1 = planner.plan_model(model, cost_db=db)
+    p2 = planner.plan_model(model, cost_db=db2)
+    assert p1.report() == p2.report()
+    assert (json.dumps(p1.to_json(), sort_keys=True)
+            == json.dumps(p2.to_json(), sort_keys=True))
+    # the report is the documented explainable artifact: every stage
+    # row names its tier + reason, the header names the link source
+    rep = p1.report()
+    assert "ExecutionPlan" in rep and "Stage tiers" in rep
+    assert "measured" in rep or "static" in rep
+
+
+def test_plan_json_schema(rng):
+    model, _ = _pruning_cse_model(rng)
+    doc = planner.plan_model(model).to_json()
+    assert doc["version"] == 1
+    assert set(doc["tiers"]) == {"engine", "fitstats", "transform"}
+    assert doc["counts"]["stages"] == len(doc["stages"])
+    for row in doc["stages"]:
+        assert {"uid", "stage", "kind", "tier", "reason",
+                "source"} <= set(row)
+
+
+# ---------------------------------------------------------------------------
+# dead-column liveness + CSE analyses
+# ---------------------------------------------------------------------------
+
+
+def test_dead_columns_found_and_reported(rng):
+    model, _ = _pruning_cse_model(rng)
+    plan = planner.plan_model(model)
+    assert plan.counts()["prunedColumns"] > 0
+    rules = [f.rule for f in plan.findings()]
+    assert "TMG402" in rules
+    # liveness must cover every column the sanity checker keeps: the
+    # per-stage live sets union to at least the kept width
+    from transmogrifai_tpu.ops.sanity_checker import SanityCheckerModel
+    sc = next(m for m in model.fitted_stages.values()
+              if isinstance(m, SanityCheckerModel))
+    live_total = sum(len(v) for v in plan.prune.values())
+    full_widths = sum(plan.widths.values())
+    assert full_widths - live_total == plan.counts()["prunedColumns"]
+    assert live_total >= 1 and len(sc.keep_indices) >= 1
+
+
+def test_cse_merge_is_verified(rng):
+    model, _ = _pruning_cse_model(rng, dup_pivot=True)
+    plan = planner.plan_model(model)
+    assert len(plan.cse) == 1
+    m = plan.cse[0]
+    assert m["stage"] == "OneHotModel" and len(m["dropped"]) == 1
+    # no duplicate: no merge
+    model2, _ = _pruning_cse_model(rng, dup_pivot=False)
+    assert planner.plan_model(model2).cse == []
+
+
+def test_tmg403_state_mismatch_suppresses_merge(rng):
+    model, _ = _pruning_cse_model(rng, dup_pivot=True)
+    from transmogrifai_tpu.ops.onehot import OneHotModel
+    pivots = [m for m in model.fitted_stages.values()
+              if isinstance(m, OneHotModel)]
+    assert len(pivots) == 2
+    # perturb one twin's fitted state: still structurally identical
+    # (same class/inputs/params) but no longer bit-identical — the
+    # merge must be SUPPRESSED, not applied
+    pivots[1].vocabs = [list(reversed(v)) for v in pivots[1].vocabs]
+    plan = planner.plan_model(model)
+    assert plan.cse == []
+    f = next(f for f in plan.findings() if f.rule == "TMG403")
+    assert "fitted state differs" in f.message
+    assert f.severity == lint.Severity.INFO
+
+
+def test_planned_scores_bit_identical_with_cse_and_pruning(rng, fast_link):
+    model, recs = _pruning_cse_model(rng)
+    plan = model.plan()                        # builds + attaches
+    assert plan.counts()["prunedColumns"] > 0
+    assert plan.counts()["cseMerges"] == 1
+    base = model.score(recs, engine=False)
+    planned_eng = model.scoring_engine(gate_bandwidth=False)
+    unplanned_eng = model.scoring_engine(plan=None, gate_bandwidth=False)
+    # the aliased twin contributes no prepared blocks (host_prepare
+    # skipped) and the pruning actually rewrote the select indices
+    assert planned_eng._cse_alias and planned_eng._prune
+    assert not unplanned_eng._cse_alias and not unplanned_eng._prune
+    planned = planned_eng.score_store(recs)
+    unplanned = unplanned_eng.score_store(recs)
+    nm = [f.name for f in model.result_features][0]
+    for other in (planned, unplanned):
+        assert np.array_equal(base[nm].prediction, other[nm].prediction)
+        assert np.array_equal(base[nm].probability, other[nm].probability)
+        assert np.array_equal(base[nm].raw_prediction,
+                              other[nm].raw_prediction)
+    # transform path materializes every column: pruning must self-
+    # disable there and stay bit-identical too
+    tb = model.transform(recs, engine=False)
+    tp = planned_eng.transform_store(recs)
+    for cn in tb.names():
+        vb = getattr(tb[cn], "values", None)
+        if isinstance(vb, np.ndarray) and vb.dtype != object:
+            assert np.array_equal(vb, np.asarray(tp[cn].values)), cn
+
+
+def test_pruning_parity_on_titanic_example(fast_link):
+    sys.path.insert(0, os.path.join(_REPO, "examples"))
+    try:
+        from titanic import run as run_titanic
+    finally:
+        sys.path.pop(0)
+    out = run_titanic(num_folds=2, seed=42)
+    model = out["model"]
+    plan = planner.plan_model(model)
+    # the sanity checker prunes bad features on titanic → dead columns
+    assert plan.counts()["prunedColumns"] > 0
+    raws = [f for f in model.result_features[0].raw_features()]
+    from titanic import DEFAULT_CSV, TITANIC_SCHEMA
+    from transmogrifai_tpu.readers import DataReaders
+    store = DataReaders.simple.csv(
+        DEFAULT_CSV, TITANIC_SCHEMA,
+        key_fn=lambda r: r["id"]).generate_store(raws)
+    base = model.score(store, engine=False)
+    model.attach_plan(plan)
+    planned = model.scoring_engine(gate_bandwidth=False).score_store(store)
+    nm = [f.name for f in model.result_features][0]
+    assert np.array_equal(base[nm].prediction, planned[nm].prediction)
+    assert np.array_equal(base[nm].probability, planned[nm].probability)
+
+
+# ---------------------------------------------------------------------------
+# tier assignment: hints, measured costs, TMG401
+# ---------------------------------------------------------------------------
+
+
+def test_engine_tier_hint_overrides_gate(rng, monkeypatch):
+    model, _ = _pruning_cse_model(rng)
+    from transmogrifai_tpu import workflow as wf
+    monkeypatch.setattr(wf, "_DEVICE_BW_MBPS", 1.0)   # link below gate
+    plan = planner.plan_model(model)
+    plan.engine_tier = "device"
+    eng = model.attach_plan(plan).scoring_engine()
+    assert eng.enabled()          # measured tier beats the slow prior
+    plan2 = planner.plan_model(model)
+    plan2.engine_tier = "host"
+    monkeypatch.setattr(wf, "_DEVICE_BW_MBPS", 1e9)
+    eng = model.attach_plan(plan2).scoring_engine()
+    assert not eng.enabled()      # measured host tier beats a fast link
+    # the explicit force knob outranks the plan tier: a caller who
+    # builds with gate_bandwidth=False owns the decision
+    eng = model.attach_plan(plan2).scoring_engine(gate_bandwidth=False)
+    assert eng.enabled()
+    eng = model.attach_plan(None).scoring_engine()
+    assert eng.enabled()          # no plan: the gate (prior) rules
+
+
+def test_measured_chain_costs_decide_engine_tier(rng, tmp_path):
+    model, _ = _pruning_cse_model(rng)
+    db = CostDatabase.load(str(tmp_path / "db.json"))
+    db.record_chain(host_rows_per_s=1000.0, engine_rows_per_s=10000.0)
+    assert planner.plan_model(model, cost_db=db).engine_tier == "device"
+    db.record_chain(host_rows_per_s=10000.0, engine_rows_per_s=1000.0)
+    assert planner.plan_model(model, cost_db=db).engine_tier == "host"
+
+
+def test_tmg401_measured_slower_on_device(rng, tmp_path):
+    model, _ = _pruning_cse_model(rng)
+    db = CostDatabase.load(str(tmp_path / "db.json"))
+    # the vectorizer class measured 10× slower on device than host but
+    # its consumers pin it into the fused program → TMG401 warning
+    db.record_stage("NumericVectorizerModel", "host", 0.001, 1000)
+    db.record_stage("NumericVectorizerModel", "device", 0.01, 1000)
+    plan = planner.plan_model(model, cost_db=db)
+    f = next(f for f in plan.findings() if f.rule == "TMG401")
+    assert f.severity == lint.Severity.WARNING
+    assert "slower on device" in f.message
+    entry = next(e for e in plan.entries
+                 if e.stage == "NumericVectorizerModel")
+    assert entry.source == "measured"
+
+
+def test_fitstats_tier_hint_overrides_bandwidth_only(monkeypatch):
+    from transmogrifai_tpu import workflow as wf
+    from transmogrifai_tpu.columns import ColumnStore, column_from_values
+    from transmogrifai_tpu.fitstats import LayerStatsPlan, StatRequest
+    from transmogrifai_tpu.types import feature_types as ft
+    rng = np.random.default_rng(7)
+    n = wf.FUSE_MIN_ROWS
+    store = ColumnStore(
+        {"x": column_from_values(ft.Real, rng.normal(size=n))}, n)
+    plan = LayerStatsPlan([StatRequest("mean", "x")], n_stages=2)
+    monkeypatch.setattr(wf, "_DEVICE_BW_MBPS", 1.0)   # slow link
+    assert plan._gate_device(store) is False
+    assert plan._gate_device(store, "device") is True   # hint overrides
+    monkeypatch.setattr(wf, "_DEVICE_BW_MBPS", 1e9)   # fast link
+    assert plan._gate_device(store, "host") is False    # hint overrides
+    # the row floor holds whatever the hint says
+    small = ColumnStore(
+        {"x": column_from_values(ft.Real, rng.normal(size=8))}, 8)
+    assert plan._gate_device(small, "device") is False
+    # results parity: hinted tiers compute the same stats
+    r_host = plan.run(store, device=False)
+    r_hint = plan.run(store, tier_hint="host", mesh=False)
+    assert r_host.value("mean", "x") == r_hint.value("mean", "x")
+
+
+def test_transform_layer_fuse_override(rng, monkeypatch):
+    from transmogrifai_tpu import workflow as wf
+    model, recs = _pruning_cse_model(rng)
+    layer = [m for m in model._resolved_dag()[0]]
+    from transmogrifai_tpu.workflow import (_generate_raw_store,
+                                            _raw_features_of,
+                                            apply_layer_vectorized)
+    store = _generate_raw_store(recs,
+                                _raw_features_of(model.result_features))
+    monkeypatch.setattr(wf, "_DEVICE_BW_MBPS", 1.0)   # gate says host
+    host = apply_layer_vectorized(layer, store, fuse_min_rows=1)
+    fused = apply_layer_vectorized(layer, store, fuse_min_rows=1,
+                                   fuse=True)
+    for m in layer:
+        nm = m.output_name
+        assert np.array_equal(np.asarray(host[nm].values),
+                              np.asarray(fused[nm].values)), nm
+
+
+def test_pruning_parity_with_scaler_between_combine_and_select(
+        rng, fast_link):
+    """A StandardScaler between the (pruned) combiner and the sanity
+    select: the engine must slice the scaler's full-width mean/std to
+    the surviving columns (or the program would fail to broadcast) and
+    stay bit-identical."""
+    from transmogrifai_tpu.ops.vectors import StandardScalerEstimator
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    fj = FeatureBuilder.Real("junk").from_column().as_predictor()
+    fc = FeatureBuilder.PickList("c").from_column().as_predictor()
+    vec = transmogrify([fx, fj, fc])
+    scaled = StandardScalerEstimator().set_input(vec).get_output()
+    checked = label.sanity_check(scaled, remove_bad_features=True)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None,
+        seed=5)
+    pred = label.transform_with(sel, checked)
+    recs = _records(rng)
+    model = (Workflow().set_input_records(recs)
+             .set_result_features(pred).train())
+    # the parity oracle here is the UNPLANNED engine: host numpy runs
+    # the scaler in f64 while the device program runs the pipeline f32,
+    # a pre-existing engine-wide difference independent of planning —
+    # the planner's contract is planned ≡ unplanned, bit for bit
+    unplanned = model.scoring_engine(
+        plan=None, gate_bandwidth=False).score_store(recs)
+    plan = model.plan()
+    assert plan.counts()["prunedColumns"] > 0
+    eng = model.scoring_engine(gate_bandwidth=False)
+    assert eng._prune and eng._scale_slice, \
+        "the scaler under pruning must carry a constants slice"
+    planned = eng.score_store(recs)
+    nm = [f.name for f in model.result_features][0]
+    assert np.array_equal(unplanned[nm].prediction,
+                          planned[nm].prediction)
+    assert np.array_equal(unplanned[nm].probability,
+                          planned[nm].probability)
+    assert np.array_equal(unplanned[nm].raw_prediction,
+                          planned[nm].raw_prediction)
+
+
+def test_liveness_unknown_width_disables_pruning_through_combine():
+    """A combine input of unknown width (an upload) poisons the column
+    offsets of everything after it — no input of that combine may be
+    reported prunable."""
+    from types import SimpleNamespace
+
+    from transmogrifai_tpu.planner import _ALL, _device_liveness
+    from transmogrifai_tpu.scoring import _FusedStage
+
+    vec = SimpleNamespace(uid="v1",
+                          vector_metadata=lambda: SimpleNamespace(size=3))
+    sel = SimpleNamespace(uid="s1", keep_indices=[3])
+    items = [
+        _FusedStage(vec, "vec", "v1o", []),
+        _FusedStage(SimpleNamespace(uid="c1"), "combine", "co",
+                    ["upload", "v1o"]),
+        _FusedStage(sel, "select", "so", ["co"]),
+        _FusedStage(SimpleNamespace(uid="p1"), "predict", "po", ["so"]),
+    ]
+    live, _widths = _device_liveness(items, ["po"])
+    assert live["v1o"] is _ALL
+    # with the width known, the same shape DOES prune correctly
+    vec0 = SimpleNamespace(uid="v0",
+                           vector_metadata=lambda: SimpleNamespace(size=3))
+    items[1] = _FusedStage(SimpleNamespace(uid="c1"), "combine", "co",
+                           ["v0o", "v1o"])
+    live, _ = _device_liveness([_FusedStage(vec0, "vec", "v0o", [])]
+                               + items, ["po"])
+    assert live["v1o"] == {0}          # global col 3 → v1o's col 0
+    assert live["v0o"] == set()        # v0 is entirely dead
+
+
+def test_cse_pass_tolerates_unparamable_stages():
+    from types import SimpleNamespace
+
+    from transmogrifai_tpu.planner import _cse_pass
+    from transmogrifai_tpu.scoring import _FusedStage
+
+    class _NoParams:
+        def __init__(self, uid):
+            self.uid = uid
+            self.input_features = (SimpleNamespace(name="x"),)
+
+        def get_params(self):
+            raise RuntimeError("no ctor capture")
+
+    items = [_FusedStage(_NoParams("a"), "vec", "ao", []),
+             _FusedStage(_NoParams("b"), "vec", "bo", [])]
+    merges, suppressed = _cse_pass(items)     # must not raise
+    assert merges == []
+
+
+def test_phase_observations_feed_measured_phase_tiers(rng, monkeypatch):
+    """The fused stats pass / transform fusion report their measured
+    (phase, tier) costs; drained into a db they activate the planner's
+    per-phase tier decisions — the path that retires the global gate."""
+    from transmogrifai_tpu import workflow as wf
+    from transmogrifai_tpu.columns import ColumnStore, column_from_values
+    from transmogrifai_tpu.fitstats import LayerStatsPlan, StatRequest
+    from transmogrifai_tpu.types import feature_types as ft
+    db = CostDatabase()
+    planner.drain_phase_observations(db)          # clear any pending
+    n = wf.FUSE_MIN_ROWS
+    store = ColumnStore(
+        {"x": column_from_values(ft.Real,
+                                 np.random.default_rng(3).normal(size=n))},
+        n)
+    plan = LayerStatsPlan([StatRequest("mean", "x")], n_stages=2)
+    plan.run(store, device=False)                  # host-tier pass
+    db2 = CostDatabase()
+    assert planner.drain_phase_observations(db2) >= 1
+    assert db2.stage_cost("phase:fitstats", "host") is not None
+    # both tiers measured → the phase tier activates
+    db2.record_stage("phase:fitstats", "device", 10.0, 1000)
+    model, _ = _pruning_cse_model(rng)
+    assert planner.plan_model(model, cost_db=db2).fitstats_tier == "host"
+    db2.record_stage("phase:fitstats", "device", 0.000001, 1000000000)
+    p = planner.plan_model(model, cost_db=db2)
+    assert p.fitstats_tier in ("host", "device")   # decided, not None
+
+
+# ---------------------------------------------------------------------------
+# cost database: atomicity + corruption tolerance (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_cost_db_atomic_write_and_roundtrip(tmp_path):
+    path = str(tmp_path / "cache" / "tmog_cost_db.json")
+    db = CostDatabase.load(path)
+    db.record_stage("Foo", "fit", 0.5, 1000)
+    db.record_stage("Foo", "fit", 1.5, 1000)      # running mean
+    db.record_bandwidth(1234.56)
+    assert db.save()
+    assert os.path.exists(path)
+    assert not os.path.exists(path + ".tmp"), \
+        "atomic write must leave no temp file behind"
+    back = CostDatabase.load(path)
+    assert back.stage_cost("Foo", "fit") == pytest.approx(1.0)
+    assert back.bandwidth_mbps() == pytest.approx(1234.6)
+    assert back.corrupt is False and back.finding() is None
+
+
+@pytest.mark.parametrize("payload", [
+    '{"version": 1, "stages": {',            # truncated mid-object
+    "not json at all",
+    '{"version": 99, "stages": {}}',         # wrong version
+    '[1, 2, 3]',                             # wrong shape
+])
+def test_cost_db_corruption_never_crashes(tmp_path, payload):
+    path = str(tmp_path / "db.json")
+    with open(path, "w") as fh:
+        fh.write(payload)
+    db = CostDatabase.load(path)
+    assert db.corrupt is True
+    f = db.finding()
+    assert f.rule == "TMG404" and f.severity == lint.Severity.WARNING
+    assert db.stage_cost("Foo", "fit") is None
+    db.record_stage("Foo", "fit", 1.0, 1000)  # still usable
+    assert db.save()                          # and repairable
+
+
+def test_cost_db_merge_window_keeps_means_refreshable():
+    db = CostDatabase()
+    for _ in range(100):
+        db.record_stage("Foo", "device", 0.001, 1000)     # 0.001 s/krow
+    slot = db.doc["stages"]["Foo"]["device"]
+    assert slot["n"] == 100                # observation count is honest
+    db.record_stage("Foo", "device", 0.001 + 0.032, 1000)
+    # bounded window: the new observation carries >= 1/MERGE_WINDOW
+    # weight (an unbounded mean would move by only 1/101)
+    moved = db.stage_cost("Foo", "device") - 0.001
+    assert moved >= 0.032 / CostDatabase.MERGE_WINDOW - 1e-9
+
+
+def test_runner_disabled_plan_clears_stale_workflow_plan(rng, tmp_path):
+    """A reused runner: run A plans, run B sets plan:false — run B must
+    not silently follow run A's plan while stamping plan: null."""
+    wf = _flow_for_runner(rng)
+    reader = _CountingReader(_records(rng))
+    runner = OpWorkflowRunner(wf, training_reader=reader)
+    runner.run(RunType.TRAIN,
+               OpParams(model_location=str(tmp_path / "m1")))
+    assert wf._exec_plan is not None
+    out = runner.run(RunType.TRAIN,
+                     OpParams(model_location=str(tmp_path / "m2"),
+                              custom_params={"plan": False}))
+    assert wf._exec_plan is None
+    assert out.metrics["plan"] is None
+
+
+def test_record_fit_costs_from_trained_model(rng):
+    model, _ = _pruning_cse_model(rng)
+    assert model.train_rows > 0
+    db = CostDatabase(path=None)
+    n = planner.record_fit_costs(model, db)
+    assert n > 0
+    assert db.stage_cost("ModelSelector_modelSelector", "fit") is not None
+    # loaded models (train_rows 0) record nothing
+    model.train_rows = 0
+    assert planner.record_fit_costs(model, CostDatabase()) == 0
+
+
+# ---------------------------------------------------------------------------
+# runner + CLI integration
+# ---------------------------------------------------------------------------
+
+
+class _CountingReader:
+    def __init__(self, records):
+        self._records = records
+        self.calls = 0
+
+    def read_records(self):
+        self.calls += 1
+        return list(self._records)
+
+
+def _flow_for_runner(rng):
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    vec = transmogrify([fx])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None,
+        seed=5)
+    pred = label.transform_with(sel, vec)
+    return Workflow().set_result_features(pred)
+
+
+def test_runner_train_stamps_plan_and_persists_cost_db(rng, tmp_path):
+    wf = _flow_for_runner(rng)
+    reader = _CountingReader(_records(rng))
+    db_path = str(tmp_path / "cost.json")
+    params = OpParams(model_location=str(tmp_path / "model"),
+                      metrics_location=str(tmp_path / "metrics.json"),
+                      custom_params={"costDb": db_path})
+    out = OpWorkflowRunner(wf, training_reader=reader).run(
+        RunType.TRAIN, params)
+    plan = out.metrics["plan"]
+    assert plan["version"] == 1 and plan["counts"]["stages"] >= 2
+    # the post-train stamp is the FULL model plan (kinds classified)
+    assert any(e["kind"] == "predict" for e in plan["stages"])
+    db = json.load(open(db_path))
+    assert db["stages"], "measured fit costs must persist"
+    sunk = json.load(open(params.metrics_location))
+    assert sunk["plan"]["counts"] == plan["counts"]
+    # and the plan rides into score runs, attached to the engine
+    params2 = OpParams(model_location=str(tmp_path / "model"),
+                       custom_params={"costDb": db_path})
+    runner2 = OpWorkflowRunner(wf, scoring_reader=_CountingReader(
+        _records(rng)))
+    out2 = runner2.run(RunType.SCORE, params2)
+    assert out2.metrics["plan"]["counts"]["stages"] >= 2
+    # plan: false disables and stamps None
+    params3 = OpParams(model_location=str(tmp_path / "model"),
+                       custom_params={"plan": False})
+    out3 = runner2.run(RunType.SCORE, params3)
+    assert out3.metrics["plan"] is None
+
+
+def test_runner_plan_findings_ride_failon_and_suppress(rng, tmp_path):
+    wf = _flow_for_runner(rng)
+    db_path = str(tmp_path / "cost.json")
+    with open(db_path, "w") as fh:
+        fh.write('{"version": 1, "stages": {')       # corrupt → TMG404
+    model_dir = str(tmp_path / "model")
+    reader = _CountingReader(_records(rng))
+    OpWorkflowRunner(wf, training_reader=reader).run(
+        RunType.TRAIN,
+        OpParams(model_location=model_dir,
+                 custom_params={"plan": False}))
+    runner = OpWorkflowRunner(wf, scoring_reader=reader)
+    # default failOn=error: the TMG404 warning logs but passes
+    out = runner.run(RunType.SCORE, OpParams(
+        model_location=model_dir, custom_params={"costDb": db_path}))
+    assert out.metrics["rowsScored"] > 0
+    # failOn=warning gates it — BEFORE any reader I/O
+    reader.calls = 0
+    with pytest.raises(lint.LintError) as ei:
+        runner.run(RunType.SCORE, OpParams(
+            model_location=model_dir,
+            custom_params={"costDb": db_path, "failOn": "warning"}))
+    assert "TMG404" in str(ei.value)
+    assert reader.calls == 0
+    # lintSuppress mutes the rule and the run proceeds
+    out = runner.run(RunType.SCORE, OpParams(
+        model_location=model_dir,
+        custom_params={"costDb": db_path, "failOn": "warning",
+                       "lintSuppress": ["TMG404"]}))
+    assert out.metrics["rowsScored"] > 0
+
+
+def test_plan_cli_no_reader_io_no_device_dispatch(rng, tmp_path,
+                                                  capsys, monkeypatch):
+    from transmogrifai_tpu.cli import run_plan
+    model, _ = _pruning_cse_model(rng)
+    model.save(str(tmp_path / "model"), overwrite=True)
+    # the acceptance gate: planning must never probe the link, dispatch
+    # to a device, or read a dataset (same discipline as PR 5's check)
+    import jax
+
+    from transmogrifai_tpu import telemetry, workflow as wfmod
+
+    def _boom(*a, **k):
+        raise AssertionError("plan must not touch the device/link")
+    monkeypatch.setattr(wfmod, "device_roundtrip_mbps", _boom)
+    monkeypatch.setattr(telemetry, "probe_device_roundtrip_mbps", _boom)
+    monkeypatch.setattr(jax, "device_put", _boom)
+    assert run_plan(model_location=str(tmp_path / "model")) == 0
+    out = capsys.readouterr().out
+    assert "ExecutionPlan" in out and "Stage tiers" in out
+    assert "TMG402" in out            # the dead columns are reported
+    # --json renders the same stable document
+    assert run_plan(model_location=str(tmp_path / "model"),
+                    as_json=True) == 0
+    doc = json.loads(capsys.readouterr().out.split("\nTMG")[0])
+    assert doc["version"] == 1
+    # --suppress (and a params file's lintSuppress) mutes advisories,
+    # same machinery as check/the runner
+    assert run_plan(model_location=str(tmp_path / "model"),
+                    suppress=["TMG402"]) == 0
+    assert "TMG402" not in capsys.readouterr().out
+    p = tmp_path / "params.json"
+    p.write_text(json.dumps({
+        "modelLocation": str(tmp_path / "model"),
+        "customParams": {"lintSuppress": ["TMG402"]}}))
+    assert run_plan(str(p)) == 0
+    assert "TMG402" not in capsys.readouterr().out
+    # a missing model is a clean exit-1, not a traceback
+    assert run_plan(model_location=str(tmp_path / "nope")) == 1
+
+
+def test_cli_check_validates_planner_knobs(tmp_path, capsys):
+    from transmogrifai_tpu.cli import run_check
+    p = tmp_path / "params.json"
+    p.write_text(json.dumps({"customParams": {"plan": "yes"}}))
+    assert run_check(str(p)) == 1
+    assert "customParams.plan" in capsys.readouterr().out
+    p.write_text(json.dumps({"customParams": {"costDb": 5}}))
+    assert run_check(str(p)) == 1
+    assert "customParams.costDb" in capsys.readouterr().out
+    p.write_text(json.dumps({"customParams": {
+        "plan": True, "costDb": "/tmp/db.json"}}))
+    assert run_check(str(p)) == 0
+
+
+def test_cli_gen_emits_plan_knobs(tmp_path):
+    from transmogrifai_tpu.cli import generate_project
+    csv = tmp_path / "data.csv"
+    csv.write_text("label,x\n1,0.5\n0,0.1\n1,0.9\n0,0.2\n")
+    files = generate_project(str(csv), "label", str(tmp_path / "proj"))
+    params = json.load(open(files["params.json"]))
+    assert params["customParams"]["plan"] is True
+    assert params["customParams"]["costDb"] is None
+
+
+# ---------------------------------------------------------------------------
+# telemetry mirroring + always-on tallies
+# ---------------------------------------------------------------------------
+
+
+def test_plan_emits_telemetry_and_tallies(rng):
+    from transmogrifai_tpu import telemetry
+    model, _ = _pruning_cse_model(rng)
+    before = planner.planner_stats()
+    telemetry.enable()
+    try:
+        telemetry.reset()
+        collector = telemetry.add_listener(
+            telemetry.CollectingRunListener())
+        plan = planner.plan_model(model)
+        assert collector.plan is not None
+        assert collector.plan["stages"] == plan.counts()["stages"]
+        assert collector.plan["cseMerges"] == 1
+        assert collector.summary()["plan"]["prunedColumns"] > 0
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    after = planner.planner_stats()
+    assert after["plans_built"] == before["plans_built"] + 1
+    assert after["pruned_columns"] > before["pruned_columns"]
+
+
+def test_plan_workflow_pre_fit(rng):
+    wf = _flow_for_runner(rng)
+    plan = planner.plan_workflow(wf)
+    assert plan.counts()["stages"] >= 2
+    assert plan.engine_tier is None and plan.prune == {}
+    wf.set_plan(plan)
+    assert wf._exec_plan is plan

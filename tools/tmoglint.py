@@ -21,6 +21,12 @@ here as rules (the TMG3xx family of the catalog in
   (``with telemetry.span(...)``): a bare ``span(...)`` call is an
   unpaired begin/end that never records and silently corrupts the
   per-thread span stack.
+* **TMG306** — runtime code must not call ``make_mesh()`` directly:
+  the PR-6 one-process-mesh invariant routes every consumer through
+  ``process_default_mesh()``/``set_process_mesh`` (a throwaway mesh per
+  pass is the regression ``mesh_constructions`` exists to catch).
+  ``parallel/`` itself and tests are exempt; a deliberate explicit
+  construction carries ``# lint: explicit-mesh — reason``.
 
 Runs as a CLI over one or more paths (default: the ``transmogrifai_tpu``
 package next to this script) and as a tier-1 pytest
@@ -46,11 +52,12 @@ if _REPO not in sys.path:                       # direct script execution
 from transmogrifai_tpu.lint import Finding, Severity, enforce  # noqa: E402
 
 __all__ = ["lint_source", "lint_file", "lint_paths", "main",
-           "ALLOW_WALLCLOCK", "ALLOW_BROAD_EXCEPT"]
+           "ALLOW_WALLCLOCK", "ALLOW_BROAD_EXCEPT", "ALLOW_EXPLICIT_MESH"]
 
 #: suppression markers, checked on the finding's own source line
 ALLOW_WALLCLOCK = "lint: wall-clock"
 ALLOW_BROAD_EXCEPT = "lint: broad-except"
+ALLOW_EXPLICIT_MESH = "lint: explicit-mesh"
 
 
 def _fault_sites() -> frozenset:
@@ -68,14 +75,21 @@ class _Visitor(ast.NodeVisitor):
         self.lines = lines
         self.findings: List[Finding] = []
         #: local names bound to the time module / telemetry module /
-        #: resilience module / their relevant functions
+        #: resilience module / mesh module / their relevant functions
         self.time_modules: Set[str] = set()
         self.time_funcs: Set[str] = set()       # from time import time [as x]
         self.telemetry_modules: Set[str] = set()
         self.span_funcs: Set[str] = set()
         self.resilience_modules: Set[str] = set()
         self.inject_funcs: Set[str] = set()
+        self.mesh_modules: Set[str] = set()
+        self.make_mesh_funcs: Set[str] = set()
         self.with_contexts: Set[int] = set()
+        #: parallel/ owns mesh construction, tests may build explicit
+        #: topologies — TMG306 exempts both by path
+        parts = os.path.normpath(path).split(os.sep)
+        self.mesh_exempt = ("parallel" in parts or "tests" in parts
+                            or os.path.basename(path).startswith("test_"))
 
     # -- helpers -----------------------------------------------------------
     def _marked(self, lineno: int, marker: str) -> bool:
@@ -99,6 +113,8 @@ class _Visitor(ast.NodeVisitor):
                 self.telemetry_modules.add(local)
             if alias.name.endswith("resilience"):
                 self.resilience_modules.add(local)
+            if alias.name.endswith("mesh"):
+                self.mesh_modules.add(local)
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
@@ -111,10 +127,14 @@ class _Visitor(ast.NodeVisitor):
                 self.telemetry_modules.add(local)
             if alias.name == "resilience":
                 self.resilience_modules.add(local)
+            if alias.name == "mesh":
+                self.mesh_modules.add(local)
             if mod.endswith("telemetry") and alias.name == "span":
                 self.span_funcs.add(local)
             if mod.endswith("resilience") and alias.name == "inject":
                 self.inject_funcs.add(local)
+            if mod.endswith("mesh") and alias.name == "make_mesh":
+                self.make_mesh_funcs.add(local)
         self.generic_visit(node)
 
     # -- with: remember sanctioned context-manager calls -------------------
@@ -170,6 +190,14 @@ class _Visitor(ast.NodeVisitor):
             return True
         return isinstance(f, ast.Name) and f.id in self.span_funcs
 
+    def _is_make_mesh(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "make_mesh" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in self.mesh_modules:
+            return True
+        return isinstance(f, ast.Name) and f.id in self.make_mesh_funcs
+
     def visit_Call(self, node: ast.Call) -> None:
         if self._is_time_time(node) \
                 and not self._marked(node.lineno, ALLOW_WALLCLOCK):
@@ -203,6 +231,15 @@ class _Visitor(ast.NodeVisitor):
                 "span only records on __exit__, so an unpaired call "
                 "never lands in the trace and corrupts the per-thread "
                 "span stack")
+        elif self._is_make_mesh(node) and not self.mesh_exempt \
+                and not self._marked(node.lineno, ALLOW_EXPLICIT_MESH):
+            self._add(
+                "TMG306", node.lineno,
+                "direct make_mesh() outside parallel/ — runtime code "
+                "shares the ONE process mesh via process_default_mesh()"
+                "/set_process_mesh (a throwaway mesh per pass is the "
+                "mesh_constructions regression); mark a deliberate "
+                f"explicit topology '# {ALLOW_EXPLICIT_MESH} — <reason>'")
         self.generic_visit(node)
 
 

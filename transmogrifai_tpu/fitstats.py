@@ -501,7 +501,7 @@ class LayerStatsPlan:
     def n_requests(self) -> int:
         return len(self.requests)
 
-    def _gate_device(self, store) -> bool:
+    def _gate_device(self, store, tier_hint: Optional[str] = None) -> bool:
         # the breaker is deliberately process-wide (unlike the
         # per-model scoring.engine breaker): the moment-fold program is
         # model-independent — (chunk, width, dtype) shapes, no plan —
@@ -509,22 +509,39 @@ class LayerStatsPlan:
         # workflow in the process shares. allow() goes LAST in the
         # chain: it may consume the half-open probe, and short-circuit
         # guarantees a device attempt (which reports back) follows.
+        #
+        # ``tier_hint`` is the planner's measured per-phase decision
+        # (planner.ExecutionPlan.fitstats_tier): it overrides only the
+        # BANDWIDTH half of the gate — the row floor (below it compile
+        # cost dominates any link) and the breaker always hold.
         from . import resilience
         from .workflow import (FUSE_MIN_BANDWIDTH_MBPS, FUSE_MIN_ROWS,
                                device_roundtrip_mbps)
-        return (store.n_rows >= FUSE_MIN_ROWS
-                and device_roundtrip_mbps() >= FUSE_MIN_BANDWIDTH_MBPS
-                and resilience.breaker("fitstats.device").allow())
+        if store.n_rows < FUSE_MIN_ROWS:
+            return False
+        if tier_hint == "host":
+            return False
+        if tier_hint != "device" \
+                and device_roundtrip_mbps() < FUSE_MIN_BANDWIDTH_MBPS:
+            return False
+        return resilience.breaker("fitstats.device").allow()
 
     def run(self, store, device: Optional[bool] = None,
-            mesh=None) -> StatResults:
+            mesh=None, tier_hint: Optional[str] = None) -> StatResults:
         """Execute every request in one pass; ``device`` overrides the
-        bandwidth/row gate (tests pin it either way). ``mesh`` is the
-        caller's (data, grid) mesh for the device tier's row sharding —
-        None falls back to the cached process default, ``False`` forces
-        the unsharded path."""
+        bandwidth/row gate (tests pin it either way), ``tier_hint``
+        (the planner's measured decision, ``"host"``/``"device"``)
+        overrides only the bandwidth half — the row floor and the
+        device-tier breaker always hold. ``mesh`` is the caller's
+        (data, grid) mesh for the device tier's row sharding — None
+        falls back to the cached process default, ``False`` forces the
+        unsharded path."""
         from . import telemetry
 
+        import time
+
+        t_run = time.perf_counter()
+        c_run = telemetry._COMPILE_CLOCK["s"]
         moment_cols: Dict[str, Dict[str, List[Tuple]]] = {}
         other: List[StatRequest] = []
         for r in self.requests:
@@ -539,7 +556,8 @@ class LayerStatsPlan:
         # asked when a device pass (which reports the probe's outcome)
         # would actually run
         use_device = bool(moment_cols) and (
-            self._gate_device(store) if device is None else bool(device))
+            self._gate_device(store, tier_hint) if device is None
+            else bool(device))
 
         values: Dict[Tuple, Any] = {}
         touched: Dict[str, int] = {}
@@ -565,6 +583,12 @@ class LayerStatsPlan:
                         "fitstats device pass failed; computing this "
                         "pass on the host tier")
                     use_device = False
+                    # restart the phase-cost window: the failed device
+                    # attempt's time must not be charged to the HOST
+                    # observation below (it would bias the cost db
+                    # toward the very tier that is failing)
+                    t_run = time.perf_counter()
+                    c_run = telemetry._COMPILE_CLOCK["s"]
             if not use_device:
                 bundles = {nm: _host_moment_bundle(store[nm], kinds)
                            for nm, kinds in moment_cols.items()}
@@ -601,4 +625,18 @@ class LayerStatsPlan:
             self.n_requests, self.n_stages,
             "device" if use_device else "host", len(touched),
             scanned / 1e6, saved)
+        # feed the planner's measured per-phase tier costs — only at
+        # row counts where the tier decision is contested, so the two
+        # tiers' s/krow observations stay comparable (planner.py); the
+        # one-time XLA compile of the fold program is subtracted so a
+        # cold pass cannot poison the device tier's steady-state mean
+        from .workflow import FUSE_MIN_ROWS
+        if moment_cols and store.n_rows >= FUSE_MIN_ROWS:
+            from . import planner
+            elapsed = time.perf_counter() - t_run
+            compile_s = min(telemetry._COMPILE_CLOCK["s"] - c_run,
+                            elapsed)
+            planner.observe_phase(
+                "fitstats", "device" if use_device else "host",
+                elapsed - compile_s, store.n_rows)
         return StatResults(values)

@@ -130,6 +130,23 @@ RULES: Dict[str, Tuple[str, str]] = {
     "TMG305": (Severity.ERROR,
                "source file does not parse — the self-lint could not "
                "analyze it"),
+    "TMG306": (Severity.ERROR,
+               "direct make_mesh() call outside parallel/ — runtime code "
+               "must go through process_default_mesh()/set_process_mesh "
+               "(allow: '# lint: explicit-mesh — reason')"),
+    # -- TMG4xx: whole-DAG planner advisories (planner.py) -----------------
+    "TMG401": (Severity.WARNING,
+               "stage measured slower on device than host but is pinned "
+               "to the device tier"),
+    "TMG402": (Severity.INFO,
+               "prunable dead columns: vectorizer output columns never "
+               "reach a sink (dropped before the predictor)"),
+    "TMG403": (Severity.INFO,
+               "CSE opportunity suppressed: structurally identical stages "
+               "differ only in uid-sensitive params/state"),
+    "TMG404": (Severity.WARNING,
+               "cost database unreadable (corrupt/truncated JSON) — "
+               "static fallback estimates are in force"),
 }
 
 

@@ -1,0 +1,969 @@
+"""Cost-based whole-DAG plan analyzer — the KeystoneML/Flare middle-end.
+
+TransmogrifAI inherits Catalyst's whole-pipeline view but never exploits
+it; KeystoneML's pipeline-level cost optimizer and Flare's whole-query
+native compilation (PAPERS.md) show what a middle-end buys when the DAG
+is analyzed *before* execution. This module is that middle-end for the
+TPU runtime: it abstractly interprets the whole feature DAG — reusing
+lint.py's synthetic typed store discipline, so **no dataset is read and
+no device is dispatched** — and emits an explainable
+:class:`ExecutionPlan` that ``Workflow`` fitting and the compiled
+scoring engine then follow.
+
+Four analyses run over the abstract DAG:
+
+* **Dead-column pruning** — column-granular liveness propagated from the
+  sinks (result features, predictors) backward through the fused
+  select/scale/combine chain, extending TMG104's stage-granular orphan
+  detection to individual vector slots: vectorizer output columns the
+  sanity checker drops before the predictor are dead in the device
+  program, and the scoring engine slices them off right after
+  ``device_compute`` (gather-of-concat == concat-of-gathers, so results
+  are bit-identical by construction).
+* **Cross-stage CSE** — structurally identical stages (same class, same
+  non-uid params, same input features, and — for fitted models —
+  bit-identical fitted state) are deduplicated to ONE computation with
+  fan-out in the scoring engine's device program. Merges are only
+  emitted after the fitted-state equality check, so aliased outputs are
+  bit-identical to the unplanned run by construction; near-misses that
+  differ only in uid-sensitive params surface as TMG403 advisories.
+* **Per-stage tier assignment** — host vs device vs fused decided per
+  stage (and per heavy phase: scoring engine, fused fit-stats pass,
+  transform-layer fusion) from a persisted :class:`CostDatabase` of
+  measured compile/execute/transfer costs, written atomically alongside
+  the compile cache. When no measurement exists, documented static
+  fallback estimates from the abstract shapes apply, and the old global
+  ``FUSE_MIN_BANDWIDTH_MBPS`` gate degrades to exactly what it should
+  be: the cold-start bandwidth *prior*, not a hard per-process switch.
+* **Plan explanation** — a stable, diffable report (per-stage tier +
+  reason + estimated vs measured cost, pruned columns, CSE merges)
+  stamped into every runner metrics doc under ``plan``, surfaced via
+  ``python -m transmogrifai_tpu plan params.json [--model DIR]``, and
+  mirrored into lint as the TMG4xx advisory rule family so plan
+  findings flow through the existing ``failOn``/suppress/telemetry
+  machinery.
+
+Static fallback cost model (per 1000 rows, used when the cost database
+has no measurement for a stage class):
+
+* ``host``  = bytes/krow ÷ :data:`STATIC_HOST_GBPS` — numpy streaming
+  throughput over the stage's input+prepared bytes;
+* ``device`` = bytes/krow ÷ link (the db's measured bandwidth, else the
+  ``FUSE_MIN_BANDWIDTH_MBPS`` prior) + bytes/krow ÷
+  :data:`STATIC_DEVICE_GBPS` — transfer plus HBM-bound compute.
+
+Both are deliberately coarse: they only need to rank tiers sensibly
+until a measurement lands in the db, and every plan entry says which
+source (``measured``/``static``) produced its decision.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "CostDatabase", "ExecutionPlan", "PlanEntry",
+    "plan_model", "plan_workflow", "record_fit_costs",
+    "default_cost_db_path", "planner_stats", "reset_planner_stats",
+    "COST_DB_FILENAME", "STATIC_HOST_GBPS", "STATIC_DEVICE_GBPS",
+]
+
+#: cost database file name, persisted alongside the XLA compile cache
+#: (same lifecycle: a warm directory makes the next process smarter)
+COST_DB_FILENAME = "tmog_cost_db.json"
+
+#: static prior: host numpy streams a transform at about this rate
+STATIC_HOST_GBPS = 1.0
+
+#: static prior: device elementwise transform work is HBM-bound at
+#: roughly this rate (per-chip; deliberately conservative)
+STATIC_DEVICE_GBPS = 50.0
+
+# ---------------------------------------------------------------------------
+# always-on tallies (bench stamps these on every doc, like fitstats)
+# ---------------------------------------------------------------------------
+
+_TALLY_LOCK = threading.Lock()
+_TALLY = {"plans_built": 0, "cse_merges": 0, "pruned_columns": 0,
+          "stages_fused": 0, "stages_host": 0}
+
+
+def planner_stats() -> Dict[str, int]:
+    """Process-wide planner tallies (always on, cheap — the
+    ``fitstats_stats`` discipline): plans built, CSE merges found,
+    dead columns found, per-tier stage counts."""
+    with _TALLY_LOCK:
+        return dict(_TALLY)
+
+
+def reset_planner_stats() -> None:
+    with _TALLY_LOCK:
+        for k in _TALLY:
+            _TALLY[k] = 0
+
+
+def _tally(key: str, n: int = 1) -> None:
+    with _TALLY_LOCK:
+        _TALLY[key] += n
+
+
+# ---------------------------------------------------------------------------
+# phase-cost observations — how the measured per-phase tiers get fed
+# ---------------------------------------------------------------------------
+
+#: pending (phase, tier, seconds, rows) observations reported by the
+#: fused stats pass and the transform-layer fusion as they execute;
+#: the runner drains them into the persisted cost db after a train.
+#: Bounded so a drain-less process cannot grow it without limit.
+_OBS_LOCK = threading.Lock()
+_PHASE_OBS: List[Tuple[str, str, float, int]] = []
+_PHASE_OBS_CAP = 4096
+
+
+def observe_phase(phase: str, tier: str, seconds: float,
+                  rows: int) -> None:
+    """Record one measured phase execution (``phase`` in
+    ``fitstats``/``transform``, ``tier`` in ``host``/``device``).
+    Always on and cheap (a lock + append); callers only report rows
+    counts where the tier decision is actually contested (at or above
+    the fusion row floor), so the two tiers' s/krow stay comparable."""
+    if rows <= 0 or seconds < 0:
+        return
+    with _OBS_LOCK:
+        if len(_PHASE_OBS) < _PHASE_OBS_CAP:
+            _PHASE_OBS.append((str(phase), str(tier), float(seconds),
+                               int(rows)))
+
+
+def drain_phase_observations(db: "CostDatabase") -> int:
+    """Fold every pending phase observation into ``db`` (as
+    ``phase:<name>`` stage entries — what :func:`_phase_tier` reads)
+    and clear the buffer; returns the count drained."""
+    with _OBS_LOCK:
+        obs = list(_PHASE_OBS)
+        del _PHASE_OBS[:]
+    for phase, tier, s, rows in obs:
+        db.record_stage(f"phase:{phase}", tier, s, rows)
+    return len(obs)
+
+
+# ---------------------------------------------------------------------------
+# cost database — measured costs persisted next to the compile cache
+# ---------------------------------------------------------------------------
+
+
+def default_cost_db_path(compile_cache_dir: Optional[str]) -> Optional[str]:
+    """Where the cost database lives for a given persistent compile
+    cache directory (None when no cache is configured — the db is then
+    in-memory only and static estimates rule)."""
+    if not compile_cache_dir:
+        return None
+    return os.path.join(str(compile_cache_dir), COST_DB_FILENAME)
+
+
+class CostDatabase:
+    """Measured per-stage-class and whole-chain costs, JSON-persisted.
+
+    Schema (``version`` 1)::
+
+        {"version": 1,
+         "bandwidth_mbps": 1234.5 | null,          # measured link
+         "chain": {"engine_s_per_krow": ..., "host_s_per_krow": ...},
+         "stages": {"<StageClass>": {
+             "fit":    {"s_per_krow": ..., "n": k},
+             "host":   {"s_per_krow": ..., "n": k},
+             "device": {"s_per_krow": ..., "n": k}}}}
+
+    Writes reuse the runner's atomic temp + ``os.replace`` discipline; a
+    corrupt/truncated db **never crashes** — it loads as a fresh db with
+    ``corrupt=True`` and a TMG404 warning finding, and static estimates
+    rule until new measurements land.
+    """
+
+    VERSION = 1
+
+    def __init__(self, path: Optional[str] = None,
+                 doc: Optional[Dict[str, Any]] = None,
+                 corrupt: bool = False):
+        self.path = path
+        self.corrupt = corrupt
+        self.doc: Dict[str, Any] = doc if doc is not None else {
+            "version": self.VERSION, "bandwidth_mbps": None,
+            "chain": {}, "stages": {}}
+
+    # -- persistence -------------------------------------------------------
+    @classmethod
+    def load(cls, path: Optional[str]) -> "CostDatabase":
+        """Load from ``path``; a missing file is a fresh db, a corrupt or
+        truncated one is a fresh db flagged ``corrupt`` (TMG404) — never
+        an exception."""
+        if not path or not os.path.exists(path):
+            return cls(path=path)
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+            if (not isinstance(doc, dict)
+                    or not isinstance(doc.get("stages"), dict)
+                    or doc.get("version") != cls.VERSION):
+                raise ValueError(f"unexpected cost-db structure in {path}")
+        except (OSError, ValueError) as e:
+            # json.JSONDecodeError is a ValueError: truncated/corrupt
+            # files land here, degrade to static estimates with a finding
+            logger.warning("cost database %s unreadable (%s); static "
+                           "estimates in force", path, e)
+            return cls(path=path, corrupt=True)
+        doc.setdefault("bandwidth_mbps", None)
+        doc.setdefault("chain", {})
+        return cls(path=path, doc=doc)
+
+    def save(self, path: Optional[str] = None) -> bool:
+        """Atomic write (temp + ``os.replace``, the ``_write_metrics``
+        discipline): a kill mid-write can never leave a truncated db for
+        the next process to trip over. Coordinator-only in multi-host
+        runs (every process computes identical costs)."""
+        path = path or self.path
+        if not path:
+            return False
+        from .parallel.multihost import is_coordinator
+        if not is_coordinator():
+            return False
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self.doc, fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        self.path = path
+        return True
+
+    def finding(self):
+        """The TMG404 warning when this db loaded corrupt, else None."""
+        if not self.corrupt:
+            return None
+        from .lint import Finding
+        return Finding(
+            "TMG404", "cost database is corrupt/truncated — falling back "
+            "to static estimates (delete or regenerate it to clear this)",
+            location=self.path)
+
+    # -- recording ---------------------------------------------------------
+
+    #: running-mean window: new observations always keep at least
+    #: 1/WINDOW weight, so a changed backend/link re-converges instead
+    #: of being frozen under an unbounded historical mean
+    MERGE_WINDOW = 32
+
+    @classmethod
+    def _merge(cls, slot: Dict[str, Any], s_per_krow: float) -> None:
+        n = min(int(slot.get("n", 0)), cls.MERGE_WINDOW - 1)
+        old = float(slot.get("s_per_krow", 0.0))
+        slot["s_per_krow"] = round((old * n + s_per_krow) / (n + 1), 6)
+        slot["n"] = int(slot.get("n", 0)) + 1
+
+    def record_stage(self, class_name: str, tier: str, seconds: float,
+                     rows: int) -> None:
+        """Fold one measured (class, tier) observation in: ``tier`` is
+        ``fit`` / ``host`` / ``device``."""
+        if rows <= 0 or seconds < 0:
+            return
+        slot = self.doc["stages"].setdefault(str(class_name), {}) \
+            .setdefault(tier, {})
+        self._merge(slot, seconds / (rows / 1000.0))
+
+    def record_bandwidth(self, mbps: float) -> None:
+        self.doc["bandwidth_mbps"] = round(float(mbps), 1)
+
+    def record_chain(self, host_rows_per_s: Optional[float] = None,
+                     engine_rows_per_s: Optional[float] = None) -> None:
+        """Whole-chain scoring measurements (per-layer host path vs the
+        compiled engine) — the strongest tier evidence there is."""
+        ch = self.doc["chain"]
+        if host_rows_per_s and host_rows_per_s > 0:
+            ch["host_s_per_krow"] = round(1000.0 / host_rows_per_s, 6)
+        if engine_rows_per_s and engine_rows_per_s > 0:
+            ch["engine_s_per_krow"] = round(1000.0 / engine_rows_per_s, 6)
+
+    # -- lookup ------------------------------------------------------------
+    def stage_cost(self, class_name: str, tier: str) -> Optional[float]:
+        slot = self.doc["stages"].get(class_name, {}).get(tier)
+        return float(slot["s_per_krow"]) if slot else None
+
+    def chain_cost(self, which: str) -> Optional[float]:
+        v = self.doc["chain"].get(f"{which}_s_per_krow")
+        return float(v) if v is not None else None
+
+    def bandwidth_mbps(self) -> Optional[float]:
+        v = self.doc.get("bandwidth_mbps")
+        return float(v) if v else None
+
+
+def record_fit_costs(model, db: CostDatabase) -> int:
+    """Harvest a freshly trained model's per-stage fit timings (the
+    telemetry/stage_metrics evidence) into the cost database; returns
+    the number of observations recorded. Warm-started stages carry no
+    measurement and are skipped."""
+    rows = int(getattr(model, "train_rows", 0) or 0)
+    if rows <= 0:
+        return 0
+    n = 0
+    for _uid, m in sorted(model.stage_metrics.items()):
+        if m.get("warmStarted") or "fitSeconds" not in m:
+            continue
+        execute = m.get("executeSeconds", m["fitSeconds"])
+        db.record_stage(m.get("stageName", "?"), "fit", float(execute),
+                        rows)
+        n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# the plan
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PlanEntry:
+    """One stage's row in the execution plan."""
+
+    uid: str
+    stage: str                      # stage class/display name
+    kind: str                       # vec|combine|select|scale|predict|host
+    tier: str                       # host|fused
+    reason: str
+    est_host_s_per_krow: Optional[float] = None
+    est_device_s_per_krow: Optional[float] = None
+    measured_s_per_krow: Optional[float] = None
+    source: str = "static"          # static|measured
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "uid": self.uid, "stage": self.stage, "kind": self.kind,
+            "tier": self.tier, "reason": self.reason,
+            "source": self.source}
+        for k, v in (("estHostSPerKrow", self.est_host_s_per_krow),
+                     ("estDeviceSPerKrow", self.est_device_s_per_krow),
+                     ("measuredSPerKrow", self.measured_s_per_krow)):
+            if v is not None:
+                out[k] = v
+        return out
+
+
+class ExecutionPlan:
+    """The planner's output: explainable, stable, and executable.
+
+    ``Workflow`` fitting consults ``fitstats_tier``/``transform_tier``;
+    the scoring engine consults ``engine_tier``, ``prune`` (per-vec live
+    column indices) and ``cse`` (verified merge groups). ``report()`` is
+    byte-stable for a given (DAG, cost db) pair — the determinism tests
+    diff it directly."""
+
+    def __init__(self, entries: List[PlanEntry],
+                 prune: Optional[Dict[str, "np.ndarray"]] = None,
+                 widths: Optional[Dict[str, int]] = None,
+                 cse: Optional[List[Dict[str, Any]]] = None,
+                 cse_suppressed: Optional[List[Dict[str, Any]]] = None,
+                 engine_tier: Optional[str] = None,
+                 fitstats_tier: Optional[str] = None,
+                 transform_tier: Optional[str] = None,
+                 link_mbps: float = 0.0, link_source: str = "prior",
+                 tier_findings: Optional[List[Any]] = None,
+                 db_finding: Optional[Any] = None):
+        self.entries = entries
+        #: {vec stage uid: sorted live column indices} — only stages
+        #: with at least one dead column appear
+        self.prune = prune or {}
+        #: {vec stage uid: declared output width} for pruned stages
+        self.widths = widths or {}
+        #: verified merges: [{"kept": uid, "dropped": [uid...],
+        #: "stage": class}] — bit-identical state asserted by the planner
+        self.cse = cse or []
+        self.cse_suppressed = cse_suppressed or []
+        self.engine_tier = engine_tier
+        self.fitstats_tier = fitstats_tier
+        self.transform_tier = transform_tier
+        self.link_mbps = link_mbps
+        self.link_source = link_source
+        self._tier_findings = tier_findings or []
+        self._db_finding = db_finding
+
+    # -- summaries ---------------------------------------------------------
+    def counts(self) -> Dict[str, Any]:
+        tiers: Dict[str, int] = {}
+        for e in self.entries:
+            tiers[e.tier] = tiers.get(e.tier, 0) + 1
+        return {
+            "stages": len(self.entries),
+            "tiers": {k: tiers[k] for k in sorted(tiers)},
+            "prunedColumns": int(sum(
+                self.widths[uid] - len(idx)
+                for uid, idx in self.prune.items())),
+            "cseMerges": len(self.cse),
+            "engineTier": self.engine_tier,
+        }
+
+    def to_json(self) -> Dict[str, Any]:
+        """Stable JSON form (the ``plan`` block of metrics docs)."""
+        pruned = {}
+        for uid, idx in sorted(self.prune.items()):
+            live = {int(i) for i in idx}
+            pruned[uid] = {"width": int(self.widths[uid]),
+                           "dead": [j for j in range(self.widths[uid])
+                                    if j not in live]}
+        return {
+            "version": 1,
+            "link": {"mbps": round(self.link_mbps, 1),
+                     "source": self.link_source},
+            "tiers": {"engine": self.engine_tier,
+                      "fitstats": self.fitstats_tier,
+                      "transform": self.transform_tier},
+            "stages": [e.to_json() for e in self.entries],
+            "prunedColumns": pruned,
+            "cse": self.cse,
+            "cseSuppressed": self.cse_suppressed,
+            "counts": self.counts(),
+        }
+
+    def report(self) -> str:
+        """The human-facing plan explanation: one deterministic text
+        document (tier table + prune/CSE sections) suitable for diffing
+        across planner or cost-db changes."""
+        from .utils.table import Table
+        c = self.counts()
+        head = (f"ExecutionPlan: {c['stages']} stage(s) "
+                + " ".join(f"{k}={v}" for k, v in c["tiers"].items())
+                + f" | engine tier: {self.engine_tier or 'gate'}"
+                + f" | link {self.link_mbps:.1f} MB/s ({self.link_source})")
+        rows = []
+        for e in self.entries:
+            rows.append([
+                e.stage, e.uid, e.kind, e.tier,
+                "" if e.est_host_s_per_krow is None
+                else f"{e.est_host_s_per_krow:.6f}",
+                "" if e.est_device_s_per_krow is None
+                else f"{e.est_device_s_per_krow:.6f}",
+                "" if e.measured_s_per_krow is None
+                else f"{e.measured_s_per_krow:.6f}",
+                e.source, e.reason])
+        parts = [head, Table(
+            ["stage", "uid", "kind", "tier", "est host s/krow",
+             "est device s/krow", "measured s/krow", "source", "reason"],
+            rows, name="Stage tiers").render()]
+        if self.prune:
+            lines = ["Pruned dead columns "
+                     f"({c['prunedColumns']} total):"]
+            for uid, idx in sorted(self.prune.items()):
+                dead = self.widths[uid] - len(idx)
+                lines.append(f"  {uid}: {dead} of {self.widths[uid]} "
+                             "column(s) never reach a sink")
+            parts.append("\n".join(lines))
+        if self.cse:
+            lines = [f"CSE merges ({len(self.cse)}):"]
+            for m in self.cse:
+                lines.append(f"  {m['stage']}: keep {m['kept']}, alias "
+                             + ", ".join(m["dropped"]))
+            parts.append("\n".join(lines))
+        if self.cse_suppressed:
+            lines = [f"CSE suppressed ({len(self.cse_suppressed)}):"]
+            for m in self.cse_suppressed:
+                lines.append(f"  {m['stage']}: {m['reason']}")
+            parts.append("\n".join(lines))
+        return "\n\n".join(parts) + "\n"
+
+    def findings(self) -> List[Any]:
+        """TMG4xx advisory findings: tier contradictions (TMG401), dead
+        columns (TMG402), suppressed CSE (TMG403), corrupt db (TMG404)
+        — routed through the same ``failOn``/suppress machinery as the
+        pre-flight rules."""
+        from .lint import Finding
+        out: List[Finding] = list(self._tier_findings)
+        for uid, idx in sorted(self.prune.items()):
+            dead = self.widths[uid] - len(idx)
+            out.append(Finding(
+                "TMG402", f"{dead} of {self.widths[uid]} output "
+                "column(s) are dead: dropped by a downstream selector "
+                "before any sink — the planner prunes them from the "
+                "device program", stage=uid))
+        for m in self.cse_suppressed:
+            out.append(Finding(
+                "TMG403", f"{m['stage']}: structurally identical stages "
+                f"({', '.join(m['uids'])}) cannot merge — {m['reason']}",
+                stage=m["uids"][0]))
+        if self._db_finding is not None:
+            out.append(self._db_finding)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# liveness (dead-column pruning) over the fused plan
+# ---------------------------------------------------------------------------
+
+#: liveness sentinel: every column live (distinct from a missing entry,
+#: which means "no fused consumer needs this output at all")
+_ALL = object()
+
+
+def _union(a, b):
+    if a is _ALL or b is _ALL:
+        return _ALL
+    return a | b
+
+
+def _device_liveness(plan_items, result_names: Sequence[str]
+                     ) -> Tuple[Dict[str, Any], Dict[str, int]]:
+    """Column liveness per fused output name, propagated sinks-backward.
+
+    ``plan_items`` are the scoring engine's ``_FusedStage`` records in
+    topological (producers-first) order. Returns ``(live, widths)``
+    where ``live[name]`` is a set of live column indices or the ``_ALL``
+    sentinel, and ``widths[name]`` the known column count."""
+    widths: Dict[str, int] = {}
+    for it in plan_items:
+        if it.kind == "vec":
+            widths[it.out] = it.model.vector_metadata().size
+        elif it.kind == "combine":
+            ins = [widths.get(nm) for nm in it.ins]
+            widths[it.out] = (sum(ins)            # type: ignore[arg-type]
+                              if all(w is not None for w in ins) else None)
+        elif it.kind == "select":
+            widths[it.out] = len(it.model.keep_indices)
+        elif it.kind == "scale":
+            widths[it.out] = widths.get(it.ins[0])
+
+    live: Dict[str, Any] = {nm: _ALL for nm in result_names}
+    # consumers-first: the plan list is producers-first and acyclic
+    for it in reversed(plan_items):
+        ol = live.get(it.out, _ALL if it.out in result_names else None)
+        if ol is None:
+            # no fused consumer and not a result: nothing downstream
+            # needs it — contribute no liveness to the inputs
+            continue
+        if it.kind == "select":
+            keep = list(it.model.keep_indices)
+            contrib = (set(int(k) for k in keep) if ol is _ALL
+                       else {int(keep[i]) for i in ol})
+            live[it.ins[0]] = _union(live.get(it.ins[0], set()), contrib)
+        elif it.kind == "scale":
+            live[it.ins[0]] = _union(live.get(it.ins[0], set()),
+                                     ol if ol is not _ALL else _ALL)
+        elif it.kind == "combine":
+            if any(widths.get(nm) is None for nm in it.ins):
+                # an input of unknown width poisons every offset after
+                # it — column math through this combine is unsound, so
+                # every input stays fully live (no pruning through it)
+                for nm in it.ins:
+                    live[nm] = _ALL
+                continue
+            off = 0
+            for nm in it.ins:
+                w = widths[nm]
+                if ol is _ALL:
+                    contrib: Any = _ALL
+                else:
+                    contrib = {j - off for j in ol if off <= j < off + w}
+                live[nm] = _union(live.get(nm, set()), contrib)
+                off += w
+        elif it.kind == "predict":
+            for nm in it.ins:
+                live[nm] = _ALL
+        # vec: no fused inputs to propagate into
+    return live, {k: v for k, v in widths.items() if v is not None}
+
+
+# ---------------------------------------------------------------------------
+# CSE over stages
+# ---------------------------------------------------------------------------
+
+
+def _params_signature(stage) -> Tuple:
+    """Stable, uid-free signature of a stage's constructor params."""
+    try:
+        params = dict(stage.get_params())
+    except Exception:  # lint: broad-except — unparamable stage: signature falls back to identity
+        return ("<unparamable>", id(stage))
+    params.pop("uid", None)
+    return tuple(sorted((k, repr(v)) for k, v in params.items()))
+
+
+def _uid_sensitive_keys(a_params: Dict[str, Any],
+                        b_params: Dict[str, Any]) -> List[str]:
+    """Keys whose values differ between two otherwise identical stages
+    and look uid-like (the TMG403 evidence)."""
+    from .utils import uid as uid_mod
+    keys = []
+    for k in sorted(set(a_params) | set(b_params)):
+        if k == "uid":
+            continue
+        va, vb = a_params.get(k), b_params.get(k)
+        if va == vb:
+            continue
+        for v in (va, vb):
+            try:
+                uid_mod.parse_uid(str(v))
+                keys.append(k)
+                break
+            except Exception:  # lint: broad-except — non-uid param value: not uid-sensitive
+                continue
+    return keys
+
+
+def _state_equal(a, b) -> bool:
+    """Bit-identical fitted state (numpy-aware deep compare)."""
+    try:
+        sa, sb = a.get_model_state(), b.get_model_state()
+    except Exception:  # lint: broad-except — unstateable model: never merge it
+        return False
+
+    def eq(x, y) -> bool:
+        if isinstance(x, np.ndarray) or isinstance(y, np.ndarray):
+            x, y = np.asarray(x), np.asarray(y)
+            return (x.shape == y.shape and x.dtype == y.dtype
+                    and bool(np.array_equal(x, y)))
+        if isinstance(x, dict) and isinstance(y, dict):
+            return (sorted(x) == sorted(y)
+                    and all(eq(x[k], y[k]) for k in x))
+        if isinstance(x, (list, tuple)) and isinstance(y, (list, tuple)):
+            return (len(x) == len(y)
+                    and all(eq(p, q) for p, q in zip(x, y)))
+        return bool(x == y)
+
+    return eq(sa, sb)
+
+
+def _cse_pass(vec_items) -> Tuple[List[Dict[str, Any]],
+                                  List[Dict[str, Any]]]:
+    """Group structurally identical fused vectorizers.
+
+    Returns ``(merges, suppressed)``: merges are verified (class, input
+    features, params AND fitted state all identical — aliasing is
+    bit-identical by construction); suppressed records structural twins
+    whose merge a uid-sensitive param or state mismatch blocks."""
+    groups: Dict[Tuple, List[Any]] = {}
+    for it in vec_items:
+        m = it.model
+        key = (type(m).__name__,
+               tuple(f.name for f in m.input_features),
+               _params_signature(m))
+        groups.setdefault(key, []).append(it)
+
+    merges: List[Dict[str, Any]] = []
+    suppressed: List[Dict[str, Any]] = []
+    for key, items in sorted(groups.items(),
+                             key=lambda kv: kv[1][0].model.uid):
+        if len(items) < 2:
+            continue
+        kept = items[0]
+        ok, bad = [kept], []
+        for it in items[1:]:
+            (ok if _state_equal(kept.model, it.model) else bad).append(it)
+        if len(ok) > 1:
+            merges.append({"stage": key[0], "kept": kept.model.uid,
+                           "dropped": [it.model.uid for it in ok[1:]]})
+        if bad:
+            suppressed.append({
+                "stage": key[0],
+                "uids": [kept.model.uid] + [it.model.uid for it in bad],
+                "reason": "fitted state differs despite identical "
+                          "params/inputs (uid-seeded or data-order-"
+                          "sensitive fit)"})
+
+    # near-misses: same class+inputs, params differing only in uid-like
+    # values — the classic generated-pipeline pattern TMG403 names
+    by_shape: Dict[Tuple, List[Any]] = {}
+    for it in vec_items:
+        m = it.model
+        by_shape.setdefault(
+            (type(m).__name__, tuple(f.name for f in m.input_features)),
+            []).append(it)
+    for (cls, _ins), items in sorted(by_shape.items()):
+        if len(items) < 2:
+            continue
+        # one representative per distinct signature: the comparison
+        # must cross the signature boundary, or a uid-sensitive twin
+        # hiding behind two identical-param stages is never seen
+        by_sig: Dict[Tuple, Any] = {}
+        for it in items:
+            by_sig.setdefault(_params_signature(it.model), it)
+        if len(by_sig) < 2:
+            continue            # identical params: handled above
+        reps = list(by_sig.values())
+        a, b = reps[0].model, reps[1].model
+        try:
+            a_params, b_params = a.get_params(), b.get_params()
+        except Exception:  # lint: broad-except — unparamable near-miss: skip it, don't kill the plan
+            continue
+        keys = _uid_sensitive_keys(a_params, b_params)
+        if keys:
+            suppressed.append({
+                "stage": cls, "uids": sorted(m.model.uid for m in items),
+                "reason": f"params {keys} carry uid-like values — make "
+                "them uid-independent to unlock the merge"})
+    return merges, suppressed
+
+
+# ---------------------------------------------------------------------------
+# tier assignment
+# ---------------------------------------------------------------------------
+
+
+def _resolve_link(db: Optional[CostDatabase]) -> Tuple[float, str]:
+    """The link bandwidth the plan reasons with. NEVER probes a device
+    (planning is static): a db measurement wins, else the old global
+    gate value serves as the documented cold-start prior."""
+    from .workflow import FUSE_MIN_BANDWIDTH_MBPS
+    if db is not None:
+        mbps = db.bandwidth_mbps()
+        if mbps:
+            return mbps, "measured"
+    return FUSE_MIN_BANDWIDTH_MBPS, "prior"
+
+
+def _stage_bytes_per_row(model, kind: str, store, widths: Dict[str, int]
+                         ) -> float:
+    """Abstract per-row byte volume of a stage — the static cost model's
+    input. Vectorizers: canonicalized prepared blocks measured on the
+    synthetic store; structural kinds: f32 width."""
+    if kind == "vec":
+        from .ops.vectorizer_base import canonicalize_prepared
+        n = store.n_rows
+        try:
+            prep = canonicalize_prepared(model.host_prepare(store))
+        except Exception:  # lint: broad-except — unpreparable stage: width-based fallback estimate
+            return 4.0 * model.vector_metadata().size
+        total = 0.0
+        for v in prep.values():
+            a = np.asarray(v)
+            if a.ndim and a.shape[0] == n:
+                total += a.nbytes / n
+        return total + 4.0 * model.vector_metadata().size
+    w = widths.get(getattr(model, "output_name", ""), 0) or 0
+    return 4.0 * float(w)
+
+
+def _entry_for(model, kind: Optional[str], fused: bool, store,
+               widths: Dict[str, int], db: Optional[CostDatabase],
+               link_mbps: float):
+    """One stage's PlanEntry + its (host, device) cost pair."""
+    from .lint import Finding, _stage_label
+    name = type(model).__name__
+    label = _stage_label(model)
+    if kind is None:
+        measured = db.stage_cost(name, "host") if db else None
+        return PlanEntry(
+            uid=model.uid, stage=name, kind="host", tier="host",
+            reason="no device form (host-only stage)",
+            measured_s_per_krow=measured,
+            source="measured" if measured is not None else "static",
+        ), None, None
+    bpr = _stage_bytes_per_row(model, kind, store, widths)
+    est_host = round(1000.0 * bpr / (STATIC_HOST_GBPS * 1e9), 6)
+    est_dev = round(1000.0 * bpr * (1.0 / (link_mbps * 1e6)
+                                    + 1.0 / (STATIC_DEVICE_GBPS * 1e9)), 6)
+    # per-class host/device transform costs are the db's injectable
+    # interface (bench/operator-fed): a fused program's per-stage
+    # device time is not separable from outside, so nothing records
+    # them automatically — absent entries fall back to the estimates
+    m_host = db.stage_cost(name, "host") if db else None
+    m_dev = db.stage_cost(name, "device") if db else None
+    if not fused:
+        return PlanEntry(
+            uid=model.uid, stage=name, kind=kind, tier="host",
+            reason="demoted: a host-only stage consumes its output",
+            est_host_s_per_krow=est_host, est_device_s_per_krow=est_dev,
+            measured_s_per_krow=m_host,
+            source="measured" if m_host is not None else "static",
+        ), None, None
+    measured = m_dev if m_dev is not None else None
+    src = "measured" if (m_host is not None and m_dev is not None) \
+        else "static"
+    finding = None
+    if m_host is not None and m_dev is not None and m_dev > m_host:
+        finding = Finding(
+            "TMG401", f"{label} measured slower on device "
+            f"({m_dev:.6f} s/krow) than host ({m_host:.6f} s/krow) but "
+            "is pinned to the fused device program by its consumers — "
+            "consider demoting the chain", stage=model.uid)
+    return PlanEntry(
+        uid=model.uid, stage=name, kind=kind, tier="fused",
+        reason=("measured costs favor the fused device program"
+                if src == "measured" and (m_dev or 0) <= (m_host or 0)
+                else "consumer-closed device-capable chain"),
+        est_host_s_per_krow=est_host, est_device_s_per_krow=est_dev,
+        measured_s_per_krow=measured, source=src,
+    ), (m_host if m_host is not None else est_host,
+        m_dev if m_dev is not None else est_dev), finding
+
+
+def _engine_tier(host_total: float, dev_total: float,
+                 db: Optional[CostDatabase], link_mbps: float,
+                 link_source: str) -> Tuple[Optional[str], str]:
+    """Whole-chain tier: measured chain costs rule when present, else
+    the per-stage totals; with nothing but priors the decision degrades
+    to the classic bandwidth gate (the prior's whole remaining job)."""
+    if db is not None:
+        ch_h, ch_e = db.chain_cost("host"), db.chain_cost("engine")
+        if ch_h is not None and ch_e is not None:
+            return (("device" if ch_e <= ch_h else "host"),
+                    "measured whole-chain scoring costs")
+    if link_source == "measured":
+        return (("device" if dev_total <= host_total else "host"),
+                "static per-stage estimates over the measured link")
+    # pure priors: keep the legacy gate semantics (the prior IS the
+    # gate) — None leaves the engine's own bandwidth gate in charge
+    return None, "cold-start prior (bandwidth gate rules)"
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def plan_model(model, cost_db: Optional[CostDatabase] = None,
+               n_rows: int = 8) -> ExecutionPlan:
+    """Build the execution plan for a fitted :class:`WorkflowModel`.
+
+    Purely static: host stages and ``host_prepare`` run on lint.py's
+    tiny synthetic typed store (defaults only, no dataset read), device
+    computes are never dispatched, and the link bandwidth comes from the
+    cost database or the cold-start prior — never a live probe."""
+    from . import telemetry
+    from .lint import _synthetic_store
+    from .scoring import build_fused_plan
+
+    plan_items, host_layers = build_fused_plan(model._resolved_dag())
+    result_names = [f.name for f in model.result_features]
+
+    # the synthetic store, advanced through the host stages so
+    # host_prepare sees realistic (typed, empty-default) inputs
+    store = _synthetic_store(model.result_features, n_rows)
+    for layer in host_layers:
+        for m in layer:
+            try:
+                store = m.transform(store)
+            except Exception:  # lint: broad-except — a host stage without a static form only degrades its own byte estimate
+                logger.debug("planner: host stage %s has no static form",
+                             m.uid)
+
+    live, widths_by_name = _device_liveness(plan_items, result_names)
+    prune: Dict[str, np.ndarray] = {}
+    prune_widths: Dict[str, int] = {}
+    for it in plan_items:
+        if it.kind != "vec":
+            continue
+        lv = live.get(it.out)
+        w = widths_by_name.get(it.out)
+        if lv is _ALL or lv is None or w is None:
+            continue
+        if len(lv) < w:
+            prune[it.model.uid] = np.asarray(sorted(int(j) for j in lv),
+                                             dtype=np.int64)
+            prune_widths[it.model.uid] = int(w)
+
+    vec_items = [it for it in plan_items if it.kind == "vec"]
+    merges, suppressed = _cse_pass(vec_items)
+
+    link_mbps, link_source = _resolve_link(cost_db)
+    fused_uids = {it.model.uid for it in plan_items}
+    entries: List[PlanEntry] = []
+    tier_findings: List[Any] = []
+    host_total = dev_total = 0.0
+    from .scoring import _classify
+    for layer in model._resolved_dag():
+        for m in layer:
+            kind = _classify(m)
+            entry, costs, finding = _entry_for(
+                m, kind, m.uid in fused_uids, store, widths_by_name,
+                cost_db, link_mbps)
+            entries.append(entry)
+            if costs is not None:
+                host_total += costs[0]
+                dev_total += costs[1]
+            if finding is not None:
+                tier_findings.append(finding)
+    engine_tier, tier_reason = _engine_tier(
+        host_total, dev_total, cost_db, link_mbps, link_source)
+
+    plan = ExecutionPlan(
+        entries, prune=prune, widths=prune_widths, cse=merges,
+        cse_suppressed=suppressed, engine_tier=engine_tier,
+        fitstats_tier=_phase_tier(cost_db, "fitstats"),
+        transform_tier=_phase_tier(cost_db, "transform"),
+        link_mbps=link_mbps, link_source=link_source,
+        tier_findings=tier_findings,
+        db_finding=cost_db.finding() if cost_db is not None else None)
+    logger.info("planner: %d stage(s), engine tier %s (%s), %d pruned "
+                "column(s), %d CSE merge(s)", len(entries),
+                engine_tier or "gate", tier_reason,
+                plan.counts()["prunedColumns"], len(merges))
+    _record_tallies(plan)
+    telemetry.emit("plan", stages=len(entries),
+                   engine_tier=engine_tier,
+                   pruned_columns=plan.counts()["prunedColumns"],
+                   cse_merges=len(merges))
+    return plan
+
+
+def plan_workflow(workflow, cost_db: Optional[CostDatabase] = None
+                  ) -> ExecutionPlan:
+    """Plan an untrained :class:`Workflow` (graph-only: fitted state —
+    sanity keep-indices, model weights — does not exist yet, so dead-
+    column pruning and verified CSE wait for the model plan; tier
+    estimates and the fit-phase tiers are available now and
+    ``Workflow.train`` follows them)."""
+    from . import telemetry
+    from .graph import compute_dag
+    link_mbps, link_source = _resolve_link(cost_db)
+    entries: List[PlanEntry] = []
+    for layer in compute_dag(workflow.result_features):
+        for st in layer:
+            # fit costs are recorded under stage_name() (class + op,
+            # the stage_metrics key) — look them up the same way
+            name = st.stage_name()
+            measured = (cost_db.stage_cost(name, "fit")
+                        if cost_db is not None else None)
+            entries.append(PlanEntry(
+                uid=st.uid, stage=name, kind="estimator"
+                if hasattr(st, "fit_columns") else "host", tier="host",
+                reason="fit-path stage (tier decided per phase)",
+                measured_s_per_krow=measured,
+                source="measured" if measured is not None else "static"))
+    plan = ExecutionPlan(
+        entries, engine_tier=None,
+        fitstats_tier=_phase_tier(cost_db, "fitstats"),
+        transform_tier=_phase_tier(cost_db, "transform"),
+        link_mbps=link_mbps, link_source=link_source,
+        db_finding=cost_db.finding() if cost_db is not None else None)
+    _record_tallies(plan)
+    telemetry.emit("plan", stages=len(entries), engine_tier=None,
+                   pruned_columns=0, cse_merges=0)
+    return plan
+
+
+def _phase_tier(db: Optional[CostDatabase],
+                phase: str) -> Optional[str]:
+    """Measured tier for a whole fit phase (``fitstats`` stats pass /
+    ``transform`` layer fusion): both tiers must have been measured to
+    override the gate; otherwise None keeps the legacy gate in charge
+    (and the bit-exact host tier stays the default on slow links)."""
+    if db is None:
+        return None
+    h = db.stage_cost(f"phase:{phase}", "host")
+    d = db.stage_cost(f"phase:{phase}", "device")
+    if h is None or d is None:
+        return None
+    return "device" if d <= h else "host"
+
+
+def _record_tallies(plan: ExecutionPlan) -> None:
+    c = plan.counts()
+    _tally("plans_built")
+    _tally("cse_merges", c["cseMerges"])
+    _tally("pruned_columns", c["prunedColumns"])
+    _tally("stages_fused", c["tiers"].get("fused", 0))
+    _tally("stages_host", c["tiers"].get("host", 0))

@@ -236,6 +236,90 @@ class OpWorkflowRunner:
         self._last_preflight = summary
         return summary
 
+    # -- whole-DAG planning (planner.py, on by default) --------------------
+    @staticmethod
+    def _cost_db_path(params: "OpParams") -> Optional[str]:
+        """Where this run's cost database lives: an explicit
+        ``customParams.costDb`` wins, else it sits alongside the
+        persistent compile cache (``compileCacheDir``), else None —
+        an in-memory db whose static estimates still produce a plan."""
+        from . import planner
+        db = params.custom_params.get("costDb")
+        if db:
+            return str(db)
+        return planner.default_cost_db_path(
+            params.custom_params.get("compileCacheDir"))
+
+    def _plan_step(self, params: "OpParams", workflow=None, model=None):
+        """Build the cost-based ExecutionPlan BEFORE any reader I/O and
+        install it so the run follows it: ``Workflow.train`` consults
+        the per-phase tiers, score-type runs attach the model plan to
+        the scoring engine (CSE, dead-column pruning, measured tier).
+
+        On by default; ``customParams.plan: false`` disables. The
+        TMG4xx advisory findings flow through the SAME ``failOn`` /
+        ``lintSuppress`` machinery as the pre-flight rules, and the
+        plan's JSON form rides in the metrics doc under ``plan``."""
+        from . import lint, planner
+        enabled = params.custom_params.get("plan", True)
+        if enabled in (False, 0) or str(enabled).lower() == "false":
+            # a reused workflow must not silently follow a PREVIOUS
+            # run's plan while this run stamps plan: null
+            if workflow is not None:
+                workflow.set_plan(None)
+            return None
+        fail_on = str(params.custom_params.get("failOn", "error")).lower()
+        suppress = params.custom_params.get("lintSuppress", ())
+        db = planner.CostDatabase.load(self._cost_db_path(params))
+        try:
+            with telemetry.span("run:plan"):
+                if model is not None:
+                    plan = planner.plan_model(model, cost_db=db)
+                    model.attach_plan(plan)
+                else:
+                    plan = planner.plan_workflow(workflow, cost_db=db)
+                    workflow.set_plan(plan)
+        except Exception:  # lint: broad-except — the plan is an optimization, never a dependency: a planner failure degrades to the unplanned run
+            logger.exception("plan step failed; the run proceeds "
+                             "unplanned (gates rule)")
+            if workflow is not None:
+                workflow.set_plan(None)     # no stale plan from run N-1
+            if model is not None:
+                model.attach_plan(None)
+            return None
+        findings = lint._apply_suppress(list(plan.findings()), suppress)
+        lint.emit_findings(findings)
+        for f in findings:
+            (logger.warning if f.severity == "warning"
+             else logger.info)("plan: %s", f.format())
+        lint.enforce(findings, fail_on=fail_on)
+        self._plan_db = db
+        self._last_plan = plan.to_json()
+        return plan
+
+    def _record_plan_costs(self, model) -> None:
+        """After a fresh fit: fold the measured per-stage costs (and the
+        link bandwidth, when the run probed it) into the cost database
+        and persist it atomically, then re-plan the now-fitted model so
+        the stamped ``plan`` block carries the full model plan (pruning
+        + CSE + tiers) instead of the graph-only pre-fit plan."""
+        from . import planner
+        from . import workflow as _wf
+        db = getattr(self, "_plan_db", None)
+        if db is None:
+            return
+        try:
+            planner.record_fit_costs(model, db)
+            planner.drain_phase_observations(db)
+            if _wf._DEVICE_BW_MBPS is not None:
+                db.record_bandwidth(_wf._DEVICE_BW_MBPS)
+            db.save()
+            self._last_plan = planner.plan_model(model,
+                                                 cost_db=db).to_json()
+        except Exception:  # lint: broad-except — cost recording must never fail a finished train
+            logger.exception("cost-db recording failed; the pre-fit "
+                             "plan stamp stands")
+
     # -- metrics sink ------------------------------------------------------
     @staticmethod
     def _write_metrics(location: Optional[str], doc: Dict[str, Any],
@@ -298,7 +382,7 @@ class OpWorkflowRunner:
                                           minimum=1)
         run_mesh_obj = None
         if mesh_devices is not None or mesh_grid is not None:
-            run_mesh_obj = _mesh.make_mesh(n_devices=mesh_devices,
+            run_mesh_obj = _mesh.make_mesh(n_devices=mesh_devices,  # lint: explicit-mesh — the run-scoped meshDevices/meshGridSize override IS the sanctioned explicit construction
                                            grid_axis=mesh_grid)
         prev_mesh = None
         run_mesh = False
@@ -321,6 +405,7 @@ class OpWorkflowRunner:
         # the tallies are process-cumulative; the run doc must report
         # THIS run's events, not a predecessor's quarantines
         self._last_preflight = None
+        self._last_plan = None
         res_before = resilience.resilience_stats()
         # install the run-scoped mesh LAST, immediately before the
         # try/finally that restores it — an exception in the setup above
@@ -355,6 +440,10 @@ class OpWorkflowRunner:
                     # pre-flight verdict rides in every metrics doc
                     # (None = validation disabled for this run)
                     result.metrics["preflight"] = self._last_preflight
+                    # the execution plan the run followed rides too
+                    # (None = planning disabled; see planner.py and
+                    # docs/static-analysis.md for the block's schema)
+                    result.metrics["plan"] = self._last_plan
                     # quarantine / retry / breaker evidence rides too —
                     # the always-on tallies make silent data loss
                     # visible in every run doc, telemetry on or off
@@ -400,9 +489,16 @@ class OpWorkflowRunner:
             # the compile-time-type-safety analog: a mis-wired DAG is
             # rejected HERE, before the reader touches a byte
             self._preflight(params, workflow=self.workflow)
+            # cost-based plan (graph-only pre-fit): train follows its
+            # per-phase tier decisions
+            wf_plan = self._plan_step(params, workflow=self.workflow)
             if self.training_reader is not None:
                 self.workflow.set_reader(self.training_reader)
             model = self.workflow.train()
+            if wf_plan is not None:
+                # measured fit costs feed the persisted db; the stamped
+                # plan upgrades to the full fitted-model plan
+                self._record_plan_costs(model)
             # multi-host: every process computes the identical model;
             # only the coordinator touches the shared filesystem
             from .parallel.multihost import is_coordinator, process_summary
@@ -421,6 +517,9 @@ class OpWorkflowRunner:
         # graph + eval_shape device pre-flight on the loaded model,
         # before the scoring/evaluation reader does any I/O
         self._preflight(params, model=model)
+        # cost-based plan, attached so the scoring engine follows its
+        # CSE/pruning/tier decisions (still before any reader I/O)
+        self._plan_step(params, model=model)
 
         if run_type == RunType.SCORE:
             reader = self.scoring_reader

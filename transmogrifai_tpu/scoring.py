@@ -34,6 +34,15 @@ Both live here:
 The engine honors the same bandwidth gate as layer fusion
 (``workflow.FUSE_MIN_BANDWIDTH_MBPS``): on a slow tunnelled link the
 numpy host path stays the right answer, and ``enabled()`` says so.
+Since PR 7 that gate is only the *cold-start prior*: an attached
+:class:`~transmogrifai_tpu.planner.ExecutionPlan` carries the measured
+tier decision (``enabled()`` follows it either way) plus two
+bit-identical device-program rewrites — verified CSE merges (a
+structurally identical twin's output fans out from ONE computation;
+its ``host_prepare``/``device_compute`` never run) and dead-column
+pruning (columns the sanity checker drops before any sink are gathered
+away right after their producing ``device_compute``, with the select
+indices remapped into pruned coordinates).
 
 On a multi-device host each bucket's row-leading blocks are sharded
 over the process mesh's ``data`` axis before dispatch (PR 6 — see
@@ -168,6 +177,54 @@ def _classify(m) -> Optional[str]:
     return None
 
 
+def build_fused_plan(layers) -> Tuple[List["_FusedStage"], List[List[Any]]]:
+    """Classify a resolved DAG's fitted stages and compute the largest
+    consumer-closed fused set. Returns ``(plan_items, host_layers)`` —
+    shared by the engine's program builder and the whole-DAG planner
+    (planner.py), so the two can never disagree about what fuses."""
+    flat = [m for layer in layers for m in layer]
+    kinds = {m.uid: _classify(m) for m in flat}
+
+    # consumer map over output names (host stages read via the store,
+    # fused stages via the device env — both count as consumption)
+    consumers: Dict[str, List[Any]] = {}
+    for m in flat:
+        for f in m.input_features:
+            consumers.setdefault(f.name, []).append(m)
+
+    # largest consumer-closed fused set: walk shallow→deep demoting
+    # device-capable stages any of whose consumers stayed on host
+    fused: Dict[str, bool] = {}
+    for m in reversed(flat):
+        ok = kinds[m.uid] is not None
+        if ok:
+            for c in consumers.get(m.output_name, []):
+                if not fused.get(c.uid, False):
+                    ok = False
+                    break
+        fused[m.uid] = ok
+
+    plan: List[_FusedStage] = []
+    host_layers: List[List[Any]] = []
+    for layer in layers:
+        host_row = []
+        for m in layer:
+            if not fused[m.uid]:
+                host_row.append(m)
+                continue
+            kind = kinds[m.uid]
+            if kind == "vec":
+                ins: List[str] = []
+            elif kind in ("select", "predict"):
+                # (label, vector) arity: only the vector crosses
+                ins = [m.input_features[1].name]
+            else:
+                ins = [f.name for f in m.input_features]
+            plan.append(_FusedStage(m, kind, m.output_name, ins))
+        host_layers.append(host_row)
+    return plan, host_layers
+
+
 class _PreparedBatch:
     """Host-side output of :meth:`ScoringEngine.prepare_batch`: everything
     the device program needs, already padded to its bucket. Chunked when
@@ -189,13 +246,17 @@ class ScoringEngine:
     """
 
     def __init__(self, model, bucket_cap: int = DEFAULT_BUCKET_CAP,
-                 gate_bandwidth: bool = True, mesh=None):
+                 gate_bandwidth: bool = True, mesh=None, plan=None):
         self.model = model
         self.bucket_cap = int(bucket_cap)
         self.gate_bandwidth = gate_bandwidth
         #: (data, grid) mesh for batch sharding: None resolves to the
         #: process default per dispatch, False forces unsharded
         self._mesh = mesh
+        #: optional planner.ExecutionPlan this engine follows: CSE
+        #: aliases, dead-column pruning and the measured tier decision
+        #: (None = legacy behavior, bandwidth gate only)
+        self._exec_plan = plan
         self._programs: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._compile_count = 0
         self._lock = threading.Lock()
@@ -206,51 +267,13 @@ class ScoringEngine:
         self._prep_cache: "OrderedDict[Tuple, Tuple[Any, _PreparedBatch]]" \
             = OrderedDict()
         self._build_plan()
+        self._apply_exec_plan()
 
     # -- plan --------------------------------------------------------------
     def _build_plan(self) -> None:
         from .workflow import _raw_features_of
         layers = self.model._resolved_dag()
-        flat = [m for layer in layers for m in layer]
-        kinds = {m.uid: _classify(m) for m in flat}
-
-        # consumer map over output names (host stages read via the store,
-        # fused stages via the device env — both count as consumption)
-        consumers: Dict[str, List[Any]] = {}
-        for m in flat:
-            for f in m.input_features:
-                consumers.setdefault(f.name, []).append(m)
-
-        # largest consumer-closed fused set: walk shallow→deep demoting
-        # device-capable stages any of whose consumers stayed on host
-        fused: Dict[str, bool] = {}
-        for m in reversed(flat):
-            ok = kinds[m.uid] is not None
-            if ok:
-                for c in consumers.get(m.output_name, []):
-                    if not fused.get(c.uid, False):
-                        ok = False
-                        break
-            fused[m.uid] = ok
-
-        plan: List[_FusedStage] = []
-        host_layers: List[List[Any]] = []
-        for layer in layers:
-            host_row = []
-            for m in layer:
-                if not fused[m.uid]:
-                    host_row.append(m)
-                    continue
-                kind = kinds[m.uid]
-                if kind == "vec":
-                    ins: List[str] = []
-                elif kind in ("select", "predict"):
-                    # (label, vector) arity: only the vector crosses
-                    ins = [m.input_features[1].name]
-                else:
-                    ins = [f.name for f in m.input_features]
-                plan.append(_FusedStage(m, kind, m.output_name, ins))
-            host_layers.append(host_row)
+        plan, host_layers = build_fused_plan(layers)
 
         produced = {it.out for it in plan}
         upload_names: List[str] = []
@@ -261,10 +284,190 @@ class ScoringEngine:
 
         self._host_layers = host_layers
         self._plan = plan
+        self._by_out = {it.out: it for it in plan}
         self._fused_out = produced
         self._upload_names = upload_names
         self._result_names = [f.name for f in self.model.result_features]
         self._raw_features = _raw_features_of(self.model.result_features)
+
+    # -- execution-plan application (planner.py) ---------------------------
+    def _apply_exec_plan(self) -> None:
+        """Translate the attached ExecutionPlan into program-level
+        rewrites: CSE output aliases (the dropped stage's host_prepare
+        and device_compute never run — its env entry is a fan-out of the
+        kept computation), per-vec live-column gathers with the select
+        indices remapped into pruned coordinates, and the measured tier
+        hint ``enabled()`` consults. Both rewrites are bit-identical by
+        construction (verified-identical state; gather-of-concat equals
+        concat-of-gathers), and pruning self-disables for any program
+        whose requested outputs a prune would visibly narrow."""
+        self._cse_alias: Dict[str, str] = {}
+        self._prune: Dict[str, np.ndarray] = {}
+        self._prune_affected: set = set()
+        self._select_keep_remap: Dict[str, np.ndarray] = {}
+        self._scale_slice: Dict[str, np.ndarray] = {}
+        plan = self._exec_plan
+        self._plan_tier = getattr(plan, "engine_tier", None) \
+            if plan is not None else None
+        if plan is None:
+            return
+        by_uid = {it.model.uid: it for it in self._plan}
+        cse_groups: List[List[str]] = []
+        for m in getattr(plan, "cse", ()):
+            kept = by_uid.get(m.get("kept"))
+            if kept is None or kept.kind != "vec":
+                continue
+            members = [kept.model.uid]
+            for uid in m.get("dropped", ()):
+                it = by_uid.get(uid)
+                if it is not None and it.kind == "vec" \
+                        and it.out != kept.out:
+                    self._cse_alias[it.out] = kept.out
+                    members.append(uid)
+            if len(members) > 1:
+                cse_groups.append(members)
+        for uid, live in sorted(getattr(plan, "prune", {}).items()):
+            it = by_uid.get(uid)
+            w = getattr(plan, "widths", {}).get(uid)
+            if it is None or it.kind != "vec" or not w:
+                continue
+            live = np.asarray(live, dtype=np.int64)
+            if live.size and live.size < int(w) \
+                    and int(live.max()) < int(w) and int(live.min()) >= 0:
+                self._prune[uid] = live
+        # CSE × pruning: an aliased output IS the kept computation, so
+        # every member of a merge group must carry one live set — the
+        # union (a fully-live member means no pruning for the group)
+        for members in cse_groups:
+            lives = [self._prune.get(u) for u in members]
+            if all(lv is None for lv in lives):
+                continue
+            w = by_uid[members[0]].model.vector_metadata().size
+            if any(lv is None for lv in lives):
+                union: Optional[np.ndarray] = None
+            else:
+                union = np.asarray(
+                    sorted(set(int(j) for lv in lives for j in lv)),
+                    dtype=np.int64)
+                if union.size >= w:
+                    union = None
+            for u in members:
+                if union is None:
+                    self._prune.pop(u, None)
+                else:
+                    self._prune[u] = union
+        if not self._prune:
+            return
+        pruned_outs = {by_uid[uid].out for uid in self._prune}
+        affected = set(pruned_outs)
+        for it in self._plan:
+            if it.kind in ("combine", "scale") \
+                    and any(nm in affected for nm in it.ins):
+                affected.add(it.out)
+        self._prune_affected = affected
+
+        def _disable(reason: str, uid: str) -> None:
+            logger.warning("planner pruning disabled: %s (%s)", reason,
+                           uid)
+            self._prune = {}
+            self._prune_affected = set()
+            self._select_keep_remap = {}
+            self._scale_slice = {}
+
+        for it in self._plan:
+            # only select/scale/combine consumers understand a narrowed
+            # input; anything else reading one would see wrong columns
+            if it.kind not in ("select", "scale", "combine") \
+                    and any(nm in affected for nm in it.ins):
+                return _disable("a non-remappable stage consumes a "
+                                "pruned value", it.model.uid)
+        for it in self._plan:
+            if it.kind == "scale" and it.ins[0] in affected:
+                # the scaler's fitted mean/std are full-width: slice
+                # them to the input's surviving (old) columns so the
+                # per-column math is unchanged on what remains
+                o2n = self._old_to_new(it.ins[0])
+                if o2n is None:
+                    return _disable("unresolvable width under a "
+                                    "scaler", it.model.uid)
+                self._scale_slice[it.model.uid] = \
+                    np.nonzero(o2n >= 0)[0]
+            if it.kind != "select" or it.ins[0] not in affected:
+                continue
+            o2n = self._old_to_new(it.ins[0])
+            keep = np.asarray(it.model.keep_indices, dtype=np.int64)
+            if o2n is None or keep.size and int(keep.max()) >= o2n.size:
+                remap = None
+            else:
+                remap = o2n[keep]
+            if remap is None or (remap < 0).any():
+                # a kept column the liveness pass missed (or an
+                # unresolvable width): pruning must not mis-select —
+                # drop it entirely rather than risk a wrong gather
+                return _disable("select keeps a column the liveness "
+                                "pass marked dead", it.model.uid)
+            self._select_keep_remap[it.model.uid] = remap
+
+    def _in_width(self, name: str) -> Optional[int]:
+        it = self._by_out.get(name)
+        if it is None:
+            return None                      # upload: width unknown here
+        if it.kind == "vec":
+            return it.model.vector_metadata().size
+        if it.kind == "combine":
+            ws = [self._in_width(nm) for nm in it.ins]
+            return sum(ws) if all(w is not None for w in ws) else None
+        if it.kind == "select":
+            return len(it.model.keep_indices)
+        if it.kind == "scale":
+            return self._in_width(it.ins[0])
+        return None
+
+    def _old_to_new(self, name: str) -> Optional[np.ndarray]:
+        """Old→pruned column index map for a fused env value (−1 =
+        dead), or None when the value is not narrowed by pruning."""
+        it = self._by_out.get(name)
+        if it is None:
+            return None
+        if it.kind == "vec":
+            live = self._prune.get(it.model.uid)
+            if live is None:
+                return None
+            w = it.model.vector_metadata().size
+            o2n = np.full(w, -1, dtype=np.int64)
+            o2n[live] = np.arange(live.size, dtype=np.int64)
+            return o2n
+        if it.kind == "combine":
+            parts = []
+            any_pruned = False
+            new_off = 0
+            for nm in it.ins:
+                sub = self._old_to_new(nm)
+                w = self._in_width(nm)
+                if w is None:
+                    return None              # unresolvable width: bail
+                if sub is None:
+                    sub = np.arange(w, dtype=np.int64)
+                else:
+                    any_pruned = True
+                parts.append(np.where(sub >= 0, sub + new_off, -1))
+                new_off += int((sub >= 0).sum())
+            return np.concatenate(parts) if any_pruned else None
+        if it.kind == "scale":
+            # a scaler narrows exactly as its input does (mean/std are
+            # sliced to match in the program body)
+            return self._old_to_new(it.ins[0])
+        return None                # select outputs are never pruned
+
+    def _active_prune(self, out_names) -> Optional[Dict[str, np.ndarray]]:
+        """The prune map for a program pulling ``out_names`` — None when
+        any requested output would be visibly narrowed (the transform
+        path materializes every column; score paths prune freely)."""
+        if not self._prune:
+            return None
+        if any(nm in self._prune_affected for nm in out_names):
+            return None
+        return self._prune
 
     # -- introspection -----------------------------------------------------
     @property
@@ -287,12 +490,24 @@ class ScoringEngine:
         return len(bucket_ladder(self.bucket_cap)) * modes
 
     def enabled(self) -> bool:
-        """Engine pays off: something fused AND the link clears the same
-        bandwidth gate as layer fusion (a memory-bound transform chain on
-        a tunnelled device costs more than host numpy)."""
+        """Engine pays off: something fused AND the tier decision says
+        device. Precedence: an explicit ``gate_bandwidth=False`` build
+        (the caller's force knob) first, then an attached
+        ExecutionPlan's measured tier (``device`` overrides a slow-link
+        prior, ``host`` wins even on a fast link), then — when the plan
+        defers (None) or none is attached — the legacy bandwidth gate
+        as the cold-start prior."""
         if not self._plan:
             return False
         if not self.gate_bandwidth:
+            # the explicit force knob outranks everything: a caller who
+            # built the engine with gate_bandwidth=False owns the tier
+            # decision (parity tests, serving export)
+            return True
+        tier = getattr(self, "_plan_tier", None)
+        if tier == "host":
+            return False
+        if tier == "device":
             return True
         from .workflow import FUSE_MIN_BANDWIDTH_MBPS, device_roundtrip_mbps
         return device_roundtrip_mbps() >= FUSE_MIN_BANDWIDTH_MBPS
@@ -309,7 +524,9 @@ class ScoringEngine:
                 store = m.transform(store)
         prepared = {}
         for it in self._plan:
-            if it.kind == "vec":
+            # a CSE-aliased vectorizer contributes no blocks: its env
+            # entry fans out from the kept twin's computation
+            if it.kind == "vec" and it.out not in self._cse_alias:
                 prepared[it.model.uid] = canonicalize_prepared(
                     it.model.host_prepare(store))
         uploads = {}
@@ -453,16 +670,31 @@ class ScoringEngine:
         # a data-sharded one must never collide in the cache
         return (tuple(sig), tuple(out_names), mesh_key)
 
-    def _program_body(self, jnp, prepared, uploads, out_names):
+    def _program_body(self, jnp, prepared, uploads, out_names,
+                      prune: Optional[Dict[str, np.ndarray]] = None):
         env: Dict[str, Any] = dict(uploads)
         for it in self._plan:
-            if it.kind == "vec":
-                env[it.out] = it.model.device_compute(jnp, prepared[it.model.uid])
+            alias = self._cse_alias.get(it.out)
+            if alias is not None:
+                # CSE fan-out: the dropped twin's output IS the kept
+                # computation (bit-identical state, planner-verified)
+                env[it.out] = env[alias]
+            elif it.kind == "vec":
+                v = it.model.device_compute(jnp, prepared[it.model.uid])
+                if prune is not None and it.model.uid in prune:
+                    # dead-column prune right at the producer: the
+                    # select's remapped indices pick the same survivors
+                    v = v[:, np.asarray(prune[it.model.uid],
+                                        dtype=np.int32)]
+                env[it.out] = v
             elif it.kind == "combine":
                 mats = [env[nm] for nm in it.ins]
                 env[it.out] = jnp.concatenate(mats, axis=1)
             elif it.kind == "select":
                 keep = it.model.keep_indices
+                if prune is not None \
+                        and it.model.uid in self._select_keep_remap:
+                    keep = self._select_keep_remap[it.model.uid].tolist()
                 x = env[it.ins[0]]
                 if keep == list(range(x.shape[1])):
                     env[it.out] = x
@@ -470,8 +702,15 @@ class ScoringEngine:
                     env[it.out] = x[:, np.asarray(keep, dtype=np.int32)]
             elif it.kind == "scale":
                 m = it.model
-                env[it.out] = ((env[it.ins[0]] - m.mean[None, :])
-                               / m.std[None, :])
+                mean, std = m.mean, m.std
+                if prune is not None \
+                        and it.model.uid in self._scale_slice:
+                    # pruned input: slice the fitted constants to the
+                    # surviving columns — per-column math unchanged
+                    sl = self._scale_slice[it.model.uid]
+                    mean, std = mean[sl], std[sl]
+                env[it.out] = ((env[it.ins[0]] - mean[None, :])
+                               / std[None, :])
             elif it.kind == "predict":
                 env[it.out] = it.model.predict_device(env[it.ins[0]])
         return {nm: env[nm] for nm in out_names}
@@ -480,7 +719,9 @@ class ScoringEngine:
                  mesh_key: Optional[Tuple] = None):
         import jax
 
-        key = self._signature(prepared, uploads, out_names, mesh_key)
+        prune = self._active_prune(out_names)
+        key = self._signature(prepared, uploads, out_names, mesh_key) \
+            + (("plan", bool(self._cse_alias), prune is not None),)
         with self._lock:
             fn = self._programs.pop(key, None)
             if fn is not None:
@@ -492,7 +733,8 @@ class ScoringEngine:
 
         def run(prepared_, uploads_):
             import jax.numpy as jnp
-            return self._program_body(jnp, prepared_, uploads_, out_names)
+            return self._program_body(jnp, prepared_, uploads_, out_names,
+                                      prune=prune)
 
         fn = jax.jit(run)
         with self._lock:

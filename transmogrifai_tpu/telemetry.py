@@ -559,6 +559,14 @@ class RunListener:
         in the extra kwargs when the rule has them."""
         pass
 
+    def on_plan(self, stages: int, engine_tier: Optional[str] = None,
+                pruned_columns: int = 0, cse_merges: int = 0,
+                **_: Any) -> None:
+        """The whole-DAG planner built an ExecutionPlan (planner.py):
+        per-stage tier assignment, dead-column pruning and CSE counts —
+        the cost-based middle-end's decision record."""
+        pass
+
 
 _LISTENERS: List[RunListener] = []
 
@@ -624,6 +632,7 @@ class CollectingRunListener(RunListener):
         self.quarantined: Dict[str, int] = {}
         self.breaker_trips = 0
         self.lint_findings: Dict[str, int] = {}
+        self.plan: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
 
     def on_run_start(self, run_type: str, **_: Any) -> None:
@@ -707,6 +716,16 @@ class CollectingRunListener(RunListener):
             self.lint_findings[severity] = \
                 self.lint_findings.get(severity, 0) + 1
 
+    def on_plan(self, stages: int, engine_tier: Optional[str] = None,
+                pruned_columns: int = 0, cse_merges: int = 0,
+                **_: Any) -> None:
+        with self._lock:
+            self.events.append("plan")
+            self.plan = {"stages": int(stages),
+                         "engineTier": engine_tier,
+                         "prunedColumns": int(pruned_columns),
+                         "cseMerges": int(cse_merges)}
+
     def summary(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -727,6 +746,7 @@ class CollectingRunListener(RunListener):
                 "quarantined": dict(self.quarantined),
                 "breakerTrips": self.breaker_trips,
                 "lintFindings": dict(self.lint_findings),
+                "plan": dict(self.plan) if self.plan else None,
             }
 
 

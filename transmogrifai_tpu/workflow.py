@@ -178,7 +178,8 @@ def _atomic_checkpoint(model: "WorkflowModel", directory: str) -> None:
 
 
 def apply_layer_vectorized(models: Sequence[Transformer], store: ColumnStore,
-                           fuse_min_rows: Optional[int] = None) -> ColumnStore:
+                           fuse_min_rows: Optional[int] = None,
+                           fuse: Optional[bool] = None) -> ColumnStore:
     """Transform a DAG layer, fusing its vectorizers into one XLA program.
 
     The reference fuses a layer's row transformers into one RDD map
@@ -203,6 +204,12 @@ def apply_layer_vectorized(models: Sequence[Transformer], store: ColumnStore,
       a transform layer is memory-bound, so on a slow link (e.g. a
       network-tunnelled TPU) the round-trip costs more than the compute.
       Locally attached chips (PCIe/ICI) clear it easily.
+
+    ``fuse`` overrides the BANDWIDTH half of the gate (the planner's
+    measured per-phase tier decision, planner.py): ``True`` fuses even
+    on a link below the prior, ``False`` keeps the layer on host. The
+    row floor always holds — below it compile cost dominates whatever
+    the link measures.
     """
     from .columns import VectorColumn
     from .ops.vectorizer_base import VectorizerModel, canonicalize_prepared
@@ -213,8 +220,13 @@ def apply_layer_vectorized(models: Sequence[Transformer], store: ColumnStore,
     threshold = FUSE_MIN_ROWS if fuse_min_rows is None else fuse_min_rows
     vecs = [m for m in models if isinstance(m, VectorizerModel)]
     rest = [m for m in models if not isinstance(m, VectorizerModel)]
-    if (len(vecs) >= 1 and store.n_rows >= threshold
-            and device_roundtrip_mbps() >= FUSE_MIN_BANDWIDTH_MBPS):
+    bandwidth_ok = (fuse if fuse is not None else
+                    device_roundtrip_mbps() >= FUSE_MIN_BANDWIDTH_MBPS)
+    fused_path = (len(vecs) >= 1 and store.n_rows >= threshold
+                  and bandwidth_ok)
+    t_layer = time.perf_counter()
+    c_layer = _COMPILE_CLOCK["s"]
+    if fused_path:
         import jax.numpy as jnp
 
         preps = [canonicalize_prepared(m.host_prepare(store)) for m in vecs]
@@ -250,6 +262,19 @@ def apply_layer_vectorized(models: Sequence[Transformer], store: ColumnStore,
         rest = list(models)
     for m in rest:
         store = m.transform(store)
+    # feed the planner's measured transform-phase tier costs — only
+    # where the tier decision is contested (fusable layer at or above
+    # the row floor), so host and device s/krow stay comparable. The
+    # one-time XLA compile is subtracted (the _fit_layer clamp
+    # discipline): folding ~seconds of compile into a steady-state
+    # s/krow mean would poison the device tier against itself.
+    if vecs and store.n_rows >= threshold:
+        from . import planner
+        elapsed = time.perf_counter() - t_layer
+        compile_s = min(_COMPILE_CLOCK["s"] - c_layer, elapsed)
+        planner.observe_phase(
+            "transform", "device" if fused_path else "host",
+            elapsed - compile_s, store.n_rows)
     return store
 
 
@@ -269,6 +294,10 @@ class Workflow:
         #: default over all visible devices at train time (PR 6: the
         #: mesh is the mainline substrate, 1×1 degenerate on one device)
         self.mesh = None
+        #: attached planner.ExecutionPlan (set_plan): its per-phase tier
+        #: decisions steer the fused stats pass and layer fusion; None
+        #: keeps the legacy gates (PR 7: the cost-based middle-end)
+        self._exec_plan = None
         self._workflow_cv = False
         self._checkpoint_dir: Optional[str] = None
         self._warm_stages: Dict[str, FittedModel] = {}
@@ -308,6 +337,17 @@ class Workflow:
         device takes the degenerate 1×1 path. ``mesh=False`` forces the
         unsharded single-device path on any host."""
         self.mesh = mesh
+        return self
+
+    def set_plan(self, plan) -> "Workflow":
+        """Attach a :class:`~transmogrifai_tpu.planner.ExecutionPlan`
+        whose per-phase tier decisions this fit follows: the fused
+        fit-statistics pass and the transform-layer fusion consult its
+        ``fitstats_tier``/``transform_tier`` instead of the global
+        bandwidth gate (which stays as the cold-start prior when the
+        plan defers). Tier choices change cost, never results — the
+        planner only overrides the bandwidth half of each gate."""
+        self._exec_plan = plan
         return self
 
     def with_raw_feature_filter(self, rff) -> "Workflow":
@@ -444,6 +484,7 @@ class Workflow:
             rff_results=rff_results,
             train_time_s=train_time,
             stage_metrics=self._stage_metrics,
+            train_rows=train_store.n_rows,
         )
 
     def fit(self, resume_from: Optional[str] = None) -> "WorkflowModel":
@@ -598,7 +639,9 @@ class Workflow:
                 stats = plan.run(
                     train,
                     mesh=(False if self.mesh is False
-                          else getattr(self, "_active_mesh", None)))
+                          else getattr(self, "_active_mesh", None)),
+                    tier_hint=(self._exec_plan.fitstats_tier
+                               if self._exec_plan is not None else None))
             telemetry.emit("stats_pass", layer=li,
                            n_stages=n_scanning,
                            n_requests=plan.n_requests,
@@ -700,11 +743,22 @@ class Workflow:
                             "(terminal layer, outputs unconsumed)", li)
         else:
             tt = time.perf_counter()
+            # the planner's measured transform tier overrides the
+            # bandwidth prior (omitted entirely when the plan defers,
+            # so the gate — and any test double of this function —
+            # sees the pre-planner call shape; the row floor inside
+            # apply_layer_vectorized always holds)
+            fuse_kw = {}
+            if self._exec_plan is not None \
+                    and self._exec_plan.transform_tier is not None:
+                fuse_kw = {"fuse":
+                           self._exec_plan.transform_tier == "device"}
             with telemetry.span("fit:transform_layer", layer=li,
                                 stages=len(models)):
-                train = apply_layer_vectorized(models, train)
+                train = apply_layer_vectorized(models, train, **fuse_kw)
                 if test is not None:
-                    test = apply_layer_vectorized(models, test)
+                    test = apply_layer_vectorized(models, test,
+                                                  **fuse_kw)
             layer_transform_s = time.perf_counter() - tt
             if models:
                 logger.info("layer %d: transformed %d stage(s) in "
@@ -822,7 +876,8 @@ class WorkflowModel:
                  blacklisted_features: Sequence[Feature] = (),
                  rff_results=None,
                  train_time_s: float = 0.0,
-                 stage_metrics: Optional[Dict[str, Dict[str, Any]]] = None):
+                 stage_metrics: Optional[Dict[str, Dict[str, Any]]] = None,
+                 train_rows: int = 0):
         self.uid = uid_mod.make_uid("WorkflowModel")
         self.result_features = tuple(result_features)
         self.fitted_stages = dict(fitted_stages)
@@ -833,9 +888,14 @@ class WorkflowModel:
         self.train_time_s = train_time_s
         #: per-stage fit/transform timings (OpSparkListener analog)
         self.stage_metrics = stage_metrics or {}
+        #: rows of the training split (the cost database's denominator;
+        #: 0 on loaded models — only fresh fits record costs)
+        self.train_rows = int(train_rows)
         #: lazily built compiled scoring engine (scoring.ScoringEngine);
         #: False = not yet attempted, None = attempted and unusable
         self._scoring_engine: Any = False
+        #: attached planner.ExecutionPlan the scoring engine follows
+        self._execution_plan: Any = None
 
     # -- stage access (OpWorkflowModel.getOriginStageOf analog) ------------
     def _resolved_dag(self) -> List[List[Transformer]]:
@@ -871,6 +931,32 @@ class WorkflowModel:
         from . import lint
         return lint.check_model(self, device=device, suppress=suppress)
 
+    # -- planning (planner.py, the cost-based middle-end) ------------------
+    def plan(self, cost_db=None, attach: bool = True):
+        """Build this model's :class:`~transmogrifai_tpu.planner
+        .ExecutionPlan` (dead-column liveness, CSE merges, per-stage
+        tier assignment from ``cost_db``'s measured costs with static
+        fallbacks) and — with ``attach`` — install it so the scoring
+        engine follows it. Purely static: no data read, no device
+        dispatched (lint.py's synthetic-store discipline)."""
+        from . import planner
+        p = planner.plan_model(self, cost_db=cost_db)
+        if attach:
+            self.attach_plan(p)
+        return p
+
+    def attach_plan(self, plan) -> "WorkflowModel":
+        """Install an ExecutionPlan: the next ``scoring_engine()`` build
+        applies its CSE aliases, dead-column pruning and measured tier
+        decision (a memoized engine is invalidated so the plan takes
+        effect). ``attach_plan(None)`` reverts to unplanned behavior."""
+        self._execution_plan = plan
+        self._scoring_engine = False          # rebuild under the plan
+        return self
+
+    def execution_plan(self):
+        return self._execution_plan
+
     # -- scoring -----------------------------------------------------------
 
     def _engine_breaker(self):
@@ -899,8 +985,12 @@ class WorkflowModel:
         the engine still runs, it just reports ``enabled() == False``)."""
         if rebuild or self._scoring_engine is False or engine_kw:
             from .scoring import ScoringEngine
+            kw = dict(engine_kw)
+            # the attached ExecutionPlan rides into every build unless
+            # the caller pins plan= explicitly (plan=None opts out)
+            kw.setdefault("plan", getattr(self, "_execution_plan", None))
             try:
-                eng = ScoringEngine(self, **engine_kw)
+                eng = ScoringEngine(self, **kw)
             except Exception:  # lint: broad-except — engine build failure falls back to the per-layer path
                 logger.exception("scoring engine build failed; "
                                  "per-layer path stays active")
