@@ -166,6 +166,19 @@ class Bench:
                 self.doc["planner"] = planner.planner_stats()
             except Exception:
                 self.doc.setdefault("planner", None)
+            # AOT program-bank + model-server tallies (banks exported /
+            # loaded, requests, coalescing factor, SLO attainment) ride
+            # on EVERY doc too — the serving tier's evidence
+            try:
+                from transmogrifai_tpu import aot
+                self.doc["aot"] = aot.aot_stats()
+            except Exception:
+                self.doc.setdefault("aot", None)
+            try:
+                from transmogrifai_tpu import server
+                self.doc["server"] = server.server_stats()
+            except Exception:
+                self.doc.setdefault("server", None)
         if final:
             self.doc.pop("partial", None)
         print(json.dumps(self.doc), flush=True)
@@ -349,6 +362,245 @@ def _scoring_throughput() -> dict:
     else:
         out["engine"] = ("gated_off: link below FUSE_MIN_BANDWIDTH_MBPS"
                          if eng is not None else "unavailable")
+    return out
+
+
+_COLD_PROBE_SCRIPT = r"""
+import json, os, sys, time
+import jax
+sys.path.insert(0, sys.argv[1])
+from transmogrifai_tpu import aot
+from transmogrifai_tpu.cli import _populate_stage_registry
+from transmogrifai_tpu.scoring import ScoringEngine
+from transmogrifai_tpu.workflow import WorkflowModel
+model_dir, export_dir, cap, use_bank = (
+    sys.argv[2], sys.argv[3], int(sys.argv[4]), sys.argv[5] == "bank")
+_populate_stage_registry()
+model = WorkflowModel.load(model_dir)
+eng = ScoringEngine(model, gate_bandwidth=False, mesh=False,
+                    bucket_cap=cap)
+t_load0 = time.perf_counter()
+report = {"loaded": []}
+if use_bank:
+    report = aot.load_program_bank(eng, export_dir)
+load_ms = (time.perf_counter() - t_load0) * 1e3
+records = json.load(open(os.path.join(export_dir, "bench_req.json")))
+t0 = time.perf_counter()
+out = eng.score_store(records)
+first_ms = (time.perf_counter() - t0) * 1e3
+print("COLDJSON " + json.dumps({
+    "first_request_ms": round(first_ms, 3),
+    "bank_load_ms": round(load_ms, 3),
+    "bank_buckets": report["loaded"],
+    "compile_count": eng.compile_count,
+    "rows": out.n_rows}))
+"""
+
+
+def _serving_latency() -> dict:
+    """AOT bank + model server benchmark (the millions-of-users tier):
+
+    1. **Cold-process first-request latency** — a fresh interpreter
+       loads the saved smoke model and answers one request, with vs
+       without the AOT program bank. Honest cold: the subprocess does
+       NOT inherit this process's persistent compile cache. Pass flag:
+       ``bank_cold_start_ms < 0.05 * jit_cold_start_ms`` (the 10×
+       acceptance criterion with margin).
+    2. **Steady-state serving** — a ModelServer under a Poisson-ish
+       synthetic load at two batching deadlines: p50/p99 request
+       latency, throughput and the measured coalescing factor.
+    """
+    import subprocess
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from transmogrifai_tpu import (ColumnStore, FeatureBuilder, Workflow,
+                                   column_from_values, serving)
+    from transmogrifai_tpu import server as server_mod
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import \
+        BinaryClassificationModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+
+    cap = int(os.environ.get("BENCH_SERVE_BUCKET_CAP", 1024))
+    train_rows = 20_000
+    rng = np.random.default_rng(17)
+    y = rng.integers(0, 2, train_rows).astype(float)
+    xs = {f"x{j}": rng.normal(size=train_rows) + (0.3 * j) * y
+          for j in range(6)}
+    cols = {"label": column_from_values(ft.RealNN, y)}
+    for k, v in xs.items():
+        cols[k] = column_from_values(ft.Real, list(v))
+    store = ColumnStore(cols, train_rows)
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = [FeatureBuilder.Real(f"x{j}").from_column().as_predictor()
+             for j in range(6)]
+    vec = transmogrify(feats)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily(
+            grid=[{"regParam": 0.01, "elasticNetParam": 0.0}])],
+        splitter=None, seed=5)
+    pred = label.transform_with(selector, vec)
+    model = (Workflow().set_input_store(store)
+             .set_result_features(pred).train())
+
+    records = [{"label": float(y[i]),
+                **{f"x{j}": float(xs[f"x{j}"][i]) for j in range(6)}}
+               for i in range(2048)]
+
+    work = tempfile.mkdtemp(prefix="tmog_serve_bench_")
+    model_dir = os.path.join(work, "model")
+    export_dir = os.path.join(work, "export")
+    model.save(model_dir)
+    t0 = time.time()
+    meta = serving.export_scoring_fn(model, export_dir, records[:8],
+                                     bucket_cap=cap)
+    export_s = time.time() - t0
+    with open(os.path.join(export_dir, "bench_req.json"), "w") as fh:
+        json.dump(records[:64], fh)
+
+    out: dict = {"bucket_cap": cap, "export_s": round(export_s, 2),
+                 "aot_meta": meta["aot"]}
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    def cold_probe(mode: str) -> dict:
+        proc = subprocess.run(
+            [sys.executable, "-c", _COLD_PROBE_SCRIPT, here, model_dir,
+             export_dir, str(cap), mode],
+            capture_output=True, text=True, timeout=600)
+        for line in proc.stdout.splitlines():
+            if line.startswith("COLDJSON "):
+                return json.loads(line[len("COLDJSON "):])
+        raise RuntimeError(
+            f"cold probe ({mode}) produced no result: rc="
+            f"{proc.returncode} stderr={proc.stderr[-400:]!r}")
+
+    def cold_probe_inproc(mode: str) -> dict:
+        """Fallback when a second process cannot attach the accelerator
+        (TPU runtimes are exclusive): a FRESH engine per probe — its
+        program cache starts empty — with the persistent compile cache
+        disabled so the JIT leg pays a real compile."""
+        import jax
+
+        from transmogrifai_tpu import aot
+        from transmogrifai_tpu.scoring import ScoringEngine
+        prev = jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
+            eng = ScoringEngine(model, gate_bandwidth=False, mesh=False,
+                                bucket_cap=cap)
+            t0 = time.perf_counter()
+            report = {"loaded": []}
+            if mode == "bank":
+                report = aot.load_program_bank(eng, export_dir)
+            load_ms = (time.perf_counter() - t0) * 1e3
+            t0 = time.perf_counter()
+            res = eng.score_store(records[:64], use_cache=False)
+            return {"first_request_ms":
+                    round((time.perf_counter() - t0) * 1e3, 3),
+                    "bank_load_ms": round(load_ms, 3),
+                    "bank_buckets": report["loaded"],
+                    "compile_count": eng.compile_count,
+                    "rows": res.n_rows}
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+
+    if meta["aot"] is None:
+        out["cold_start"] = {"status": "bank_unavailable_on_backend"}
+    else:
+        try:
+            jit = cold_probe("jit")
+            bank = cold_probe("bank")
+            out["cold_mode"] = "subprocess"
+        except (RuntimeError, subprocess.TimeoutExpired) as e:
+            # exclusive-accelerator runtimes (TPU) refuse a second
+            # process: measure with fresh engines in THIS process,
+            # persistent compile cache off — the compile_count field
+            # still proves the zero-compile claim
+            _log(f"[bench] cold subprocess unavailable ({e!r}); "
+                 "in-process fresh-engine fallback")
+            jit = cold_probe_inproc("jit")
+            bank = cold_probe_inproc("bank")
+            out["cold_mode"] = "in_process_fresh_engine"
+        out["cold_start"] = {
+            "jit_cold_start_ms": jit["first_request_ms"],
+            "jit_compiles": jit["compile_count"],
+            "bank_cold_start_ms": bank["first_request_ms"],
+            "bank_load_ms": bank["bank_load_ms"],
+            "bank_compiles": bank["compile_count"],
+            "speedup": round(jit["first_request_ms"]
+                             / max(bank["first_request_ms"], 1e-9), 1),
+            "pass": (bank["compile_count"] == 0
+                     and bank["first_request_ms"]
+                     < 0.05 * jit["first_request_ms"]),
+        }
+
+    # -- steady state: Poisson-ish load at two batching deadlines ----------
+    duration_s = float(os.environ.get("BENCH_SERVE_SECONDS", 3.0))
+    rate_hz = float(os.environ.get("BENCH_SERVE_RATE_HZ", 400.0))
+    n_clients = 4
+    out["steady_state"] = {}
+    for deadline_ms in (0.0, 5.0):
+        srv = server_mod.ModelServer(batch_deadline_s=deadline_ms / 1e3,
+                                     bucket_cap=cap, slo_ms=50.0)
+        srv.register("m", model_dir=model_dir, bank_dir=export_dir,
+                     preload=True)
+        stats_before = server_mod.server_stats()
+        lat: list = []
+        lat_lock = threading.Lock()
+
+        def client(k: int) -> None:
+            crng = np.random.default_rng(100 + k)
+            t_end = time.perf_counter() + duration_s
+            while time.perf_counter() < t_end:
+                # exponential inter-arrival — the Poisson-ish load
+                time.sleep(float(crng.exponential(
+                    n_clients / rate_hz)))
+                lo = int(crng.integers(0, len(records) - 8))
+                n = int(crng.integers(1, 9))
+                try:
+                    res = srv.submit(
+                        "m", records[lo:lo + n]).result(timeout=60)
+                except server_mod.ServerBusy:
+                    continue
+                with lat_lock:
+                    lat.append(res.seconds)
+
+        threads = [threading.Thread(target=client, args=(k,),
+                                    name=f"bench-client-{k}",
+                                    daemon=True)
+                   for k in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=duration_s * 4 + 60)
+        wall = time.perf_counter() - t0
+        srv.shutdown(drain=True)
+        d = {k: v - stats_before[k]
+             for k, v in server_mod.server_stats().items()
+             if isinstance(v, (int, float))
+             and isinstance(stats_before.get(k), (int, float))}
+        arr = np.asarray(lat, dtype=np.float64) * 1e3
+        out["steady_state"][f"deadline_{deadline_ms:g}ms"] = {
+            "requests": int(arr.size),
+            "requests_per_s": round(arr.size / wall, 1),
+            "p50_ms": round(float(np.percentile(arr, 50)), 3)
+            if arr.size else None,
+            "p99_ms": round(float(np.percentile(arr, 99)), 3)
+            if arr.size else None,
+            "coalescing_factor": (round(d["requests"]
+                                        / max(d["batches"], 1), 2)),
+            "bank_hit_batches": d.get("bank_hit_batches", 0),
+            "quarantined": d.get("quarantined_requests", 0),
+            "slo50ms_attainment": (round(
+                d.get("slo_met", 0)
+                / max(d.get("slo_met", 0) + d.get("slo_missed", 0), 1),
+                4)),
+        }
     return out
 
 
@@ -800,6 +1052,25 @@ def main() -> None:
         except Exception as e:
             _log(f"[bench] scoring_throughput failed: {e!r}")
             configs["scoring_throughput"] = {"error": repr(e)[:400]}
+    bench.emit()
+
+    # 4b2. Serving latency (the AOT bank + model server proof):
+    #      cold-process first-request latency with vs without the
+    #      program bank (subprocess — honest cold), steady-state
+    #      p50/p99 under Poisson-ish load at two batching deadlines.
+    #      Budget-gated: two interpreter spawns dominate its cost.
+    if bench.remaining() < 180:
+        configs["serving_latency"] = {
+            "status": "skipped_budget",
+            "remaining_budget_s": round(bench.remaining(), 1)}
+        _log(f"[bench] serving_latency skipped: remaining "
+             f"{bench.remaining():.0f}s < 180s")
+    else:
+        try:
+            configs["serving_latency"] = _serving_latency()
+        except Exception as e:
+            _log(f"[bench] serving_latency failed: {e!r}")
+            configs["serving_latency"] = {"error": repr(e)[:400]}
     bench.emit()
 
     # 4c. Fit-statistics engine (fit path): one-pass-per-layer fused

@@ -1,0 +1,367 @@
+"""AOT program bank tests (aot.py + the serving.py export/load story).
+
+The acceptance contract: a cold process that loads an AOT-banked export
+answers its first scoring request with ``compile_count == 0``, and every
+corruption/incompatibility mode (version skew, wrong device kind,
+tampered digest, truncated manifest, missing program) degrades to
+per-bucket JIT with a TMG5xx advisory — never a crash."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, Workflow, aot, serving
+from transmogrifai_tpu.models import (BinaryClassificationModelSelector,
+                                      LogisticRegressionFamily)
+from transmogrifai_tpu.ops.sanity_checker import SanityChecker
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.scoring import (PROGRAM_CACHE_CAP, ScoringEngine,
+                                       engine_cache_stats)
+
+BUCKET_CAP = 64
+
+
+def _train(seed=7, n=240):
+    rng = np.random.default_rng(seed)
+    y = np.asarray([i % 2 for i in range(n)], float)
+    rng.shuffle(y)
+    cats = ["a", "b", "c", None]
+    records = [{"label": float(y[i]),
+                "x1": float(rng.normal() + y[i]),
+                "x2": float(rng.normal()),
+                "cat": cats[i % 4]} for i in range(n)]
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x1").from_column().as_predictor()
+    f2 = FeatureBuilder.Real("x2").from_column().as_predictor()
+    f3 = FeatureBuilder.PickList("cat").from_column().as_predictor()
+    vec = transmogrify([f1, f2, f3])
+    checker = SanityChecker(remove_bad_features=True,
+                            remove_feature_group=False)
+    label.transform_with(checker, vec)
+    vec = checker.get_output()
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None,
+        seed=seed)
+    pred = label.transform_with(sel, vec)
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    return model, records, pred
+
+
+@pytest.fixture(scope="module")
+def banked(tmp_path_factory):
+    """One trained model + one AOT-banked export, shared module-wide."""
+    model, records, pred = _train()
+    export = str(tmp_path_factory.mktemp("export"))
+    meta = serving.export_scoring_fn(model, export, records[:8],
+                                     bucket_cap=BUCKET_CAP)
+    return model, records, pred, export, meta
+
+
+def _cold_engine(model):
+    """A fresh engine — the per-engine program cache starts empty, so
+    its ``compile_count`` is the cold-process compile oracle."""
+    return ScoringEngine(model, gate_bandwidth=False, mesh=False,
+                         bucket_cap=BUCKET_CAP)
+
+
+def _assert_bitwise(a, b):
+    for fld in ("prediction", "raw_prediction", "probability"):
+        assert np.array_equal(getattr(a, fld), getattr(b, fld)), fld
+
+
+# ---------------------------------------------------------------------------
+# the happy path: bank → zero compiles, bit-identical
+# ---------------------------------------------------------------------------
+
+
+def test_bank_load_scores_with_zero_compiles(banked):
+    model, records, pred, export, meta = banked
+    assert meta["aot"] is not None and meta["aot"]["programs"] == 4
+    eng = _cold_engine(model)
+    report = aot.load_program_bank(eng, export)
+    assert report["present"] and report["compatible"]
+    assert report["loaded"] == [8, 16, 32, 64]
+    assert report["findings"] == []
+    assert len(eng.programs()) == 4
+    # two different buckets, zero compiles — the acceptance criterion
+    out_small = eng.score_store(records[:5])
+    out_big = eng.score_store(records[:40])
+    assert eng.compile_count == 0
+    # bit-identical to a JIT-compiled engine on the same model
+    jit = _cold_engine(model)
+    _assert_bitwise(out_small[pred.name],
+                    jit.score_store(records[:5])[pred.name])
+    _assert_bitwise(out_big[pred.name],
+                    jit.score_store(records[:40])[pred.name])
+    assert jit.compile_count > 0
+
+
+def test_export_metadata_stamped_even_without_aot(banked, tmp_path):
+    """Satellite: bucket_cap, ladder, plan digest and versions land in
+    the export metadata whether or not a bank ships."""
+    model, records, pred, export, _ = banked
+    meta = serving.export_scoring_fn(model, str(tmp_path), records[:8],
+                                     bucket_cap=BUCKET_CAP, aot=False)
+    assert meta["aot"] is None
+    assert not os.path.isdir(aot.bank_dir(str(tmp_path)))
+    assert meta["bucketCap"] == BUCKET_CAP
+    assert meta["bucketLadder"] == [8, 16, 32, 64]
+    env = meta["environment"]
+    import jax
+    import jaxlib
+    assert env["jax"] == jax.__version__
+    assert env["jaxlib"] == jaxlib.__version__
+    assert env["platform"] == "cpu"
+    eng = _cold_engine(model)
+    assert meta["planDigest"] == eng.rewrite_digest()
+    assert meta["stateDigest"] == eng.state_digest()
+    # bankless artifacts still load (pre-bank compatibility)
+    fn = serving.load_scoring_fn(str(tmp_path))
+    assert fn.bank_buckets == []
+
+
+def test_cold_process_first_request_zero_compiles(banked, tmp_path):
+    """THE acceptance test: a genuinely cold process (fresh
+    interpreter, nothing warm) loads the saved model + banked export
+    and answers its first request without one XLA compile."""
+    model, records, pred, export, _ = banked
+    model_dir = str(tmp_path / "model")
+    model.save(model_dir)
+    script = r"""
+import os, sys, json, time
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, sys.argv[4])
+from transmogrifai_tpu import aot
+from transmogrifai_tpu.cli import _populate_stage_registry
+from transmogrifai_tpu.scoring import ScoringEngine
+from transmogrifai_tpu.workflow import WorkflowModel
+_populate_stage_registry()
+model = WorkflowModel.load(sys.argv[1])
+eng = ScoringEngine(model, gate_bandwidth=False, mesh=False,
+                    bucket_cap=int(sys.argv[3]))
+report = aot.load_program_bank(eng, sys.argv[2])
+assert report["compatible"], report
+records = json.load(open(os.path.join(sys.argv[2], "req.json")))
+t0 = time.perf_counter()
+out = eng.score_store(records)
+ms = (time.perf_counter() - t0) * 1e3
+assert eng.compile_count == 0, eng.compile_count
+print(f"COLD_OK rows={out.n_rows} first_request_ms={ms:.2f}")
+"""
+    with open(os.path.join(export, "req.json"), "w") as fh:
+        json.dump(records[:10], fh)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c", script, model_dir, export,
+         str(BUCKET_CAP), repo],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "COLD_OK rows=10" in proc.stdout, proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# degradation matrix: every corruption falls back to JIT with an advisory
+# ---------------------------------------------------------------------------
+
+
+def _corrupt_manifest(export, mutate):
+    mp = aot.manifest_path(export)
+    with open(mp) as fh:
+        manifest = json.load(fh)
+    mutate(manifest)
+    with open(mp, "w") as fh:
+        json.dump(manifest, fh)
+
+
+def _copy_export(export, tmp_path):
+    import shutil
+    dst = str(tmp_path / "export_copy")
+    shutil.copytree(export, dst)
+    return dst
+
+
+@pytest.mark.parametrize("case", [
+    "truncated_manifest", "not_json_manifest", "wrong_device_kind",
+    "jax_version_skew", "tampered_program", "missing_program",
+    "plan_digest_mismatch", "state_digest_mismatch",
+    "format_version_bump",
+])
+def test_bank_corruption_degrades_to_jit(banked, tmp_path, case):
+    model, records, pred, export, _ = banked
+    export = _copy_export(export, tmp_path)
+    whole_bank_dead = True
+    if case == "truncated_manifest":
+        with open(aot.manifest_path(export), "w") as fh:
+            fh.write('{"formatVersion": 1, "programs"')
+    elif case == "not_json_manifest":
+        with open(aot.manifest_path(export), "wb") as fh:
+            fh.write(b"\x00\x01garbage")
+    elif case == "wrong_device_kind":
+        _corrupt_manifest(
+            export, lambda m: m["environment"].update(
+                deviceKind="TPU v5e"))
+    elif case == "jax_version_skew":
+        _corrupt_manifest(
+            export, lambda m: m["environment"].update(jax="0.0.1"))
+    elif case == "plan_digest_mismatch":
+        _corrupt_manifest(
+            export, lambda m: m.update(planDigest="deadbeef" * 4))
+    elif case == "state_digest_mismatch":
+        _corrupt_manifest(
+            export, lambda m: m.update(stateDigest="deadbeef" * 4))
+    elif case == "format_version_bump":
+        _corrupt_manifest(export, lambda m: m.update(formatVersion=99))
+    elif case == "tampered_program":
+        f = os.path.join(aot.bank_dir(export), "bucket_16.xbin")
+        blob = bytearray(open(f, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(f, "wb").write(bytes(blob))
+        whole_bank_dead = False
+    elif case == "missing_program":
+        os.remove(os.path.join(aot.bank_dir(export), "bucket_32.xbin"))
+        whole_bank_dead = False
+
+    eng = _cold_engine(model)
+    report = aot.load_program_bank(eng, export)   # must not raise
+    assert report["findings"], case
+    rules = {f.rule for f in report["findings"]}
+    assert rules <= {"TMG501", "TMG502"}, rules
+    if whole_bank_dead:
+        assert report["loaded"] == []
+    else:
+        # per-program damage: the OTHER buckets still serve from the bank
+        assert report["loaded"] != []
+        assert len(report["skipped"]) == 1
+        assert {"TMG502"} == rules
+    # scoring still works — JIT fills the holes, results identical
+    out = eng.score_store(records[:12])           # bucket 16
+    jit = _cold_engine(model)
+    _assert_bitwise(out[pred.name],
+                    jit.score_store(records[:12])[pred.name])
+    if whole_bank_dead:
+        assert eng.compile_count > 0
+    elif case == "tampered_program":
+        assert eng.compile_count == 1             # only bucket 16 re-JITs
+
+
+def test_load_scoring_fn_warns_on_version_skew(banked, tmp_path, caplog):
+    """Satellite: environment skew on the plain StableHLO artifact is a
+    WARNING (TMG503), not a failure — the artifact still loads and
+    scores."""
+    import logging
+    model, records, pred, export, _ = banked
+    export = _copy_export(export, tmp_path)
+    meta_path = os.path.join(export, "scoring_export.json")
+    with open(meta_path) as fh:
+        meta = json.load(fh)
+    meta["environment"]["jax"] = "0.0.1"
+    with open(meta_path, "w") as fh:
+        json.dump(meta, fh)
+    with caplog.at_level(logging.WARNING,
+                         logger="transmogrifai_tpu.serving"):
+        fn = serving.load_scoring_fn(export, prefer_bank=False)
+    assert any("TMG503" in r.message for r in caplog.records)
+    assert callable(fn)
+
+
+def test_flat_bank_path_matches_stablehlo_path(banked):
+    """load_scoring_fn's bank dispatch (padded to the ladder bucket,
+    sliced back) returns the same arrays as the StableHLO JIT path, and
+    batches beyond the bank's cap fall back."""
+    model, records, pred, export, _ = banked
+    eng = _cold_engine(model)
+    store = eng._raw_store(records[:10])
+    _, prepared, uploads = eng.host_blocks(store)
+    blocks = {}
+    for uid, bl in prepared.items():
+        for k, v in bl.items():
+            blocks[f"{uid}/{k}"] = v
+    blocks.update(uploads)
+
+    banked_fn = serving.load_scoring_fn(export)
+    plain_fn = serving.load_scoring_fn(export, prefer_bank=False)
+    assert banked_fn.bank_buckets == [8, 16, 32, 64]
+    assert plain_fn.bank_buckets == []
+    a = banked_fn(blocks)
+    b = plain_fn(blocks)
+    assert sorted(a) == sorted(b)
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-6, atol=1e-7)
+    nm = pred.name
+    assert a[f"{nm}.prediction"].shape == (10,)
+
+
+# ---------------------------------------------------------------------------
+# the engine preload seam + eviction tallies (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_preload_seam_and_eviction_counter(banked):
+    model, records, pred, export, _ = banked
+    eng = _cold_engine(model)
+    assert eng.programs() == []
+    before = engine_cache_stats()
+    sentinel = object()
+    for i in range(PROGRAM_CACHE_CAP + 3):
+        eng.preload(("fake-key", i), sentinel)
+    after = engine_cache_stats()
+    assert after["preloads"] - before["preloads"] == PROGRAM_CACHE_CAP + 3
+    assert after["evictions"] - before["evictions"] == 3
+    assert len(eng.programs()) == PROGRAM_CACHE_CAP
+    # LRU order: the oldest keys were the ones evicted
+    assert ("fake-key", 0) not in eng.programs()
+    assert ("fake-key", PROGRAM_CACHE_CAP + 2) in eng.programs()
+    assert eng.compile_count == 0     # preloads are not compiles
+
+
+def test_stale_bank_rejected_and_bankless_export_removes_bank(banked,
+                                                              tmp_path):
+    """A re-export that does NOT write a fresh bank must not leave the
+    previous export's bank behind (it closes over the OLD weights); and
+    if a stale bank does survive (copied back, partial rsync), the flat
+    loader cross-checks the bank digests against the export metadata
+    and refuses it with a TMG501 advisory."""
+    import shutil
+    model, records, pred, export, _ = banked
+    d = str(tmp_path / "roundtrip")
+    shutil.copytree(export, d)
+    assert os.path.isdir(aot.bank_dir(d))
+    # (b) bankless re-export removes the stale bank directory
+    model2, records2, pred2 = _train(seed=99)
+    serving.export_scoring_fn(model2, d, records2[:8],
+                              bucket_cap=BUCKET_CAP, aot=False)
+    assert not os.path.isdir(aot.bank_dir(d))
+    # (a) resurrect model 1's bank beside model 2's StableHLO: the
+    # digest cross-check must reject it — StableHLO path serves
+    shutil.copytree(aot.bank_dir(export), aot.bank_dir(d))
+    fn = serving.load_scoring_fn(d)
+    assert fn.bank_buckets == []
+    manifest, programs, findings = aot.load_flat_programs(
+        d, expect_digests={"planDigest": fn.meta["planDigest"],
+                           "stateDigest": fn.meta["stateDigest"]})
+    assert programs == {}
+    assert any(f.rule == "TMG501" and "STALE" in f.message
+               for f in findings)
+
+
+def test_aot_stats_tallies(banked, tmp_path):
+    model, records, pred, export, _ = banked
+    before = aot.aot_stats()
+    serving.export_scoring_fn(model, str(tmp_path), records[:8],
+                              bucket_cap=16)
+    eng = ScoringEngine(model, gate_bandwidth=False, mesh=False,
+                        bucket_cap=16)
+    aot.load_program_bank(eng, str(tmp_path))
+    after = aot.aot_stats()
+    assert after["banks_exported"] - before["banks_exported"] == 1
+    assert after["programs_exported"] - before["programs_exported"] == 2
+    assert after["banks_loaded"] - before["banks_loaded"] == 1
+    assert after["programs_loaded"] - before["programs_loaded"] == 2

@@ -559,6 +559,38 @@ def test_tmg306_direct_make_mesh_outside_parallel():
     assert tm.lint_source(bad, "tests/test_whatever.py") == []
 
 
+def test_tmg307_thread_name_daemon_explicit():
+    """PR-8 rule: worker threads must declare name= and daemon= — the
+    telemetry tracer keys trace tracks by thread name, and the model
+    server's shutdown semantics hinge on daemonness being visible."""
+    tm = _load_tmoglint()
+    bad = ("import threading\n"
+           "t = threading.Thread(target=f)\n")
+    assert [f.rule for f in tm.lint_source(bad)] == ["TMG307"]
+    # one missing keyword is still a finding (and names the gap)
+    half = ("import threading\n"
+            "t = threading.Thread(target=f, name='worker')\n")
+    fs = tm.lint_source(half)
+    assert [f.rule for f in fs] == ["TMG307"]
+    assert "daemon=" in fs[0].message
+    # the from-import and aliased-module forms trigger too
+    from_import = ("from threading import Thread\n"
+                   "t = Thread(target=f)\n")
+    assert [f.rule for f in tm.lint_source(from_import)] == ["TMG307"]
+    aliased = ("import threading as _threading\n"
+               "t = _threading.Thread(target=f)\n")
+    assert [f.rule for f in tm.lint_source(aliased)] == ["TMG307"]
+    # fully explicit is clean
+    ok = ("import threading\n"
+          "t = threading.Thread(target=f, name='serve-x', daemon=True)\n")
+    assert tm.lint_source(ok) == []
+    # the thread marker allows a deliberate default
+    allowed = ("import threading\n"
+               "t = threading.Thread(target=f)  "
+               "# lint: thread — interpreter-owned helper\n")
+    assert tm.lint_source(allowed) == []
+
+
 def test_repo_is_clean_under_self_lint():
     """The meta-test: the package itself reports zero findings — the
     project invariants PRs 1-4 introduced by convention are now CI

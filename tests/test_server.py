@@ -1,0 +1,542 @@
+"""Multi-tenant model server tests (server.py).
+
+The serving correctness contract: dynamic micro-batching is
+bit-identical to solo scoring (co-batching never perturbs a tenant's
+rows), admission control rejects loudly, the LRU evicts and reloads
+transparently, faults quarantine requests without killing the server,
+and graceful shutdown drains every accepted request."""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (FeatureBuilder, Workflow, resilience,
+                               serving, telemetry)
+from transmogrifai_tpu import server as server_mod
+from transmogrifai_tpu.models import (BinaryClassificationModelSelector,
+                                      LogisticRegressionFamily)
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.server import (ModelNotFound, ModelServer,
+                                      ServerBusy, ServerClosed,
+                                      serve_http, server_stats)
+
+BUCKET_CAP = 64
+
+
+def _train(seed, n=200):
+    rng = np.random.default_rng(seed)
+    y = np.asarray([i % 2 for i in range(n)], float)
+    rng.shuffle(y)
+    records = [{"label": float(y[i]),
+                "x1": float(rng.normal() + y[i]),
+                "x2": float(rng.normal())} for i in range(n)]
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x1").from_column().as_predictor()
+    f2 = FeatureBuilder.Real("x2").from_column().as_predictor()
+    vec = transmogrify([f1, f2])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None,
+        seed=seed)
+    pred = label.transform_with(sel, vec)
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    return model, records, pred
+
+
+@pytest.fixture(scope="module")
+def tenants(tmp_path_factory):
+    """Two trained models saved + AOT-exported — the mixed-model
+    serving roster."""
+    out = {}
+    for name, seed in (("A", 11), ("B", 12)):
+        model, records, pred = _train(seed)
+        mdir = str(tmp_path_factory.mktemp(f"model{name}"))
+        edir = str(tmp_path_factory.mktemp(f"export{name}"))
+        model.save(mdir, overwrite=True)
+        serving.export_scoring_fn(model, edir, records[:8],
+                                  bucket_cap=BUCKET_CAP)
+        out[name] = {"model": model, "records": records, "pred": pred,
+                     "model_dir": mdir, "export_dir": edir}
+    yield out
+    # chaos/breaker state must not leak across modules
+    for t in out.values():
+        t["model"]._engine_breaker().reset()
+
+
+def _server(tenants, **kw):
+    kw.setdefault("bucket_cap", BUCKET_CAP)
+    kw.setdefault("batch_deadline_s", 0.02)
+    srv = ModelServer(**kw)
+    for name, t in tenants.items():
+        srv.register(name, model_dir=t["model_dir"],
+                     bank_dir=t["export_dir"])
+    return srv
+
+
+def _assert_bitwise(a, b):
+    for fld in ("prediction", "raw_prediction", "probability"):
+        assert np.array_equal(getattr(a, fld), getattr(b, fld)), fld
+
+
+def _reset_breakers(srv):
+    for e in srv._entries.values():
+        if e.model is not None:
+            e.model._engine_breaker().reset()
+
+
+# ---------------------------------------------------------------------------
+# basic serving + coalescing
+# ---------------------------------------------------------------------------
+
+
+def test_roundtrip_coalescing_and_bank_cold_start(tenants):
+    srv = _server(tenants, slo_ms=2000)
+    try:
+        before = server_stats()
+        futs = [(nm, t["records"][i * 3:(i + 1) * 3],
+                 srv.submit(nm, t["records"][i * 3:(i + 1) * 3]))
+                for i in range(5) for nm, t in tenants.items()]
+        for nm, recs, f in futs:
+            res = f.result(timeout=60)
+            assert res.rows == len(recs)
+            entry = srv._entries[nm]
+            # bit-identical to solo scoring through the same program
+            # (the dispatch's bucket pinned — co-batching is inert)
+            solo = entry.engine.score_store(recs, bucket_min=res.bucket)
+            _assert_bitwise(res.store[tenants[nm]["pred"].name],
+                            solo[tenants[nm]["pred"].name])
+        after = server_stats()
+        d = {k: after[k] - before[k] for k in
+             ("requests", "batches", "rows", "model_loads", "bank_loads")}
+        assert d["requests"] == 10
+        assert d["rows"] == 30
+        assert 0 < d["batches"] <= 10
+        assert d["model_loads"] == 2 and d["bank_loads"] == 2
+        # the AOT bank answered the cold start: zero compiles anywhere
+        assert all(e.engine.compile_count == 0
+                   for e in srv._entries.values())
+        # the sync convenience wrapper
+        res = srv.score("A", tenants["A"]["records"][:4], timeout_s=60)
+        assert res.store.n_rows == 4
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_stats_shapes(tenants):
+    srv = _server(tenants, slo_ms=5000)
+    try:
+        srv.score("A", tenants["A"]["records"][:4], timeout_s=60)
+        doc = srv.stats()
+        assert doc["sloMs"] == 5000
+        a = doc["models"]["A"]
+        assert a["loaded"] and a["requests"] >= 1
+        assert "p50_ms" in a and "p99_ms" in a
+        assert a["bankBuckets"] == [8, 16, 32, 64]
+        glob = doc["server"]
+        assert glob["batch_coalescing_factor"] is not None
+        assert glob["slo_attainment"] is not None
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_unknown_model_and_closed_server(tenants):
+    srv = _server(tenants)
+    with pytest.raises(ModelNotFound):
+        srv.submit("nope", [{"x": 1}])
+    srv.shutdown(drain=True)
+    with pytest.raises(ServerClosed):
+        srv.submit("A", tenants["A"]["records"][:1])
+    srv.shutdown(drain=True)      # idempotent
+
+
+def test_backpressure_rejects_when_queue_full(tenants):
+    """Admission control: a full bounded queue rejects synchronously
+    with ServerBusy — no silent unbounded buffering. The first dispatch
+    is held on an event so the fill is deterministic."""
+    gate = threading.Event()
+    released = threading.Event()
+
+    class Held(ModelServer):
+        def _dispatch(self, entry, batch):
+            released.set()
+            gate.wait(timeout=30)
+            super()._dispatch(entry, batch)
+
+    srv = Held(max_models=2, max_queue=2, batch_deadline_s=0.0,
+               bucket_cap=BUCKET_CAP)
+    srv.register("A", model_dir=tenants["A"]["model_dir"])
+    try:
+        recs = tenants["A"]["records"]
+        before = server_stats()["rejected"]
+        f0 = srv.submit("A", recs[:2])      # worker picks this up
+        released.wait(timeout=30)           # dispatch is now held
+        f1 = srv.submit("A", recs[2:4])     # queued (1/2)
+        f2 = srv.submit("A", recs[4:6])     # queued (2/2)
+        with pytest.raises(ServerBusy):
+            srv.submit("A", recs[6:8])      # bounced
+        assert server_stats()["rejected"] - before == 1
+        gate.set()
+        for f in (f0, f1, f2):
+            assert f.result(timeout=60).rows == 2
+    finally:
+        gate.set()
+        srv.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# LRU eviction / reload
+# ---------------------------------------------------------------------------
+
+
+def test_lru_evicts_and_reloads(tenants):
+    srv = _server(tenants, max_models=1)
+    try:
+        before = server_stats()
+        srv.score("A", tenants["A"]["records"][:3], timeout_s=60)
+        assert srv._entries["A"].model is not None
+        srv.score("B", tenants["B"]["records"][:3], timeout_s=60)
+        # loading B crossed max_models=1: A (the LRU victim) unloaded
+        assert srv._entries["A"].model is None
+        assert srv._entries["B"].model is not None
+        # A transparently reloads on its next request — correct results
+        res = srv.score("A", tenants["A"]["records"][:3], timeout_s=60)
+        solo = srv._entries["A"].engine.score_store(
+            tenants["A"]["records"][:3], bucket_min=res.bucket)
+        _assert_bitwise(res.store[tenants["A"]["pred"].name],
+                        solo[tenants["A"]["pred"].name])
+        d = server_stats()
+        assert d["model_evictions"] - before["model_evictions"] >= 2
+        assert d["model_loads"] - before["model_loads"] >= 3
+        # the bank re-attaches on reload: still zero compiles
+        assert srv._entries["A"].engine.compile_count == 0
+        # LRU weight: bank bytes with a 1 MiB floor (tiny test banks
+        # sit under the floor)
+        from transmogrifai_tpu import aot
+        manifest, _ = aot.read_manifest(tenants["A"]["export_dir"])
+        assert aot.bank_bytes(manifest) > 0
+        assert srv._entries["A"].weight_bytes \
+            == max(aot.bank_bytes(manifest), 1 << 20)
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_eviction_mid_dispatch_does_not_kill_worker(tenants):
+    """Regression: an LRU eviction landing while a dispatch is in
+    flight must not null the model out from under it — the dispatch
+    scores through references captured under the entry lock, the
+    future resolves, and the worker survives for the next request."""
+    gate = threading.Event()
+    released = threading.Event()
+
+    class Held(ModelServer):
+        def _dispatch(self, entry, batch):
+            if entry.name == "A":
+                released.set()
+                gate.wait(timeout=60)
+            super()._dispatch(entry, batch)
+
+    srv = Held(max_models=1, batch_deadline_s=0.0, bucket_cap=BUCKET_CAP)
+    srv.register("A", model_dir=tenants["A"]["model_dir"],
+                 bank_dir=tenants["A"]["export_dir"])
+    srv.register("B", model_dir=tenants["B"]["model_dir"])
+    try:
+        fa = srv.submit("A", tenants["A"]["records"][:3])
+        released.wait(timeout=60)          # A's dispatch is in flight
+        # B's load crosses max_models=1 and evicts A mid-dispatch
+        srv.score("B", tenants["B"]["records"][:3], timeout_s=60)
+        gate.set()
+        assert fa.result(timeout=60).rows == 3      # batch unharmed
+        # the worker survived: a fresh A request reloads and scores
+        assert srv.score("A", tenants["A"]["records"][:2],
+                         timeout_s=60).rows == 2
+    finally:
+        gate.set()
+        srv.shutdown(drain=True)
+        _reset_breakers(srv)
+
+
+def test_pinned_live_model_never_evicted(tenants):
+    srv = ModelServer(max_models=1, batch_deadline_s=0.0,
+                      bucket_cap=BUCKET_CAP)
+    srv.register("live", model=tenants["A"]["model"])
+    srv.register("B", model_dir=tenants["B"]["model_dir"])
+    try:
+        srv.score("B", tenants["B"]["records"][:3], timeout_s=60)
+        assert srv._entries["live"].model is not None   # pinned
+        res = srv.score("live", tenants["A"]["records"][:3],
+                        timeout_s=60)
+        assert res.rows == 3
+    finally:
+        srv.shutdown(drain=True)
+        tenants["A"]["model"]._engine_breaker().reset()
+
+
+# ---------------------------------------------------------------------------
+# graceful shutdown drains
+# ---------------------------------------------------------------------------
+
+
+def test_graceful_shutdown_drains_all_queued(tenants):
+    """A long batching deadline leaves requests queued/coalescing when
+    shutdown lands; drain=True scores every accepted request anyway."""
+    srv = _server(tenants, batch_deadline_s=30.0)
+    futs = [srv.submit(nm, tenants[nm]["records"][i * 2:(i + 1) * 2])
+            for i in range(4) for nm in ("A", "B")]
+    t0 = time.perf_counter()
+    srv.shutdown(drain=True, timeout_s=120)
+    assert time.perf_counter() - t0 < 60       # sentinel cut the hold
+    for f in futs:
+        res = f.result(timeout=1)              # already resolved
+        assert res.rows == 2
+    _reset_breakers(srv)
+
+
+def test_no_drain_fails_pending(tenants):
+    """drain=False: in-flight work completes, but requests still QUEUED
+    fail loudly with ServerClosed instead of being silently dropped.
+    The first dispatch is held on an event so 'queued' is
+    deterministic."""
+    gate = threading.Event()
+    released = threading.Event()
+
+    class Held(ModelServer):
+        def _dispatch(self, entry, batch):
+            released.set()
+            gate.wait(timeout=60)
+            super()._dispatch(entry, batch)
+
+    srv = Held(max_models=2, batch_deadline_s=0.0,
+               bucket_cap=BUCKET_CAP)
+    srv.register("A", model_dir=tenants["A"]["model_dir"])
+    try:
+        f0 = srv.submit("A", tenants["A"]["records"][:2])  # in flight
+        released.wait(timeout=60)
+        queued = [srv.submit("A", tenants["A"]["records"][:2])
+                  for _ in range(2)]
+        stopper = threading.Thread(
+            target=lambda: srv.shutdown(drain=False, timeout_s=120),
+            name="test-stopper", daemon=True)
+        stopper.start()
+        for f in queued:       # failed synchronously by the no-drain path
+            with pytest.raises(ServerClosed):
+                f.result(timeout=60)
+        gate.set()
+        stopper.join(timeout=120)
+        assert f0.result(timeout=60).rows == 2   # in-flight completed
+    finally:
+        gate.set()
+        srv.shutdown(drain=False)
+        _reset_breakers(srv)
+
+
+# ---------------------------------------------------------------------------
+# chaos: faults injected, bit-identity held, quarantine counted, no drops
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_concurrent_mixed_model_chaos_bit_identity(tenants):
+    """The acceptance chaos test: K threads of mixed-model traffic with
+    a seeded fault plan on ``server.dispatch``. Every request either
+    succeeds BIT-IDENTICALLY to solo scoring or fails with the injected
+    fault and is quarantined; the quarantine tally matches the failures
+    exactly; graceful shutdown drops nothing."""
+    srv = _server(tenants, batch_deadline_s=0.005)
+    results = []
+    res_lock = threading.Lock()
+    plan = resilience.FaultPlan(seed=1234).on(
+        "server.dispatch", error=RuntimeError, probability=0.35)
+    q_before = resilience.resilience_stats()["quarantined_batches"]
+    s_before = server_stats()
+
+    def client(k):
+        rng = np.random.default_rng(1000 + k)
+        for i in range(8):
+            nm = "A" if (k + i) % 2 == 0 else "B"
+            recs = tenants[nm]["records"]
+            lo = int(rng.integers(0, 150))
+            n = int(rng.integers(1, 7))
+            reqs = recs[lo:lo + n]
+            try:
+                fut = srv.submit(nm, reqs)
+            except ServerBusy:
+                continue
+            with res_lock:
+                results.append((nm, reqs, fut))
+
+    try:
+        with resilience.fault_plan(plan):
+            threads = [threading.Thread(target=client, args=(k,),
+                                        name=f"chaos-client-{k}",
+                                        daemon=True)
+                       for k in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            srv.shutdown(drain=True, timeout_s=120)
+    finally:
+        _reset_breakers(srv)
+
+    assert results
+    failed = 0
+    for nm, reqs, fut in results:
+        assert fut.done()          # graceful shutdown dropped nothing
+        try:
+            res = fut.result(timeout=1)
+        except RuntimeError:
+            failed += 1            # the injected fault, surfaced loudly
+            continue
+        assert res.rows == len(reqs)
+        entry = srv._entries[nm]
+        pred = tenants[nm]["pred"]
+        if res.engine_tier:
+            solo = entry.engine.score_store(reqs, bucket_min=res.bucket)
+        else:
+            solo = entry.model.score(reqs, engine=False)
+        _assert_bitwise(res.store[pred.name], solo[pred.name])
+    # quarantine accounting: every failed request was quarantined, and
+    # nothing else was
+    q_delta = (resilience.resilience_stats()["quarantined_batches"]
+               - q_before)
+    assert q_delta == failed
+    s_after = server_stats()
+    assert s_after["quarantined_requests"] \
+        - s_before["quarantined_requests"] == failed
+    assert s_after["requests"] - s_before["requests"] \
+        == len(results) - failed
+    assert plan.fired("server.dispatch") >= failed
+
+
+# ---------------------------------------------------------------------------
+# telemetry + HTTP front end
+# ---------------------------------------------------------------------------
+
+
+def test_on_request_listener_and_instruments(tenants):
+    telemetry.enable()
+    try:
+        collector = telemetry.add_listener(
+            telemetry.CollectingRunListener())
+        srv = _server(tenants, slo_ms=5000)
+        try:
+            srv.score("A", tenants["A"]["records"][:4], timeout_s=60)
+        finally:
+            srv.shutdown(drain=True)
+        summary = collector.summary()
+        assert summary["requests"] == 1
+        assert summary["requestRows"] == 4
+        assert summary["requestsFailed"] == 0
+        doc = telemetry.metrics_json()
+        assert doc["server.requests"] >= 1
+        assert "server.request_seconds.A" in doc
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_http_front_end(tenants):
+    import http.client
+    srv = _server(tenants, slo_ms=5000)
+    httpd = serve_http(srv, port=0)
+    host, port = httpd.server_address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+
+        def call(method, path, body=None):
+            conn.request(method, path,
+                         None if body is None else json.dumps(body),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            return r.status, json.loads(r.read() or b"{}")
+
+        status, doc = call("GET", "/healthz")
+        assert status == 200 and sorted(doc["models"]) == ["A", "B"]
+        status, doc = call("POST", "/v1/models/A:score",
+                           {"records": tenants["A"]["records"][:3]})
+        assert status == 200
+        assert doc["rows"] == 3 and doc["bucket"] >= 3
+        pred_name = tenants["A"]["pred"].name
+        assert pred_name in doc["outputs"][0]
+        assert "prediction" in doc["outputs"][0][pred_name]
+        status, _ = call("POST", "/v1/models/nope:score",
+                         {"records": [{"x": 1}]})
+        assert status == 404
+        status, _ = call("POST", "/v1/models/A:score", {"records": []})
+        assert status == 400
+        status, doc = call("GET", "/stats")
+        assert status == 200 and "A" in doc["models"]
+        status, _ = call("GET", "/nothing")
+        assert status == 404
+    finally:
+        httpd.shutdown()
+        srv.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# params-file construction + knob validation (runner/cli satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_build_server_from_params(tenants, tmp_path):
+    from transmogrifai_tpu.cli import build_server_from_params
+    from transmogrifai_tpu.runner import OpParams
+    params = OpParams(
+        model_location=tenants["A"]["model_dir"],
+        custom_params={
+            "serveModels": {"B": {"model": tenants["B"]["model_dir"],
+                                  "bank": tenants["B"]["export_dir"]}},
+            "serveBank": tenants["A"]["export_dir"],
+            "serveBatchDeadlineMs": 1, "serveMaxQueue": 16,
+            "serveMaxModels": 2, "serveSloMs": 5000,
+            "serveBucketCap": BUCKET_CAP})
+    srv = build_server_from_params(params)
+    try:
+        assert sorted(srv.models()) == ["B", "default"]
+        assert srv.slo_ms == 5000 and srv.max_queue == 16
+        res = srv.score("default", tenants["A"]["records"][:3],
+                        timeout_s=60)
+        assert res.rows == 3
+        assert srv._entries["default"].engine.compile_count == 0  # bank
+    finally:
+        srv.shutdown(drain=True)
+
+
+@pytest.mark.parametrize("key,val", [
+    ("serveBatchDeadlineMs", "soon"), ("serveMaxQueue", 2.5),
+    ("serveMaxModels", 0), ("serveSloMs", float("nan")),
+    ("serveBucketCap", 4),
+])
+def test_serve_knob_validation_names_the_key(tenants, key, val):
+    from transmogrifai_tpu.cli import build_server_from_params
+    from transmogrifai_tpu.runner import OpParams
+    params = OpParams(model_location=tenants["A"]["model_dir"],
+                      custom_params={key: val})
+    with pytest.raises(ValueError, match=key):
+        build_server_from_params(params)
+
+
+def test_cli_check_validates_serve_knobs(tmp_path, capsys):
+    from transmogrifai_tpu.cli import run_check
+    p = tmp_path / "params.json"
+    p.write_text(json.dumps({
+        "customParams": {"serveBatchDeadlineMs": "abc",
+                         "serveMaxModels": 1.5}}))
+    assert run_check(str(p)) == 1
+    out = capsys.readouterr().out
+    assert "TMG001" in out
+    assert "serveBatchDeadlineMs" in out and "serveMaxModels" in out
+
+
+def test_cli_serve_bad_params_exits_nonzero(tmp_path, capsys):
+    from transmogrifai_tpu.cli import run_serve
+    p = tmp_path / "params.json"
+    p.write_text(json.dumps({"customParams": {"serveMaxQueue": "lots"}}))
+    assert run_serve(str(p)) == 1
+    assert "serveMaxQueue" in capsys.readouterr().out
+    # no models configured at all
+    p.write_text(json.dumps({}))
+    assert run_serve(str(p)) == 1
+    assert "no models" in capsys.readouterr().out

@@ -27,6 +27,12 @@ here as rules (the TMG3xx family of the catalog in
   pass is the regression ``mesh_constructions`` exists to catch).
   ``parallel/`` itself and tests are exempt; a deliberate explicit
   construction carries ``# lint: explicit-mesh — reason``.
+* **TMG307** — ``threading.Thread(...)`` must pass ``name=`` and
+  ``daemon=`` explicitly (the PR-8 model-server rule: the telemetry
+  tracer keys trace tracks by thread name, so an unnamed worker renders
+  as ``Thread-7`` and an implicit daemon flag hides whether shutdown
+  waits for it). A deliberate default carries
+  ``# lint: thread — reason``.
 
 Runs as a CLI over one or more paths (default: the ``transmogrifai_tpu``
 package next to this script) and as a tier-1 pytest
@@ -52,12 +58,14 @@ if _REPO not in sys.path:                       # direct script execution
 from transmogrifai_tpu.lint import Finding, Severity, enforce  # noqa: E402
 
 __all__ = ["lint_source", "lint_file", "lint_paths", "main",
-           "ALLOW_WALLCLOCK", "ALLOW_BROAD_EXCEPT", "ALLOW_EXPLICIT_MESH"]
+           "ALLOW_WALLCLOCK", "ALLOW_BROAD_EXCEPT", "ALLOW_EXPLICIT_MESH",
+           "ALLOW_THREAD"]
 
 #: suppression markers, checked on the finding's own source line
 ALLOW_WALLCLOCK = "lint: wall-clock"
 ALLOW_BROAD_EXCEPT = "lint: broad-except"
 ALLOW_EXPLICIT_MESH = "lint: explicit-mesh"
+ALLOW_THREAD = "lint: thread"
 
 
 def _fault_sites() -> frozenset:
@@ -84,6 +92,8 @@ class _Visitor(ast.NodeVisitor):
         self.inject_funcs: Set[str] = set()
         self.mesh_modules: Set[str] = set()
         self.make_mesh_funcs: Set[str] = set()
+        self.threading_modules: Set[str] = set()
+        self.thread_funcs: Set[str] = set()      # from threading import Thread
         self.with_contexts: Set[int] = set()
         #: parallel/ owns mesh construction, tests may build explicit
         #: topologies — TMG306 exempts both by path
@@ -115,6 +125,8 @@ class _Visitor(ast.NodeVisitor):
                 self.resilience_modules.add(local)
             if alias.name.endswith("mesh"):
                 self.mesh_modules.add(local)
+            if alias.name == "threading":
+                self.threading_modules.add(local)
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
@@ -135,6 +147,8 @@ class _Visitor(ast.NodeVisitor):
                 self.inject_funcs.add(local)
             if mod.endswith("mesh") and alias.name == "make_mesh":
                 self.make_mesh_funcs.add(local)
+            if mod == "threading" and alias.name == "Thread":
+                self.thread_funcs.add(local)
         self.generic_visit(node)
 
     # -- with: remember sanctioned context-manager calls -------------------
@@ -198,6 +212,14 @@ class _Visitor(ast.NodeVisitor):
             return True
         return isinstance(f, ast.Name) and f.id in self.make_mesh_funcs
 
+    def _is_thread(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "Thread" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in self.threading_modules:
+            return True
+        return isinstance(f, ast.Name) and f.id in self.thread_funcs
+
     def visit_Call(self, node: ast.Call) -> None:
         if self._is_time_time(node) \
                 and not self._marked(node.lineno, ALLOW_WALLCLOCK):
@@ -240,6 +262,20 @@ class _Visitor(ast.NodeVisitor):
                 "/set_process_mesh (a throwaway mesh per pass is the "
                 "mesh_constructions regression); mark a deliberate "
                 f"explicit topology '# {ALLOW_EXPLICIT_MESH} — <reason>'")
+        elif self._is_thread(node) \
+                and not self._marked(node.lineno, ALLOW_THREAD):
+            kws = {kw.arg for kw in node.keywords}
+            missing = [f"{k}=" for k in ("name", "daemon")
+                       if k not in kws]
+            if missing:
+                self._add(
+                    "TMG307", node.lineno,
+                    f"threading.Thread() without explicit "
+                    f"{' and '.join(missing)} — telemetry trace tracks "
+                    "are keyed by thread name and implicit daemonness "
+                    "hides shutdown semantics; pass name= and daemon= "
+                    "(or mark a deliberate default "
+                    f"'# {ALLOW_THREAD} — <reason>')")
         self.generic_visit(node)
 
 

@@ -1,0 +1,468 @@
+"""AOT program bank — ahead-of-time compiled scoring executables.
+
+BENCH_r05 measured ~449 s of XLA compile clock against ~33 s of warm CV
+train: compilation, not compute, dominates the system, and every cold
+process re-pays the scoring engine's bucket-ladder compile before its
+first request. This module extends the persistent-compile-cache story
+(PR 3) into a true ahead-of-time contract, following the
+TFX/TensorFlow-Serving export-then-serve artifact model (PAPERS.md):
+
+* **Export** (:func:`build_program_bank`, called by
+  ``serving.export_scoring_fn``): lower + compile the fused
+  transform→predict chain for the WHOLE power-of-two bucket ladder —
+  through :meth:`ScoringEngine.program_callable`, so the attached
+  ExecutionPlan's CSE/pruning rewrites are baked into the serialized
+  programs — and ship the serialized executables
+  (``jax.experimental.serialize_executable``) in the export directory
+  alongside the StableHLO, under a manifest recording the bucket
+  ladder, plan + fitted-state digests, jax/jaxlib versions, device
+  kind, and a per-program blake2b digest.
+* **Load** (:func:`load_program_bank`): probe the manifest, check
+  environment compatibility (platform, device kind, jax/jaxlib
+  versions) and engine identity (plan-rewrite digest, fitted-state
+  digest, output set), then deserialize compatible executables straight
+  into the ScoringEngine program cache via the public
+  :meth:`ScoringEngine.preload` seam — ``compile_count`` stays 0, so a
+  cold process answers its first request in milliseconds. Every
+  failure mode (version skew, wrong device kind, tampered digest,
+  truncated manifest, missing program file) degrades per-bucket to
+  JIT-on-miss with a TMG5xx advisory finding — never a crash.
+
+The always-on :func:`aot_stats` tallies follow the ``engine_cache_stats``
+discipline: cheap enough to never turn off, stamped on bench docs.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import telemetry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["build_program_bank", "load_program_bank", "read_manifest",
+           "environment_fingerprint", "bank_dir", "manifest_path",
+           "load_flat_programs", "aot_stats", "reset_aot_stats",
+           "FORMAT_VERSION", "BANK_DIRNAME", "BANK_MANIFEST"]
+
+FORMAT_VERSION = 1
+BANK_DIRNAME = "aot_bank"
+BANK_MANIFEST = "aot_manifest.json"
+
+# ---------------------------------------------------------------------------
+# always-on tallies (bench docs stamp these; telemetry mirrors when enabled)
+# ---------------------------------------------------------------------------
+
+_TALLY_LOCK = threading.Lock()
+_TALLY = {"banks_exported": 0, "programs_exported": 0,
+          "banks_loaded": 0, "programs_loaded": 0,
+          "programs_skipped": 0, "banks_incompatible": 0}
+
+
+def aot_stats() -> Dict[str, int]:
+    """Snapshot of the process-wide AOT-bank tallies (always on, the
+    ``engine_cache_stats`` discipline): exports, loads, per-program
+    skip counts and whole-bank incompatibility rejections."""
+    with _TALLY_LOCK:
+        return dict(_TALLY)
+
+
+def reset_aot_stats() -> None:
+    with _TALLY_LOCK:
+        for k in _TALLY:
+            _TALLY[k] = 0
+
+
+def _tally(key: str, n: int = 1) -> None:
+    with _TALLY_LOCK:
+        _TALLY[key] += n
+    telemetry.counter(f"aot.{key}").inc(n)
+
+
+# ---------------------------------------------------------------------------
+# paths + fingerprints
+# ---------------------------------------------------------------------------
+
+
+def bank_dir(path: str) -> str:
+    """The program-bank subdirectory of an export directory."""
+    return os.path.join(path, BANK_DIRNAME)
+
+
+def manifest_path(path: str) -> str:
+    return os.path.join(bank_dir(path), BANK_MANIFEST)
+
+
+def environment_fingerprint() -> Dict[str, Any]:
+    """The compatibility fields a serialized executable is only valid
+    under: jax/jaxlib versions, backend platform and device kind.
+    Serialized XLA executables are NOT portable across any of these —
+    the loader compares field-for-field and falls back to JIT on any
+    mismatch."""
+    import jax
+    import jaxlib
+    dev = jax.devices()[0]
+    return {"jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "platform": dev.platform,
+            "deviceKind": dev.device_kind}
+
+
+def _program_digest(blob: bytes) -> str:
+    return hashlib.blake2b(blob, digest_size=16).hexdigest()
+
+
+def _spec_blocks(blocks: List[Dict[str, Any]], bucket: int
+                 ) -> Tuple[Dict[str, Dict[str, np.ndarray]],
+                            Dict[str, np.ndarray]]:
+    """(prepared, uploads) dummy pytrees at ``bucket`` rows from the
+    export block manifest — zero-copy broadcast views, used both to
+    lower the program at export and to recompute the exact cache key at
+    load (shape/dtype are all the key reads)."""
+    prepared: Dict[str, Dict[str, np.ndarray]] = {}
+    uploads: Dict[str, np.ndarray] = {}
+    for spec in blocks:
+        shape = (bucket, *[int(t) for t in spec["tail"]])
+        a = np.broadcast_to(np.zeros((), dtype=np.dtype(spec["dtype"])),
+                            shape)
+        if spec["kind"] == "prepared":
+            prepared.setdefault(spec["uid"], {})[spec["name"]] = a
+        else:
+            uploads[spec["name"]] = a
+    return prepared, uploads
+
+
+# ---------------------------------------------------------------------------
+# export
+# ---------------------------------------------------------------------------
+
+
+def build_program_bank(engine, blocks: List[Dict[str, Any]],
+                       out_names: List[str], path: str,
+                       ladder: Optional[List[int]] = None
+                       ) -> Optional[Dict[str, Any]]:
+    """Compile the engine's program for every ladder bucket and ship the
+    serialized executables under ``path``'s ``aot_bank/`` directory.
+
+    ``blocks`` is the export block manifest (``engine.export_manifest``
+    output); ``ladder`` defaults to the full power-of-two ladder up to
+    the engine's bucket cap. Returns the written bank manifest, or
+    ``None`` when this backend's executables do not support
+    serialization (export still succeeds without a bank — an advisory,
+    not an error)."""
+    import jax
+    from jax.experimental import serialize_executable as se
+
+    from .scoring import bucket_ladder
+
+    ladder = sorted({int(b) for b in (ladder
+                     or bucket_ladder(engine.bucket_cap))})
+    run = engine.program_callable(out_names)
+    bdir = bank_dir(path)
+    os.makedirs(bdir, exist_ok=True)
+    programs: Dict[str, Dict[str, Any]] = {}
+    with telemetry.span("aot:build_program_bank", buckets=len(ladder)):
+        for bucket in ladder:
+            prepared, uploads = _spec_blocks(blocks, bucket)
+            compiled = jax.jit(run).lower(prepared, uploads).compile()
+            try:
+                payload, in_tree, out_tree = se.serialize(compiled)
+            except (ValueError, TypeError) as e:
+                # this backend's executables don't serialize (no
+                # unloaded-executable support): the export ships
+                # without a bank, JIT serves — advisory, never fatal
+                logger.warning(
+                    "AOT bank disabled: executable serialization "
+                    "unsupported on this backend (%s)", e)
+                return None
+            blob = pickle.dumps((payload, in_tree, out_tree),
+                                protocol=pickle.HIGHEST_PROTOCOL)
+            fname = f"bucket_{bucket}.xbin"
+            with open(os.path.join(bdir, fname), "wb") as fh:
+                fh.write(blob)
+            programs[str(bucket)] = {"file": fname, "bytes": len(blob),
+                                     "digest": _program_digest(blob)}
+            _tally("programs_exported")
+    manifest = {
+        "formatVersion": FORMAT_VERSION,
+        "bucketLadder": ladder,
+        "bucketCap": int(engine.bucket_cap),
+        "outNames": list(out_names),
+        "blocks": blocks,
+        "planDigest": engine.rewrite_digest(),
+        "stateDigest": engine.state_digest(),
+        "environment": environment_fingerprint(),
+        "programs": programs,
+    }
+    tmp = manifest_path(path) + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh, indent=1)
+    os.replace(tmp, manifest_path(path))
+    _tally("banks_exported")
+    logger.info("AOT program bank: %d executable(s) at %s "
+                "(%d bytes total)", len(programs), bdir,
+                sum(p["bytes"] for p in programs.values()))
+    return manifest
+
+
+def remove_bank(path: str) -> None:
+    """Delete any program bank under export dir ``path``. Called by
+    ``export_scoring_fn`` whenever it does NOT write a fresh bank
+    (``aot=False`` or a non-serializing backend): a stale bank from a
+    previous export would otherwise survive next to new StableHLO/meta
+    and serve the OLD model's weights."""
+    import shutil
+    shutil.rmtree(bank_dir(path), ignore_errors=True)
+
+
+def bank_bytes(manifest: Optional[Dict[str, Any]]) -> int:
+    """Total serialized-program bytes a bank manifest describes (the
+    model server's LRU weight)."""
+    if not manifest:
+        return 0
+    try:
+        return sum(int(p.get("bytes", 0))
+                   for p in manifest.get("programs", {}).values())
+    except (TypeError, ValueError, AttributeError):
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# load
+# ---------------------------------------------------------------------------
+
+
+def read_manifest(path: str) -> Tuple[Optional[Dict[str, Any]], List[Any]]:
+    """(manifest, findings) for the bank under export dir ``path``.
+    A missing bank is ``(None, [])`` — not an error (pre-bank exports
+    stay loadable); a truncated/corrupt manifest is ``(None,
+    [TMG502 finding])``."""
+    from .lint import Finding
+    mp = manifest_path(path)
+    try:
+        with open(mp) as fh:
+            manifest = json.load(fh)
+    except FileNotFoundError:
+        return None, []
+    except (json.JSONDecodeError, UnicodeDecodeError, OSError) as e:
+        return None, [Finding(
+            "TMG502", f"AOT bank manifest unreadable ({e}); the whole "
+            "bank is ignored and scoring JIT-compiles per bucket",
+            location=mp)]
+    if not isinstance(manifest, dict) \
+            or not isinstance(manifest.get("programs"), dict) \
+            or not isinstance(manifest.get("blocks"), list):
+        return None, [Finding(
+            "TMG502", "AOT bank manifest is missing its programs/blocks "
+            "tables (truncated or hand-edited); the whole bank is "
+            "ignored and scoring JIT-compiles per bucket", location=mp)]
+    return manifest, []
+
+
+def _compat_findings(manifest: Dict[str, Any], path: str,
+                     engine=None) -> List[Any]:
+    """Environment (+ optional engine-identity) compatibility findings.
+    Non-empty means the bank must not serve — JIT-on-miss takes over."""
+    from .lint import Finding
+    out: List[Any] = []
+    loc = manifest_path(path)
+    if manifest.get("formatVersion") != FORMAT_VERSION:
+        out.append(Finding(
+            "TMG501", "AOT bank format version "
+            f"{manifest.get('formatVersion')!r} != {FORMAT_VERSION} — "
+            "re-export the bank with this build", location=loc))
+        return out
+    env = environment_fingerprint()
+    want = manifest.get("environment") or {}
+    for k in ("platform", "deviceKind", "jax", "jaxlib"):
+        if want.get(k) != env[k]:
+            out.append(Finding(
+                "TMG501", f"AOT bank {k} mismatch: exported under "
+                f"{want.get(k)!r}, this process runs {env[k]!r} — "
+                "serialized executables are environment-bound, scoring "
+                "falls back to per-bucket JIT", location=loc))
+    if engine is not None and not out:
+        if manifest.get("planDigest") != engine.rewrite_digest():
+            out.append(Finding(
+                "TMG501", "AOT bank plan-rewrite digest mismatch (the "
+                "serve-time ExecutionPlan differs from the exported "
+                "one; banked gathers would compute different columns) — "
+                "per-bucket JIT serves", location=loc))
+        if manifest.get("stateDigest") != engine.state_digest():
+            out.append(Finding(
+                "TMG501", "AOT bank fitted-state digest mismatch (the "
+                "banked executables close over DIFFERENT weights than "
+                "this model carries) — per-bucket JIT serves",
+                location=loc))
+        if list(manifest.get("outNames") or []) \
+                != list(engine._out_names(results_only=True)):
+            out.append(Finding(
+                "TMG501", "AOT bank output set differs from the "
+                "serve-time engine's result features — per-bucket JIT "
+                "serves", location=loc))
+        if int(manifest.get("bucketCap", 0)) != int(engine.bucket_cap):
+            out.append(Finding(
+                "TMG501", f"AOT bank bucket cap "
+                f"{manifest.get('bucketCap')!r} != engine cap "
+                f"{engine.bucket_cap} — per-bucket JIT serves",
+                location=loc))
+    return out
+
+
+def _load_program(path: str, manifest: Dict[str, Any], bucket: int):
+    """Deserialize one banked executable; raises ``ValueError`` with a
+    descriptive reason on any integrity failure (caller converts to a
+    per-bucket advisory + JIT fallback)."""
+    from jax.experimental import serialize_executable as se
+    rec = manifest["programs"][str(bucket)]
+    fpath = os.path.join(bank_dir(path), str(rec.get("file", "")))
+    try:
+        with open(fpath, "rb") as fh:
+            blob = fh.read()
+    except OSError as e:
+        raise ValueError(f"program file unreadable ({e})") from None
+    expect = rec.get("bytes")
+    if expect is not None and len(blob) != int(expect):
+        raise ValueError(
+            f"truncated program: {len(blob)} bytes on disk, manifest "
+            f"recorded {expect}")
+    digest = rec.get("digest")
+    if digest is not None and _program_digest(blob) != digest:
+        raise ValueError(
+            "program digest mismatch (bytes altered since export)")
+    try:
+        payload, in_tree, out_tree = pickle.loads(blob)
+        return se.deserialize_and_load(payload, in_tree, out_tree)
+    except Exception as e:  # lint: broad-except — any deserialize failure degrades to JIT, never crashes serving
+        raise ValueError(
+            f"executable deserialization failed "
+            f"({type(e).__name__}: {e})") from e
+
+
+def load_program_bank(engine, path: str,
+                      emit: bool = True) -> Dict[str, Any]:
+    """Probe the bank under export dir ``path`` and preload every
+    compatible executable into ``engine``'s program cache.
+
+    Returns a report: ``{"present", "compatible", "loaded": [buckets],
+    "skipped": {bucket: reason}, "findings": [lint.Finding]}``. All
+    failure modes are advisories (TMG501 whole-bank incompatibility,
+    TMG502 per-artifact corruption) — the engine always remains
+    servable via JIT-on-miss. ``emit`` mirrors the findings into
+    telemetry (``lint.*`` counters + ``on_lint``).
+
+    The engine should be built with ``mesh=False`` (the server and
+    ``aot``-aware loaders do): banked executables are unsharded, and a
+    multi-device dispatch keys on the mesh shape so a preloaded program
+    would never be found."""
+    from . import lint
+    report: Dict[str, Any] = {"present": False, "compatible": False,
+                              "loaded": [], "skipped": {},
+                              "findings": []}
+    manifest, findings = read_manifest(path)
+    report["findings"].extend(findings)
+    if manifest is None:
+        report["present"] = bool(findings)
+        if findings:
+            _tally("banks_incompatible")
+        _finish_report(report, emit)
+        return report
+    report["present"] = True
+    compat = _compat_findings(manifest, path, engine=engine)
+    if compat:
+        report["findings"].extend(compat)
+        _tally("banks_incompatible")
+        _finish_report(report, emit)
+        return report
+    out_names = list(manifest["outNames"])
+    with telemetry.span("aot:load_program_bank",
+                        buckets=len(manifest["programs"])):
+        for bucket_s in sorted(manifest["programs"], key=int):
+            bucket = int(bucket_s)
+            try:
+                fn = _load_program(path, manifest, bucket)
+            except ValueError as e:
+                report["skipped"][bucket] = str(e)
+                report["findings"].append(lint.Finding(
+                    "TMG502", f"AOT bank bucket {bucket}: {e} — this "
+                    "bucket JIT-compiles on first use",
+                    location=manifest_path(path)))
+                _tally("programs_skipped")
+                continue
+            prepared, uploads = _spec_blocks(manifest["blocks"], bucket)
+            key = engine.program_key(prepared, uploads, out_names,
+                                     mesh_key=None)
+            engine.preload(key, fn)
+            report["loaded"].append(bucket)
+            _tally("programs_loaded")
+    report["compatible"] = bool(report["loaded"])
+    if report["compatible"]:
+        _tally("banks_loaded")
+    _finish_report(report, emit)
+    return report
+
+
+def _finish_report(report: Dict[str, Any], emit: bool) -> None:
+    for f in report["findings"]:
+        logger.warning("aot: %s", f.format())
+    if emit and report["findings"]:
+        from . import lint
+        lint.emit_findings(report["findings"])
+
+
+def load_flat_programs(path: str,
+                       expect_digests: Optional[Dict[str, Any]] = None
+                       ) -> Tuple[Optional[Dict[str, Any]],
+                                  Dict[int, Any], List[Any]]:
+    """The package-light load path for ``serving.load_scoring_fn``:
+    ``(manifest, {bucket: callable}, findings)``. Environment checks
+    plus — when ``expect_digests`` carries the export metadata's
+    ``planDigest``/``stateDigest`` — an identity cross-check against
+    the bank manifest, so a stale bank left beside a re-exported
+    StableHLO (different weights!) is rejected instead of silently
+    serving the old model. Corrupt or missing programs are skipped
+    per-bucket with TMG502 advisories. An absent bank returns
+    ``(None, {}, [])``."""
+    manifest, findings = read_manifest(path)
+    if manifest is None:
+        if findings:
+            _tally("banks_incompatible")
+        return None, {}, findings
+    compat = _compat_findings(manifest, path, engine=None)
+    if not compat:
+        from .lint import Finding
+        for key in ("planDigest", "stateDigest"):
+            want = (expect_digests or {}).get(key)
+            if want is not None and manifest.get(key) != want:
+                compat.append(Finding(
+                    "TMG501", f"AOT bank {key} does not match the "
+                    "StableHLO export metadata — the bank is STALE "
+                    "(left over from a previous export of a different "
+                    "model); the StableHLO path serves",
+                    location=manifest_path(path)))
+    if compat:
+        _tally("banks_incompatible")
+        return manifest, {}, findings + compat
+    from .lint import Finding
+    programs: Dict[int, Any] = {}
+    for bucket_s in sorted(manifest["programs"], key=int):
+        bucket = int(bucket_s)
+        try:
+            programs[bucket] = _load_program(path, manifest, bucket)
+            _tally("programs_loaded")
+        except ValueError as e:
+            findings.append(Finding(
+                "TMG502", f"AOT bank bucket {bucket}: {e} — this bucket "
+                "serves through the StableHLO JIT path",
+                location=manifest_path(path)))
+            _tally("programs_skipped")
+    if programs:
+        _tally("banks_loaded")
+    return manifest, programs, findings

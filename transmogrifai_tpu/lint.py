@@ -134,6 +134,23 @@ RULES: Dict[str, Tuple[str, str]] = {
                "direct make_mesh() call outside parallel/ — runtime code "
                "must go through process_default_mesh()/set_process_mesh "
                "(allow: '# lint: explicit-mesh — reason')"),
+    "TMG307": (Severity.ERROR,
+               "threading.Thread() without explicit name= and daemon= — "
+               "unnamed threads make per-thread telemetry trace tracks "
+               "unreadable and implicit daemonness hides shutdown "
+               "semantics (allow: '# lint: thread — reason')"),
+    # -- TMG5xx: serving / AOT-bank advisories (aot.py, serving.py,
+    #    server.py) — degradation notices, never crash paths ---------------
+    "TMG501": (Severity.WARNING,
+               "AOT program bank incompatible (version skew, wrong "
+               "device kind, plan/state digest mismatch) — scoring "
+               "degrades to per-bucket JIT"),
+    "TMG502": (Severity.WARNING,
+               "AOT bank artifact corrupt/tampered/truncated — affected "
+               "program(s) skipped, JIT serves those buckets"),
+    "TMG503": (Severity.WARNING,
+               "serving export version skew: artifact exported under a "
+               "different jax/jaxlib than this process runs"),
     # -- TMG4xx: whole-DAG planner advisories (planner.py) -----------------
     "TMG401": (Severity.WARNING,
                "stage measured slower on device than host but is pinned "

@@ -113,6 +113,9 @@ FAULT_SITES = frozenset({
     "csv.decode",                # csv decode (readers/data_readers.py)
     "fitstats.device_pass",      # fused fit-stats device tier (fitstats.py)
     "scoring.device_dispatch",   # compiled engine dispatch (scoring.py)
+    "server.dispatch",           # model-server micro-batch dispatch
+                                 # (server.py — batch AND per-request
+                                 # fallback attempts pass through it)
     "checkpoint.write",          # layer-checkpoint save (workflow.py)
     "checkpoint.rename",         # layer-checkpoint swap (workflow.py)
 })

@@ -451,6 +451,14 @@ class OpWorkflowRunner:
                         k: v - res_before.get(k, 0)
                         for k, v in
                         resilience.resilience_stats().items()}
+                    # serving-tier tallies ride too (AOT bank traffic +
+                    # model-server coalescing/SLO evidence — zeros on
+                    # runs that never touch the serving tier; always-on
+                    # like the resilience block, docs/serving.md)
+                    from . import aot as _aot
+                    from . import server as _server
+                    result.metrics["aot"] = _aot.aot_stats()
+                    result.metrics["server"] = _server.server_stats()
                     if collector is not None:
                         result.metrics["telemetry"] = collector.summary()
                         result.metrics["telemetryMetrics"] = \

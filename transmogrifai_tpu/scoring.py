@@ -105,15 +105,23 @@ PROGRAM_CACHE_CAP = 32
 #: cache hit/miss evidence on every emitted doc without forcing full
 #: telemetry on; the telemetry registry mirrors them when enabled. The
 #: module lock keeps concurrent engines' read-modify-writes exact.
-_CACHE_STATS = {"hits": 0, "misses": 0}
+#: ``preloads`` counts programs seeded via :meth:`ScoringEngine.preload`
+#: (the AOT bank path — they are neither hits nor compiles);
+#: ``evictions`` counts LRU drops, so a bank whose ladder outruns
+#: PROGRAM_CACHE_CAP shows up in bench docs instead of silently
+#: re-JIT-ing.
+_CACHE_STATS = {"hits": 0, "misses": 0, "evictions": 0, "preloads": 0}
 _CACHE_STATS_LOCK = threading.Lock()
 
 
 def engine_cache_stats() -> Dict[str, int]:
     """Cumulative scoring-engine program-cache hits/misses (and compiles
-    == misses) across all engines in this process."""
+    == misses), LRU evictions and AOT-bank preloads across all engines
+    in this process."""
     return {"hits": _CACHE_STATS["hits"], "misses": _CACHE_STATS["misses"],
-            "compiles": _CACHE_STATS["misses"]}
+            "compiles": _CACHE_STATS["misses"],
+            "evictions": _CACHE_STATS["evictions"],
+            "preloads": _CACHE_STATS["preloads"]}
 
 
 def bucket_for(n: int, cap: int = DEFAULT_BUCKET_CAP) -> int:
@@ -557,7 +565,8 @@ class ScoringEngine:
         pad = np.zeros((bucket - n,) + a.shape[1:], dtype=a.dtype)
         return np.concatenate([a, pad], axis=0)
 
-    def prepare_batch(self, data, use_cache: bool = True) -> _PreparedBatch:
+    def prepare_batch(self, data, use_cache: bool = True,
+                      bucket_min: Optional[int] = None) -> _PreparedBatch:
         """Host half of a scoring call, padded to the bucket ladder —
         safe to run in a worker thread (numpy/python only).
 
@@ -565,13 +574,19 @@ class ScoringEngine:
         object (score → evaluate, repeated warm calls) reuses the
         prepared blocks instead of re-running host transforms +
         host_prepare. Stores are treated as immutable (the ColumnStore
-        API is copy-on-write); ``use_cache=False`` opts out."""
+        API is copy-on-write); ``use_cache=False`` opts out.
+
+        ``bucket_min`` pins the padded bucket to at least that rung
+        (cap-clamped): the model server's per-request parity oracle
+        scores a lone request through the SAME program its coalesced
+        dispatch used, so co-batching is bit-identical by construction,
+        not by accident of XLA's per-shape compilation."""
         import weakref
 
         from .columns import ColumnStore
         cache_key = None
         if use_cache and isinstance(data, ColumnStore):
-            cache_key = (id(data), data.n_rows)
+            cache_key = (id(data), data.n_rows, bucket_min)
             with self._lock:
                 hit = self._prep_cache.get(cache_key)
             if hit is not None and hit[0]() is data:
@@ -589,6 +604,9 @@ class ScoringEngine:
                     sub = store.take(np.arange(lo, hi))
                 n = sub.n_rows
                 bucket = bucket_for(n, self.bucket_cap)
+                if bucket_min is not None:
+                    bucket = min(self.bucket_cap,
+                                 max(bucket, int(bucket_min)))
                 host_store, prepared, uploads = self.host_blocks(sub)
                 prepared = {uid: {k: self._pad_rows(v, n, bucket)
                                   for k, v in blocks.items()}
@@ -715,13 +733,69 @@ class ScoringEngine:
                 env[it.out] = it.model.predict_device(env[it.ins[0]])
         return {nm: env[nm] for nm in out_names}
 
+    def program_callable(self, out_names: List[str]):
+        """The pure pytree→pytree program body for ``out_names`` —
+        ``run(prepared, uploads) -> {name: array-or-triple}`` with this
+        engine's plan rewrites (CSE fan-out, dead-column pruning) baked
+        in. Shared by the JIT path (:meth:`_program`) and the AOT bank's
+        ahead-of-time ``lower().compile()`` (aot.py), so a banked
+        executable and a JIT-on-miss compile can never disagree."""
+        prune = self._active_prune(out_names)
+
+        def run(prepared_, uploads_):
+            import jax.numpy as jnp
+            return self._program_body(jnp, prepared_, uploads_, out_names,
+                                      prune=prune)
+
+        return run
+
+    def program_key(self, prepared, uploads, out_names: List[str],
+                    mesh_key: Optional[Tuple] = None) -> Tuple:
+        """The exact program-cache key :meth:`_program` would use for
+        these blocks — the public half of the AOT preload seam: the bank
+        computes keys through the engine itself (shapes, dtypes, output
+        set, mesh shape AND the plan-rewrite bits), so a preloaded
+        program can only ever be served where a JIT compile would have
+        produced the identical computation."""
+        prune = self._active_prune(out_names)
+        return self._signature(prepared, uploads, out_names, mesh_key) \
+            + (("plan", bool(self._cse_alias), prune is not None),)
+
+    def preload(self, key: Tuple, fn) -> None:
+        """Seed the program cache with an ahead-of-time compiled
+        executable under ``key`` (from :meth:`program_key`). Counted as
+        a preload — NOT a compile: ``compile_count`` stays untouched, so
+        the cold-start guarantee (`compile_count == 0` after a full bank
+        load) is assertable. Subject to the same LRU cap as JIT
+        programs."""
+        with self._lock:
+            self._programs.pop(key, None)
+            self._programs[key] = fn
+            with _CACHE_STATS_LOCK:
+                _CACHE_STATS["preloads"] += 1
+            telemetry.counter("scoring.cache_preloads").inc()
+            self._evict_over_cap_locked()
+
+    def programs(self) -> List[Tuple]:
+        """Snapshot of the live program-cache keys, LRU-oldest first
+        (introspection for the bank and the bench)."""
+        with self._lock:
+            return list(self._programs.keys())
+
+    def _evict_over_cap_locked(self) -> None:
+        """LRU trim (caller holds ``self._lock``); evictions are tallied
+        so a bank-evicted program is visible in bench docs."""
+        while len(self._programs) > PROGRAM_CACHE_CAP:
+            self._programs.popitem(last=False)
+            with _CACHE_STATS_LOCK:
+                _CACHE_STATS["evictions"] += 1
+            telemetry.counter("scoring.cache_evictions").inc()
+
     def _program(self, prepared, uploads, out_names,
                  mesh_key: Optional[Tuple] = None):
         import jax
 
-        prune = self._active_prune(out_names)
-        key = self._signature(prepared, uploads, out_names, mesh_key) \
-            + (("plan", bool(self._cse_alias), prune is not None),)
+        key = self.program_key(prepared, uploads, out_names, mesh_key)
         with self._lock:
             fn = self._programs.pop(key, None)
             if fn is not None:
@@ -731,12 +805,7 @@ class ScoringEngine:
                 telemetry.counter("scoring.cache_hits").inc()
                 return fn
 
-        def run(prepared_, uploads_):
-            import jax.numpy as jnp
-            return self._program_body(jnp, prepared_, uploads_, out_names,
-                                      prune=prune)
-
-        fn = jax.jit(run)
+        fn = jax.jit(self.program_callable(out_names))
         with self._lock:
             self._programs[key] = fn
             self._compile_count += 1
@@ -744,8 +813,7 @@ class ScoringEngine:
                 _CACHE_STATS["misses"] += 1
             telemetry.counter("scoring.cache_misses").inc()
             telemetry.counter("scoring.compile_count").inc()
-            while len(self._programs) > PROGRAM_CACHE_CAP:
-                self._programs.popitem(last=False)
+            self._evict_over_cap_locked()
         return fn
 
     # -- output wiring -----------------------------------------------------
@@ -895,12 +963,15 @@ class ScoringEngine:
                               results_only=False)
 
     def score_store(self, data, keep_intermediate: bool = False,
-                    use_cache: bool = True):
+                    use_cache: bool = True,
+                    bucket_min: Optional[int] = None):
         """Engine analog of ``WorkflowModel.score``: only result columns
-        are pulled off the device."""
+        are pulled off the device. ``bucket_min`` pins the padded bucket
+        (see :meth:`prepare_batch`)."""
         if keep_intermediate:
             return self.transform_store(data, use_cache=use_cache)
-        store = self.run_batch(self.prepare_batch(data, use_cache=use_cache),
+        store = self.run_batch(self.prepare_batch(data, use_cache=use_cache,
+                                                  bucket_min=bucket_min),
                                results_only=True)
         return store.select([nm for nm in self._result_names
                              if nm in store])
@@ -934,6 +1005,86 @@ class ScoringEngine:
                              "tail": list(a.shape[1:]),
                              "dtype": str(a.dtype)})
         return manifest
+
+    def rewrite_digest(self) -> str:
+        """blake2b-128 over the plan rewrites baked into this engine's
+        programs (CSE aliases, per-vec live sets, remapped select
+        indices, sliced scaler constants) plus the fused-plan structure.
+        An AOT bank records it at export; a serve-time engine whose
+        rewrites differ (different attached ExecutionPlan) must NOT
+        serve the banked executables — the baked gathers would produce
+        different columns — so the loader compares digests and falls
+        back to JIT on mismatch."""
+        import hashlib
+        h = hashlib.blake2b(digest_size=16)
+        for it in self._plan:
+            h.update(f"{it.kind}|{it.model.uid}|{it.out}|"
+                     f"{','.join(it.ins)};".encode())
+        for k in sorted(self._cse_alias):
+            h.update(f"cse:{k}->{self._cse_alias[k]};".encode())
+        for uid in sorted(self._prune):
+            h.update(f"prune:{uid}:".encode())
+            h.update(np.asarray(self._prune[uid], np.int64).tobytes())
+        for uid in sorted(self._select_keep_remap):
+            h.update(f"remap:{uid}:".encode())
+            h.update(np.asarray(self._select_keep_remap[uid],
+                                np.int64).tobytes())
+        for uid in sorted(self._scale_slice):
+            h.update(f"slice:{uid}:".encode())
+            h.update(np.asarray(self._scale_slice[uid],
+                                np.int64).tobytes())
+        return h.hexdigest()
+
+    def state_digest(self) -> str:
+        """blake2b-128 over the fused stages' fitted ARRAY state: every
+        numpy/jax array leaf reachable through public attributes
+        (sorted by path, shallow object recursion). The banked
+        executables close over these weights, so the bank manifest
+        records this digest and the loader refuses (advisory, JIT
+        fallback) when the serve-time model's arrays differ — a
+        retrained model with coincidentally identical uids/shapes must
+        never be served stale weights. Only array LEAVES are hashed:
+        bookkeeping that legitimately differs across a save/load
+        roundtrip (ctor params, selector summaries, private caches)
+        must not poison the digest, so non-array values and
+        underscore-private attributes are skipped."""
+        import hashlib
+        h = hashlib.blake2b(digest_size=16)
+
+        def leaves(obj, path: str, depth: int, out) -> None:
+            if isinstance(obj, np.ndarray):
+                if obj.dtype != object:
+                    out.append((path, obj))
+                return
+            if hasattr(obj, "__array__") and hasattr(obj, "dtype"):
+                leaves(np.asarray(obj), path, depth, out)  # jax arrays
+                return
+            if depth <= 0:
+                return
+            if isinstance(obj, (list, tuple)):
+                for i, v in enumerate(obj):
+                    leaves(v, f"{path}[{i}]", depth - 1, out)
+                return
+            if isinstance(obj, dict):
+                for k in sorted(obj, key=str):
+                    leaves(obj[k], f"{path}.{k}", depth - 1, out)
+                return
+            d = getattr(obj, "__dict__", None)
+            if isinstance(d, dict):
+                for k in sorted(d):
+                    if not k.startswith("_"):
+                        leaves(d[k], f"{path}.{k}", depth - 1, out)
+
+        for it in self._plan:
+            out: List[Tuple[str, np.ndarray]] = []
+            leaves(it.model, "", 3, out)
+            h.update(f"{it.kind}|{it.model.uid}|".encode())
+            for path, a in sorted(out, key=lambda kv: kv[0]):
+                h.update(path.encode())
+                h.update(str(a.dtype).encode())
+                h.update(str(a.shape).encode())
+                h.update(np.ascontiguousarray(a).tobytes())
+        return h.hexdigest()
 
     def export_callable(self, manifest, out_names):
         """Flat-arg callable over ``manifest`` order, for jax.export."""

@@ -1,0 +1,666 @@
+"""Multi-tenant model server — scoring at traffic scale, not batch scale.
+
+The Clipper analog (PAPERS.md): a serving tier that holds N loaded
+models behind a capacity-bounded LRU, gives each model its own request
+queue with **dynamic micro-batching** — concurrent requests coalesce up
+to a deadline into one engine dispatch padded to the nearest
+power-of-two ladder bucket, results scattered back per request — and
+reports per-model latency/throughput/queue-depth SLO instruments. The
+AOT program bank (aot.py) supplies the cold-start story: a freshly
+loaded model answers its first request without a single XLA compile.
+
+Correctness contract
+--------------------
+
+* **Co-batching is bit-identical.** Every fused stage is row-independent
+  (the scoring-engine contract), so a request's rows compute the same
+  values whether padded with zeros or with another tenant's rows. The
+  chaos test pins the solo oracle to the coalesced dispatch's bucket
+  (``ScoringEngine.score_store(bucket_min=...)``) and asserts
+  ``np.array_equal`` — the same program, byte-for-byte the same answers.
+* **Failure is contained.** Each model carries its own device-tier
+  circuit breaker (the per-model ``WorkflowModel._engine_breaker``); a
+  failed micro-batch dispatch retries per request on the host path; a
+  request that BOTH tiers reject is quarantined (resilience dead-letter
+  sink) and its future carries the error — the server never dies with
+  traffic in flight. ``server.dispatch`` is a registered fault site, so
+  chaos plans can score the whole path deterministically.
+* **Backpressure is explicit.** Queues are bounded; a full queue rejects
+  the request with :class:`ServerBusy` (HTTP 429) instead of buffering
+  without bound. Graceful shutdown drains every queued request before
+  workers exit.
+
+The HTTP front end is stdlib-only (``http.server``)::
+
+    POST /v1/models/<name>:score   {"records": [...]}  → scored rows
+    GET  /v1/models                → model table + stats
+    GET  /healthz                  → liveness
+    GET  /stats                    → server_stats() + per-model stats
+
+Run it with ``python -m transmogrifai_tpu serve params.json`` (knobs:
+``customParams.serve*`` — see docs/serving.md).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import aot, resilience, telemetry
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ModelServer", "RequestResult", "ServerError", "ModelNotFound",
+           "ServerBusy", "ServerClosed", "serve_http", "server_stats",
+           "reset_server_stats", "DEFAULT_BATCH_DEADLINE_MS",
+           "DEFAULT_MAX_QUEUE", "DEFAULT_MAX_MODELS"]
+
+#: how long the micro-batcher holds the first queued request open for
+#: co-riders before dispatching (ms). 0 = dispatch immediately.
+DEFAULT_BATCH_DEADLINE_MS = 2.0
+
+#: bounded per-model queue — beyond it, submit() raises ServerBusy
+DEFAULT_MAX_QUEUE = 256
+
+#: loaded models held before the LRU evicts
+DEFAULT_MAX_MODELS = 4
+
+#: per-model latency reservoir for exact p50/p95/p99 in stats
+_LATENCY_WINDOW = 4096
+
+
+# ---------------------------------------------------------------------------
+# always-on tallies (bench docs stamp these; telemetry mirrors when enabled)
+# ---------------------------------------------------------------------------
+
+_TALLY_LOCK = threading.Lock()
+_TALLY = {"requests": 0, "requests_failed": 0, "rows": 0, "batches": 0,
+          "coalesced_requests": 0, "bank_hit_batches": 0, "rejected": 0,
+          "quarantined_requests": 0, "model_loads": 0, "model_evictions": 0,
+          "bank_loads": 0, "slo_met": 0, "slo_missed": 0}
+
+
+def server_stats() -> Dict[str, Any]:
+    """Process-wide serving tallies (always on, the
+    ``engine_cache_stats`` discipline) plus the derived headline
+    numbers: ``batch_coalescing_factor`` (requests per dispatch),
+    ``bank_hit_rate`` (dispatches served by an AOT-banked program) and
+    ``slo_attainment`` (fraction of SLO-tracked requests under the
+    deadline; None when no SLO is configured)."""
+    with _TALLY_LOCK:
+        out: Dict[str, Any] = dict(_TALLY)
+    out["batch_coalescing_factor"] = (
+        round(out["requests"] / out["batches"], 3) if out["batches"]
+        else None)
+    out["bank_hit_rate"] = (
+        round(out["bank_hit_batches"] / out["batches"], 3)
+        if out["batches"] else None)
+    tracked = out["slo_met"] + out["slo_missed"]
+    out["slo_attainment"] = (round(out["slo_met"] / tracked, 4)
+                             if tracked else None)
+    return out
+
+
+def reset_server_stats() -> None:
+    with _TALLY_LOCK:
+        for k in _TALLY:
+            _TALLY[k] = 0
+
+
+def _tally(key: str, n: int = 1) -> None:
+    with _TALLY_LOCK:
+        _TALLY[key] += n
+
+
+# ---------------------------------------------------------------------------
+# request plumbing
+# ---------------------------------------------------------------------------
+
+
+class ServerError(Exception):
+    """Base class for serving-tier rejections."""
+
+
+class ModelNotFound(ServerError):
+    pass
+
+
+class ServerBusy(ServerError):
+    """Admission control: the model's bounded queue is full — explicit
+    backpressure instead of unbounded buffering (HTTP 429)."""
+
+
+class ServerClosed(ServerError):
+    pass
+
+
+@dataclass
+class RequestResult:
+    """One request's scored slice plus its dispatch provenance."""
+
+    store: Any                  # ColumnStore of the result columns
+    rows: int
+    bucket: int                 # padded ladder bucket the dispatch used
+    coalesced: int              # requests sharing that dispatch
+    seconds: float              # queue-to-completion latency
+    engine_tier: bool           # True = compiled engine, False = host
+
+
+class _Request:
+    __slots__ = ("records", "future", "t_enqueued", "rows")
+
+    def __init__(self, records: List[Dict[str, Any]]):
+        self.records = list(records)
+        self.rows = len(self.records)
+        self.future: "Future[RequestResult]" = Future()
+        self.t_enqueued = time.perf_counter()
+
+
+_SENTINEL = object()
+
+
+class _ModelEntry:
+    """One registered model: its queue, worker, loaded state and stats."""
+
+    def __init__(self, name: str, model_dir: Optional[str],
+                 bank_dir: Optional[str], model: Any,
+                 max_queue: int):
+        self.name = name
+        self.model_dir = model_dir
+        self.bank_dir = bank_dir
+        #: a model registered as a live object (no directory) cannot be
+        #: reloaded after eviction, so the LRU pins it
+        self.pinned = model is not None and model_dir is None
+        self.model = model
+        self.engine = None
+        self.bank_buckets: List[int] = []
+        self.bank_report: Optional[Dict[str, Any]] = None
+        self.weight_bytes = 0
+        self.queue: "queue.Queue[Any]" = queue.Queue(maxsize=max_queue)
+        self.lock = threading.Lock()       # guards load/unload
+        self.worker: Optional[threading.Thread] = None
+        self.latencies: "deque[float]" = deque(maxlen=_LATENCY_WINDOW)
+        self.requests = 0
+        self.failures = 0
+        self.rows = 0
+        self.batches = 0
+        self.bank_hit_batches = 0
+        self.loads = 0
+
+    def stats(self) -> Dict[str, Any]:
+        lat = np.asarray(self.latencies, dtype=np.float64)
+        pct = {}
+        if lat.size:
+            pct = {"p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+                   "p95_ms": round(float(np.percentile(lat, 95)) * 1e3, 3),
+                   "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3)}
+        return {"loaded": self.model is not None, "pinned": self.pinned,
+                "requests": self.requests, "failures": self.failures,
+                "rows": self.rows, "batches": self.batches,
+                "bankBuckets": list(self.bank_buckets),
+                "bankHitBatches": self.bank_hit_batches,
+                "weightBytes": self.weight_bytes,
+                "queueDepth": self.queue.qsize(), "loads": self.loads,
+                **pct}
+
+
+class ModelServer:
+    """N models behind a weighted LRU, one micro-batching worker each.
+
+    ``capacity_bytes`` bounds the summed program-bank weight of loaded
+    models (``max_models`` bounds their count); the least-recently-used
+    reloadable model is unloaded when either bound is crossed and
+    transparently reloaded on its next request. ``batch_deadline_s`` is
+    the micro-batching hold; ``slo_ms`` (optional) scores each request
+    against a latency SLO in stats and telemetry."""
+
+    def __init__(self, max_models: int = DEFAULT_MAX_MODELS,
+                 capacity_bytes: Optional[int] = None,
+                 batch_deadline_s: float = DEFAULT_BATCH_DEADLINE_MS / 1e3,
+                 max_queue: int = DEFAULT_MAX_QUEUE,
+                 slo_ms: Optional[float] = None,
+                 bucket_cap: Optional[int] = None):
+        if max_models < 1:
+            raise ValueError(f"max_models must be >= 1, got {max_models}")
+        self.max_models = int(max_models)
+        self.capacity_bytes = (None if capacity_bytes is None
+                               else int(capacity_bytes))
+        self.batch_deadline_s = max(float(batch_deadline_s), 0.0)
+        self.max_queue = int(max_queue)
+        self.slo_ms = None if slo_ms is None else float(slo_ms)
+        self.bucket_cap = bucket_cap
+        #: LRU order: oldest first; touched on every submit
+        self._entries: "OrderedDict[str, _ModelEntry]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # -- registration / LRU ------------------------------------------------
+    def register(self, name: str, model_dir: Optional[str] = None,
+                 bank_dir: Optional[str] = None,
+                 model: Any = None, preload: bool = False) -> None:
+        """Register a tenant: either a saved-model directory (evictable,
+        reloaded on demand) or a live ``WorkflowModel`` (pinned).
+        ``bank_dir`` names the export directory carrying the AOT program
+        bank (aot.py); ``preload`` loads immediately instead of on first
+        request."""
+        if model is None and model_dir is None:
+            raise ValueError("register() needs model_dir or model")
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("server is shut down")
+            if name in self._entries:
+                raise ValueError(f"model {name!r} already registered")
+            entry = _ModelEntry(name, model_dir, bank_dir, model,
+                                self.max_queue)
+            entry.worker = threading.Thread(
+                target=self._worker_loop, args=(entry,),
+                name=f"serve-{name}", daemon=True)
+            self._entries[name] = entry
+        entry.worker.start()
+        if preload or model is not None:
+            self._ensure_loaded(entry)
+
+    def models(self) -> List[str]:
+        with self._lock:
+            return list(self._entries)
+
+    def _ensure_loaded(self, entry: _ModelEntry):
+        """Load (or reload) the entry's model + engine + bank; evict LRU
+        models over capacity. Engine is built ``gate_bandwidth=False``
+        (a serving loop amortizes every compile immediately) and
+        ``mesh=False`` (banked executables are unsharded — see aot.py).
+
+        Returns ``(model, engine, bank_buckets)`` captured UNDER the
+        entry lock: a dispatch must score through these locals, never
+        through ``entry.model``/``entry.engine``, because a concurrent
+        LRU eviction may null the entry's slots mid-dispatch — the
+        captured references keep the objects alive until the batch
+        completes."""
+        with entry.lock:
+            if entry.model is None:
+                from .workflow import WorkflowModel
+                with telemetry.span("server:load_model",
+                                    model=entry.name):
+                    entry.model = WorkflowModel.load(entry.model_dir)
+                entry.loads += 1
+                _tally("model_loads")
+                telemetry.counter("server.model_loads").inc()
+            if entry.engine is None:
+                kw: Dict[str, Any] = {"gate_bandwidth": False,
+                                      "mesh": False}
+                if self.bucket_cap:
+                    kw["bucket_cap"] = int(self.bucket_cap)
+                entry.engine = entry.model.scoring_engine(**kw)
+                if entry.engine is not None and entry.bank_dir:
+                    report = aot.load_program_bank(entry.engine,
+                                                   entry.bank_dir)
+                    entry.bank_report = report
+                    entry.bank_buckets = list(report["loaded"])
+                    if report["loaded"]:
+                        _tally("bank_loads")
+                entry.weight_bytes = self._entry_weight(entry)
+            captured = (entry.model, entry.engine,
+                        list(entry.bank_buckets))
+        self._evict_over_capacity(keep=entry.name)
+        return captured
+
+    def _entry_weight(self, entry: _ModelEntry) -> int:
+        """LRU weight: the bank's serialized-program bytes (the dominant
+        resident cost of a served model — compiled executables), else a
+        1 MiB floor so bankless models still count against capacity."""
+        manifest, _ = (aot.read_manifest(entry.bank_dir)
+                       if entry.bank_dir else (None, []))
+        return max(aot.bank_bytes(manifest), 1 << 20)
+
+    def _evict_over_capacity(self, keep: str) -> None:
+        while True:
+            victim = None
+            with self._lock:
+                loaded = [e for e in self._entries.values()
+                          if e.model is not None and not e.pinned]
+                n_loaded = sum(1 for e in self._entries.values()
+                               if e.model is not None)
+                total = sum(e.weight_bytes for e in self._entries.values()
+                            if e.model is not None)
+                over = (n_loaded > self.max_models
+                        or (self.capacity_bytes is not None
+                            and total > self.capacity_bytes))
+                if over:
+                    for e in loaded:         # LRU order: oldest first
+                        if e.name != keep and e.queue.qsize() == 0:
+                            victim = e
+                            break
+            if victim is None:
+                return
+            with victim.lock:
+                if victim.model is None:
+                    continue
+                logger.info("server: evicting %s (LRU, %d bytes)",
+                            victim.name, victim.weight_bytes)
+                victim.model = None
+                victim.engine = None
+                victim.bank_buckets = []
+                _tally("model_evictions")
+                telemetry.counter("server.model_evictions").inc()
+
+    # -- request entry -----------------------------------------------------
+    def submit(self, name: str, records: List[Dict[str, Any]]):
+        """Enqueue a scoring request; returns a
+        ``concurrent.futures.Future[RequestResult]``. Raises
+        :class:`ModelNotFound` / :class:`ServerBusy` /
+        :class:`ServerClosed` synchronously (admission control)."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("server is shut down")
+            entry = self._entries.get(name)
+            if entry is not None:
+                self._entries.move_to_end(name)    # LRU touch
+        if entry is None:
+            raise ModelNotFound(f"no model {name!r} registered "
+                                f"(have: {self.models()})")
+        req = _Request(records)
+        try:
+            entry.queue.put_nowait(req)
+        except queue.Full:
+            _tally("rejected")
+            telemetry.counter("server.rejected").inc()
+            raise ServerBusy(
+                f"model {name!r} queue is full ({self.max_queue} "
+                "pending) — back off and retry") from None
+        if telemetry.enabled():
+            telemetry.gauge(f"server.queue_depth.{name}").set(
+                entry.queue.qsize())
+        return req.future
+
+    def score(self, name: str, records: List[Dict[str, Any]],
+              timeout_s: Optional[float] = 30.0) -> RequestResult:
+        """Synchronous convenience wrapper over :meth:`submit`."""
+        return self.submit(name, records).result(timeout=timeout_s)
+
+    # -- micro-batching worker ---------------------------------------------
+    def _worker_loop(self, entry: _ModelEntry) -> None:
+        from .scoring import DEFAULT_BUCKET_CAP
+        cap = int(self.bucket_cap or DEFAULT_BUCKET_CAP)
+        stop = False
+        while not stop:
+            item = entry.queue.get()
+            if item is _SENTINEL:
+                break
+            batch: List[_Request] = [item]
+            rows = item.rows
+            deadline = time.perf_counter() + self.batch_deadline_s
+            # dynamic micro-batching: hold the dispatch open until the
+            # deadline (or the bucket cap) for co-riding requests
+            while rows < cap:
+                wait = deadline - time.perf_counter()
+                if wait <= 0:
+                    break
+                try:
+                    nxt = entry.queue.get(timeout=wait)
+                except queue.Empty:
+                    break
+                if nxt is _SENTINEL:
+                    stop = True        # drain this batch, then exit
+                    break
+                batch.append(nxt)
+                rows += nxt.rows
+            self._dispatch(entry, batch)
+        # drain anything still queued after the sentinel (shutdown
+        # promises no request is dropped)
+        leftovers: List[_Request] = []
+        while True:
+            try:
+                item = entry.queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _SENTINEL:
+                leftovers.append(item)
+        if leftovers:
+            self._dispatch(entry, leftovers)
+
+    def _dispatch(self, entry: _ModelEntry, batch: List[_Request]) -> None:
+        """Score one coalesced micro-batch and scatter results back.
+        Tier ladder: compiled engine (breaker-governed) → per-request
+        host fallback → quarantine + per-future error. Never raises."""
+        from .scoring import DEFAULT_BUCKET_CAP, bucket_for
+        try:
+            # model/engine captured under the entry lock: a concurrent
+            # LRU eviction nulling entry.model mid-dispatch must not
+            # touch THIS batch (the locals keep the objects alive)
+            model, eng, bank_buckets = self._ensure_loaded(entry)
+        except Exception as e:  # lint: broad-except — a model that cannot load must fail ITS requests, not the server
+            logger.exception("server: loading %s failed", entry.name)
+            for req in batch:
+                if not req.future.set_running_or_notify_cancel():
+                    continue
+                req.future.set_exception(e)
+            return
+        records = [r for req in batch for r in req.records]
+        n = len(records)
+        cap = eng.bucket_cap if eng is not None \
+            else (self.bucket_cap or DEFAULT_BUCKET_CAP)
+        bucket = bucket_for(n, int(cap)) if n else 0
+        t0 = time.perf_counter()
+        store = None
+        engine_tier = False
+        brk = model._engine_breaker()
+        if n and eng is not None and brk.allow():
+            try:
+                resilience.inject("server.dispatch", model=entry.name,
+                                  rows=n, requests=len(batch))
+                with telemetry.span("server:dispatch", model=entry.name,
+                                    rows=n, requests=len(batch),
+                                    bucket=bucket):
+                    store = eng.score_store(records, use_cache=False)
+                brk.record_success()
+                engine_tier = True
+            except Exception:  # lint: broad-except — breaker-governed device-tier fallback (per-request host retry follows)
+                brk.record_failure()
+                logger.exception(
+                    "server: engine dispatch for %s failed; batch "
+                    "retries per request on the host path", entry.name)
+                store = None
+        entry.batches += 1
+        _tally("batches")
+        _tally("rows", n)
+        bank_hit = engine_tier and bucket in bank_buckets
+        if bank_hit:
+            entry.bank_hit_batches += 1
+            _tally("bank_hit_batches")
+        if len(batch) > 1:
+            _tally("coalesced_requests", len(batch))
+        telemetry.counter("server.batches").inc()
+        lo = 0
+        for req in batch:
+            if not req.future.set_running_or_notify_cancel():
+                lo += req.rows
+                continue
+            if store is not None:
+                sub = store.take(np.arange(lo, lo + req.rows))
+                lo += req.rows
+                self._complete(entry, req, sub, bucket, len(batch),
+                               engine_tier)
+                continue
+            # per-request host fallback: the dispatch site fires again
+            # (a solo retry IS a dispatch), so chaos plans can poison
+            # individual requests deterministically
+            try:
+                resilience.inject("server.dispatch", model=entry.name,
+                                  rows=req.rows, requests=1)
+                sub = model.score(req.records, engine=False)
+            except Exception as e:  # lint: broad-except — both tiers rejected: the request is poison, quarantined not fatal
+                resilience.quarantine(
+                    "server.dispatch", repr(e), kind="batches",
+                    model=entry.name, rows=req.rows,
+                    records=req.records)
+                _tally("quarantined_requests")
+                _tally("requests_failed")
+                entry.failures += 1
+                telemetry.counter("server.requests_failed").inc()
+                seconds = time.perf_counter() - req.t_enqueued
+                telemetry.emit("request", model=entry.name,
+                               rows=req.rows, seconds=seconds, ok=False,
+                               coalesced=len(batch), bucket=bucket,
+                               slo_met=self._slo(seconds))
+                req.future.set_exception(e)
+                continue
+            self._complete(entry, req, sub, bucket, len(batch), False)
+
+    def _slo(self, seconds: float) -> Optional[bool]:
+        if self.slo_ms is None:
+            return None
+        met = seconds * 1e3 <= self.slo_ms
+        _tally("slo_met" if met else "slo_missed")
+        return met
+
+    def _complete(self, entry: _ModelEntry, req: _Request, store,
+                  bucket: int, coalesced: int, engine_tier: bool) -> None:
+        seconds = time.perf_counter() - req.t_enqueued
+        entry.requests += 1
+        entry.rows += req.rows
+        entry.latencies.append(seconds)
+        _tally("requests")
+        telemetry.counter("server.requests").inc()
+        telemetry.counter("server.rows_scored").inc(req.rows)
+        if telemetry.enabled():
+            telemetry.histogram(
+                f"server.request_seconds.{entry.name}").observe(seconds)
+            telemetry.gauge(f"server.queue_depth.{entry.name}").set(
+                entry.queue.qsize())
+        slo_met = self._slo(seconds)
+        telemetry.emit("request", model=entry.name, rows=req.rows,
+                       seconds=seconds, ok=True, coalesced=coalesced,
+                       bucket=bucket, slo_met=slo_met)
+        req.future.set_result(RequestResult(
+            store=store, rows=req.rows, bucket=bucket,
+            coalesced=coalesced, seconds=seconds,
+            engine_tier=engine_tier))
+
+    # -- stats / shutdown --------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """This server's view: global tallies + per-model stats (incl.
+        exact p50/p95/p99 over the latency window)."""
+        with self._lock:
+            entries = list(self._entries.items())
+        return {"server": server_stats(),
+                "sloMs": self.slo_ms,
+                "batchDeadlineMs": self.batch_deadline_s * 1e3,
+                "models": {name: e.stats() for name, e in entries}}
+
+    def shutdown(self, drain: bool = True,
+                 timeout_s: Optional[float] = 30.0) -> None:
+        """Stop accepting requests and stop the workers. With ``drain``
+        (the default) every queued request is scored before its worker
+        exits — graceful shutdown never drops accepted work. Without
+        it, pending futures fail with :class:`ServerClosed`."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            entries = list(self._entries.values())
+        for e in entries:
+            if not drain:
+                # fail queued requests loudly instead of scoring them
+                while True:
+                    try:
+                        item = e.queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is not _SENTINEL and \
+                            item.future.set_running_or_notify_cancel():
+                        item.future.set_exception(
+                            ServerClosed("server shut down (no drain)"))
+            e.queue.put(_SENTINEL)
+        for e in entries:
+            if e.worker is not None:
+                e.worker.join(timeout=timeout_s)
+
+
+# ---------------------------------------------------------------------------
+# stdlib HTTP front end
+# ---------------------------------------------------------------------------
+
+
+def _store_rows(store) -> List[Dict[str, Any]]:
+    return [{nm: store[nm].get_raw(i) for nm in store.names()}
+            for i in range(store.n_rows)]
+
+
+def serve_http(server: ModelServer, host: str = "127.0.0.1",
+               port: int = 8000, request_timeout_s: float = 30.0):
+    """Start the stdlib HTTP front end on a daemon thread; returns the
+    ``ThreadingHTTPServer`` (``.server_address`` carries the bound port;
+    ``.shutdown()`` stops it). No dependencies beyond the stdlib."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):   # route through logging
+            logger.debug("http: " + fmt, *args)
+
+        def _send(self, code: int, doc: Dict[str, Any]) -> None:
+            body = json.dumps(doc, default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                return self._send(200, {"status": "ok",
+                                        "models": server.models()})
+            if self.path == "/stats":
+                return self._send(200, server.stats())
+            if self.path == "/v1/models":
+                return self._send(200, {"models": server.stats()["models"]})
+            return self._send(404, {"error": f"no route {self.path!r}"})
+
+        def do_POST(self):
+            path = self.path
+            if not (path.startswith("/v1/models/")
+                    and path.endswith(":score")):
+                return self._send(404, {"error": f"no route {path!r}"})
+            name = path[len("/v1/models/"):-len(":score")]
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(length) or b"{}")
+                records = doc.get("records")
+                if not isinstance(records, list) or not records:
+                    return self._send(400, {
+                        "error": "body must be {\"records\": [..]} with "
+                                 "at least one record"})
+                res = server.submit(name, records).result(
+                    timeout=request_timeout_s)
+            except ModelNotFound as e:
+                return self._send(404, {"error": str(e)})
+            except ServerBusy as e:
+                return self._send(429, {"error": str(e)})
+            except ServerClosed as e:
+                return self._send(503, {"error": str(e)})
+            except json.JSONDecodeError as e:
+                return self._send(400, {"error": f"bad JSON body: {e}"})
+            except Exception as e:  # lint: broad-except — HTTP boundary: a poison request answers 500, the server lives
+                return self._send(500, {"error": repr(e)})
+            return self._send(200, {
+                "model": name, "rows": res.rows, "bucket": res.bucket,
+                "coalesced": res.coalesced,
+                "latencyMs": round(res.seconds * 1e3, 3),
+                "engineTier": res.engine_tier,
+                "outputs": _store_rows(res.store)})
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    httpd.daemon_threads = True
+    t = threading.Thread(target=httpd.serve_forever,
+                         name="serve-http", daemon=True)
+    t.start()
+    logger.info("model server HTTP front end on %s:%d",
+                *httpd.server_address)
+    return httpd

@@ -23,12 +23,15 @@ Two export granularities:
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
 from . import telemetry
+
+logger = logging.getLogger(__name__)
 
 __all__ = ["export_prediction_fn", "load_prediction_fn",
            "export_scoring_fn", "load_scoring_fn"]
@@ -204,7 +207,10 @@ def _block_key(spec: Dict[str, Any]) -> str:
 
 
 def export_scoring_fn(model, path: str, sample_data,
-                      bucket_cap: Optional[int] = None) -> Dict[str, Any]:
+                      bucket_cap: Optional[int] = None,
+                      aot: bool = True,
+                      aot_ladder: Optional[List[int]] = None
+                      ) -> Dict[str, Any]:
     """Export the FULL fused transform→predict chain as StableHLO.
 
     Requires every stage between the prepared host blocks and the result
@@ -216,14 +222,27 @@ def export_scoring_fn(model, path: str, sample_data,
     to discover the prepared-block manifest; the exported program is
     batch-size polymorphic over the row dimension. Returns the metadata
     dict (manifest + outputs) written alongside the artifact.
-    """
+
+    With ``aot`` (the default) the whole power-of-two bucket ladder is
+    additionally compiled ahead of time and shipped as serialized
+    executables under ``aot_bank/`` (aot.py): a cold process that loads
+    the export answers its first request without paying a single XLA
+    compile. The model's attached ExecutionPlan — CSE merges,
+    dead-column pruning — is baked into both the StableHLO and the
+    banked programs. ``aot_ladder`` restricts the banked buckets (the
+    full ladder otherwise). Whatever ``aot`` says, the export metadata
+    records the bucket ladder, plan/state digests and the jax/jaxlib +
+    device environment, so ``load_scoring_fn`` can warn on version skew
+    even for bankless artifacts."""
     import jax
     import jax.numpy as jnp
     from jax import export as jexport
 
-    from .scoring import ScoringEngine
+    from . import aot as aot_mod
+    from .scoring import ScoringEngine, bucket_ladder
 
     eng = ScoringEngine(model, gate_bandwidth=False,
+                        plan=getattr(model, "_execution_plan", None),
                         **({"bucket_cap": bucket_cap} if bucket_cap else {}))
     if not eng.covers_prediction:
         raise ValueError(
@@ -265,14 +284,64 @@ def export_scoring_fn(model, path: str, sample_data,
             "fusedStages": eng.fused_stage_count,
             "inputs": manifest,
             "resultFeatures": out_names,
+            # environment + identity stamps (written whether or not a
+            # program bank ships): load_scoring_fn compares these and
+            # WARNS on skew instead of failing silently mid-request
+            "bucketCap": int(eng.bucket_cap),
+            "bucketLadder": bucket_ladder(eng.bucket_cap),
+            "planDigest": eng.rewrite_digest(),
+            "stateDigest": eng.state_digest(),
+            "environment": aot_mod.environment_fingerprint(),
             **_blob_fingerprint(payload)}
+    bank = None
+    if aot:
+        bank = aot_mod.build_program_bank(
+            eng, manifest, out_names, path, ladder=aot_ladder)
+    if bank is None:
+        # never leave a STALE bank (a previous export's weights) next
+        # to freshly written StableHLO/meta
+        aot_mod.remove_bank(path)
+        meta["aot"] = None
+    else:
+        meta["aot"] = {"programs": len(bank["programs"]),
+                       "bytes": aot_mod.bank_bytes(bank),
+                       "bucketLadder": bank["bucketLadder"]}
     with open(os.path.join(path, _SCORE_META), "w") as fh:
         json.dump(meta, fh, indent=1)
     return meta
 
 
-def load_scoring_fn(path: str) -> Callable[[Dict[str, np.ndarray]],
-                                           Dict[str, np.ndarray]]:
+def _warn_version_skew(meta: Dict[str, Any], path: str) -> None:
+    """Satellite: environment compatibility used to be silent — the blob
+    digest was checked but a jax/jaxlib skew between export and load
+    surfaced only as a cryptic deserialization error (or not at all).
+    Compare the export's recorded environment to this process and WARN
+    (TMG503 advisory, telemetry-mirrored) — never fail: StableHLO is
+    designed to be forward-loadable, so skew is a risk note, not an
+    error. Pre-stamp exports (no ``environment`` field) skip silently."""
+    want = meta.get("environment")
+    if not isinstance(want, dict):
+        return
+    from . import lint
+    from .aot import environment_fingerprint
+    env = environment_fingerprint()
+    skew = {k: (want.get(k), env[k]) for k in ("jax", "jaxlib")
+            if want.get(k) is not None and want.get(k) != env[k]}
+    if not skew:
+        return
+    detail = ", ".join(f"{k}: exported {a!r} / running {b!r}"
+                       for k, (a, b) in sorted(skew.items()))
+    f = lint.Finding("TMG503",
+                     f"serving artifact version skew ({detail}) — the "
+                     "StableHLO should still load, but re-export to "
+                     "clear the risk", location=path)
+    logger.warning("serving: %s", f.format())
+    lint.emit_findings([f])
+
+
+def load_scoring_fn(path: str, prefer_bank: bool = True
+                    ) -> Callable[[Dict[str, np.ndarray]],
+                                  Dict[str, np.ndarray]]:
     """Load a full-chain artifact → callable({block key: array}) → dict of
     output arrays. Block keys are ``"<stage uid>/<block name>"`` for
     prepared vectorizer blocks and the bare column name for direct vector
@@ -280,13 +349,63 @@ def load_scoring_fn(path: str) -> Callable[[Dict[str, np.ndarray]],
     the caller supplies host-prepared blocks (every row-leading array,
     one consistent batch size). A truncated or corrupt artifact raises a
     descriptive ``ValueError`` (size + digest checked against the export
-    metadata) instead of a raw deserialization traceback."""
+    metadata) instead of a raw deserialization traceback; a jax/jaxlib
+    version skew between export and load WARNS (TMG503) but loads.
+
+    With ``prefer_bank`` (default) and a compatible AOT program bank in
+    the export directory (aot.py), requests are zero-padded to the
+    nearest ladder bucket and dispatched through the bank's
+    pre-compiled executables — the first request pays NO XLA compile.
+    Buckets the bank lacks (and any environment mismatch, corrupt
+    program, oversized batch) fall back per-call to the StableHLO JIT
+    path — never an error."""
     with telemetry.span("serving:load_scoring_fn"):
         meta, payload = _load_verified_blob(path, _SCORE_BLOB,
                                             _SCORE_META)
         exp = _deserialize_blob(payload, path)
     telemetry.counter("serving.loads").inc()
+    _warn_version_skew(meta, path)
     manifest: List[Dict[str, Any]] = meta["inputs"]
+
+    bank_programs: Dict[int, Any] = {}
+    bank_cap = 0
+    if prefer_bank:
+        from . import aot as aot_mod
+        from . import lint
+        bank_manifest, bank_programs, findings = \
+            aot_mod.load_flat_programs(
+                path, expect_digests={
+                    "planDigest": meta.get("planDigest"),
+                    "stateDigest": meta.get("stateDigest")})
+        for f in findings:
+            logger.warning("serving: %s", f.format())
+        if findings:
+            lint.emit_findings(findings)
+        if bank_programs:
+            bank_cap = int(bank_manifest.get("bucketCap", 0))
+
+    def _bank_call(args: List[np.ndarray], n: int,
+                   bucket: int) -> Dict[str, np.ndarray]:
+        prepared: Dict[str, Dict[str, Any]] = {}
+        uploads: Dict[str, Any] = {}
+        for spec, a in zip(manifest, args):
+            if bucket != n:
+                pad = np.zeros((bucket - n,) + a.shape[1:], dtype=a.dtype)
+                a = np.concatenate([a, pad], axis=0)
+            if spec["kind"] == "prepared":
+                prepared.setdefault(spec["uid"], {})[spec["name"]] = a
+            else:
+                uploads[spec["name"]] = a
+        outs = bank_programs[bucket](prepared, uploads)
+        flat: Dict[str, np.ndarray] = {}
+        for nm, v in outs.items():
+            if isinstance(v, tuple):    # Prediction triple
+                flat[f"{nm}.prediction"] = np.asarray(v[0])[:n]
+                flat[f"{nm}.rawPrediction"] = np.asarray(v[1])[:n]
+                flat[f"{nm}.probability"] = np.asarray(v[2])[:n]
+            else:
+                flat[nm] = np.asarray(v)[:n]
+        return flat
 
     def call(blocks: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         args = []
@@ -298,10 +417,20 @@ def load_scoring_fn(path: str) -> Callable[[Dict[str, np.ndarray]],
         ns = {a.shape[0] for a in args}
         if len(ns) > 1:
             raise ValueError(f"inconsistent batch sizes across blocks: {ns}")
+        if bank_programs and args:
+            from .scoring import bucket_for
+            n = args[0].shape[0]
+            bucket = bucket_for(n, bank_cap)
+            if n <= bank_cap and bucket in bank_programs:
+                telemetry.counter("serving.bank_hits").inc()
+                return _bank_call(args, n, bucket)
+            telemetry.counter("serving.bank_misses").inc()
         out = exp.call(*args)
         flat: Dict[str, np.ndarray] = {}
         for k, v in out.items():
             flat[k] = np.asarray(v)
         return flat
 
+    call.meta = meta
+    call.bank_buckets = sorted(bank_programs)
     return call

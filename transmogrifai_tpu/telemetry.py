@@ -567,6 +567,17 @@ class RunListener:
         the cost-based middle-end's decision record."""
         pass
 
+    def on_request(self, model: str, rows: int, seconds: float,
+                   ok: bool = True, coalesced: int = 1,
+                   bucket: int = 0, slo_met: Optional[bool] = None,
+                   **_: Any) -> None:
+        """The model server completed one scoring request (server.py):
+        per-request latency, the dispatch bucket it rode in and how many
+        requests shared that dispatch (``coalesced``). ``ok`` is False
+        for quarantined/errored requests; ``slo_met`` is None when no
+        SLO is configured."""
+        pass
+
 
 _LISTENERS: List[RunListener] = []
 
@@ -633,6 +644,9 @@ class CollectingRunListener(RunListener):
         self.breaker_trips = 0
         self.lint_findings: Dict[str, int] = {}
         self.plan: Optional[Dict[str, Any]] = None
+        self.requests = 0
+        self.request_rows = 0
+        self.requests_failed = 0
         self._lock = threading.Lock()
 
     def on_run_start(self, run_type: str, **_: Any) -> None:
@@ -726,6 +740,17 @@ class CollectingRunListener(RunListener):
                          "prunedColumns": int(pruned_columns),
                          "cseMerges": int(cse_merges)}
 
+    def on_request(self, model: str, rows: int, seconds: float,
+                   ok: bool = True, coalesced: int = 1,
+                   bucket: int = 0, slo_met: Optional[bool] = None,
+                   **_: Any) -> None:
+        with self._lock:
+            self.events.append("request")
+            self.requests += 1
+            self.request_rows += int(rows)
+            if not ok:
+                self.requests_failed += 1
+
     def summary(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -747,6 +772,9 @@ class CollectingRunListener(RunListener):
                 "breakerTrips": self.breaker_trips,
                 "lintFindings": dict(self.lint_findings),
                 "plan": dict(self.plan) if self.plan else None,
+                "requests": self.requests,
+                "requestRows": self.request_rows,
+                "requestsFailed": self.requests_failed,
             }
 
 
