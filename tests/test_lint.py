@@ -591,6 +591,48 @@ def test_tmg307_thread_name_daemon_explicit():
     assert tm.lint_source(allowed) == []
 
 
+def test_tmg308_unbounded_queue():
+    """Input-pipeline rule: a queue.Queue() without maxsize= hides
+    backpressure — the staged pipeline's contract is bounded queues."""
+    tm = _load_tmoglint()
+    bad = ("import queue\n"
+           "q = queue.Queue()\n")
+    assert [f.rule for f in tm.lint_source(bad)] == ["TMG308"]
+    # from-import and aliased-module forms trigger too
+    from_import = ("from queue import Queue\n"
+                   "q = Queue()\n")
+    assert [f.rule for f in tm.lint_source(from_import)] == ["TMG308"]
+    aliased = ("import queue as _q\n"
+               "q = _q.Queue()\n")
+    assert [f.rule for f in tm.lint_source(aliased)] == ["TMG308"]
+    # an explicit bound is clean — keyword or positional
+    ok = ("import queue\n"
+          "q = queue.Queue(maxsize=64)\n")
+    assert tm.lint_source(ok) == []
+    ok_pos = ("import queue\n"
+              "q = queue.Queue(64)\n")
+    assert tm.lint_source(ok_pos) == []
+    # maxsize<=0 is UNBOUNDED in queue semantics — flagged like omission
+    zero_pos = ("import queue\n"
+                "q = queue.Queue(0)\n")
+    assert [f.rule for f in tm.lint_source(zero_pos)] == ["TMG308"]
+    zero_kw = ("import queue\n"
+               "q = queue.Queue(maxsize=0)\n")
+    assert [f.rule for f in tm.lint_source(zero_kw)] == ["TMG308"]
+    neg = ("import queue\n"
+           "q = queue.Queue(maxsize=-1)\n")
+    assert [f.rule for f in tm.lint_source(neg)] == ["TMG308"]
+    # the marker allows a deliberate unbounded queue
+    allowed = ("import queue\n"
+               "q = queue.Queue()  "
+               "# lint: unbounded-queue — drained synchronously in tests\n")
+    assert tm.lint_source(allowed) == []
+    # someone else's Queue (multiprocessing, a local class) is not ours
+    other = ("import multiprocessing\n"
+             "q = multiprocessing.Queue()\n")
+    assert tm.lint_source(other) == []
+
+
 def test_repo_is_clean_under_self_lint():
     """The meta-test: the package itself reports zero findings — the
     project invariants PRs 1-4 introduced by convention are now CI

@@ -1,0 +1,718 @@
+"""Staged asynchronous input pipeline tests (pipeline.py + its wiring).
+
+Covers the building blocks (ordered parallel map determinism, buffer
+pool reuse, prefetch autotune dynamics, the sustained-bandwidth probe),
+the scoring-engine integration (stage_batch parity, pipelined
+stream_score bit-identity at N workers), the runner/CLI knob surface
+(validated ``overlap``/``pipeline*`` customParams) and the telemetry
+``on_pipeline_stats`` hook. The worker-pool chaos coverage lives in
+tests/test_resilience.py; the directory-stream parallel-decode
+determinism in tests/test_readers.py.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import pipeline, telemetry
+from transmogrifai_tpu.pipeline import (BufferPool, PrefetchAutotuner,
+                                        map_ordered, resolve_workers)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# map_ordered — the decode/prep stage
+# ---------------------------------------------------------------------------
+
+
+def test_map_ordered_is_deterministic_across_worker_counts():
+    """N-worker output equals the serial loop in content AND order,
+    whatever the per-item latencies do to completion order."""
+    items = list(range(32))
+
+    def slow_square(i):
+        # reverse-staggered sleeps: later items finish FIRST on a pool
+        time.sleep(0.002 * (32 - i) / 32)
+        return i * i
+
+    serial = [(i, i * i, None) for i in items]
+    for workers in (1, 2, 4):
+        got = list(map_ordered(slow_square, items, workers=workers))
+        assert got == serial
+
+
+def test_map_ordered_exceptions_ride_in_order_not_raise():
+    def boom(i):
+        if i == 3:
+            raise ValueError("poison")
+        return i
+
+    got = list(map_ordered(boom, range(6), workers=3))
+    assert [g[0] for g in got] == list(range(6))
+    assert [g[1] for g in got] == [0, 1, 2, None, 4, 5]
+    assert isinstance(got[3][2], ValueError)
+    assert all(g[2] is None for i, g in enumerate(got) if i != 3)
+
+
+def test_map_ordered_abandoned_consumer_stops_submitting():
+    """Breaking out mid-stream must not drain the whole upstream
+    iterator (max_batches leaves unread files re-offered)."""
+    pulled = []
+
+    def gen():
+        for i in range(1000):
+            pulled.append(i)
+            yield i
+
+    it = map_ordered(lambda x: x, gen(), workers=2)
+    for _ in range(3):
+        next(it)
+    it.close()
+    assert len(pulled) < 50          # bounded by the in-flight depth
+
+
+def test_map_ordered_yields_ready_result_while_source_blocks():
+    """A batch that finishes while ``next(it)`` is blocked on a sparse
+    live source (a directory stream between file arrivals) must be
+    delivered immediately, not withheld until the next item arrives —
+    the feeder thread owns the blocking ``next()``."""
+    gate = threading.Event()
+
+    def gen():
+        yield 1
+        gate.wait(10.0)      # the "next file" arrives only when released
+        yield 2
+
+    it = map_ordered(lambda x: x * 10, gen(), workers=2)
+    t0 = time.perf_counter()
+    assert next(it) == (1, 10, None)
+    assert time.perf_counter() - t0 < 5.0    # didn't wait out the gate
+    gate.set()
+    assert next(it) == (2, 20, None)
+    assert list(it) == []
+
+
+def test_map_ordered_worker_threads_are_named():
+    names = set()
+
+    def grab(i):
+        names.add(threading.current_thread().name)
+        return i
+
+    list(map_ordered(grab, range(8), workers=2, name="decode-test"))
+    assert names and all(n.startswith("decode-test") for n in names)
+
+
+def test_slow_source_does_not_count_as_starvation():
+    """A source-bound stream (items arrive slower than they decode)
+    must not ratchet the prefetch depth: the consumer's wait is the
+    SOURCE's fault, and extra depth cannot make items arrive faster."""
+    tuner = PrefetchAutotuner(max_depth=8)
+    d0 = tuner.depth()
+
+    def slow_source():
+        for i in range(8):
+            time.sleep(0.02)
+            yield i
+
+    got = list(map_ordered(lambda i: i * i, slow_source(), workers=2,
+                           tuner=tuner))
+    assert [g[0] for g in got] == list(range(8))
+    assert [g[1] for g in got] == [i * i for i in range(8)]
+    assert tuner.starvations == 0
+    assert tuner.depth() == d0
+
+
+def test_slow_workers_still_count_as_starvation():
+    """The flip side: with a fast source and slow work, the pipeline IS
+    the bottleneck and starvations must still register."""
+    tuner = PrefetchAutotuner(max_depth=8)
+
+    def fast_source():
+        yield from range(6)
+
+    def slow_work(i):
+        time.sleep(0.03)
+        return i
+
+    got = list(map_ordered(slow_work, fast_source(), workers=1,
+                           tuner=tuner))
+    assert [g[0] for g in got] == list(range(6))
+    assert tuner.starvations >= 1
+
+
+def test_resolve_workers():
+    assert resolve_workers(3) == 3
+    assert resolve_workers(0) == 1
+    assert resolve_workers(None) == pipeline.DEFAULT_WORKERS
+
+
+def test_kill_switch_forces_serial_directory_stream(monkeypatch,
+                                                    tmp_path):
+    """TMOG_PIPELINE=0 must not be overridable by an explicit
+    ``stream(workers=N)``: the parallel pool never spins up and the
+    batches still flow (serially)."""
+    from transmogrifai_tpu.readers.avro import write_avro_records
+    from transmogrifai_tpu.readers.streaming import DirectoryStreamReader
+
+    rows = [{"a": float(i)} for i in range(20)]
+    write_avro_records(str(tmp_path / "p0.avro"), rows)
+    monkeypatch.setattr(pipeline, "PIPELINE_ENABLED", False)
+    assert resolve_workers(4) == 1
+    r = DirectoryStreamReader(str(tmp_path), poll_interval_s=0.05,
+                              settle_s=0.0)
+
+    def boom(*a, **k):
+        raise AssertionError("parallel pool spun up under "
+                             "TMOG_PIPELINE=0")
+
+    monkeypatch.setattr(r, "_stream_parallel", boom)
+    got = list(r.stream(max_batches=1, timeout_s=5, workers=4))
+    assert len(got) == 1
+    assert [dict(x) for x in got[0]] == rows
+
+
+# ---------------------------------------------------------------------------
+# BufferPool — pinned-buffer reuse
+# ---------------------------------------------------------------------------
+
+
+def test_buffer_pool_reuses_and_pads_bit_identically():
+    pool = BufferPool()
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    taken = []
+    padded = pool.pad_rows(a, 3, 8, taken)
+    assert padded.shape == (8, 4)
+    np.testing.assert_array_equal(padded[:3], a)
+    assert not padded[3:].any()
+    # the reference padding (fresh-allocation path) is bit-identical
+    ref = np.concatenate([a, np.zeros((5, 4), np.float32)])
+    np.testing.assert_array_equal(padded, ref)
+    assert taken == [padded]
+    pool.give(padded)
+    again = pool.take((8, 4), np.float32)
+    assert again is padded           # recycled, not reallocated
+    assert pool.reuses == 1 and pool.allocs == 1
+    # different shape/dtype never collide
+    other = pool.take((8, 4), np.float64)
+    assert other is not padded
+
+
+def test_buffer_pool_passthrough_for_constants_and_full_buckets():
+    pool = BufferPool()
+    taken = []
+    const = np.asarray(3.0, np.float32)   # 0-d fitted constant
+    assert pool.pad_rows(const, 4, 8, taken) is const
+    full = np.zeros((8, 2), np.float32)
+    assert pool.pad_rows(full, 8, 8, taken) is full
+    assert taken == []
+
+
+def test_buffer_pool_bounded_per_key():
+    pool = BufferPool(max_per_key=2)
+    bufs = [pool.take((4,), np.float32) for _ in range(5)]
+    for b in bufs:
+        pool.give(b)
+    assert pool.free_buffers() == 2
+
+
+# ---------------------------------------------------------------------------
+# PrefetchAutotuner
+# ---------------------------------------------------------------------------
+
+
+def test_autotuner_grows_on_starvation_and_shrinks_when_calm():
+    t = PrefetchAutotuner(min_depth=2, max_depth=4, window=2)
+    assert t.depth() == 2
+    # a starved window grows
+    t.record_starvation()
+    t.on_batch()
+    t.on_batch()
+    assert t.depth() == 3
+    # growth is capped at max_depth
+    for _ in range(4):
+        t.record_starvation()
+        t.on_batch()
+        t.on_batch()
+    assert t.depth() == 4
+    # two calm windows shrink one step
+    for _ in range(4):
+        t.on_batch()
+    assert t.depth() == 3
+    assert t.grows >= 2 and t.shrinks == 1
+
+
+def test_autotuner_never_leaves_bounds_and_cap_below_floor_wins():
+    t = PrefetchAutotuner(min_depth=2, max_depth=8, window=1)
+    for _ in range(50):
+        t.on_batch()
+    assert t.depth() == 2            # floor holds
+    # pipelineDepth: 1 forces serial prefetch — the cap wins
+    t1 = PrefetchAutotuner(max_depth=1)
+    assert t1.depth() == 1
+    t1.record_starvation()
+    t1.on_batch()
+    for _ in range(8):
+        t1.on_batch()
+    assert t1.depth() == 1
+
+
+def test_map_ordered_depth_follows_tuner():
+    """With a depth-1 tuner only one item is ever in flight ahead."""
+    tuner = PrefetchAutotuner(max_depth=1)
+    pulled = []
+
+    def gen():
+        for i in range(10):
+            pulled.append(i)
+            yield i
+
+    it = map_ordered(lambda x: x, gen(), workers=4, tuner=tuner)
+    next(it)
+    assert len(pulled) <= 2
+    it.close()
+
+
+# ---------------------------------------------------------------------------
+# sustained-bandwidth probe + fusion gate evidence
+# ---------------------------------------------------------------------------
+
+
+def test_probe_sustained_mbps_positive_and_tallied():
+    mbps = pipeline.probe_sustained_mbps(n_transfers=4, buf_mb=1)
+    assert mbps > 0
+    assert pipeline.pipeline_stats()["sustained_mbps"] == round(mbps, 1)
+
+
+def test_fusion_state_carries_probe_and_sustained(monkeypatch):
+    from transmogrifai_tpu import workflow as wf
+    monkeypatch.setattr(wf, "_DEVICE_BW_MBPS", 750.0)
+    monkeypatch.setattr(wf, "_DEVICE_BW_PROBE_MBPS", 23.0)
+    st = wf.fusion_state()
+    assert st["fusion"] == "ON"               # sustained clears the gate
+    assert st["sustained_mbps"] == 750.0
+    assert st["mbps"] == 23.0                 # the cold probe stays visible
+
+
+def test_device_roundtrip_uses_sustained_measurement(monkeypatch):
+    from transmogrifai_tpu import workflow as wf
+    monkeypatch.setattr(wf, "_DEVICE_BW_MBPS", None)
+    monkeypatch.setattr(wf, "_DEVICE_BW_PROBE_MBPS", None)
+    monkeypatch.setattr(telemetry, "probe_device_roundtrip_mbps",
+                        lambda: 23.0)
+    monkeypatch.setattr(pipeline, "probe_sustained_mbps", lambda: 900.0)
+    assert wf.device_roundtrip_mbps() == 900.0
+    assert wf._DEVICE_BW_PROBE_MBPS == 23.0
+    st = wf.fusion_state()
+    assert st["sustained_mbps"] == 900.0 and st["mbps"] == 23.0
+
+
+def test_cost_db_records_both_bandwidth_numbers(tmp_path):
+    from transmogrifai_tpu import planner
+    db = planner.CostDatabase.load(str(tmp_path / "db.json"))
+    db.record_bandwidth(850.0, probe_mbps=23.4)
+    db.save()
+    db2 = planner.CostDatabase.load(str(tmp_path / "db.json"))
+    assert db2.bandwidth_mbps() == 850.0      # the tier-deciding number
+    assert db2.doc["probe_mbps"] == 23.4
+
+
+# ---------------------------------------------------------------------------
+# scoring-engine integration
+# ---------------------------------------------------------------------------
+
+
+def _binary_model(rng, n=240):
+    from transmogrifai_tpu import ColumnStore, FeatureBuilder, Workflow
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import \
+        BinaryClassificationModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+
+    y = rng.integers(0, 2, n).astype(float)
+    x1 = rng.normal(size=n) + y
+    x2 = rng.normal(size=n)
+    records = [{"label": float(y[i]), "x1": float(x1[i]),
+                "x2": float(x2[i])} for i in range(n)]
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x1").from_column().as_predictor()
+    f2 = FeatureBuilder.Real("x2").from_column().as_predictor()
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None,
+        seed=7)
+    pred = label.transform_with(selector, transmogrify([f1, f2]))
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    return model, records, pred
+
+
+def test_stage_batch_is_bit_identical_to_unstaged(rng):
+    """The double-buffered upload stage (program pre-resolved, blocks
+    device_put ahead of dispatch) must change nothing downstream."""
+    model, records, pred = _binary_model(rng)
+    eng = model.scoring_engine(gate_bandwidth=False)
+    prep = eng.prepare_batch(records, use_cache=False)
+    plain = eng.run_batch(prep, results_only=True)
+    prep2 = eng.prepare_batch(records, use_cache=False)
+    staged = eng.stage_batch(prep2, results_only=True)
+    out = eng.run_batch(staged, results_only=True)
+    np.testing.assert_array_equal(out[pred.name].probability,
+                                  plain[pred.name].probability)
+    np.testing.assert_array_equal(out[pred.name].prediction,
+                                  plain[pred.name].prediction)
+
+
+def test_stage_batch_results_only_mismatch_is_loud(rng):
+    model, records, _pred = _binary_model(rng, n=64)
+    eng = model.scoring_engine(gate_bandwidth=False)
+    staged = eng.stage_batch(eng.prepare_batch(records, use_cache=False),
+                             results_only=True)
+    with pytest.raises(ValueError, match="results_only mismatch"):
+        eng.run_batch(staged, results_only=False)
+
+
+def test_pooled_prepare_releases_buffers_after_run(rng):
+    model, records, pred = _binary_model(rng, n=100)
+    eng = model.scoring_engine(gate_bandwidth=False)
+    pool = BufferPool()
+    prep = eng.prepare_batch(records, use_cache=False, pool=pool)
+    assert prep.buffers                       # padding went through the pool
+    n_taken = len(prep.buffers)
+    baseline = eng.run_batch(eng.prepare_batch(records, use_cache=False),
+                             results_only=True)
+    out = eng.run_batch(eng.stage_batch(prep, results_only=True),
+                        results_only=True)
+    np.testing.assert_array_equal(out[pred.name].probability,
+                                  baseline[pred.name].probability)
+    assert pool.free_buffers() == n_taken     # recycled after the pull
+    # the next pooled prepare reuses instead of reallocating
+    prep3 = eng.prepare_batch(records, use_cache=False, pool=pool)
+    assert pool.reuses >= n_taken
+    prep3.release()
+    prep3.release()                           # idempotent
+
+
+def test_pipelined_stream_bit_identical_across_worker_counts(rng):
+    """The acceptance bit: pipelined streaming score (N prep workers,
+    autotuned prefetch, staged uploads) equals the serial engine path
+    EXACTLY, in batch order and bytes."""
+    from transmogrifai_tpu.readers import stream_score
+
+    model, records, pred = _binary_model(rng, n=320)
+    batches = [records[i:i + 40] for i in range(0, 320, 40)]
+    eng = model.scoring_engine(gate_bandwidth=False)
+    want = [eng.score_store(list(b), use_cache=False)[pred.name]
+            for b in batches]
+    for workers in (1, 2, 4):
+        got = list(stream_score(model, batches, overlap=True,
+                                workers=workers))
+        assert len(got) == len(batches)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g[pred.name].probability,
+                                          w.probability)
+            np.testing.assert_array_equal(g[pred.name].prediction,
+                                          w.prediction)
+
+
+def test_mid_stream_source_error_flushes_prepped_batches(rng):
+    """A batch source that dies mid-stream must not swallow batches
+    already decoded: the pipelined path yields every pre-error batch
+    (exactly as the serial path scores them before raising) BEFORE
+    surfacing the source exception — the staged one-batch skew may not
+    drop the last prepped batch."""
+    from transmogrifai_tpu.readers import stream_score
+
+    model, records, pred = _binary_model(rng, n=160)
+    batches = [records[i:i + 40] for i in range(0, 160, 40)]
+    eng = model.scoring_engine(gate_bandwidth=False)
+    want = [eng.score_store(list(b), use_cache=False)[pred.name]
+            for b in batches]
+
+    def dying_source():
+        for b in batches:
+            yield b
+        raise RuntimeError("poll blew up")
+
+    got = []
+    with pytest.raises(RuntimeError, match="poll blew up"):
+        for s in stream_score(model, dying_source(), overlap=True,
+                              workers=2):
+            got.append(s)
+    assert len(got) == len(batches)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g[pred.name].probability,
+                                      w.probability)
+        np.testing.assert_array_equal(g[pred.name].prediction,
+                                      w.prediction)
+
+
+def test_pipelined_stream_records_stats_and_listener(rng):
+    from transmogrifai_tpu.readers import stream_score
+
+    class Grab(telemetry.RunListener):
+        def __init__(self):
+            self.seen = []
+
+        def on_pipeline_stats(self, **kw):
+            self.seen.append(kw)
+
+    model, records, _pred = _binary_model(rng, n=160)
+    batches = [records[i:i + 40] for i in range(0, 160, 40)]
+    before = pipeline.pipeline_stats()
+    telemetry.enable()
+    grab = telemetry.add_listener(Grab())
+    collector = telemetry.add_listener(telemetry.CollectingRunListener())
+    try:
+        list(stream_score(model, batches, overlap=True, workers=2,
+                          prefetch=4))
+    finally:
+        telemetry.remove_listener(grab)
+        telemetry.remove_listener(collector)
+    after = pipeline.pipeline_stats()
+    assert after["streams"] == before["streams"] + 1
+    assert after["batches"] == before["batches"] + 4
+    assert after["last_workers"] == 2
+    assert after["last_prefetch_depth"] >= 1
+    assert grab.seen and grab.seen[0]["batches"] == 4 \
+        and grab.seen[0]["workers"] == 2
+    summary = collector.summary()
+    assert summary["pipeline"]["streams"] == 1
+    assert summary["pipeline"]["batches"] == 4
+
+
+@pytest.mark.chaos
+def test_staged_upload_fault_falls_back_to_host_not_quarantine(rng):
+    """A pipeline.upload fault is a TIER failure: the batch retries on
+    the host path, the breaker hears about it, nothing is quarantined."""
+    from transmogrifai_tpu import resilience
+    from transmogrifai_tpu.readers import stream_score
+
+    resilience.reset_breakers()
+    resilience.reset_resilience_stats()
+    model, records, pred = _binary_model(rng, n=160)
+    batches = [records[i:i + 40] for i in range(0, 160, 40)]
+    clean = [s[pred.name].probability.copy()
+             for s in stream_score(model, batches, overlap=True)]
+    plan = resilience.FaultPlan(seed=3).on("pipeline.upload",
+                                           error=IOError, at=[1])
+    with resilience.fault_plan(plan):
+        got = [s[pred.name].probability.copy()
+               for s in stream_score(model, batches, overlap=True,
+                                     workers=2)]
+    assert len(got) == len(clean)             # no batch lost
+    for g, w in zip(got, clean):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+    stats = resilience.resilience_stats()
+    assert stats["quarantined_batches"] == 0
+    resilience.reset_breakers()
+
+
+# ---------------------------------------------------------------------------
+# runner + CLI knob surface
+# ---------------------------------------------------------------------------
+
+
+def test_bool_custom_param_validates_and_names_key():
+    from transmogrifai_tpu.runner import OpParams, _bool_custom_param
+
+    p = OpParams(custom_params={"overlap": "TRUE", "pipeline": False,
+                                "bad": "yes"})
+    assert _bool_custom_param(p, "overlap", allow_auto=True) is True
+    assert _bool_custom_param(p, "pipeline") is False
+    assert _bool_custom_param(p, "absent", default="auto",
+                              allow_auto=True) == "auto"
+    with pytest.raises(ValueError, match="customParams.bad"):
+        _bool_custom_param(p, "bad")
+    # "auto" only where the knob is tri-state
+    p2 = OpParams(custom_params={"pipeline": "auto"})
+    with pytest.raises(ValueError, match="customParams.pipeline"):
+        _bool_custom_param(p2, "pipeline")
+
+
+def test_runner_streaming_validates_pipeline_knobs(rng, tmp_path):
+    from transmogrifai_tpu.runner import (OpParams, OpWorkflowRunner,
+                                          RunType)
+
+    model, records, _pred = _binary_model(rng, n=80)
+    mdir = str(tmp_path / "model")
+    model.save(mdir)
+
+    class _Reader:
+        def read_records(self):
+            return records
+
+    def run(custom):
+        runner = OpWorkflowRunner(None, scoring_reader=_Reader())
+        params = OpParams(model_location=mdir,
+                          custom_params={"validate": False, "plan": False,
+                                         **custom})
+        return runner.run(RunType.STREAMING_SCORE, params)
+
+    for bad in ({"overlap": "bogus"}, {"pipelineWorkers": "two"},
+                {"pipelineWorkers": 0}, {"pipelineDepth": 1.5},
+                {"pipeline": "maybe"}):
+        key = next(iter(bad))
+        with pytest.raises(ValueError, match=f"customParams.{key}"):
+            run(bad)
+
+    res = run({"batchSize": 40, "pipelineWorkers": 2,
+               "pipelineDepth": 3, "overlap": "false"})
+    assert res.metrics["rowsScored"] == 80
+    assert res.metrics["overlap"] is False
+    assert "prefetchDepth" in res.metrics
+    assert "pipelineStarvations" in res.metrics
+    assert res.metrics["pipeline"]["streams"] >= 0   # always-on stamp
+
+
+def test_runner_pipeline_kill_switch_restores_reader_columnar(rng,
+                                                              tmp_path):
+    """``customParams.pipeline: false`` is run-scoped: the reader's
+    columnar flag must come back after the run, so a later pipelined
+    run on the SAME reader instance keeps the vectorized decode."""
+    from transmogrifai_tpu.runner import (OpParams, OpWorkflowRunner,
+                                          RunType)
+
+    model, records, _pred = _binary_model(rng, n=80)
+    mdir = str(tmp_path / "model")
+    model.save(mdir)
+
+    class _Reader:
+        def __init__(self):
+            self.columnar = True
+
+        def read_records(self):
+            return records
+
+    reader = _Reader()
+    runner = OpWorkflowRunner(None, scoring_reader=reader)
+    params = OpParams(model_location=mdir,
+                      custom_params={"validate": False, "plan": False,
+                                     "pipeline": False, "batchSize": 40})
+    res = runner.run(RunType.STREAMING_SCORE, params)
+    assert res.metrics["rowsScored"] == 80
+    assert reader.columnar is True
+
+
+def test_runner_accepts_pre_pipeline_stream_contract(rng, tmp_path):
+    """A duck-typed reader whose ``stream()`` predates the workers knob
+    (``stream(max_batches, timeout_s)``) still streams — serially —
+    instead of crashing on an unexpected kwarg."""
+    from transmogrifai_tpu.runner import (OpParams, OpWorkflowRunner,
+                                          RunType)
+
+    model, records, _pred = _binary_model(rng, n=80)
+    mdir = str(tmp_path / "model")
+    model.save(mdir)
+
+    class _OldReader:
+        def stream(self, max_batches=None, timeout_s=None):
+            for i in range(0, 80, 40):
+                yield records[i:i + 40]
+
+    runner = OpWorkflowRunner(None, scoring_reader=_OldReader())
+    params = OpParams(model_location=mdir,
+                      custom_params={"validate": False, "plan": False,
+                                     "pipelineWorkers": 2})
+    res = runner.run(RunType.STREAMING_SCORE, params)
+    assert res.metrics["rowsScored"] == 80
+    assert res.metrics["batches"] == 2
+
+
+def test_cli_gen_emits_pipeline_knobs_and_check_validates(tmp_path,
+                                                          capsys):
+    from transmogrifai_tpu import cli
+
+    csv = tmp_path / "d.csv"
+    csv.write_text("label,x\n1,0.5\n0,0.3\n1,0.9\n0,0.1\n")
+    out = cli.generate_project(str(csv), "label", str(tmp_path / "proj"))
+    params = json.load(open(out["params.json"]))
+    cp = params["customParams"]
+    assert cp["overlap"] == "auto" and cp["pipeline"] is True
+    assert cp["pipelineWorkers"] is None and cp["pipelineDepth"] is None
+    # gen output round-trips clean through check
+    assert cli.run_check(out["params.json"]) == 0
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({
+        "customParams": {"overlap": "sometimes", "pipelineWorkers": 0.5,
+                         "pipelineDepth": -1, "pipeline": "maybe"}}))
+    rc = cli.run_check(str(bad))
+    txt = capsys.readouterr().out
+    assert rc == 1
+    for key in ("overlap", "pipelineWorkers", "pipelineDepth",
+                "pipeline"):
+        assert f"customParams.{key}" in txt
+    assert "TMG001" in txt
+
+
+# ---------------------------------------------------------------------------
+# fitstats double-buffered fold stays exact
+# ---------------------------------------------------------------------------
+
+
+def test_fitstats_double_buffered_fold_matches_host(monkeypatch):
+    """Multi-chunk device fold (upload k+1 overlapping fold k, pooled
+    pad staging) still merges to the host tier's exact counts/extrema
+    and f64-close moments — run twice so the second pass exercises
+    buffer REUSE, not just allocation."""
+    from transmogrifai_tpu import ColumnStore, column_from_values, fitstats
+    from transmogrifai_tpu.fitstats import LayerStatsPlan, StatRequest
+    from transmogrifai_tpu.types import feature_types as ft
+
+    monkeypatch.setattr(fitstats, "FITSTATS_CHUNK_ROWS", 1024)
+    rng = np.random.default_rng(9)
+    n = 2500                                   # 3 chunks, last one padded
+    vals = rng.normal(size=n) * 3.0
+    vals[rng.random(n) < 0.1] = np.nan
+    store = ColumnStore({"x": column_from_values(ft.Real, vals)}, n)
+    reqs = [StatRequest(k, "x")
+            for k in ("count", "mean", "variance", "min", "max")]
+    host = LayerStatsPlan(reqs).run(store, device=False)
+    before = pipeline.pipeline_stats()
+    dev1 = LayerStatsPlan(reqs).run(store, device=True, mesh=False)
+    dev2 = LayerStatsPlan(reqs).run(store, device=True, mesh=False)
+    after = pipeline.pipeline_stats()
+    for dev in (dev1, dev2):
+        assert dev.value("count", "x") == host.value("count", "x")
+        assert dev.value("min", "x") == host.value("min", "x")
+        assert dev.value("max", "x") == host.value("max", "x")
+        np.testing.assert_allclose(dev.value("mean", "x"),
+                                   host.value("mean", "x"), rtol=1e-6)
+        np.testing.assert_allclose(dev.value("variance", "x"),
+                                   host.value("variance", "x"), rtol=1e-5)
+    assert after["buffer_reuses"] > before["buffer_reuses"]
+
+
+def test_one_chunk_fold_immune_to_pool_churn(monkeypatch):
+    """One-chunk (padded) fits upload through the content-keyed cache,
+    which may hold a zero-copy alias of its source array: re-fitting
+    store A after fit B churned the staging pool must reproduce A's
+    stats exactly — the pad arrays feeding the cache are fresh, never
+    recycled pool buffers."""
+    from transmogrifai_tpu import ColumnStore, column_from_values, fitstats
+    from transmogrifai_tpu.fitstats import LayerStatsPlan, StatRequest
+    from transmogrifai_tpu.types import feature_types as ft
+
+    monkeypatch.setattr(fitstats, "FITSTATS_CHUNK_ROWS", 1024)
+
+    def mk(seed):
+        v = np.random.default_rng(seed).normal(size=700)  # < chunk: padded
+        return ColumnStore({"x": column_from_values(ft.Real, v)}, 700)
+
+    reqs = [StatRequest(k, "x") for k in ("count", "mean", "variance")]
+    a1 = LayerStatsPlan(reqs).run(mk(1), device=True, mesh=False)
+    LayerStatsPlan(reqs).run(mk(2), device=True, mesh=False)
+    a2 = LayerStatsPlan(reqs).run(mk(1), device=True, mesh=False)
+    assert a2.value("count", "x") == a1.value("count", "x")
+    np.testing.assert_array_equal(a2.value("mean", "x"),
+                                  a1.value("mean", "x"))
+    np.testing.assert_array_equal(a2.value("variance", "x"),
+                                  a1.value("variance", "x"))

@@ -389,6 +389,102 @@ def test_stream_score_quarantines_faulted_batch_rest_bit_identical(
     assert entry["records"] == batches[k]
 
 
+@pytest.mark.chaos
+def test_parallel_decode_fault_quarantines_exactly_one_file(tmp_path):
+    """Acceptance: a seeded ``avro.decode`` fault inside the worker
+    pool quarantines exactly the batch it hit — identified through the
+    dead-letter sink — and every surviving batch is bit-identical to
+    the serial decode of the surviving files. The fault site moved onto
+    worker threads; its semantics did not."""
+    import pickle
+
+    from transmogrifai_tpu.readers import DirectoryStreamReader
+    from transmogrifai_tpu.readers.avro import write_avro_records
+
+    d = tmp_path / "in"
+    d.mkdir()
+    n_files = 8
+    by_file = {}
+    for i in range(n_files):
+        recs = [{"x": i * 10 + r, "y": f"f{i}"} for r in range(5)]
+        fp = str(d / f"b{i:03d}.avro")
+        write_avro_records(fp, recs)
+        by_file[fp] = recs
+
+    resilience.set_quarantine(str(tmp_path / "dead.jsonl"))
+    # the j-th avro.decode CALL on the pool faults with a non-transient
+    # decode error (retry must NOT mask it — AvroDecodeError is not
+    # retryable); which file that call lands on is worker-schedule
+    # dependent, so the sink entry names it
+    plan = resilience.FaultPlan(seed=5).on(
+        "avro.decode", error=ValueError("chaos: torn container"), at=[3])
+    with resilience.fault_plan(plan):
+        got = list(DirectoryStreamReader(str(d), settle_s=0.0)
+                   .stream(max_batches=n_files - 1, workers=4,
+                           timeout_s=5.0))
+
+    stats = resilience.resilience_stats()
+    assert stats["quarantined_files"] == 1
+    entries = resilience.get_quarantine().entries()
+    assert len(entries) == 1 and entries[0]["site"] == "stream.read_file"
+    bad = entries[0]["path"]
+    assert bad in by_file
+    survivors = [by_file[fp] for fp in sorted(by_file) if fp != bad]
+    assert pickle.dumps(got) == pickle.dumps(survivors)
+
+
+@pytest.mark.chaos
+def test_pipelined_stream_score_survivors_bit_identical(tmp_path):
+    """The PR-4 stream chaos acceptance, re-run through the staged
+    pipeline (N prep workers + staged uploads): an IO fault on batch k
+    quarantines exactly batch k, survivors bit-identical, records in
+    the dead letter."""
+    from transmogrifai_tpu.readers import stream_score
+
+    records = _records()
+    pred = _three_layer_workflow()
+    model = _train(records, pred)
+    batches = [records[i:i + 30] for i in range(0, len(records), 30)]
+    eng = model.scoring_engine(gate_bandwidth=False)
+    clean = [eng.score_store(list(b), use_cache=False)[
+        pred.name].prediction.copy() for b in batches]
+
+    resilience.set_quarantine(str(tmp_path / "dead.jsonl"))
+    k = 2
+    # single worker first: call order == batch order, so at=[k] is
+    # exactly batch k — the PR-4 assertion verbatim on the new path
+    plan = resilience.FaultPlan(seed=13).on(
+        "stream.score_batch", error=IOError, at=[k])
+    with resilience.fault_plan(plan):
+        faulted = [s[pred.name].prediction.copy()
+                   for s in stream_score(model, batches, overlap=True,
+                                         workers=1, prefetch=2)]
+    assert len(faulted) == len(clean) - 1
+    survivors = [c for i, c in enumerate(clean) if i != k]
+    for got, want in zip(faulted, survivors):
+        np.testing.assert_array_equal(got, want)
+    entry = resilience.get_quarantine().entries()[0]
+    assert entry["index"] == k and entry["records"] == batches[k]
+
+    # with 2 workers the k-th CALL may land on a different batch
+    # (worker interleaving orders the site's calls) — but exactly one
+    # batch is quarantined, the sink names it, and every other batch
+    # is bit-identical to its clean twin
+    resilience.reset_resilience_stats()
+    plan2 = resilience.FaultPlan(seed=13).on(
+        "stream.score_batch", error=IOError, at=[k])
+    with resilience.fault_plan(plan2):
+        faulted2 = [s[pred.name].prediction.copy()
+                    for s in stream_score(model, batches, overlap=True,
+                                          workers=2)]
+    assert len(faulted2) == len(clean) - 1
+    assert resilience.resilience_stats()["quarantined_batches"] == 1
+    dropped = resilience.get_quarantine().entries()[-1]["index"]
+    survivors2 = [c for i, c in enumerate(clean) if i != dropped]
+    for got, want in zip(faulted2, survivors2):
+        np.testing.assert_array_equal(got, want)
+
+
 def test_stream_score_on_error_raise_propagates():
     from transmogrifai_tpu.readers import stream_score
     records = _records(60)

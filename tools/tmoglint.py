@@ -33,6 +33,12 @@ here as rules (the TMG3xx family of the catalog in
   as ``Thread-7`` and an implicit daemon flag hides whether shutdown
   waits for it). A deliberate default carries
   ``# lint: thread — reason``.
+* **TMG308** — ``queue.Queue()`` must pass an explicit ``maxsize=``
+  (the input-pipeline rule: an unbounded queue between pipeline stages
+  hides backpressure — a stalled consumer lets the producer eat the
+  heap instead of slowing down; the staged pipeline's whole contract
+  is bounded queues with explicit backpressure). A deliberate
+  unbounded queue carries ``# lint: unbounded-queue — reason``.
 
 Runs as a CLI over one or more paths (default: the ``transmogrifai_tpu``
 package next to this script) and as a tier-1 pytest
@@ -59,13 +65,14 @@ from transmogrifai_tpu.lint import Finding, Severity, enforce  # noqa: E402
 
 __all__ = ["lint_source", "lint_file", "lint_paths", "main",
            "ALLOW_WALLCLOCK", "ALLOW_BROAD_EXCEPT", "ALLOW_EXPLICIT_MESH",
-           "ALLOW_THREAD"]
+           "ALLOW_THREAD", "ALLOW_UNBOUNDED_QUEUE"]
 
 #: suppression markers, checked on the finding's own source line
 ALLOW_WALLCLOCK = "lint: wall-clock"
 ALLOW_BROAD_EXCEPT = "lint: broad-except"
 ALLOW_EXPLICIT_MESH = "lint: explicit-mesh"
 ALLOW_THREAD = "lint: thread"
+ALLOW_UNBOUNDED_QUEUE = "lint: unbounded-queue"
 
 
 def _fault_sites() -> frozenset:
@@ -94,6 +101,8 @@ class _Visitor(ast.NodeVisitor):
         self.make_mesh_funcs: Set[str] = set()
         self.threading_modules: Set[str] = set()
         self.thread_funcs: Set[str] = set()      # from threading import Thread
+        self.queue_modules: Set[str] = set()
+        self.queue_funcs: Set[str] = set()       # from queue import Queue
         self.with_contexts: Set[int] = set()
         #: parallel/ owns mesh construction, tests may build explicit
         #: topologies — TMG306 exempts both by path
@@ -127,6 +136,8 @@ class _Visitor(ast.NodeVisitor):
                 self.mesh_modules.add(local)
             if alias.name == "threading":
                 self.threading_modules.add(local)
+            if alias.name == "queue":
+                self.queue_modules.add(local)
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
@@ -149,6 +160,8 @@ class _Visitor(ast.NodeVisitor):
                 self.make_mesh_funcs.add(local)
             if mod == "threading" and alias.name == "Thread":
                 self.thread_funcs.add(local)
+            if mod == "queue" and alias.name == "Queue":
+                self.queue_funcs.add(local)
         self.generic_visit(node)
 
     # -- with: remember sanctioned context-manager calls -------------------
@@ -220,6 +233,14 @@ class _Visitor(ast.NodeVisitor):
             return True
         return isinstance(f, ast.Name) and f.id in self.thread_funcs
 
+    def _is_queue(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "Queue" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in self.queue_modules:
+            return True
+        return isinstance(f, ast.Name) and f.id in self.queue_funcs
+
     def visit_Call(self, node: ast.Call) -> None:
         if self._is_time_time(node) \
                 and not self._marked(node.lineno, ALLOW_WALLCLOCK):
@@ -276,6 +297,32 @@ class _Visitor(ast.NodeVisitor):
                     "hides shutdown semantics; pass name= and daemon= "
                     "(or mark a deliberate default "
                     f"'# {ALLOW_THREAD} — <reason>')")
+        elif self._is_queue(node) \
+                and not self._marked(node.lineno, ALLOW_UNBOUNDED_QUEUE):
+            size = None
+            for kw in node.keywords:
+                if kw.arg == "maxsize":
+                    size = kw.value
+            if size is None and node.args:
+                size = node.args[0]
+            # a LITERAL maxsize <= 0 (incl. -1 spelled as UnaryOp) is
+            # unbounded in queue semantics — same defect as omitting it
+            literal_unbounded = (
+                isinstance(size, ast.Constant)
+                and isinstance(size.value, int) and size.value <= 0) \
+                or (isinstance(size, ast.UnaryOp)
+                    and isinstance(size.op, ast.USub)
+                    and isinstance(size.operand, ast.Constant))
+            if size is None or literal_unbounded:
+                self._add(
+                    "TMG308", node.lineno,
+                    "queue.Queue() without an explicit positive "
+                    "maxsize= (maxsize<=0 means UNBOUNDED) — an "
+                    "unbounded queue between pipeline stages hides "
+                    "backpressure (a stalled consumer lets the producer "
+                    "eat the heap instead of slowing down); pass "
+                    "maxsize= (or mark a deliberate unbounded queue "
+                    f"'# {ALLOW_UNBOUNDED_QUEUE} — <reason>')")
         self.generic_visit(node)
 
 

@@ -25,7 +25,8 @@ from .types.feature_types import ColumnKind, FeatureType
 __all__ = [
     "Column", "NumericColumn", "TextColumn", "TextListColumn", "TextSetColumn",
     "RaggedColumn", "GeoColumn", "VectorColumn", "MapColumn", "PredictionColumn",
-    "ColumnStore", "column_from_values", "column_of_empty",
+    "ColumnStore", "column_from_values", "column_from_array",
+    "column_of_empty",
 ]
 
 
@@ -289,6 +290,53 @@ def _stock_convert(ftype, base) -> bool:
     return ftype._convert.__func__ is base._convert.__func__
 
 
+def _bulk_numeric_gate(ftype: Type[FeatureType], kind: ColumnKind) -> bool:
+    """True when ``ftype``'s kind/converter admit the bulk numeric path
+    — the ONE gate both bulk builders share."""
+    return ((kind is ColumnKind.REAL and _stock_convert(ftype, ft.Real))
+            or (kind is ColumnKind.INTEGRAL
+                and _stock_convert(ftype, ft.Integral)))
+
+
+def _bulk_numeric_column(ftype: Type[FeatureType], fvals: np.ndarray,
+                         kind: ColumnKind) -> Optional[NumericColumn]:
+    """The shared masking/round-trip expressions of BOTH bulk numeric
+    builders (:func:`column_from_array` and the fast path inside
+    :func:`column_from_values`) — one copy, so the 'columnar batch is
+    bit-identical to dicts' invariant cannot drift between them.
+    NaN = missing; int64 magnitudes beyond 2^53 don't round-trip
+    through f64, so those return None for the caller's exact path."""
+    mask = ~np.isnan(fvals)
+    fvals = np.where(mask, fvals, 0.0)
+    dtype = _KIND_TO_DTYPE[kind]
+    if dtype == np.float64:
+        return NumericColumn(ftype, fvals, mask)
+    vals = fvals.astype(dtype)
+    if bool((vals == fvals).all()):
+        return NumericColumn(ftype, vals, mask)
+    return None
+
+
+def column_from_array(ftype: Type[FeatureType], arr) -> Optional[Column]:
+    """Bulk counterpart of :func:`column_from_values` for a numpy column
+    (the input pipeline's columnar-decode lane): NaN = missing, bools =
+    1/0 — the SAME expressions as the stock-converter fast path below
+    (shared via :func:`_bulk_numeric_column`), so a column built here is
+    bit-identical to one built from the equivalent python values.
+    Returns None when ``ftype`` has no bulk form (custom ``_convert``,
+    non-numeric kind) — the caller falls back to the per-record path."""
+    kind = ftype.column_kind
+    if not _bulk_numeric_gate(ftype, kind):
+        return None
+    try:
+        fvals = np.asarray(arr, dtype=np.float64)
+    except (TypeError, ValueError, OverflowError):
+        return None
+    if fvals.ndim != 1:
+        return None
+    return _bulk_numeric_column(ftype, fvals, kind)
+
+
 def column_from_values(ftype: Type[FeatureType], values: Sequence[Any]) -> Column:
     """Build a column from raw python values (None = missing).
 
@@ -299,29 +347,19 @@ def column_from_values(ftype: Type[FeatureType], values: Sequence[Any]) -> Colum
     n = len(unboxed)
 
     if kind in (ColumnKind.REAL, ColumnKind.INTEGRAL, ColumnKind.BINARY):
-        from .types import feature_types as _ft
         dtype = _KIND_TO_DTYPE[kind]
         # bulk fast path for stock converters: one C-speed np.array pass
         # (None → nan, bools → 1/0) replaces n Python _convert frames —
         # at the 300k-row bench ingest this loop alone was seconds/column
-        if (kind is ColumnKind.REAL
-                and _stock_convert(ftype, _ft.Real)) or \
-           (kind is ColumnKind.INTEGRAL
-                and _stock_convert(ftype, _ft.Integral)):
+        if _bulk_numeric_gate(ftype, kind):
             try:
                 fvals = np.array(unboxed, dtype=np.float64)
             except (TypeError, ValueError, OverflowError):
                 fvals = None
             if fvals is not None and fvals.shape == (n,):
-                mask = ~np.isnan(fvals)
-                fvals = np.where(mask, fvals, 0.0)
-                if dtype == np.float64:
-                    return NumericColumn(ftype, fvals, mask)
-                vals = fvals.astype(dtype)
-                # int64 magnitudes beyond 2^53 don't round-trip through
-                # f64 — fall back to the exact per-value loop for those
-                if bool((vals == fvals).all()):
-                    return NumericColumn(ftype, vals, mask)
+                col = _bulk_numeric_column(ftype, fvals, kind)
+                if col is not None:
+                    return col
         vals = np.zeros((n,), dtype=dtype)
         mask = np.zeros((n,), dtype=bool)
         for i, v in enumerate(unboxed):

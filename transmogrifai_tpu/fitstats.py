@@ -288,6 +288,20 @@ _HOST_EXEC = {
 _PROGRAM_CACHE: Dict[Tuple, Any] = {}
 _PROGRAM_CACHE_CAP = 32
 
+#: pinned staging pool for the fold's pad-to-chunk buffers — repeat
+#: passes (bench warm reps, CV re-fits) recycle instead of re-zeroing
+#: fresh pages per pass (pipeline.py; reuse counts ride in
+#: ``pipeline.pipeline_stats()``)
+_STAGE_POOL = None
+
+
+def _stage_pool():
+    global _STAGE_POOL
+    if _STAGE_POOL is None:
+        from .pipeline import BufferPool
+        _STAGE_POOL = BufferPool(max_per_key=4)
+    return _STAGE_POOL
+
 
 def program_cache_stats() -> Dict[str, int]:
     return {"size": len(_PROGRAM_CACHE),
@@ -404,14 +418,34 @@ def _device_moment_bundles(store, col_kinds: Dict[str, Dict[str, List[Tuple]]],
             sharding = NamedSharding(mesh, P("data", None))
 
     prog = _moment_program(chunk, k, str(dtype))
-    parts = []
-    for off in range(0, n, chunk):
+    pool = _stage_pool()
+
+    def _place(off: int):
+        """Pad (through the pinned staging pool) and issue one chunk's
+        uploads; device_put is asynchronous, so the transfer drains
+        behind whatever the caller computes next."""
         v = V[off:off + chunk]
         b = B[off:off + chunk]
+        taken: List[np.ndarray] = []
         if v.shape[0] < chunk:
-            pad = chunk - v.shape[0]
-            v = np.concatenate([v, np.zeros((pad, k), dtype)])
-            b = np.concatenate([b, np.zeros((pad, k), bool)])
+            m = v.shape[0]
+            if one_chunk:
+                # the content-keyed upload cache below may retain a
+                # zero-copy ALIAS of its source array (CPU device_put):
+                # pad into fresh arrays here — a recycled pool buffer
+                # would be overwritten by a later fit and corrupt the
+                # cached upload under its old key
+                vp = np.zeros((chunk, k), dtype)
+                bp = np.zeros((chunk, k), bool)
+            else:
+                vp = pool.take((chunk, k), dtype)
+                bp = pool.take((chunk, k), bool)
+                taken += [vp, bp]
+            vp[:m] = v
+            vp[m:] = 0
+            bp[:m] = b
+            bp[m:] = False
+            v, b = vp, bp
         if sharding is not None:
             vd = jax.device_put(v, sharding)
             bd = jax.device_put(b, sharding)
@@ -428,7 +462,37 @@ def _device_moment_bundles(store, col_kinds: Dict[str, Dict[str, List[Tuple]]],
             # insertions would flush genuinely reusable cache entries
             vd = jax.device_put(v)
             bd = jax.device_put(b)
+        return vd, bd, taken
+
+    # double-buffered fold (pipeline.py discipline): chunk i+1's upload
+    # is issued BEFORE chunk i's result is pulled, so the host→device
+    # transfer overlaps the device fold — the one-pass scan's ingest no
+    # longer serializes upload → compute → upload. Staging buffers
+    # recycle only after their chunk's pull (transfers complete by
+    # then). TMOG_PIPELINE=0 serializes the fold (one chunk fully
+    # pulled before the next uploads — the pre-pipeline behavior).
+    from .pipeline import PIPELINE_ENABLED as _pipe_on
+    parts = []
+    pending = None
+
+    def _pull(placed):
+        # dispatch + pull, THEN recycle: the staging buffers' transfer
+        # is complete once device_get returns
+        vd, bd, taken = placed
         parts.append(jax.device_get(prog(vd, bd)))
+        for buf in taken:
+            pool.give(buf)
+
+    for off in range(0, max(n, 1), chunk):
+        placed = _place(off)
+        if not _pipe_on:
+            _pull(placed)
+            continue
+        if pending is not None:
+            _pull(pending)
+        pending = placed
+    if pending is not None:
+        _pull(pending)
 
     # the per-chunk partials merge on host (Chan); the device-side column
     # reductions above are the psum GSPMD inserted when `sharding` is set

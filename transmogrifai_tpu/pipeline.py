@@ -1,0 +1,555 @@
+"""Staged asynchronous input pipeline — the tf.data analog.
+
+Ingest, not compute, is the measured scoring bottleneck (BENCH_r05:
+host→device probed 23 MB/s against the 500 MB/s fusion gate, and
+``data_prep_s`` was 16.6 s of a 57.7 s 10M-row run) while ``readers/``
+decoded Avro/CSV on a single thread and the "overlapped" streaming
+scorer pipelined exactly one batch deep. This module is the staged
+pipeline the tf.data paper describes (PAPERS.md) — the building blocks
+every ingest path in the runtime now shares:
+
+* **Ordered parallel stage** (:func:`map_ordered`) — a named worker
+  pool runs a decode/prepare function over a stream of items
+  concurrently while the consumer sees results in EXACT submission
+  order (a bounded deque of futures is the reorder buffer — item k is
+  yielded only after items 0..k-1, whatever the workers' interleaving).
+  Per-item exceptions ride alongside results instead of killing the
+  stream, so the resilience layer's quarantine/retry semantics survive
+  the move onto worker threads unchanged. In-flight depth is bounded —
+  backpressure is explicit, never an unbounded queue (TMG308).
+* **Pinned-buffer reuse** (:class:`BufferPool`) — preallocated numpy
+  staging buffers keyed by (shape, dtype) and recycled across batches,
+  so the pad-to-bucket step of every streaming batch stops allocating
+  (and re-faulting) fresh pages per batch; the reuse/alloc split is
+  tallied so churn regressions show in bench docs.
+* **Autotuned prefetch** (:class:`PrefetchAutotuner`) — the in-flight
+  depth starts small, GROWS while the consumer starves (a result was
+  not ready when asked for: the pipeline is the bottleneck) and SHRINKS
+  when a full tuning window passes with no starvation (depth beyond
+  what hides the latency is pure buffer memory) — tf.data's AUTOTUNE
+  analog, with the chosen depth observable (``pipeline.prefetch_depth``
+  gauge + the always-on tallies).
+* **Double-buffered uploads** — the scoring engine stages batch k+1's
+  ``device_put`` (``ScoringEngine.stage_batch``) before batch k's
+  result is pulled, so the host→device transfer overlaps device
+  compute; :func:`probe_sustained_mbps` measures the link through
+  exactly that path (pinned buffers, one transfer in flight behind the
+  compute) — the SUSTAINED number the fusion gate and the planner's
+  cost db now reason with, instead of the cold single-shot probe.
+
+Consumers: ``readers.DirectoryStreamReader.stream(workers=)`` (parallel
+file decode), ``scoring.stream_score_overlapped`` (parallel host prep +
+staged uploads), ``fitstats._device_moment_bundles`` (double-buffered
+chunk uploads in the one-pass scan). Knobs ride in the runner as
+``customParams.pipeline`` / ``pipelineWorkers`` / ``pipelineDepth``
+(docs/performance.md "Input pipeline").
+
+Everything here is host-side python/numpy plus ``jax.device_put`` — no
+new dependencies, no device compute.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
+                    Tuple, TypeVar)
+
+import numpy as np
+
+from . import telemetry
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "DEFAULT_WORKERS", "MIN_PREFETCH", "DEFAULT_MAX_PREFETCH",
+    "resolve_workers", "concrete_batch", "map_ordered",
+    "BufferPool", "PrefetchAutotuner",
+    "probe_sustained_mbps",
+    "pipeline_stats", "reset_pipeline_stats",
+]
+
+#: default decode/prep worker count: enough to hide host decode behind
+#: device compute without oversubscribing small hosts
+DEFAULT_WORKERS = max(1, min(4, (os.cpu_count() or 2) - 1))
+
+#: prefetch depth floor — one batch computing + one in flight is the
+#: minimum that overlaps at all
+MIN_PREFETCH = 2
+
+#: prefetch depth ceiling: beyond it the autotuner never grows (each
+#: unit of depth pins one decoded+padded batch in host memory)
+DEFAULT_MAX_PREFETCH = 8
+
+#: ``TMOG_PIPELINE=0`` forces every consumer back to the single-thread
+#: ingest path (kill switch, the TMOG_FITSTATS discipline)
+PIPELINE_ENABLED = os.environ.get("TMOG_PIPELINE", "1") != "0"
+
+
+def concrete_batch(batch):
+    """A re-iterable batch: columnar batches (avro.ColumnarRecords —
+    already concrete, and listifying one would undo the vectorized
+    decode by materializing every dict) and lists/tuples pass through;
+    one-shot iterables materialize."""
+    if hasattr(batch, "columns") or isinstance(batch, (list, tuple)):
+        return batch
+    return list(batch)
+
+
+def resolve_workers(workers: Optional[int]) -> int:
+    """The effective worker count for a pipeline stage: the
+    ``TMOG_PIPELINE=0`` kill switch forces 1 for EVERY consumer (even
+    an explicit ``pipelineWorkers`` — the incident lever must not be
+    overridable from a params file), else an explicit value wins
+    (floored at 1) and None means the module default."""
+    if not PIPELINE_ENABLED:
+        return 1
+    if workers is not None:
+        return max(1, int(workers))
+    return DEFAULT_WORKERS
+
+
+# ---------------------------------------------------------------------------
+# always-on tallies (bench/runner stamp these on every doc; telemetry
+# mirrors the interesting ones as counters/gauges when enabled)
+# ---------------------------------------------------------------------------
+
+_TALLY_LOCK = threading.Lock()
+_TALLY: Dict[str, Any] = {
+    "streams": 0, "batches": 0, "starvations": 0,
+    "prefetch_grows": 0, "prefetch_shrinks": 0,
+    "buffer_allocs": 0, "buffer_reuses": 0,
+    "staged_uploads": 0,
+    "decode_vectorized": 0, "decode_fallback": 0,
+    "last_workers": 0, "last_prefetch_depth": 0,
+    "sustained_mbps": None,
+}
+
+
+def pipeline_stats() -> Dict[str, Any]:
+    """Snapshot of the process-wide input-pipeline tallies (always on —
+    the ``fitstats_stats`` discipline, cheap enough to never turn off).
+    ``last_prefetch_depth`` is the depth the autotuner converged to on
+    the most recent stream; ``sustained_mbps`` the last pinned-buffer
+    double-buffered bandwidth measurement (None before any probe)."""
+    with _TALLY_LOCK:
+        return dict(_TALLY)
+
+
+def reset_pipeline_stats() -> None:
+    with _TALLY_LOCK:
+        for k in _TALLY:
+            _TALLY[k] = None if k == "sustained_mbps" else 0
+
+
+def _tally(key: str, n: int = 1) -> None:
+    with _TALLY_LOCK:
+        _TALLY[key] += n
+
+
+def _tally_set(key: str, v: Any) -> None:
+    with _TALLY_LOCK:
+        _TALLY[key] = v
+
+
+# ---------------------------------------------------------------------------
+# pinned-buffer pool
+# ---------------------------------------------------------------------------
+
+
+class BufferPool:
+    """Reusable preallocated numpy staging buffers keyed by
+    (shape, dtype).
+
+    ``take`` returns a buffer with UNSPECIFIED contents (callers
+    overwrite every element — the pad helpers fill ``[:n]`` with data
+    and zero ``[n:]``); ``give`` recycles it. Per-key free lists are
+    bounded so a shape that appears once (the odd tail bucket) cannot
+    pin memory forever. Thread-safe: prep workers take concurrently
+    while the consumer gives back.
+
+    The point is allocation churn, not correctness: padding every
+    streaming batch to its bucket used to ``np.zeros`` + concatenate
+    fresh arrays per block per batch. With the pool, the steady state
+    allocates ~(prefetch depth × blocks) buffers once and then recycles
+    — the ``buffer_reuses`` / ``buffer_allocs`` tallies make a churn
+    regression visible in every bench doc."""
+
+    def __init__(self, max_per_key: int = 16):
+        self.max_per_key = int(max_per_key)
+        self.reuses = 0
+        self.allocs = 0
+        self._free: Dict[Tuple, List[np.ndarray]] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _key(shape: Tuple[int, ...], dtype) -> Tuple:
+        return (tuple(int(s) for s in shape), np.dtype(dtype).str)
+
+    def take(self, shape: Tuple[int, ...], dtype) -> np.ndarray:
+        """A writable buffer of exactly ``shape``/``dtype`` — recycled
+        when one is free, freshly allocated otherwise."""
+        key = self._key(shape, dtype)
+        with self._lock:
+            free = self._free.get(key)
+            if free:
+                buf = free.pop()
+                self.reuses += 1
+                _tally("buffer_reuses")
+                return buf
+            self.allocs += 1
+        _tally("buffer_allocs")
+        telemetry.counter("pipeline.buffer_allocs").inc()
+        return np.empty(shape, dtype)
+
+    def give(self, buf: np.ndarray) -> None:
+        """Recycle ``buf``. The caller must no longer read or write it
+        — the next ``take`` hands it to another batch."""
+        key = self._key(buf.shape, buf.dtype)
+        with self._lock:
+            free = self._free.setdefault(key, [])
+            if len(free) < self.max_per_key:
+                free.append(buf)
+
+    def pad_rows(self, a: np.ndarray, n: int, bucket: int,
+                 taken: List[np.ndarray]) -> np.ndarray:
+        """Zero-pad the leading (row) axis of ``a`` from ``n`` to
+        ``bucket`` into a pooled buffer, appending it to ``taken`` so
+        the caller can recycle after the batch is consumed. Blocks
+        whose leading dim is not the row count (fitted constants) and
+        already-full buckets pass through untouched — exactly the
+        ``ScoringEngine._pad_rows`` contract, same values bit-for-bit."""
+        a = np.asarray(a)
+        if a.ndim == 0 or a.shape[0] != n or n == bucket:
+            return a
+        out = self.take((bucket,) + a.shape[1:], a.dtype)
+        out[:n] = a
+        out[n:] = 0
+        taken.append(out)
+        return out
+
+    def free_buffers(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._free.values())
+
+
+# ---------------------------------------------------------------------------
+# autotuned prefetch depth
+# ---------------------------------------------------------------------------
+
+
+class PrefetchAutotuner:
+    """tf.data-AUTOTUNE analog for the in-flight batch depth.
+
+    The depth bounds how many items :func:`map_ordered` keeps submitted
+    ahead of the consumer. Tuning runs on a fixed window of consumed
+    batches:
+
+    * any **starvation** in the window (the consumer asked for a result
+      that was not ready — the pipeline, not the device, was the
+      bottleneck) grows the depth by one, up to ``max_depth``;
+    * two consecutive starvation-free windows shrink it by one, down to
+      ``min_depth`` — depth beyond what hides the latency is pure
+      buffer memory (each unit pins one decoded+padded batch), so the
+      tuner backs off under the implicit memory pressure instead of
+      camping at the ceiling.
+
+    The chosen depth is observable: the ``pipeline.prefetch_depth``
+    gauge tracks every change and the always-on tallies record the
+    final depth plus the grow/shrink/starvation counts that explain it.
+    """
+
+    def __init__(self, min_depth: int = MIN_PREFETCH,
+                 max_depth: int = DEFAULT_MAX_PREFETCH,
+                 window: int = 4):
+        if max_depth < min_depth:
+            # an explicit cap below the floor wins (pipelineDepth: 1 is
+            # the sanctioned way to force serial prefetch)
+            min_depth = max_depth
+        self.min_depth = int(min_depth)
+        self.max_depth = int(max_depth)
+        self.window = max(1, int(window))
+        self._depth = self.min_depth
+        self._batches = 0
+        self._starved = 0
+        self._calm_windows = 0
+        self.starvations = 0
+        self.grows = 0
+        self.shrinks = 0
+        self._lock = threading.Lock()
+        telemetry.gauge("pipeline.prefetch_depth").set(self._depth)
+
+    def depth(self) -> int:
+        return self._depth
+
+    def record_starvation(self) -> None:
+        with self._lock:
+            self._starved += 1
+            self.starvations += 1
+        _tally("starvations")
+        telemetry.counter("pipeline.starvations").inc()
+
+    def on_batch(self) -> None:
+        """One batch consumed; closes a tuning window every
+        ``window`` batches."""
+        with self._lock:
+            self._batches += 1
+            if self._batches % self.window:
+                return
+            if self._starved:
+                self._calm_windows = 0
+                if self._depth < self.max_depth:
+                    self._depth += 1
+                    self.grows += 1
+                    _tally("prefetch_grows")
+            else:
+                self._calm_windows += 1
+                if self._calm_windows >= 2 and self._depth > self.min_depth:
+                    self._depth -= 1
+                    self.shrinks += 1
+                    self._calm_windows = 0
+                    _tally("prefetch_shrinks")
+            self._starved = 0
+        telemetry.gauge("pipeline.prefetch_depth").set(self._depth)
+
+
+# ---------------------------------------------------------------------------
+# ordered parallel map — the decode/prep stage
+# ---------------------------------------------------------------------------
+
+_T = TypeVar("_T")
+
+
+def map_ordered(fn: Callable[[_T], Any], items: Iterable[_T],
+                workers: Optional[int] = None,
+                tuner: Optional[PrefetchAutotuner] = None,
+                name: str = "pipeline",
+                executor: Optional[Any] = None
+                ) -> Iterator[Tuple[_T, Any, Optional[BaseException]]]:
+    """Run ``fn`` over ``items`` on a named worker pool, yielding
+    ``(item, result, exception)`` in EXACT submission order.
+
+    The deque of in-flight futures is the reorder buffer: results
+    complete in any order on the workers, but the consumer always pops
+    the oldest submission first, so N-worker output is bit-identical
+    (in content AND order) to the serial loop. A failing item yields
+    its exception instead of raising — the caller owns the poison
+    policy (quarantine / re-raise), same as the serial paths.
+
+    In-flight depth is ``tuner.depth()`` when a tuner is attached
+    (autotuned prefetch with explicit backpressure: the upstream
+    iterator is only advanced when a slot frees), else ``workers + 1``.
+    A consumer that stops iterating mid-stream cancels everything still
+    queued — items never submitted are simply not consumed, which is
+    what lets ``max_batches`` leave unread files re-offered.
+
+    Live sources (anything but an in-memory sequence) are advanced on a
+    dedicated feeder thread: ``next(it)`` on a directory stream between
+    file arrivals can block a full poll interval, and a batch that
+    finished DURING that block must not sit in the reorder buffer
+    behind it — the consumer only ever waits on the oldest future, so
+    results flow the moment they are ready however sparse the source.
+    An exception out of the source itself (not an item) is re-raised to
+    the consumer after every batch submitted before it has been
+    yielded.
+
+    ``executor`` lets a long-lived caller (the directory stream's poll
+    loop) reuse one pool across many ``map_ordered`` calls instead of
+    paying thread spin-up/teardown per call; a caller-owned executor is
+    never shut down here."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    n_workers = resolve_workers(workers)
+    tel = telemetry.enabled()
+    own_ex = executor is None
+    ex = executor if executor is not None else ThreadPoolExecutor(
+        max_workers=n_workers, thread_name_prefix=name)
+    dq: deque = deque()
+    cv = threading.Condition()
+    state: Dict[str, Any] = {"exhausted": False, "stop": False,
+                             "error": None}
+    it = iter(items)
+    live = not isinstance(items, (list, tuple))
+
+    def _depth() -> int:
+        return tuner.depth() if tuner is not None else n_workers + 1
+
+    def _gauge() -> None:
+        if tel:
+            telemetry.gauge("pipeline.queue_depth").set(len(dq))
+
+    def _feed_live() -> None:
+        # runs on the feeder thread; dq/state mutations only under cv
+        try:
+            while True:
+                with cv:
+                    while not state["stop"] and len(dq) >= _depth():
+                        cv.wait()
+                    if state["stop"]:
+                        return
+                    # while the feeder is inside next(it) the SOURCE is
+                    # the limiter — a consumer starving then must not
+                    # grow the prefetch depth (extra depth cannot make
+                    # files arrive faster); cleared on submit, and left
+                    # set on source exhaustion for the same reason
+                    state["source_wait"] = True
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+                except BaseException as e:  # lint: broad-except — a source failure rides to the consumer, after in-flight items
+                    state["error"] = e
+                    return
+                with cv:
+                    state["source_wait"] = False
+                    if state["stop"]:
+                        return
+                    dq.append((item, ex.submit(fn, item)))
+                    _gauge()
+                    cv.notify_all()
+        finally:
+            with cv:
+                state["exhausted"] = True
+                cv.notify_all()
+
+    def _top_up_inline() -> None:
+        # sequence source: next() cannot block, so feed from the
+        # consumer and skip the feeder thread entirely
+        while not state["exhausted"] and len(dq) < _depth():
+            try:
+                item = next(it)
+            except StopIteration:
+                state["exhausted"] = True
+                break
+            dq.append((item, ex.submit(fn, item)))
+        _gauge()
+
+    if live:
+        threading.Thread(target=_feed_live, name=f"{name}-feeder",
+                         daemon=True).start()
+    first_pop = True
+    try:
+        while True:
+            src_bound = False
+            if live:
+                with cv:
+                    while not dq and not state["exhausted"]:
+                        cv.wait()
+                    if not dq:
+                        break
+                    item, fut = dq.popleft()
+                    src_bound = state.get("source_wait", False)
+                    _gauge()
+                    cv.notify_all()        # a slot freed for the feeder
+            else:
+                _top_up_inline()
+                if not dq:
+                    break
+                item, fut = dq.popleft()
+                _gauge()
+            # the first pop lands microseconds after the first submit
+            # and is ~always unfinished — that's cold start, not "the
+            # pipeline is the bottleneck", so it must not count as a
+            # starvation (it would grow the depth and pollute the
+            # tallies on EVERY stream, balanced or not)
+            if tuner is not None and not fut.done() and not first_pop \
+                    and not src_bound:
+                tuner.record_starvation()
+            first_pop = False
+            try:
+                res, exc = fut.result(), None
+            except BaseException as e:  # lint: broad-except — per-item failures ride to the caller's poison policy
+                res, exc = None, e
+            yield item, res, exc
+            if tuner is not None:
+                tuner.on_batch()
+                if live:
+                    with cv:
+                        cv.notify_all()    # depth may have grown
+        if state["error"] is not None:
+            raise state["error"]
+    finally:
+        with cv:
+            state["stop"] = True
+            cv.notify_all()
+        for _item, fut in dq:
+            fut.cancel()
+        if own_ex:
+            ex.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# sustained-bandwidth probe (the double-buffered path's number)
+# ---------------------------------------------------------------------------
+
+
+def probe_sustained_mbps(n_transfers: int = 8,
+                         buf_mb: int = 4) -> float:
+    """Host→device bandwidth (MB/s) through the pipeline's own path:
+    TWO pinned (reused) 4 MB staging buffers, each transfer issued
+    while the previous one is still in flight — the double-buffered
+    discipline ``ScoringEngine.stage_batch`` runs, so this is the rate
+    streaming ingest actually sustains, not the cold single-shot
+    round-trip ``telemetry.probe_device_roundtrip_mbps`` measures
+    (23 MB/s vs the 500 MB/s gate in BENCH_r05 — the number that kept
+    the fusion gate OFF). Measures on every call;
+    ``workflow.device_roundtrip_mbps`` owns the once-per-process cache.
+
+    One h2d direction only: the overlapped scorer pulls results once
+    per batch but uploads the (much wider) prepared feature blocks —
+    upload is the direction the gate is about."""
+    import jax
+
+    n_elems = (buf_mb << 20) // 4
+    bufs = [np.zeros((n_elems,), np.float32) for _ in range(2)]
+    # warm-up absorbs backend init / dispatch compilation
+    jax.block_until_ready(jax.device_put(bufs[0]))
+    nbytes = bufs[0].nbytes
+    with telemetry.span("pipeline:sustained_probe",
+                        bytes=n_transfers * nbytes):
+        t0 = time.perf_counter()
+        prev = None
+        for i in range(n_transfers):
+            # reusing buffer i % 2 is safe: its previous transfer
+            # (i - 2) was blocked on at iteration i - 1
+            cur = jax.device_put(bufs[i % 2])
+            if prev is not None:
+                jax.block_until_ready(prev)
+            prev = cur
+        jax.block_until_ready(prev)
+        dt = max(time.perf_counter() - t0, 1e-9)
+    mbps = (n_transfers * nbytes / 1e6) / dt
+    _tally_set("sustained_mbps", round(mbps, 1))
+    telemetry.gauge("device.sustained_mbps").set(mbps)
+    logger.info("sustained host->device bandwidth (double-buffered, "
+                "pinned reuse): %.0f MB/s", mbps)
+    return mbps
+
+
+# ---------------------------------------------------------------------------
+# stream bookkeeping shared by the pipelined consumers
+# ---------------------------------------------------------------------------
+
+
+def record_stream(n_batches: int, workers: int,
+                  tuner: Optional[PrefetchAutotuner] = None,
+                  pool: Optional[BufferPool] = None) -> None:
+    """Fold one finished pipelined stream into the always-on tallies
+    and emit the ``on_pipeline_stats`` RunListener event — the
+    OpSparkListener-style summary row the runner's metrics doc and the
+    bench stamp (docs/observability.md)."""
+    _tally("streams")
+    _tally("batches", n_batches)
+    _tally_set("last_workers", int(workers))
+    depth = tuner.depth() if tuner is not None else 0
+    if tuner is not None:
+        _tally_set("last_prefetch_depth", depth)
+    telemetry.counter("pipeline.batches").inc(n_batches)
+    telemetry.emit(
+        "pipeline_stats", batches=n_batches, workers=int(workers),
+        prefetch_depth=depth,
+        starvations=tuner.starvations if tuner is not None else 0,
+        buffer_reuses=pool.reuses if pool is not None else 0,
+        buffer_allocs=pool.allocs if pool is not None else 0)

@@ -175,7 +175,10 @@ class CostDatabase:
     Schema (``version`` 1)::
 
         {"version": 1,
-         "bandwidth_mbps": 1234.5 | null,          # measured link
+         "bandwidth_mbps": 1234.5 | null,   # SUSTAINED link (pipeline's
+                                            # double-buffered path — the
+                                            # number tier decisions use)
+         "probe_mbps": 23.4 | null,         # cold single-shot round trip
          "chain": {"engine_s_per_krow": ..., "host_s_per_krow": ...},
          "stages": {"<StageClass>": {
              "fit":    {"s_per_krow": ..., "n": k},
@@ -221,6 +224,7 @@ class CostDatabase:
                            "estimates in force", path, e)
             return cls(path=path, corrupt=True)
         doc.setdefault("bandwidth_mbps", None)
+        doc.setdefault("probe_mbps", None)
         doc.setdefault("chain", {})
         return cls(path=path, doc=doc)
 
@@ -277,8 +281,15 @@ class CostDatabase:
             .setdefault(tier, {})
         self._merge(slot, seconds / (rows / 1000.0))
 
-    def record_bandwidth(self, mbps: float) -> None:
+    def record_bandwidth(self, mbps: float,
+                         probe_mbps: Optional[float] = None) -> None:
+        """``mbps`` is the SUSTAINED measurement (the pipeline's
+        pinned-buffer double-buffered path — what tier decisions use);
+        ``probe_mbps`` the cold single-shot round trip, recorded beside
+        it so a tier flip between processes is explainable."""
         self.doc["bandwidth_mbps"] = round(float(mbps), 1)
+        if probe_mbps is not None:
+            self.doc["probe_mbps"] = round(float(probe_mbps), 1)
 
     def record_chain(self, host_rows_per_s: Optional[float] = None,
                      engine_rows_per_s: Optional[float] = None) -> None:

@@ -4,5 +4,6 @@ from .data_readers import (DataReader, CSVReader, CSVAutoReader,  # noqa: F401
                            JoinedDataReader, JoinedAggregateDataReader,
                            TimeBasedFilter, FilteredReader, CutOffTime,
                            stream_score)
-from .avro import read_avro_records  # noqa: F401
+from .avro import (ColumnarRecords, read_avro_records,  # noqa: F401
+                   read_avro_table)
 from .streaming import DirectoryStreamReader  # noqa: F401

@@ -339,18 +339,24 @@ class JoinedAggregateDataReader(AggregateReader):
 
 def stream_score(model, batches: Iterable[Sequence[Mapping[str, Any]]],
                  keep_intermediate: bool = False, overlap: Any = "auto",
-                 on_error: Optional[str] = None):
+                 on_error: Optional[str] = None,
+                 workers: Optional[int] = None,
+                 prefetch: Optional[int] = None):
     """Incremental scoring over record batches (StreamingScore run type /
     StreamingReaders.scala analog): yields one scored ColumnStore per
     batch, reusing the fitted DAG — jitted transforms recompile only when
     a batch size changes shape buckets.
 
-    ``overlap`` engages the compiled scoring engine's software-pipelined
-    mode (scoring.stream_score_overlapped): host feature extraction of
-    batch k+1 runs in a worker thread while batch k computes on device.
+    ``overlap`` engages the compiled scoring engine's staged input
+    pipeline (scoring.stream_score_overlapped, per pipeline.py): host
+    feature extraction runs on a parallel worker pool with autotuned
+    prefetch while device compute and the next batch's upload overlap.
     ``"auto"`` (default) turns it on when the engine is available, the
     link clears the bandwidth gate and the first batch is big enough to
     pay for compilation; ``True``/``False`` force/forbid it.
+    ``workers`` / ``prefetch`` bound the pipeline's decode/prep pool and
+    prefetch-depth ceiling (None = the pipeline module defaults; the
+    runner's ``customParams.pipelineWorkers`` / ``pipelineDepth``).
 
     ``on_error`` governs poison batches (tf.data's graceful-degradation
     contract): ``"quarantine"`` routes a batch whose scoring raises to
@@ -376,8 +382,13 @@ def stream_score(model, batches: Iterable[Sequence[Mapping[str, Any]]],
     if first is None:
         return
     chained = itertools.chain([first], it)
+    from .. import pipeline as _pipeline
     use_overlap = False
-    if overlap is not False and hasattr(model, "scoring_engine"):
+    if overlap is not False and _pipeline.PIPELINE_ENABLED \
+            and hasattr(model, "scoring_engine"):
+        # TMOG_PIPELINE=0 is the emergency lever: it wins over an
+        # explicit overlap=True and drops the stream to the
+        # single-thread per-batch path
         from ..scoring import SCORING_MIN_ROWS
         eng = model.scoring_engine()
         ok = eng is not None and eng.enabled()
@@ -390,14 +401,14 @@ def stream_score(model, batches: Iterable[Sequence[Mapping[str, Any]]],
         from ..scoring import stream_score_overlapped
         yield from stream_score_overlapped(
             model, chained, keep_intermediate=keep_intermediate,
-            on_error=on_error)
+            on_error=on_error, workers=workers, prefetch=prefetch)
         return
     for i, batch in enumerate(chained):
         try:
             resilience.inject("stream.score_batch", index=i,
                               rows=len(batch))
             with telemetry.span("stream:score_batch", rows=len(batch)):
-                out = model.score(list(batch),
+                out = model.score(_pipeline.concrete_batch(batch),
                                   keep_intermediate=keep_intermediate)
         except Exception as e:  # lint: broad-except — poison batch quarantines, never kills the stream
             # the records ride in the dead letter: unlike a quarantined

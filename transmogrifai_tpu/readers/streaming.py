@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import glob
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
@@ -43,16 +44,33 @@ class DirectoryStreamReader:
                  = None,
                  new_files_only: bool = False,
                  poll_interval_s: float = 1.0,
-                 settle_s: float = 0.5):
+                 settle_s: float = 0.5,
+                 columnar: bool = True):
         self.path = path
         self.pattern = pattern
         self.reader_for = reader_for
         self.new_files_only = new_files_only
         self.poll_interval_s = poll_interval_s
         self.settle_s = settle_s
+        #: route Avro decode through the vectorized columnar fast path
+        #: (avro.read_avro_table — bit-identical batches that iterate
+        #: as the same dicts, but numpy-decoded so the pipeline's
+        #: workers escape the GIL). False = the pre-pipeline per-record
+        #: Python decoder, kept for the bench's serial baseline leg.
+        self.columnar = columnar
         self._seen: set = set()
+        #: interruptible idle wait: ``stop()`` wakes a sleeping
+        #: ``stream()`` immediately instead of blocking shutdown a full
+        #: poll interval
+        self._stop = threading.Event()
         if new_files_only:
             self._seen.update(self._snapshot())
+
+    def stop(self) -> None:
+        """Ask a running ``stream()`` to end now: the idle wait is an
+        Event wait, so shutdown never blocks a full ``poll_interval_s``.
+        The next ``stream()`` call on this reader starts fresh."""
+        self._stop.set()
 
     # -- format routing ----------------------------------------------------
     def _read_file(self, fp: str) -> List[Dict[str, Any]]:
@@ -62,8 +80,11 @@ class DirectoryStreamReader:
             return self.reader_for(fp)
         ext = os.path.splitext(fp)[1].lower()
         if ext == ".avro":
-            from .avro import read_avro_records
-            return read_avro_records(fp)
+            from .. import pipeline
+            from .avro import read_avro_records, read_avro_table
+            return read_avro_table(fp) \
+                if self.columnar and pipeline.PIPELINE_ENABLED \
+                else read_avro_records(fp)
         if ext == ".csv":
             from .data_readers import CSVAutoReader
             return CSVAutoReader(fp).read_records()
@@ -103,8 +124,21 @@ class DirectoryStreamReader:
         marked seen and skipped: retrying it every poll would wedge the
         stream forever, and dropping it without trace loses data
         silently (the pre-resilience behavior)."""
-        import logging
+        snapshot = self._retried_poll()
+        for fp in snapshot:
+            if fp in self._seen or not self._ready(fp):
+                continue
+            try:
+                recs = self._retried_read(fp)
+            except Exception as e:  # lint: broad-except — ANY read failure quarantines, never wedges the stream
+                self._consume_error(fp, e)
+                continue
+            self._seen.add(fp)
+            return recs
+        return None
 
+    def _retried_poll(self) -> List[str]:
+        """One retried directory listing + the backlog gauge."""
         from .. import resilience, telemetry
         snapshot = resilience.READER_RETRY.call(
             "stream.poll", self._poll_snapshot)
@@ -115,30 +149,38 @@ class DirectoryStreamReader:
             # off the listing this poll already does; no extra stat I/O.
             telemetry.gauge("stream.file_backlog").set(
                 sum(1 for fp in snapshot if fp not in self._seen))
-        for fp in snapshot:
-            if fp in self._seen or not self._ready(fp):
-                continue
-            try:
-                recs = resilience.READER_RETRY.call(
-                    "stream.read_file", self._read_file, fp)
-            except _NoReaderError:
-                # unknown extension: a CONFIGURATION gap, but the file
-                # must still be marked seen before raising or it wedges
-                # the stream (every later poll re-hits it) and blocks
-                # the readable files behind it
-                self._seen.add(fp)
-                raise
-            except Exception as e:  # lint: broad-except — ANY read failure quarantines, never wedges the stream
-                logging.getLogger(__name__).warning(
-                    "stream reader quarantining unreadable file %s",
-                    fp, exc_info=True)
-                resilience.quarantine("stream.read_file", repr(e),
-                                      kind="files", path=fp)
-                self._seen.add(fp)
-                continue
+        return snapshot
+
+    def _retried_read(self, fp: str) -> List[Dict[str, Any]]:
+        """One file's records behind READER_RETRY — the decode unit the
+        parallel workers run; the ``stream.read_file``/``avro.decode``/
+        ``csv.decode`` fault sites all fire inside it, on whichever
+        thread executes it."""
+        from .. import resilience
+        return resilience.READER_RETRY.call(
+            "stream.read_file", self._read_file, fp)
+
+    def _consume_error(self, fp: str, exc: BaseException) -> None:
+        """The ONE poison-file policy both the serial and the parallel
+        consumers apply, in file order: an unknown extension is a
+        CONFIGURATION gap that re-raises (after marking seen so it
+        cannot wedge the stream), anything else quarantines the file
+        with its reason and the stream flows on."""
+        import logging
+
+        from .. import resilience
+        if isinstance(exc, _NoReaderError):
+            # the file must still be marked seen before raising or it
+            # wedges the stream (every later poll re-hits it) and
+            # blocks the readable files behind it
             self._seen.add(fp)
-            return recs
-        return None
+            raise exc
+        logging.getLogger(__name__).warning(
+            "stream reader quarantining unreadable file %s",
+            fp, exc_info=exc)
+        resilience.quarantine("stream.read_file", repr(exc),
+                              kind="files", path=fp)
+        self._seen.add(fp)
 
     def poll_once(self) -> List[List[Dict[str, Any]]]:
         """One poll: read every settled unseen file, oldest first."""
@@ -150,13 +192,55 @@ class DirectoryStreamReader:
             if recs:
                 batches.append(recs)
 
+    def _idle_wait(self, t0: float,
+                   timeout_s: Optional[float]) -> bool:
+        """Idle between polls; returns False when the stream should end
+        (timeout elapsed or :meth:`stop` was called). The wait is an
+        interruptible Event wait clamped to the REMAINING timeout — a
+        ``timeout_s`` shorter than ``poll_interval_s`` is honored, and
+        ``stop()``/``max_batches`` never block a full interval."""
+        remaining = None
+        if timeout_s is not None:
+            remaining = timeout_s - (time.perf_counter() - t0)
+            if remaining <= 0:
+                return False
+        wait = self.poll_interval_s if remaining is None \
+            else min(self.poll_interval_s, remaining)
+        return not self._stop.wait(wait)
+
     def stream(self, max_batches: Optional[int] = None,
-               timeout_s: Optional[float] = None
+               timeout_s: Optional[float] = None,
+               workers: Optional[int] = None
                ) -> Iterator[List[Dict[str, Any]]]:
-        """Yield per-file record batches as files appear."""
+        """Yield per-file record batches as files appear.
+
+        A productive poll is followed by another poll IMMEDIATELY (the
+        stream only sleeps when a poll found nothing new), and the idle
+        sleep is interruptible (:meth:`stop`) and clamped to the
+        remaining ``timeout_s``.
+
+        ``workers`` > 1 decodes the settled files of each poll on a
+        parallel worker pool (pipeline.py) with DETERMINISTIC order:
+        batches arrive in sorted-file order, bit-identical to the
+        serial decode, and the ``stream.read_file``/``avro.decode``/
+        ``csv.decode`` fault sites + READER_RETRY + poison-file
+        quarantine run inside the workers unchanged."""
+        self._stop.clear()
+        if workers is not None:
+            # an explicit count still rides the TMOG_PIPELINE=0 kill
+            # switch (resolve_workers forces 1 — the incident lever is
+            # not overridable); None keeps the serial default
+            from .. import pipeline
+            workers = pipeline.resolve_workers(int(workers))
+        if workers is not None and workers > 1:
+            yield from self._stream_parallel(workers, max_batches,
+                                             timeout_s)
+            return
         t0 = time.perf_counter()
         n = 0
         while True:
+            if self._stop.is_set():
+                return
             recs = self._take_next()
             if recs is not None:
                 if recs:
@@ -165,10 +249,63 @@ class DirectoryStreamReader:
                     if max_batches is not None and n >= max_batches:
                         return
                 continue            # drain without sleeping
-            if timeout_s is not None \
-                    and time.perf_counter() - t0 >= timeout_s:
+            if not self._idle_wait(t0, timeout_s):
                 return
-            time.sleep(self.poll_interval_s)
+
+    def _stream_parallel(self, workers: int,
+                         max_batches: Optional[int],
+                         timeout_s: Optional[float]
+                         ) -> Iterator[List[Dict[str, Any]]]:
+        """Parallel-decode poll loop: each poll's settled unseen files
+        fan out over the worker pool; the reorder buffer hands results
+        back in sorted-file order. Files are marked seen one at a time
+        AS THEIR RESULT IS CONSUMED, so a consumer that stops at
+        ``max_batches`` leaves later files re-offered on the next poll,
+        never silently dropped (the serial contract)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from .. import pipeline
+
+        t0 = time.perf_counter()
+        n = 0
+        ex = None
+        try:
+            while True:
+                if self._stop.is_set():
+                    return
+                snapshot = self._retried_poll()
+                ready = [fp for fp in snapshot
+                         if fp not in self._seen and self._ready(fp)]
+                if ready:
+                    if ex is None:
+                        # one pool for the stream's lifetime, created
+                        # lazily on the first productive poll: an idle
+                        # watch never spins up threads, and productive
+                        # polls never pay per-poll spin-up/teardown
+                        ex = ThreadPoolExecutor(
+                            max_workers=workers,
+                            thread_name_prefix="stream-decode")
+                    for fp, recs, exc in pipeline.map_ordered(
+                            self._retried_read, ready, workers=workers,
+                            name="stream-decode", executor=ex):
+                        if exc is not None:
+                            self._consume_error(fp, exc)
+                            continue
+                        self._seen.add(fp)
+                        if recs:
+                            yield recs
+                            n += 1
+                            if max_batches is not None \
+                                    and n >= max_batches:
+                                return
+                        if self._stop.is_set():
+                            return
+                    continue        # productive poll: re-poll immediately
+                if not self._idle_wait(t0, timeout_s):
+                    return
+        finally:
+            if ex is not None:
+                ex.shutdown(wait=False)
 
     # -- DataReader interop (batch fallback) -------------------------------
     def read_records(self) -> List[Dict[str, Any]]:

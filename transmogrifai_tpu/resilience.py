@@ -113,6 +113,10 @@ FAULT_SITES = frozenset({
     "csv.decode",                # csv decode (readers/data_readers.py)
     "fitstats.device_pass",      # fused fit-stats device tier (fitstats.py)
     "scoring.device_dispatch",   # compiled engine dispatch (scoring.py)
+    "pipeline.upload",           # staged double-buffered device_put
+                                 # (scoring.ScoringEngine.stage_batch —
+                                 # an upload failure is a tier failure:
+                                 # breaker-reported, host-path retry)
     "server.dispatch",           # model-server micro-batch dispatch
                                  # (server.py — batch AND per-request
                                  # fallback attempts pass through it)

@@ -64,6 +64,32 @@ def _numeric_custom_param(params: "OpParams", key: str, cast=float,
     return v
 
 
+def _bool_custom_param(params: "OpParams", key: str, default: Any = None,
+                       allow_auto: bool = False) -> Any:
+    """Validated boolean ``customParams`` lookup — the machinery the
+    hand-rolled ``overlap`` string→bool parsing used to bypass: a JSON
+    ``true``/``false``, the strings ``"true"``/``"false"`` (config
+    files written by shell templating), and — with ``allow_auto`` —
+    the tri-state ``"auto"``. Anything else raises a ``ValueError``
+    NAMING the key, so ``cli check`` reports it as TMG001 and a typo'd
+    ``overlap: "yes"`` can no longer silently mean "auto"."""
+    raw = params.custom_params.get(key)
+    if raw is None:
+        return default
+    if isinstance(raw, bool):
+        return raw
+    if isinstance(raw, str):
+        s = raw.strip().lower()
+        if s in ("true", "false"):
+            return s == "true"
+        if allow_auto and s == "auto":
+            return "auto"
+    kinds = "a boolean (true/false)"
+    if allow_auto:
+        kinds += ' or "auto"'
+    raise ValueError(f"customParams.{key} must be {kinds}, got {raw!r}")
+
+
 @dataclass
 class OpParams:
     """File-driven workflow configuration (OpParams.scala:30-150)."""
@@ -135,6 +161,11 @@ class OpParams:
             for key in (stage.uid, type(stage).__name__):
                 if key in self.stage_params:
                     stage.set_params(**self.stage_params[key])
+
+
+def _pipeline_stats() -> Dict[str, Any]:
+    from . import pipeline
+    return pipeline.pipeline_stats()
 
 
 def _enable_compile_cache(path: str) -> str:
@@ -312,7 +343,11 @@ class OpWorkflowRunner:
             planner.record_fit_costs(model, db)
             planner.drain_phase_observations(db)
             if _wf._DEVICE_BW_MBPS is not None:
-                db.record_bandwidth(_wf._DEVICE_BW_MBPS)
+                # sustained (the tier-deciding number) + the cold probe
+                # beside it — see CostDatabase.record_bandwidth
+                db.record_bandwidth(
+                    _wf._DEVICE_BW_MBPS,
+                    probe_mbps=_wf._DEVICE_BW_PROBE_MBPS)
             db.save()
             self._last_plan = planner.plan_model(model,
                                                  cost_db=db).to_json()
@@ -459,6 +494,11 @@ class OpWorkflowRunner:
                     from . import server as _server
                     result.metrics["aot"] = _aot.aot_stats()
                     result.metrics["server"] = _server.server_stats()
+                    # input-pipeline tallies ride on every doc too:
+                    # converged prefetch depth, worker count, buffer
+                    # reuse and the sustained-bandwidth measurement
+                    # behind the fusion gate (pipeline.py)
+                    result.metrics["pipeline"] = _pipeline_stats()
                     if collector is not None:
                         result.metrics["telemetry"] = collector.summary()
                         result.metrics["telemetryMetrics"] = \
@@ -555,52 +595,127 @@ class OpWorkflowRunner:
                                        minimum=1)
             ts = _numeric_custom_param(params, "timeoutS", float,
                                        minimum=0)
-            if hasattr(reader, "stream"):
-                # directory-watching reader (StreamingReaders analog):
-                # each NEW file is one micro-batch
-                batch = "per-file"
-                batches = reader.stream(max_batches=mb, timeout_s=ts)
-            else:
-                data = reader.read_records()
-                batch = _numeric_custom_param(params, "batchSize", int,
-                                              default=1024, minimum=1)
-                batches = (data[i:i + batch]
-                           for i in range(0, len(data), batch))
-            # overlapped streaming (tf.data-style software pipelining):
-            # host feature extraction of batch k+1 runs concurrently with
-            # batch k's device compute when the scoring engine is active.
-            # customParams.overlap: true/false force/forbid; default auto.
-            overlap = params.custom_params.get("overlap", "auto")
-            if isinstance(overlap, str) and overlap.lower() in (
-                    "true", "false"):
-                overlap = overlap.lower() == "true"
-            # sink-aware default (resilience.resolve_on_error): with a
-            # quarantineLocation configured, poison batches quarantine;
-            # without one their records would land nowhere, so the run
-            # fails loudly instead. customParams.onBatchError overrides.
-            on_error = params.custom_params.get("onBatchError")
-            rows = 0
-            n_batches = 0
-            q_before = resilience.resilience_stats()
-            sink = (_make_sink(params.write_location)
-                    if params.write_location else None)
+            # staged input pipeline (pipeline.py): parallel decode/prep
+            # workers, autotuned prefetch, double-buffered uploads.
+            # customParams.overlap true/false force/forbid the pipelined
+            # engine path (default auto); pipeline false drops back to
+            # single-thread ingest; pipelineWorkers/pipelineDepth bound
+            # the pool and the prefetch ceiling (null = module
+            # defaults). ALL validated up front — a malformed value
+            # names its key now (TMG001 via `cli check`), not deep in
+            # the stream.
+            overlap = _bool_custom_param(params, "overlap",
+                                         default="auto", allow_auto=True)
+            pipe_on = _bool_custom_param(params, "pipeline", default=True)
+            pipe_workers = _numeric_custom_param(
+                params, "pipelineWorkers", int, minimum=1)
+            pipe_depth = _numeric_custom_param(
+                params, "pipelineDepth", int, minimum=1)
+            restore_columnar = None
+            if not pipe_on:
+                # the run-scoped kill switch mirrors TMOG_PIPELINE=0:
+                # single-thread decode/prep AND the pre-pipeline scoring
+                # path — no staged uploads (overlap wins over an
+                # explicit true), per-record Python decode. The reader's
+                # columnar flag is saved and restored in the finally
+                # below: run-scoped like the knob itself, so a later
+                # pipelined run on the SAME reader instance keeps the
+                # vectorized decode.
+                pipe_workers, pipe_depth = 1, 1
+                overlap = False
+                if hasattr(reader, "columnar"):
+                    restore_columnar = bool(reader.columnar)
+                    reader.columnar = False
             try:
-                for scored in stream_score(model, batches, overlap=overlap,
-                                           on_error=on_error):
-                    rows += scored.n_rows
-                    n_batches += 1
+                if hasattr(reader, "stream"):
+                    # directory-watching reader (StreamingReaders
+                    # analog): each NEW file is one micro-batch, decoded
+                    # on the pipeline's worker pool when one is
+                    # configured
+                    batch = "per-file"
+                    import inspect
+
+                    from .pipeline import resolve_workers
+                    kw: Dict[str, Any] = {"max_batches": mb,
+                                          "timeout_s": ts}
+                    # the reader contract predates the pipeline: a
+                    # duck-typed stream(max_batches, timeout_s) without
+                    # the workers knob keeps streaming serially instead
+                    # of crashing on an unexpected kwarg
+                    try:
+                        sig = inspect.signature(reader.stream).parameters
+                        if "workers" in sig or any(
+                                p.kind is inspect.Parameter.VAR_KEYWORD
+                                for p in sig.values()):
+                            kw["workers"] = resolve_workers(pipe_workers)
+                    except (TypeError, ValueError):
+                        pass        # unintrospectable callable: old contract
+                    batches = reader.stream(**kw)
+                else:
+                    data = reader.read_records()
+                    batch = _numeric_custom_param(params, "batchSize",
+                                                  int, default=1024,
+                                                  minimum=1)
+                    batches = (data[i:i + batch]
+                               for i in range(0, len(data), batch))
+                # sink-aware default (resilience.resolve_on_error): with
+                # a quarantineLocation configured, poison batches
+                # quarantine; without one their records would land
+                # nowhere, so the run fails loudly instead.
+                # customParams.onBatchError overrides.
+                on_error = params.custom_params.get("onBatchError")
+                rows = 0
+                n_batches = 0
+                q_before = resilience.resilience_stats()
+                pipe_before = _pipeline_stats()
+                sink = (_make_sink(params.write_location)
+                        if params.write_location else None)
+                try:
+                    for scored in stream_score(model, batches,
+                                               overlap=overlap,
+                                               on_error=on_error,
+                                               workers=pipe_workers,
+                                               prefetch=pipe_depth):
+                        rows += scored.n_rows
+                        n_batches += 1
+                        if sink is not None:
+                            sink.write(scored)
+                    if sink is not None and n_batches == 0:
+                        # header-only output (as SCORE produces on
+                        # empty input)
+                        sink.write_header(
+                            [f.name for f in model.result_features])
+                finally:
                     if sink is not None:
-                        sink.write(scored)
-                if sink is not None and n_batches == 0:
-                    # header-only output (as SCORE produces on empty input)
-                    sink.write_header(
-                        [f.name for f in model.result_features])
+                        sink.close()
             finally:
-                if sink is not None:
-                    sink.close()
+                if restore_columnar is not None:
+                    reader.columnar = restore_columnar
             q_after = resilience.resilience_stats()
+            pipe_after = _pipeline_stats()
+            pipe_streams = (pipe_after["streams"]
+                            - pipe_before["streams"])
             metrics = {"rowsScored": rows, "batches": n_batches,
                        "batchSize": batch, "overlap": overlap,
+                       # THIS run's pipeline evidence: the converged
+                       # prefetch depth + worker count + starvation and
+                       # buffer-churn deltas (docs/performance.md
+                       # "Input pipeline"). last_* tallies are
+                       # process-global, so they only count as this
+                       # run's facts when this run actually streamed
+                       # pipelined — null otherwise (plain path)
+                       "pipelineWorkers":
+                           (pipe_after["last_workers"]
+                            if pipe_streams else None),
+                       "prefetchDepth":
+                           (pipe_after["last_prefetch_depth"]
+                            if pipe_streams else None),
+                       "pipelineStarvations":
+                           pipe_after["starvations"]
+                           - pipe_before["starvations"],
+                       "bufferReuses":
+                           pipe_after["buffer_reuses"]
+                           - pipe_before["buffer_reuses"],
                        "quarantinedBatches":
                            q_after["quarantined_batches"]
                            - q_before["quarantined_batches"],

@@ -236,13 +236,50 @@ def build_fused_plan(layers) -> Tuple[List["_FusedStage"], List[List[Any]]]:
 class _PreparedBatch:
     """Host-side output of :meth:`ScoringEngine.prepare_batch`: everything
     the device program needs, already padded to its bucket. Chunked when
-    the batch exceeds the bucket cap."""
+    the batch exceeds the bucket cap.
 
-    __slots__ = ("chunks", "n_rows")
+    When a pipeline :class:`~transmogrifai_tpu.pipeline.BufferPool` was
+    used for the pad-to-bucket staging, ``buffers`` holds the pooled
+    arrays so :meth:`release` can recycle them once the batch has been
+    consumed (after the device pull — by then every transfer that read
+    them has completed). ``release`` is idempotent."""
 
-    def __init__(self, chunks, n_rows: int):
+    __slots__ = ("chunks", "n_rows", "pool", "buffers")
+
+    def __init__(self, chunks, n_rows: int, pool=None, buffers=None):
         self.chunks = chunks      # [(host_store, prepared, uploads, n, bucket)]
         self.n_rows = n_rows
+        self.pool = pool
+        self.buffers = list(buffers) if buffers else []
+
+    def release(self) -> None:
+        if self.pool is None:
+            return
+        bufs, self.buffers = self.buffers, []
+        for b in bufs:
+            self.pool.give(b)
+
+
+class _StagedChunk:
+    """One chunk of a :meth:`ScoringEngine.stage_batch` result: program
+    resolved, row-leading blocks already ``device_put`` (sharded over
+    the mesh when one applies) — the double-buffered upload stage's
+    in-flight unit."""
+
+    __slots__ = ("host_store", "prepared", "uploads", "n", "bucket",
+                 "fn", "out_names", "shards", "was_compile")
+
+    def __init__(self, host_store, prepared, uploads, n, bucket, fn,
+                 out_names, shards, was_compile):
+        self.host_store = host_store
+        self.prepared = prepared
+        self.uploads = uploads
+        self.n = n
+        self.bucket = bucket
+        self.fn = fn
+        self.out_names = out_names      # tuple — must match run_batch's
+        self.shards = shards
+        self.was_compile = was_compile
 
 
 class ScoringEngine:
@@ -566,7 +603,8 @@ class ScoringEngine:
         return np.concatenate([a, pad], axis=0)
 
     def prepare_batch(self, data, use_cache: bool = True,
-                      bucket_min: Optional[int] = None) -> _PreparedBatch:
+                      bucket_min: Optional[int] = None,
+                      pool=None) -> _PreparedBatch:
         """Host half of a scoring call, padded to the bucket ladder —
         safe to run in a worker thread (numpy/python only).
 
@@ -580,11 +618,20 @@ class ScoringEngine:
         (cap-clamped): the model server's per-request parity oracle
         scores a lone request through the SAME program its coalesced
         dispatch used, so co-batching is bit-identical by construction,
-        not by accident of XLA's per-shape compilation."""
+        not by accident of XLA's per-shape compilation.
+
+        ``pool`` (a ``pipeline.BufferPool``) routes the pad-to-bucket
+        staging through reusable pinned buffers instead of fresh
+        allocations — the streaming pipeline's churn fix. Pooled
+        batches are never prep-cached (their buffers recycle after
+        consumption; a cache entry would alias recycled memory), and
+        the values written are bit-identical to the allocating path."""
         import weakref
 
         from .columns import ColumnStore
         cache_key = None
+        if pool is not None:
+            use_cache = False
         if use_cache and isinstance(data, ColumnStore):
             cache_key = (id(data), data.n_rows, bucket_min)
             with self._lock:
@@ -596,6 +643,7 @@ class ScoringEngine:
         store = self._raw_store(data)
         n_total = store.n_rows
         chunks = []
+        taken: List[np.ndarray] = []
         with telemetry.span("score:prepare", rows=n_total):
             for lo in range(0, max(n_total, 1), self.bucket_cap):
                 sub = store
@@ -608,10 +656,16 @@ class ScoringEngine:
                     bucket = min(self.bucket_cap,
                                  max(bucket, int(bucket_min)))
                 host_store, prepared, uploads = self.host_blocks(sub)
-                prepared = {uid: {k: self._pad_rows(v, n, bucket)
+                if pool is not None:
+                    def pad(v):
+                        return pool.pad_rows(v, n, bucket, taken)
+                else:
+                    def pad(v):
+                        return self._pad_rows(v, n, bucket)
+                prepared = {uid: {k: pad(v)
                                   for k, v in blocks.items()}
                             for uid, blocks in prepared.items()}
-                uploads = {k: self._pad_rows(v, n, bucket)
+                uploads = {k: pad(v)
                            for k, v in uploads.items()}
                 if telemetry.enabled():
                     # padded bytes about to cross the host→device link
@@ -624,7 +678,7 @@ class ScoringEngine:
                 chunks.append((host_store, prepared, uploads, n, bucket))
                 if n_total <= self.bucket_cap:
                     break
-        pb = _PreparedBatch(chunks, n_total)
+        pb = _PreparedBatch(chunks, n_total, pool=pool, buffers=taken)
         if cache_key is not None:
             with self._lock:
                 self._prep_cache[cache_key] = (weakref.ref(data), pb)
@@ -882,22 +936,112 @@ class ScoringEngine:
                 w[it.out] = None
         return w
 
+    def stage_batch(self, prep: _PreparedBatch,
+                    results_only: bool = True) -> _PreparedBatch:
+        """The double-buffered upload stage: resolve each chunk's
+        program and issue its row-leading blocks' ``device_put`` NOW —
+        ``jax.device_put`` is asynchronous, so the transfers drain in
+        the background while the consumer is still computing the
+        previous batch. ``run_batch`` on the returned batch skips
+        resolution/sharding and dispatches the staged program directly
+        (``results_only`` must match — asserted there).
+
+        Pool buffers (the pinned staging arrays) move to the staged
+        batch; they recycle only after ITS device pull, by which point
+        every transfer that read them has completed."""
+        import jax
+
+        out_names = tuple(self._out_names(results_only))
+        staged = []
+        for host_store, prepared, uploads, n, bucket in prep.chunks:
+            if not out_names:
+                staged.append((host_store, prepared, uploads, n, bucket))
+                continue
+            resilience.inject("pipeline.upload", rows=n, bucket=bucket)
+            mesh = self._chunk_mesh(bucket)
+            before = self._compile_count
+            # key/resolve off the HOST blocks before any placement
+            fn = self._program(prepared, uploads, list(out_names),
+                               self._mesh_key(mesh))
+            was_compile = self._compile_count > before
+            with telemetry.span("pipeline:upload", rows=n, bucket=bucket,
+                                sharded=mesh is not None):
+                if mesh is not None:
+                    prepared, uploads = self._shard_inputs(
+                        mesh, prepared, uploads, bucket)
+                    shards = mesh.shape["data"]
+                else:
+                    def place(a):
+                        arr = np.asarray(a)
+                        if arr.ndim == 0 or arr.shape[0] != bucket:
+                            return a          # fitted constant: replicated by jit
+                        return jax.device_put(arr)
+                    prepared = {uid: {k: place(v)
+                                      for k, v in blocks.items()}
+                                for uid, blocks in prepared.items()}
+                    uploads = {k: place(v) for k, v in uploads.items()}
+                    shards = 1
+            staged.append(_StagedChunk(host_store, prepared, uploads, n,
+                                       bucket, fn, out_names, shards,
+                                       was_compile))
+        from . import pipeline as _pl
+        # only chunks whose device_put was actually issued count — with
+        # no engine outputs the chunks ride through as plain tuples
+        n_uploads = len(staged) if out_names else 0
+        _pl._tally("staged_uploads", n_uploads)
+        telemetry.counter("pipeline.staged_uploads").inc(n_uploads)
+        out = _PreparedBatch(staged, prep.n_rows, pool=prep.pool,
+                             buffers=prep.buffers)
+        prep.buffers = []          # ownership moved: no double-recycle
+        return out
+
     def run_batch(self, prep: _PreparedBatch, results_only: bool = True):
         """Device half: one jitted dispatch + one pull per chunk, then
-        column wrapping. Returns a ColumnStore."""
+        column wrapping. Returns a ColumnStore. Accepts both plain
+        prepared batches (program resolved + uploaded here) and
+        :meth:`stage_batch` output (uploads already in flight); pooled
+        staging buffers are recycled on the way out either way."""
+        out_names = self._out_names(results_only)
+        try:
+            stores = self._run_chunks(prep, out_names, results_only)
+        finally:
+            prep.release()
+        if len(stores) == 1:
+            return stores[0]
+        return _concat_stores(stores)
+
+    def _run_chunks(self, prep: _PreparedBatch, out_names, results_only):
         import jax
 
         from .columns import ColumnStore, PredictionColumn, VectorColumn
         from .types.feature_types import OPVector
 
-        out_names = self._out_names(results_only)
         stores = []
-        for host_store, prepared, uploads, n, bucket in prep.chunks:
+        for chunk in prep.chunks:
+            is_staged = isinstance(chunk, _StagedChunk)
+            if is_staged:
+                host_store, prepared, uploads = (chunk.host_store,
+                                                 chunk.prepared,
+                                                 chunk.uploads)
+                n, bucket = chunk.n, chunk.bucket
+                if chunk.out_names != tuple(out_names):
+                    raise ValueError(
+                        "stage_batch/run_batch results_only mismatch: "
+                        f"staged for {chunk.out_names}, running "
+                        f"{tuple(out_names)}")
+            else:
+                host_store, prepared, uploads, n, bucket = chunk
             t0 = time.perf_counter()
             was_compile = False
             resilience.inject("scoring.device_dispatch", rows=n,
                               bucket=bucket)
-            if out_names:
+            if out_names and is_staged:
+                was_compile = chunk.was_compile
+                with telemetry.span("score:bucket", rows=n, bucket=bucket,
+                                    compiled=was_compile, staged=True,
+                                    data_shards=chunk.shards):
+                    outs = jax.device_get(chunk.fn(prepared, uploads))
+            elif out_names:
                 mesh = self._chunk_mesh(bucket)
                 before = self._compile_count
                 # key the program off the HOST blocks (shapes/dtypes are
@@ -951,9 +1095,7 @@ class ScoringEngine:
                 store = store.select([nm for nm in self._result_names
                                       if nm in store])
             stores.append(store)
-        if len(stores) == 1:
-            return stores[0]
-        return _concat_stores(stores)
+        return stores
 
     # -- public scoring ----------------------------------------------------
     def transform_store(self, data, use_cache: bool = True):
@@ -1153,42 +1295,67 @@ def _concat_stores(stores):
 
 def stream_score_overlapped(model, batches, keep_intermediate: bool = False,
                             engine: Optional[ScoringEngine] = None,
-                            on_error: Optional[str] = None):
-    """Software-pipelined streaming score: host feature extraction of
-    micro-batch k+1 (record→columns, host transforms, host_prepare,
-    padding) runs in a worker thread while batch k computes on device —
-    the tf.data overlap model on the serving path. Yields one scored
-    ColumnStore per batch, same contract as ``readers.stream_score``.
+                            on_error: Optional[str] = None,
+                            workers: Optional[int] = None,
+                            prefetch: Optional[int] = None):
+    """Pipelined streaming score — the tf.data-staged serving path.
 
-    Falls back to the plain per-batch path when the engine is missing or
-    gated off (slow link).
+    Three stages run concurrently (pipeline.py):
+
+    1. **parallel host prep** — record→columns, host transforms,
+       ``host_prepare`` and pad-to-bucket (through a reusable pinned
+       :class:`~transmogrifai_tpu.pipeline.BufferPool`) run on a named
+       worker pool (``workers``, default ``pipeline.DEFAULT_WORKERS``)
+       with DETERMINISTIC output order — N-worker output is
+       bit-identical to the serial loop, in content and order;
+    2. **autotuned prefetch** — the in-flight depth starts at 2, grows
+       while the consumer starves and shrinks when it never does
+       (``prefetch`` caps it; ``pipeline.PrefetchAutotuner``);
+    3. **double-buffered upload** — batch k+1's ``device_put`` is
+       issued (:meth:`ScoringEngine.stage_batch`) BEFORE batch k's
+       result is pulled, so the host→device transfer overlaps device
+       compute.
+
+    Yields one scored ColumnStore per batch, same contract as
+    ``readers.stream_score``. Falls back to the plain per-batch path
+    when the engine is missing or gated off (slow link).
 
     ``on_error="quarantine"`` routes a batch whose prep raises to the
     resilience dead-letter sink and keeps the pipeline flowing (same
     contract as ``readers.stream_score``, including the sink-aware
-    ``None`` default and the first-batch-always-raises rule). A DEVICE
-    compute failure is handled as a tier failure, not data poison: it
-    reports to the model's scoring-engine circuit breaker and the batch
-    retries on the per-layer host path — only a batch that BOTH tiers
-    reject is quarantined. With the breaker open, remaining batches
-    route straight to the host path (the stream keeps scoring, without
+    ``None`` default and the first-batch-always-raises rule — batches
+    are consumed in order, so index 0 still fails loudly whatever the
+    worker count). A DEVICE compute (or staged upload) failure is
+    handled as a tier failure, not data poison: it reports to the
+    model's scoring-engine circuit breaker and the batch retries on the
+    per-layer host path — only a batch that BOTH tiers reject is
+    quarantined. With the breaker open, remaining batches route
+    straight to the host path (the stream keeps scoring, without
     re-paying a failing dispatch per batch).
 
-    Telemetry (when enabled): the worker's host prep and the consumer's
-    device compute land on separate trace tracks (the overlap is visible
-    in Perfetto), and the run records occupancy gauges —
+    Telemetry (when enabled): each prep worker and the consumer land on
+    their own trace tracks (``pipeline:host_prep`` spans vs
+    ``stream:device_compute``/``pipeline:upload`` — the overlap is
+    visible in Perfetto), ``pipeline.queue_depth`` /
+    ``pipeline.prefetch_depth`` gauges track the pipeline's state live,
+    and the run records the occupancy gauges —
     ``stream.host_occupancy`` / ``stream.device_occupancy`` (busy
     fraction of the stream's wall-clock per side) and
     ``stream.overlap_efficiency`` (achieved fraction of the ideal
-    overlap: ``(host_s + device_s - wall) / min(host_s, device_s)``)."""
-    from concurrent.futures import ThreadPoolExecutor
+    overlap: ``(host_s + device_s - wall) / min(host_s, device_s)``).
+    The always-on ``pipeline.pipeline_stats()`` tallies record the
+    converged prefetch depth and buffer reuse either way."""
+    import itertools
+    import threading
+
+    from . import pipeline as pl
 
     on_error = resilience.resolve_on_error(on_error)
     eng = engine if engine is not None else model.scoring_engine()
     if eng is None or not eng.enabled():
         for i, batch in enumerate(batches):
             try:
-                yield model.score(list(batch),
+                yield model.score(pl.concrete_batch(batch),
                                   keep_intermediate=keep_intermediate)
             except Exception as e:  # lint: broad-except — poison batch quarantines (no-engine path)
                 resilience.quarantine_batch_or_raise(on_error, i, e,
@@ -1199,101 +1366,159 @@ def stream_score_overlapped(model, batches, keep_intermediate: bool = False,
     first = next(it, None)
     if first is None:
         return
+    chained = itertools.chain([first], it)
     tel = telemetry.enabled()
-    host_s = [0.0]      # accumulated on the worker thread
+    n_workers = pl.resolve_workers(workers)
+    tuner = pl.PrefetchAutotuner(
+        max_depth=(int(prefetch) if prefetch is not None
+                   else pl.DEFAULT_MAX_PREFETCH))
+    pool = pl.BufferPool()
+    # host prep busy-span: the UNION of worker-active intervals, not
+    # the per-worker sum — with N workers summed seconds exceed wall
+    # and would saturate the occupancy/overlap gauges at any worker
+    # count, making the headline overlap_efficiency trivially 1.0
+    host_busy = [0.0]
+    host_active = [0]
+    host_t0 = [0.0]
+    host_lock = threading.Lock()
     device_s = 0.0
     n_batches = 0
+    results_only = not keep_intermediate
     t_start = time.perf_counter()
 
-    def _prep(batch):
+    def _prep(item):
+        _i, batch = item
         resilience.inject("stream.score_batch", rows=len(batch))
         if not tel:
-            return eng.prepare_batch(batch)
-        t0 = time.perf_counter()
-        with telemetry.span("stream:host_prep", rows=len(batch)):
+            return eng.prepare_batch(batch, use_cache=False, pool=pool)
+        with host_lock:
+            if host_active[0] == 0:
+                host_t0[0] = time.perf_counter()
+            host_active[0] += 1
+        with telemetry.span("pipeline:host_prep", rows=len(batch)):
             try:
-                return eng.prepare_batch(batch)
+                return eng.prepare_batch(batch, use_cache=False,
+                                         pool=pool)
             finally:
-                host_s[0] += time.perf_counter() - t0
+                with host_lock:
+                    host_active[0] -= 1
+                    if host_active[0] == 0:
+                        host_busy[0] += (time.perf_counter()
+                                         - host_t0[0])
+
+    brk_fn = getattr(model, "_engine_breaker", None)
+    brk = brk_fn() if callable(brk_fn) else None
+
+    def _staged_stream():
+        """Order-preserving prep results, each batch's uploads issued
+        one step AHEAD of its consumption: when the consumer computes
+        batch k, batch k+1's device transfers are already in flight.
+        The breaker is consulted HERE, before the upload — with it open
+        a batch skips ``stage_batch`` entirely and rides straight to
+        the host fallback; the single ``allow()`` call per batch also
+        keeps half-open probe accounting honest (one probe handed out,
+        reported once by the consumer's success/failure record). Note
+        the one-batch skew inherent to staging ahead: batch k+1's
+        upload is issued before the consumer records batch k's outcome,
+        so the trip that opens the breaker can land AFTER one more
+        upload has already gone out — open means at most one straggler,
+        then no further device_put until the reset timeout."""
+        pending = None
+        items = ((i, pl.concrete_batch(b)) for i, b in enumerate(chained))
+        results = pl.map_ordered(_prep, items, workers=n_workers,
+                                 tuner=tuner, name="score-prep")
+        while True:
+            try:
+                (i, batch), prep, exc = next(results)
+            except StopIteration:
+                break
+            except Exception:  # lint: broad-except — flushed and re-raised, nothing swallowed
+                # the batch SOURCE raised (per-item decode faults ride
+                # in order as `exc` instead): flush the already-prepped
+                # pending batch first so every batch produced before
+                # the failure is scored, like the serial path, then
+                # surface the error
+                if pending is not None:
+                    yield pending
+                    pending = None
+                raise
+            staged, stage_exc = None, None
+            if exc is None and (brk is None or brk.allow()):
+                try:
+                    staged = eng.stage_batch(prep,
+                                             results_only=results_only)
+                except Exception as e:  # lint: broad-except — upload failure is a tier failure (handled by the consumer)
+                    stage_exc = e
+            if pending is not None:
+                yield pending
+            pending = (i, batch, prep, staged, exc, stage_exc)
+        if pending is not None:
+            yield pending
 
     try:
-        with ThreadPoolExecutor(max_workers=1,
-                                thread_name_prefix="score-prep") as ex:
-            idx = 0
-            fut_batch = list(first)
-            fut = ex.submit(_prep, fut_batch)
-            while fut is not None:
-                cur_batch = fut_batch
+        for i, batch, prep, staged, exc, stage_exc in _staged_stream():
+            if exc is not None:
+                resilience.quarantine_batch_or_raise(on_error, i, exc,
+                                                     batch)
+                continue
+            # a device/upload failure is a TIER failure, not data
+            # poison: report it to the model's engine breaker and retry
+            # the batch on the per-layer host path; a batch the breaker
+            # refused arrives with staged=None (the upload was never
+            # issued) and falls straight through to the host path
+            store = None
+            if stage_exc is not None:
+                if brk is not None:
+                    brk.record_failure()
+                logger.warning(
+                    "staged upload failed (%r); batch %d retries on "
+                    "the host path", stage_exc, i)
+            elif staged is not None:
+                t0 = time.perf_counter()
                 try:
-                    prep = fut.result()
-                except Exception as e:  # lint: broad-except — poison batch quarantines (prep tier)
-                    resilience.quarantine_batch_or_raise(on_error, idx,
-                                                         e, cur_batch)
-                    prep = None
-                nxt = next(it, None)
-                fut_batch = list(nxt) if nxt is not None else []
-                fut = (ex.submit(_prep, fut_batch)
-                       if nxt is not None else None)
-                if tel:
-                    telemetry.gauge("stream.queue_depth").set(
-                        1 if fut is not None else 0)
-                cur = idx
-                idx += 1
-                if prep is None:
+                    with telemetry.span("stream:device_compute",
+                                        rows=prep.n_rows):
+                        store = eng.run_batch(staged,
+                                              results_only=results_only)
+                    if brk is not None:
+                        brk.record_success()
+                except Exception:  # lint: broad-except — breaker-governed device-tier fallback
+                    if brk is not None:
+                        brk.record_failure()
+                    logger.exception(
+                        "overlapped device compute failed; batch "
+                        "%d retries on the host path", i)
+                finally:
+                    device_s += time.perf_counter() - t0
+            if store is None:
+                (staged if staged is not None else prep).release()
+                try:
+                    store = model.score(
+                        batch,
+                        keep_intermediate=keep_intermediate,
+                        engine=False)
+                except Exception as e:  # lint: broad-except — both tiers rejected: batch quarantines
+                    # both tiers rejected it: now it is poison
+                    resilience.quarantine_batch_or_raise(
+                        on_error, i, e, batch, rows=prep.n_rows)
                     continue
-                # a device failure is a TIER failure, not data poison:
-                # report it to the model's engine breaker and retry the
-                # batch on the per-layer host path; with the breaker
-                # open, skip the failing dispatch entirely
-                brk_fn = getattr(model, "_engine_breaker", None)
-                brk = brk_fn() if callable(brk_fn) else None
-                store = None
-                if brk is None or brk.allow():
-                    t0 = time.perf_counter()
-                    try:
-                        with telemetry.span("stream:device_compute",
-                                            rows=prep.n_rows):
-                            store = eng.run_batch(
-                                prep,
-                                results_only=not keep_intermediate)
-                        if brk is not None:
-                            brk.record_success()
-                    except Exception:  # lint: broad-except — breaker-governed device-tier fallback
-                        if brk is not None:
-                            brk.record_failure()
-                        logger.exception(
-                            "overlapped device compute failed; batch "
-                            "%d retries on the host path", cur)
-                    finally:
-                        device_s += time.perf_counter() - t0
-                if store is None:
-                    try:
-                        store = model.score(
-                            cur_batch,
-                            keep_intermediate=keep_intermediate,
-                            engine=False)
-                    except Exception as e:  # lint: broad-except — both tiers rejected: batch quarantines
-                        # both tiers rejected it: now it is poison
-                        resilience.quarantine_batch_or_raise(
-                            on_error, cur, e, cur_batch,
-                            rows=prep.n_rows)
-                        continue
-                n_batches += 1
-                if not keep_intermediate:
-                    store = store.select([nm for nm in eng._result_names
-                                          if nm in store])
-                yield store
+            n_batches += 1
+            if results_only:
+                store = store.select([nm for nm in eng._result_names
+                                      if nm in store])
+            yield store
     finally:
+        pl.record_stream(n_batches, n_workers, tuner=tuner, pool=pool)
         if tel:
             wall = max(time.perf_counter() - t_start, 1e-9)
             telemetry.counter("stream.batches").inc(n_batches)
-            telemetry.gauge("stream.queue_depth").set(0)
+            telemetry.gauge("pipeline.queue_depth").set(0)
             telemetry.gauge("stream.host_occupancy").set(
-                min(host_s[0] / wall, 1.0))
+                min(host_busy[0] / wall, 1.0))
             telemetry.gauge("stream.device_occupancy").set(
                 min(device_s / wall, 1.0))
-            ideal = min(host_s[0], device_s)
-            eff = ((host_s[0] + device_s - wall) / ideal
+            ideal = min(host_busy[0], device_s)
+            eff = ((host_busy[0] + device_s - wall) / ideal
                    if ideal > 0 else 0.0)
             telemetry.gauge("stream.overlap_efficiency").set(
                 max(0.0, min(eff, 1.0)))

@@ -70,6 +70,15 @@ class FeatureGeneratorStage(Transformer):
         """Run extract_fn over host records → typed column (reader path,
         DataReader.generateDataFrame analog)."""
         key = getattr(self.extract_fn, "_column_key", None)
+        cols = getattr(records, "columns", None)
+        if key is not None and cols is not None and key in cols:
+            # columnar batch (avro.ColumnarRecords, the pipeline's
+            # vectorized decode): the field is already a numpy column —
+            # build the typed column in one bulk pass, no dicts at all
+            from ..columns import column_from_array
+            col = column_from_array(self.ftype, cols[key])
+            if col is not None:
+                return col
         if key is not None and not isinstance(records, np.ndarray):
             # from_column extractors are plain rec.get(name): run the map
             # in C (methodcaller) — at 300k rows × ~8 features the Python

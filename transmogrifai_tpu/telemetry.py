@@ -531,6 +531,16 @@ class RunListener:
                        compiled: bool = False, **_: Any) -> None:
         pass
 
+    def on_pipeline_stats(self, batches: int, workers: int,
+                          prefetch_depth: int, starvations: int = 0,
+                          buffer_reuses: int = 0, buffer_allocs: int = 0,
+                          **_: Any) -> None:
+        """One pipelined ingest stream finished (pipeline.py): how many
+        batches it moved, the worker count it decoded/prepared on, the
+        prefetch depth the autotuner converged to and the
+        starvation/buffer-churn evidence behind that depth."""
+        pass
+
     def on_compile(self, event: str, seconds: float, **_: Any) -> None:
         pass
 
@@ -639,6 +649,7 @@ class CollectingRunListener(RunListener):
         self.compile_seconds = 0.0
         self.stats_passes = 0
         self.fit_passes_saved = 0
+        self.pipeline: Optional[Dict[str, Any]] = None
         self.retries = 0
         self.quarantined: Dict[str, int] = {}
         self.breaker_trips = 0
@@ -699,6 +710,28 @@ class CollectingRunListener(RunListener):
             self.rows_scored += int(n_rows)
             if compiled:
                 self.compiled_batches += 1
+
+    def on_pipeline_stats(self, batches: int, workers: int,
+                          prefetch_depth: int, starvations: int = 0,
+                          buffer_reuses: int = 0, buffer_allocs: int = 0,
+                          **_: Any) -> None:
+        with self._lock:
+            self.events.append("pipeline_stats")
+            prev = self.pipeline or {"streams": 0, "batches": 0,
+                                     "starvations": 0, "bufferReuses": 0,
+                                     "bufferAllocs": 0}
+            # counts accumulate across streams (each stream has its own
+            # pool, so the churn evidence is the SUM); workers and the
+            # converged prefetch depth are per-stream facts — last wins,
+            # same as the module tallies' last_* keys
+            self.pipeline = {
+                "streams": prev["streams"] + 1,
+                "batches": prev["batches"] + int(batches),
+                "workers": int(workers),
+                "prefetchDepth": int(prefetch_depth),
+                "starvations": prev["starvations"] + int(starvations),
+                "bufferReuses": prev["bufferReuses"] + int(buffer_reuses),
+                "bufferAllocs": prev["bufferAllocs"] + int(buffer_allocs)}
 
     def on_compile(self, event: str, seconds: float, **_: Any) -> None:
         with self._lock:
@@ -767,6 +800,8 @@ class CollectingRunListener(RunListener):
                 "compileSeconds": round(self.compile_seconds, 4),
                 "statsPasses": self.stats_passes,
                 "fitPassesSaved": self.fit_passes_saved,
+                "pipeline": dict(self.pipeline) if self.pipeline
+                else None,
                 "retries": self.retries,
                 "quarantined": dict(self.quarantined),
                 "breakerTrips": self.breaker_trips,

@@ -67,7 +67,10 @@ def _generate_raw_store(data, raw_features: Sequence[Feature]) -> ColumnStore:
         if missing:
             raise WorkflowError(f"Input store is missing raw features {missing}")
         return data.select([f.name for f in raw_features])
-    records = list(data)
+    # a columnar batch (avro.ColumnarRecords) already knows its length
+    # and hands extract_column numpy columns directly — materializing
+    # it into dicts here would undo the pipeline's vectorized decode
+    records = data if hasattr(data, "columns") else list(data)
     cols = {}
     for f in raw_features:
         gen = f.origin_stage
@@ -91,6 +94,11 @@ FUSE_MIN_BANDWIDTH_MBPS = 500.0
 
 _DEVICE_BW_MBPS: Optional[float] = None
 
+#: the cold single-shot round-trip measurement (the number that used to
+#: decide the gate alone — kept for the ``fusion_gate`` evidence blocks:
+#: the probe/sustained split explains WHY the gate flipped)
+_DEVICE_BW_PROBE_MBPS: Optional[float] = None
+
 #: jitted per-layer programs keyed by (model ids, prepared shapes)
 _LAYER_JIT_CACHE: Dict[Any, Any] = {}
 
@@ -104,17 +112,32 @@ compile_clock_s = telemetry.compile_clock_s
 
 
 def device_roundtrip_mbps() -> float:
-    """Measured host→device→host bandwidth (MB/s); probed once per
-    process (telemetry.probe_device_roundtrip_mbps) and cached here —
-    tests pin ``_DEVICE_BW_MBPS`` to force the fusion gate either way."""
-    global _DEVICE_BW_MBPS
+    """The link bandwidth (MB/s) the fusion/engine gates decide on;
+    measured once per process and cached here — tests pin
+    ``_DEVICE_BW_MBPS`` to force the gate either way.
+
+    Since the input-pipeline PR this is the SUSTAINED number: the
+    better of the cold single-shot round-trip probe
+    (telemetry.probe_device_roundtrip_mbps — dispatch latency dominates
+    it on a warm link, the 23 MB/s that kept the gate OFF in BENCH_r05)
+    and the pinned-buffer double-buffered measurement
+    (pipeline.probe_sustained_mbps — the rate the staged pipeline's
+    upload path actually achieves). Both raw numbers stay visible in
+    :func:`fusion_state` / the cost db, so a gate decision is always
+    explainable."""
+    global _DEVICE_BW_MBPS, _DEVICE_BW_PROBE_MBPS
     if _DEVICE_BW_MBPS is None:
-        _DEVICE_BW_MBPS = telemetry.probe_device_roundtrip_mbps()
+        _DEVICE_BW_PROBE_MBPS = telemetry.probe_device_roundtrip_mbps()
+        from .pipeline import probe_sustained_mbps
+        _DEVICE_BW_MBPS = max(_DEVICE_BW_PROBE_MBPS,
+                              probe_sustained_mbps())
         logger.info(
-            "layer fusion %s (gate %.0f MB/s)",
+            "layer fusion %s (gate %.0f MB/s; probe %.0f, "
+            "sustained %.0f MB/s)",
             "ON" if _DEVICE_BW_MBPS >= FUSE_MIN_BANDWIDTH_MBPS else
             "OFF (tunnelled/slow link: transforms stay on host)",
-            FUSE_MIN_BANDWIDTH_MBPS)
+            FUSE_MIN_BANDWIDTH_MBPS, _DEVICE_BW_PROBE_MBPS,
+            _DEVICE_BW_MBPS)
     return _DEVICE_BW_MBPS
 
 
@@ -122,10 +145,16 @@ def fusion_state() -> Dict[str, Any]:
     """Layer-fusion gate state for benchmark recording: the measured
     link bandwidth and whether fused device transforms are ON — probed
     once per process (VERDICT r3: every benched number must say whether
-    feature engineering ran fused-on-device or on host)."""
+    feature engineering ran fused-on-device or on host). ``mbps`` is
+    the cold single-shot probe, ``sustained_mbps`` the pipeline's
+    double-buffered measurement — the GATE number (the two together
+    explain a gate flip)."""
     bw = device_roundtrip_mbps()
+    probe = _DEVICE_BW_PROBE_MBPS if _DEVICE_BW_PROBE_MBPS is not None \
+        else bw          # tests pin _DEVICE_BW_MBPS directly
     return {"fusion": "ON" if bw >= FUSE_MIN_BANDWIDTH_MBPS else "OFF",
-            "mbps": round(bw, 1),
+            "mbps": round(probe, 1),
+            "sustained_mbps": round(bw, 1),
             "gate_mbps": FUSE_MIN_BANDWIDTH_MBPS}
 
 
