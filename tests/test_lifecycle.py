@@ -1,0 +1,847 @@
+"""Model lifecycle tier tests (lifecycle.py + server.py rollouts).
+
+The lifecycle correctness contract: a version NAMES fitted weights (the
+AOT state digest), the ``current`` pointer swap is atomic under any
+crash (fresh-interpreter verified), the serving-time drift sentinel
+flags a shifted stream within one sliding window without ever touching
+the score path's results, shadow/canary rollouts keep non-canaried
+traffic bit-identical to solo scoring, automated promotion moves the
+pointer only after clean windows, and automated rollback under an
+injected ``lifecycle.promote`` fault drops zero requests.
+"""
+import json
+import subprocess
+import sys
+import textwrap
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (FeatureBuilder, Workflow, lifecycle, lint,
+                               resilience, serving, telemetry)
+from transmogrifai_tpu import server as server_mod
+from transmogrifai_tpu.features import Feature
+from transmogrifai_tpu.filters.distribution import (FeatureDistribution,
+                                                    Summary,
+                                                    distributions_of_column)
+from transmogrifai_tpu.filters.raw_feature_filter import RawFeatureFilter
+from transmogrifai_tpu.lifecycle import (DriftSentinel, ModelRegistry,
+                                         RegistryError, version_of_export)
+from transmogrifai_tpu.models import (BinaryClassificationModelSelector,
+                                      LogisticRegressionFamily)
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+from transmogrifai_tpu.server import (ModelNotFound, ModelServer,
+                                      RolloutError, serve_http)
+from transmogrifai_tpu.workflow import WorkflowModel, _generate_raw_store
+
+BUCKET_CAP = 64
+
+
+def _train(seed, n=200):
+    rng = np.random.default_rng(seed)
+    y = np.asarray([i % 2 for i in range(n)], float)
+    rng.shuffle(y)
+    records = [{"label": float(y[i]),
+                "x1": float(rng.normal() + y[i]),
+                "x2": float(rng.normal())} for i in range(n)]
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x1").from_column().as_predictor()
+    f2 = FeatureBuilder.Real("x2").from_column().as_predictor()
+    vec = transmogrify([f1, f2])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None,
+        seed=seed)
+    pred = label.transform_with(sel, vec)
+    model = (Workflow().set_input_records(records)
+             .with_raw_feature_filter(RawFeatureFilter(bins=20))
+             .set_result_features(pred).train())
+    return model, records, pred
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    """Two trained versions of ONE model (RawFeatureFilter attached so
+    train-time distributions persist), saved + AOT-exported + registered
+    with v1 promoted."""
+    reg_dir = str(tmp_path_factory.mktemp("registry"))
+    reg = ModelRegistry(reg_dir)
+    out = {"registry": reg, "registry_dir": reg_dir, "versions": {}}
+    for tag, seed in (("v1", 11), ("v2", 12)):
+        model, records, pred = _train(seed)
+        mdir = str(tmp_path_factory.mktemp(f"model_{tag}"))
+        edir = str(tmp_path_factory.mktemp(f"export_{tag}"))
+        model.save(mdir, overwrite=True)
+        serving.export_scoring_fn(model, edir, records[:8],
+                                  bucket_cap=BUCKET_CAP)
+        vid = reg.register("churn", mdir, bank_dir=edir,
+                           train_metrics={"seed": seed},
+                           promote=(tag == "v1"))
+        out[tag] = {"model": model, "records": records, "pred": pred,
+                    "model_dir": mdir, "export_dir": edir, "vid": vid}
+        out["versions"][tag] = vid
+    yield out
+    for tag in ("v1", "v2"):
+        out[tag]["model"]._engine_breaker().reset()
+
+
+@pytest.fixture()
+def fresh_pointer(fleet):
+    """Tests mutate the shared registry's pointer; restore v1-current."""
+    reg = fleet["registry"]
+    yield reg
+    reg.promote("churn", fleet["versions"]["v1"])
+
+
+def _server(fleet, **kw):
+    kw.setdefault("bucket_cap", BUCKET_CAP)
+    kw.setdefault("batch_deadline_s", 0.0)
+    kw.setdefault("registry", fleet["registry"])
+    srv = ModelServer(**kw)
+    srv.register_from_registry("churn")
+    return srv
+
+
+def _assert_bitwise(a, b):
+    for fld in ("prediction", "raw_prediction", "probability"):
+        assert np.array_equal(getattr(a, fld), getattr(b, fld)), fld
+
+
+# ---------------------------------------------------------------------------
+# ModelRegistry
+# ---------------------------------------------------------------------------
+
+
+def test_version_id_is_the_aot_state_digest(fleet):
+    """A registry version NAMES the fitted weights: the id equals the
+    exported AOT manifest's state digest, so the bank loader's
+    weights-vs-manifest verification transitively pins version->weights."""
+    from transmogrifai_tpu import aot
+    t = fleet["v1"]
+    manifest, _ = aot.read_manifest(t["export_dir"])
+    assert manifest is not None
+    assert t["vid"] == manifest["stateDigest"]
+    assert version_of_export(t["model_dir"], t["export_dir"]) == t["vid"]
+    # bankless fallback digests the artifact bytes instead — stable
+    # across calls, different across different models
+    a = version_of_export(t["model_dir"])
+    assert a == version_of_export(t["model_dir"])
+    assert a != version_of_export(fleet["v2"]["model_dir"])
+
+
+def test_register_promote_rollback_roundtrip(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    # registration does not need real artifacts when the version id is
+    # explicit (the registry is a routing table, not a blob store)
+    reg.register("m", str(tmp_path / "a"), version="va")
+    reg.register("m", str(tmp_path / "b"), version="vb")
+    assert reg.current("m") is None
+    with pytest.raises(RegistryError):
+        reg.resolve("m")                    # nothing promoted yet
+    reg.promote("m", "va")
+    assert reg.current("m") == "va" and reg.previous("m") is None
+    reg.promote("m", "vb")
+    assert (reg.current("m"), reg.previous("m")) == ("vb", "va")
+    assert reg.resolve("m")["modelDir"].endswith("b")
+    # rollback swings back; rollback is its own undo
+    assert reg.rollback("m") == "va"
+    assert (reg.current("m"), reg.previous("m")) == ("va", "vb")
+    assert reg.rollback("m") == "vb"
+    # idempotent re-register updates in place: still two versions
+    reg.register("m", str(tmp_path / "b2"), version="vb")
+    assert [r["version"] for r in reg.versions("m")] == ["va", "vb"]
+    assert reg.record("m", "vb")["modelDir"].endswith("b2")
+    assert reg.models() == ["m"]
+
+
+def test_concurrent_registers_from_separate_handles_never_lose_records(
+        tmp_path):
+    """One atomic file per version: two registry handles (standing in
+    for two PROCESSES — CLI + training runner) interleaving registers
+    of the same model both land; there is no shared versions document
+    to lose a read-modify-write race on."""
+    a = ModelRegistry(str(tmp_path / "reg"))
+    b = ModelRegistry(str(tmp_path / "reg"))
+    a.register("m", "/tmp/a", version="va")
+    b.register("m", "/tmp/b", version="vb")
+    a.register("m", "/tmp/c", version="vc")
+    for reg in (a, b):
+        assert [r["version"] for r in reg.versions("m")] == \
+            ["va", "vb", "vc"]
+    with pytest.raises(RegistryError):
+        a.register("m", "/tmp/x", version="../escape")
+
+
+def test_registry_misuse_errors(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    with pytest.raises(RegistryError):
+        reg.promote("ghost", "v0")          # never registered
+    reg.register("m", str(tmp_path / "a"), version="va")
+    with pytest.raises(RegistryError):
+        reg.promote("m", "nope")            # unknown version
+    with pytest.raises(RegistryError):
+        reg.rollback("m")                   # no previous
+    with pytest.raises(RegistryError):
+        reg.register("bad/name", str(tmp_path / "a"), version="v")
+
+
+def test_promote_fault_site_is_cataloged():
+    assert "lifecycle.promote" in resilience.FAULT_SITES
+
+
+def test_crash_mid_promote_leaves_pointer_intact_fresh_interpreter(
+        tmp_path):
+    """The atomic-pointer guarantee, verified across interpreters: a
+    promote killed by an injected fault leaves the OLD pointer readable
+    by a FRESH process — never a torn or half-switched state."""
+    reg_dir = str(tmp_path / "reg")
+    crash = textwrap.dedent(f"""
+        import sys
+        from transmogrifai_tpu import resilience
+        from transmogrifai_tpu.lifecycle import ModelRegistry
+        reg = ModelRegistry({reg_dir!r})
+        reg.register("m", "/tmp/a", version="va", promote=True)
+        reg.register("m", "/tmp/b", version="vb")
+        plan = resilience.FaultPlan(seed=7).on("lifecycle.promote",
+                                               error=OSError)
+        with resilience.fault_plan(plan):
+            try:
+                reg.promote("m", "vb")
+            except OSError:
+                sys.exit(41)        # the "crash": process dies mid-promote
+        sys.exit(1)
+    """)
+    proc = subprocess.run([sys.executable, "-c", crash],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 41, proc.stderr[-800:]
+    probe = textwrap.dedent(f"""
+        import sys
+        from transmogrifai_tpu.lifecycle import ModelRegistry
+        reg = ModelRegistry({reg_dir!r})
+        assert reg.current("m") == "va", reg.current("m")
+        assert reg.resolve("m")["modelDir"] == "/tmp/a"
+        reg.promote("m", "vb")      # the registry is not wedged
+        assert reg.current("m") == "vb"
+        sys.exit(0)
+    """)
+    proc = subprocess.run([sys.executable, "-c", probe],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-800:]
+
+
+# ---------------------------------------------------------------------------
+# DriftSentinel
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_sentinel(rng, n=256, bins=20, **kw):
+    feats = [FeatureBuilder.Real("x1").from_column().as_predictor(),
+             FeatureBuilder.Real("x2").from_column().as_predictor()]
+    recs = [{"x1": float(rng.normal()), "x2": float(rng.normal())}
+            for _ in range(n)]
+    store = _generate_raw_store(recs, feats)
+    summaries, baseline = {}, []
+    for f in feats:
+        summaries[(f.name, None)] = Summary.of_values(
+            np.asarray([r[f.name] for r in recs]))
+        baseline += distributions_of_column(f.name, store[f.name], bins,
+                                            summaries)
+    return DriftSentinel(baseline, feats, **kw), recs
+
+
+def test_sentinel_sliding_window_and_in_distribution_silence():
+    rng = np.random.default_rng(3)
+    s, recs = _synthetic_sentinel(rng, window_rows=64, subwindows=4)
+    assert s.subwindow_rows == 16
+    # in-distribution traffic: windows compare, nothing fires
+    for lo in range(0, 128, 8):
+        out = s.observe([{"x1": float(rng.normal()),
+                          "x2": float(rng.normal())}
+                         for _ in range(8)])
+        assert out == []
+    st = s.stats()
+    # ring filled at 64 rows, then slid every 16-row sub-window
+    assert st["windowsCompared"] == 5
+    assert st["advisories"] == 0
+    assert st["trackedFeatures"] == 2
+    assert st["lastWindow"]["rows"] == 64
+
+
+def test_sentinel_flags_shift_within_one_window():
+    rng = np.random.default_rng(4)
+    s, _ = _synthetic_sentinel(rng, window_rows=64, subwindows=4)
+    fired = []
+    rows = 0
+    while rows < 64 and not fired:
+        fired = s.observe([{"x1": float(rng.normal() + 0.0),
+                            "x2": float(rng.normal() * 0.05 + 2.5)}
+                           for _ in range(8)])
+        rows += 8
+    assert rows <= 64, "advisory must fire within one window of shift"
+    assert "TMG602" not in {f.rule for f in fired}
+    assert {f.rule for f in fired} == {"TMG601"}
+    (f,) = [f for f in fired if f.feature == "x2"]
+    assert "JS divergence" in f.message
+
+
+def test_sentinel_out_of_support_shift_is_maximal_divergence():
+    """Live values entirely OUTSIDE the train bin range would be
+    invisible to the in-range histogram (empty -> JS 0.0); the
+    out-of-range mass guard reads them as what they are: maximal."""
+    rng = np.random.default_rng(5)
+    s, _ = _synthetic_sentinel(rng, window_rows=32, subwindows=4)
+    s.observe([{"x1": float(1000.0 + i), "x2": float(rng.normal())}
+               for i in range(32)])
+    assert s.last_report["features"]["x1"]["js"] == 1.0
+    assert any(f.rule == "TMG601" and f.feature == "x1"
+               for f in s.last_findings)
+
+
+def test_sentinel_fill_rate_shift_fires_tmg602():
+    rng = np.random.default_rng(6)
+    s, _ = _synthetic_sentinel(rng, window_rows=32, subwindows=4)
+    # x2 vanishes from live traffic: fill 1.0 (train) -> 0.0 (live)
+    findings = s.observe([{"x1": float(rng.normal())} for _ in range(32)])
+    assert any(f.rule == "TMG602" and f.feature == "x2"
+               for f in findings)
+    info = s.last_report["features"]["x2"]
+    assert info["liveFill"] == 0.0 and info["trainFill"] == 1.0
+
+
+def test_sentinel_suppress_and_telemetry_hooks():
+    rng = np.random.default_rng(7)
+    # suppressed rules are muted but the window math still runs
+    s, _ = _synthetic_sentinel(rng, window_rows=32, subwindows=4,
+                               suppress=("TMG601", "TMG602"))
+    out = s.observe([{"x1": 1000.0} for _ in range(32)])
+    assert out == [] and s.stats()["windowsCompared"] == 1
+    assert s.stats()["advisories"] == 0
+    # unsuppressed: the on_drift listener hook + drift.* gauges fire
+    telemetry.enable()
+    try:
+        listener = telemetry.add_listener(telemetry.CollectingRunListener())
+        s2, _ = _synthetic_sentinel(rng, window_rows=32, subwindows=4,
+                                    model_name="churn")
+        s2.observe([{"x1": 1000.0, "x2": float(rng.normal())}
+                    for _ in range(32)])
+        assert listener.drift_advisories.get("TMG601", 0) >= 1
+        assert "drift" in listener.events
+        doc = telemetry.metrics_json()
+        assert doc.get("drift.js_divergence.x1") == 1.0
+        assert "lifecycle.drift_advisories" in doc
+        summary = listener.summary()
+        assert summary["driftAdvisories"].get("TMG601", 0) >= 1
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_sentinel_for_model_without_baseline_emits_tmg603():
+    telemetry.enable()
+    try:
+        listener = telemetry.add_listener(telemetry.CollectingRunListener())
+        bare = SimpleNamespace(rff_results=None, result_features=[])
+        assert DriftSentinel.for_model(bare, model_name="bare") is None
+        # TMG603 is INFO severity; the lint mirror carries it
+        assert listener.lint_findings.get("info", 0) == 1
+        assert "lint" in listener.events
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_drift_rules_are_cataloged():
+    for rule in ("TMG601", "TMG602", "TMG603"):
+        assert rule in lint.RULES
+
+
+# ---------------------------------------------------------------------------
+# RawFeatureFilterResults persistence (the sentinel's baseline)
+# ---------------------------------------------------------------------------
+
+
+def test_rff_results_roundtrip_through_saved_model(fleet):
+    t = fleet["v1"]
+    assert t["model"].rff_results is not None
+    loaded = WorkflowModel.load(t["model_dir"])
+    rff = loaded.rff_results
+    assert rff is not None
+    assert {d.name for d in rff.training_distributions} == {"x1", "x2"}
+    orig = {d.name: d for d in
+            t["model"].rff_results.training_distributions}
+    for d in rff.training_distributions:
+        assert np.array_equal(d.distribution, orig[d.name].distribution)
+        assert d.summary_info == orig[d.name].summary_info
+    assert rff.config.get("bins") == 20
+    summ = rff.summary()
+    assert summ["trainingDistributions"] == 2
+    assert summ["excludedCount"] == len(summ["excluded"])
+
+
+def test_runner_stamps_lifecycle_and_rff_summary(fleet, tmp_path):
+    from transmogrifai_tpu.runner import OpParams, OpWorkflowRunner, RunType
+
+    class _Reader:
+        def read_records(self):
+            return list(fleet["v1"]["records"])
+
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x1").from_column().as_predictor()
+    f2 = FeatureBuilder.Real("x2").from_column().as_predictor()
+    vec = transmogrify([f1, f2])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None,
+        seed=3)
+    pred = label.transform_with(sel, vec)
+    wf = (Workflow().set_result_features(pred)
+          .with_raw_feature_filter(RawFeatureFilter(bins=10)))
+    params = OpParams(model_location=str(tmp_path / "model"),
+                      metrics_location=str(tmp_path / "metrics.json"))
+    out = OpWorkflowRunner(wf, training_reader=_Reader()).run(
+        RunType.TRAIN, params)
+    rffs = out.metrics["rawFeatureFilter"]
+    assert rffs is not None and rffs["trainingDistributions"] == 2
+    sunk = json.load(open(params.metrics_location))
+    assert sunk["rawFeatureFilter"]["featuresChecked"] >= 2
+    assert set(lifecycle.lifecycle_stats()) == set(sunk["lifecycle"])
+
+
+# ---------------------------------------------------------------------------
+# server: registry tenants, shadow, canary, automated promote/rollback
+# ---------------------------------------------------------------------------
+
+
+def test_registry_tenant_serves_current_and_reresolves_on_reload(
+        fleet, fresh_pointer):
+    reg = fresh_pointer
+    srv = _server(fleet)
+    try:
+        t1, t2 = fleet["v1"], fleet["v2"]
+        res = srv.score("churn", t1["records"][:4], timeout_s=120)
+        solo = t1["model"].scoring_engine(
+            gate_bandwidth=False, mesh=False,
+            bucket_cap=BUCKET_CAP).score_store(t1["records"][:4],
+                                               bucket_min=res.bucket)
+        _assert_bitwise(res.store[t1["pred"].name], solo[t1["pred"].name])
+        # promote v2 out-of-band, then evict: the reload re-resolves the
+        # CURRENT pointer and serves the new version
+        reg.promote("churn", t2["vid"])
+        entry = srv._entries["churn"]
+        with entry.lock:
+            entry.model = None
+            entry.engine = None
+            entry.bank_buckets = []
+            entry.sentinel = None
+        res2 = srv.score("churn", t1["records"][:4], timeout_s=120)
+        solo2 = t2["model"].scoring_engine(
+            gate_bandwidth=False, mesh=False,
+            bucket_cap=BUCKET_CAP).score_store(t1["records"][:4],
+                                               bucket_min=res2.bucket)
+        _assert_bitwise(res2.store[t2["pred"].name],
+                        solo2[t2["pred"].name])
+        assert srv.stats()["models"]["churn"]["viaRegistry"] is True
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_register_via_registry_needs_registry():
+    srv = ModelServer(registry=None)
+    try:
+        with pytest.raises(RolloutError):
+            srv.register_from_registry("churn")
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_shadow_rollout_parity_latency_and_solo_bit_identity(
+        fleet, fresh_pointer):
+    """Shadow of the SAME artifacts: every mirrored request records
+    parity ok, latency delta is measured, responses stay bit-identical
+    to solo scoring, and clean windows auto-promote (pointer unchanged
+    for a same-version refresh)."""
+    t1 = fleet["v1"]
+    srv = _server(fleet)
+    before = lifecycle.lifecycle_stats()
+    try:
+        srv.deploy("churn", t1["vid"], mode="shadow",
+                   window_requests=4, promote_windows=2)
+        with pytest.raises(RolloutError):        # one rollout at a time
+            srv.deploy("churn", t1["vid"], mode="shadow")
+        for i in range(6):
+            res = srv.score("churn", t1["records"][i * 3:(i + 1) * 3],
+                            timeout_s=120)
+            assert res.canary is False
+            solo = t1["model"].scoring_engine(
+                gate_bandwidth=False, mesh=False,
+                bucket_cap=BUCKET_CAP).score_store(
+                    t1["records"][i * 3:(i + 1) * 3],
+                    bucket_min=res.bucket)
+            _assert_bitwise(res.store[t1["pred"].name],
+                            solo[t1["pred"].name])
+        # 6 requests x window 4 -> 1+ windows; finish to auto-promote
+        for i in range(4):
+            srv.score("churn", t1["records"][:2], timeout_s=120)
+        after = lifecycle.lifecycle_stats()
+        assert after["deploys"] - before["deploys"] == 1
+        assert after["auto_promotions"] - before["auto_promotions"] == 1
+        assert after["shadow_requests"] - before["shadow_requests"] >= 8
+        assert after["shadow_parity_ok"] - before["shadow_parity_ok"] >= 8
+        assert (after["shadow_parity_mismatch"]
+                == before["shadow_parity_mismatch"])
+        assert srv._entries["churn"].rollout is None
+        assert fresh_pointer.current("churn") == t1["vid"]
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_shadow_mismatch_blocks_promotion(fleet, fresh_pointer):
+    """A candidate whose predictions DIFFER never reaches a clean
+    window: parity mismatches are recorded and block auto-promote."""
+    t1, t2 = fleet["v1"], fleet["v2"]
+    srv = _server(fleet)
+    try:
+        srv.deploy("churn", t2["vid"], mode="shadow",
+                   window_requests=2, promote_windows=1)
+        for i in range(8):
+            srv.score("churn", t1["records"][i:i + 2], timeout_s=120)
+        status = srv.lifecycle_status("churn")
+        assert status["rollout"] is not None, "must NOT have promoted"
+        assert status["rollout"]["parityMismatch"] >= 1
+        assert status["rollout"]["cleanWindows"] == 0
+        assert status["rollout"]["shadowLatencyDeltaMs"] is not None
+        assert fresh_pointer.current("churn") == t1["vid"]
+        out = srv.rollback("churn")              # manual abort
+        assert out["aborted"] == t2["vid"]
+        assert srv._entries["churn"].rollout is None
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_canary_routing_deterministic_and_noncanaried_bit_identical(
+        fleet, fresh_pointer):
+    t1, t2 = fleet["v1"], fleet["v2"]
+    srv = _server(fleet)
+    try:
+        srv.deploy("churn", t2["vid"], mode="canary", fraction=0.5,
+                   window_requests=10_000, promote_windows=100)
+        flags = {}
+        for i in range(24):
+            res = srv.score("churn", [t1["records"][i]], timeout_s=120)
+            flags[i] = res.canary
+            if not res.canary:
+                # the solo-path contract: non-canaried rows bit-identical
+                solo = t1["model"].scoring_engine(
+                    gate_bandwidth=False, mesh=False,
+                    bucket_cap=BUCKET_CAP).score_store(
+                        [t1["records"][i]], bucket_min=res.bucket)
+                _assert_bitwise(res.store[t1["pred"].name],
+                                solo[t1["pred"].name])
+        assert any(flags.values()) and not all(flags.values())
+        # deterministic: the SAME record routes the SAME way, always
+        for i in (0, 5, 11):
+            res = srv.score("churn", [t1["records"][i]], timeout_s=120)
+            assert res.canary == flags[i]
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_canary_auto_promotes_after_clean_windows(fleet, fresh_pointer):
+    t1, t2 = fleet["v1"], fleet["v2"]
+    srv = _server(fleet)
+    before = lifecycle.lifecycle_stats()
+    try:
+        srv.deploy("churn", t2["vid"], mode="canary", fraction=0.5,
+                   window_requests=4, promote_windows=2)
+        n = 0
+        while fresh_pointer.current("churn") != t2["vid"] and n < 64:
+            res = srv.score("churn", [t1["records"][n % 100]],
+                            timeout_s=120)
+            assert res.rows == 1
+            n += 1
+        assert fresh_pointer.current("churn") == t2["vid"]
+        assert srv._entries["churn"].rollout is None
+        after = lifecycle.lifecycle_stats()
+        assert after["auto_promotions"] - before["auto_promotions"] == 1
+        assert after["canary_requests"] > before["canary_requests"]
+        # the promoted model serves: bit-identical to v2 solo
+        res = srv.score("churn", t1["records"][:4], timeout_s=120)
+        solo = t2["model"].scoring_engine(
+            gate_bandwidth=False, mesh=False,
+            bucket_cap=BUCKET_CAP).score_store(t1["records"][:4],
+                                               bucket_min=res.bucket)
+        _assert_bitwise(res.store[t2["pred"].name], solo[t2["pred"].name])
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_canary_promote_fault_rolls_back_with_zero_drops(
+        fleet, fresh_pointer):
+    """The acceptance chaos test: a seeded fault on ``lifecycle.promote``
+    during a canary rollout. The automated promotion fails, automated
+    rollback fires, EVERY request across the switch is answered (zero
+    drops, nothing quarantined), the registry pointer never moves, and
+    post-rollback traffic is bit-identical to the stable version."""
+    t1, t2 = fleet["v1"], fleet["v2"]
+    srv = _server(fleet)
+    before = lifecycle.lifecycle_stats()
+    q_before = resilience.resilience_stats()
+    plan = resilience.FaultPlan(seed=9).on("lifecycle.promote",
+                                           error=RuntimeError)
+    try:
+        srv.deploy("churn", t2["vid"], mode="canary", fraction=1.0,
+                   window_requests=2, promote_windows=1)
+        answered = 0
+        with resilience.fault_plan(plan):
+            for i in range(12):
+                res = srv.score("churn", [t1["records"][i]], timeout_s=120)
+                answered += int(res.rows == 1)
+        assert answered == 12, "a rollout switch must drop zero requests"
+        assert plan.fired("lifecycle.promote") == 1
+        after = lifecycle.lifecycle_stats()
+        assert after["auto_rollbacks"] - before["auto_rollbacks"] == 1
+        assert after["auto_promotions"] == before["auto_promotions"]
+        assert srv._entries["churn"].rollout is None
+        assert fresh_pointer.current("churn") == t1["vid"]
+        q_after = resilience.resilience_stats()
+        for k in ("quarantined_batches", "quarantined_records"):
+            assert q_after[k] == q_before[k]
+        # the stable version still serves, bit-identically
+        res = srv.score("churn", t1["records"][:4], timeout_s=120)
+        solo = t1["model"].scoring_engine(
+            gate_bandwidth=False, mesh=False,
+            bucket_cap=BUCKET_CAP).score_store(t1["records"][:4],
+                                               bucket_min=res.bucket)
+        _assert_bitwise(res.store[t1["pred"].name], solo[t1["pred"].name])
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_window_without_candidate_evidence_neither_promotes_nor_resets(
+        fleet, fresh_pointer):
+    """A window in which no request touched the candidate (host-tier
+    primaries under shadow, zero canaried requests) proves nothing:
+    it must not advance the promotion count — and must not reset it."""
+    t1 = fleet["v1"]
+    srv = _server(fleet)
+    try:
+        srv.deploy("churn", t1["vid"], mode="shadow",
+                   window_requests=1, promote_windows=1)
+        entry = srv._entries["churn"]
+        rollout = entry.rollout
+        srv._rollout_tick(entry, rollout, 1)     # evidence-free window
+        assert entry.rollout is rollout, \
+            "must NOT promote on zero parity evidence"
+        assert rollout.windows == 1 and rollout.clean_windows == 0
+        rollout.win_evidence = 2                 # now the window proves
+        srv._rollout_tick(entry, rollout, 1)
+        assert entry.rollout is None             # promoted
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_manual_rollback_wins_over_racing_auto_promote(
+        fleet, fresh_pointer):
+    """An operator's rollback() landing between the worker's
+    clean-window check and its promote must stick: the promote
+    re-checks the rollout's identity under the entry lock and gives
+    up."""
+    t1, t2 = fleet["v1"], fleet["v2"]
+    srv = _server(fleet)
+    try:
+        srv.deploy("churn", t2["vid"], mode="shadow",
+                   window_requests=10 ** 6)
+        entry = srv._entries["churn"]
+        rollout = entry.rollout
+        assert srv.rollback("churn")["aborted"] == t2["vid"]
+        before = lifecycle.lifecycle_stats()
+        srv._promote_rollout(entry, rollout)     # the racing worker
+        after = lifecycle.lifecycle_stats()
+        assert after["auto_promotions"] == before["auto_promotions"]
+        assert fresh_pointer.current("churn") == t1["vid"]
+        assert entry.rollout is None
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_poison_request_during_rollout_never_kills_the_worker(
+        fleet, fresh_pointer):
+    """A record whose dict KEY is not JSON-serializable (tuple key —
+    ``json.dumps`` raises even with ``default=str``) must not kill the
+    tenant's worker thread mid-rollout: canary routing falls back to
+    the stable path (which scores the absent features as nulls) and
+    the next request is answered normally."""
+    t1, t2 = fleet["v1"], fleet["v2"]
+    srv = _server(fleet)
+    try:
+        srv.deploy("churn", t2["vid"], mode="canary", fraction=1.0,
+                   window_requests=10 ** 6)
+        res = srv.score("churn", [{(1, 2): "unroutable"}], timeout_s=120)
+        assert res.rows == 1 and res.canary is False
+        res = srv.score("churn", t1["records"][:2], timeout_s=120)
+        assert res.rows == 2
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_deploy_misuse_errors(fleet):
+    srv = _server(fleet)
+    try:
+        with pytest.raises(RolloutError):
+            srv.deploy("churn", fleet["v1"]["vid"], mode="blue-green")
+        with pytest.raises(RegistryError):
+            srv.deploy("churn", "no-such-version")
+        with pytest.raises(RolloutError):
+            srv.deploy("churn", fleet["v2"]["vid"], mode="canary",
+                       fraction=1.5)
+        with pytest.raises(ModelNotFound):
+            srv.deploy("ghost", fleet["v1"]["vid"])
+        no_reg = ModelServer()
+        try:
+            no_reg.register("m", model_dir=fleet["v1"]["model_dir"])
+            with pytest.raises(RolloutError):
+                no_reg.deploy("m", "v")
+            with pytest.raises(RolloutError):
+                no_reg.rollback("m")     # no rollout and no registry
+        finally:
+            no_reg.shutdown(drain=True)
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_server_drift_sentinel_flags_shifted_traffic(fleet):
+    srv = _server(fleet, drift_window=64)
+    try:
+        t1 = fleet["v1"]
+        for lo in range(0, 64, 8):
+            srv.score("churn", t1["records"][lo:lo + 8], timeout_s=120)
+        srv.drain_drift()
+        st = srv.stats()["models"]["churn"]["drift"]
+        assert st["windowsCompared"] >= 1 and st["advisories"] == 0
+        shifted = [{"label": 0.0, "x1": 500.0, "x2": 0.1}] * 8
+        for _ in range(8):
+            srv.score("churn", shifted, timeout_s=120)
+        srv.drain_drift()
+        st = srv.stats()["models"]["churn"]["drift"]
+        assert st["advisories"] >= 1, "shifted stream must trip TMG6xx"
+        assert srv.lifecycle_status("churn")["drift"] == st
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_http_lifecycle_endpoints(fleet, fresh_pointer):
+    import http.client
+    t1, t2 = fleet["v1"], fleet["v2"]
+    srv = _server(fleet)
+    httpd = serve_http(srv, port=0)
+    host, port = httpd.server_address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+
+        def call(method, path, body=None):
+            conn.request(method, path,
+                         None if body is None else json.dumps(body),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            return r.status, json.loads(r.read() or b"{}")
+
+        status, doc = call("GET", "/v1/models/churn/versions")
+        assert status == 200
+        assert doc["current"] == t1["vid"] and doc["rollout"] is None
+        assert {r["version"] for r in doc["versions"]} == \
+            {t1["vid"], t2["vid"]}
+        status, doc = call("POST", "/v1/models/churn:deploy",
+                           {"version": t2["vid"], "mode": "shadow",
+                            "windowRequests": 1000})
+        assert status == 200 and doc["rollout"]["mode"] == "shadow"
+        status, doc = call("GET", "/v1/models/churn/versions")
+        assert doc["rollout"]["version"] == t2["vid"]
+        status, doc = call("POST", "/v1/models/churn:score",
+                           {"records": t1["records"][:2]})
+        assert status == 200 and doc["rows"] == 2
+        assert doc["canary"] is False
+        status, doc = call("POST", "/v1/models/churn:rollback", {})
+        assert status == 200 and doc["aborted"] == t2["vid"]
+        status, _ = call("POST", "/v1/models/churn:deploy",
+                         {"version": t2["vid"], "mode": "blue-green"})
+        assert status == 400
+        status, _ = call("GET", "/v1/models/ghost/versions")
+        assert status == 404
+    finally:
+        httpd.shutdown()
+        srv.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# CLI: registry subcommand + lifecycle knobs
+# ---------------------------------------------------------------------------
+
+
+def test_cli_registry_subcommand(fleet, tmp_path, capsys):
+    from transmogrifai_tpu.cli import main
+    reg_dir = str(tmp_path / "reg")
+    t1, t2 = fleet["v1"], fleet["v2"]
+    rc = main(["registry", "register", "--registry", reg_dir,
+               "--model", "churn", "--model-dir", t1["model_dir"],
+               "--bank", t1["export_dir"], "--promote"])
+    assert rc == 0
+    assert t1["vid"] in capsys.readouterr().out
+    rc = main(["registry", "register", "--registry", reg_dir,
+               "--model", "churn", "--model-dir", t2["model_dir"],
+               "--bank", t2["export_dir"]])
+    assert rc == 0 and t2["vid"] in capsys.readouterr().out
+    rc = main(["registry", "list", "--registry", reg_dir, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0 and doc["churn"]["current"] == t1["vid"]
+    assert len(doc["churn"]["versions"]) == 2
+    rc = main(["registry", "promote", "--registry", reg_dir,
+               "--model", "churn", "--version", t2["vid"]])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main(["registry", "current", "--registry", reg_dir,
+               "--model", "churn"])
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == t2["vid"]
+    rc = main(["registry", "rollback", "--registry", reg_dir,
+               "--model", "churn"])
+    assert rc == 0 and t1["vid"] in capsys.readouterr().out
+    # misuse fails loudly, exit 1
+    rc = main(["registry", "promote", "--registry", reg_dir,
+               "--model", "churn", "--version", "nope"])
+    assert rc == 1
+    capsys.readouterr()
+
+
+def test_cli_gen_emits_lifecycle_knobs_and_check_validates(tmp_path,
+                                                           capsys):
+    from transmogrifai_tpu.cli import generate_project, run_check
+    csv = tmp_path / "data.csv"
+    csv.write_text("label,x\n1,0.5\n0,0.1\n1,0.9\n0,0.2\n")
+    files = generate_project(str(csv), "label", str(tmp_path / "proj"))
+    params = json.load(open(files["params.json"]))
+    cp = params["customParams"]
+    for knob in ("registryDir", "driftWindow", "driftJsThreshold",
+                 "canaryFraction"):
+        assert knob in cp and cp[knob] is None
+    # valid knobs pass the TMG001 numeric validation
+    p = tmp_path / "params.json"
+    p.write_text(json.dumps({"customParams": {
+        "driftWindow": 2048, "driftJsThreshold": 0.2,
+        "canaryFraction": 0.1, "registryDir": "./registry"}}))
+    assert run_check(str(p)) == 0
+    capsys.readouterr()
+    for bad in ({"driftWindow": 2.5}, {"driftWindow": 0},
+                {"driftJsThreshold": "hot"}, {"canaryFraction": 1.5},
+                {"canaryFraction": 0}, {"registryDir": 42}):
+        p.write_text(json.dumps({"customParams": bad}))
+        assert run_check(str(p)) == 1, bad
+        out = capsys.readouterr().out
+        assert "TMG001" in out and next(iter(bad)) in out
+
+
+def test_lifecycle_stats_reset_and_server_stamp(fleet):
+    srv = _server(fleet)
+    try:
+        stats = srv.stats()
+        assert set(stats["lifecycle"]) == set(lifecycle.lifecycle_stats())
+    finally:
+        srv.shutdown(drain=True)

@@ -51,6 +51,20 @@ def test_distribution_monoid_and_metrics(rng):
     assert d.js_divergence(d) == pytest.approx(0.0, abs=1e-12)
 
 
+def test_relative_fill_ratio_of_two_empty_features_is_one():
+    """Regression: hi/lo with hi == lo == 0 used to return inf — a
+    false maximal-drift signal for two identically-EMPTY features.
+    0/0 is ratio 1 (maximally similar); only 0-vs-nonzero is inf."""
+    from transmogrifai_tpu.filters.distribution import FeatureDistribution
+    empty_a = FeatureDistribution("x", count=10, nulls=10)
+    empty_b = FeatureDistribution("x", count=4, nulls=4)
+    assert empty_a.relative_fill_ratio(empty_b) == 1.0
+    assert empty_a.relative_fill_ratio(empty_a) == 1.0
+    full = FeatureDistribution("x", count=10, nulls=0)
+    assert empty_a.relative_fill_ratio(full) == float("inf")
+    assert full.relative_fill_ratio(empty_a) == float("inf")
+
+
 def test_summary_monoid():
     s = Summary.of_values(np.array([1.0, 5.0])) + Summary.of_values(
         np.array([-2.0]))

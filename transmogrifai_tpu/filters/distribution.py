@@ -101,6 +101,10 @@ class FeatureDistribution:
     def relative_fill_ratio(self, other: "FeatureDistribution") -> float:
         a, b = self.fill_rate(), other.fill_rate()
         lo, hi = min(a, b), max(a, b)
+        # two identically-empty features are maximally SIMILAR, not
+        # maximally drifted: 0/0 is ratio 1, not inf
+        if hi == 0.0:
+            return 1.0
         return float("inf") if lo == 0.0 else hi / lo
 
     def js_divergence(self, other: "FeatureDistribution") -> float:

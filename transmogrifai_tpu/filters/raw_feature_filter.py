@@ -102,6 +102,19 @@ class RawFeatureFilterResults:
                 "trainingDistributions": [d.to_json() for d in self.training_distributions],
                 "scoringDistributions": [d.to_json() for d in self.scoring_distributions]}
 
+    def summary(self) -> Dict[str, Any]:
+        """The compact block the runner stamps in its train metrics doc:
+        how many (feature, key) pairs were checked, which were excluded
+        and why-counts, and whether train-time distributions were
+        persisted (the serving-time drift sentinel's baseline)."""
+        excluded = [(f"{r.name}({r.key})" if r.key is not None else r.name)
+                    for r in self.exclusion_reasons if r.excluded]
+        return {"featuresChecked": len(self.metrics),
+                "excluded": excluded,
+                "excludedCount": len(excluded),
+                "trainingDistributions": len(self.training_distributions),
+                "config": dict(self.config)}
+
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "RawFeatureFilterResults":
         return RawFeatureFilterResults(

@@ -151,6 +151,17 @@ RULES: Dict[str, Tuple[str, str]] = {
     "TMG503": (Severity.WARNING,
                "serving export version skew: artifact exported under a "
                "different jax/jaxlib than this process runs"),
+    # -- TMG6xx: serving-time drift advisories (lifecycle.DriftSentinel —
+    #    the continuous RawFeatureFilter; never crash paths) ---------------
+    "TMG601": (Severity.WARNING,
+               "serving-time drift: train↔live JS divergence above "
+               "threshold over the sliding comparison window"),
+    "TMG602": (Severity.WARNING,
+               "serving-time drift: live fill rate shifted from the "
+               "train-time fill rate beyond the delta/ratio thresholds"),
+    "TMG603": (Severity.INFO,
+               "drift sentinel inactive: model carries no train-time "
+               "feature distributions (RawFeatureFilterResults)"),
     # -- TMG4xx: whole-DAG planner advisories (planner.py) -----------------
     "TMG401": (Severity.WARNING,
                "stage measured slower on device than host but is pinned "

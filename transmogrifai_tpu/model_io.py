@@ -491,10 +491,19 @@ def load_workflow_model(path: str):
     result_features = [feat_by_uid[u] for u in doc["resultFeatureUids"]]
     fitted = {uid: st for uid, st in stage_by_uid.items()
               if isinstance(st, FittedModel)}
+    rff_results = None
+    if doc.get("rawFeatureFilterResults"):
+        # round-trip the train-time feature distributions + exclusion
+        # reasons: the serving-time drift sentinel compares live traffic
+        # against these, so a loaded model must carry what its save wrote
+        from .filters.raw_feature_filter import RawFeatureFilterResults
+        rff_results = RawFeatureFilterResults.from_json(
+            doc["rawFeatureFilterResults"])
     model = WorkflowModel(
         result_features=result_features,
         fitted_stages=fitted,
         parameters=doc.get("parameters") or {},
+        rff_results=rff_results,
         train_time_s=doc.get("trainTimeSeconds", 0.0),
     )
     model.uid = doc["uid"]

@@ -120,6 +120,11 @@ FAULT_SITES = frozenset({
     "server.dispatch",           # model-server micro-batch dispatch
                                  # (server.py — batch AND per-request
                                  # fallback attempts pass through it)
+    "lifecycle.promote",         # registry current-pointer swap
+                                 # (lifecycle.ModelRegistry.promote —
+                                 # fires BEFORE the atomic os.replace,
+                                 # so an injected fault models a crash
+                                 # mid-promote: pointer untouched)
     "checkpoint.write",          # layer-checkpoint save (workflow.py)
     "checkpoint.rename",         # layer-checkpoint swap (workflow.py)
 })

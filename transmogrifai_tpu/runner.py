@@ -494,6 +494,12 @@ class OpWorkflowRunner:
                     from . import server as _server
                     result.metrics["aot"] = _aot.aot_stats()
                     result.metrics["server"] = _server.server_stats()
+                    # model-lifecycle tallies ride on every doc too:
+                    # registry traffic, rollout promotions/rollbacks,
+                    # drift windows + advisories (lifecycle.py)
+                    from . import lifecycle as _lifecycle
+                    result.metrics["lifecycle"] = \
+                        _lifecycle.lifecycle_stats()
                     # input-pipeline tallies ride on every doc too:
                     # converged prefetch depth, worker count, buffer
                     # reuse and the sustained-bandwidth measurement
@@ -555,6 +561,13 @@ class OpWorkflowRunner:
             metrics = model.summary()
             metrics["appSeconds"] = round(time.perf_counter() - t0, 3)
             metrics["process"] = process_summary()
+            # RawFeatureFilter verdict (None = no filter configured):
+            # exclusions + whether the train-time distributions the
+            # serving-time drift sentinel compares against were
+            # persisted with the model (docs/lifecycle.md)
+            metrics["rawFeatureFilter"] = (
+                model.rff_results.summary()
+                if model.rff_results is not None else None)
             return RunnerResult(run_type, metrics=metrics,
                                 model_location=params.model_location)
 

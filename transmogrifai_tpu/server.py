@@ -42,6 +42,7 @@ Run it with ``python -m transmogrifai_tpu serve params.json`` (knobs:
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import queue
@@ -54,14 +55,17 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from . import aot, resilience, telemetry
+from . import aot, lifecycle, resilience, telemetry
+from .lifecycle import RegistryError
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["ModelServer", "RequestResult", "ServerError", "ModelNotFound",
-           "ServerBusy", "ServerClosed", "serve_http", "server_stats",
-           "reset_server_stats", "DEFAULT_BATCH_DEADLINE_MS",
-           "DEFAULT_MAX_QUEUE", "DEFAULT_MAX_MODELS"]
+           "ServerBusy", "ServerClosed", "RolloutError", "serve_http",
+           "server_stats", "reset_server_stats",
+           "DEFAULT_BATCH_DEADLINE_MS", "DEFAULT_MAX_QUEUE",
+           "DEFAULT_MAX_MODELS", "DEFAULT_CANARY_FRACTION",
+           "DEFAULT_ROLLOUT_WINDOW_REQUESTS", "DEFAULT_PROMOTE_WINDOWS"]
 
 #: how long the micro-batcher holds the first queued request open for
 #: co-riders before dispatching (ms). 0 = dispatch immediately.
@@ -75,6 +79,41 @@ DEFAULT_MAX_MODELS = 4
 
 #: per-model latency reservoir for exact p50/p95/p99 in stats
 _LATENCY_WINDOW = 4096
+
+#: default request fraction a canary rollout routes to the candidate
+DEFAULT_CANARY_FRACTION = 0.1
+
+#: completed requests that make one rollout evaluation window
+DEFAULT_ROLLOUT_WINDOW_REQUESTS = 64
+
+#: consecutive clean windows before a rollout auto-promotes
+DEFAULT_PROMOTE_WINDOWS = 3
+
+#: record batches the off-path drift queue holds before it starts
+#: dropping (dropped batches are tallied, never block a worker)
+DRIFT_QUEUE_DEPTH = 64
+
+#: rows the sentinel thread coalesces into one sketch pass when a
+#: backlog builds. Large passes amortize the histogram fixed costs AND
+#: the GIL convoy tax of waking next to busy workers — fewer, longer
+#: passes beat many short ones for serving throughput, at the price of
+#: a few ms of worker stall per pass.
+DRIFT_COALESCE_ROWS = 2048
+
+#: ceiling on the fraction of host CPU (GIL time) the sentinel thread
+#: may consume: after each sketch pass of ``dt`` seconds it sleeps
+#: ``dt * (1/duty - 1)``, capped at 2 s. Under saturated Python-bound
+#: serving the queue overflows and DROPS observations (a sampling
+#: sentinel) rather than slowing the score path — drift detection
+#: needs a statistically representative window, not every row. The
+#: nominal duty badly under-states the real cost for GIL-heavy
+#: workers (convoy/switch latency rides on top of the work share), so
+#: it is set far below the drift_canary bench's 5% overhead gate:
+#: with the cap this works out to one coalesced few-ms sketch pass
+#: every ~2 s under saturation. On accelerator-backed serving the
+#: workers hold the GIL far less, so the same throttle admits far
+#: more observation.
+DRIFT_DUTY_CYCLE = 0.002
 
 
 # ---------------------------------------------------------------------------
@@ -142,6 +181,11 @@ class ServerClosed(ServerError):
     pass
 
 
+class RolloutError(ServerError):
+    """Rollout misuse: no registry attached, unknown version, a rollout
+    already in flight, or an invalid deploy mode/fraction."""
+
+
 @dataclass
 class RequestResult:
     """One request's scored slice plus its dispatch provenance."""
@@ -152,6 +196,75 @@ class RequestResult:
     coalesced: int              # requests sharing that dispatch
     seconds: float              # queue-to-completion latency
     engine_tier: bool           # True = compiled engine, False = host
+    canary: bool = False        # True = scored by a canary candidate
+
+
+class _Rollout:
+    """One in-flight shadow/canary rollout on a served model.
+
+    Mutated only by the model's single worker thread (window counters)
+    and read by stats; installation/clearing happens under the entry
+    lock. ``clean_windows`` consecutive clean evaluation windows — no
+    candidate failure, no SLO miss on candidate traffic, no new drift
+    advisory, no shadow parity mismatch — trigger automated promotion;
+    a breaker trip / dispatch failure / SLO breach triggers automated
+    rollback immediately."""
+
+    def __init__(self, mode: str, version: Optional[str], fraction: float,
+                 model: Any, engine: Any, bank_buckets: List[int],
+                 bank_report: Optional[Dict[str, Any]],
+                 model_dir: Optional[str], bank_dir: Optional[str],
+                 window_requests: int, promote_windows: int):
+        self.mode = mode
+        self.version = version
+        self.fraction = float(fraction)
+        self.model = model
+        self.engine = engine
+        self.bank_buckets = list(bank_buckets)
+        self.bank_report = bank_report
+        self.model_dir = model_dir
+        self.bank_dir = bank_dir
+        self.window_requests = max(int(window_requests), 1)
+        self.promote_windows = max(int(promote_windows), 1)
+        # window-scoped evidence (reset each evaluation window)
+        self.win_requests = 0
+        self.win_failures = 0
+        self.win_slo_missed = 0
+        self.win_parity_mismatch = 0
+        #: candidate-touching requests this window (canary scored or
+        #: shadow compared) — a window with NONE proves nothing and
+        #: must not advance the promotion count
+        self.win_evidence = 0
+        # rollout-cumulative evidence
+        self.windows = 0
+        self.clean_windows = 0
+        self.canary_requests = 0
+        self.shadow_requests = 0
+        self.shadow_batches = 0
+        self.parity_ok = 0
+        self.parity_mismatch = 0
+        self.shadow_seconds = 0.0
+        self.primary_seconds = 0.0
+        self.drift_seen = 0          # entry sentinel advisories at window start
+
+    def status(self) -> Dict[str, Any]:
+        compared = self.parity_ok + self.parity_mismatch
+        return {"mode": self.mode, "version": self.version,
+                "fraction": self.fraction,
+                "windowRequests": self.window_requests,
+                "promoteWindows": self.promote_windows,
+                "windows": self.windows,
+                "cleanWindows": self.clean_windows,
+                "canaryRequests": self.canary_requests,
+                "shadowRequests": self.shadow_requests,
+                "parityOk": self.parity_ok,
+                "parityMismatch": self.parity_mismatch,
+                "parityRate": (round(self.parity_ok / compared, 4)
+                               if compared else None),
+                "shadowLatencyDeltaMs": (
+                    round((self.shadow_seconds - self.primary_seconds)
+                          / max(self.shadow_batches, 1) * 1e3, 3)
+                    if self.shadow_batches else None)}
 
 
 class _Request:
@@ -183,6 +296,14 @@ class _ModelEntry:
         self.engine = None
         self.bank_buckets: List[int] = []
         self.bank_report: Optional[Dict[str, Any]] = None
+        #: True = model_dir/bank_dir re-resolve through the registry's
+        #: ``current`` pointer on every (re)load, so an evicted tenant
+        #: picks up a promote when it comes back
+        self.via_registry = False
+        #: lifecycle.DriftSentinel over live traffic (None = drift off)
+        self.sentinel: Any = None
+        #: in-flight shadow/canary rollout (_Rollout), None otherwise
+        self.rollout: Optional["_Rollout"] = None
         self.weight_bytes = 0
         self.queue: "queue.Queue[Any]" = queue.Queue(maxsize=max_queue)
         self.lock = threading.Lock()       # guards load/unload
@@ -202,6 +323,8 @@ class _ModelEntry:
             pct = {"p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
                    "p95_ms": round(float(np.percentile(lat, 95)) * 1e3, 3),
                    "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3)}
+        rollout = self.rollout
+        sentinel = self.sentinel
         return {"loaded": self.model is not None, "pinned": self.pinned,
                 "requests": self.requests, "failures": self.failures,
                 "rows": self.rows, "batches": self.batches,
@@ -209,6 +332,9 @@ class _ModelEntry:
                 "bankHitBatches": self.bank_hit_batches,
                 "weightBytes": self.weight_bytes,
                 "queueDepth": self.queue.qsize(), "loads": self.loads,
+                "viaRegistry": self.via_registry,
+                "rollout": rollout.status() if rollout else None,
+                "drift": sentinel.stats() if sentinel else None,
                 **pct}
 
 
@@ -227,7 +353,13 @@ class ModelServer:
                  batch_deadline_s: float = DEFAULT_BATCH_DEADLINE_MS / 1e3,
                  max_queue: int = DEFAULT_MAX_QUEUE,
                  slo_ms: Optional[float] = None,
-                 bucket_cap: Optional[int] = None):
+                 bucket_cap: Optional[int] = None,
+                 registry: Optional["lifecycle.ModelRegistry"] = None,
+                 drift_window: Optional[int] = None,
+                 drift_js_threshold: float = lifecycle.DEFAULT_JS_THRESHOLD,
+                 drift_fill_delta: float =
+                 lifecycle.DEFAULT_FILL_DELTA_THRESHOLD,
+                 canary_fraction: float = DEFAULT_CANARY_FRACTION):
         if max_models < 1:
             raise ValueError(f"max_models must be >= 1, got {max_models}")
         self.max_models = int(max_models)
@@ -237,20 +369,55 @@ class ModelServer:
         self.max_queue = int(max_queue)
         self.slo_ms = None if slo_ms is None else float(slo_ms)
         self.bucket_cap = bucket_cap
+        #: model lifecycle wiring (lifecycle.py): the registry resolves
+        #: versioned tenants + receives promote/rollback; drift_window
+        #: (rows) turns the serving-time drift sentinel on per tenant
+        self._registry = registry
+        self.drift_window = (None if drift_window is None
+                             else int(drift_window))
+        self.drift_js_threshold = float(drift_js_threshold)
+        self.drift_fill_delta = float(drift_fill_delta)
+        self.canary_fraction = float(canary_fraction)
         #: LRU order: oldest first; touched on every submit
         self._entries: "OrderedDict[str, _ModelEntry]" = OrderedDict()
         self._lock = threading.Lock()
         self._closed = False
+        #: off-path drift accumulation: dispatch workers enqueue scored
+        #: record batches O(1) and ONE shared sentinel thread folds them
+        #: into the tenants' sketches — observation never rides a
+        #: request's latency, and under saturation the bounded queue
+        #: DROPS batches (tallied) instead of slowing serving
+        self._drift_queue: Optional["queue.Queue[Any]"] = None
+        self._drift_thread: Optional[threading.Thread] = None
+        if self.drift_window:
+            self._drift_queue = queue.Queue(maxsize=DRIFT_QUEUE_DEPTH)
+            self._drift_thread = threading.Thread(
+                target=self._drift_loop, name="serve-drift", daemon=True)
+            self._drift_thread.start()
+
+    @property
+    def registry(self) -> Optional["lifecycle.ModelRegistry"]:
+        return self._registry
 
     # -- registration / LRU ------------------------------------------------
     def register(self, name: str, model_dir: Optional[str] = None,
                  bank_dir: Optional[str] = None,
-                 model: Any = None, preload: bool = False) -> None:
+                 model: Any = None, preload: bool = False,
+                 via_registry: bool = False) -> None:
         """Register a tenant: either a saved-model directory (evictable,
         reloaded on demand) or a live ``WorkflowModel`` (pinned).
         ``bank_dir`` names the export directory carrying the AOT program
         bank (aot.py); ``preload`` loads immediately instead of on first
-        request."""
+        request. ``via_registry`` resolves model/bank dirs through the
+        attached registry's ``current`` pointer on EVERY (re)load —
+        eviction + reload transparently picks up a promote."""
+        if via_registry:
+            if self._registry is None:
+                raise RolloutError(
+                    "register(via_registry=True) needs a registry "
+                    "attached to the server")
+            rec = self._registry.resolve(name)
+            model_dir, bank_dir = rec["modelDir"], rec.get("bankDir")
         if model is None and model_dir is None:
             raise ValueError("register() needs model_dir or model")
         with self._lock:
@@ -260,6 +427,7 @@ class ModelServer:
                 raise ValueError(f"model {name!r} already registered")
             entry = _ModelEntry(name, model_dir, bank_dir, model,
                                 self.max_queue)
+            entry.via_registry = via_registry
             entry.worker = threading.Thread(
                 target=self._worker_loop, args=(entry,),
                 name=f"serve-{name}", daemon=True)
@@ -267,6 +435,12 @@ class ModelServer:
         entry.worker.start()
         if preload or model is not None:
             self._ensure_loaded(entry)
+
+    def register_from_registry(self, name: str,
+                               preload: bool = False) -> None:
+        """Register a tenant that serves whatever the registry's
+        ``current`` pointer names (and keeps re-resolving it)."""
+        self.register(name, via_registry=True, preload=preload)
 
     def models(self) -> List[str]:
         with self._lock:
@@ -286,31 +460,63 @@ class ModelServer:
         completes."""
         with entry.lock:
             if entry.model is None:
+                if entry.via_registry and self._registry is not None:
+                    # an evicted/reloaded registry tenant re-resolves
+                    # the CURRENT pointer — a promote that happened
+                    # while it was out takes effect on reload
+                    try:
+                        rec = self._registry.resolve(entry.name)
+                        entry.model_dir = rec["modelDir"]
+                        entry.bank_dir = rec.get("bankDir")
+                    except RegistryError:
+                        pass        # pointer gone: last-known dirs serve
                 from .workflow import WorkflowModel
                 with telemetry.span("server:load_model",
                                     model=entry.name):
                     entry.model = WorkflowModel.load(entry.model_dir)
                 entry.loads += 1
+                entry.sentinel = None       # rebuilt for the new model
                 _tally("model_loads")
                 telemetry.counter("server.model_loads").inc()
             if entry.engine is None:
-                kw: Dict[str, Any] = {"gate_bandwidth": False,
-                                      "mesh": False}
-                if self.bucket_cap:
-                    kw["bucket_cap"] = int(self.bucket_cap)
-                entry.engine = entry.model.scoring_engine(**kw)
-                if entry.engine is not None and entry.bank_dir:
-                    report = aot.load_program_bank(entry.engine,
-                                                   entry.bank_dir)
-                    entry.bank_report = report
-                    entry.bank_buckets = list(report["loaded"])
-                    if report["loaded"]:
-                        _tally("bank_loads")
+                (entry.engine, entry.bank_buckets,
+                 entry.bank_report) = self._build_engine(entry.model,
+                                                         entry.bank_dir)
                 entry.weight_bytes = self._entry_weight(entry)
+            if entry.sentinel is None and self.drift_window:
+                entry.sentinel = self._build_sentinel(entry.model,
+                                                      entry.name)
             captured = (entry.model, entry.engine,
                         list(entry.bank_buckets))
         self._evict_over_capacity(keep=entry.name)
         return captured
+
+    def _build_engine(self, model, bank_dir: Optional[str]):
+        """(engine, bank_buckets, bank_report) for one loaded model —
+        shared by tenant loading and rollout candidate loading so the
+        two can never disagree on engine construction."""
+        kw: Dict[str, Any] = {"gate_bandwidth": False, "mesh": False}
+        if self.bucket_cap:
+            kw["bucket_cap"] = int(self.bucket_cap)
+        engine = model.scoring_engine(**kw)
+        bank_buckets: List[int] = []
+        bank_report: Optional[Dict[str, Any]] = None
+        if engine is not None and bank_dir:
+            bank_report = aot.load_program_bank(engine, bank_dir)
+            bank_buckets = list(bank_report["loaded"])
+            if bank_report["loaded"]:
+                _tally("bank_loads")
+        return engine, bank_buckets, bank_report
+
+    def _build_sentinel(self, model, name: str):
+        """The tenant's serving-time drift sentinel (None when the
+        server runs driftless or the model has no persisted baseline)."""
+        if not self.drift_window:
+            return None
+        return lifecycle.DriftSentinel.for_model(
+            model, model_name=name, window_rows=self.drift_window,
+            js_threshold=self.drift_js_threshold,
+            fill_delta_threshold=self.drift_fill_delta)
 
     def _entry_weight(self, entry: _ModelEntry) -> int:
         """LRU weight: the bank's serialized-program bytes (the dominant
@@ -348,6 +554,10 @@ class ModelServer:
                 victim.model = None
                 victim.engine = None
                 victim.bank_buckets = []
+                # the reload may resolve a DIFFERENT version (registry
+                # pointer moved while evicted): the sentinel's baseline
+                # belongs to the old model, rebuild on reload
+                victim.sentinel = None
                 _tally("model_evictions")
                 telemetry.counter("server.model_evictions").inc()
 
@@ -427,10 +637,34 @@ class ModelServer:
             self._dispatch(entry, leftovers)
 
     def _dispatch(self, entry: _ModelEntry, batch: List[_Request]) -> None:
-        """Score one coalesced micro-batch and scatter results back.
-        Tier ladder: compiled engine (breaker-governed) → per-request
-        host fallback → quarantine + per-future error. Never raises."""
-        from .scoring import DEFAULT_BUCKET_CAP, bucket_for
+        """Route one coalesced micro-batch — the worker's never-raises
+        boundary. Any exception a routing branch leaks (the scoring tier
+        ladder has its own quarantine path) fails THIS batch's futures
+        and leaves the worker alive: a poison request must never kill a
+        tenant's serve thread."""
+        try:
+            self._dispatch_routed(entry, batch)
+        except Exception as e:  # lint: broad-except — the worker thread must survive any dispatch path
+            logger.exception("server: dispatch for %s failed past the "
+                             "tier ladder", entry.name)
+            for req in batch:
+                f = req.future
+                if f.done():
+                    continue
+                try:
+                    f.set_running_or_notify_cancel()
+                except Exception:  # lint: broad-except — racing a concurrent resolution
+                    pass
+                try:
+                    f.set_exception(e)
+                except Exception:  # lint: broad-except — already resolved: nothing to fail
+                    pass
+
+    def _dispatch_routed(self, entry: _ModelEntry,
+                         batch: List[_Request]) -> None:
+        """Route one coalesced micro-batch: the plain path scores it on
+        the tenant's model; an active rollout splits it (canary) or
+        duplicates it (shadow) against the candidate."""
         try:
             # model/engine captured under the entry lock: a concurrent
             # LRU eviction nulling entry.model mid-dispatch must not
@@ -443,6 +677,58 @@ class ModelServer:
                     continue
                 req.future.set_exception(e)
             return
+        rollout = entry.rollout
+        if rollout is None:
+            self._dispatch_group(entry, batch, model, eng, bank_buckets)
+        elif rollout.mode == "canary":
+            flags = [self._canaried(req, rollout.fraction)
+                     for req in batch]
+            stable = [r for r, c in zip(batch, flags) if not c]
+            canary = [r for r, c in zip(batch, flags) if c]
+            if stable:
+                # the stable sub-batch runs the EXACT solo code path —
+                # non-canaried traffic stays bit-identical to a
+                # rollout-free server (asserted in tests)
+                self._dispatch_group(entry, stable, model, eng,
+                                     bank_buckets)
+            if canary:
+                rollout.canary_requests += len(canary)
+                lifecycle.tally("canary_requests", len(canary))
+                if not self._dispatch_candidate(entry, canary, rollout):
+                    # candidate failed: its requests fall back to the
+                    # stable tier (zero drops) and the rollout rolls
+                    # back automatically
+                    self._rollback_rollout(
+                        entry, rollout,
+                        "candidate dispatch failure / breaker open")
+                    self._dispatch_group(entry, canary, model, eng,
+                                         bank_buckets)
+        else:                                   # shadow
+            primary = self._dispatch_group(entry, batch, model, eng,
+                                           bank_buckets)
+            self._shadow_observe(entry, batch, rollout, primary)
+        # off-path drift accumulation over ALL live records: hand the
+        # batch to the shared sentinel thread (O(1) enqueue — the
+        # worker, and therefore every future, never pays the sketch)
+        if entry.sentinel is not None and self._drift_queue is not None:
+            try:
+                self._drift_queue.put_nowait(
+                    (entry, [r for req in batch for r in req.records]))
+            except queue.Full:
+                # saturated: drop the observation, never the request
+                lifecycle.tally("drift_dropped_batches")
+        if entry.rollout is not None:
+            self._rollout_tick(entry, entry.rollout, len(batch))
+
+    def _dispatch_group(self, entry: _ModelEntry, batch: List[_Request],
+                        model, eng, bank_buckets: List[int]):
+        """Score one group of requests and scatter results back.
+        Tier ladder: compiled engine (breaker-governed) → per-request
+        host fallback → quarantine + per-future error. Never raises.
+        Returns ``(store, bucket, seconds)`` of the engine dispatch
+        (store None when the engine tier did not serve) for the shadow
+        comparer."""
+        from .scoring import DEFAULT_BUCKET_CAP, bucket_for
         records = [r for req in batch for r in req.records]
         n = len(records)
         cap = eng.bucket_cap if eng is not None \
@@ -468,26 +754,14 @@ class ModelServer:
                     "server: engine dispatch for %s failed; batch "
                     "retries per request on the host path", entry.name)
                 store = None
-        entry.batches += 1
-        _tally("batches")
-        _tally("rows", n)
-        bank_hit = engine_tier and bucket in bank_buckets
-        if bank_hit:
-            entry.bank_hit_batches += 1
-            _tally("bank_hit_batches")
-        if len(batch) > 1:
-            _tally("coalesced_requests", len(batch))
-        telemetry.counter("server.batches").inc()
-        lo = 0
+        disp_s = time.perf_counter() - t0
+        self._account_batch(entry, n, len(batch),
+                            engine_tier and bucket in bank_buckets)
+        if store is not None:
+            self._scatter_store(entry, batch, store, bucket, engine_tier)
+            return store, bucket, disp_s
         for req in batch:
             if not req.future.set_running_or_notify_cancel():
-                lo += req.rows
-                continue
-            if store is not None:
-                sub = store.take(np.arange(lo, lo + req.rows))
-                lo += req.rows
-                self._complete(entry, req, sub, bucket, len(batch),
-                               engine_tier)
                 continue
             # per-request host fallback: the dispatch site fires again
             # (a solo retry IS a dispatch), so chaos plans can poison
@@ -513,6 +787,441 @@ class ModelServer:
                 req.future.set_exception(e)
                 continue
             self._complete(entry, req, sub, bucket, len(batch), False)
+        return store, bucket, disp_s
+
+    def _account_batch(self, entry: _ModelEntry, n: int, n_requests: int,
+                       bank_hit: bool) -> None:
+        """One dispatched micro-batch's tallies — shared by the stable
+        and canary-candidate paths so their accounting can never
+        diverge."""
+        entry.batches += 1
+        _tally("batches")
+        _tally("rows", n)
+        if bank_hit:
+            entry.bank_hit_batches += 1
+            _tally("bank_hit_batches")
+        if n_requests > 1:
+            _tally("coalesced_requests", n_requests)
+        telemetry.counter("server.batches").inc()
+
+    def _scatter_store(self, entry: _ModelEntry, batch: List[_Request],
+                       store, bucket: int, engine_tier: bool,
+                       canary: bool = False,
+                       rollout: Optional[_Rollout] = None) -> None:
+        """Slice one scored store back onto its requests' futures —
+        shared by the stable and canary-candidate paths so the
+        row-offset bookkeeping can never diverge."""
+        lo = 0
+        for req in batch:
+            if not req.future.set_running_or_notify_cancel():
+                lo += req.rows
+                continue
+            sub = store.take(np.arange(lo, lo + req.rows))
+            lo += req.rows
+            self._complete(entry, req, sub, bucket, len(batch),
+                           engine_tier, canary=canary, rollout=rollout)
+
+    # -- shadow / canary rollout -------------------------------------------
+    @staticmethod
+    def _canaried(req: _Request, fraction: float) -> bool:
+        """Deterministic request routing: a stable hash of the request's
+        FIRST record lands in the canary fraction or not — the SAME
+        request always routes the same way, across workers and
+        processes. Hashing one record instead of the whole payload keeps
+        the routing decision O(1) on the dispatch hot path; a request is
+        routed atomically either way. Empty or unserializable payloads
+        ride the stable path — routing must never fail a request."""
+        if not req.records:
+            return False
+        try:
+            blob = json.dumps(req.records[0], sort_keys=True,
+                              default=str).encode()
+        except (TypeError, ValueError):
+            return False
+        h = int.from_bytes(hashlib.blake2b(blob, digest_size=8).digest(),
+                           "big")
+        return (h % 10_000) < int(round(fraction * 10_000))
+
+    def _dispatch_candidate(self, entry: _ModelEntry,
+                            batch: List[_Request],
+                            rollout: _Rollout) -> bool:
+        """Score one canary sub-batch on the rollout candidate. On ANY
+        failure (engine missing, breaker open, dispatch error) returns
+        False WITHOUT touching the futures — the caller re-dispatches
+        the sub-batch on the stable tier, so a broken candidate can
+        never drop a request."""
+        from .scoring import bucket_for
+        records = [r for req in batch for r in req.records]
+        n = len(records)
+        model, eng = rollout.model, rollout.engine
+        if not n or eng is None:
+            rollout.win_failures += bool(n)
+            return not n
+        brk = model._engine_breaker()
+        if not brk.allow():
+            rollout.win_failures += 1
+            return False
+        bucket = bucket_for(n, int(eng.bucket_cap))
+        try:
+            resilience.inject("server.dispatch", model=entry.name,
+                              rows=n, requests=len(batch), canary=True)
+            with telemetry.span("server:canary_dispatch",
+                                model=entry.name, rows=n,
+                                version=rollout.version, bucket=bucket):
+                store = eng.score_store(records, use_cache=False)
+            brk.record_success()
+        except Exception:  # lint: broad-except — a failing candidate is rollout evidence; its requests re-dispatch on the stable tier
+            brk.record_failure()
+            rollout.win_failures += 1
+            logger.exception(
+                "server: canary dispatch for %s@%s failed; sub-batch "
+                "re-dispatches on the stable tier", entry.name,
+                rollout.version)
+            return False
+        self._account_batch(entry, n, len(batch),
+                            bucket in rollout.bank_buckets)
+        self._scatter_store(entry, batch, store, bucket, True,
+                            canary=True, rollout=rollout)
+        rollout.win_evidence += len(batch)
+        return True
+
+    def _shadow_observe(self, entry: _ModelEntry, batch: List[_Request],
+                        rollout: _Rollout, primary) -> None:
+        """Duplicate one already-answered batch to the shadow candidate:
+        responses are DISCARDED, prediction parity and the latency delta
+        are recorded. Runs after the primary futures resolve, so the
+        answered batch never waits on its shadow — but the double
+        compute IS shadow's cost: it occupies the tenant's worker before
+        the next pickup, so a tenant near saturation loses throughput
+        for the rollout's duration (docs/lifecycle.md deploy-mode
+        matrix)."""
+        store, _bucket, primary_s = primary
+        if store is None:
+            return                  # host-tier batch: nothing to mirror
+        records = [r for req in batch for r in req.records]
+        rollout.shadow_requests += len(batch)
+        rollout.shadow_batches += 1
+        rollout.primary_seconds += primary_s
+        lifecycle.tally("shadow_requests", len(batch))
+        t0 = time.perf_counter()
+        try:
+            if rollout.engine is not None:
+                cand = rollout.engine.score_store(records, use_cache=False)
+            else:
+                cand = rollout.model.score(records, engine=False)
+        except Exception:  # lint: broad-except — shadow failure is rollout evidence, never a served error
+            rollout.win_failures += 1
+            logger.exception("server: shadow dispatch for %s@%s failed",
+                             entry.name, rollout.version)
+            return
+        rollout.shadow_seconds += time.perf_counter() - t0
+        lo = 0
+        for req in batch:
+            idx = np.arange(lo, lo + req.rows)
+            lo += req.rows
+            if _stores_equal(store.take(idx), cand.take(idx)):
+                rollout.parity_ok += 1
+                lifecycle.tally("shadow_parity_ok")
+            else:
+                rollout.parity_mismatch += 1
+                rollout.win_parity_mismatch += 1
+                lifecycle.tally("shadow_parity_mismatch")
+            rollout.win_evidence += 1
+
+    def _rollout_tick(self, entry: _ModelEntry, rollout: _Rollout,
+                      n_requests: int) -> None:
+        """Advance the rollout's evaluation window after one dispatch;
+        rolls back on hard failure signals, promotes after
+        ``promote_windows`` consecutive clean windows."""
+        rollout.win_requests += n_requests
+        if rollout.win_failures:
+            self._rollback_rollout(entry, rollout,
+                                   "candidate failure / breaker trip")
+            return
+        if rollout.win_slo_missed:
+            self._rollback_rollout(entry, rollout,
+                                   "SLO breach on candidate traffic")
+            return
+        if rollout.win_requests < rollout.window_requests:
+            return
+        sentinel = entry.sentinel
+        drift_now = sentinel.advisories if sentinel is not None else 0
+        new_drift = drift_now - rollout.drift_seen
+        rollout.drift_seen = drift_now
+        clean = (new_drift == 0 and rollout.win_parity_mismatch == 0)
+        rollout.windows += 1
+        if not clean:
+            rollout.clean_windows = 0
+        elif rollout.win_evidence > 0:
+            rollout.clean_windows += 1
+        # else: a window that never touched the candidate (host-tier
+        # primaries under shadow, or zero canaried requests) proves
+        # NOTHING — it neither advances nor resets the promotion count
+        rollout.win_requests = 0
+        rollout.win_parity_mismatch = 0
+        evidence = rollout.win_evidence
+        rollout.win_evidence = 0
+        logger.info("server: rollout window %d for %s@%s %s "
+                    "(%d/%d clean, %d candidate-touching)",
+                    rollout.windows, entry.name,
+                    rollout.version, "clean" if clean else "NOT clean",
+                    rollout.clean_windows, rollout.promote_windows,
+                    evidence)
+        if rollout.clean_windows >= rollout.promote_windows:
+            self._promote_rollout(entry, rollout)
+
+    def _promote_rollout(self, entry: _ModelEntry,
+                         rollout: _Rollout) -> None:
+        """Swap the candidate in as the tenant's serving model and move
+        the registry's ``current`` pointer (through the
+        ``lifecycle.promote`` fault site). The swap happens on the
+        tenant's single worker thread between dispatches, so no request
+        is in flight across it — zero drops by construction. A failed
+        pointer swap rolls the rollout back; the stable model keeps
+        serving and the registry still names it. Pointer move + model
+        swap happen under ONE hold of the entry lock, re-checking the
+        rollout's identity first: a manual ``rollback()`` that raced in
+        wins — its abort can never be silently overridden by a promote
+        that was already past the clean-window check."""
+        promote_err: Optional[BaseException] = None
+        with entry.lock:
+            if entry.rollout is not rollout:
+                return                      # aborted while we decided
+            try:
+                if self._registry is not None and rollout.version:
+                    self._registry.promote(entry.name, rollout.version)
+            except Exception as e:  # lint: broad-except — a failed pointer swap must leave the stable fleet serving (chaos-tested)
+                logger.exception("server: promote of %s@%s failed; "
+                                 "rolling back", entry.name,
+                                 rollout.version)
+                promote_err = e
+            else:
+                entry.model = rollout.model
+                entry.engine = rollout.engine
+                entry.bank_buckets = list(rollout.bank_buckets)
+                entry.bank_report = rollout.bank_report
+                if rollout.model_dir:
+                    entry.model_dir = rollout.model_dir
+                    entry.bank_dir = rollout.bank_dir
+                entry.weight_bytes = self._entry_weight(entry)
+                entry.sentinel = self._build_sentinel(entry.model,
+                                                      entry.name)
+                entry.rollout = None
+        if promote_err is not None:
+            self._rollback_rollout(entry, rollout,
+                                   f"promote failed: {promote_err!r}")
+            return
+        lifecycle.tally("auto_promotions")
+        telemetry.emit("rollout", model=entry.name, action="promote",
+                       version=rollout.version, mode=rollout.mode,
+                       windows=rollout.windows)
+        logger.info("server: %s promoted to %s after %d clean window(s)",
+                    entry.name, rollout.version, rollout.clean_windows)
+
+    def _rollback_rollout(self, entry: _ModelEntry, rollout: _Rollout,
+                          reason: str) -> None:
+        """Abort the rollout: the candidate is discarded, the stable
+        model keeps serving, the registry pointer is untouched."""
+        with entry.lock:
+            if entry.rollout is rollout:
+                entry.rollout = None
+        lifecycle.tally("auto_rollbacks")
+        telemetry.counter("server.rollbacks").inc()
+        telemetry.emit("rollout", model=entry.name, action="rollback",
+                       version=rollout.version, mode=rollout.mode,
+                       reason=reason)
+        logger.warning("server: rollout of %s@%s rolled back: %s",
+                       entry.name, rollout.version, reason)
+
+    def deploy(self, name: str, version: str, mode: str = "shadow",
+               fraction: Optional[float] = None,
+               window_requests: int = DEFAULT_ROLLOUT_WINDOW_REQUESTS,
+               promote_windows: int = DEFAULT_PROMOTE_WINDOWS
+               ) -> Dict[str, Any]:
+        """Start a shadow or canary rollout of registry ``version`` on
+        tenant ``name``.
+
+        ``mode="shadow"`` duplicates every request to the candidate
+        (responses discarded; parity + latency delta recorded);
+        ``mode="canary"`` routes a deterministic hash-``fraction`` of
+        requests to it. After ``promote_windows`` consecutive clean
+        evaluation windows of ``window_requests`` requests each — no
+        candidate failure, no SLO miss on candidate traffic, no new
+        drift advisory, no shadow parity mismatch — the candidate is
+        promoted automatically (registry pointer + in-place model swap);
+        a breaker trip / dispatch failure / SLO breach rolls back
+        automatically. Returns the rollout status block."""
+        if mode not in ("shadow", "canary"):
+            raise RolloutError(
+                f"deploy mode must be 'shadow' or 'canary', got {mode!r}")
+        if self._registry is None:
+            raise RolloutError("deploy() needs a registry attached to "
+                               "the server (ModelServer(registry=...))")
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise ModelNotFound(f"no model {name!r} registered "
+                                f"(have: {self.models()})")
+        rec = self._registry.record(name, version)
+        frac = float(self.canary_fraction if fraction is None
+                     else fraction)
+        if mode == "canary" and not 0.0 < frac <= 1.0:
+            raise RolloutError(
+                f"canary fraction must be in (0, 1], got {frac!r}")
+        # candidate loads OUTSIDE the entry lock (slow: model + engine +
+        # bank); serving continues on the stable model meanwhile
+        from .workflow import WorkflowModel
+        with telemetry.span("server:load_candidate", model=name,
+                            version=str(version)):
+            cand = WorkflowModel.load(rec["modelDir"])
+            engine, bank_buckets, bank_report = self._build_engine(
+                cand, rec.get("bankDir"))
+        if mode == "canary" and engine is None:
+            # canary routes LIVE traffic to the candidate and has no
+            # host-tier fallback of its own — an engine-less candidate
+            # would fail its first routed request and insta-rollback
+            # with misleading evidence; shadow supports it instead
+            raise RolloutError(
+                f"version {version!r} of {name!r} has no compiled "
+                "scoring engine; canary needs one — use mode='shadow' "
+                "to evaluate a host-tier candidate")
+        rollout = _Rollout(mode=mode, version=str(version), fraction=frac,
+                           model=cand, engine=engine,
+                           bank_buckets=bank_buckets,
+                           bank_report=bank_report,
+                           model_dir=rec["modelDir"],
+                           bank_dir=rec.get("bankDir"),
+                           window_requests=window_requests,
+                           promote_windows=promote_windows)
+        with entry.lock:
+            if entry.rollout is not None:
+                raise RolloutError(
+                    f"model {name!r} already has an active "
+                    f"{entry.rollout.mode} rollout of version "
+                    f"{entry.rollout.version}")
+            sentinel = entry.sentinel
+            if sentinel is not None:
+                rollout.drift_seen = sentinel.advisories
+            entry.rollout = rollout
+        lifecycle.tally("deploys")
+        telemetry.emit("rollout", model=name, action="deploy",
+                       version=str(version), mode=mode, fraction=frac)
+        logger.info("server: %s rollout of %s@%s started (fraction=%g)",
+                    mode, name, version, frac)
+        return rollout.status()
+
+    def rollback(self, name: str) -> Dict[str, Any]:
+        """Manual rollback. With a rollout in flight: abort it (the
+        stable model was serving all along). Otherwise: swing the
+        registry pointer back to ``previous`` and force the tenant to
+        reload through it."""
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise ModelNotFound(f"no model {name!r} registered "
+                                f"(have: {self.models()})")
+        with entry.lock:
+            rollout, entry.rollout = entry.rollout, None
+        if rollout is not None:
+            # the counter covers automated AND manual aborts
+            # (docs/observability.md) — dashboards must see both
+            telemetry.counter("server.rollbacks").inc()
+            telemetry.emit("rollout", model=name, action="rollback",
+                           version=rollout.version, mode=rollout.mode,
+                           reason="manual")
+            logger.info("server: rollout of %s@%s aborted manually",
+                        name, rollout.version)
+            return {"model": name, "aborted": rollout.version,
+                    "mode": rollout.mode}
+        if self._registry is None:
+            raise RolloutError("rollback() without a rollout needs a "
+                               "registry attached to the server")
+        prev = self._registry.rollback(name)
+        rec = self._registry.record(name, prev)
+        with entry.lock:
+            entry.model = None
+            entry.engine = None
+            entry.bank_buckets = []
+            entry.sentinel = None
+            entry.model_dir = rec["modelDir"]
+            entry.bank_dir = rec.get("bankDir")
+        return {"model": name, "rolledBackTo": prev}
+
+    def _drift_loop(self) -> None:
+        """The shared sentinel thread: fold enqueued record batches into
+        their tenant's sliding sketches. One thread for the whole server,
+        coalescing backlog into sub-window-sized passes and throttled to
+        ``DRIFT_DUTY_CYCLE`` of host CPU — observation can never crowd
+        out the serving workers' GIL time."""
+        held = None
+        while True:
+            item = held if held is not None else self._drift_queue.get()
+            held = None
+            if item is None:                # shutdown sentinel
+                self._drift_queue.task_done()
+                return
+            entry, records = item
+            taken = 1
+            stop = False
+            while len(records) < DRIFT_COALESCE_ROWS:
+                try:
+                    nxt = self._drift_queue.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:             # shutdown sentinel mid-burst
+                    taken += 1              # its task_done rides below
+                    stop = True
+                    break
+                if nxt[0] is not entry:
+                    held = nxt              # different tenant: next round
+                    break
+                records = records + nxt[1]
+                taken += 1
+            t0 = time.perf_counter()
+            try:
+                sentinel = entry.sentinel
+                if sentinel is not None:
+                    sentinel.observe(records)
+            except Exception:  # lint: broad-except — drift observation must never take down its thread
+                logger.exception("server: drift observation failed "
+                                 "for %s", entry.name)
+            finally:
+                for _ in range(taken):
+                    self._drift_queue.task_done()
+            if stop:
+                return
+            dt = time.perf_counter() - t0
+            if dt > 0 and held is None:
+                time.sleep(min(dt * (1.0 / DRIFT_DUTY_CYCLE - 1.0), 2.0))
+
+    def drain_drift(self) -> None:
+        """Block until every enqueued drift observation is folded —
+        makes sentinel stats deterministic for tests and benches."""
+        if self._drift_queue is not None:
+            self._drift_queue.join()
+
+    def lifecycle_status(self, name: str) -> Dict[str, Any]:
+        """Registry versions + pointer + live rollout/drift state for one
+        tenant — the ``/v1/models/<name>/versions`` document."""
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise ModelNotFound(f"no model {name!r} registered "
+                                f"(have: {self.models()})")
+        # locals first: a racing promote/eviction nulls these fields
+        # between a truthiness test and the method call
+        rollout = entry.rollout
+        sentinel = entry.sentinel
+        doc: Dict[str, Any] = {
+            "model": name,
+            "rollout": rollout.status() if rollout else None,
+            "drift": sentinel.stats() if sentinel else None}
+        if self._registry is not None:
+            try:
+                doc.update(self._registry.status(name))
+            except RegistryError as e:
+                doc["registryError"] = str(e)
+        return doc
 
     def _slo(self, seconds: float) -> Optional[bool]:
         if self.slo_ms is None:
@@ -522,7 +1231,9 @@ class ModelServer:
         return met
 
     def _complete(self, entry: _ModelEntry, req: _Request, store,
-                  bucket: int, coalesced: int, engine_tier: bool) -> None:
+                  bucket: int, coalesced: int, engine_tier: bool,
+                  canary: bool = False,
+                  rollout: Optional[_Rollout] = None) -> None:
         seconds = time.perf_counter() - req.t_enqueued
         entry.requests += 1
         entry.rows += req.rows
@@ -536,13 +1247,16 @@ class ModelServer:
             telemetry.gauge(f"server.queue_depth.{entry.name}").set(
                 entry.queue.qsize())
         slo_met = self._slo(seconds)
+        if rollout is not None and slo_met is False:
+            # candidate traffic missing the SLO is a rollback trigger
+            rollout.win_slo_missed += 1
         telemetry.emit("request", model=entry.name, rows=req.rows,
                        seconds=seconds, ok=True, coalesced=coalesced,
                        bucket=bucket, slo_met=slo_met)
         req.future.set_result(RequestResult(
             store=store, rows=req.rows, bucket=bucket,
             coalesced=coalesced, seconds=seconds,
-            engine_tier=engine_tier))
+            engine_tier=engine_tier, canary=canary))
 
     # -- stats / shutdown --------------------------------------------------
     def stats(self) -> Dict[str, Any]:
@@ -551,7 +1265,9 @@ class ModelServer:
         with self._lock:
             entries = list(self._entries.items())
         return {"server": server_stats(),
+                "lifecycle": lifecycle.lifecycle_stats(),
                 "sloMs": self.slo_ms,
+                "driftWindow": self.drift_window,
                 "batchDeadlineMs": self.batch_deadline_s * 1e3,
                 "models": {name: e.stats() for name, e in entries}}
 
@@ -582,6 +1298,53 @@ class ModelServer:
         for e in entries:
             if e.worker is not None:
                 e.worker.join(timeout=timeout_s)
+        if self._drift_thread is not None:
+            if drain:
+                self._drift_queue.join()
+            # no-drain must stay a fast abort: never block on a full
+            # queue — evict pending observations until the sentinel fits
+            while True:
+                try:
+                    self._drift_queue.put_nowait(None)
+                    break
+                except queue.Full:
+                    try:
+                        self._drift_queue.get_nowait()
+                        self._drift_queue.task_done()
+                    except queue.Empty:
+                        pass
+            self._drift_thread.join(timeout=timeout_s)
+
+
+def _stores_equal(a, b) -> bool:
+    """Bitwise prediction parity between two result stores over their
+    shared columns (the shadow comparer's oracle): Prediction columns
+    compare all three arrays, value columns compare their payloads."""
+    names = [n for n in a.names() if n in b]
+    if not names:
+        return False
+    for n in names:
+        ca, cb = a[n], b[n]
+        if type(ca) is not type(cb):
+            return False
+        if hasattr(ca, "prediction"):
+            for fld in ("prediction", "raw_prediction", "probability"):
+                if not np.array_equal(getattr(ca, fld),
+                                      getattr(cb, fld)):
+                    return False
+        elif hasattr(ca, "mask") and hasattr(ca, "values"):
+            if not np.array_equal(ca.mask, cb.mask):
+                return False
+            va, vb = np.asarray(ca.values), np.asarray(cb.values)
+            equal = (np.array_equal(va, vb, equal_nan=True)
+                     if va.dtype.kind == "f" and vb.dtype.kind == "f"
+                     else np.array_equal(va, vb))
+            if not equal:
+                return False
+        elif hasattr(ca, "values"):
+            if list(ca.values) != list(cb.values):
+                return False
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -621,17 +1384,48 @@ def serve_http(server: ModelServer, host: str = "127.0.0.1",
                 return self._send(200, server.stats())
             if self.path == "/v1/models":
                 return self._send(200, {"models": server.stats()["models"]})
+            if (self.path.startswith("/v1/models/")
+                    and self.path.endswith("/versions")):
+                name = self.path[len("/v1/models/"):-len("/versions")]
+                try:
+                    return self._send(200, server.lifecycle_status(name))
+                except ModelNotFound as e:
+                    return self._send(404, {"error": str(e)})
+                except (RolloutError, RegistryError) as e:
+                    return self._send(400, {"error": str(e)})
             return self._send(404, {"error": f"no route {self.path!r}"})
+
+        def _body(self):
+            length = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(length) or b"{}")
 
         def do_POST(self):
             path = self.path
-            if not (path.startswith("/v1/models/")
-                    and path.endswith(":score")):
+            if not path.startswith("/v1/models/"):
                 return self._send(404, {"error": f"no route {path!r}"})
-            name = path[len("/v1/models/"):-len(":score")]
             try:
-                length = int(self.headers.get("Content-Length", 0))
-                doc = json.loads(self.rfile.read(length) or b"{}")
+                if path.endswith(":deploy"):
+                    name = path[len("/v1/models/"):-len(":deploy")]
+                    doc = self._body()
+                    kw = {}
+                    if doc.get("fraction") is not None:
+                        kw["fraction"] = float(doc["fraction"])
+                    if doc.get("windowRequests") is not None:
+                        kw["window_requests"] = int(doc["windowRequests"])
+                    if doc.get("promoteWindows") is not None:
+                        kw["promote_windows"] = int(doc["promoteWindows"])
+                    return self._send(200, {
+                        "model": name,
+                        "rollout": server.deploy(
+                            name, doc.get("version"),
+                            mode=doc.get("mode", "shadow"), **kw)})
+                if path.endswith(":rollback"):
+                    name = path[len("/v1/models/"):-len(":rollback")]
+                    return self._send(200, server.rollback(name))
+                if not path.endswith(":score"):
+                    return self._send(404, {"error": f"no route {path!r}"})
+                name = path[len("/v1/models/"):-len(":score")]
+                doc = self._body()
                 records = doc.get("records")
                 if not isinstance(records, list) or not records:
                     return self._send(400, {
@@ -641,12 +1435,16 @@ def serve_http(server: ModelServer, host: str = "127.0.0.1",
                     timeout=request_timeout_s)
             except ModelNotFound as e:
                 return self._send(404, {"error": str(e)})
+            except (RolloutError, RegistryError, TypeError,
+                    ValueError) as e:
+                if isinstance(e, json.JSONDecodeError):
+                    return self._send(400,
+                                      {"error": f"bad JSON body: {e}"})
+                return self._send(400, {"error": str(e)})
             except ServerBusy as e:
                 return self._send(429, {"error": str(e)})
             except ServerClosed as e:
                 return self._send(503, {"error": str(e)})
-            except json.JSONDecodeError as e:
-                return self._send(400, {"error": f"bad JSON body: {e}"})
             except Exception as e:  # lint: broad-except — HTTP boundary: a poison request answers 500, the server lives
                 return self._send(500, {"error": repr(e)})
             return self._send(200, {
@@ -654,6 +1452,7 @@ def serve_http(server: ModelServer, host: str = "127.0.0.1",
                 "coalesced": res.coalesced,
                 "latencyMs": round(res.seconds * 1e3, 3),
                 "engineTier": res.engine_tier,
+                "canary": res.canary,
                 "outputs": _store_rows(res.store)})
 
     httpd = ThreadingHTTPServer((host, port), Handler)

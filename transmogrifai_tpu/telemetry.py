@@ -588,6 +588,24 @@ class RunListener:
         SLO is configured."""
         pass
 
+    def on_drift(self, model: str, feature: str, rule: str,
+                 value: float = 0.0, threshold: float = 0.0,
+                 window_rows: int = 0, **_: Any) -> None:
+        """The serving-time drift sentinel flagged one feature
+        (lifecycle.DriftSentinel): ``rule`` is the TMG6xx advisory id,
+        ``value`` the measured JS divergence / fill delta that crossed
+        ``threshold`` over the last ``window_rows`` live rows."""
+        pass
+
+    def on_rollout(self, model: str, action: str,
+                   version: Optional[str] = None, mode: str = "",
+                   **_: Any) -> None:
+        """A shadow/canary rollout changed state on the model server
+        (docs/lifecycle.md): ``action`` is ``deploy`` / ``promote`` /
+        ``rollback``, ``mode`` the rollout kind; rollbacks carry a
+        ``reason`` kwarg."""
+        pass
+
 
 _LISTENERS: List[RunListener] = []
 
@@ -658,6 +676,8 @@ class CollectingRunListener(RunListener):
         self.requests = 0
         self.request_rows = 0
         self.requests_failed = 0
+        self.drift_advisories: Dict[str, int] = {}
+        self.rollouts: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     def on_run_start(self, run_type: str, **_: Any) -> None:
@@ -784,6 +804,21 @@ class CollectingRunListener(RunListener):
             if not ok:
                 self.requests_failed += 1
 
+    def on_drift(self, model: str, feature: str, rule: str,
+                 value: float = 0.0, threshold: float = 0.0,
+                 window_rows: int = 0, **_: Any) -> None:
+        with self._lock:
+            self.events.append("drift")
+            self.drift_advisories[rule] = \
+                self.drift_advisories.get(rule, 0) + 1
+
+    def on_rollout(self, model: str, action: str,
+                   version: Optional[str] = None, mode: str = "",
+                   **_: Any) -> None:
+        with self._lock:
+            self.events.append("rollout")
+            self.rollouts[action] = self.rollouts.get(action, 0) + 1
+
     def summary(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -810,6 +845,8 @@ class CollectingRunListener(RunListener):
                 "requests": self.requests,
                 "requestRows": self.request_rows,
                 "requestsFailed": self.requests_failed,
+                "driftAdvisories": dict(self.drift_advisories),
+                "rollouts": dict(self.rollouts),
             }
 
 
