@@ -188,6 +188,14 @@ class Bench:
                 self.doc["lifecycle"] = lifecycle.lifecycle_stats()
             except Exception:
                 self.doc.setdefault("lifecycle", None)
+            # serving-fleet tallies (workers spawned/respawned, routed
+            # requests, failovers, load shed) ride on EVERY doc too —
+            # the horizontal tier's evidence (fleet.py, docs/fleet.md)
+            try:
+                from transmogrifai_tpu import fleet
+                self.doc["fleet"] = fleet.fleet_stats()
+            except Exception:
+                self.doc.setdefault("fleet", None)
             # input-pipeline tallies (converged prefetch depth, worker
             # count, buffer reuse, sustained bandwidth) ride on EVERY
             # doc too — the ingest tier's evidence (pipeline.py)
@@ -969,6 +977,201 @@ def _drift_canary() -> dict:
     return out
 
 
+def _fleet_resilience() -> dict:
+    """Horizontal serving fleet benchmark (fleet.py, docs/fleet.md):
+
+    1. **Scaling** — router throughput at 1 vs N workers over the SAME
+       shared registry + AOT bank: requests/s, rows/s and
+       ``scaling_efficiency = rate_N / (N * rate_1)``.
+    2. **Chaos** — SIGKILL one worker mid-load: recovery time (kill →
+       the respawned worker probes READY again), the client-observed
+       failed-request count (must be 0 — sibling failover absorbs the
+       in-flight loss within the router's retry budget), post-respawn
+       throughput, and a fresh check that the registry CURRENT pointer
+       survived the kill unmoved.
+    """
+    import http.client
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from transmogrifai_tpu import (ColumnStore, FeatureBuilder, Workflow,
+                                   column_from_values, serving)
+    from transmogrifai_tpu import fleet as fleet_mod
+    from transmogrifai_tpu.lifecycle import ModelRegistry
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import \
+        BinaryClassificationModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+
+    cap = int(os.environ.get("BENCH_FLEET_BUCKET_CAP", 256))
+    # >= 2 workers ALWAYS: the chaos phase SIGKILLs one, and failover
+    # needs a sibling — a 1-worker "fleet" would report a guaranteed
+    # failure that says nothing about the failover contract
+    n_fleet = max(2, int(os.environ.get("BENCH_FLEET_WORKERS",
+                                        min(3, os.cpu_count() or 2))))
+    load_s = float(os.environ.get("BENCH_FLEET_SECONDS", 3.0))
+    train_rows = 10_000
+    rng = np.random.default_rng(23)
+    y = rng.integers(0, 2, train_rows).astype(float)
+    xs = {f"x{j}": rng.normal(size=train_rows) + (0.3 * j) * y
+          for j in range(4)}
+    cols = {"label": column_from_values(ft.RealNN, y)}
+    for k, v in xs.items():
+        cols[k] = column_from_values(ft.Real, list(v))
+    store = ColumnStore(cols, train_rows)
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = [FeatureBuilder.Real(f"x{j}").from_column().as_predictor()
+             for j in range(4)]
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily(
+            grid=[{"regParam": 0.01, "elasticNetParam": 0.0}])],
+        splitter=None, seed=7)
+    pred = label.transform_with(selector, transmogrify(feats))
+    model = (Workflow().set_input_store(store)
+             .set_result_features(pred).train())
+    records = [{"label": float(y[i]),
+                **{f"x{j}": float(xs[f"x{j}"][i]) for j in range(4)}}
+               for i in range(1024)]
+
+    work = tempfile.mkdtemp(prefix="tmog_fleet_bench_")
+    model_dir = os.path.join(work, "model")
+    export_dir = os.path.join(work, "export")
+    model.save(model_dir)
+    serving.export_scoring_fn(model, export_dir, records[:8],
+                              bucket_cap=cap)
+    reg_dir = os.path.join(work, "registry")
+    registry = ModelRegistry(reg_dir)
+    vid = registry.register("m", model_dir, bank_dir=export_dir,
+                            promote=True)
+    params_path = os.path.join(work, "params.json")
+    with open(params_path, "w") as fh:
+        json.dump({"customParams": {
+            "registryDir": reg_dir, "serveBucketCap": cap,
+            "serveBatchDeadlineMs": 1.0, "validate": False,
+            "plan": False}}, fh)
+
+    fleet_before = fleet_mod.fleet_stats()
+    out: dict = {"workers": n_fleet, "bucket_cap": cap,
+                 "load_s": load_s, "version": vid}
+
+    def pump(port: int, seconds: float, n_clients: int = 4) -> dict:
+        """Closed-loop client threads against the router; every non-200
+        answer counts as a failed request."""
+        ok = [0] * n_clients
+        fail = [0] * n_clients
+        rows = [0] * n_clients
+        stop_at = time.perf_counter() + seconds
+
+        def client(k: int) -> None:
+            crng = np.random.default_rng(300 + k)
+            while time.perf_counter() < stop_at:
+                lo = int(crng.integers(0, len(records) - 8))
+                body = json.dumps({"records": records[lo:lo + 8]})
+                try:
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", port, timeout=60)
+                    conn.request("POST", "/v1/models/m:score", body,
+                                 {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    status = resp.status
+                    conn.close()
+                except OSError:
+                    status = 599
+                if status == 200:
+                    ok[k] += 1
+                    rows[k] += 8
+                else:
+                    fail[k] += 1
+
+        threads = [threading.Thread(target=client, args=(k,),
+                                    name=f"fleet-bench-client-{k}",
+                                    daemon=True)
+                   for k in range(n_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=seconds * 4 + 120)
+        wall = time.perf_counter() - t0
+        return {"requests": sum(ok), "failed": sum(fail),
+                "requests_per_s": round(sum(ok) / wall, 1),
+                "rows_per_s": round(sum(rows) / wall, 1)}
+
+    def run_fleet(n: int):
+        sup = fleet_mod.FleetSupervisor(params_path, workers=n,
+                                        respawn_max=4,
+                                        probe_interval_s=0.1)
+        sup.start()
+        sup.wait_ready(timeout_s=300)
+        httpd = fleet_mod.serve_fleet_http(sup, port=0, retry_budget=2)
+        return sup, httpd, httpd.server_address[1]
+
+    # -- 1. scaling: 1 worker vs N -----------------------------------------
+    sup, httpd, port = run_fleet(1)
+    try:
+        pump(port, 0.5)                         # warmup: banks touched
+        out["one_worker"] = pump(port, load_s)
+    finally:
+        httpd.shutdown()
+        sup.stop(drain=True)
+    sup, httpd, port = run_fleet(n_fleet)
+    try:
+        pump(port, 0.5)
+        out["n_workers"] = pump(port, load_s)
+        r1 = max(out["one_worker"]["requests_per_s"], 1e-9)
+        out["scaling_efficiency"] = round(
+            out["n_workers"]["requests_per_s"] / (n_fleet * r1), 3)
+
+        # -- 2. chaos: SIGKILL one worker under sustained load -------------
+        victim = sup.workers[0]
+        spawns_before = victim.spawns
+        res_box: dict = {}
+
+        def chaos_load() -> None:
+            res_box["load"] = pump(port, load_s * 2, n_clients=4)
+
+        loader = threading.Thread(target=chaos_load,
+                                  name="fleet-bench-chaos-load",
+                                  daemon=True)
+        loader.start()
+        time.sleep(load_s * 0.3)
+        t_kill = time.perf_counter()
+        victim.proc.kill()                      # SIGKILL: a real crash
+        while victim.spawns == spawns_before \
+                or victim.state != fleet_mod.READY:
+            if time.perf_counter() - t_kill > 240:
+                break
+            time.sleep(0.05)
+        recovery_s = time.perf_counter() - t_kill
+        loader.join(timeout=load_s * 8 + 240)
+        out["chaos"] = {
+            **res_box.get("load", {}),
+            "recovery_s": round(recovery_s, 3),
+            "respawned": bool(victim.state == fleet_mod.READY),
+            "pointer_intact": registry.current("m") == vid,
+        }
+        out["post_respawn"] = pump(port, load_s)
+        out["chaos"]["pass"] = bool(
+            out["chaos"].get("failed") == 0
+            and out["chaos"]["respawned"]
+            and out["chaos"]["pointer_intact"]
+            and out["post_respawn"]["requests"] > 0)
+    finally:
+        httpd.shutdown()
+        sup.stop(drain=True)
+    d = fleet_mod.fleet_stats()
+    out["fleet_delta"] = {
+        k: v - fleet_before.get(k, 0) for k, v in d.items()
+        if isinstance(v, (int, float))
+        and isinstance(fleet_before.get(k), (int, float))}
+    out["pass"] = bool(out.get("chaos", {}).get("pass"))
+    return out
+
+
 def _fit_stats() -> dict:
     """Fit-path statistics engine benchmark: ONE wide DAG layer of
     opted-in estimators (mean imputers + pivots + a bucketizer over the
@@ -1474,6 +1677,26 @@ def main() -> None:
         except Exception as e:
             _log(f"[bench] drift_canary failed: {e!r}")
             configs["drift_canary"] = {"error": repr(e)[:400]}
+    bench.emit()
+
+    # 4b4. Fleet resilience (the horizontal serving tier proof):
+    #      throughput at 1 vs N workers (scaling efficiency), then
+    #      SIGKILL one worker mid-load — recovery time, zero failed
+    #      client requests beyond the retry budget, post-respawn
+    #      throughput, registry pointer intact. Budget-gated: spawns
+    #      1 + N + 1 worker interpreters.
+    if bench.remaining() < 240:
+        configs["fleet_resilience"] = {
+            "status": "skipped_budget",
+            "remaining_budget_s": round(bench.remaining(), 1)}
+        _log(f"[bench] fleet_resilience skipped: remaining "
+             f"{bench.remaining():.0f}s < 240s")
+    else:
+        try:
+            configs["fleet_resilience"] = _fleet_resilience()
+        except Exception as e:
+            _log(f"[bench] fleet_resilience failed: {e!r}")
+            configs["fleet_resilience"] = {"error": repr(e)[:400]}
     bench.emit()
 
     # 4c. Fit-statistics engine (fit path): one-pass-per-layer fused

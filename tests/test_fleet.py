@@ -1,0 +1,541 @@
+"""Horizontal serving fleet tests (fleet.py + the server/cli satellites).
+
+The fleet robustness contract: the router consistent-hash routes across
+ready workers and fails over to a sibling when one is down (zero failed
+client requests under a real SIGKILL), the supervisor respawns crashed
+workers with backoff and zero registry-pointer corruption (the flock
+discipline releases a dead holder's kernel lock — fresh-interpreter
+SIGKILL verified), a promote issued during an outage is observed by the
+respawned worker on rejoin, rolling drain-then-restart loses zero
+requests, and every survivor score is bit-identical to a single-process
+run."""
+import http.client
+import json
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (FeatureBuilder, Workflow, aot, resilience,
+                               serving)
+from transmogrifai_tpu import fleet as fleet_mod
+from transmogrifai_tpu import server as server_mod
+from transmogrifai_tpu.fleet import (FleetSupervisor, fleet_stats,
+                                     serve_fleet_http)
+from transmogrifai_tpu.lifecycle import ModelRegistry
+from transmogrifai_tpu.models import (BinaryClassificationModelSelector,
+                                      LogisticRegressionFamily)
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+
+BUCKET_CAP = 64
+
+#: fast respawn schedule for tests (the production default backs off to
+#: seconds; a test fleet should come back as fast as the boot allows)
+_FAST_BACKOFF = resilience.RetryPolicy(max_attempts=8, base_delay_s=0.05,
+                                       max_delay_s=0.5, jitter=0.1,
+                                       seed=3)
+
+
+def _train(seed, n=160):
+    rng = np.random.default_rng(seed)
+    y = np.asarray([i % 2 for i in range(n)], float)
+    rng.shuffle(y)
+    records = [{"label": float(y[i]),
+                "x1": float(rng.normal() + y[i]),
+                "x2": float(rng.normal())} for i in range(n)]
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x1").from_column().as_predictor()
+    f2 = FeatureBuilder.Real("x2").from_column().as_predictor()
+    vec = transmogrify([f1, f2])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None,
+        seed=seed)
+    pred = label.transform_with(sel, vec)
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    return model, records, pred
+
+
+@pytest.fixture(scope="module")
+def fleet_env(tmp_path_factory):
+    """Two trained versions of one registry model ('churn', v1
+    promoted), AOT-exported, plus the shared params file every fleet
+    worker boots from."""
+    reg_dir = str(tmp_path_factory.mktemp("registry"))
+    reg = ModelRegistry(reg_dir)
+    env = {"registry": reg, "registry_dir": reg_dir}
+    for tag, seed in (("v1", 11), ("v2", 12)):
+        model, records, pred = _train(seed)
+        mdir = str(tmp_path_factory.mktemp(f"model_{tag}"))
+        edir = str(tmp_path_factory.mktemp(f"export_{tag}"))
+        model.save(mdir, overwrite=True)
+        serving.export_scoring_fn(model, edir, records[:8],
+                                  bucket_cap=BUCKET_CAP)
+        vid = reg.register("churn", mdir, bank_dir=edir,
+                           promote=(tag == "v1"))
+        env[tag] = {"model": model, "records": records, "pred": pred,
+                    "model_dir": mdir, "export_dir": edir, "vid": vid}
+    # a SECOND tenant (same artifacts as churn@v2 under its own name):
+    # the fleet serves a mixed-model roster, like the PR 8 server tests
+    reg.register("fraud", env["v2"]["model_dir"],
+                 bank_dir=env["v2"]["export_dir"], promote=True)
+    params = tmp_path_factory.mktemp("params") / "params.json"
+    params.write_text(json.dumps({"customParams": {
+        "registryDir": reg_dir, "serveBucketCap": BUCKET_CAP,
+        "serveBatchDeadlineMs": 1.0}}))
+    env["params_path"] = str(params)
+    yield env
+    for tag in ("v1", "v2"):
+        env[tag]["model"]._engine_breaker().reset()
+
+
+@pytest.fixture(scope="module")
+def fleet4(fleet_env):
+    """One live 4-worker fleet + router, shared by the module's tests
+    (spawning real interpreters is the expensive part)."""
+    sup = FleetSupervisor(fleet_env["params_path"], workers=4,
+                          respawn_max=6, probe_interval_s=0.1,
+                          backoff=_FAST_BACKOFF)
+    sup.start()
+    sup.wait_ready(timeout_s=240)
+    httpd = serve_fleet_http(sup, port=0, retry_budget=3,
+                             forward_timeout_s=60.0)
+    port = httpd.server_address[1]
+    yield sup, httpd, port
+    httpd.shutdown()
+    sup.stop(drain=True)
+
+
+def _post(port, path, doc, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", path, json.dumps(doc),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, json.loads(r.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _get(port, path, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, json.loads(r.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def _oracle(env_tag, recs, bucket):
+    """The single-process answer a fleet response must match
+    BIT-IDENTICALLY, pushed through the same JSON encode/decode the
+    HTTP front end applies (float repr round-trips exactly, so equal
+    parsed docs ⇔ equal bits)."""
+    eng = env_tag.setdefault("_oracle_engine", None)
+    if eng is None:
+        eng = env_tag["model"].scoring_engine(
+            gate_bandwidth=False, mesh=False, bucket_cap=BUCKET_CAP)
+        aot.load_program_bank(eng, env_tag["export_dir"])
+        env_tag["_oracle_engine"] = eng
+    store = eng.score_store(recs, bucket_min=bucket, use_cache=False)
+    return json.loads(json.dumps(server_mod._store_rows(store),
+                                 default=str))
+
+
+# ---------------------------------------------------------------------------
+# fault sites + cross-process canary agreement (no fleet needed)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_fault_sites_registered():
+    assert "fleet.forward" in resilience.FAULT_SITES
+    assert "fleet.spawn" in resilience.FAULT_SITES
+
+
+def test_canary_routing_agrees_across_processes(tmp_path):
+    """Router-free canary consistency: the deterministic blake2b
+    hash-fraction routing (server._canaried) makes EVERY worker route a
+    given request identically — asserted against a fresh interpreter,
+    so the claim holds across real processes, not just call sites."""
+    rng = np.random.default_rng(7)
+    records = [{"x1": float(rng.normal()), "x2": float(rng.normal())}
+               for _ in range(64)]
+    local = [server_mod.ModelServer._canaried(
+        server_mod._Request([r]), 0.3) for r in records]
+    assert any(local) and not all(local)    # the fraction actually splits
+    rec_file = tmp_path / "records.json"
+    rec_file.write_text(json.dumps(records))
+    probe = textwrap.dedent(f"""
+        import json, sys
+        from transmogrifai_tpu import server as server_mod
+        records = json.load(open({str(rec_file)!r}))
+        flags = [server_mod.ModelServer._canaried(
+            server_mod._Request([r]), 0.3) for r in records]
+        print("FLAGS " + json.dumps(flags))
+    """)
+    proc = subprocess.run([sys.executable, "-c", probe],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    remote = next(json.loads(ln[len("FLAGS "):])
+                  for ln in proc.stdout.splitlines()
+                  if ln.startswith("FLAGS "))
+    assert remote == local
+
+
+# ---------------------------------------------------------------------------
+# routing, aggregation, probes
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_routes_bit_identical_and_aggregates(fleet_env, fleet4):
+    sup, _httpd, port = fleet4
+    recs_all = fleet_env["v1"]["records"]
+    before = fleet_stats()
+    for i in range(10):
+        recs = recs_all[i * 4:(i + 1) * 4]
+        status, doc = _post(port, "/v1/models/churn:score",
+                            {"records": recs})
+        assert status == 200, doc
+        assert doc["rows"] == 4
+        # bit-identical to a single-process run through the same
+        # program (the dispatch bucket pinned, JSON roundtrip on both)
+        assert doc["outputs"] == _oracle(fleet_env["v1"], recs,
+                                         doc["bucket"])
+    d = fleet_stats()
+    assert d["routed_requests"] - before["routed_requests"] == 10
+    assert d["routed_failed"] == before["routed_failed"]
+    # router probes + aggregation
+    status, doc = _get(port, "/healthz")
+    assert status == 200 and len(doc["workers"]) == 4
+    status, doc = _get(port, "/readyz")
+    assert status == 200 and doc["readyWorkers"] == 4
+    status, doc = _get(port, "/stats")
+    assert status == 200
+    assert doc["aggregate"]["requests"] >= 10
+    assert doc["fleet"]["ready"] == 4
+    served = [w for w in doc["workers"].values()
+              if isinstance(w, dict) and w.get("server")]
+    assert served, doc["workers"]
+    # the consistent hash spread distinct payloads across workers
+    assert sum(1 for w in served
+               if (w["server"] or {}).get("requests", 0) > 0) >= 2
+
+
+def test_worker_readyz_and_healthz_split(fleet4):
+    """Probe semantics on a real worker: /healthz 200 (live) and
+    /readyz 200 with the loadable-tenants + queue-headroom document."""
+    sup, _httpd, _port = fleet4
+    h = sup.ready_workers()[0]
+    status, doc = _get(h.port, "/healthz")
+    assert status == 200 and doc["status"] == "ok"
+    status, doc = _get(h.port, "/readyz")
+    assert status == 200 and doc["ready"] is True
+    assert doc["models"] == 2 and doc["queueHeadroom"] == 1.0
+
+
+def test_router_sheds_503_when_no_ready_worker(fleet_env):
+    """An empty fleet sheds loudly: 503 with a reason, tallied — never
+    a hang or a silent drop. (Supervisor never started: zero ready.)"""
+    sup = FleetSupervisor(fleet_env["params_path"], workers=2)
+    httpd = serve_fleet_http(sup, port=0)
+    port = httpd.server_address[1]
+    try:
+        before = fleet_stats()["shed_503"]
+        status, doc = _post(port, "/v1/models/churn:score",
+                            {"records": [{"x1": 1.0, "x2": 2.0}]})
+        assert status == 503 and "no ready worker" in doc["error"]
+        status, _doc = _get(port, "/readyz")
+        assert status == 503
+        assert fleet_stats()["shed_503"] - before >= 1
+    finally:
+        httpd.shutdown()
+        sup.stop(drain=False)
+
+
+def test_forward_fault_site_fails_over(fleet_env, fleet4):
+    """A chaos plan poisoning ``fleet.forward`` on its first attempt
+    still answers the client 200 — the sibling retry absorbs it."""
+    _sup, _httpd, port = fleet4
+    plan = resilience.FaultPlan(seed=5).on("fleet.forward",
+                                           error=OSError, at=[0])
+    before = fleet_stats()["failovers"]
+    with resilience.fault_plan(plan):
+        status, doc = _post(port, "/v1/models/churn:score",
+                            {"records": fleet_env["v1"]["records"][:3]})
+    assert status == 200 and doc["rows"] == 3
+    assert plan.fired("fleet.forward") == 1
+    assert fleet_stats()["failovers"] - before >= 1
+
+
+# ---------------------------------------------------------------------------
+# rolling drain-then-restart: zero drops
+# ---------------------------------------------------------------------------
+
+
+def test_drained_restart_loses_zero_requests(fleet_env, fleet4):
+    sup, _httpd, port = fleet4
+    recs_all = fleet_env["v1"]["records"]
+    results = []
+    res_lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(k):
+        i = 0
+        while not stop.is_set():
+            lo = ((k * 37 + i * 11) % (len(recs_all) - 4))
+            recs = recs_all[lo:lo + 4]
+            status, doc = _post(port, "/v1/models/churn:score",
+                                {"records": recs})
+            with res_lock:
+                results.append((status, recs, doc))
+            i += 1
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=client, args=(k,),
+                                name=f"fleet-roll-client-{k}",
+                                daemon=True) for k in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        before = fleet_stats()["drained_restarts"]
+        sup.restart_worker(sup.workers[1], ready_timeout_s=240)
+        assert fleet_stats()["drained_restarts"] - before == 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=240)
+    assert results
+    failed = [(s, d) for s, _r, d in results if s != 200]
+    assert not failed, failed[:3]
+    # every answer bit-identical to the single-process oracle
+    for status, recs, doc in results:
+        assert doc["outputs"] == _oracle(fleet_env["v1"], recs,
+                                         doc["bucket"])
+    assert len(sup.ready_workers()) == 4
+
+
+# ---------------------------------------------------------------------------
+# the chaos acceptance: SIGKILL mid-load
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_sigkill_failover_respawn_and_post_promote_rejoin(
+        fleet_env, fleet4):
+    """The acceptance chaos test (ISSUE 11): 4-worker fleet under
+    sustained load, SIGKILL one worker → ZERO failed client requests
+    (sibling failover absorbs the in-flight loss), the supervisor
+    respawns it within the backoff budget, the registry CURRENT pointer
+    is unmoved and uncorrupted (fresh-interpreter assert), a promote
+    issued DURING the outage is observed by the respawned worker on
+    rejoin, and every survivor score is bit-identical to a
+    single-process run."""
+    sup, _httpd, port = fleet4
+    reg = fleet_env["registry"]
+    v1, v2 = fleet_env["v1"], fleet_env["v2"]
+    assert reg.current("churn") == v1["vid"]
+    recs_all = v1["records"]
+    # warm EVERY worker's BOTH tenants first: a lazy tenant resolves
+    # CURRENT on its first load, so an un-warmed survivor would
+    # legitimately serve v2 after the mid-outage promote and the
+    # survivor bit-identity assertion below would be ill-posed
+    for h in sup.ready_workers():
+        for name in ("churn", "fraud"):
+            status, _doc = _post(h.port, f"/v1/models/{name}:score",
+                                 {"records": recs_all[:2]})
+            assert status == 200, (name, _doc)
+    results = []
+    res_lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(k):
+        i = 0
+        while not stop.is_set():
+            lo = ((k * 53 + i * 17) % (len(recs_all) - 6))
+            n = 2 + (i % 4)
+            recs = recs_all[lo:lo + n]
+            name = "churn" if (k + i) % 2 == 0 else "fraud"
+            status, doc = _post(port, f"/v1/models/{name}:score",
+                                {"records": recs})
+            with res_lock:
+                results.append((name, status, recs, doc))
+            i += 1
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=client, args=(k,),
+                                name=f"fleet-chaos-client-{k}",
+                                daemon=True) for k in range(4)]
+    for t in threads:
+        t.start()
+    victim = sup.workers[0]
+    spawns_before = victim.spawns
+    respawned_before = fleet_stats()["workers_respawned"]
+    try:
+        time.sleep(0.5)                       # load is flowing
+        victim.proc.send_signal(signal.SIGKILL)   # a REAL crash
+        # the promote lands while the victim is DOWN: the registry's
+        # flock + atomic pointer swap work under fleet load, and the
+        # respawned worker must observe the new CURRENT on rejoin
+        reg.promote("churn", v2["vid"])
+        time.sleep(1.5)                       # sustained load over the outage
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=240)
+
+    # zero failed client requests: failover absorbed the kill
+    assert len(results) >= 40
+    assert {nm for nm, _s, _r, _d in results} == {"churn", "fraud"}
+    failed = [(s, d) for _n, s, _r, d in results if s != 200]
+    assert not failed, failed[:3]
+    # survivors served churn@v1 / fraud@v2 throughout (loaded before
+    # the promote): every answer bit-identical to the single-process
+    # run of the version that tenant was serving
+    for name, status, recs, doc in results:
+        tag = v1 if name == "churn" else v2
+        assert doc["outputs"] == _oracle(tag, recs, doc["bucket"])
+
+    # the supervisor respawns the victim within the backoff budget
+    deadline = time.monotonic() + 240
+    while time.monotonic() < deadline:
+        if victim.spawns > spawns_before \
+                and victim.state == fleet_mod.READY:
+            break
+        time.sleep(0.05)
+    assert victim.spawns > spawns_before, victim.status()
+    assert victim.state == fleet_mod.READY, victim.status()
+    assert fleet_stats()["workers_respawned"] - respawned_before >= 1
+
+    # pointer unmoved by the crash, uncorrupted, readable by a FRESH
+    # interpreter (the crashed holder's flock released automatically)
+    probe = textwrap.dedent(f"""
+        import sys
+        from transmogrifai_tpu.lifecycle import ModelRegistry
+        reg = ModelRegistry({fleet_env["registry_dir"]!r})
+        assert reg.current("churn") == {v2["vid"]!r}, reg.current("churn")
+        reg.promote("churn", {v2["vid"]!r})   # idempotent: not wedged
+        sys.exit(0)
+    """)
+    proc = subprocess.run([sys.executable, "-c", probe],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-800:]
+
+    # the respawned worker resolved the NEW pointer on boot: scoring
+    # DIRECTLY against it answers with v2, bit-identical to v2's
+    # single-process run (v1 and v2 genuinely disagree on this payload)
+    recs = recs_all[:5]
+    deadline = time.monotonic() + 240
+    status, doc = 0, {}
+    while time.monotonic() < deadline:
+        try:
+            status, doc = _post(victim.port, "/v1/models/churn:score",
+                                {"records": recs})
+            if status == 200:
+                break
+        except OSError:
+            pass
+        time.sleep(0.2)
+    assert status == 200, doc
+    v2_answer = _oracle(v2, recs, doc["bucket"])
+    assert doc["outputs"] == v2_answer
+    assert v2_answer != _oracle(v1, recs, doc["bucket"])
+    # restore v1 for any later test using the shared registry
+    reg.promote("churn", v1["vid"])
+
+
+# ---------------------------------------------------------------------------
+# flock crash-release: fresh-interpreter SIGKILL of the lock holder
+# ---------------------------------------------------------------------------
+
+
+def test_sigkill_of_pointer_lock_holder_releases_flock(tmp_path):
+    """Extends the PR 10 crash-mid-promote test to REAL process death:
+    a fresh interpreter takes the registry's pointer flock and is
+    SIGKILLed while holding it. The kernel releases the lock with the
+    process, so a sibling's promote proceeds — no staleness heuristic,
+    no manual cleanup, no wedged fleet."""
+    reg_dir = str(tmp_path / "reg")
+    reg = ModelRegistry(reg_dir)
+    reg.register("m", "/tmp/a", version="va", promote=True)
+    reg.register("m", "/tmp/b", version="vb")
+    holder = textwrap.dedent(f"""
+        import sys, time
+        from transmogrifai_tpu.lifecycle import ModelRegistry
+        reg = ModelRegistry({reg_dir!r})
+        with reg._pointer_mutation("m", timeout_s=5):
+            print("LOCKED", flush=True)
+            time.sleep(300)
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", holder],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "LOCKED" in line, proc.stderr.read()[-800:]
+        # while the holder lives, a sibling CANNOT take the lock ...
+        from transmogrifai_tpu.lifecycle import RegistryError
+        with pytest.raises(RegistryError, match="held elsewhere"):
+            with reg._pointer_mutation("m", timeout_s=0.3):
+                pass
+        # ... SIGKILL the holder: no unlock code runs, only the kernel
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)
+    # the dead holder's flock released automatically: promote proceeds
+    ptr = reg.promote("m", "vb")
+    assert ptr["current"] == "vb" and ptr["previous"] == "va"
+
+
+# ---------------------------------------------------------------------------
+# cli satellites: gen knobs, check validation, fleet arg validation
+# ---------------------------------------------------------------------------
+
+
+def test_cli_gen_emits_fleet_knobs(tmp_path):
+    from transmogrifai_tpu.cli import generate_project
+    csv = tmp_path / "in.csv"
+    csv.write_text("id,label,x\n1,0,0.5\n2,1,1.5\n3,0,0.7\n4,1,1.1\n")
+    out = generate_project(str(csv), "label", str(tmp_path / "proj"),
+                           id_column="id")
+    params = json.loads(open(out["params.json"]).read())
+    for knob in ("fleetWorkers", "fleetBasePort", "workerRespawnMax",
+                 "routerRetryBudget"):
+        assert knob in params["customParams"]
+        assert params["customParams"][knob] is None
+
+
+@pytest.mark.parametrize("key,val", [
+    ("fleetWorkers", 0), ("fleetWorkers", 2.5),
+    ("fleetBasePort", "ephemeral"), ("workerRespawnMax", -1),
+    ("routerRetryBudget", "lots"),
+])
+def test_cli_check_validates_fleet_knobs(tmp_path, capsys, key, val):
+    from transmogrifai_tpu.cli import run_check
+    p = tmp_path / "params.json"
+    p.write_text(json.dumps({"customParams": {key: val}}))
+    assert run_check(str(p)) == 1
+    out = capsys.readouterr().out
+    assert "TMG001" in out and key in out
+
+
+def test_cli_fleet_bad_params_exits_nonzero(tmp_path, capsys):
+    from transmogrifai_tpu.cli import run_fleet
+    assert run_fleet(None) == 1
+    assert "params file is required" in capsys.readouterr().out
+    p = tmp_path / "params.json"
+    p.write_text(json.dumps({"customParams": {"fleetWorkers": "many"}}))
+    assert run_fleet(str(p)) == 1
+    assert "fleetWorkers" in capsys.readouterr().out
+    # an explicit --workers 0 is a config error, not "use the knob"
+    p.write_text(json.dumps({}))
+    assert run_fleet(str(p), workers=0) == 1
+    assert "--workers must be >= 1" in capsys.readouterr().out

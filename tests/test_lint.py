@@ -633,6 +633,47 @@ def test_tmg308_unbounded_queue():
     assert tm.lint_source(other) == []
 
 
+def test_tmg309_popen_explicit_streams():
+    """Fleet-supervisor rule: product-code subprocess.Popen must own
+    its child's streams — an inherited stdout ties worker logs to the
+    parent's terminal, an undrained PIPE deadlocks the child."""
+    tm = _load_tmoglint()
+    bad = ("import subprocess\n"
+           "p = subprocess.Popen(['worker'])\n")
+    assert [f.rule for f in tm.lint_source(bad)] == ["TMG309"]
+    # one missing keyword is still a finding (and names the gap)
+    half = ("import subprocess\n"
+            "p = subprocess.Popen(['worker'], stdout=fh)\n")
+    fs = tm.lint_source(half)
+    assert [f.rule for f in fs] == ["TMG309"]
+    assert "without explicit stderr=" in fs[0].message
+    # the from-import and aliased-module forms trigger too
+    from_import = ("from subprocess import Popen\n"
+                   "p = Popen(['worker'])\n")
+    assert [f.rule for f in tm.lint_source(from_import)] == ["TMG309"]
+    aliased = ("import subprocess as sp\n"
+               "p = sp.Popen(['worker'])\n")
+    assert [f.rule for f in tm.lint_source(aliased)] == ["TMG309"]
+    # fully explicit is clean
+    ok = ("import subprocess\n"
+          "p = subprocess.Popen(['worker'], stdout=fh, "
+          "stderr=subprocess.STDOUT)\n")
+    assert tm.lint_source(ok) == []
+    # subprocess.run is the blocking convenience API, not supervision
+    run_ok = ("import subprocess\n"
+              "subprocess.run(['git', 'rev-parse'], capture_output=True)\n")
+    assert tm.lint_source(run_ok) == []
+    # a **kwargs splat may carry stdout/stderr — no false ERROR
+    splat_ok = ("import subprocess\n"
+                "p = subprocess.Popen(['worker'], **opts)\n")
+    assert tm.lint_source(splat_ok) == []
+    # the popen marker allows a deliberate inherit
+    allowed = ("import subprocess\n"
+               "p = subprocess.Popen(['worker'])  "
+               "# lint: popen — interactive child owns the tty\n")
+    assert tm.lint_source(allowed) == []
+
+
 def test_repo_is_clean_under_self_lint():
     """The meta-test: the package itself reports zero findings — the
     project invariants PRs 1-4 introduced by convention are now CI

@@ -475,6 +475,122 @@ def test_http_front_end(tenants):
         srv.shutdown(drain=True)
 
 
+def test_http_score_timeout_answers_504_and_is_tallied(tenants):
+    """A request that outlives request_timeout_s answers 504 with a
+    structured body (it used to fall into the broad-except and answer
+    500), is tallied, and the still-running future is accounted for —
+    its eventual completion lands in ``timed_out_completions`` instead
+    of vanishing."""
+    import http.client
+    gate = threading.Event()
+    released = threading.Event()
+
+    class Held(ModelServer):
+        def _dispatch(self, entry, batch):
+            released.set()
+            gate.wait(timeout=60)
+            super()._dispatch(entry, batch)
+
+    srv = Held(max_models=2, batch_deadline_s=0.0, bucket_cap=BUCKET_CAP)
+    srv.register("A", model_dir=tenants["A"]["model_dir"])
+    httpd = serve_http(srv, port=0, request_timeout_s=0.3)
+    host, port = httpd.server_address
+    before = server_stats()
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("POST", "/v1/models/A:score",
+                     json.dumps({"records": tenants["A"]["records"][:2]}),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        doc = json.loads(r.read())
+        assert r.status == 504
+        assert "timed out after 0.3s" in doc["error"]
+        conn.close()
+        d = server_stats()
+        assert d["requests_timed_out"] - before["requests_timed_out"] == 1
+        gate.set()                # let the held dispatch complete late
+        srv.shutdown(drain=True, timeout_s=120)
+        d = server_stats()
+        # the future was NOT silently dropped: either the cancel won
+        # (worker skipped it) or its late completion was tallied
+        assert (d["timed_out_completions"]
+                - before["timed_out_completions"]) in (0, 1)
+    finally:
+        gate.set()
+        httpd.shutdown()
+        srv.shutdown(drain=True)
+        _reset_breakers(srv)
+
+
+def test_healthz_draining_and_readyz_split(tenants):
+    """Liveness vs readiness: /healthz flips 503 the instant shutdown
+    begins (a router must stop sending to a draining worker); /readyz
+    reports loadable tenants + queue headroom as its own document."""
+    import http.client
+    srv = _server(tenants)
+    httpd = serve_http(srv, port=0)
+    host, port = httpd.server_address
+
+    def call(path):
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        try:
+            conn.request("GET", path)
+            r = conn.getresponse()
+            return r.status, json.loads(r.read() or b"{}")
+        finally:
+            conn.close()
+
+    try:
+        status, doc = call("/healthz")
+        assert status == 200 and doc["status"] == "ok"
+        status, doc = call("/readyz")
+        assert status == 200 and doc["ready"] is True
+        assert doc["models"] == 2 and doc["queueHeadroom"] == 1.0
+        assert doc["reasons"] == []
+        srv.shutdown(drain=True)
+        status, doc = call("/healthz")
+        assert status == 503 and doc["status"] == "draining"
+        status, doc = call("/readyz")
+        assert status == 503 and doc["ready"] is False
+        assert "closing" in doc["reasons"]
+    finally:
+        httpd.shutdown()
+        srv.shutdown(drain=True)
+
+
+def test_readiness_reports_queue_saturation(tenants):
+    """A server whose queues are nearly full stops being READY while
+    staying LIVE — the router keeps the worker but stops sending."""
+    gate = threading.Event()
+    released = threading.Event()
+
+    class Held(ModelServer):
+        def _dispatch(self, entry, batch):
+            released.set()
+            gate.wait(timeout=60)
+            super()._dispatch(entry, batch)
+
+    srv = Held(max_models=2, max_queue=2, batch_deadline_s=0.0,
+               bucket_cap=BUCKET_CAP)
+    srv.register("A", model_dir=tenants["A"]["model_dir"])
+    try:
+        recs = tenants["A"]["records"]
+        futs = [srv.submit("A", recs[:2])]
+        released.wait(timeout=60)
+        futs += [srv.submit("A", recs[2:4]), srv.submit("A", recs[4:6])]
+        doc = srv.readiness()
+        assert doc["ready"] is False
+        assert any("headroom" in r for r in doc["reasons"])
+        gate.set()
+        for f in futs:
+            assert f.result(timeout=60).rows == 2
+        assert srv.readiness()["ready"] is True
+    finally:
+        gate.set()
+        srv.shutdown(drain=True)
+        _reset_breakers(srv)
+
+
 # ---------------------------------------------------------------------------
 # params-file construction + knob validation (runner/cli satellite)
 # ---------------------------------------------------------------------------

@@ -39,6 +39,13 @@ here as rules (the TMG3xx family of the catalog in
   heap instead of slowing down; the staged pipeline's whole contract
   is bounded queues with explicit backpressure). A deliberate
   unbounded queue carries ``# lint: unbounded-queue — reason``.
+* **TMG309** — product-code ``subprocess.Popen(...)`` must pass
+  explicit ``stdout=`` and ``stderr=`` (the fleet-supervisor rule: an
+  inherited stdout ties a long-lived child's output to whatever
+  terminal started the parent, and a ``PIPE`` nobody drains deadlocks
+  the child once the OS buffer fills — a supervisor must own its
+  workers' streams). A deliberate inherit carries
+  ``# lint: popen — reason``.
 
 Runs as a CLI over one or more paths (default: the ``transmogrifai_tpu``
 package next to this script) and as a tier-1 pytest
@@ -65,7 +72,7 @@ from transmogrifai_tpu.lint import Finding, Severity, enforce  # noqa: E402
 
 __all__ = ["lint_source", "lint_file", "lint_paths", "main",
            "ALLOW_WALLCLOCK", "ALLOW_BROAD_EXCEPT", "ALLOW_EXPLICIT_MESH",
-           "ALLOW_THREAD", "ALLOW_UNBOUNDED_QUEUE"]
+           "ALLOW_THREAD", "ALLOW_UNBOUNDED_QUEUE", "ALLOW_POPEN"]
 
 #: suppression markers, checked on the finding's own source line
 ALLOW_WALLCLOCK = "lint: wall-clock"
@@ -73,6 +80,7 @@ ALLOW_BROAD_EXCEPT = "lint: broad-except"
 ALLOW_EXPLICIT_MESH = "lint: explicit-mesh"
 ALLOW_THREAD = "lint: thread"
 ALLOW_UNBOUNDED_QUEUE = "lint: unbounded-queue"
+ALLOW_POPEN = "lint: popen"
 
 
 def _fault_sites() -> frozenset:
@@ -103,6 +111,8 @@ class _Visitor(ast.NodeVisitor):
         self.thread_funcs: Set[str] = set()      # from threading import Thread
         self.queue_modules: Set[str] = set()
         self.queue_funcs: Set[str] = set()       # from queue import Queue
+        self.subprocess_modules: Set[str] = set()
+        self.popen_funcs: Set[str] = set()       # from subprocess import Popen
         self.with_contexts: Set[int] = set()
         #: parallel/ owns mesh construction, tests may build explicit
         #: topologies — TMG306 exempts both by path
@@ -138,6 +148,8 @@ class _Visitor(ast.NodeVisitor):
                 self.threading_modules.add(local)
             if alias.name == "queue":
                 self.queue_modules.add(local)
+            if alias.name == "subprocess":
+                self.subprocess_modules.add(local)
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
@@ -162,6 +174,8 @@ class _Visitor(ast.NodeVisitor):
                 self.thread_funcs.add(local)
             if mod == "queue" and alias.name == "Queue":
                 self.queue_funcs.add(local)
+            if mod == "subprocess" and alias.name == "Popen":
+                self.popen_funcs.add(local)
         self.generic_visit(node)
 
     # -- with: remember sanctioned context-manager calls -------------------
@@ -240,6 +254,14 @@ class _Visitor(ast.NodeVisitor):
                 and f.value.id in self.queue_modules:
             return True
         return isinstance(f, ast.Name) and f.id in self.queue_funcs
+
+    def _is_popen(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "Popen" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in self.subprocess_modules:
+            return True
+        return isinstance(f, ast.Name) and f.id in self.popen_funcs
 
     def visit_Call(self, node: ast.Call) -> None:
         if self._is_time_time(node) \
@@ -323,6 +345,25 @@ class _Visitor(ast.NodeVisitor):
                     "eat the heap instead of slowing down); pass "
                     "maxsize= (or mark a deliberate unbounded queue "
                     f"'# {ALLOW_UNBOUNDED_QUEUE} — <reason>')")
+        elif self._is_popen(node) \
+                and not self._marked(node.lineno, ALLOW_POPEN):
+            kws = {kw.arg for kw in node.keywords}
+            # a **kwargs splat may well carry stdout/stderr — the
+            # static check cannot see inside it, so don't false-ERROR a
+            # dynamically configured Popen
+            missing = [] if None in kws else \
+                [f"{k}=" for k in ("stdout", "stderr") if k not in kws]
+            if missing:
+                self._add(
+                    "TMG309", node.lineno,
+                    f"subprocess.Popen() without explicit "
+                    f"{' and '.join(missing)} — an inherited stdout "
+                    "ties a long-lived child's output to whatever "
+                    "terminal started the parent, and a PIPE nobody "
+                    "drains deadlocks the child once the OS buffer "
+                    "fills; a supervisor must own its workers' "
+                    "streams (or mark a deliberate inherit "
+                    f"'# {ALLOW_POPEN} — <reason>')")
         self.generic_visit(node)
 
 

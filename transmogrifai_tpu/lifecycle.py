@@ -273,6 +273,16 @@ class ModelRegistry:
         doc = self._read_json(os.path.join(self._mdir(name), POINTER_FILE))
         return doc or {"current": None, "previous": None}
 
+    def pointer_lock_path(self, name: str) -> str:
+        """The persistent flock file that serializes pointer writers
+        across processes — exposed so the fleet chaos suite can prove
+        the discipline's crash story: a SIGKILLed holder's kernel lock
+        releases automatically (no staleness heuristic to mis-steal
+        from a merely-slow holder), so a dead fleet worker can never
+        wedge a sibling's promote (tests/test_fleet.py)."""
+        return os.path.join(self._mdir(name, create=True),
+                            POINTER_FILE + ".lock")
+
     @contextlib.contextmanager
     def _pointer_mutation(self, name: str, timeout_s: float = 10.0):
         """Cross-process mutual exclusion for the pointer's
@@ -287,8 +297,7 @@ class ModelRegistry:
         take it — the pointer file itself stays a single atomic
         document."""
         import fcntl
-        path = os.path.join(self._mdir(name, create=True),
-                            POINTER_FILE + ".lock")
+        path = self.pointer_lock_path(name)
         fd = os.open(path, os.O_CREAT | os.O_WRONLY, 0o644)
         t0 = time.monotonic()
         try:

@@ -139,6 +139,17 @@ RULES: Dict[str, Tuple[str, str]] = {
                "unnamed threads make per-thread telemetry trace tracks "
                "unreadable and implicit daemonness hides shutdown "
                "semantics (allow: '# lint: thread — reason')"),
+    "TMG308": (Severity.ERROR,
+               "queue.Queue() without an explicit positive maxsize= — "
+               "an unbounded queue between pipeline stages hides "
+               "backpressure (allow: '# lint: unbounded-queue — "
+               "reason')"),
+    "TMG309": (Severity.ERROR,
+               "subprocess.Popen() without explicit stdout= and "
+               "stderr= — an inherited stream ties the child to the "
+               "parent's terminal and an undrained PIPE deadlocks it; "
+               "a supervisor owns its workers' streams (allow: "
+               "'# lint: popen — reason')"),
     # -- TMG5xx: serving / AOT-bank advisories (aot.py, serving.py,
     #    server.py) — degradation notices, never crash paths ---------------
     "TMG501": (Severity.WARNING,

@@ -120,6 +120,16 @@ FAULT_SITES = frozenset({
     "server.dispatch",           # model-server micro-batch dispatch
                                  # (server.py — batch AND per-request
                                  # fallback attempts pass through it)
+    "fleet.forward",             # router→worker forward attempt
+                                 # (fleet.serve_fleet_http — fires per
+                                 # attempt, so a fault models a dead or
+                                 # unreachable worker and the sibling
+                                 # retry is the recovery under test)
+    "fleet.spawn",               # worker process spawn/respawn
+                                 # (fleet.FleetSupervisor._spawn —
+                                 # fires before Popen, so a fault
+                                 # models a spawn failure and re-enters
+                                 # the jittered respawn backoff)
     "lifecycle.promote",         # registry current-pointer swap
                                  # (lifecycle.ModelRegistry.promote —
                                  # fires BEFORE the atomic os.replace,

@@ -500,6 +500,12 @@ class OpWorkflowRunner:
                     from . import lifecycle as _lifecycle
                     result.metrics["lifecycle"] = \
                         _lifecycle.lifecycle_stats()
+                    # serving-fleet tallies ride on every doc too:
+                    # spawns/respawns, routed requests, failovers and
+                    # load shedding (fleet.py, docs/fleet.md) — zeros
+                    # on runs that never touch the fleet tier
+                    from . import fleet as _fleet
+                    result.metrics["fleet"] = _fleet.fleet_stats()
                     # input-pipeline tallies ride on every doc too:
                     # converged prefetch depth, worker count, buffer
                     # reuse and the sustained-bandwidth measurement

@@ -33,8 +33,11 @@ Correctness contract
 The HTTP front end is stdlib-only (``http.server``)::
 
     POST /v1/models/<name>:score   {"records": [...]}  → scored rows
+                                   (504 once request_timeout_s elapses)
     GET  /v1/models                → model table + stats
-    GET  /healthz                  → liveness
+    GET  /healthz                  → liveness (503 once shutdown began)
+    GET  /readyz                   → readiness: loadable tenants +
+                                     queue headroom (docs/fleet.md)
     GET  /stats                    → server_stats() + per-model stats
 
 Run it with ``python -m transmogrifai_tpu serve params.json`` (knobs:
@@ -50,6 +53,7 @@ import threading
 import time
 from collections import OrderedDict, deque
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
@@ -62,7 +66,7 @@ logger = logging.getLogger(__name__)
 
 __all__ = ["ModelServer", "RequestResult", "ServerError", "ModelNotFound",
            "ServerBusy", "ServerClosed", "RolloutError", "serve_http",
-           "server_stats", "reset_server_stats",
+           "server_stats", "reset_server_stats", "READY_MIN_HEADROOM",
            "DEFAULT_BATCH_DEADLINE_MS", "DEFAULT_MAX_QUEUE",
            "DEFAULT_MAX_MODELS", "DEFAULT_CANARY_FRACTION",
            "DEFAULT_ROLLOUT_WINDOW_REQUESTS", "DEFAULT_PROMOTE_WINDOWS"]
@@ -88,6 +92,12 @@ DEFAULT_ROLLOUT_WINDOW_REQUESTS = 64
 
 #: consecutive clean windows before a rollout auto-promotes
 DEFAULT_PROMOTE_WINDOWS = 3
+
+#: readiness gate: the server reports NOT ready once its summed queue
+#: depth leaves less than this fraction of total queue capacity free —
+#: a router keeps sending to a busy-but-ready worker and stops before
+#: the queues actually overflow into 429s
+READY_MIN_HEADROOM = 0.1
 
 #: record batches the off-path drift queue holds before it starts
 #: dropping (dropped batches are tallied, never block a worker)
@@ -124,7 +134,8 @@ _TALLY_LOCK = threading.Lock()
 _TALLY = {"requests": 0, "requests_failed": 0, "rows": 0, "batches": 0,
           "coalesced_requests": 0, "bank_hit_batches": 0, "rejected": 0,
           "quarantined_requests": 0, "model_loads": 0, "model_evictions": 0,
-          "bank_loads": 0, "slo_met": 0, "slo_missed": 0}
+          "bank_loads": 0, "slo_met": 0, "slo_missed": 0,
+          "requests_timed_out": 0, "timed_out_completions": 0}
 
 
 def server_stats() -> Dict[str, Any]:
@@ -1259,6 +1270,44 @@ class ModelServer:
             engine_tier=engine_tier, canary=canary))
 
     # -- stats / shutdown --------------------------------------------------
+    @property
+    def closing(self) -> bool:
+        """True once :meth:`shutdown` has begun — liveness (``/healthz``)
+        reports 503 from that instant so a supervisor/router never
+        routes a request to a draining worker."""
+        return self._closed
+
+    def readiness(self) -> Dict[str, Any]:
+        """Readiness, distinct from liveness: can this server usefully
+        take traffic RIGHT NOW? Ready iff it is not closing, has at
+        least one tenant, every tenant is loadable (loaded, or saved on
+        disk for a milliseconds bank reload), and the summed queue
+        depth leaves at least ``READY_MIN_HEADROOM`` of total capacity
+        free. The ``/readyz`` document; reasons name what failed."""
+        with self._lock:
+            closed = self._closed
+            entries = list(self._entries.items())
+        depth = sum(e.queue.qsize() for _, e in entries)
+        capacity = self.max_queue * len(entries)
+        headroom = (1.0 - depth / capacity) if capacity else 0.0
+        loaded = [n for n, e in entries if e.model is not None]
+        unloadable = [n for n, e in entries
+                      if e.model is None and not e.model_dir]
+        reasons: List[str] = []
+        if closed:
+            reasons.append("closing")
+        if not entries:
+            reasons.append("no models registered")
+        if unloadable:
+            reasons.append(f"tenants not loadable: {unloadable}")
+        if entries and headroom < READY_MIN_HEADROOM:
+            reasons.append(
+                f"queue headroom {headroom:.3f} < {READY_MIN_HEADROOM}")
+        return {"ready": not reasons, "reasons": reasons,
+                "models": len(entries), "loadedModels": loaded,
+                "queueDepth": depth,
+                "queueHeadroom": round(headroom, 4)}
+
     def stats(self) -> Dict[str, Any]:
         """This server's view: global tallies + per-model stats (incl.
         exact p50/p95/p99 over the latency window)."""
@@ -1378,8 +1427,17 @@ def serve_http(server: ModelServer, host: str = "127.0.0.1",
 
         def do_GET(self):
             if self.path == "/healthz":
+                # liveness flips 503 the INSTANT shutdown begins — a
+                # supervisor/router must stop routing to a draining
+                # worker before its queues close (docs/fleet.md)
+                if server.closing:
+                    return self._send(503, {"status": "draining",
+                                            "models": server.models()})
                 return self._send(200, {"status": "ok",
                                         "models": server.models()})
+            if self.path == "/readyz":
+                doc = server.readiness()
+                return self._send(200 if doc["ready"] else 503, doc)
             if self.path == "/stats":
                 return self._send(200, server.stats())
             if self.path == "/v1/models":
@@ -1431,8 +1489,28 @@ def serve_http(server: ModelServer, host: str = "127.0.0.1",
                     return self._send(400, {
                         "error": "body must be {\"records\": [..]} with "
                                  "at least one record"})
-                res = server.submit(name, records).result(
-                    timeout=request_timeout_s)
+                fut = server.submit(name, records)
+                try:
+                    res = fut.result(timeout=request_timeout_s)
+                except FuturesTimeout:
+                    # answer 504, and account for the in-flight future
+                    # either way: a successful cancel means the worker
+                    # will skip it (set_running_or_notify_cancel), an
+                    # unsuccessful one means the dispatch already owns
+                    # it — tally its eventual completion and retrieve
+                    # its exception so the drop is never silent
+                    _tally("requests_timed_out")
+                    telemetry.counter("server.requests_timed_out").inc()
+                    if not fut.cancel():
+                        def _late(f: "Future[RequestResult]") -> None:
+                            _tally("timed_out_completions")
+                            if not f.cancelled():
+                                f.exception()
+                        fut.add_done_callback(_late)
+                    return self._send(504, {
+                        "error": f"timed out after "
+                                 f"{request_timeout_s:g}s",
+                        "model": name, "rows": len(records)})
             except ModelNotFound as e:
                 return self._send(404, {"error": str(e)})
             except (RolloutError, RegistryError, TypeError,
