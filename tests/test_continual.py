@@ -1,0 +1,784 @@
+"""Continuous-training tests (continual.py + the fitstats warm seam).
+
+The self-healing contract: drift windows arm a retrain only after a
+hysteresis streak, the job runs as a supervised subprocess behind a
+flocked ACTIVE slot (exactly one retrainer fleet-wide), a warm-started
+refit Chan-merges the persisted sufficient statistics with the fresh
+slice and matches a cold full refit over the concatenated window, a
+worse-on-holdout candidate is rejected before deploy, the consecutive-
+failure budget disarms LOUDLY, and a SIGKILL mid-retrain (real, fresh
+interpreter) leaves the CURRENT pointer serving the stable version with
+the job record replayable and the storm controls honored on restart.
+"""
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (FeatureBuilder, Workflow, continual,
+                               fitstats, lifecycle, lint, resilience,
+                               serving)
+from transmogrifai_tpu import server as server_mod
+from transmogrifai_tpu.continual import ContinualError, RetrainController
+from transmogrifai_tpu.filters.raw_feature_filter import RawFeatureFilter
+from transmogrifai_tpu.lifecycle import ModelRegistry
+from transmogrifai_tpu.models import (BinaryClassificationModelSelector,
+                                      LogisticRegressionFamily)
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+
+BUCKET_CAP = 64
+N_ROWS = 240
+
+#: the shared data-generation recipe — the trainer subprocess embeds the
+#: SAME code (via _GEN_SRC) so parent and trainer agree on distributions
+_GEN_SRC = textwrap.dedent("""
+    import numpy as np
+
+    def gen(seed, n, shifted=False):
+        rng = np.random.default_rng(seed)
+        y = np.asarray([i % 2 for i in range(n)], float)
+        rng.shuffle(y)
+        recs = []
+        for i in range(n):
+            base = float(0.8 * rng.normal() + 2.0 * y[i])
+            x1 = (30.0 - base) if shifted else base
+            recs.append({
+                "label": float(y[i]),
+                "x1": (None if rng.random() < 0.1 else x1),
+                "x2": float(rng.normal())})
+        return recs
+
+    def build(recs, seed=1):
+        from transmogrifai_tpu import FeatureBuilder, Workflow
+        from transmogrifai_tpu.filters.raw_feature_filter import \\
+            RawFeatureFilter
+        from transmogrifai_tpu.models import (
+            BinaryClassificationModelSelector, LogisticRegressionFamily)
+        from transmogrifai_tpu.ops.transmogrifier import transmogrify
+        label = FeatureBuilder.RealNN("label").from_column().as_response()
+        f1 = FeatureBuilder.Real("x1").from_column().as_predictor()
+        f2 = FeatureBuilder.Real("x2").from_column().as_predictor()
+        vec = transmogrify([f1, f2])
+        sel = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=2, families=[LogisticRegressionFamily()],
+            splitter=None, seed=seed)
+        pred = label.transform_with(sel, vec)
+        return (Workflow().set_input_records(recs)
+                .with_raw_feature_filter(RawFeatureFilter(bins=20))
+                .set_result_features(pred))
+""")
+
+_ns: dict = {}
+exec(_GEN_SRC, _ns)
+gen, build = _ns["gen"], _ns["build"]
+
+
+def _aupr(model_summary):
+    from transmogrifai_tpu.continual import _metric_of
+    return _metric_of(model_summary, "AuPR")
+
+
+@pytest.fixture(scope="module")
+def stable(tmp_path_factory):
+    """One trained stable model (missing values so fill means matter),
+    saved + AOT-exported + registered as the promoted CURRENT."""
+    model = build(gen(11, N_ROWS)).train()
+    mdir = str(tmp_path_factory.mktemp("model_v0"))
+    edir = str(tmp_path_factory.mktemp("export_v0"))
+    model.save(mdir, overwrite=True)
+    recs = gen(11, 16)
+    serving.export_scoring_fn(model, edir, recs[:8],
+                              bucket_cap=BUCKET_CAP)
+    reg_dir = str(tmp_path_factory.mktemp("registry"))
+    reg = ModelRegistry(reg_dir)
+    vid = reg.register("churn", mdir, bank_dir=edir,
+                       train_metrics={"AuPR": _aupr(model.summary())},
+                       promote=True)
+    yield {"model": model, "model_dir": mdir, "export_dir": edir,
+           "registry": reg, "registry_dir": reg_dir, "vid": vid}
+    model._engine_breaker().reset()
+
+
+def _quick_fail_cmd(code=3):
+    return [sys.executable, "-c", f"import sys; sys.exit({code})"]
+
+
+def _no_delay_backoff():
+    return resilience.RetryPolicy(max_attempts=4, base_delay_s=0.0,
+                                  max_delay_s=0.0, jitter=0.0)
+
+
+def _drifted():
+    return [SimpleNamespace(rule="TMG601", feature="x1")]
+
+
+# ---------------------------------------------------------------------------
+# catalog / monoid / persistence
+# ---------------------------------------------------------------------------
+
+
+def test_fault_sites_and_rules_cataloged():
+    for site in ("continual.retrain", "continual.register",
+                 "continual.merge_stats"):
+        assert site in resilience.FAULT_SITES
+    for rule in ("TMG310", "TMG604", "TMG605"):
+        assert rule in lint.RULES
+
+
+def test_sufficient_stats_monoid_matches_concat():
+    """merge(state(a), state(b)) == state(a ++ b) — the Chan-merge
+    exactness the whole warm-start story rests on."""
+    rng = np.random.default_rng(3)
+    a, b = rng.normal(size=999), rng.normal(size=501) + 7.0
+
+    class Col:
+        def __init__(self, v):
+            self.values = v
+            self.mask = np.ones(v.size, bool)
+
+    merged = fitstats.collect_column_state(Col(a)).merge(
+        fitstats.collect_column_state(Col(b)))
+    full = fitstats.collect_column_state(Col(np.concatenate([a, b])))
+    assert merged.count == full.count
+    assert merged.min == full.min and merged.max == full.max
+    assert abs(merged.mean - full.mean) < 1e-12
+    assert abs(merged.finalize("variance") - full.finalize("variance")) \
+        < 1e-12
+    assert abs(merged.finalize("std", (1,)) - full.finalize("std", (1,))) \
+        < 1e-12
+    # JSON round-trip is lossless
+    rt = fitstats.SufficientStats.from_json(json.loads(
+        json.dumps(merged.to_json())))
+    assert rt.to_json() == merged.to_json()
+    # empty-side identity
+    assert fitstats.SufficientStats().merge(full).to_json() \
+        == full.to_json()
+
+
+def test_sufficient_stats_persist_with_model(stable):
+    """Every train persists its moment sufficient stats in model.json;
+    load_warm_stats round-trips them and degrades (TMG604 + tally) on a
+    model dir without them."""
+    assert stable["model"].fit_stats, "train collected no fit_stats"
+    warm = continual.load_warm_stats(stable["model_dir"])
+    assert warm and all(isinstance(v, fitstats.SufficientStats)
+                        for v in warm.values())
+    assert any(k.endswith(":x1") for k in warm)
+    before = continual.continual_stats()["full_refit_fallbacks"]
+    assert continual.load_warm_stats("/nonexistent/model/dir") is None
+    assert continual.continual_stats()["full_refit_fallbacks"] \
+        == before + 1
+
+
+def test_warm_refit_matches_cold_concat_fresh_interpreter(
+        stable, tmp_path):
+    """Satellite: a warm-started refit (merged persisted stats + one
+    pass over the fresh slice) matches a cold full refit over the
+    concatenated window within tolerance, per opted-in estimator family
+    — proven in a FRESH interpreter so the stats round-trip through the
+    saved model on disk, not through process state."""
+    script = _GEN_SRC + textwrap.dedent(f"""
+        import sys
+        from transmogrifai_tpu import continual
+
+        old = gen(11, {N_ROWS})
+        fresh = gen(12, 160, shifted=False)
+        warm_stats = continual.load_warm_stats({stable['model_dir']!r})
+        assert warm_stats, "persisted stats did not load"
+        mw = build(fresh).with_warm_fit_stats(warm_stats).train()
+        mc = build(old + fresh).train()
+
+        def fills(m):
+            return {{st.stage_name(): [float(v) for v in st.fill_values]
+                     for st in m.fitted_stages.values()
+                     if getattr(st, "fill_values", None) is not None}}
+
+        fw, fc = fills(mw), fills(mc)
+        assert fw and set(fw) == set(fc), (fw, fc)
+        for k in fw:
+            for a, b in zip(fw[k], fc[k]):
+                assert abs(a - b) < 1e-6, (k, a, b, fw, fc)
+        from transmogrifai_tpu import fitstats
+        assert fitstats.fitstats_stats()["warm_state_merges"] >= 1
+        print("WARM_PARITY_OK")
+        sys.exit(0)
+    """)
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "WARM_PARITY_OK" in proc.stdout
+
+
+def test_corrupt_warm_stats_degrade_with_tmg604(stable, tmp_path):
+    """Corrupt persisted stats ⇒ load returns None (TMG604, tallied);
+    a mismatched warm mapping ⇒ the train emits TMG604 and runs a full
+    refit — never a crash."""
+    broken = tmp_path / "broken_model"
+    shutil.copytree(stable["model_dir"], broken)
+    mj = broken / "model.json"
+    doc = json.loads(mj.read_text())
+    doc["fitSufficientStats"] = {"0:x1": {"count": "NOT A NUMBER"}}
+    mj.write_text(json.dumps(doc))
+    assert continual.load_warm_stats(str(broken)) is None
+    # keys that match no fused layer: full refit + TMG604, not a crash
+    bogus = {"9:no_such_column": fitstats.SufficientStats(1, 0, 0, 0, 0)}
+    model = build(gen(21, 120)).with_warm_fit_stats(bogus).train()
+    assert model.fitted_stages
+
+
+def test_merge_stats_fault_degrades_column_to_fresh(stable):
+    """An injected continual.merge_stats fault degrades that column to
+    fresh-slice stats — the refit completes, nothing raises."""
+    warm = continual.load_warm_stats(stable["model_dir"])
+    plan = resilience.FaultPlan(seed=5).on("continual.merge_stats",
+                                           error=ValueError)
+    with resilience.fault_plan(plan):
+        model = build(gen(22, 120)).with_warm_fit_stats(warm).train()
+    assert model.fitted_stages
+    assert plan.fired("continual.merge_stats") >= 1
+
+
+# ---------------------------------------------------------------------------
+# storm control (hysteresis, cooldown, failure budget, flock)
+# ---------------------------------------------------------------------------
+
+
+def test_hysteresis_then_cooldown(stable, tmp_path):
+    """One drifted window never trains (arm_windows=2); a clean window
+    resets the streak; two consecutive drifted windows launch ONE job;
+    the cooldown then suppresses further triggers."""
+    c = RetrainController("churn", stable["registry"], _quick_fail_cmd(),
+                          job_dir=str(tmp_path / "jobs"),
+                          arm_windows=2, cooldown_s=60.0,
+                          max_failures=10,
+                          backoff=_no_delay_backoff())
+    c.on_window(_drifted(), {})
+    assert c.status()["streak"] == 1 and not c.jobs()
+    c.on_window([], {})                      # clean window resets
+    assert c.status()["streak"] == 0
+    c.on_window(_drifted(), {})
+    c.on_window(_drifted(), {})              # second consecutive: arm
+    assert c.wait_idle(60)
+    jobs = c.jobs()
+    assert len(jobs) == 1
+    assert jobs[0]["state"] == "failed"
+    assert "exited 3" in jobs[0]["error"]
+    # cooldown: two more drifted windows are suppressed, no second job
+    before = continual.continual_stats()["suppressed_cooldown"]
+    c.on_window(_drifted(), {})
+    c.on_window(_drifted(), {})
+    assert continual.continual_stats()["suppressed_cooldown"] > before
+    assert len(c.jobs()) == 1
+    assert c.status()["cooldownRemainingS"] > 0
+
+
+def test_failure_budget_disarms_loudly_and_rearm(stable, tmp_path):
+    """max_failures consecutive failed jobs ⇒ TMG605 + disarm (never a
+    retrain-crash-retrain hot loop); rearm() restores operation."""
+    c = RetrainController("churn", stable["registry"], _quick_fail_cmd(),
+                          job_dir=str(tmp_path / "jobs"),
+                          arm_windows=1, cooldown_s=0.0, max_failures=2,
+                          backoff=_no_delay_backoff())
+    gave_before = continual.continual_stats()["gave_up"]
+    c.on_window(_drifted(), {})
+    assert c.wait_idle(60)
+    assert not c.status()["disarmed"]
+    c.on_window(_drifted(), {})
+    assert c.wait_idle(60)
+    st = c.status()
+    assert st["disarmed"] and st["failures"] == 2
+    assert continual.continual_stats()["gave_up"] == gave_before + 1
+    # disarmed: further drift is suppressed, loudly tallied
+    before = continual.continual_stats()["suppressed_disarmed"]
+    c.on_window(_drifted(), {})
+    assert continual.continual_stats()["suppressed_disarmed"] > before
+    assert len(c.jobs()) == 2
+    # operator re-arm restores the loop
+    c.rearm()
+    c.on_window(_drifted(), {})
+    assert c.wait_idle(60)
+    assert len(c.jobs()) == 3
+
+
+def test_retrain_fault_site_counts_as_failure(stable, tmp_path):
+    """An injected continual.retrain fault models a job dying at t=0:
+    no subprocess spawns, the failure budget still advances."""
+    c = RetrainController("churn", stable["registry"], _quick_fail_cmd(),
+                          job_dir=str(tmp_path / "jobs"),
+                          arm_windows=1, cooldown_s=0.0, max_failures=5,
+                          backoff=_no_delay_backoff())
+    plan = resilience.FaultPlan(seed=9).on("continual.retrain",
+                                           error=OSError, times=1)
+    with resilience.fault_plan(plan):
+        c.on_window(_drifted(), {})
+        assert c.wait_idle(60)
+    assert plan.fired("continual.retrain") == 1
+    assert c.status()["failures"] == 1
+    assert not c.jobs() or c.jobs()[-1]["state"] != "running"
+
+
+def test_active_slot_flock_single_retrainer(stable, tmp_path):
+    """Two controllers sharing one job dir (the fleet-worker topology):
+    the second trigger finds the ACTIVE slot flocked and drops — one
+    job record, no double retrain."""
+    jd = str(tmp_path / "shared_jobs")
+    slow = [sys.executable, "-c",
+            "import time, sys; time.sleep(2.0); sys.exit(4)"]
+    a = RetrainController("churn", stable["registry"], slow, job_dir=jd,
+                          arm_windows=1, cooldown_s=0.0,
+                          max_failures=10, backoff=_no_delay_backoff())
+    b = RetrainController("churn", stable["registry"], slow, job_dir=jd,
+                          arm_windows=1, cooldown_s=0.0,
+                          max_failures=10, backoff=_no_delay_backoff())
+    suppressed = continual.continual_stats()["suppressed_active"]
+    assert a.trigger() is not None
+    deadline = time.monotonic() + 30
+    while not a.jobs() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert a.jobs(), "first job never started"
+    assert b.trigger() is not None        # thread starts, slot is held
+    assert a.wait_idle(60) and b.wait_idle(60)
+    assert continual.continual_stats()["suppressed_active"] > suppressed
+    assert len(a.jobs()) == 1             # exactly ONE job record
+
+
+def test_holdout_gate_rejects_worse_candidate(stable, tmp_path):
+    """A trainer that produces a model measurably worse than stable on
+    the holdout metric is REJECTED before deploy: nothing registers,
+    the pointer stays, the rejection spends failure budget."""
+    trainer = tmp_path / "bad_trainer.py"
+    trainer.write_text(textwrap.dedent("""
+        import json, os, shutil
+        out = os.environ["TMOG_RETRAIN_OUT"]
+        shutil.copytree(os.environ["TMOG_RETRAIN_STABLE"],
+                        os.path.join(out, "model"))
+        with open(os.path.join(out, "metrics.json"), "w") as fh:
+            json.dump({"AuPR": 0.05}, fh)
+    """))
+    reg = stable["registry"]
+    versions_before = len(reg.versions("churn"))
+    rejected_before = continual.continual_stats()["candidates_rejected"]
+    c = RetrainController("churn", reg,
+                          [sys.executable, str(trainer)],
+                          job_dir=str(tmp_path / "jobs"),
+                          arm_windows=1, cooldown_s=0.0, max_failures=5,
+                          backoff=_no_delay_backoff(),
+                          holdout_metric="AuPR")
+    c.on_window(_drifted(), {})
+    assert c.wait_idle(120)
+    job = c.jobs()[-1]
+    assert job["state"] == "rejected", job
+    assert "holdout" in job["error"]
+    assert continual.continual_stats()["candidates_rejected"] \
+        == rejected_before + 1
+    assert len(reg.versions("churn")) == versions_before
+    assert reg.current("churn") == stable["vid"]
+    assert c.status()["failures"] == 1
+
+
+def test_timeout_kills_stalled_job(stable, tmp_path):
+    """A trainer that outlives timeout_s is SIGKILLed; the job records
+    the kill reason and the budget advances."""
+    slow = [sys.executable, "-c", "import time; time.sleep(120)"]
+    c = RetrainController("churn", stable["registry"], slow,
+                          job_dir=str(tmp_path / "jobs"),
+                          arm_windows=1, cooldown_s=0.0, max_failures=5,
+                          timeout_s=1.0, heartbeat_timeout_s=600.0,
+                          backoff=_no_delay_backoff())
+    killed_before = continual.continual_stats()["jobs_killed"]
+    c.on_window(_drifted(), {})
+    assert c.wait_idle(90)
+    job = c.jobs()[-1]
+    assert job["state"] == "killed" and "timeout" in job["error"]
+    assert continual.continual_stats()["jobs_killed"] \
+        == killed_before + 1
+    assert not continual._pid_alive(job["pid"])
+
+
+# ---------------------------------------------------------------------------
+# crash safety: SIGKILL mid-retrain, recovery, replay
+# ---------------------------------------------------------------------------
+
+
+def test_sigkill_mid_retrain_pointer_safe_and_replayable(
+        stable, tmp_path):
+    """The acceptance chaos test: SIGKILL a REAL controller process
+    mid-retrain. The CURRENT pointer keeps serving the stable version,
+    the job record is on disk in `running`, a fresh controller's
+    recover() marks it interrupted, kills the orphan trainer, and the
+    cooldown + failure budget are honored on retry — a crash can never
+    reset the storm controls."""
+    jd = str(tmp_path / "jobs")
+    child_src = textwrap.dedent(f"""
+        import sys, time
+        from transmogrifai_tpu.continual import RetrainController
+        from transmogrifai_tpu.lifecycle import ModelRegistry
+        reg = ModelRegistry({stable['registry_dir']!r})
+        c = RetrainController(
+            "churn", reg,
+            [sys.executable, "-c", "import time; time.sleep(120)"],
+            job_dir={jd!r}, arm_windows=1, cooldown_s=300.0,
+            max_failures=3)
+        assert c.trigger() is not None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            jobs = c.jobs()
+            if jobs and jobs[-1]["state"] == "running":
+                print("RUNNING", jobs[-1]["pid"], flush=True)
+                break
+            time.sleep(0.05)
+        time.sleep(300)
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", child_src],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    line = proc.stdout.readline()
+    assert line.startswith("RUNNING"), line
+    trainer_pid = int(line.split()[1])
+    proc.send_signal(signal.SIGKILL)      # the crash, mid-retrain
+    proc.wait(timeout=60)
+    # the stable version never stopped being CURRENT
+    reg = stable["registry"]
+    assert reg.current("churn") == stable["vid"]
+    # the job record survived the kill, still marked running
+    probe = RetrainController(
+        "churn", reg, _quick_fail_cmd(), job_dir=jd, arm_windows=1,
+        cooldown_s=300.0, max_failures=3,
+        backoff=_no_delay_backoff())
+    jobs = probe.jobs()
+    assert jobs and jobs[-1]["state"] == "running"
+    assert continual._pid_alive(trainer_pid)      # orphan still alive
+    repaired = probe.recover()
+    assert len(repaired) == 1
+    job = probe.jobs()[-1]
+    assert job["state"] == "interrupted"
+    assert job["replayable"] is False     # trainer never exported
+    deadline = time.monotonic() + 10
+    while continual._pid_alive(trainer_pid) \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not continual._pid_alive(trainer_pid), "orphan not killed"
+    # storm controls restored from the records: budget counted, the
+    # cooldown window re-anchored to the crashed job's start
+    st = probe.status()
+    assert st["failures"] >= 1
+    assert st["cooldownRemainingS"] > 0
+    assert probe.trigger() is None        # cooldown honored on retry
+    assert reg.current("churn") == stable["vid"]
+
+
+def test_interrupted_job_with_export_is_replayable(stable, tmp_path):
+    """A controller that died AFTER its trainer exported (crash
+    mid-register): recover() marks the record replayable and replay()
+    completes register+deploy from disk — no retrain."""
+    jd = tmp_path / "jobs"
+    (jd / "jobs").mkdir(parents=True)
+    out_dir = jd / "jobs" / "job-x.out"
+    shutil.copytree(stable["model_dir"], out_dir / "model")
+    with open(out_dir / "metrics.json", "w") as fh:
+        json.dump({"AuPR": 0.99}, fh)
+    dead = subprocess.run([sys.executable, "-c", "pass"],
+                          capture_output=True)
+    assert dead.returncode == 0
+    record = {"jobId": "job-x", "model": "churn", "state": "running",
+              "trigger": None, "cmd": ["true"],
+              "outDir": str(out_dir), "log": str(jd / "jobs/job-x.log"),
+              "createdAt": 0.0, "controllerPid": 2 ** 22 + os.getpid(),
+              "pid": None, "exitCode": 0, "version": None,
+              "error": None, "replayable": False}
+    with open(jd / "jobs" / "job-x.json", "w") as fh:
+        json.dump(record, fh)
+    reg = stable["registry"]
+    versions_before = len(reg.versions("churn"))
+    c = RetrainController("churn", reg, _quick_fail_cmd(),
+                          job_dir=str(jd), cooldown_s=0.0,
+                          backoff=_no_delay_backoff())
+    c.recover()
+    job = c.job("job-x")
+    assert job["state"] == "interrupted" and job["replayable"]
+    replayed = c.replay("job-x")
+    assert replayed["state"] == "succeeded"
+    assert replayed["version"]
+    # the register half completed from the persisted record (no server
+    # attached: registered, awaiting promote — the pointer is untouched)
+    assert len(reg.versions("churn")) == versions_before + 1
+    assert reg.current("churn") == stable["vid"]
+    with pytest.raises(ContinualError):
+        c.replay("job-x")                 # no longer interrupted
+
+
+# ---------------------------------------------------------------------------
+# satellite: sentinel thread catch-and-tally
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_thread_survives_poison_and_tallies(stable):
+    """Satellite regression: a poison item on the drift queue used to
+    kill the accumulation thread silently (and wedge drain_drift). Now
+    it tallies lifecycle.sentinel_errors, stays accounted, and the
+    thread keeps observing."""
+    srv = server_mod.ModelServer(bucket_cap=BUCKET_CAP,
+                                 batch_deadline_s=0.0,
+                                 registry=stable["registry"],
+                                 drift_window=64)
+    try:
+        srv.register_from_registry("churn")
+        recs = gen(31, 64)
+        srv.score("churn", recs[:8], timeout_s=600)
+        srv.drain_drift()
+        errors_before = lifecycle.lifecycle_stats()["sentinel_errors"]
+        # a malformed queue item: the unpack/coalesce path raises
+        srv._drift_queue.put(("poison item with no records",))
+        srv.drain_drift()             # returns — task_done accounted
+        assert lifecycle.lifecycle_stats()["sentinel_errors"] \
+            == errors_before + 1
+        # the thread is alive and still folds real observations
+        entry = srv._entries["churn"]
+        seen_before = entry.sentinel.rows_seen
+        for i in range(4):
+            srv.score("churn", recs[8 * (i + 1):8 * (i + 2)],
+                      timeout_s=600)
+        srv.drain_drift()
+        assert entry.sentinel.rows_seen > seen_before
+        assert srv._drift_thread.is_alive()
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_drift_subscription_survives_sentinel_rebuild(stable):
+    """subscribe_drift re-attaches across sentinel rebuilds (the
+    promote/eviction path), so the controller's trigger cannot be lost
+    to a reload."""
+    srv = server_mod.ModelServer(bucket_cap=BUCKET_CAP,
+                                 batch_deadline_s=0.0,
+                                 registry=stable["registry"],
+                                 drift_window=64)
+    try:
+        srv.register_from_registry("churn")
+        srv.score("churn", gen(32, 8), timeout_s=600)
+        seen = []
+        srv.subscribe_drift("churn", lambda f, r: seen.append(len(f)))
+        entry = srv._entries["churn"]
+        # simulate the eviction/promote path: sentinel rebuilt
+        with entry.lock:
+            entry.sentinel = srv._build_sentinel(entry.model, "churn")
+        assert entry.sentinel._subscribers, "subscription lost"
+        for i in range(12):
+            srv.score("churn", gen(33 + i, 16), timeout_s=600)
+        srv.drain_drift()
+        assert seen, "no window callback fired after rebuild"
+    finally:
+        srv.shutdown(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# the closed loop (chaos acceptance)
+# ---------------------------------------------------------------------------
+
+
+def _prob_of(store):
+    for n in store.names():
+        col = store[n]
+        if hasattr(col, "probability"):
+            p = np.asarray(col.probability)
+            return p[:, 1] if p.ndim == 2 and p.shape[1] >= 2 \
+                else np.asarray(col.prediction, float)
+    raise AssertionError("no prediction column in result store")
+
+
+def test_self_healing_loop_end_to_end(stable, tmp_path):
+    """The acceptance loop: a covariate-shifted stream trips TMG601, a
+    supervised retrain job runs (warm-started, real subprocess), the
+    candidate registers and canary-promotes on evidence, holdout AuPR
+    recovers, and ZERO client requests drop end to end."""
+    trainer = tmp_path / "trainer.py"
+    trainer.write_text(_GEN_SRC + textwrap.dedent("""
+        import json, os
+        from transmogrifai_tpu import continual, serving
+
+        out = os.environ["TMOG_RETRAIN_OUT"]
+        stable_dir = os.environ.get("TMOG_RETRAIN_STABLE") or None
+        recs = gen(77, 240, shifted=True)      # the fresh (live) slice
+        wf = build(recs, seed=2)
+        warm = continual.load_warm_stats(stable_dir)
+        wf.with_warm_fit_stats(warm)
+        model = wf.train()
+        model.save(os.path.join(out, "model"))
+        serving.export_scoring_fn(model, os.path.join(out, "export"),
+                                  recs[:8], bucket_cap=64)
+        doc = model.summary()
+        doc["warmStarted"] = bool(warm)
+        with open(os.path.join(out, "metrics.json"), "w") as fh:
+            json.dump(doc, fh, default=str)
+        print("TRAINER_DONE", flush=True)
+    """))
+    reg = stable["registry"]
+    srv = server_mod.ModelServer(bucket_cap=BUCKET_CAP,
+                                 batch_deadline_s=0.0,
+                                 registry=reg, drift_window=128)
+    ctrl = None
+    try:
+        srv.register_from_registry("churn")
+        srv.score("churn", gen(40, 8), timeout_s=600)   # warm
+        ctrl = RetrainController(
+            "churn", reg, [sys.executable, str(trainer)],
+            server=srv, job_dir=str(tmp_path / "jobs"),
+            arm_windows=2, cooldown_s=600.0, max_failures=2,
+            timeout_s=500.0, heartbeat_timeout_s=500.0,
+            deploy_mode="canary", canary_fraction=0.35,
+            window_requests=6, promote_windows=2,
+            holdout_metric="AuPR", holdout_tolerance=0.3).attach()
+        shifted = gen(99, 4096, shifted=True)
+        labels, probs = [], []
+        promoted_at = None
+        batch = 8
+        i = 0
+        deadline = time.monotonic() + 420
+        while time.monotonic() < deadline:
+            lo = (i * batch) % (len(shifted) - batch)
+            recs = shifted[lo:lo + batch]
+            res = srv.score("churn", recs, timeout_s=600)
+            assert res.rows == batch          # zero drops, every time
+            labels.extend(r["label"] for r in recs)
+            probs.extend(_prob_of(res.store))
+            i += 1
+            srv.drain_drift()
+            if reg.current("churn") != stable["vid"]:
+                promoted_at = len(labels)
+                break
+        assert promoted_at is not None, (
+            f"loop never promoted: ctrl={ctrl.status()} "
+            f"jobs={ctrl.jobs()}")
+        # drift was detected (TMG601 fired) before anything retrained
+        entry = srv._entries["churn"]
+        job = ctrl.jobs()[-1]
+        assert job["state"] == "deployed", job
+        assert job["version"] and job["version"] != stable["vid"]
+        assert reg.current("churn") == job["version"]
+        # the retrain WARM-started from the persisted stats
+        rec = reg.record("churn", job["version"])
+        assert rec["trainMetrics"]["warmStarted"] is True
+        # traffic keeps flowing on the promoted model; AuPR recovers
+        post_labels, post_probs = [], []
+        for k in range(32):
+            lo = (k * batch) % (len(shifted) - batch)
+            recs = shifted[lo:lo + batch]
+            res = srv.score("churn", recs, timeout_s=600)
+            assert res.rows == batch
+            post_labels.extend(r["label"] for r in recs)
+            post_probs.extend(_prob_of(res.store))
+        from transmogrifai_tpu.evaluators.metrics import binary_metrics
+        n_before = min(promoted_at, 256)
+        y0 = np.asarray(labels[:n_before])
+        s0 = np.asarray(probs[:n_before])
+        before = binary_metrics(y0, (s0 > 0.5).astype(float), s0)["AuPR"]
+        y1 = np.asarray(post_labels)
+        s1 = np.asarray(post_probs)
+        after = binary_metrics(y1, (s1 > 0.5).astype(float), s1)["AuPR"]
+        assert after > before, (before, after)
+        assert after > 0.7, (before, after)
+        # the loop's evidence: drift advisories fired, a canary ran,
+        # the auto-promotion is on the lifecycle tallies
+        stats = lifecycle.lifecycle_stats()
+        assert stats["drift_advisories"] >= 1
+        assert stats["auto_promotions"] >= 1
+    finally:
+        srv.shutdown(drain=True)
+        reg.promote("churn", stable["vid"])   # restore for other tests
+
+
+# ---------------------------------------------------------------------------
+# runner / CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_runner_stamps_continual_block(stable, tmp_path):
+    from transmogrifai_tpu.runner import (OpParams, OpWorkflowRunner,
+                                          RunType)
+    runner = OpWorkflowRunner(build(gen(51, 80)))
+    params = OpParams(metrics_location=str(tmp_path / "m.json"))
+    res = runner.run(RunType.TRAIN, params)
+    assert "continual" in res.metrics
+    assert set(res.metrics["continual"]) \
+        == set(continual.continual_stats())
+    doc = json.loads((tmp_path / "m.json").read_text())
+    assert "continual" in doc
+
+
+def test_cli_gen_emits_retrain_knobs_and_check_validates(tmp_path,
+                                                        capsys):
+    from transmogrifai_tpu import cli
+    csv = tmp_path / "d.csv"
+    csv.write_text("label,x1\n1,0.5\n0,1.5\n" * 40)
+    out = tmp_path / "proj"
+    cli.generate_project(str(csv), "label", str(out))
+    params = json.loads((out / "params.json").read_text())
+    for key in ("retrainOnDrift", "retrainCmd", "retrainArmWindows",
+                "retrainCooldownS", "retrainMaxFailures",
+                "retrainTimeoutS"):
+        assert key in params["customParams"]
+    # a generated params file is clean
+    assert cli.run_check(str(out / "params.json")) == 0
+    capsys.readouterr()
+    # malformed knobs are TMG001
+    bad = dict(params)
+    bad["customParams"] = dict(params["customParams"],
+                               retrainCooldownS="soon",
+                               retrainCmd="not-a-list",
+                               retrainOnDrift="yes")
+    bad_path = tmp_path / "bad.json"
+    bad_path.write_text(json.dumps(bad))
+    assert cli.run_check(str(bad_path)) == 1
+    out_text = capsys.readouterr().out
+    assert out_text.count("TMG001") == 3
+    assert "retrainCooldownS" in out_text
+    assert "retrainCmd" in out_text
+    assert "retrainOnDrift" in out_text
+
+
+def test_serve_retrain_wiring_validation(stable):
+    """build_retrain_controllers: misuse fails loudly, a correct config
+    attaches one recovered controller per promoted tenant."""
+    from transmogrifai_tpu.cli import build_retrain_controllers
+    from transmogrifai_tpu.runner import OpParams
+    srv = server_mod.ModelServer(bucket_cap=BUCKET_CAP,
+                                 registry=stable["registry"],
+                                 drift_window=128)
+    try:
+        srv.register_from_registry("churn")
+        off = OpParams()
+        assert build_retrain_controllers(off, srv) == []
+        p = OpParams(custom_params={"retrainOnDrift": True})
+        with pytest.raises(ValueError, match="retrainCmd"):
+            build_retrain_controllers(p, srv)
+        p = OpParams(custom_params={
+            "retrainOnDrift": True,
+            "retrainCmd": [sys.executable, "-c", "pass"],
+            "retrainArmWindows": 3, "retrainCooldownS": 1.0,
+            "retrainMaxFailures": 4, "retrainTimeoutS": 60.0})
+        ctrls = build_retrain_controllers(p, srv)
+        assert len(ctrls) == 1
+        assert ctrls[0].arm_windows == 3
+        assert ctrls[0].max_failures == 4
+    finally:
+        srv.shutdown(drain=True)
+    # driftless server: loud error, not a silent no-op loop
+    srv2 = server_mod.ModelServer(bucket_cap=BUCKET_CAP,
+                                  registry=stable["registry"])
+    try:
+        srv2.register_from_registry("churn")
+        p = OpParams(custom_params={
+            "retrainOnDrift": True,
+            "retrainCmd": [sys.executable, "-c", "pass"]})
+        with pytest.raises(ValueError, match="driftWindow"):
+            build_retrain_controllers(p, srv2)
+    finally:
+        srv2.shutdown(drain=True)

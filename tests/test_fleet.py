@@ -539,3 +539,39 @@ def test_cli_fleet_bad_params_exits_nonzero(tmp_path, capsys):
     p.write_text(json.dumps({}))
     assert run_fleet(str(p), workers=0) == 1
     assert "--workers must be >= 1" in capsys.readouterr().out
+
+
+def test_respawn_budget_resets_after_sustained_health(tmp_path):
+    """Satellite regression: the consecutive-crash budget resets after
+    a SUSTAINED-healthy interval (READY for >= the backoff max delay),
+    so a worker crashing once a day never exhausts workerRespawnMax —
+    while a flicker-ready crash loop (which the old instant reset let
+    evade the budget forever) still exhausts it. Pure state-machine
+    test: no processes are spawned."""
+    backoff = resilience.RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                                     max_delay_s=0.05, jitter=0.0)
+    sup = FleetSupervisor(str(tmp_path / "params.json"), workers=1,
+                          respawn_max=1, backoff=backoff,
+                          log_dir=str(tmp_path / "logs"))
+    h = sup.workers[0]
+    # crash #1: within budget, scheduled for respawn
+    sup._note_crash(h)
+    assert h.state == fleet_mod.DEAD and h.restarts == 1
+    # back READY: the budget does NOT reset on the first probe
+    sup._note_ready(h)
+    assert h.state == fleet_mod.READY and h.restarts == 1
+    # ... but does after the sustained-healthy interval
+    time.sleep(backoff.max_delay_s + 0.02)
+    sup._note_ready(h)
+    assert h.restarts == 0
+    # crash #2, a day-later-style spaced crash: a NEW incident — the
+    # worker respawns instead of being given up on (was: FAILED once
+    # the lifetime count crept past the budget)
+    sup._note_crash(h)
+    assert h.state == fleet_mod.DEAD and h.restarts == 1
+    # flicker-ready crash loop: READY too briefly to reset, so the
+    # SECOND crash exhausts respawn_max=1 and the worker goes FAILED
+    sup._note_ready(h)
+    sup._note_crash(h)
+    assert h.restarts == 2
+    assert h.state == fleet_mod.FAILED

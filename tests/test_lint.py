@@ -674,6 +674,63 @@ def test_tmg309_popen_explicit_streams():
     assert tm.lint_source(allowed) == []
 
 
+def test_tmg310_thread_loop_must_catch():
+    """Continual-tier rule: a while loop inside a Thread target with no
+    try anywhere in its body dies silently on the first exception —
+    loop bodies must catch-and-tally."""
+    tm = _load_tmoglint()
+    bad = ("import threading\n"
+           "def loop():\n"
+           "    while True:\n"
+           "        work()\n"
+           "threading.Thread(target=loop, name='w', daemon=True)\n")
+    assert [f.rule for f in tm.lint_source(bad)] == ["TMG310"]
+    # method targets (target=self._loop) resolve by attribute name,
+    # and definition order does not matter (post-pass resolution)
+    method = ("import threading\n"
+              "class S:\n"
+              "    def start(self):\n"
+              "        threading.Thread(target=self._loop, name='w',\n"
+              "                         daemon=True).start()\n"
+              "    def _loop(self):\n"
+              "        while True:\n"
+              "            step()\n")
+    assert [f.rule for f in tm.lint_source(method)] == ["TMG310"]
+    # a try ANYWHERE in the while body is the catch-and-tally shape
+    ok = ("import threading\n"
+          "def loop():\n"
+          "    while True:\n"
+          "        try:\n"
+          "            work()\n"
+          "        except ValueError:\n"
+          "            tally()\n"
+          "threading.Thread(target=loop, name='w', daemon=True)\n")
+    assert tm.lint_source(ok) == []
+    # a function never used as a thread target is out of scope
+    plain = ("def loop():\n"
+             "    while True:\n"
+             "        work()\n")
+    assert tm.lint_source(plain) == []
+    # library targets the module does not define are out of scope
+    lib = ("import threading\n"
+           "threading.Thread(target=httpd.serve_forever, name='h',\n"
+           "                 daemon=True)\n")
+    assert tm.lint_source(lib) == []
+    # the marker allows a deliberately bare loop — while or def line
+    allowed = ("import threading\n"
+               "def loop():\n"
+               "    while True:  # lint: thread-loop — exits with the process\n"
+               "        work()\n"
+               "threading.Thread(target=loop, name='w', daemon=True)\n")
+    assert tm.lint_source(allowed) == []
+    allowed_def = ("import threading\n"
+                   "def loop():  # lint: thread-loop — supervised elsewhere\n"
+                   "    while True:\n"
+                   "        work()\n"
+                   "threading.Thread(target=loop, name='w', daemon=True)\n")
+    assert tm.lint_source(allowed_def) == []
+
+
 def test_repo_is_clean_under_self_lint():
     """The meta-test: the package itself reports zero findings — the
     project invariants PRs 1-4 introduced by convention are now CI

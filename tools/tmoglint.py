@@ -46,6 +46,14 @@ here as rules (the TMG3xx family of the catalog in
   the child once the OS buffer fills — a supervisor must own its
   workers' streams). A deliberate inherit carries
   ``# lint: popen — reason``.
+* **TMG310** — a function used as a ``threading.Thread`` ``target=``
+  must not contain a ``while`` loop with no ``try`` anywhere in its
+  body (the continual-tier rule: an uncaught exception kills the
+  thread SILENTLY — the drift sentinel, a fleet monitor or a retrain
+  supervisor keeps "running" with nobody home while its queue fills
+  and its subsystem rots; long-lived loop bodies must catch-and-tally).
+  A deliberately bare loop carries ``# lint: thread-loop — reason`` on
+  the ``while`` line or the ``def`` line.
 
 Runs as a CLI over one or more paths (default: the ``transmogrifai_tpu``
 package next to this script) and as a tier-1 pytest
@@ -72,7 +80,8 @@ from transmogrifai_tpu.lint import Finding, Severity, enforce  # noqa: E402
 
 __all__ = ["lint_source", "lint_file", "lint_paths", "main",
            "ALLOW_WALLCLOCK", "ALLOW_BROAD_EXCEPT", "ALLOW_EXPLICIT_MESH",
-           "ALLOW_THREAD", "ALLOW_UNBOUNDED_QUEUE", "ALLOW_POPEN"]
+           "ALLOW_THREAD", "ALLOW_UNBOUNDED_QUEUE", "ALLOW_POPEN",
+           "ALLOW_THREAD_LOOP"]
 
 #: suppression markers, checked on the finding's own source line
 ALLOW_WALLCLOCK = "lint: wall-clock"
@@ -81,6 +90,7 @@ ALLOW_EXPLICIT_MESH = "lint: explicit-mesh"
 ALLOW_THREAD = "lint: thread"
 ALLOW_UNBOUNDED_QUEUE = "lint: unbounded-queue"
 ALLOW_POPEN = "lint: popen"
+ALLOW_THREAD_LOOP = "lint: thread-loop"
 
 
 def _fault_sites() -> frozenset:
@@ -114,6 +124,11 @@ class _Visitor(ast.NodeVisitor):
         self.subprocess_modules: Set[str] = set()
         self.popen_funcs: Set[str] = set()       # from subprocess import Popen
         self.with_contexts: Set[int] = set()
+        #: TMG310 bookkeeping: names used as Thread(target=...) and the
+        #: module's function defs by name (methods included; resolved in
+        #: a post-pass so definition order never matters)
+        self.thread_targets: Set[str] = set()
+        self.func_defs: Dict[str, ast.AST] = {}
         #: parallel/ owns mesh construction, tests may build explicit
         #: topologies — TMG306 exempts both by path
         parts = os.path.normpath(path).split(os.sep)
@@ -177,6 +192,13 @@ class _Visitor(ast.NodeVisitor):
             if mod == "subprocess" and alias.name == "Popen":
                 self.popen_funcs.add(local)
         self.generic_visit(node)
+
+    # -- function defs: TMG310 target resolution ---------------------------
+    def visit_FunctionDef(self, node) -> None:
+        self.func_defs.setdefault(node.name, node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
 
     # -- with: remember sanctioned context-manager calls -------------------
     def visit_With(self, node: ast.With) -> None:
@@ -264,6 +286,17 @@ class _Visitor(ast.NodeVisitor):
         return isinstance(f, ast.Name) and f.id in self.popen_funcs
 
     def visit_Call(self, node: ast.Call) -> None:
+        if self._is_thread(node):
+            # TMG310: remember the target's name whatever the TMG307
+            # outcome — `target=self._loop` and `target=loop` both
+            # resolve against the module's function defs in a post-pass
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    v = kw.value
+                    if isinstance(v, ast.Name):
+                        self.thread_targets.add(v.id)
+                    elif isinstance(v, ast.Attribute):
+                        self.thread_targets.add(v.attr)
         if self._is_time_time(node) \
                 and not self._marked(node.lineno, ALLOW_WALLCLOCK):
             self._add(
@@ -367,6 +400,36 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _check_thread_loops(v: _Visitor) -> None:
+    """TMG310 post-pass: every function the module hands to
+    ``threading.Thread(target=...)`` is a long-lived loop body — each of
+    its ``while`` loops must contain a ``try`` somewhere (catch-and-
+    tally), or the first uncaught exception kills the thread silently
+    while its subsystem keeps 'running' with nobody home."""
+    for name in sorted(v.thread_targets):
+        fn = v.func_defs.get(name)
+        if fn is None:
+            continue                # library callable (serve_forever, …)
+        if v._marked(fn.lineno, ALLOW_THREAD_LOOP):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.While):
+                continue
+            if v._marked(node.lineno, ALLOW_THREAD_LOOP):
+                continue
+            if any(isinstance(x, ast.Try) for x in ast.walk(node)):
+                continue
+            v._add(
+                "TMG310", node.lineno,
+                f"'while' loop in thread target {name!r} has no "
+                "try/except anywhere in its body — an uncaught "
+                "exception kills the thread SILENTLY and the subsystem "
+                "it drives keeps 'running' with nobody home; "
+                "catch-and-tally in the loop body (or mark a "
+                "deliberately bare loop "
+                f"'# {ALLOW_THREAD_LOOP} — <reason>')")
+
+
 def lint_source(src: str, path: str = "<string>") -> List[Finding]:
     """Lint one module's source text; returns TMG3xx findings."""
     try:
@@ -376,6 +439,7 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
                         location=f"{path}:{e.lineno or 0}")]
     v = _Visitor(path, src.splitlines())
     v.visit(tree)
+    _check_thread_loops(v)
     return sorted(v.findings, key=lambda f: f.location or "")
 
 

@@ -72,9 +72,11 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 __all__ = [
-    "StatRequest", "StatResults", "LayerStatsPlan",
+    "StatRequest", "StatResults", "LayerStatsPlan", "SufficientStats",
     "FITSTATS_ENABLED", "FITSTATS_MIN_STAGES", "FITSTATS_CHUNK_ROWS",
     "fitstats_stats", "reset_fitstats_stats", "program_cache_stats",
+    "collect_column_state", "sufficient_stats_to_json",
+    "sufficient_stats_from_json", "load_sufficient_stats",
 ]
 
 #: master switch (``TMOG_FITSTATS=0`` disables; tests/bench toggle the
@@ -101,7 +103,8 @@ _MOMENT_KINDS = frozenset(
 
 _TALLY_LOCK = threading.Lock()
 _TALLY = {"layers_fused": 0, "passes_saved": 0, "bytes_scanned": 0,
-          "host_passes": 0, "device_passes": 0, "programs_compiled": 0}
+          "host_passes": 0, "device_passes": 0, "programs_compiled": 0,
+          "warm_state_merges": 0}
 
 
 def fitstats_stats() -> Dict[str, int]:
@@ -182,17 +185,144 @@ class StatResults:
 
 
 # ---------------------------------------------------------------------------
+# sufficient statistics — the continual-learning merge seam
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SufficientStats:
+    """One column's moment-family sufficient statistics as a MONOID:
+    (count, mean, centered M2, min, max). ``merge`` is Chan's parallel
+    combination — the exact merge the device tier's ``_chan_combine``
+    runs across chunks, lifted to a persistable per-column record — so a
+    refit over [old train window + fresh slice] is one merge plus one
+    pass over the fresh slice, never a rescan of the old window
+    (continual.py, docs/lifecycle.md "Continuous training")."""
+
+    count: float = 0.0
+    mean: float = 0.0
+    m2: float = 0.0
+    min: float = float("inf")
+    max: float = float("-inf")
+
+    def merge(self, other: "SufficientStats") -> "SufficientStats":
+        tot = self.count + other.count
+        if tot <= 0:
+            return SufficientStats()
+        delta = other.mean - self.mean
+        mean = (self.count * self.mean + other.count * other.mean) / tot
+        m2 = self.m2 + other.m2 + delta * delta \
+            * self.count * other.count / tot
+        return SufficientStats(tot, mean, m2, min(self.min, other.min),
+                               max(self.max, other.max))
+
+    def finalize(self, kind: str, params: Tuple = ()) -> Any:
+        """The finalized stat value a :class:`StatRequest` of ``kind``
+        asks for — the same expressions the device tier finalizes its
+        Chan-merged partials with."""
+        c = int(self.count)
+        if kind == "count":
+            return c
+        if c == 0:
+            return None
+        if kind == "mean":
+            return float(self.mean)
+        if kind == "variance":
+            return float(self.m2 / c)
+        if kind == "std":
+            ddof = params[0] if params else 0
+            return (float(np.sqrt(self.m2 / (c - ddof)))
+                    if c > ddof else None)
+        if kind == "min":
+            return float(self.min)
+        if kind == "max":
+            return float(self.max)
+        raise ValueError(f"unknown moment kind {kind!r}")
+
+    def to_json(self) -> Dict[str, float]:
+        return {"count": self.count, "mean": self.mean, "m2": self.m2,
+                "min": self.min, "max": self.max}
+
+    @staticmethod
+    def from_json(d: Mapping[str, Any]) -> "SufficientStats":
+        return SufficientStats(float(d["count"]), float(d["mean"]),
+                               float(d["m2"]), float(d["min"]),
+                               float(d["max"]))
+
+
+def collect_column_state(col) -> SufficientStats:
+    """One column's :class:`SufficientStats` from its masked values —
+    the host-tier state collector (the device tier reads its state
+    straight out of the Chan-merged fold partials)."""
+    vals = col.values[col.mask].astype(np.float64)
+    n = int(vals.size)
+    if n == 0:
+        return SufficientStats()
+    return SufficientStats(float(n), float(vals.mean()),
+                           float(vals.var() * n), float(vals.min()),
+                           float(vals.max()))
+
+
+def sufficient_stats_to_json(states: Mapping[str, SufficientStats]
+                             ) -> Dict[str, Dict[str, float]]:
+    return {k: s.to_json() for k, s in states.items()}
+
+
+def sufficient_stats_from_json(doc: Mapping[str, Any]
+                               ) -> Dict[str, SufficientStats]:
+    return {str(k): SufficientStats.from_json(v)
+            for k, v in doc.items()}
+
+
+def load_sufficient_stats(model_dir: str
+                          ) -> Optional[Dict[str, SufficientStats]]:
+    """The train-time sufficient statistics persisted with a saved
+    model (``model.json``'s ``fitSufficientStats`` block), parsed back
+    into mergeable :class:`SufficientStats`. Returns None — the
+    full-refit degradation signal — when the model predates the
+    persistence, carries no fused-fit stats, or the block is corrupt;
+    the caller (``continual.load_warm_stats``) owns the TMG604
+    advisory."""
+    import json as _json
+
+    from .model_io import MODEL_JSON
+    try:
+        with open(os.path.join(model_dir, MODEL_JSON)) as fh:
+            doc = _json.load(fh)
+        raw = doc.get("fitSufficientStats")
+        if not raw:
+            return None
+        return sufficient_stats_from_json(raw)
+    except (OSError, ValueError, KeyError, TypeError):
+        logger.exception("sufficient stats at %s are unreadable",
+                         model_dir)
+        return None
+
+
+# ---------------------------------------------------------------------------
 # host execution — the bit-exact twin of the sequential fit_columns code
 # ---------------------------------------------------------------------------
 
 
-def _host_moment_bundle(col, kinds: Dict[str, List[Tuple]]) -> Dict[Tuple, Any]:
+def _host_moment_bundle(col, kinds: Dict[str, List[Tuple]],
+                        state_out: Optional[Dict[str, Any]] = None,
+                        name: Optional[str] = None) -> Dict[Tuple, Any]:
     """All moment-family stats of one column, computed with the exact
     expressions the sequential fits use: one compressed
     ``values[mask].astype(f64)`` materialization, then numpy's own
-    ``mean/std/var/min/max`` on it."""
+    ``mean/std/var/min/max`` on it. When ``state_out`` is given, the
+    column's :class:`SufficientStats` are derived from the SAME
+    materialized array — state collection never costs a second scan
+    (and never perturbs the bit-exact request values)."""
     vals = col.values[col.mask].astype(np.float64)
     count = int(vals.size)
+    if state_out is not None:
+        state_out[name] = (SufficientStats() if count == 0 else
+                           SufficientStats(float(count),
+                                           float(vals.mean()),
+                                           float(vals.var() * count),
+                                           float(vals.min()),
+                                           float(vals.max())))
     out: Dict[Tuple, Any] = {}
     for kind, params_list in kinds.items():
         for params in params_list:
@@ -375,7 +505,9 @@ _MESH_OFF = os.environ.get("TMOG_FITSTATS_MESH", "1") == "0"
 
 
 def _device_moment_bundles(store, col_kinds: Dict[str, Dict[str, List[Tuple]]],
-                           mesh=None) -> Dict[str, Dict[Tuple, Any]]:
+                           mesh=None,
+                           states_out: Optional[Dict[str, SufficientStats]]
+                           = None) -> Dict[str, Dict[Tuple, Any]]:
     """Device tier: stack the requested scalar columns into [n, k],
     stream fixed-shape row chunks through ONE jitted fold program, and
     combine the per-chunk partials on host in f64.
@@ -504,6 +636,12 @@ def _device_moment_bundles(store, col_kinds: Dict[str, Dict[str, List[Tuple]]],
     out: Dict[str, Dict[Tuple, Any]] = {}
     for j, nm in enumerate(names):
         c = int(cnt[j])
+        if states_out is not None:
+            # the fold's Chan-merged partials ARE the sufficient stats —
+            # the state the continual tier persists with the model
+            states_out[nm] = SufficientStats(
+                float(cnt[j]), float(mean[j]), float(m2[j]),
+                float(mn[j]), float(mx[j]))
         vals: Dict[Tuple, Any] = {}
         for kind, params_list in col_kinds[nm].items():
             for params in params_list:
@@ -565,6 +703,40 @@ class LayerStatsPlan:
     def n_requests(self) -> int:
         return len(self.requests)
 
+    @staticmethod
+    def _warm_merge(states: Dict[str, SufficientStats],
+                    warm_state: Optional[Mapping[str, SufficientStats]]
+                    ) -> Dict[str, SufficientStats]:
+        """Chan-merge the fresh-slice states with the persisted warm
+        states, per column, through the ``continual.merge_stats`` fault
+        site. A fault (or a malformed warm record) degrades THAT
+        column to fresh-only stats — warm start is an optimization,
+        never a dependency — and the degradation is logged + counted,
+        never silent."""
+        merged: Dict[str, SufficientStats] = {}
+        if not warm_state:
+            return merged
+        from . import resilience, telemetry
+        for nm, fresh in states.items():
+            warm = warm_state.get(nm)
+            if warm is None:
+                continue
+            try:
+                resilience.inject("continual.merge_stats", column=nm)
+                merged[nm] = warm.merge(fresh)
+            except Exception:  # lint: broad-except — a failed merge degrades this column to fresh-only stats
+                logger.exception(
+                    "warm-state merge for column %r failed; the refit "
+                    "uses fresh-slice stats for it", nm)
+                continue
+            _tally("warm_state_merges")
+            telemetry.counter("fitstats.warm_state_merges").inc()
+        if merged:
+            logger.info("fitstats: warm-merged %d column(s) of "
+                        "persisted sufficient stats into this pass",
+                        len(merged))
+        return merged
+
     def _gate_device(self, store, tier_hint: Optional[str] = None) -> bool:
         # the breaker is deliberately process-wide (unlike the
         # per-model scoring.engine breaker): the moment-fold program is
@@ -591,7 +763,10 @@ class LayerStatsPlan:
         return resilience.breaker("fitstats.device").allow()
 
     def run(self, store, device: Optional[bool] = None,
-            mesh=None, tier_hint: Optional[str] = None) -> StatResults:
+            mesh=None, tier_hint: Optional[str] = None,
+            state_out: Optional[Dict[str, SufficientStats]] = None,
+            warm_state: Optional[Mapping[str, SufficientStats]] = None
+            ) -> StatResults:
         """Execute every request in one pass; ``device`` overrides the
         bandwidth/row gate (tests pin it either way), ``tier_hint``
         (the planner's measured decision, ``"host"``/``"device"``)
@@ -599,7 +774,19 @@ class LayerStatsPlan:
         device-tier breaker always hold. ``mesh`` is the caller's
         (data, grid) mesh for the device tier's row sharding — None
         falls back to the cached process default, ``False`` forces the
-        unsharded path."""
+        unsharded path.
+
+        The continual-learning seam: ``state_out`` (a dict the caller
+        provides) receives each moment column's :class:`SufficientStats`
+        so the train can persist them with the model; ``warm_state``
+        maps columns to PERSISTED stats from a previous train — each
+        present column's fresh-slice state is Chan-merged with it
+        (through the ``continual.merge_stats`` fault site) and the
+        moment-family request values finalize from the MERGED state, so
+        the refit covers [old window + fresh slice] without rescanning
+        the old window. Columns without a warm entry stay fresh-only;
+        non-moment kinds (quantiles, top-K, sanity) are not mergeable
+        and always compute over the fresh store."""
         from . import telemetry
 
         import time
@@ -625,6 +812,10 @@ class LayerStatsPlan:
 
         values: Dict[Tuple, Any] = {}
         touched: Dict[str, int] = {}
+        #: fresh-slice SufficientStats per moment column — collected
+        #: whenever the caller persists state OR warm-merges
+        states: Dict[str, SufficientStats] = {}
+        want_state = state_out is not None or warm_state is not None
 
         if moment_cols:
             if use_device:
@@ -638,8 +829,9 @@ class LayerStatsPlan:
                 try:
                     resilience.inject("fitstats.device_pass",
                                       rows=store.n_rows)
-                    bundles = _device_moment_bundles(store, moment_cols,
-                                                     mesh=mesh)
+                    bundles = _device_moment_bundles(
+                        store, moment_cols, mesh=mesh,
+                        states_out=states if want_state else None)
                     brk.record_success()
                 except Exception:  # lint: broad-except — breaker-governed device-tier fallback
                     brk.record_failure()
@@ -647,6 +839,7 @@ class LayerStatsPlan:
                         "fitstats device pass failed; computing this "
                         "pass on the host tier")
                     use_device = False
+                    states.clear()
                     # restart the phase-cost window: the failed device
                     # attempt's time must not be charged to the HOST
                     # observation below (it would bias the cost db
@@ -654,13 +847,25 @@ class LayerStatsPlan:
                     t_run = time.perf_counter()
                     c_run = telemetry._COMPILE_CLOCK["s"]
             if not use_device:
-                bundles = {nm: _host_moment_bundle(store[nm], kinds)
-                           for nm, kinds in moment_cols.items()}
+                bundles = {nm: _host_moment_bundle(
+                    store[nm], kinds,
+                    state_out=states if want_state else None, name=nm)
+                    for nm, kinds in moment_cols.items()}
+            merged = self._warm_merge(states, warm_state)
             for r in self.requests:
                 if r.kind in _MOMENT_KINDS:
                     touched.setdefault(r.column, _col_bytes(store[r.column]))
-                    values[r.key()] = \
-                        bundles[r.column][(r.kind, tuple(r.params))]
+                    if r.column in merged:
+                        # warm start: the value reflects [old + fresh]
+                        values[r.key()] = merged[r.column].finalize(
+                            r.kind, tuple(r.params))
+                    else:
+                        values[r.key()] = \
+                            bundles[r.column][(r.kind, tuple(r.params))]
+            if state_out is not None:
+                # the persisted state is the cumulative union: a chain
+                # of warm retrains keeps accumulating, never resets
+                state_out.update({**states, **merged})
 
         for r in other:
             exec_fn = _HOST_EXEC.get(r.kind)

@@ -182,9 +182,12 @@ class WorkerHandle:
         self.port: Optional[int] = None
         self.port_file: Optional[str] = None
         self.state = STARTING
-        self.restarts = 0            # consecutive respawns (ready resets)
+        self.restarts = 0            # consecutive respawns (sustained-
+                                     # healthy interval resets — _probe)
         self.spawns = 0              # lifetime spawns
         self.next_spawn_at = 0.0     # monotonic deadline for the respawn
+        self.ready_since: Optional[float] = None   # monotonic READY entry
+        self.awaiting_ready = False  # a respawn not yet probed READY
         self.last_exit: Optional[int] = None
         #: per-worker failover breaker: open ⇒ the router routes around
         #: this worker without attempting it
@@ -304,6 +307,10 @@ class FleetSupervisor:
         h.state = STARTING
         h.port = port or None
         h.last_exit = None
+        # a respawn (restarts>0) tallies workers_respawned exactly once,
+        # at its FIRST ready probe — readiness flicker after that must
+        # not re-count it now that the restarts counter resets lazily
+        h.awaiting_ready = h.restarts > 0
         _tally("workers_spawned")
         logger.info("fleet: worker %d spawned (pid %d, port %s)",
                     h.wid, h.proc.pid, port or "ephemeral")
@@ -345,6 +352,7 @@ class FleetSupervisor:
     # -- monitor -----------------------------------------------------------
     def _note_crash(self, h: WorkerHandle, error: str = "") -> None:
         h.state = DEAD
+        h.ready_since = None
         _tally("worker_crashes")
         h.restarts += 1
         if h.restarts > self.respawn_max:
@@ -409,16 +417,36 @@ class FleetSupervisor:
                 h.state = STARTING       # unreachable: not routable
             return
         if rdy == 200:
-            if h.state != READY:
-                logger.info("fleet: worker %d ready on port %d "
-                            "(spawn %d)", h.wid, h.port, h.spawns)
-            if h.restarts:
-                _tally("workers_respawned")
-            h.restarts = 0
-            h.state = READY
-            h.breaker.reset()
+            self._note_ready(h)
         elif h.state == READY:
             h.state = STARTING           # lost readiness (queues full)
+            h.ready_since = None
+
+    def _note_ready(self, h: WorkerHandle) -> None:
+        """One successful readiness probe. The consecutive-crash budget
+        resets only after a SUSTAINED-healthy interval — READY for at
+        least the backoff schedule's max delay (was: reset on the FIRST
+        ready probe, which let a flicker-ready crash loop evade the
+        budget forever, while the budget's original never-resetting
+        draft meant a worker crashing once a day eventually exhausted
+        ``workerRespawnMax``). After the interval, the next crash is a
+        NEW incident, not the same crash loop."""
+        now = time.monotonic()
+        if h.state != READY:
+            logger.info("fleet: worker %d ready on port %s (spawn %d)",
+                        h.wid, h.port, h.spawns)
+            h.ready_since = now
+            if h.awaiting_ready:
+                _tally("workers_respawned")
+                h.awaiting_ready = False
+        h.state = READY
+        h.breaker.reset()
+        if h.restarts and h.ready_since is not None \
+                and now - h.ready_since >= self.backoff.max_delay_s:
+            logger.info("fleet: worker %d healthy for %.1fs — "
+                        "consecutive-crash budget reset", h.wid,
+                        now - h.ready_since)
+            h.restarts = 0
 
     def _monitor_loop(self) -> None:
         while not self._stop.is_set():

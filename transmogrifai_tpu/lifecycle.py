@@ -91,7 +91,7 @@ _TALLY_LOCK = threading.Lock()
 _TALLY = {"registered": 0, "promotions": 0, "rollbacks": 0,
           "deploys": 0, "auto_promotions": 0, "auto_rollbacks": 0,
           "drift_windows": 0, "drift_advisories": 0,
-          "drift_dropped_batches": 0,
+          "drift_dropped_batches": 0, "sentinel_errors": 0,
           "shadow_requests": 0, "shadow_parity_ok": 0,
           "shadow_parity_mismatch": 0, "canary_requests": 0}
 
@@ -517,6 +517,11 @@ class DriftSentinel:
         self._lock = threading.Lock()
         self._pending: Dict[Tuple[str, Optional[str]], Any] = {}
         self._pending_rows = 0
+        #: window subscribers: fn(findings, report) called after EVERY
+        #: completed comparison window (clean ones included — a
+        #: hysteresis consumer needs the resets too). The continual
+        #: tier's RetrainController subscribes here.
+        self._subscribers: List[Any] = []
         #: ring of (rows, {key: FeatureDistribution}) sub-window sketches
         self._ring: "deque[Tuple[int, Dict[Tuple[str, Optional[str]], Any]]]" \
             = deque(maxlen=self.subwindows)
@@ -587,6 +592,15 @@ class DriftSentinel:
             return store.select([f.name for f in self._features])
         return _generate_raw_store(data, self._features)
 
+    def subscribe(self, fn) -> None:
+        """Register a window callback ``fn(findings, report)`` invoked
+        after every completed comparison window — including CLEAN ones
+        (findings empty), so a hysteresis consumer (the continual
+        tier's retrain controller) sees its streak resets. Callbacks
+        run on the observing thread and must be cheap; a raising
+        callback is logged and skipped, never kills observation."""
+        self._subscribers.append(fn)
+
     def observe(self, data) -> List[Any]:
         """Fold one scored batch (records or a raw ColumnStore) into the
         current sub-window sketch; returns the findings of any window
@@ -599,6 +613,7 @@ class DriftSentinel:
         store = self._raw_store(data)
         sketch = self._sketch(store)
         findings: List[Any] = []
+        compared = False
         with self._lock:
             self.rows_seen += n
             for k, d in sketch.items():
@@ -613,8 +628,17 @@ class DriftSentinel:
                 if ring_rows >= min(self.window_rows,
                                     self.subwindow_rows * self.subwindows):
                     findings = self._compare_locked(ring_rows)
+                    compared = True
         if findings:
             self._emit(findings)
+        if compared:
+            report = self.last_report
+            for fn in list(self._subscribers):
+                try:
+                    fn(list(findings), report)
+                except Exception:  # lint: broad-except — a subscriber must never take down drift observation
+                    logger.exception(
+                        "drift window subscriber %r failed", fn)
         return findings
 
     # -- comparison --------------------------------------------------------

@@ -150,6 +150,12 @@ RULES: Dict[str, Tuple[str, str]] = {
                "parent's terminal and an undrained PIPE deadlocks it; "
                "a supervisor owns its workers' streams (allow: "
                "'# lint: popen — reason')"),
+    "TMG310": (Severity.ERROR,
+               "long-lived thread loop body without a catch — an "
+               "uncaught exception silently kills the thread and the "
+               "subsystem it drives keeps 'running' with nobody home; "
+               "loop bodies of Thread targets must catch-and-tally "
+               "(allow: '# lint: thread-loop — reason')"),
     # -- TMG5xx: serving / AOT-bank advisories (aot.py, serving.py,
     #    server.py) — degradation notices, never crash paths ---------------
     "TMG501": (Severity.WARNING,
@@ -173,6 +179,16 @@ RULES: Dict[str, Tuple[str, str]] = {
     "TMG603": (Severity.INFO,
                "drift sentinel inactive: model carries no train-time "
                "feature distributions (RawFeatureFilterResults)"),
+    "TMG604": (Severity.WARNING,
+               "continuous-training warm start unavailable: persisted "
+               "train-time sufficient statistics missing or corrupt — "
+               "the retrain degrades to a full refit over the fresh "
+               "window"),
+    "TMG605": (Severity.ERROR,
+               "continuous-training controller FAILED: consecutive "
+               "retrain-job failure budget exhausted — retraining is "
+               "disarmed until an operator clears the job records "
+               "(docs/lifecycle.md runbook)"),
     # -- TMG4xx: whole-DAG planner advisories (planner.py) -----------------
     "TMG401": (Severity.WARNING,
                "stage measured slower on device than host but is pinned "

@@ -12,11 +12,14 @@ feature graph is rebuilt topologically, and fitted models are rebound by uid
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from .columns import ColumnStore
 from .features import Feature
@@ -235,6 +238,12 @@ def collect_stage_records(features: List[Feature],
     return records
 
 
+def _fit_stats_json(model):
+    from .fitstats import SufficientStats
+    return {k: (v.to_json() if isinstance(v, SufficientStats) else v)
+            for k, v in model.fit_stats.items()}
+
+
 def save_workflow_model(model, path: str, overwrite: bool = False) -> None:
     if os.path.exists(os.path.join(path, MODEL_JSON)) and not overwrite:
         raise FileExistsError(f"Model already exists at {path}")
@@ -258,6 +267,13 @@ def save_workflow_model(model, path: str, overwrite: bool = False) -> None:
         "trainTimeSeconds": model.train_time_s,
         "rawFeatureFilterResults": (model.rff_results.to_json()
                                     if model.rff_results is not None else None),
+        # train-time sufficient statistics (fitstats.SufficientStats
+        # monoids per fused moment column): the continual tier's
+        # warm-start seam — a retrain merges these with the fresh
+        # slice's stats instead of rescanning the old train window
+        "fitSufficientStats": (_fit_stats_json(model)
+                               if getattr(model, "fit_stats", None)
+                               else None),
     }
     # Crash-consistent DIRECT save (ADVICE r2): the weights go to a save-
     # unique file name recorded in model.json, and model.json lands last
@@ -499,12 +515,24 @@ def load_workflow_model(path: str):
         from .filters.raw_feature_filter import RawFeatureFilterResults
         rff_results = RawFeatureFilterResults.from_json(
             doc["rawFeatureFilterResults"])
+    fit_stats = None
+    if doc.get("fitSufficientStats"):
+        # tolerant round-trip: a corrupt stats block degrades to a
+        # model without warm-start state, never a failed load
+        try:
+            from .fitstats import sufficient_stats_from_json
+            fit_stats = sufficient_stats_from_json(
+                doc["fitSufficientStats"])
+        except (KeyError, TypeError, ValueError):
+            logger.warning("fitSufficientStats block at %s is "
+                           "malformed; warm-start state dropped", path)
     model = WorkflowModel(
         result_features=result_features,
         fitted_stages=fitted,
         parameters=doc.get("parameters") or {},
         rff_results=rff_results,
         train_time_s=doc.get("trainTimeSeconds", 0.0),
+        fit_stats=fit_stats,
     )
     model.uid = doc["uid"]
     return model

@@ -385,7 +385,7 @@ def map_ordered(fn: Callable[[_T], Any], items: Iterable[_T],
         try:
             while True:
                 with cv:
-                    while not state["stop"] and len(dq) >= _depth():
+                    while not state["stop"] and len(dq) >= _depth():  # lint: thread-loop — bare cv-wait inside the function-wide try/finally (exhausted flag always set)
                         cv.wait()
                     if state["stop"]:
                         return

@@ -135,6 +135,24 @@ FAULT_SITES = frozenset({
                                  # fires BEFORE the atomic os.replace,
                                  # so an injected fault models a crash
                                  # mid-promote: pointer untouched)
+    "continual.retrain",         # drift-triggered retrain job launch
+                                 # (continual.RetrainController — fires
+                                 # after the active-job flock, before
+                                 # the trainer subprocess spawns, so a
+                                 # fault models a job that dies at t=0
+                                 # and exercises the failure budget)
+    "continual.register",        # post-retrain registry registration
+                                 # (continual.RetrainController — fires
+                                 # before registry.register, so a fault
+                                 # models a crash mid-register: the job
+                                 # record stays replayable, the CURRENT
+                                 # pointer untouched)
+    "continual.merge_stats",     # warm-start sufficient-stats merge
+                                 # (fitstats.LayerStatsPlan.run — fires
+                                 # before the Chan merge of persisted
+                                 # train-time moments with the fresh
+                                 # slice, so a fault degrades the refit
+                                 # to fresh-only stats, never a crash)
     "checkpoint.write",          # layer-checkpoint save (workflow.py)
     "checkpoint.rename",         # layer-checkpoint swap (workflow.py)
 })

@@ -500,6 +500,14 @@ class OpWorkflowRunner:
                     from . import lifecycle as _lifecycle
                     result.metrics["lifecycle"] = \
                         _lifecycle.lifecycle_stats()
+                    # continuous-training tallies ride on every doc
+                    # too: drift windows seen, retrain triggers vs
+                    # storm suppression, job outcomes, warm-start vs
+                    # full-refit split (continual.py, docs/lifecycle.md
+                    # "Continuous training")
+                    from . import continual as _continual
+                    result.metrics["continual"] = \
+                        _continual.continual_stats()
                     # serving-fleet tallies ride on every doc too:
                     # spawns/respawns, routed requests, failovers and
                     # load shedding (fleet.py, docs/fleet.md) — zeros

@@ -225,10 +225,16 @@ class _Rollout:
                  model: Any, engine: Any, bank_buckets: List[int],
                  bank_report: Optional[Dict[str, Any]],
                  model_dir: Optional[str], bank_dir: Optional[str],
-                 window_requests: int, promote_windows: int):
+                 window_requests: int, promote_windows: int,
+                 drift_gate: bool = True):
         self.mode = mode
         self.version = version
         self.fraction = float(fraction)
+        #: False = new drift advisories do NOT block promotion — set by
+        #: the continual tier, whose candidate was trained ON the
+        #: drifted window: the stable baseline's TMG601 is the trigger
+        #: that launched this rollout, not evidence against it
+        self.drift_gate = bool(drift_gate)
         self.model = model
         self.engine = engine
         self.bank_buckets = list(bank_buckets)
@@ -393,6 +399,11 @@ class ModelServer:
         self._entries: "OrderedDict[str, _ModelEntry]" = OrderedDict()
         self._lock = threading.Lock()
         self._closed = False
+        #: per-tenant drift-window subscribers (continual.py's retrain
+        #: controller): re-attached every time a tenant's sentinel is
+        #: rebuilt (reload, eviction, promote), so a subscription
+        #: survives the sentinel's lifecycle
+        self._drift_subscribers: Dict[str, List[Any]] = {}
         #: off-path drift accumulation: dispatch workers enqueue scored
         #: record batches O(1) and ONE shared sentinel thread folds them
         #: into the tenants' sketches — observation never rides a
@@ -521,13 +532,40 @@ class ModelServer:
 
     def _build_sentinel(self, model, name: str):
         """The tenant's serving-time drift sentinel (None when the
-        server runs driftless or the model has no persisted baseline)."""
+        server runs driftless or the model has no persisted baseline).
+        Registered drift subscribers re-attach to every rebuild — a
+        promote/eviction swaps the sentinel, never the subscription."""
         if not self.drift_window:
             return None
-        return lifecycle.DriftSentinel.for_model(
+        sentinel = lifecycle.DriftSentinel.for_model(
             model, model_name=name, window_rows=self.drift_window,
             js_threshold=self.drift_js_threshold,
             fill_delta_threshold=self.drift_fill_delta)
+        if sentinel is not None:
+            for fn in self._drift_subscribers.get(name, ()):
+                sentinel.subscribe(fn)
+        return sentinel
+
+    def subscribe_drift(self, name: str, fn) -> None:
+        """Subscribe ``fn(findings, report)`` to tenant ``name``'s
+        completed drift-comparison windows (clean windows included).
+        The subscription survives sentinel rebuilds (reload / eviction
+        / promote) — the continual tier's retrain trigger seam."""
+        with self._lock:
+            entry = self._entries.get(name)
+        if entry is None:
+            raise ModelNotFound(f"no model {name!r} registered "
+                                f"(have: {self.models()})")
+        # under the ENTRY lock: sentinel rebuilds (load/promote/
+        # rollback) happen under it too, so the append and the
+        # attach-to-current-sentinel are atomic against a rebuild — a
+        # racing rebuild either sees the new subscriber in the list or
+        # we attach to the sentinel it just installed, never neither
+        with entry.lock:
+            self._drift_subscribers.setdefault(name, []).append(fn)
+            sentinel = entry.sentinel
+            if sentinel is not None:
+                sentinel.subscribe(fn)
 
     def _entry_weight(self, entry: _ModelEntry) -> int:
         """LRU weight: the bank's serialized-program bytes (the dominant
@@ -959,7 +997,8 @@ class ModelServer:
         drift_now = sentinel.advisories if sentinel is not None else 0
         new_drift = drift_now - rollout.drift_seen
         rollout.drift_seen = drift_now
-        clean = (new_drift == 0 and rollout.win_parity_mismatch == 0)
+        clean = ((new_drift == 0 or not rollout.drift_gate)
+                 and rollout.win_parity_mismatch == 0)
         rollout.windows += 1
         if not clean:
             rollout.clean_windows = 0
@@ -1047,8 +1086,8 @@ class ModelServer:
     def deploy(self, name: str, version: str, mode: str = "shadow",
                fraction: Optional[float] = None,
                window_requests: int = DEFAULT_ROLLOUT_WINDOW_REQUESTS,
-               promote_windows: int = DEFAULT_PROMOTE_WINDOWS
-               ) -> Dict[str, Any]:
+               promote_windows: int = DEFAULT_PROMOTE_WINDOWS,
+               drift_gate: bool = True) -> Dict[str, Any]:
         """Start a shadow or canary rollout of registry ``version`` on
         tenant ``name``.
 
@@ -1061,7 +1100,13 @@ class ModelServer:
         drift advisory, no shadow parity mismatch — the candidate is
         promoted automatically (registry pointer + in-place model swap);
         a breaker trip / dispatch failure / SLO breach rolls back
-        automatically. Returns the rollout status block."""
+        automatically. ``drift_gate=False`` removes the new-drift term
+        from the clean-window evidence — the continual tier sets it for
+        drift-TRIGGERED retrains, whose candidate was trained on the
+        very window the stable baseline keeps flagging (the advisory is
+        the rollout's cause, not evidence against it; the sentinel
+        rebuilds on the candidate's own baseline at promote). Returns
+        the rollout status block."""
         if mode not in ("shadow", "canary"):
             raise RolloutError(
                 f"deploy mode must be 'shadow' or 'canary', got {mode!r}")
@@ -1103,7 +1148,8 @@ class ModelServer:
                            model_dir=rec["modelDir"],
                            bank_dir=rec.get("bankDir"),
                            window_requests=window_requests,
-                           promote_windows=promote_windows)
+                           promote_windows=promote_windows,
+                           drift_gate=drift_gate)
         with entry.lock:
             if entry.rollout is not None:
                 raise RolloutError(
@@ -1163,7 +1209,16 @@ class ModelServer:
         their tenant's sliding sketches. One thread for the whole server,
         coalescing backlog into sub-window-sized passes and throttled to
         ``DRIFT_DUTY_CYCLE`` of host CPU — observation can never crowd
-        out the serving workers' GIL time."""
+        out the serving workers' GIL time.
+
+        The WHOLE per-item body runs inside one catch-and-tally guard:
+        a malformed live record (or a poison queue item) used to be able
+        to raise outside the old observe()-only try — in the unpack or
+        the backlog-coalescing concat — killing the thread silently
+        while the queue kept filling and ``drain_drift`` hung forever.
+        Now any failure tallies ``lifecycle.sentinel_errors`` (surfaced
+        in ``lifecycle_stats()``), its queue items are still accounted
+        (``task_done`` in the finally), and the thread lives."""
         held = None
         while True:
             item = held if held is not None else self._drift_queue.get()
@@ -1171,31 +1226,36 @@ class ModelServer:
             if item is None:                # shutdown sentinel
                 self._drift_queue.task_done()
                 return
-            entry, records = item
             taken = 1
             stop = False
-            while len(records) < DRIFT_COALESCE_ROWS:
-                try:
-                    nxt = self._drift_queue.get_nowait()
-                except queue.Empty:
-                    break
-                if nxt is None:             # shutdown sentinel mid-burst
-                    taken += 1              # its task_done rides below
-                    stop = True
-                    break
-                if nxt[0] is not entry:
-                    held = nxt              # different tenant: next round
-                    break
-                records = records + nxt[1]
-                taken += 1
             t0 = time.perf_counter()
             try:
+                entry, records = item
+                while len(records) < DRIFT_COALESCE_ROWS:
+                    try:
+                        nxt = self._drift_queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    # count the take FIRST: a poison item that raises
+                    # below is still accounted in the finally — an
+                    # uncounted take would wedge drain_drift forever
+                    taken += 1
+                    if nxt is None:         # shutdown sentinel mid-burst
+                        stop = True
+                        break
+                    if nxt[0] is not entry:
+                        held = nxt          # different tenant: next round
+                        taken -= 1          # its task_done rides with it
+                        break
+                    records = records + nxt[1]
                 sentinel = entry.sentinel
                 if sentinel is not None:
                     sentinel.observe(records)
-            except Exception:  # lint: broad-except — drift observation must never take down its thread
+            except Exception:  # lint: broad-except — drift observation must never take down its thread (satellite: catch-and-tally, keep serving)
+                lifecycle.tally("sentinel_errors")
                 logger.exception("server: drift observation failed "
-                                 "for %s", entry.name)
+                                 "(tallied sentinel_errors; the "
+                                 "sentinel thread lives)")
             finally:
                 for _ in range(taken):
                     self._drift_queue.task_done()

@@ -606,6 +606,18 @@ class RunListener:
         ``reason`` kwarg."""
         pass
 
+    def on_retrain(self, model: str, action: str,
+                   job: Optional[str] = None,
+                   version: Optional[str] = None, **_: Any) -> None:
+        """The continuous-training controller changed state
+        (continual.RetrainController, docs/lifecycle.md "Continuous
+        training"): ``action`` is ``trigger`` / ``start`` /
+        ``registered`` / ``deployed`` / ``rejected`` / ``failed`` /
+        ``killed`` / ``recovered`` / ``gave_up``; ``job`` names the
+        on-disk job record, ``version`` the registered candidate.
+        Failures carry an ``error`` kwarg."""
+        pass
+
 
 _LISTENERS: List[RunListener] = []
 
@@ -678,6 +690,7 @@ class CollectingRunListener(RunListener):
         self.requests_failed = 0
         self.drift_advisories: Dict[str, int] = {}
         self.rollouts: Dict[str, int] = {}
+        self.retrains: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     def on_run_start(self, run_type: str, **_: Any) -> None:
@@ -819,6 +832,13 @@ class CollectingRunListener(RunListener):
             self.events.append("rollout")
             self.rollouts[action] = self.rollouts.get(action, 0) + 1
 
+    def on_retrain(self, model: str, action: str,
+                   job: Optional[str] = None,
+                   version: Optional[str] = None, **_: Any) -> None:
+        with self._lock:
+            self.events.append("retrain")
+            self.retrains[action] = self.retrains.get(action, 0) + 1
+
     def summary(self) -> Dict[str, Any]:
         with self._lock:
             return {
@@ -847,6 +867,7 @@ class CollectingRunListener(RunListener):
                 "requestsFailed": self.requests_failed,
                 "driftAdvisories": dict(self.drift_advisories),
                 "rollouts": dict(self.rollouts),
+                "retrains": dict(self.retrains),
             }
 
 

@@ -330,6 +330,14 @@ class Workflow:
         self._workflow_cv = False
         self._checkpoint_dir: Optional[str] = None
         self._warm_stages: Dict[str, FittedModel] = {}
+        #: persisted train-time sufficient statistics from a PREVIOUS
+        #: model ({"<layer>:<column>": fitstats.SufficientStats}) — the
+        #: continual-learning warm start: moment-family fused stats
+        #: Chan-merge [old window + fresh slice] instead of rescanning
+        self._warm_fit_stats = None
+        #: this train's collected sufficient statistics (same keying),
+        #: persisted with the model so the NEXT retrain can warm-start
+        self._fit_state: Dict[str, Any] = {}
         #: per-stage fit/transform wall-clock collected during train
         #: (OpSparkListener StageMetrics analog)
         self._stage_metrics: Dict[str, Dict[str, Any]] = {}
@@ -396,6 +404,24 @@ class Workflow:
         self._warm_stages = dict(model.fitted_stages)
         return self
 
+    def with_warm_fit_stats(self, stats) -> "Workflow":
+        """Warm-start the fused fit-statistics pass from a previous
+        model's persisted sufficient statistics (the continual-learning
+        seam, continual.py / docs/lifecycle.md "Continuous training").
+
+        ``stats`` maps ``"<layer>:<column>"`` to
+        :class:`~transmogrifai_tpu.fitstats.SufficientStats` — the form
+        :func:`fitstats.load_sufficient_stats` returns. During train,
+        each fused layer's moment-family stats are Chan-merged with the
+        matching warm entries, so opted-in estimators fit over
+        [old train window + fresh slice] while the data scan covers only
+        the fresh slice. ``None`` (or an empty dict) is a no-op — the
+        train is a plain cold fit — and columns without a warm entry
+        stay fresh-only, so a partially matching DAG degrades
+        gracefully instead of failing."""
+        self._warm_fit_stats = dict(stats) if stats else None
+        return self
+
     def with_checkpointing(self, directory: str) -> "Workflow":
         """Layer-granular failure recovery: after every fitted DAG layer
         the partial model is persisted to ``directory``; a crashed train
@@ -444,6 +470,10 @@ class Workflow:
     # -- training ----------------------------------------------------------
     def train(self) -> "WorkflowModel":
         raw_features = _raw_features_of(self.result_features)
+        # per-train sufficient-stats collection state (a reused
+        # workflow must not carry a previous train's stats forward)
+        self._fit_state = {}
+        self._warm_matched = 0
         data = self._input_data
         if data is None and self._reader is not None:
             data = self._reader.read_records()
@@ -504,6 +534,17 @@ class Workflow:
                     dag, train_store, test_store, transform_last=False)
         logger.info("train: done in %.2fs (%d fitted stages)",
                     train_time, len(fitted))
+        if self._warm_fit_stats and not self._warm_matched:
+            # warm start was requested but no persisted key matched any
+            # fused layer (different DAG, fusion disabled, ...): the
+            # refit silently became a full fresh-window fit — say so
+            from . import lint
+            f = lint.Finding(
+                "TMG604", "warm-start sufficient statistics matched no "
+                "fused layer of this DAG — the refit ran as a full "
+                "fit over the fresh window")
+            lint.emit_findings([f])
+            logger.warning("train: %s", f.format())
         return WorkflowModel(
             result_features=result_features,
             fitted_stages=fitted,
@@ -514,6 +555,7 @@ class Workflow:
             train_time_s=train_time,
             stage_metrics=self._stage_metrics,
             train_rows=train_store.n_rows,
+            fit_stats=dict(self._fit_state),
         )
 
     def fit(self, resume_from: Optional[str] = None) -> "WorkflowModel":
@@ -623,6 +665,25 @@ class Workflow:
                     transform_last)
         return fitted, time.perf_counter() - t0, train, test
 
+    def _collect_layer_state(self, li: int, requests: Dict[str, list],
+                             train: ColumnStore) -> None:
+        """State-only sufficient-stats collection for a layer below the
+        fusion threshold: one cheap host pass per requested moment
+        column, keyed ``"<layer>:<column>"`` like the fused path, so
+        single-estimator layers still leave a warm-start trail. Best
+        effort — a failure costs the model its warm-start state, never
+        the fit."""
+        from . import fitstats
+        try:
+            cols = {r.column for reqs in requests.values() for r in reqs
+                    if r.kind in fitstats._MOMENT_KINDS}
+            for col in sorted(cols):
+                self._fit_state[f"{li}:{col}"] = \
+                    fitstats.collect_column_state(train[col])
+        except Exception:  # lint: broad-except — state collection is an optimization for FUTURE retrains, never a fit dependency
+            logger.exception("layer %d: sufficient-stats side "
+                             "collection failed", li)
+
     def _layer_stats_pass(self, li: int, layer: Sequence[OpPipelineStage],
                           train: ColumnStore):
         """The fused fit-statistics pass (fitstats.py, the
@@ -654,12 +715,33 @@ class Workflow:
         # scanned sequentially either, so it saves nothing and must not
         # inflate the passes_saved/layers_fused tallies
         n_scanning = sum(1 for reqs in requests.values() if reqs)
-        if n_scanning < fitstats.FITSTATS_MIN_STAGES:
+        # the continual seam, part 1: the warm stats for THIS layer's
+        # columns (a warm match forces the stats path even below the
+        # fusion threshold — the merge needs it)
+        warm = None
+        if self._warm_fit_stats and n_scanning:
+            prefix = f"{li}:"
+            warm = {k[len(prefix):]: v
+                    for k, v in self._warm_fit_stats.items()
+                    if k.startswith(prefix)} or None
+            if warm:
+                self._warm_matched += len(warm)
+        if n_scanning < fitstats.FITSTATS_MIN_STAGES and warm is None:
+            # below the fusion threshold there is no pass to save, but
+            # the moment sufficient stats still persist with the model
+            # (state-only side collection, no fused-pass tallies) so a
+            # FUTURE drift-triggered retrain can warm-start from it
+            if n_scanning:
+                self._collect_layer_state(li, requests, train)
             return None, set()
         try:
             plan = fitstats.LayerStatsPlan(
                 [r for reqs in requests.values() for r in reqs],
                 n_stages=n_scanning)
+            # the continual seam, part 2: collect this layer's
+            # sufficient stats (persisted with the model for the NEXT
+            # warm retrain) alongside the fused pass itself
+            state_out: Dict[str, Any] = {}
             tp = time.perf_counter()
             with telemetry.span("fit:stats_pass", layer=li,
                                 stages=n_scanning,
@@ -670,7 +752,10 @@ class Workflow:
                     mesh=(False if self.mesh is False
                           else getattr(self, "_active_mesh", None)),
                     tier_hint=(self._exec_plan.fitstats_tier
-                               if self._exec_plan is not None else None))
+                               if self._exec_plan is not None else None),
+                    state_out=state_out, warm_state=warm)
+            for col, st in state_out.items():
+                self._fit_state[f"{li}:{col}"] = st
             telemetry.emit("stats_pass", layer=li,
                            n_stages=n_scanning,
                            n_requests=plan.n_requests,
@@ -906,7 +991,8 @@ class WorkflowModel:
                  rff_results=None,
                  train_time_s: float = 0.0,
                  stage_metrics: Optional[Dict[str, Dict[str, Any]]] = None,
-                 train_rows: int = 0):
+                 train_rows: int = 0,
+                 fit_stats: Optional[Dict[str, Any]] = None):
         self.uid = uid_mod.make_uid("WorkflowModel")
         self.result_features = tuple(result_features)
         self.fitted_stages = dict(fitted_stages)
@@ -920,6 +1006,11 @@ class WorkflowModel:
         #: rows of the training split (the cost database's denominator;
         #: 0 on loaded models — only fresh fits record costs)
         self.train_rows = int(train_rows)
+        #: train-time sufficient statistics per fused moment column
+        #: ({"<layer>:<column>": fitstats.SufficientStats}) — persisted
+        #: with the model so a drift-triggered retrain can warm-start
+        #: by monoid merge instead of rescanning (continual.py)
+        self.fit_stats = dict(fit_stats) if fit_stats else {}
         #: lazily built compiled scoring engine (scoring.ScoringEngine);
         #: False = not yet attempted, None = attempted and unusable
         self._scoring_engine: Any = False
