@@ -213,6 +213,14 @@ class Bench:
                 self.doc["pipeline"] = pipeline.pipeline_stats()
             except Exception:
                 self.doc.setdefault("pipeline", None)
+            # temporal-tier tallies (columnar vs row-wise aggregation
+            # split, join traffic, bounded-table spills) ride on EVERY
+            # doc too — the event-log workload's evidence (temporal.py)
+            try:
+                from transmogrifai_tpu import temporal
+                self.doc["temporal"] = temporal.temporal_stats()
+            except Exception:
+                self.doc.setdefault("temporal", None)
         if final:
             self.doc.pop("partial", None)
         print(json.dumps(self.doc), flush=True)
@@ -536,6 +544,171 @@ def _input_pipeline() -> dict:
             out["fusion_gate"]["fusion"] == "ON"
             and out["ingest_speedup"] >= 2.0
             and all(out[f"pipelined_{w}w"]["parity"] for w in (1, 2, 4)))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return out
+
+
+def _event_log() -> dict:
+    """Temporal join+aggregate benchmark (the event-log workload family
+    the reader tier opens — clickstream / transactions / activity-window
+    churn): a seeded two-stream event log (transactions keyed by user ×
+    a small users dimension table) is joined and point-in-time
+    aggregated against a cutoff — per-user spend sum, windowed mean,
+    max, joined segment, and a strictly-after-cutoff response — three
+    ways:
+
+    * **serial row-wise** — the pre-temporal path: per-record Python
+      Avro decode, dict hash join, per-record monoid folds
+      (``aggregateColumnar: false``);
+    * **columnar** — vectorized decode (``read_avro_table``), vectorized
+      join probe + stable-argsort group/fold on one thread;
+    * **columnar + workers** — decode → join → partial-aggregate inside
+      the ordered worker pool (``temporal.join_aggregate_directory``),
+      monoid partials merged in file order.
+
+    Headline is join+aggregate events/s per leg. Pass flag =
+    columnar+workers ≥ 5× serial AND all three stores bit-identical
+    (the engine buys throughput, never answers)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from transmogrifai_tpu import FeatureBuilder, temporal
+    from transmogrifai_tpu.readers import (CutOffTime, DataReaders,
+                                           JoinedAggregateDataReader)
+    from transmogrifai_tpu.readers.avro import (read_avro_records,
+                                                read_avro_table,
+                                                write_avro_records)
+    from transmogrifai_tpu.utils.aggregators import (LogicalOrAggregator,
+                                                     MaxAggregator,
+                                                     MeanAggregator,
+                                                     SumAggregator)
+
+    n_files = int(os.environ.get("BENCH_EVENT_FILES", 16))
+    rows_per_file = int(os.environ.get("BENCH_EVENT_FILE_ROWS", 10_000))
+    n_users = int(os.environ.get("BENCH_EVENT_USERS", 5_000))
+    rows = n_files * rows_per_file
+    cutoff = 800.0
+    rng = np.random.default_rng(47)
+
+    key = temporal.field("user")
+    ts = temporal.field("ts")
+    feats = [
+        FeatureBuilder.Real("spend").extract(temporal.field("amount"),
+                                             "amount")
+        .aggregate(SumAggregator()).as_predictor(),
+        FeatureBuilder.Real("spend_recent")
+        .extract(temporal.field("amount"), "amount")
+        .aggregate(MeanAggregator()).window(200).as_predictor(),
+        FeatureBuilder.Real("peak").extract(temporal.field("amount"),
+                                            "amount")
+        .aggregate(MaxAggregator()).as_predictor(),
+        FeatureBuilder.Real("segment").extract(temporal.field("seg"),
+                                               "seg")
+        .aggregate(MaxAggregator()).as_predictor(),
+        FeatureBuilder.Binary("churned").extract(temporal.field("flag"),
+                                                 "flag")
+        .aggregate(LogicalOrAggregator()).as_response(),
+    ]
+    users = [{"user": float(u), "seg": float(u % 7)}
+             for u in range(n_users)]
+    out: dict = {"rows": rows, "files": n_files, "users": n_users,
+                 "rows_per_file": rows_per_file, "cutoff": cutoff}
+
+    work = tempfile.mkdtemp(prefix="tmog_event_log_")
+    try:
+        for i in range(n_files):
+            uid = rng.integers(0, n_users, rows_per_file).astype(float)
+            recs = [{"user": float(uid[r]),
+                     "ts": float(rng.uniform(0, 1000.0)),
+                     "amount": float(rng.gamma(2.0, 10.0)),
+                     "flag": bool(rng.random() < 0.05)}
+                    for r in range(rows_per_file)]
+            write_avro_records(os.path.join(work, f"e{i:04d}.avro"), recs)
+        files = sorted(os.path.join(work, f) for f in os.listdir(work))
+
+        class _Src:
+            """In-memory reader handing the join its decoded source."""
+
+            def __init__(self, data):
+                self._data = data
+                self.key_fn = key
+
+            def read_records(self):
+                return self._data
+
+        def serial_leg():
+            prev = temporal.set_run_defaults(columnar=False)
+            try:
+                t0 = time.time()
+                recs = []
+                for fp in files:
+                    recs.extend(read_avro_records(fp))
+                reader = JoinedAggregateDataReader(
+                    _Src(recs), DataReaders.simple.records(
+                        users, key_fn=key),
+                    ts, CutOffTime.at(cutoff))
+                store = reader.generate_store(feats)
+                return time.time() - t0, store
+            finally:
+                temporal.set_run_defaults(**prev)
+
+        def columnar_leg():
+            t0 = time.time()
+            tab = temporal.concat_tables(
+                [read_avro_table(fp) for fp in files])
+            reader = JoinedAggregateDataReader(
+                _Src(tab), _Src(temporal.table_from_records(users)),
+                ts, CutOffTime.at(cutoff))
+            store = reader.generate_store(feats)
+            return time.time() - t0, store
+
+        def workers_leg(w):
+            t0 = time.time()
+            store = temporal.join_aggregate_directory(
+                work, feats, temporal.table_from_records(users), ts, key,
+                cutoff_ms=cutoff, workers=w)
+            return time.time() - t0, store
+
+        def parity(a, b):
+            if a.n_rows != b.n_rows:
+                return False
+            for f in feats:
+                ca, cb = a[f.name], b[f.name]
+                if not (np.array_equal(ca.values, cb.values,
+                                       equal_nan=True)
+                        and np.array_equal(ca.mask, cb.mask)):
+                    return False
+            return True
+
+        serial_s, s_serial = serial_leg()
+        out["serial_rowwise"] = {"s": round(serial_s, 3),
+                                 "rows_per_s": round(rows / serial_s)}
+        col_s, s_col = columnar_leg()
+        out["columnar"] = {"s": round(col_s, 3),
+                           "rows_per_s": round(rows / col_s),
+                           "parity": parity(s_serial, s_col)}
+        best = 0.0
+        for w in (2, 4):
+            sec, s_w = workers_leg(w)
+            leg = {"s": round(sec, 3), "rows_per_s": round(rows / sec),
+                   "parity": parity(s_serial, s_w)}
+            out[f"columnar_{w}w"] = leg
+            best = max(best, leg["rows_per_s"])
+        out["best_columnar_workers_rows_per_s"] = round(best)
+        out["speedup_columnar"] = round(
+            out["columnar"]["rows_per_s"]
+            / out["serial_rowwise"]["rows_per_s"], 2)
+        out["speedup_columnar_workers"] = round(
+            best / out["serial_rowwise"]["rows_per_s"], 2)
+        out["keys"] = s_serial.n_rows
+        out["pass"] = bool(
+            out["speedup_columnar_workers"] >= 5.0
+            and out["columnar"]["parity"]
+            and all(out[f"columnar_{w}w"]["parity"] for w in (2, 4)))
+        out["temporal"] = temporal.temporal_stats()
     finally:
         shutil.rmtree(work, ignore_errors=True)
     return out
@@ -1779,24 +1952,36 @@ def main() -> None:
     bench.emit()
 
     # 2. Iris multiclass (string labels round-trip)
-    from iris import run as run_iris
-    cold, warm, st = bench.run_config(
-        "iris", lambda: run_iris(num_folds=3, seed=42), reps=reps)
-    configs["iris"] = {
-        "F1": round(float(warm["metrics"]["F1"]), 4),
-        **_std_config(warm, cold, st),
-    }
+    # configs 2-4 record a structured error instead of killing the
+    # round (the evidence discipline): a host without the reference
+    # checkout's datasets still produces every synthetic config below
+    try:
+        from iris import run as run_iris
+        cold, warm, st = bench.run_config(
+            "iris", lambda: run_iris(num_folds=3, seed=42), reps=reps)
+        configs["iris"] = {
+            "F1": round(float(warm["metrics"]["F1"]), 4),
+            **_std_config(warm, cold, st),
+        }
+    except Exception as e:
+        _log(f"[bench] iris failed: {e!r}")
+        configs["iris"] = {"error": repr(e)[:400]}
     bench.emit()
 
     # 3. Boston regression
-    from boston import run as run_boston
-    cold, warm, st = bench.run_config(
-        "boston", lambda: run_boston(num_folds=3, seed=42), reps=reps)
-    configs["boston"] = {
-        "RMSE": round(float(warm["metrics"]["RootMeanSquaredError"]), 4),
-        "R2": round(float(warm["metrics"]["R2"]), 4),
-        **_std_config(warm, cold, st),
-    }
+    try:
+        from boston import run as run_boston
+        cold, warm, st = bench.run_config(
+            "boston", lambda: run_boston(num_folds=3, seed=42), reps=reps)
+        configs["boston"] = {
+            "RMSE": round(float(warm["metrics"]["RootMeanSquaredError"]),
+                          4),
+            "R2": round(float(warm["metrics"]["R2"]), 4),
+            **_std_config(warm, cold, st),
+        }
+    except Exception as e:
+        _log(f"[bench] boston failed: {e!r}")
+        configs["boston"] = {"error": repr(e)[:400]}
     bench.emit()
 
     # 4. SmartText-heavy (BigPassenger schema at scale — 300k rows per
@@ -1807,24 +1992,29 @@ def main() -> None:
         _log(f"[bench] budget tight ({bench.remaining():.0f}s left): "
              f"big_text shrinks to 100k rows")
         big_rows = 100_000
-    from big_passenger import run as run_big
-    from big_passenger import TARGET_AUPR
-    cold, warm, st = bench.run_config(
-        "big_text", lambda: run_big(n_rows=big_rows, num_folds=3, seed=42),
-        reps=1)
-    big_aupr = float(warm["metrics"]["AuPR"])
-    configs["big_text"] = {
-        "rows": big_rows,
-        "AuPR": round(big_aupr, 4),
-        "target_AuPR": TARGET_AUPR,
-        "quality": "PASS" if big_aupr >= TARGET_AUPR else "FAIL",
-        "cv_warm_s": round(warm["train_time_s"], 2),
-        "whole_run_warm_s": st["warm_s_median"],
-        "cv_cold_s": round(cold["train_time_s"], 2),
-        "compile_clock_s": st["compile_clock_s"],
-        "phases": warm.get("phases"),
-        **_mfu_fields(st["warm_flops"], warm["train_time_s"]),
-    }
+    try:
+        from big_passenger import run as run_big
+        from big_passenger import TARGET_AUPR
+        cold, warm, st = bench.run_config(
+            "big_text",
+            lambda: run_big(n_rows=big_rows, num_folds=3, seed=42),
+            reps=1)
+        big_aupr = float(warm["metrics"]["AuPR"])
+        configs["big_text"] = {
+            "rows": big_rows,
+            "AuPR": round(big_aupr, 4),
+            "target_AuPR": TARGET_AUPR,
+            "quality": "PASS" if big_aupr >= TARGET_AUPR else "FAIL",
+            "cv_warm_s": round(warm["train_time_s"], 2),
+            "whole_run_warm_s": st["warm_s_median"],
+            "cv_cold_s": round(cold["train_time_s"], 2),
+            "compile_clock_s": st["compile_clock_s"],
+            "phases": warm.get("phases"),
+            **_mfu_fields(st["warm_flops"], warm["train_time_s"]),
+        }
+    except Exception as e:
+        _log(f"[bench] big_text failed: {e!r}")
+        configs["big_text"] = {"error": repr(e)[:400]}
     bench.emit()
 
     # 4b. Scoring throughput (serving path): rows/s of the compiled
@@ -1863,6 +2053,26 @@ def main() -> None:
         except Exception as e:
             _log(f"[bench] input_pipeline failed: {e!r}")
             configs["input_pipeline"] = {"error": repr(e)[:400]}
+    bench.emit()
+
+    # 4b1c. Event-log temporal workload (the reader-tier proof): a
+    #       seeded two-stream transactions+users log joined and
+    #       point-in-time aggregated against a cutoff — serial row-wise
+    #       vs columnar vs columnar+workers, headline join+aggregate
+    #       rows/s with a ≥5×-serial + bit-parity pass flag. Pure host
+    #       work (numpy + worker threads): cheap, budget-gated anyway.
+    if bench.remaining() < 90:
+        configs["event_log"] = {
+            "status": "skipped_budget",
+            "remaining_budget_s": round(bench.remaining(), 1)}
+        _log(f"[bench] event_log skipped: remaining "
+             f"{bench.remaining():.0f}s < 90s")
+    else:
+        try:
+            configs["event_log"] = _event_log()
+        except Exception as e:
+            _log(f"[bench] event_log failed: {e!r}")
+            configs["event_log"] = {"error": repr(e)[:400]}
     bench.emit()
 
     # 4b2. Serving latency (the AOT bank + model server proof):

@@ -91,6 +91,94 @@ def test_joined_aggregate_reader_windows():
     assert vals["east"] == pytest.approx(5.0)
 
 
+def test_aggregate_cutoff_boundary_ts_equal_cutoff():
+    """The pinned cutoff semantics (docs/readers.md): predictors fold
+    ts < cutoff, responses fold ts > cutoff — STRICTLY after, so the
+    event exactly AT the cutoff lands in NEITHER fold (the docstring
+    said 'strictly after' while the code kept ts == cutoff in the
+    response; the code now matches the contract)."""
+    from transmogrifai_tpu.utils.aggregators import (LogicalOrAggregator,
+                                                     SumAggregator)
+    records = [
+        {"id": "u", "ts": 99, "x": 1.0, "buy": 0},
+        {"id": "u", "ts": 100, "x": 10.0, "buy": 1},    # AT the cutoff
+        {"id": "u", "ts": 101, "x": 100.0, "buy": 0},
+    ]
+    before = (FeatureBuilder.Real("x").from_column()
+              .aggregate(SumAggregator()).as_predictor())
+    after = (FeatureBuilder.Real("after")
+             .extract(lambda r: r["x"], "x")
+             .aggregate(SumAggregator()).as_response())
+    bought = (FeatureBuilder.Binary("bought")
+              .extract(lambda r: bool(r["buy"]), "buy")
+              .aggregate(LogicalOrAggregator()).as_response())
+    reader = DataReaders.aggregate.records(
+        records, timestamp_fn=lambda r: r["ts"],
+        cutoff=CutOffTime.at(100), key_fn=lambda r: r["id"])
+    store = reader.generate_store([before, after, bought])
+    assert store["x"].get_raw(0) == 1.0         # ts=100 NOT a predictor
+    assert store["after"].get_raw(0) == 100.0   # ts=100 NOT a response
+    assert store["bought"].get_raw(0) is False  # the cutoff event itself
+    # windowed predictor shares the same exclusive upper bound
+    recent = (FeatureBuilder.Real("recent")
+              .extract(lambda r: r["x"], "x")
+              .aggregate(SumAggregator()).window(1).as_predictor())
+    store2 = reader.generate_store([recent])
+    assert store2["recent"].get_raw(0) == 1.0   # [99, 100) keeps ts=99
+
+
+def test_conditional_reader_edge_cases():
+    """ConditionalReader corners: a key with no condition-matching
+    record under drop_if_no_condition True/False, a key whose group is
+    empty after cutoff filtering on one side, and per-key cutoffs that
+    genuinely differ across keys."""
+    from transmogrifai_tpu.utils.aggregators import SumAggregator
+    records = [
+        # key a: buys at 200 → cutoff 200; pre-events at 100, post at 300
+        {"id": "a", "ts": 100, "x": 1.0, "buy": 0},
+        {"id": "a", "ts": 200, "x": 2.0, "buy": 1},
+        {"id": "a", "ts": 300, "x": 4.0, "buy": 0},
+        # key b: never buys
+        {"id": "b", "ts": 150, "x": 8.0, "buy": 0},
+        # key c: buys IMMEDIATELY (first event) → empty predictor fold
+        {"id": "c", "ts": 50, "x": 16.0, "buy": 1},
+        {"id": "c", "ts": 60, "x": 32.0, "buy": 0},
+    ]
+    before = (FeatureBuilder.Real("x").from_column()
+              .aggregate(SumAggregator()).as_predictor())
+    after = (FeatureBuilder.Real("after")
+             .extract(lambda r: r["x"], "x")
+             .aggregate(SumAggregator()).as_response())
+
+    def build(drop):
+        return DataReaders.conditional.records(
+            records, timestamp_fn=lambda r: r["ts"],
+            condition_fn=lambda r: r["buy"] == 1,
+            key_fn=lambda r: r["id"], drop_if_no_condition=drop)
+
+    # drop=True: key b (no condition event) is dropped entirely
+    store = build(True).generate_store([before, after])
+    assert store.n_rows == 2
+    rows = {tuple(store[n].get_raw(i) for n in ("x", "after"))
+            for i in range(2)}
+    # a: predictors before 200 = 1.0; responses strictly after = 4.0
+    # c: empty predictor fold (cutoff at its first event) → None;
+    #    response = 32.0
+    assert rows == {(1.0, 4.0), (None, 32.0)}
+
+    # drop=False: key b stays; with no cutoff EVERYTHING folds into
+    # both sides (the row-wise no-cutoff contract)
+    store = build(False).generate_store([before, after])
+    assert store.n_rows == 3
+    by_key = {}
+    # keys sort a, b, c
+    for i, k in enumerate(("a", "b", "c")):
+        by_key[k] = (store["x"].get_raw(i), store["after"].get_raw(i))
+    assert by_key["a"] == (1.0, 4.0)
+    assert by_key["b"] == (8.0, 8.0)      # no cutoff: folds both sides
+    assert by_key["c"] == (None, 32.0)    # per-key cutoff differs from a
+
+
 def test_time_based_filter():
     tf = TimeBasedFilter(timestamp_fn=lambda r: r["ts"], cutoff_ms=1000,
                          duration_ms=500)

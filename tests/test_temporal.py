@@ -1,0 +1,806 @@
+"""Temporal workload tier tests (temporal.py + reader integration):
+columnar aggregation bit-parity against the row-wise readers across
+monoid families / cutoff shapes / join types, the parallel partial-
+aggregation paths, the bounded streaming hash join (spill-to-quarantine,
+fault-site retry, breaker fallback), the runner/CLI knob wiring, and the
+TMG7xx cutoff-leakage rules (static, gated before reader I/O)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, Workflow, lint, temporal
+from transmogrifai_tpu import resilience
+from transmogrifai_tpu.readers import (AggregateReader, ConditionalReader,
+                                       CutOffTime, DataReaders,
+                                       JoinedAggregateDataReader,
+                                       JoinedDataReader, TemporalJoinReader)
+from transmogrifai_tpu.readers.avro import write_avro_records
+from transmogrifai_tpu.runner import (OpParams, OpWorkflowRunner, RunType)
+from transmogrifai_tpu.types import feature_types as ft
+from transmogrifai_tpu.utils.aggregators import (ConcatTextAggregator,
+                                                 FirstAggregator,
+                                                 LastAggregator,
+                                                 LogicalOrAggregator,
+                                                 MaxAggregator,
+                                                 MeanAggregator,
+                                                 MinAggregator,
+                                                 ModeAggregator,
+                                                 SumAggregator)
+
+
+class _TableSource:
+    """Reader handing a prebuilt columnar batch to the temporal tier."""
+
+    def __init__(self, table, key_fn):
+        self._table = table
+        self.key_fn = key_fn
+
+    def read_records(self):
+        return self._table
+
+
+def _events(rng, n=4000, n_keys=37, text=False):
+    recs = []
+    for _ in range(n):
+        r = {"user": float(rng.integers(0, n_keys)),
+             "ts": float(rng.uniform(0, 1000.0)),
+             "amount": float(rng.gamma(2.0, 10.0)),
+             "flag": bool(rng.random() < 0.2)}
+        if text:
+            r["word"] = f"w{int(rng.integers(0, 5))}"
+        recs.append(r)
+    return recs
+
+
+KEY = temporal.field("user")
+TS = temporal.field("ts")
+
+
+def _amount(name, agg, window=None, response=False):
+    b = FeatureBuilder.Real(name).extract(temporal.field("amount"),
+                                          "amount").aggregate(agg)
+    if window is not None:
+        b = b.window(window)
+    return b.as_response() if response else b.as_predictor()
+
+
+def _assert_store_equal(a, b, names):
+    assert a.n_rows == b.n_rows
+    for name in names:
+        ca, cb = a[name], b[name]
+        assert type(ca) is type(cb), name
+        va = getattr(ca, "values", None)
+        if va is not None:
+            assert np.array_equal(ca.values, cb.values, equal_nan=True), name
+        if hasattr(ca, "mask") and not callable(getattr(ca, "mask")):
+            assert np.array_equal(ca.mask, cb.mask), name
+
+
+# ---------------------------------------------------------------------------
+# columnar aggregation parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("cutoff", [CutOffTime.at(700),
+                                    CutOffTime.no_cutoff()])
+def test_columnar_aggregate_bit_identical_across_monoids(rng, cutoff):
+    recs = _events(rng)
+    tab = temporal.table_from_records(recs)
+    feats = [
+        _amount("s", SumAggregator()),
+        _amount("m", MeanAggregator()),
+        _amount("mx", MaxAggregator()),
+        _amount("mn", MinAggregator()),
+        _amount("first", FirstAggregator()),
+        _amount("last", LastAggregator()),
+        _amount("w", MeanAggregator(), window=150),
+        FeatureBuilder.Binary("or").extract(temporal.field("flag"), "flag")
+        .aggregate(LogicalOrAggregator()).as_response(),
+    ]
+    row = AggregateReader(DataReaders.simple.records(recs), TS, cutoff,
+                          key_fn=KEY).generate_store(feats)
+    before = temporal.temporal_stats()
+    col = AggregateReader(_TableSource(tab, KEY), TS, cutoff,
+                          key_fn=KEY).generate_store(feats)
+    after = temporal.temporal_stats()
+    assert after["columnar_aggregates"] == before["columnar_aggregates"] + 1
+    _assert_store_equal(row, col, [f.name for f in feats])
+
+
+def test_columnar_aggregate_text_and_mode_monoids(rng):
+    recs = _events(rng, n=600, n_keys=9, text=True)
+    tab = temporal.table_from_records(recs)
+    feats = [
+        FeatureBuilder.Text("cat").extract(temporal.field("word"), "word")
+        .aggregate(ConcatTextAggregator()).as_predictor(),
+        FeatureBuilder.PickList("pick").extract(temporal.field("word"),
+                                                "word")
+        .aggregate(ModeAggregator()).as_predictor(),
+        # no explicit aggregator: the type default (concat) must resolve
+        # identically on both paths
+        FeatureBuilder.Text("word").from_column().as_predictor(),
+    ]
+    cutoff = CutOffTime.at(500)
+    row = AggregateReader(DataReaders.simple.records(recs), TS, cutoff,
+                          key_fn=KEY).generate_store(feats)
+    col = AggregateReader(_TableSource(tab, KEY), TS, cutoff,
+                          key_fn=KEY).generate_store(feats)
+    assert row.n_rows == col.n_rows
+    for f in feats:
+        assert row[f.name].to_list() == col[f.name].to_list()
+
+
+def test_columnar_boundary_ts_equal_cutoff():
+    """The pinned boundary, columnar == row-wise: an event exactly AT
+    the cutoff lands in NEITHER fold; ts just below folds into the
+    predictor, just above into the response."""
+    recs = [
+        {"user": 1.0, "ts": 99.0, "amount": 2.0, "flag": False},
+        {"user": 1.0, "ts": 100.0, "amount": 5.0, "flag": True},   # AT
+        {"user": 1.0, "ts": 101.0, "amount": 11.0, "flag": False},
+    ]
+    feats = [_amount("spend", SumAggregator()),
+             FeatureBuilder.Binary("out").extract(temporal.field("flag"),
+                                                  "flag")
+             .aggregate(LogicalOrAggregator()).as_response(),
+             _amount("after", SumAggregator(), response=True)]
+    cutoff = CutOffTime.at(100)
+    row = AggregateReader(DataReaders.simple.records(recs), TS, cutoff,
+                          key_fn=KEY).generate_store(feats)
+    col = AggregateReader(
+        _TableSource(temporal.table_from_records(recs), KEY), TS, cutoff,
+        key_fn=KEY).generate_store(feats)
+    for store in (row, col):
+        assert store["spend"].get_raw(0) == 2.0       # ts=100 excluded
+        assert store["out"].get_raw(0) is False       # flag@cutoff excluded
+        assert store["after"].get_raw(0) == 11.0      # strictly after
+    _assert_store_equal(row, col, [f.name for f in feats])
+
+
+def test_conditional_columnar_parity_and_edge_cases(rng):
+    recs = _events(rng, n=2500, n_keys=25)
+    tab = temporal.table_from_records(recs)
+    feats = [_amount("s", SumAggregator()),
+             _amount("resp", SumAggregator(), response=True)]
+    cond = temporal.field("flag")
+    for drop in (True, False):
+        row = ConditionalReader(DataReaders.simple.records(recs), TS,
+                                lambda r: bool(r["flag"]),
+                                drop_if_no_condition=drop,
+                                key_fn=KEY).generate_store(feats)
+        col = ConditionalReader(_TableSource(tab, KEY), TS,
+                                lambda r: bool(r["flag"]),
+                                drop_if_no_condition=drop,
+                                key_fn=KEY).generate_store(feats)
+        _assert_store_equal(row, col, [f.name for f in feats])
+    assert cond is not None
+
+
+def test_unroutable_extractor_falls_back_rowwise(rng):
+    """A custom (non-column-keyed) extract_fn cannot vectorize: the
+    columnar route declines and the row-wise fold serves, identical —
+    and the breaker is NOT poisoned."""
+    recs = _events(rng, n=400, n_keys=7)
+    tab = temporal.table_from_records(recs)
+    opaque = (FeatureBuilder.Real("double_amt")
+              .extract(lambda r: (r.get("amount") or 0.0) * 2, "amount")
+              .aggregate(SumAggregator()).as_predictor())
+    cutoff = CutOffTime.at(600)
+    row = AggregateReader(DataReaders.simple.records(recs), TS, cutoff,
+                          key_fn=KEY).generate_store([opaque])
+    before = temporal.temporal_stats()
+    col = AggregateReader(_TableSource(tab, KEY), TS, cutoff,
+                          key_fn=KEY).generate_store([opaque])
+    after = temporal.temporal_stats()
+    assert after["rowwise_aggregates"] == before["rowwise_aggregates"] + 1
+    assert after["columnar_aggregates"] == before["columnar_aggregates"]
+    assert resilience.breaker("temporal.columnar").state == "closed"
+    _assert_store_equal(row, col, ["double_amt"])
+
+
+def test_columnar_mode_knob_forces_off(rng):
+    recs = _events(rng, n=300, n_keys=5)
+    tab = temporal.table_from_records(recs)
+    feats = [_amount("s", SumAggregator())]
+    prev = temporal.set_run_defaults(columnar=False)
+    try:
+        before = temporal.temporal_stats()
+        AggregateReader(_TableSource(tab, KEY), TS, CutOffTime.at(500),
+                        key_fn=KEY).generate_store(feats)
+        after = temporal.temporal_stats()
+        assert after["columnar_aggregates"] == before["columnar_aggregates"]
+        assert after["rowwise_aggregates"] == \
+            before["rowwise_aggregates"] + 1
+    finally:
+        temporal.set_run_defaults(**prev)
+
+
+def test_columnar_fault_trips_breaker_and_falls_back(rng):
+    """A fault injected at temporal.aggregate degrades to the row-wise
+    fold bit-identically, counts a fallback, and repeated failures trip
+    the temporal.columnar breaker (later reads skip the failing tier
+    without attempting)."""
+    recs = _events(rng, n=500, n_keys=8)
+    tab = temporal.table_from_records(recs)
+    feats = [_amount("s", SumAggregator())]
+    cutoff = CutOffTime.at(500)
+    want = AggregateReader(DataReaders.simple.records(recs), TS, cutoff,
+                           key_fn=KEY).generate_store(feats)
+    resilience.reset_breakers()
+    plan = resilience.FaultPlan(seed=3).on("temporal.aggregate",
+                                           error=RuntimeError)
+    before = temporal.temporal_stats()
+    with resilience.fault_plan(plan):
+        for _ in range(4):
+            got = AggregateReader(_TableSource(tab, KEY), TS, cutoff,
+                                  key_fn=KEY).generate_store(feats)
+            _assert_store_equal(want, got, ["s"])
+    after = temporal.temporal_stats()
+    assert after["columnar_fallbacks"] >= before["columnar_fallbacks"] + 3
+    br = resilience.breaker("temporal.columnar")
+    assert br.state == "open"
+    # breaker OPEN: the failing columnar pass is not even attempted
+    fired_before = plan.fired("temporal.aggregate")
+    with resilience.fault_plan(plan):
+        got = AggregateReader(_TableSource(tab, KEY), TS, cutoff,
+                              key_fn=KEY).generate_store(feats)
+    _assert_store_equal(want, got, ["s"])
+    assert plan.fired("temporal.aggregate") == fired_before
+    resilience.reset_breakers()
+
+
+# ---------------------------------------------------------------------------
+# parallel partial aggregation
+# ---------------------------------------------------------------------------
+
+
+def test_aggregate_directory_parallel_bit_identical(rng, tmp_path):
+    all_recs = []
+    for i in range(5):
+        recs = _events(rng, n=800, n_keys=30)
+        all_recs.extend(recs)
+        write_avro_records(str(tmp_path / f"b{i:03d}.avro"), recs)
+    feats = [_amount("s", SumAggregator()),
+             _amount("w", MeanAggregator(), window=250),
+             FeatureBuilder.Binary("r").extract(temporal.field("flag"),
+                                                "flag")
+             .aggregate(LogicalOrAggregator()).as_response()]
+    serial = AggregateReader(DataReaders.simple.records(all_recs), TS,
+                             CutOffTime.at(650),
+                             key_fn=KEY).generate_store(feats)
+    for workers in (1, 3):
+        par = temporal.aggregate_directory(str(tmp_path), feats, TS, KEY,
+                                           cutoff_ms=650, workers=workers)
+        _assert_store_equal(serial, par, [f.name for f in feats])
+
+
+def test_aggregate_tables_matches_single_table(rng):
+    tables = [temporal.table_from_records(_events(rng, n=700, n_keys=20))
+              for _ in range(3)]
+    feats = [_amount("s", SumAggregator())]
+    whole = AggregateReader(
+        _TableSource(temporal.concat_tables(tables), KEY), TS,
+        CutOffTime.at(500), key_fn=KEY).generate_store(feats)
+    split = temporal.aggregate_tables(tables, feats, TS, KEY,
+                                      cutoff_ms=500, workers=2)
+    _assert_store_equal(whole, split, ["s"])
+
+
+# ---------------------------------------------------------------------------
+# streaming hash join
+# ---------------------------------------------------------------------------
+
+
+def _join_fixture(rng, n=3000, n_keys=40, missing=6):
+    left = _events(rng, n=n, n_keys=n_keys)
+    right = [{"user": float(u), "seg": float(u % 7)}
+             for u in range(n_keys - missing)]
+    return left, right
+
+
+@pytest.mark.parametrize("join_type", ["left_outer", "inner"])
+def test_streaming_join_matches_joined_reader(rng, join_type):
+    left, right = _join_fixture(rng)
+    lr = DataReaders.simple.records(left, key_fn=KEY)
+    rr = DataReaders.simple.records(right, key_fn=KEY)
+    old = JoinedDataReader(lr, rr, join_type).read_records()
+    new = TemporalJoinReader(lr, rr, join_type).read_records()
+    assert len(old) == len(new)
+    for a, b in zip(old, new):
+        for k in set(a) | set(b):
+            assert a.get(k) == b.get(k), (join_type, k)
+
+
+@pytest.mark.parametrize("join_type", ["left_outer", "inner"])
+def test_columnar_join_aggregate_composition_parity(rng, join_type):
+    left, right = _join_fixture(rng)
+    feats = [_amount("s", SumAggregator()),
+             FeatureBuilder.Real("seg_f").extract(temporal.field("seg"),
+                                                  "seg")
+             .aggregate(MaxAggregator()).as_predictor(),
+             FeatureBuilder.Binary("r").extract(temporal.field("flag"),
+                                                "flag")
+             .aggregate(LogicalOrAggregator()).as_response()]
+    row = JoinedAggregateDataReader(
+        DataReaders.simple.records(left, key_fn=KEY),
+        DataReaders.simple.records(right, key_fn=KEY),
+        TS, CutOffTime.at(700), join_type).generate_store(feats)
+    col = JoinedAggregateDataReader(
+        _TableSource(temporal.table_from_records(left), KEY),
+        _TableSource(temporal.table_from_records(right), KEY),
+        TS, CutOffTime.at(700), join_type).generate_store(feats)
+    _assert_store_equal(row, col, [f.name for f in feats])
+
+
+def test_join_aggregate_directory_workers_parity(rng, tmp_path):
+    all_recs = []
+    for i in range(4):
+        recs = _events(rng, n=900, n_keys=35)
+        all_recs.extend(recs)
+        write_avro_records(str(tmp_path / f"e{i:02d}.avro"), recs)
+    right = [{"user": float(u), "seg": float(u % 5)} for u in range(30)]
+    feats = [_amount("s", SumAggregator()),
+             FeatureBuilder.Real("seg_f").extract(temporal.field("seg"),
+                                                  "seg")
+             .aggregate(MaxAggregator()).as_predictor()]
+    want = JoinedAggregateDataReader(
+        DataReaders.simple.records(all_recs, key_fn=KEY),
+        DataReaders.simple.records(right, key_fn=KEY),
+        TS, CutOffTime.at(600)).generate_store(feats)
+    for w in (1, 3):
+        got = temporal.join_aggregate_directory(
+            str(tmp_path), feats, temporal.table_from_records(right),
+            TS, KEY, cutoff_ms=600, workers=w)
+        _assert_store_equal(want, got, [f.name for f in feats])
+
+
+def test_join_aggregate_directory_dict_right_lifts_and_bound_rejects(
+        rng, tmp_path):
+    """A plain list-of-dicts dimension table auto-lifts to a columnar
+    build side; an un-vectorizable build (over the partition bound)
+    is rejected LOUDLY up front instead of crashing inside a worker."""
+    recs = _events(rng, n=400, n_keys=12)
+    write_avro_records(str(tmp_path / "a.avro"), recs)
+    right = [{"user": float(u), "seg": float(u)} for u in range(12)]
+    feats = [_amount("s", SumAggregator()),
+             FeatureBuilder.Real("seg_f").extract(temporal.field("seg"),
+                                                  "seg")
+             .aggregate(MaxAggregator()).as_predictor()]
+    via_table = temporal.join_aggregate_directory(
+        str(tmp_path), feats, temporal.table_from_records(right), TS, KEY,
+        cutoff_ms=600)
+    via_dicts = temporal.join_aggregate_directory(
+        str(tmp_path), feats, right, TS, KEY, cutoff_ms=600)
+    _assert_store_equal(via_table, via_dicts, [f.name for f in feats])
+    prev = temporal.set_run_defaults(join_partitions=1,
+                                     join_table_max_rows=3)
+    try:
+        with pytest.raises(temporal.TemporalError, match="bounded"):
+            temporal.join_aggregate_directory(
+                str(tmp_path), feats, right, TS, KEY, cutoff_ms=600)
+    finally:
+        temporal.set_run_defaults(**prev)
+
+
+def test_unroutable_pass_does_not_reset_breaker_failures(rng):
+    """An unroutable (TemporalError) aggregation records NEITHER
+    success nor failure: interleaving one with a failing columnar
+    reader must not keep resetting the consecutive-failure count."""
+    recs = _events(rng, n=200, n_keys=5)
+    tab = temporal.table_from_records(recs)
+    opaque = (FeatureBuilder.Real("d")
+              .extract(lambda r: r.get("amount"), "amount")
+              .aggregate(SumAggregator()).as_predictor())
+    good = [_amount("s", SumAggregator())]
+    cutoff = CutOffTime.at(500)
+    resilience.reset_breakers()
+    # fault only the GOOD reads (calls 0/2/4) — the interleaved opaque
+    # reads (calls 1/3) must reach the engine and raise TemporalError
+    plan = resilience.FaultPlan(seed=8).on("temporal.aggregate",
+                                           error=RuntimeError,
+                                           at=[0, 2, 4])
+    br = resilience.breaker("temporal.columnar")
+    with resilience.fault_plan(plan):
+        for i in range(3):
+            AggregateReader(_TableSource(tab, KEY), TS, cutoff,
+                            key_fn=KEY).generate_store(good)   # fails
+            if i < 2:
+                # unroutable pass between failures must not reset them
+                AggregateReader(_TableSource(tab, KEY), TS, cutoff,
+                                key_fn=KEY).generate_store([opaque])
+                assert br.consecutive_failures == i + 1
+    assert br.state == "open"
+    resilience.reset_breakers()
+
+
+def test_join_table_overflow_spills_to_quarantine(tmp_path, rng):
+    """A build-side partition past joinTableMaxRows spills NEW keys'
+    rows to the dead-letter sink (counted + replayable) instead of
+    growing the heap; probe rows for spilled keys come back unmatched."""
+    sink_path = str(tmp_path / "dead.jsonl")
+    prev_sink = resilience.set_quarantine(sink_path)
+    try:
+        right = [{"user": float(u), "seg": float(u)} for u in range(10)]
+        left = [{"user": float(u), "ts": 1.0, "amount": 1.0}
+                for u in range(10)]
+        before = temporal.temporal_stats()
+        out = TemporalJoinReader(
+            DataReaders.simple.records(left, key_fn=KEY),
+            DataReaders.simple.records(right, key_fn=KEY),
+            "left_outer", partitions=1,
+            table_max_rows=4).read_records()
+        after = temporal.temporal_stats()
+        assert len(out) == 10                 # probe side never dropped
+        spilled = after["join_spilled_rows"] - before["join_spilled_rows"]
+        assert spilled == 6
+        matched = [r for r in out if r.get("seg") is not None]
+        assert len(matched) == 4
+        entries = resilience.get_quarantine().entries()
+        assert sum(1 for e in entries
+                   if e["site"] == "temporal.join") == spilled
+        assert all(e["records"] for e in entries
+                   if e["site"] == "temporal.join")   # replayable
+    finally:
+        resilience.set_quarantine(prev_sink)
+
+
+def test_join_mixed_int_float_keys_match_like_dict_join(rng):
+    """Python-dict key equality is the join contract: int 1, float 1.0
+    and True are ONE key, so the partitioned build tables must land
+    them in one partition — a repr-based hash split an int-keyed build
+    side from a float-keyed probe side and silently unmatched every
+    row (regression test for the canonical-key fix)."""
+    left = [{"user": float(u % 6), "ts": 1.0, "amount": 1.0}
+            for u in range(24)]
+    right = [{"user": int(u), "seg": float(u * 10)} for u in range(6)]
+    lr = DataReaders.simple.records(left, key_fn=KEY)
+    rr = DataReaders.simple.records(right, key_fn=KEY)
+    want = JoinedDataReader(lr, rr).read_records()
+    for partitions in (1, 4, 7):
+        got = TemporalJoinReader(lr, rr,
+                                 partitions=partitions).read_records()
+        assert all(a.get("seg") == b.get("seg") and a.get("seg") is not None
+                   for a, b in zip(want, got))
+    assert temporal.partition_of(1, 7) == temporal.partition_of(1.0, 7) \
+        == temporal.partition_of(True, 7) \
+        == temporal.partition_of(np.float64(1.0), 7)
+
+
+def test_nan_timestamp_folds_both_sides_row_and_columnar():
+    """A NaN event time passes none of the row-wise cutoff guards, so
+    the row folds into BOTH sides (and bypasses windows); the columnar
+    masks must match bit-for-bit (regression test for the NaN-ts
+    parity fix)."""
+    recs = [{"user": 1.0, "ts": float("nan"), "amount": 5.0,
+             "flag": True},
+            {"user": 1.0, "ts": 50.0, "amount": 1.0, "flag": False},
+            {"user": 1.0, "ts": 150.0, "amount": 2.0, "flag": False}]
+    feats = [_amount("pred", SumAggregator()),
+             _amount("win", SumAggregator(), window=200),
+             _amount("resp", SumAggregator(), response=True)]
+    cutoff = CutOffTime.at(100)
+    row = AggregateReader(DataReaders.simple.records(recs), TS, cutoff,
+                          key_fn=KEY).generate_store(feats)
+    col = AggregateReader(
+        _TableSource(temporal.table_from_records(recs), KEY), TS, cutoff,
+        key_fn=KEY).generate_store(feats)
+    assert row["pred"].get_raw(0) == 6.0      # nan-ts row + ts=50
+    assert row["win"].get_raw(0) == 6.0       # window bypassed for nan
+    assert row["resp"].get_raw(0) == 7.0      # nan-ts row + ts=150
+    _assert_store_equal(row, col, [f.name for f in feats])
+    # the parallel partial path matches too
+    par = temporal.aggregate_tables(
+        [temporal.table_from_records(recs)], feats, TS, KEY,
+        cutoff_ms=100.0, workers=1)
+    _assert_store_equal(row, par, [f.name for f in feats])
+
+
+def test_join_aggregate_directory_retries_transient_fault(rng, tmp_path):
+    recs = _events(rng, n=300, n_keys=10)
+    write_avro_records(str(tmp_path / "a.avro"), recs)
+    right = [{"user": float(u), "seg": float(u)} for u in range(10)]
+    feats = [_amount("s", SumAggregator())]
+    want = temporal.join_aggregate_directory(
+        str(tmp_path), feats, temporal.table_from_records(right), TS, KEY,
+        cutoff_ms=600)
+    plan = resilience.FaultPlan(seed=5).on("temporal.join", error=OSError,
+                                           times=1)
+    before = resilience.resilience_stats()
+    with resilience.fault_plan(plan):
+        got = temporal.join_aggregate_directory(
+            str(tmp_path), feats, temporal.table_from_records(right), TS,
+            KEY, cutoff_ms=600)
+    after = resilience.resilience_stats()
+    assert plan.fired("temporal.join") == 1
+    assert after["retries"] == before["retries"] + 1
+    _assert_store_equal(want, got, ["s"])
+
+
+def test_join_fault_site_rides_reader_retry(rng):
+    """A transient OSError injected at temporal.join retries (the build
+    is pure compute, safe to re-run) and the read succeeds."""
+    left, right = _join_fixture(rng, n=200, n_keys=10)
+    plan = resilience.FaultPlan(seed=1).on("temporal.join", error=OSError,
+                                           times=1)
+    before = resilience.resilience_stats()
+    with resilience.fault_plan(plan):
+        out = TemporalJoinReader(
+            DataReaders.simple.records(left, key_fn=KEY),
+            DataReaders.simple.records(right, key_fn=KEY)).read_records()
+    after = resilience.resilience_stats()
+    assert len(out) == len(left)
+    assert plan.fired("temporal.join") == 1
+    assert after["retries"] == before["retries"] + 1
+
+
+# ---------------------------------------------------------------------------
+# workflow / runner / CLI integration
+# ---------------------------------------------------------------------------
+
+
+def _temporal_workflow(rng, cutoff=CutOffTime.at(700)):
+    recs = _events(rng, n=1200, n_keys=120)
+    reader = AggregateReader(DataReaders.simple.records(recs), TS, cutoff,
+                             key_fn=KEY)
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import \
+        BinaryClassificationModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    label = (FeatureBuilder.RealNN("label")
+             .extract(temporal.field("flag"), "flag")
+             .aggregate(LogicalOrAggregator()).as_response())
+    spend = _amount("spend", SumAggregator())
+    recent = _amount("recent", MeanAggregator(), window=300)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None,
+        seed=11)
+    pred = label.transform_with(selector, transmogrify([spend, recent]))
+    wf = Workflow().set_result_features(pred).set_reader(reader)
+    return wf, reader, pred
+
+
+def test_workflow_train_uses_aggregating_reader(rng):
+    """Workflow.train hands raw-store generation to an aggregating
+    reader: one row per KEY (not per event), trainable end to end."""
+    wf, reader, pred = _temporal_workflow(rng)
+    model = wf.train()
+    assert model.train_rows == 120
+    store = reader.generate_store(
+        [f for f in pred.raw_features()])
+    assert store.n_rows == 120
+
+
+def test_runner_stamps_temporal_and_validates_knobs(rng, tmp_path):
+    wf, reader, _pred = _temporal_workflow(rng)
+    runner = OpWorkflowRunner(wf, training_reader=reader)
+    params = OpParams(custom_params={"plan": False},
+                      metrics_location=str(tmp_path / "m.json"))
+    res = runner.run(RunType.TRAIN, params)
+    assert "temporal" in res.metrics
+    assert res.metrics["temporal"]["rowwise_aggregates"] >= 1
+    doc = json.load(open(tmp_path / "m.json"))
+    assert "temporal" in doc
+    # malformed knobs name their key up front
+    for key, val in (("joinPartitions", 0), ("joinTableMaxRows", 2.5),
+                     ("aggregateColumnar", "yes")):
+        bad = OpParams(custom_params={key: val})
+        with pytest.raises(ValueError, match=key):
+            runner.run(RunType.TRAIN, bad)
+
+
+def test_runner_knob_installs_run_scoped_defaults(rng):
+    wf, reader, _pred = _temporal_workflow(rng)
+    runner = OpWorkflowRunner(wf, training_reader=reader)
+    params = OpParams(custom_params={"plan": False,
+                                     "joinPartitions": 3,
+                                     "joinTableMaxRows": 123,
+                                     "aggregateColumnar": False})
+    seen = {}
+    orig = wf.train
+
+    def spy_train():
+        seen["partitions"] = temporal.join_partitions()
+        seen["cap"] = temporal.join_table_max_rows()
+        seen["mode"] = temporal.columnar_mode()
+        return orig()
+
+    wf.train = spy_train
+    try:
+        runner.run(RunType.TRAIN, params)
+    finally:
+        wf.train = orig
+    assert seen == {"partitions": 3, "cap": 123, "mode": False}
+    # restored after the run
+    assert temporal.join_partitions() == temporal.DEFAULT_JOIN_PARTITIONS
+    assert temporal.columnar_mode() == "auto"
+
+
+def test_cli_gen_emits_and_check_validates_temporal_knobs(tmp_path,
+                                                          capsys):
+    from transmogrifai_tpu.cli import generate_project, run_check
+    csv = tmp_path / "d.csv"
+    csv.write_text("id,x,label\n1,0.5,0\n2,1.5,1\n3,2.5,0\n4,3.5,1\n")
+    files = generate_project(str(csv), "label", str(tmp_path / "proj"),
+                             id_column="id")
+    params = json.load(open(files["params.json"]))
+    cp = params["customParams"]
+    assert cp["aggregateColumnar"] is None
+    assert cp["joinPartitions"] is None
+    assert cp["joinTableMaxRows"] is None
+    # clean params pass check
+    assert run_check(files["params.json"]) == 0
+    # malformed temporal knobs are TMG001 findings
+    cp["joinPartitions"] = 0
+    cp["aggregateColumnar"] = "maybe"
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(params))
+    assert run_check(str(bad)) == 1
+    out = capsys.readouterr().out
+    assert "joinPartitions" in out and "aggregateColumnar" in out
+    assert "TMG001" in out
+
+
+# ---------------------------------------------------------------------------
+# TMG7xx cutoff leakage rules
+# ---------------------------------------------------------------------------
+
+
+class _NoIOReader(AggregateReader):
+    """Aggregating reader whose any I/O fails the test."""
+
+    def read_records(self):
+        raise AssertionError("reader I/O happened during static checks")
+
+
+def _leaky_workflow(rng, cutoff=CutOffTime.no_cutoff()):
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import \
+        BinaryClassificationModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    reader = _NoIOReader(DataReaders.simple.records([]), TS, cutoff,
+                         key_fn=KEY)
+    label = (FeatureBuilder.RealNN("label")
+             .extract(temporal.field("flag"), "flag")
+             .aggregate(LogicalOrAggregator()).as_response())
+    spend = _amount("spend", SumAggregator())
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None)
+    pred = label.transform_with(selector, transmogrify([spend]))
+    wf = Workflow().set_result_features(pred)
+    return wf, reader
+
+
+def test_tmg701_no_cutoff_with_response_fires_and_repairs(rng):
+    wf, reader = _leaky_workflow(rng)
+    findings = lint.check_workflow(wf, reader=reader)
+    f = next(x for x in findings if x.rule == "TMG701")
+    assert f.severity == "error"
+    assert "spend" in f.message and "label" in f.message
+    # repaired: a cutoff (or a conditional reader) clears it
+    wf2, reader2 = _leaky_workflow(rng, cutoff=CutOffTime.at(500))
+    assert not [x for x in lint.check_workflow(wf2, reader=reader2)
+                if x.rule == "TMG701"]
+    cond = ConditionalReader(DataReaders.simple.records([]), TS,
+                             lambda r: bool(r["flag"]), key_fn=KEY)
+    assert not [x for x in lint.check_workflow(wf2, reader=cond)
+                if x.rule == "TMG701"]
+
+
+def test_tmg701_runner_blocks_before_reader_io(rng):
+    wf, reader = _leaky_workflow(rng)
+    runner = OpWorkflowRunner(wf, training_reader=reader)
+    with pytest.raises(lint.LintError, match="TMG701"):
+        runner.run(RunType.TRAIN, OpParams(custom_params={"plan": False}))
+    # suppression flows through the normal machinery — and the reader
+    # still does no I/O during the static phase (train then hits the
+    # asserting reader, proving the gate ran first)
+    params = OpParams(custom_params={"plan": False,
+                                     "lintSuppress": ["TMG701"]})
+    with pytest.raises(AssertionError, match="reader I/O"):
+        runner.run(RunType.TRAIN, params)
+
+
+def test_tmg702_response_window_is_error(rng):
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import \
+        BinaryClassificationModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    reader = _NoIOReader(DataReaders.simple.records([]), TS,
+                         CutOffTime.at(500), key_fn=KEY)
+    label = (FeatureBuilder.RealNN("label")
+             .extract(temporal.field("flag"), "flag")
+             .aggregate(LogicalOrAggregator()).window(100).as_response())
+    spend = _amount("spend", SumAggregator())
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()], splitter=None)
+    pred = label.transform_with(selector, transmogrify([spend]))
+    wf = Workflow().set_result_features(pred)
+    findings = lint.check_workflow(wf, reader=reader)
+    f = next(x for x in findings if x.rule == "TMG702")
+    assert f.severity == "error" and f.feature == "label"
+    # clean: window on the PREDICTOR side is the sanctioned shape
+    wf2, reader2 = _leaky_workflow(rng, cutoff=CutOffTime.at(500))
+    assert not [x for x in lint.check_workflow(wf2, reader=reader2)
+                if x.rule == "TMG702"]
+
+
+def test_tmg703_join_key_from_response_field_warns(rng):
+    left = DataReaders.simple.records([], key_fn=temporal.field("flag"))
+    right = DataReaders.simple.records([], key_fn=temporal.field("flag"))
+    join = TemporalJoinReader(left, right, key_field="flag")
+    reader = AggregateReader(join, TS, CutOffTime.at(500),
+                             key_fn=temporal.field("flag"))
+    label = (FeatureBuilder.RealNN("label")
+             .extract(temporal.field("flag"), "flag")
+             .aggregate(LogicalOrAggregator()).as_response())
+    spend = _amount("spend", SumAggregator())
+    findings = temporal.check_temporal(reader, [label, spend])
+    f = next(x for x in findings if x.rule == "TMG703")
+    assert f.severity == "warning" and "flag" in f.message
+    # clean: joining on a non-response key
+    left2 = DataReaders.simple.records([], key_fn=KEY)
+    right2 = DataReaders.simple.records([], key_fn=KEY)
+    join2 = TemporalJoinReader(left2, right2, key_field="user")
+    reader2 = AggregateReader(join2, TS, CutOffTime.at(500), key_fn=KEY)
+    assert not [x for x in temporal.check_temporal(reader2, [label, spend])
+                if x.rule == "TMG703"]
+
+
+# ---------------------------------------------------------------------------
+# TMG311 self-lint fixtures
+# ---------------------------------------------------------------------------
+
+
+def _load_tmoglint():
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "tmoglint", os.path.join(repo, "tools", "tmoglint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tmg311_unstable_sort_flagged_and_allowlisted():
+    tm = _load_tmoglint()
+    bad = "import numpy as np\norder = np.argsort(ts)\n"
+    assert [f.rule for f in tm.lint_source(bad)] == ["TMG311"]
+    bad2 = "import numpy as np\ni = np.searchsorted(edges, ts)\n"
+    assert [f.rule for f in tm.lint_source(bad2)] == ["TMG311"]
+    from_import = "from numpy import argsort\no = argsort(ts)\n"
+    assert [f.rule for f in tm.lint_source(from_import)] == ["TMG311"]
+    ok = ("import numpy as np\n"
+          "o = np.argsort(ts, kind='stable')\n"
+          "i = np.searchsorted(edges, ts, side='left')\n")
+    assert tm.lint_source(ok) == []
+    allowed = ("import numpy as np\n"
+               "o = np.argsort(x)  # lint: sort — rank only, ties ok\n")
+    assert tm.lint_source(allowed) == []
+    jnp_ok = "import jax.numpy as jnp\no = jnp.argsort(x)\n"
+    assert tm.lint_source(jnp_ok) == []
+    method_ok = "o = x.argsort()\n"          # not attributable to numpy
+    assert tm.lint_source(method_ok) == []
+
+
+def test_tmg7xx_and_tmg311_in_rules_catalog():
+    for rule in ("TMG701", "TMG702", "TMG703", "TMG311"):
+        assert rule in lint.RULES
+    assert lint.RULES["TMG701"][0] == "error"
+    assert lint.RULES["TMG702"][0] == "error"
+    assert lint.RULES["TMG703"][0] == "warning"
+
+
+def test_temporal_findings_mirror_to_telemetry(rng):
+    from transmogrifai_tpu import telemetry
+    wf, reader = _leaky_workflow(rng)
+    telemetry.enable()
+    try:
+        telemetry.reset(keep_listeners=True)
+        findings = lint.check_workflow(wf, reader=reader)
+        lint.emit_findings(findings)
+        assert telemetry.counter("lint.errors").value >= 1
+    finally:
+        telemetry.disable()
+        telemetry.reset(keep_listeners=True)

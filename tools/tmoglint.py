@@ -54,6 +54,16 @@ here as rules (the TMG3xx family of the catalog in
   and its subsystem rots; long-lived loop bodies must catch-and-tally).
   A deliberately bare loop carries ``# lint: thread-loop — reason`` on
   the ``while`` line or the ``def`` line.
+* **TMG311** — ``np.argsort(...)`` must pass an explicit ``kind=`` and
+  ``np.searchsorted(...)`` an explicit ``side=`` (the temporal-tier
+  rule: the columnar aggregation engine groups by key with a STABLE
+  argsort precisely because order-dependent monoid folds — float sums,
+  concat, first/last — silently change value under unstable sort ties,
+  and an implicit ``side=`` hides which boundary of a cutoff window is
+  inclusive). A deliberate default carries ``# lint: sort — reason``.
+  Only calls attributable to numpy (``import numpy as np`` aliases /
+  ``from numpy import argsort``) are checked; ``jnp`` is exempt (jax
+  sorts are stable by construction).
 
 Runs as a CLI over one or more paths (default: the ``transmogrifai_tpu``
 package next to this script) and as a tier-1 pytest
@@ -81,7 +91,7 @@ from transmogrifai_tpu.lint import Finding, Severity, enforce  # noqa: E402
 __all__ = ["lint_source", "lint_file", "lint_paths", "main",
            "ALLOW_WALLCLOCK", "ALLOW_BROAD_EXCEPT", "ALLOW_EXPLICIT_MESH",
            "ALLOW_THREAD", "ALLOW_UNBOUNDED_QUEUE", "ALLOW_POPEN",
-           "ALLOW_THREAD_LOOP"]
+           "ALLOW_THREAD_LOOP", "ALLOW_SORT"]
 
 #: suppression markers, checked on the finding's own source line
 ALLOW_WALLCLOCK = "lint: wall-clock"
@@ -91,6 +101,7 @@ ALLOW_THREAD = "lint: thread"
 ALLOW_UNBOUNDED_QUEUE = "lint: unbounded-queue"
 ALLOW_POPEN = "lint: popen"
 ALLOW_THREAD_LOOP = "lint: thread-loop"
+ALLOW_SORT = "lint: sort"
 
 
 def _fault_sites() -> frozenset:
@@ -123,6 +134,8 @@ class _Visitor(ast.NodeVisitor):
         self.queue_funcs: Set[str] = set()       # from queue import Queue
         self.subprocess_modules: Set[str] = set()
         self.popen_funcs: Set[str] = set()       # from subprocess import Popen
+        self.numpy_modules: Set[str] = set()
+        self.np_sort_funcs: Dict[str, str] = {}  # from numpy import argsort
         self.with_contexts: Set[int] = set()
         #: TMG310 bookkeeping: names used as Thread(target=...) and the
         #: module's function defs by name (methods included; resolved in
@@ -165,6 +178,8 @@ class _Visitor(ast.NodeVisitor):
                 self.queue_modules.add(local)
             if alias.name == "subprocess":
                 self.subprocess_modules.add(local)
+            if alias.name == "numpy":
+                self.numpy_modules.add(local)
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
@@ -191,6 +206,9 @@ class _Visitor(ast.NodeVisitor):
                 self.queue_funcs.add(local)
             if mod == "subprocess" and alias.name == "Popen":
                 self.popen_funcs.add(local)
+            if mod == "numpy" and alias.name in ("argsort",
+                                                 "searchsorted"):
+                self.np_sort_funcs[local] = alias.name
         self.generic_visit(node)
 
     # -- function defs: TMG310 target resolution ---------------------------
@@ -284,6 +302,21 @@ class _Visitor(ast.NodeVisitor):
                 and f.value.id in self.subprocess_modules:
             return True
         return isinstance(f, ast.Name) and f.id in self.popen_funcs
+
+    def _np_sort_kind(self, node: ast.Call) -> Optional[str]:
+        """\"argsort\"/\"searchsorted\" when the call is attributable to
+        numpy (module alias or from-import), else None — method-form
+        ``x.argsort()`` and jax's ``jnp`` are out of scope (jax sorts
+        are stable by construction)."""
+        f = node.func
+        if isinstance(f, ast.Attribute) \
+                and f.attr in ("argsort", "searchsorted") \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in self.numpy_modules:
+            return f.attr
+        if isinstance(f, ast.Name):
+            return self.np_sort_funcs.get(f.id)
+        return None
 
     def visit_Call(self, node: ast.Call) -> None:
         if self._is_thread(node):
@@ -397,6 +430,23 @@ class _Visitor(ast.NodeVisitor):
                     "fills; a supervisor must own its workers' "
                     "streams (or mark a deliberate inherit "
                     f"'# {ALLOW_POPEN} — <reason>')")
+        else:
+            sort_kind = self._np_sort_kind(node)
+            if sort_kind is not None \
+                    and not self._marked(node.lineno, ALLOW_SORT):
+                need = "kind" if sort_kind == "argsort" else "side"
+                kws = {kw.arg for kw in node.keywords}
+                if need not in kws and None not in kws:
+                    self._add(
+                        "TMG311", node.lineno,
+                        f"np.{sort_kind}() without explicit {need}= — "
+                        "order-dependent monoid folds (float sums, "
+                        "concat, first/last) silently change value "
+                        "under unstable sort ties, and an implicit "
+                        "side= hides which window boundary is "
+                        f"inclusive; pass {need}= explicitly (or mark "
+                        "a deliberate default "
+                        f"'# {ALLOW_SORT} — <reason>')")
         self.generic_visit(node)
 
 

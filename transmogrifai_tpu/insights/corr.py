@@ -72,7 +72,7 @@ class RecordInsightsCorrModel(FittedModel, AllowLabelAsInput):
         k = min(self.top_k, d)
         out = np.empty((n,), dtype=object)
         # rank per (row, pred col) by |importance|
-        order = np.argsort(-np.abs(imp), axis=2)[:, :, :k]
+        order = np.argsort(-np.abs(imp), axis=2, kind="stable")[:, :, :k]
         p = corr.shape[0]
         for i in range(n):
             row: Dict[str, List[List[float]]] = {}

@@ -98,7 +98,8 @@ class RecordInsightsLOCO(Transformer):
         diffs = self.loco_diffs(X)                       # [d, n]
         k = min(self.top_k, d)
         out = np.empty((n,), dtype=object)
-        order = np.argsort(-np.abs(diffs), axis=0)       # [d, n] per-row rank
+        # [d, n] per-row rank; stable so tied |diffs| keep feature order
+        order = np.argsort(-np.abs(diffs), axis=0, kind="stable")
         for i in range(n):
             top = order[:k, i]
             row = {names[j]: round(float(diffs[j, i]), 10)

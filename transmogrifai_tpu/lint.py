@@ -156,6 +156,12 @@ RULES: Dict[str, Tuple[str, str]] = {
                "subsystem it drives keeps 'running' with nobody home; "
                "loop bodies of Thread targets must catch-and-tally "
                "(allow: '# lint: thread-loop — reason')"),
+    "TMG311": (Severity.ERROR,
+               "np.argsort() without kind= / np.searchsorted() without "
+               "side= in product code — order-dependent monoid folds "
+               "silently change under unstable sort ties, and an "
+               "implicit side= hides which boundary a temporal window "
+               "includes (allow: '# lint: sort — reason')"),
     # -- TMG5xx: serving / AOT-bank advisories (aot.py, serving.py,
     #    server.py) — degradation notices, never crash paths ---------------
     "TMG501": (Severity.WARNING,
@@ -189,6 +195,21 @@ RULES: Dict[str, Tuple[str, str]] = {
                "retrain-job failure budget exhausted — retraining is "
                "disarmed until an operator clears the job records "
                "(docs/lifecycle.md runbook)"),
+    # -- TMG7xx: temporal / cutoff leakage rules (temporal.check_temporal —
+    #    static, reader-aware; extend TMG105's graph taint to event time) --
+    "TMG701": (Severity.ERROR,
+               "temporal leakage: predictor aggregated with NO cutoff "
+               "while a response folds from the same events — every "
+               "predictor fold sees post-outcome rows"),
+    "TMG702": (Severity.ERROR,
+               "temporal leakage: response-side generator declares an "
+               "event-time window — responses fold strictly AFTER the "
+               "cutoff, a window reaches back across it into the "
+               "predictor window"),
+    "TMG703": (Severity.WARNING,
+               "temporal leakage: join key derived from a response-side "
+               "(post-cutoff) field routes outcome information into the "
+               "joined predictors"),
     # -- TMG4xx: whole-DAG planner advisories (planner.py) -----------------
     "TMG401": (Severity.WARNING,
                "stage measured slower on device than host but is pinned "
@@ -526,13 +547,25 @@ def _check_graph(result_features, fitted_stages: Optional[Dict[str, Any]]
 
 
 def check_workflow(workflow, known_stages: Optional[Sequence[Any]] = None,
-                   suppress: Iterable[str] = ()) -> List[Finding]:
+                   suppress: Iterable[str] = (),
+                   reader: Any = None) -> List[Finding]:
     """Static graph check (TMG1xx) over an untrained :class:`Workflow`
     (or a bare sequence of result features). Touches no data and no
-    device — the compile-time type-safety analog."""
+    device — the compile-time type-safety analog.
+
+    When a ``reader`` is known (passed explicitly — the runner hands its
+    training reader in — or set on the workflow via ``set_reader``), the
+    temporal cutoff-leakage rules (TMG7xx, ``temporal.check_temporal``)
+    run too: the reader OBJECT is inspected structurally, never polled,
+    so this still reads no data."""
     feats = getattr(workflow, "result_features", workflow)
-    return _apply_suppress(
-        _check_graph(tuple(feats), known_stages=known_stages), suppress)
+    findings = _check_graph(tuple(feats), known_stages=known_stages)
+    if reader is None:
+        reader = getattr(workflow, "_reader", None)
+    if reader is not None:
+        from . import temporal
+        findings.extend(temporal.check_temporal(reader, tuple(feats)))
+    return _apply_suppress(findings, suppress)
 
 
 def check_model(model, device: bool = True, n_rows: int = 8,

@@ -248,7 +248,8 @@ class DataCutter(Splitter):
         inverse mapping and translates predictions back."""
         if self._kept_labels is None:
             return y
-        return np.searchsorted(self._kept_labels, y).astype(np.float64)
+        return np.searchsorted(self._kept_labels, y,
+                               side="left").astype(np.float64)
 
     def original_labels(self):
         if self._kept_labels is None:

@@ -2,6 +2,7 @@ from .data_readers import (DataReader, CSVReader, CSVAutoReader,  # noqa: F401
                            ParquetReader, AvroReader,
                            AggregateReader, ConditionalReader, DataReaders,
                            JoinedDataReader, JoinedAggregateDataReader,
+                           TemporalJoinReader,
                            TimeBasedFilter, FilteredReader, CutOffTime,
                            stream_score)
 from .avro import (ColumnarRecords, read_avro_records,  # noqa: F401

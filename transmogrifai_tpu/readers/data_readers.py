@@ -8,12 +8,22 @@ dense arrays reach the device), keeping the same API shape:
 * ``DataReader.read_records()`` → list of record dicts
 * ``AggregateReader`` — group records by key, fold each feature's values
   through its monoid aggregator with event-time cutoff filtering
-  (``FeatureAggregator.extract``: responses AFTER cutoff, predictors
-  BEFORE — leak prevention, ``FeatureAggregator.scala:99-119``)
+  (``FeatureAggregator.extract``: responses strictly AFTER cutoff,
+  predictors strictly BEFORE — leak prevention,
+  ``FeatureAggregator.scala:99-119``; the cutoff event itself lands in
+  NEITHER fold — docs/readers.md has the boundary table)
 * ``ConditionalReader`` — per-key cutoff fixed by an event predicate
   (``ConditionalParams``)
 * ``JoinedDataReader`` — typed left-outer/inner joins on keys
+* ``TemporalJoinReader`` — the streaming/columnar hash join
+  (consistent-hash partitioned bounded build tables, vectorized probe
+  when both sides are columnar — temporal.py)
 * ``DataReaders.simple/aggregate/conditional`` factories
+
+Aggregating readers auto-route to the COLUMNAR temporal engine
+(``temporal.route_aggregate``) when their source yields a columnar
+batch — bit-identical to the row-wise fold, vectorized group/filter —
+and degrade back to the row-wise loop on any columnar failure.
 """
 from __future__ import annotations
 
@@ -28,7 +38,8 @@ from ..stages.generator import FeatureGeneratorStage
 
 __all__ = ["DataReader", "CSVReader", "CSVAutoReader", "ParquetReader",
            "AvroReader", "AggregateReader", "ConditionalReader",
-           "JoinedDataReader", "JoinedAggregateDataReader", "TimeBasedFilter",
+           "JoinedDataReader", "JoinedAggregateDataReader",
+           "TemporalJoinReader", "TimeBasedFilter",
            "FilteredReader", "DataReaders", "CutOffTime", "stream_score"]
 
 
@@ -120,7 +131,25 @@ class CSVAutoReader(CSVReader):
 
 class AggregateReader(DataReader):
     """Group-by-key + monoid aggregation with cutoff-time leak prevention
-    (AggregatedReader, DataReader.scala:206-230)."""
+    (AggregatedReader, DataReader.scala:206-230).
+
+    Cutoff boundary (pinned — docs/readers.md): predictors fold events
+    with ``ts < cutoff`` (within ``[cutoff - window, cutoff)`` under a
+    declared window), responses fold events with ``ts > cutoff`` —
+    STRICTLY after, so an event exactly AT the cutoff (a conditional
+    reader's triggering event) lands in neither fold.
+
+    When the source yields a columnar batch (``avro.ColumnarRecords``,
+    ``temporal.Table``, a columnar join) and every extractor is
+    column-keyed, ``generate_store`` routes to the vectorized temporal
+    engine (``temporal.route_aggregate``) — bit-identical output, no
+    per-record Python dispatch; any columnar failure degrades back to
+    the row-wise fold below.
+    """
+
+    #: Workflow.train hands raw-store generation to the reader (the
+    #: cutoff discipline lives HERE, not in the workflow)
+    is_aggregating = True
 
     def __init__(self, base: DataReader,
                  timestamp_fn: Callable[[Dict], int],
@@ -138,9 +167,21 @@ class AggregateReader(DataReader):
         return self.cutoff.timestamp_ms
 
     def generate_store(self, raw_features: Sequence[Feature]) -> ColumnStore:
+        from .. import temporal
+        records = self.read_records()
+        store = temporal.route_aggregate(self, records, raw_features)
+        if store is not None:
+            return store
+        temporal.tally_rowwise(len(records))
+        return self._rowwise_store(records, raw_features)
+
+    def _rowwise_store(self, records, raw_features: Sequence[Feature]
+                       ) -> ColumnStore:
+        """The reference row-wise fold — also the parity oracle the
+        columnar engine is asserted bit-identical against."""
         from collections import defaultdict
         groups: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
-        for rec in self.read_records():
+        for rec in records:
             groups[self.key_fn(rec)].append(rec)
         keys = sorted(groups)
         cols: Dict[str, Any] = {}
@@ -167,8 +208,11 @@ class AggregateReader(DataReader):
                     ts = self.timestamp_fn(r)
                     if cutoff is not None:
                         if f.is_response:
-                            # responses strictly AFTER cutoff
-                            if ts < cutoff:
+                            # responses STRICTLY after cutoff: the
+                            # cutoff event itself (ts == cutoff, e.g. a
+                            # conditional reader's triggering purchase)
+                            # must not fold into the outcome
+                            if ts <= cutoff:
                                 continue
                         else:
                             # predictors BEFORE cutoff, within window
@@ -204,23 +248,24 @@ class ConditionalReader(AggregateReader):
         times = [self.timestamp_fn(r) for r in records if self.condition_fn(r)]
         return min(times) if times else None
 
-    def generate_store(self, raw_features: Sequence[Feature]) -> ColumnStore:
+    def _rowwise_store(self, records, raw_features: Sequence[Feature]
+                       ) -> ColumnStore:
         if self.drop_if_no_condition:
             from collections import defaultdict
             groups: Dict[str, List[Dict[str, Any]]] = defaultdict(list)
-            for rec in self.read_records():
+            for rec in records:
                 groups[self.key_fn(rec)].append(rec)
             keep = {k for k, recs in groups.items()
                     if any(self.condition_fn(r) for r in recs)}
             filtered = [r for k, recs in groups.items() if k in keep
                         for r in recs]
-            inner = _InMemoryReader(filtered, self.key_fn)
-            tmp = ConditionalReader(inner, self.timestamp_fn,
+            tmp = ConditionalReader(_InMemoryReader(filtered, self.key_fn),
+                                    self.timestamp_fn,
                                     self.condition_fn,
                                     drop_if_no_condition=False,
                                     key_fn=self.key_fn)
-            return tmp.generate_store(raw_features)
-        return super().generate_store(raw_features)
+            return tmp._rowwise_store(filtered, raw_features)
+        return super()._rowwise_store(records, raw_features)
 
 
 class ParquetReader(DataReader):
@@ -319,22 +364,132 @@ class JoinedDataReader(DataReader):
         return out
 
 
+class TemporalJoinReader(DataReader):
+    """Streaming hash join — the memory-bounded, columnar-capable
+    ``JoinedDataReader`` (temporal.py's native tier):
+
+    * the build (right) side is consistent-hash partitioned into
+      BOUNDED per-partition hash tables (``partitions`` ×
+      ``table_max_rows`` unique keys; run defaults from
+      ``customParams.joinPartitions`` / ``joinTableMaxRows``) — a new
+      key arriving at a full partition spills its row to the
+      dead-letter quarantine instead of growing the heap;
+    * the probe (left) side streams through in order, so output order
+      and merge semantics (right fields, left overwrites on shared
+      names; last right record per key wins) are bit-identical to
+      :class:`JoinedDataReader`;
+    * when BOTH sides yield columnar batches and the key column is
+      statically known, the whole join vectorizes (one stable argsort +
+      one searchsorted probe) and the result stays columnar
+      (``temporal.Table``) — which is what lets a downstream
+      ``AggregateReader`` keep the joined-then-aggregate composition
+      columnar end-to-end.
+
+    The build step runs behind the ``temporal.join`` fault site +
+    READER_RETRY, so a transient failure retries instead of killing the
+    read.
+    """
+
+    is_joining = True
+
+    def __init__(self, left: DataReader, right: DataReader,
+                 join_type: str = "left_outer",
+                 key_field: Optional[str] = None,
+                 partitions: Optional[int] = None,
+                 table_max_rows: Optional[int] = None):
+        if join_type not in ("left_outer", "inner"):
+            raise ValueError(
+                f"join_type must be 'left_outer' or 'inner', got "
+                f"{join_type!r}")
+        from .. import temporal
+        key_fn = temporal.field(key_field) if key_field else left.key_fn
+        super().__init__(key_fn)
+        self.left = left
+        self.right = right
+        self.join_type = join_type
+        self.key_field = key_field
+        self.partitions = partitions
+        self.table_max_rows = table_max_rows
+
+    def _left_key(self):
+        from .. import temporal
+        if self.key_field:
+            return temporal.field(self.key_field)
+        return self.left.key_fn
+
+    def _right_key(self):
+        from .. import temporal
+        if self.key_field:
+            return temporal.field(self.key_field)
+        return self.right.key_fn
+
+    def read_records(self):
+        from .. import resilience, telemetry, temporal
+        left = self.left.read_records()
+        right = self.right.read_records()
+        lkey = self.key_field or temporal.column_key_of(self._left_key())
+        rkey = self.key_field or temporal.column_key_of(self._right_key())
+        # decide the build shape BEFORE building anything: the
+        # vectorized probe needs BOTH sides columnar and both key
+        # columns statically known — otherwise the partitioned bounded
+        # dict tables are the only shape that will be probed, so the
+        # columnar build would be pure wasted work
+        columnar = (temporal._is_table(left) and temporal._is_table(right)
+                    and lkey is not None and rkey is not None
+                    and temporal.columnar_mode() is not False)
+
+        def build():
+            resilience.inject("temporal.join",
+                              join_type=self.join_type,
+                              rows=len(right))
+            if columnar:
+                return temporal.build_join_table(
+                    right, rkey, partitions=self.partitions,
+                    table_max_rows=self.table_max_rows)
+            return temporal._DictBuildTable(
+                right, self._right_key(),
+                temporal.join_partitions(self.partitions),
+                temporal.join_table_max_rows(self.table_max_rows))
+
+        # transient build failures (network-mount blips on the already
+        # decoded tables are rare, but the fault site models them)
+        # retry; the build is pure compute over in-memory records, so
+        # re-running it is safe
+        table = resilience.READER_RETRY.call("temporal.join", build)
+        temporal._tally("joins")
+        telemetry.counter("temporal.joins").inc()
+        with telemetry.span("temporal:join", rows=len(left)):
+            if isinstance(table, temporal._ColumnarBuildTable):
+                return table.probe(left, lkey, self.join_type)
+            return table.probe(left, self._left_key(), self.join_type)
+
+
 class JoinedAggregateDataReader(AggregateReader):
     """Join first, then time-window aggregate the joined records —
     ``JoinedAggregateDataReader`` (JoinedDataReader.scala:119-418): the
     right side's events are windowed against the cutoff after the join, as
     in the reference's dataprep examples
-    (docs/examples/Conditional-Aggregation.md)."""
+    (docs/examples/Conditional-Aggregation.md). The join now rides
+    :class:`TemporalJoinReader` (bounded partitioned build tables,
+    vectorized when both sides are columnar), so the joined-then-
+    aggregate composition is columnar end-to-end — bit-identical to the
+    pre-temporal row-wise composition, asserted in tests."""
 
     def __init__(self, left: DataReader, right: DataReader,
                  timestamp_fn: Callable[[Dict], int],
                  cutoff: CutOffTime = CutOffTime.no_cutoff(),
                  join_type: str = "left_outer",
-                 time_filter: Optional[TimeBasedFilter] = None):
-        joined: DataReader = JoinedDataReader(left, right, join_type)
+                 time_filter: Optional[TimeBasedFilter] = None,
+                 key_field: Optional[str] = None,
+                 partitions: Optional[int] = None,
+                 table_max_rows: Optional[int] = None):
+        joined: DataReader = TemporalJoinReader(
+            left, right, join_type, key_field=key_field,
+            partitions=partitions, table_max_rows=table_max_rows)
         if time_filter is not None:
             joined = FilteredReader(joined, time_filter.keep)
-        super().__init__(joined, timestamp_fn, cutoff, left.key_fn)
+        super().__init__(joined, timestamp_fn, cutoff,
+                         joined.key_fn if key_field else left.key_fn)
 
 
 def stream_score(model, batches: Iterable[Sequence[Mapping[str, Any]]],

@@ -153,6 +153,19 @@ FAULT_SITES = frozenset({
                                  # train-time moments with the fresh
                                  # slice, so a fault degrades the refit
                                  # to fresh-only stats, never a crash)
+    "temporal.aggregate",        # columnar temporal aggregation pass
+                                 # (temporal.route_aggregate /
+                                 # aggregate_tables — fires before the
+                                 # vectorized group/fold, so a fault
+                                 # models a columnar-tier failure: the
+                                 # breaker reports it and the row-wise
+                                 # fold serves, bit-identical)
+    "temporal.join",             # streaming hash-join build/probe
+                                 # (TemporalJoinReader /
+                                 # join_aggregate_directory — fires
+                                 # inside the retried build step, so a
+                                 # transient fault rides READER_RETRY
+                                 # instead of killing the read)
     "checkpoint.write",          # layer-checkpoint save (workflow.py)
     "checkpoint.rename",         # layer-checkpoint swap (workflow.py)
 })

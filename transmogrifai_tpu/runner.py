@@ -224,7 +224,7 @@ class OpWorkflowRunner:
 
     # -- pre-flight (lint.py, on by default) -------------------------------
     def _preflight(self, params: "OpParams", workflow=None,
-                   model=None) -> Optional[Dict[str, Any]]:
+                   model=None, reader=None) -> Optional[Dict[str, Any]]:
         """Static pre-flight check BEFORE any reader I/O: the graph rules
         over an untrained workflow (Train), graph + eval_shape device
         rules over a loaded model (Score/Evaluate/Features/Streaming).
@@ -247,7 +247,11 @@ class OpWorkflowRunner:
                       or str(device).lower() == "false")
         with telemetry.span("run:preflight"):
             if workflow is not None:
-                findings = lint.check_workflow(workflow, suppress=suppress)
+                # reader-aware: the temporal cutoff-leakage rules
+                # (TMG7xx) inspect the training reader STRUCTURALLY —
+                # still zero reader I/O before the gate
+                findings = lint.check_workflow(workflow, suppress=suppress,
+                                               reader=reader)
             else:
                 findings = lint.check_model(model, device=device,
                                             suppress=suppress)
@@ -425,10 +429,27 @@ class OpWorkflowRunner:
         # --quarantine-out): poison files/batches route there for THIS
         # run; the previous sink is restored on exit (a user-level
         # resilience.set_quarantine stays in force otherwise)
+        # run-scoped temporal-tier knobs (docs/readers.md):
+        # aggregateColumnar tri-state forces/forbids the columnar
+        # aggregation engine (auto = columnar when the source yields a
+        # columnar batch), joinPartitions/joinTableMaxRows bound the
+        # streaming join's build tables. Validated up front — a
+        # malformed value names its key now (TMG001 via `cli check`) —
+        # and validated BEFORE any run-scoped installs below, so a bad
+        # knob can never leak a half-installed run configuration.
+        from . import temporal as _temporal
+        temporal_knobs = dict(
+            columnar=_bool_custom_param(params, "aggregateColumnar",
+                                        allow_auto=True),
+            join_partitions=_numeric_custom_param(
+                params, "joinPartitions", int, minimum=1),
+            join_table_max_rows=_numeric_custom_param(
+                params, "joinTableMaxRows", int, minimum=1))
         qloc = (params.quarantine_location
                 or params.custom_params.get("quarantineLocation"))
         prev_sink = (resilience.set_quarantine(str(qloc)) if qloc
                      else None)
+        prev_temporal = _temporal.set_run_defaults(**temporal_knobs)
         # one collecting listener per run (OpSparkListener analog): its
         # AppMetrics summary rides in the metrics doc/sink below
         collector = None
@@ -462,6 +483,7 @@ class OpWorkflowRunner:
                 telemetry.remove_listener(collector)
             if qloc:
                 resilience.set_quarantine(prev_sink)
+            _temporal.set_run_defaults(**prev_temporal)
             try:
                 if ok:
                     # compile-cache presence rides in every metrics doc
@@ -519,6 +541,13 @@ class OpWorkflowRunner:
                     # reuse and the sustained-bandwidth measurement
                     # behind the fusion gate (pipeline.py)
                     result.metrics["pipeline"] = _pipeline_stats()
+                    # temporal-tier tallies ride on every doc too:
+                    # columnar-vs-rowwise aggregation split, join
+                    # traffic, bounded-table spills (temporal.py,
+                    # docs/readers.md) — zeros on runs that never
+                    # touch the temporal tier
+                    result.metrics["temporal"] = \
+                        _temporal.temporal_stats()
                     if collector is not None:
                         result.metrics["telemetry"] = collector.summary()
                         result.metrics["telemetryMetrics"] = \
@@ -554,9 +583,11 @@ class OpWorkflowRunner:
                  t0: float) -> RunnerResult:
         if run_type == RunType.TRAIN:
             params.apply_to_workflow(self.workflow)
-            # the compile-time-type-safety analog: a mis-wired DAG is
-            # rejected HERE, before the reader touches a byte
-            self._preflight(params, workflow=self.workflow)
+            # the compile-time-type-safety analog: a mis-wired DAG (or a
+            # leaky cutoff configuration, TMG7xx) is rejected HERE,
+            # before the reader touches a byte
+            self._preflight(params, workflow=self.workflow,
+                            reader=self.training_reader)
             # cost-based plan (graph-only pre-fit): train follows its
             # per-phase tier decisions
             wf_plan = self._plan_step(params, workflow=self.workflow)

@@ -475,11 +475,22 @@ class Workflow:
         self._fit_state = {}
         self._warm_matched = 0
         data = self._input_data
+        store = None
         if data is None and self._reader is not None:
-            data = self._reader.read_records()
-        if data is None:
-            raise WorkflowError("No input data: call set_input_store/records/reader")
-        store = _generate_raw_store(data, raw_features)
+            if getattr(self._reader, "is_aggregating", False):
+                # event-grouped readers OWN raw-store generation: the
+                # group-by-key + cutoff/window monoid folds (and their
+                # columnar fast path) live in the reader, not here —
+                # read_records would hand us raw EVENTS, one row per
+                # event instead of one per key
+                store = self._reader.generate_store(raw_features)
+            else:
+                data = self._reader.read_records()
+        if store is None:
+            if data is None:
+                raise WorkflowError(
+                    "No input data: call set_input_store/records/reader")
+            store = _generate_raw_store(data, raw_features)
 
         result_features = self.result_features
         rff_results = None
