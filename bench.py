@@ -221,6 +221,15 @@ class Bench:
                 self.doc["temporal"] = temporal.temporal_stats()
             except Exception:
                 self.doc.setdefault("temporal", None)
+            # tree-engine kernel tallies (per-kernel trace counts,
+            # mesh-sharded histogram builds, gate state) ride on EVERY
+            # doc too — the tree-training tier's evidence
+            # (models/_pallas_hist.py, docs/performance.md)
+            try:
+                from transmogrifai_tpu.models import _pallas_hist
+                self.doc["trees"] = _pallas_hist.tree_kernel_stats()
+            except Exception:
+                self.doc.setdefault("trees", None)
         if final:
             self.doc.pop("partial", None)
         print(json.dumps(self.doc), flush=True)
@@ -711,6 +720,117 @@ def _event_log() -> dict:
         out["temporal"] = temporal.temporal_stats()
     finally:
         shutil.rmtree(work, ignore_errors=True)
+    return out
+
+
+def _wide_sparse() -> dict:
+    """Wide-sparse tree workload (the PR 14 matrix-shape proof): a
+    high-cardinality OneHot/text-hash-shaped feature matrix — hundreds
+    of mostly-zero indicator columns beside a few dense reals
+    (TransmogrifAI's 45 feature types, PAPER.md §L2) — trained with the
+    sparsity-aware binning path (2-bin indicator blocks; on the kernel
+    path additionally the sparse01 kernel, which streams the 0/1 bin
+    matrix itself instead of a 2×-wider dense indicator) against the
+    naive full-width quantile binning. Headline: rows/s of the
+    sparse-aware leg; pass = ≥ 2× the dense-binning leg at matched model
+    quality (holdout AuPR within 0.02 — DIFFERENT binning grows
+    different trees, so quality parity is the honest flag, unlike the
+    bit-parity the kernel-vs-XLA tests assert at fixed binning)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from transmogrifai_tpu.evaluators import metrics as M
+    from transmogrifai_tpu.models import _pallas_hist
+    from transmogrifai_tpu.models._treefit import tree_mesh_scope
+    from transmogrifai_tpu.models.trees import RandomForestFamily
+    from transmogrifai_tpu.parallel.mesh import process_default_mesh
+
+    rows = int(os.environ.get("BENCH_WS_ROWS", 20_000))
+    Fs, Fd = 512, 4
+    rng = np.random.default_rng(14)
+    dense = rng.normal(size=(rows, Fd)).astype(np.float32)
+    # each row activates ~8 of 512 indicator columns (≈1.6% density —
+    # the one-hot/text-hash shape)
+    sparse = (rng.random((rows, Fs)) < 8.0 / Fs).astype(np.float32)
+    beta = rng.normal(size=16).astype(np.float32)
+    logits = dense[:, 0] + 1.5 * (sparse[:, :16] @ beta)
+    y = (logits + rng.normal(size=rows).astype(np.float32) * 0.5 > 0
+         ).astype(np.float32)
+    X = np.concatenate([dense, sparse], axis=1)
+    bmask = np.array([False] * Fd + [True] * Fs)
+    n_tr = int(rows * 0.8)
+    Xd = jnp.asarray(X[:n_tr])
+    yd = jnp.asarray(y[:n_tr])
+    wd = jnp.ones((n_tr,), jnp.float32)
+    X_ho = jnp.asarray(X[n_tr:])
+    y_ho = y[n_tr:]
+    out: dict = {"rows": rows, "features": Fd + Fs,
+                 "indicator_columns": Fs,
+                 "density_pct": round(100.0 * float(sparse.mean()), 2)}
+
+    def leg(mask):
+        import jax as _jax
+        fam = RandomForestFamily(
+            grid=[{"maxDepth": 6, "minInstancesPerNode": 2,
+                   "minInfoGain": 0.0}], num_trees=8, seed=14)
+        fam.binary_mask = mask
+        tk0 = _pallas_hist.tree_kernel_stats()
+        # ONE jitted program reused across reps (fit_prepared builds a
+        # fresh jit per call, which would re-trace+re-compile — the
+        # "warm" number would then mostly measure compiler speed, not
+        # training throughput; the review caught BENCH_r07's first cut
+        # with warm_s ≈ 91% of cold_s for exactly that reason)
+        grid = fam.stack_grid()
+
+        def run(trace_fresh):
+            from transmogrifai_tpu.models.trees import (_tree_rows,
+                                                        pad_rows_to)
+            with tree_mesh_scope(process_default_mesh()):
+                def go():
+                    Xarg = fam.device_prep(Xd)
+                    yp, wp = pad_rows_to(_tree_rows(Xarg), yd, wd)
+                    if trace_fresh[0] is None:
+                        trace_fresh[0] = _jax.jit(
+                            lambda X, y, w: fam.fit_batch(X, y, w, grid))
+                    return trace_fresh[0](Xarg, yp, wp)
+                return _jax.device_get(
+                    _pallas_hist.with_pallas_fallback(go))
+        fit = [None]
+        t0 = time.time()
+        params = run(fit)
+        cold_s = time.time() - t0
+        warm = []
+        for _ in range(3):
+            t1 = time.time()
+            params = run(fit)
+            warm.append(time.time() - t1)
+        warm_s = statistics.median(warm)
+        pred, _raw, prob = fam.predict_batch(
+            {k: jnp.asarray(v) for k, v in params.items()
+             if k not in ("train_node", "train_margin")}, X_ho)
+        m = M.binary_metrics(y_ho, np.asarray(pred)[0],
+                             np.asarray(prob)[0][:, 1])
+        tk1 = _pallas_hist.tree_kernel_stats()
+        return {"cold_s": round(cold_s, 2), "warm_s": round(warm_s, 3),
+                "rows_per_s": round(n_tr / warm_s),
+                "holdout_AuPR": round(float(m["AuPR"]), 4),
+                "kernel_traces": {
+                    k: tk1[k] - tk0[k]
+                    for k in ("cumhist_traces", "sparse01_traces",
+                              "split_scan_traces",
+                              "sharded_hist_traces")}}
+
+    out["dense_binning"] = leg(None)
+    out["sparse_binning"] = leg(bmask)
+    out["speedup_vs_dense"] = round(
+        out["dense_binning"]["warm_s"]
+        / max(out["sparse_binning"]["warm_s"], 1e-9), 2)
+    out["quality_parity"] = bool(
+        out["sparse_binning"]["holdout_AuPR"]
+        >= out["dense_binning"]["holdout_AuPR"] - 0.02)
+    out["pass"] = bool(out["speedup_vs_dense"] >= 2.0
+                       and out["quality_parity"])
+    out["trees"] = _pallas_hist.tree_kernel_stats()
     return out
 
 
@@ -2073,6 +2193,25 @@ def main() -> None:
         except Exception as e:
             _log(f"[bench] event_log failed: {e!r}")
             configs["event_log"] = {"error": repr(e)[:400]}
+    bench.emit()
+
+    # 4b1d. Wide-sparse tree workload (the PR 14 matrix-shape proof):
+    #       hundreds of mostly-zero indicator columns trained with
+    #       sparsity-aware 2-bin binning (+ the sparse01 kernel on the
+    #       kernel path) vs naive full-width quantile binning —
+    #       headline rows/s, pass = ≥2× at matched holdout AuPR.
+    if bench.remaining() < 240:
+        configs["wide_sparse"] = {
+            "status": "skipped_budget",
+            "remaining_budget_s": round(bench.remaining(), 1)}
+        _log(f"[bench] wide_sparse skipped: remaining "
+             f"{bench.remaining():.0f}s < 240s")
+    else:
+        try:
+            configs["wide_sparse"] = _wide_sparse()
+        except Exception as e:
+            _log(f"[bench] wide_sparse failed: {e!r}")
+            configs["wide_sparse"] = {"error": repr(e)[:400]}
     bench.emit()
 
     # 4b2. Serving latency (the AOT bank + model server proof):

@@ -136,6 +136,188 @@ def test_fit_level_pallas_fallback(monkeypatch):
         ph.with_pallas_fallback(forced)
 
 
+def _xla_select(cum, crit, min_inst, mask2d=None):
+    """The XLA selection chain the split-scan kernel replaces (the exact
+    expressions from grow_tree's level body)."""
+    from transmogrifai_tpu.models._treefit import _NEG
+    A = cum.shape[0]
+    sb = crit.score(cum)
+    lcb = cum[:, -1, :-1, :]
+    tcb = cum[:, -1, -1:, :]
+    okb = (lcb >= min_inst) & (tcb - lcb >= min_inst)
+    extra = crit.extra_ok(cum)
+    if extra is not None:
+        okb = okb & extra
+    if mask2d is not None:
+        okb = okb & (mask2d[:, None, :] > 0.5)
+    flat = jnp.where(okb, sb, _NEG).reshape(A, -1)
+    best = jnp.argmax(flat, axis=1)
+    valid = jnp.take_along_axis(okb.reshape(A, -1), best[:, None],
+                                axis=1)[:, 0]
+    return best, valid
+
+
+def _cum_hist(rng, A, C, B, F, dtype):
+    """Random VALID cumulative histogram (monotone over bins, exact
+    small-integer values so every float op is exact in both paths)."""
+    raw = rng.integers(0, 4, size=(A, C, B, F)).astype(dtype)
+    return jnp.asarray(np.cumsum(raw, axis=2))
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+@pytest.mark.parametrize("kind", ["variance", "gini", "xgb"])
+def test_split_scan_matches_xla_selection(rng, kind, dtype):
+    """Interpret-mode bit-parity of the fused split-scan kernel against
+    the XLA score→mask→argmax chain it replaces, across criteria and
+    dtypes — including argmax's first-occurrence tie rule (small-integer
+    histograms make score ties common) and the winner-validity gather."""
+    from transmogrifai_tpu.models import _treefit as TF
+
+    crit = {"variance": TF.VarianceCriterion(),
+            "gini": TF.GiniCriterion(),
+            "xgb": TF.XGBCriterion(1.0, 2.0)}[kind]
+    C = 3 if kind == "xgb" else 4
+    A, B, F = 6, 8, 11
+    cum = _cum_hist(rng, A, C, B, F, dtype)
+    mi = jnp.asarray(3.0, cum.dtype)
+    mask2d = jnp.asarray(
+        rng.integers(0, 2, size=(A, F)).astype(dtype))
+    for mk in (None, mask2d):
+        b0, v0 = _xla_select(cum, crit, mi, mk)
+        _s, b1, v1 = _pallas_hist.split_scan(
+            cum, kind, mi, lam=1.0, min_child_weight=jnp.asarray(2.0),
+            mask=mk, interpret=True)
+        np.testing.assert_array_equal(np.asarray(b0), np.asarray(b1))
+        np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+
+
+def test_split_scan_feature_tiling_and_all_masked(rng):
+    """Feature-block tiling (grid > 1, padded F) must merge block
+    winners on the global flat axis; an all-masked level must yield the
+    XLA degenerate (index 0, valid False)."""
+    from transmogrifai_tpu.models import _treefit as TF
+
+    crit = TF.VarianceCriterion()
+    A, C, B, F = 128, 4, 32, 50     # forces Fc < F in f64
+    cum = _cum_hist(rng, A, C, B, F, np.float64)
+    mi = jnp.asarray(2.0)
+    b0, v0 = _xla_select(cum, crit, mi)
+    _s, b1, v1 = _pallas_hist.split_scan(cum, "variance", mi,
+                                         interpret=True)
+    np.testing.assert_array_equal(np.asarray(b0), np.asarray(b1))
+    np.testing.assert_array_equal(np.asarray(v0), np.asarray(v1))
+
+    _s, b2, v2 = _pallas_hist.split_scan(
+        cum[:4], "variance", jnp.asarray(1e9), interpret=True)
+    assert np.array_equal(np.asarray(b2), np.zeros(4))
+    assert not np.asarray(v2).any()
+
+
+def test_sparse01_kernel_bit_identical_to_dense(rng):
+    """The wide-sparse 2-bin kernel (zero bin = total − nonzero side)
+    must match the dense bin-indicator kernel bit-for-bit on exact
+    stats — including idle rows (node == A) and feature tiling."""
+    n, F, A, C = 203, 9, 4, 3
+    stats = jnp.asarray(rng.integers(0, 3, size=(n, C)).astype(np.float64))
+    node = jnp.asarray(rng.integers(0, A + 1, size=(n,)), jnp.int32)
+    Xb01 = jnp.asarray(rng.integers(0, 2, size=(n, F)), jnp.int32)
+    dense = _pallas_hist.cumhist(stats, node, Xb01.T, A, 2,
+                                 interpret=True)
+    sparse = _pallas_hist.cumhist(stats, node, Xb01.T, A, 2,
+                                  interpret=True, sparse01=True)
+    np.testing.assert_array_equal(np.asarray(dense), np.asarray(sparse))
+    # and against the dense O(n·A·B·F) reference
+    np.testing.assert_allclose(np.asarray(sparse),
+                               _ref_hist(stats, node, Xb01, A, 2),
+                               rtol=0, atol=0)
+
+
+@pytest.mark.parametrize("depth", [2, 3, 5])
+def test_forced_pallas_fit_with_sparse_and_scan_matches_xla(rng,
+                                                            monkeypatch,
+                                                            depth):
+    """Whole-fit parity across depths with BOTH new kernels engaged
+    (binary columns → sparse01 blocks; split scan on): trees grown with
+    the kernels forced on (interpret) must match the XLA path."""
+    from transmogrifai_tpu.models import _treefit
+
+    n, Fc_, Fb_ = 140, 4, 3
+    Xc = rng.normal(size=(n, Fc_))
+    Xb01 = rng.integers(0, 2, size=(n, Fb_)).astype(np.float64)
+    X = jnp.asarray(np.concatenate([Xc, Xb01], axis=1))
+    bmask = np.array([False] * Fc_ + [True] * Fb_)
+    y = jnp.asarray((rng.normal(size=(n,)) + np.asarray(X)[:, 0] > 0)
+                    .astype(np.float64))
+    w = jnp.ones((n,))
+    kw = dict(task="classification", n_classes=2, n_trees=3,
+              max_depth=depth, n_bins=8, min_instances=jnp.asarray(1.0),
+              min_info_gain=jnp.asarray(0.0),
+              num_trees_used=jnp.asarray(3),
+              subsample_rate=jnp.asarray(1.0), binary_mask=bmask)
+
+    monkeypatch.setenv("TMOG_PALLAS", "0")
+    base = _treefit.fit_forest(X, y, w, **kw)
+    monkeypatch.setenv("TMOG_PALLAS", "1")
+    before = _pallas_hist.tree_kernel_stats()
+    forced = _treefit.fit_forest(X, y, w, **kw)
+    after = _pallas_hist.tree_kernel_stats()
+    assert after["sparse01_traces"] > before["sparse01_traces"]
+    assert after["split_scan_traces"] > before["split_scan_traces"]
+    np.testing.assert_array_equal(np.asarray(base["feat"]),
+                                  np.asarray(forced["feat"]))
+    np.testing.assert_allclose(np.asarray(base["thr"]),
+                               np.asarray(forced["thr"]))
+    np.testing.assert_allclose(np.asarray(base["leaf"]),
+                               np.asarray(forced["leaf"]), rtol=1e-8)
+
+
+@pytest.mark.chaos
+def test_split_scan_mosaic_failure_falls_back_to_xla(rng, monkeypatch):
+    """A Mosaic rejection inside the NEW kernel (probe passed, the
+    production shape dies) must flip the gate and re-run the fit on the
+    XLA path with IDENTICAL selections — the with_pallas_fallback
+    contract extended to the split scan."""
+    from transmogrifai_tpu.models import _treefit
+
+    monkeypatch.delenv("TMOG_PALLAS", raising=False)
+    # gate "on" without the TPU backend: probe pretends to have passed
+    monkeypatch.setattr(_pallas_hist, "_PROBE", True)
+    monkeypatch.setattr(_pallas_hist, "pallas_histograms_enabled",
+                        lambda: _pallas_hist._PROBE is True)
+
+    n, F = 120, 5
+    X = jnp.asarray(rng.normal(size=(n, F)))
+    y = jnp.asarray((rng.normal(size=(n,)) + np.asarray(X)[:, 0] > 0)
+                    .astype(np.float64))
+    w = jnp.ones((n,))
+    kw = dict(task="classification", n_classes=2, n_trees=2, max_depth=3,
+              n_bins=8, min_instances=jnp.asarray(1.0),
+              min_info_gain=jnp.asarray(0.0),
+              num_trees_used=jnp.asarray(2),
+              subsample_rate=jnp.asarray(1.0))
+
+    real_scan = _pallas_hist.split_scan
+
+    def boom(*a, **k):
+        if _pallas_hist._PROBE:
+            raise RuntimeError(
+                "Mosaic lowering failed: VMEM limit exceeded in "
+                "split-scan kernel")
+        return real_scan(*a, **k)
+    monkeypatch.setattr(_pallas_hist, "split_scan", boom)
+
+    with pytest.warns(UserWarning, match="XLA matmul path"):
+        out = _pallas_hist.with_pallas_fallback(
+            lambda: _treefit.fit_forest(X, y, w, **kw))
+    assert _pallas_hist._PROBE is False       # gate flipped process-wide
+
+    monkeypatch.setenv("TMOG_PALLAS", "0")
+    base = _treefit.fit_forest(X, y, w, **kw)
+    for k in ("feat", "thr", "leaf"):
+        np.testing.assert_allclose(np.asarray(base[k]),
+                                   np.asarray(out[k]), rtol=0, atol=0)
+
+
 def test_predict_kernel_matches_xla_routing(rng):
     """Routed ensemble prediction: the transposed-domain predict kernel
     must match per-tree XLA routing exactly (incl. +inf dead-split

@@ -96,7 +96,8 @@ def test_plan_json_schema(rng):
     model, _ = _pruning_cse_model(rng)
     doc = planner.plan_model(model).to_json()
     assert doc["version"] == 1
-    assert set(doc["tiers"]) == {"engine", "fitstats", "transform"}
+    assert set(doc["tiers"]) == {"engine", "fitstats", "transform",
+                                 "aggregate"}
     assert doc["counts"]["stages"] == len(doc["stages"])
     for row in doc["stages"]:
         assert {"uid", "stage", "kind", "tier", "reason",

@@ -1,0 +1,538 @@
+"""Mesh-sharded tree training (the PR 14 tentpole) + satellites.
+
+The histogram build is a monoid fold, so the data-parallel shard_map
+(+psum) path must be BIT-IDENTICAL to the single-device pass — asserted
+here on exact-integer statistics (classification stats are weighted
+counts: every float op is exact, so accumulation order cannot hide a
+sharding bug). The degenerate 1-device mesh must resolve to the exact
+pre-shard trace (the PR 6 discipline). Satellites: order-robust quantile
+sketch, the Workflow warm probe, the planner's columnar-vs-rowwise
+aggregation hint, and the TMG312 kernel-gating self-lint rule.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models import _pallas_hist as ph
+from transmogrifai_tpu.models import _treefit as TF
+from transmogrifai_tpu.parallel.mesh import make_mesh, process_default_mesh
+
+multi_device = pytest.mark.skipif(
+    jax.device_count() < 2,
+    reason="sharded-vs-single parity needs >1 device")
+
+
+def _tree_data(rng, n=160, F=5, n_bin_cols=2):
+    Xc = rng.normal(size=(n, F - n_bin_cols))
+    Xb01 = rng.integers(0, 2, size=(n, n_bin_cols)).astype(np.float64)
+    X = jnp.asarray(np.concatenate([Xc, Xb01], axis=1))
+    bmask = np.array([False] * (F - n_bin_cols) + [True] * n_bin_cols)
+    y = jnp.asarray((rng.normal(size=(n,)) + np.asarray(X)[:, 0] > 0)
+                    .astype(np.float64))
+    return X, y, jnp.ones((n,)), bmask
+
+
+_FIT_KW = dict(task="classification", n_classes=2, n_trees=3, max_depth=4,
+               n_bins=8)
+
+
+def _fit(X, y, w, bmask, **over):
+    kw = dict(_FIT_KW, min_instances=jnp.asarray(1.0),
+              min_info_gain=jnp.asarray(0.0),
+              num_trees_used=jnp.asarray(3),
+              subsample_rate=jnp.asarray(1.0), binary_mask=bmask)
+    kw.update(over)
+    return TF.fit_forest(X, y, w, **kw)
+
+
+# ---------------------------------------------------------------------------
+# tentpole: sharded histogram build + trained-tree parity
+# ---------------------------------------------------------------------------
+
+
+@multi_device
+def test_sharded_cumhist_bit_identical(rng):
+    """shard_map partial histograms + psum == single-device kernel, bit
+    for bit (exact-integer stats), for the generic, precomputed-bc and
+    sparse01 kernel variants."""
+    mesh = make_mesh()
+    n, F, A, B, C = 128, 6, 4, 8, 3
+    stats = jnp.asarray(rng.integers(0, 3, size=(n, C)).astype(np.float64))
+    node = jnp.asarray(rng.integers(0, A + 1, size=(n,)), jnp.int32)
+    XbT = jnp.asarray(rng.integers(0, B, size=(F, n)), jnp.int32)
+    Xb01T = jnp.asarray(rng.integers(0, 2, size=(F, n)), jnp.int32)
+    bc = ph.make_bc(XbT, B, jnp.float64)
+    cases = [
+        (XbT, B, dict()),
+        (XbT, B, dict(bc=bc)),
+        (Xb01T, 2, dict(sparse01=True)),
+    ]
+    for mat, nb, kw in cases:
+        single = ph.cumhist(stats, node, mat, A, nb, **kw)
+        sharded = TF._sharded_cumhist(mesh, stats, node, mat, A, nb, **kw)
+        np.testing.assert_array_equal(np.asarray(single),
+                                      np.asarray(sharded))
+
+
+@multi_device
+def test_sharded_tree_fit_bit_identical(rng, monkeypatch):
+    """Trees grown under a multi-device tree-mesh scope (kernel forced,
+    interpret) == trees grown unscoped == the XLA path — the acceptance
+    bit-parity, covering both level drivers (scan + unrolled/sibling)."""
+    monkeypatch.setenv("TMOG_PALLAS", "0")
+    X, y, w, bmask = _tree_data(rng)
+    base = _fit(X, y, w, bmask)
+
+    monkeypatch.setenv("TMOG_PALLAS", "1")
+    solo = _fit(X, y, w, bmask)
+    before = ph.tree_kernel_stats()
+    with TF.tree_mesh_scope(make_mesh()):
+        sharded = _fit(X, y, w, bmask)
+    after = ph.tree_kernel_stats()
+    assert after["sharded_hist_traces"] > before["sharded_hist_traces"]
+    assert after["sharded_route_traces"] > before["sharded_route_traces"]
+    for k in ("feat", "thr", "leaf", "train_node"):
+        np.testing.assert_array_equal(np.asarray(base[k]),
+                                      np.asarray(solo[k]))
+        np.testing.assert_array_equal(np.asarray(solo[k]),
+                                      np.asarray(sharded[k]))
+
+    # unrolled driver (static depth, sibling subtraction) under the mesh
+    pre = TF.prepare_bins(X, 8, bmask)
+    prebinned = (pre[0], pre[1], pre[2], False)
+    solo_u = _fit(None, y, w, bmask, prebinned=prebinned, unroll=True)
+    with TF.tree_mesh_scope(make_mesh()):
+        shard_u = _fit(None, y, w, bmask, prebinned=prebinned,
+                       unroll=True)
+    for k in ("feat", "thr", "leaf"):
+        np.testing.assert_array_equal(np.asarray(solo_u[k]),
+                                      np.asarray(shard_u[k]))
+
+
+@multi_device
+def test_cv_sweep_sharded_matches_unsharded(rng, monkeypatch):
+    """The whole fused CV path (shard_cv_inputs row sharding + the
+    tree-mesh scope inside validate): winner, params and the per-fold
+    metric matrix must match the unsharded sweep exactly."""
+    from transmogrifai_tpu.models.trees import RandomForestFamily
+    from transmogrifai_tpu.models.tuning import CrossValidation
+
+    monkeypatch.setenv("TMOG_PALLAS", "1")
+    n = 256
+    X, y, _w, bmask = _tree_data(rng, n=n)
+    X, y = np.asarray(X), np.asarray(y)
+
+    def families():
+        fam = RandomForestFamily(
+            grid=[{"maxDepth": 3, "minInstancesPerNode": 1,
+                   "minInfoGain": 0.0},
+                  {"maxDepth": 3, "minInstancesPerNode": 8,
+                   "minInfoGain": 0.01}],
+            num_trees=3)
+        fam.binary_mask = bmask
+        return [fam]
+
+    cv = CrossValidation(num_folds=2, metric_name="AuROC", task="binary",
+                         seed=3)
+    _f0, hp0, sum0 = cv.validate(families(), X, y, mesh=None)
+    _f1, hp1, sum1 = cv.validate(families(), X, y, mesh=make_mesh())
+    assert hp0 == hp1
+    assert sum0.best.family_name == sum1.best.family_name
+    m0 = {(r.grid_index): r.metric_values for r in sum0.results}
+    m1 = {(r.grid_index): r.metric_values for r in sum1.results}
+    assert m0 == m1
+
+
+def test_degenerate_mesh_resolves_to_exact_path():
+    """1-device mesh / None / False under the scope → no active tree
+    mesh → the exact pre-shard trace (no shard_map anywhere)."""
+    one = make_mesh(n_devices=1)
+    with TF.tree_mesh_scope(one):
+        assert TF.active_tree_mesh() is None
+    with TF.tree_mesh_scope(None):
+        assert TF.active_tree_mesh() is None
+    with TF.tree_mesh_scope(False):
+        assert TF.active_tree_mesh() is None
+    if jax.device_count() > 1:
+        with TF.tree_mesh_scope(make_mesh()):
+            assert TF.active_tree_mesh() is not None
+        assert TF.active_tree_mesh() is None      # restored
+
+
+@multi_device
+def test_device_prep_pads_rows_to_mesh_multiple(monkeypatch, rng):
+    """Under a tree-mesh scope the kernel-path binned matrix must pad to
+    a row count the data axis divides evenly (shard_map's even-sharding
+    requirement), with zero-weight pad rows (the pad_rows discipline)."""
+    from transmogrifai_tpu.models.trees import RandomForestFamily
+
+    monkeypatch.setenv("TMOG_PALLAS", "1")
+    mesh = make_mesh()
+    d = int(mesh.shape["data"])
+    fam = RandomForestFamily(num_trees=2)
+    Xd = jnp.asarray(rng.normal(size=(300, 4)), jnp.float32)
+    with TF.tree_mesh_scope(mesh):
+        prep = fam.device_prep(Xd)
+    n_pad = prep["XbT"].shape[1]
+    assert n_pad % ph.ROW_ALIGN == 0 and n_pad % d == 0
+
+
+def test_tree_estimator_fit_enters_mesh_scope(rng, monkeypatch):
+    """Standalone tree estimator stages fit inside a tree-mesh scope on
+    the workflow-resolved (process-default) mesh — tree fits scale with
+    devices, not just the CV fold grid."""
+    from transmogrifai_tpu import FeatureBuilder, Workflow
+    from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+
+    seen = []
+    real = TF.tree_mesh_scope
+
+    def spy(mesh):
+        seen.append(mesh)
+        return real(mesh)
+    # fit_columns imports tree_mesh_scope from ._treefit at call time
+    monkeypatch.setattr(TF, "tree_mesh_scope", spy)
+
+    recs = [{"label": float(rng.integers(0, 2)),
+             "x": float(rng.normal()), "z": float(rng.normal())}
+            for _ in range(64)]
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    fz = FeatureBuilder.Real("z").from_column().as_predictor()
+    vec = transmogrify([fx, fz])
+    pred = label.transform_with(
+        OpRandomForestClassifier(num_trees=2, max_depth=2), vec)
+    (Workflow().set_input_records(recs)
+     .set_result_features(pred).train())
+    assert seen
+    if jax.device_count() > 1:
+        assert seen[-1] is process_default_mesh()
+
+
+# ---------------------------------------------------------------------------
+# satellite: order-robust quantile sketch
+# ---------------------------------------------------------------------------
+
+
+def test_quantile_sketch_order_robust(monkeypatch, rng):
+    """Sorted vs shuffled copies of the same column must sketch to the
+    same edges (both are now uniform samples of the same values — the
+    raw ``X[::stride]`` slice was a function of row order), and the
+    sketch stays deterministic call to call."""
+    monkeypatch.setattr(TF, "QUANTILE_SAMPLE_ROWS", 512)
+    n = 4096
+    vals = rng.gamma(2.0, 10.0, size=n)
+    shuffled = jnp.asarray(vals[:, None])
+    sorted_ = jnp.asarray(np.sort(vals)[:, None])
+    e_shuf = np.asarray(TF.quantile_bin_edges(shuffled, 16))
+    e_sort = np.asarray(TF.quantile_bin_edges(sorted_, 16))
+    e_true = np.quantile(vals, np.linspace(0, 1, 17)[1:-1])
+    # both are (different) uniform random samples → close to each other
+    # and to the exact quantiles, with sampling noise only
+    scale = float(np.std(vals))
+    np.testing.assert_allclose(e_shuf[0], e_sort[0], atol=0.2 * scale)
+    np.testing.assert_allclose(e_shuf[0], e_true, atol=0.2 * scale)
+    # deterministic: same input → identical edges
+    np.testing.assert_array_equal(
+        e_shuf, np.asarray(TF.quantile_bin_edges(shuffled, 16)))
+    # below the sampling threshold the exact path is untouched
+    small = jnp.asarray(vals[:256][:, None])
+    np.testing.assert_allclose(
+        np.asarray(TF.quantile_bin_edges(small, 8))[0],
+        np.quantile(vals[:256], np.linspace(0, 1, 9)[1:-1]), rtol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# satellite: Workflow warm probe
+# ---------------------------------------------------------------------------
+
+
+def test_workflow_train_warms_tree_kernel_probe(monkeypatch):
+    """A DAG containing a tree family (selector) or a tree estimator
+    must kick the async Pallas probe; a tree-free DAG must not."""
+    from transmogrifai_tpu.models.selector import ModelSelector
+    from transmogrifai_tpu.models.trees import (OpRandomForestClassifier,
+                                                RandomForestFamily)
+    from transmogrifai_tpu.workflow import Workflow
+
+    calls = []
+    monkeypatch.setattr(ph, "warm_probe_async",
+                        lambda: calls.append(True))
+
+    sel = ModelSelector(families=[RandomForestFamily(num_trees=2)])
+    Workflow._warm_tree_probe([[sel]])
+    assert calls == [True]
+
+    est = OpRandomForestClassifier()
+    Workflow._warm_tree_probe([[est]])
+    assert calls == [True, True]
+
+    Workflow._warm_tree_probe([[ModelSelector(families=[])]])
+    assert calls == [True, True]               # no tree family → no probe
+
+
+def test_resolve_mesh_assigns_tree_estimators():
+    """Workflow._resolve_mesh threads the active mesh to tree estimator
+    stages exactly like ModelSelector stages (auto-marked, re-resolved
+    on retrain)."""
+    from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+    from transmogrifai_tpu.workflow import Workflow
+
+    wf = Workflow()
+    est = OpRandomForestClassifier()
+    wf._resolve_mesh([[est]])
+    if jax.device_count() > 1:
+        assert est.mesh is process_default_mesh()
+        assert est._mesh_auto
+    else:
+        assert est.mesh is None
+    wf.mesh = False
+    wf._resolve_mesh([[est]])
+    assert est.mesh is None                    # forced unsharded wins
+
+
+# ---------------------------------------------------------------------------
+# satellite: cost-db columnar-vs-rowwise aggregation hint
+# ---------------------------------------------------------------------------
+
+
+def test_aggregate_route_tier_needs_both_measurements(tmp_path):
+    from transmogrifai_tpu import planner
+
+    db = planner.CostDatabase(path=str(tmp_path / "db.json"))
+    assert planner.aggregate_route_tier(db) is None
+    db.record_stage("phase:temporal.route_aggregate", "columnar", 0.1,
+                    10_000)
+    assert planner.aggregate_route_tier(db) is None     # one-sided
+    db.record_stage("phase:temporal.route_aggregate", "rowwise", 1.0,
+                    10_000)
+    assert planner.aggregate_route_tier(db) == "columnar"
+    # flip the evidence hard enough to move the running mean
+    for _ in range(64):
+        db.record_stage("phase:temporal.route_aggregate", "columnar",
+                        5.0, 1_000)
+    assert planner.aggregate_route_tier(db) == "rowwise"
+
+
+def test_route_aggregate_consults_hint_and_feeds_cost_db(rng):
+    """auto + hint "rowwise" → the columnar engine stands down (tallied
+    hint_fallbacks); auto + hint "columnar"/None → columnar serves and
+    reports a phase observation the cost db drains."""
+    from transmogrifai_tpu import FeatureBuilder, planner, temporal
+    from transmogrifai_tpu.readers import (AggregateReader, CutOffTime,
+                                           DataReaders)
+
+    recs = [{"user": float(rng.integers(0, 5)),
+             "ts": float(rng.uniform(0, 100)),
+             "amount": float(rng.uniform(0, 10))} for _ in range(400)]
+    tab = temporal.table_from_records(recs)
+    key = temporal.field("user")
+    ts = temporal.field("ts")
+    feats = [FeatureBuilder.Real("s")
+             .extract(temporal.field("amount"), "amount")
+             .aggregate(None).as_predictor()]
+
+    class _Src:
+        def __init__(self):
+            self.key_fn = key
+
+        def read_records(self):
+            return tab
+
+    reader = AggregateReader(_Src(), ts, CutOffTime.no_cutoff(),
+                             key_fn=key)
+    prev = temporal.set_aggregate_tier_hint("rowwise")
+    try:
+        temporal._HINT_COUNT[0] = 0
+        before = temporal.temporal_stats()
+        out = temporal.route_aggregate(reader, tab, feats)
+        after = temporal.temporal_stats()
+        assert out is None
+        assert after["hint_fallbacks"] == before["hint_fallbacks"] + 1
+        # the hint is NOT a one-way ratchet: every HINT_PROBE_EVERY-th
+        # pass still runs columnar so the measurement can flip back
+        probed = [temporal.route_aggregate(reader, tab, feats)
+                  for _ in range(temporal.HINT_PROBE_EVERY)]
+        assert any(p is not None for p in probed)
+
+        temporal.set_aggregate_tier_hint("columnar")
+        out = temporal.route_aggregate(reader, tab, feats)
+        assert out is not None
+        # the timed columnar pass fed observe_phase → a drain lands it
+        # in the db under phase:temporal.route_aggregate / columnar
+        db = planner.CostDatabase()
+        planner.drain_phase_observations(db)
+        assert db.stage_cost("phase:temporal.route_aggregate",
+                             "columnar") is not None
+    finally:
+        temporal.set_aggregate_tier_hint(prev)
+
+
+def test_rowwise_fold_reports_phase_observation(rng):
+    from transmogrifai_tpu import planner, temporal
+
+    db = planner.CostDatabase()
+    planner.drain_phase_observations(db)       # clear the buffer
+    temporal.tally_rowwise(5_000, seconds=0.25)
+    db2 = planner.CostDatabase()
+    planner.drain_phase_observations(db2)
+    assert db2.stage_cost("phase:temporal.route_aggregate",
+                          "rowwise") == pytest.approx(0.05)
+
+
+def test_uncontested_rowwise_passes_stay_out_of_cost_db(rng):
+    """Rowwise timings feed the cost db ONLY when the columnar tier was
+    a real option: row-list sources and structurally unroutable (opaque
+    extractor) readers must not poison the pooled rowwise s/krow."""
+    from transmogrifai_tpu import FeatureBuilder, planner, temporal
+    from transmogrifai_tpu.readers import (AggregateReader, CutOffTime,
+                                           DataReaders)
+
+    key = temporal.field("user")
+    ts = temporal.field("ts")
+    recs = [{"user": float(i % 3), "ts": float(i), "amount": 1.0}
+            for i in range(60)]
+    planner.drain_phase_observations(planner.CostDatabase())   # clear
+
+    # row-list source: columnar never an option → no observation
+    feats = [FeatureBuilder.Real("s")
+             .extract(temporal.field("amount"), "amount")
+             .aggregate(None).as_predictor()]
+    AggregateReader(DataReaders.simple.records(recs), ts,
+                    CutOffTime.no_cutoff(),
+                    key_fn=key).generate_store(feats)
+    db = planner.CostDatabase()
+    planner.drain_phase_observations(db)
+    assert db.stage_cost("phase:temporal.route_aggregate",
+                         "rowwise") is None
+
+    # columnar TABLE source but opaque (callable) extractor: the route
+    # raises TemporalError — structurally unroutable, NOT contested
+    tab = temporal.table_from_records(recs)
+
+    class _Src:
+        def __init__(self):
+            self.key_fn = key
+
+        def read_records(self):
+            return tab
+
+    opaque = [FeatureBuilder.Real("o")
+              .extract(lambda r: r["amount"], "amount")
+              .aggregate(None).as_predictor()]
+    AggregateReader(_Src(), ts, CutOffTime.no_cutoff(),
+                    key_fn=key).generate_store(opaque)
+    assert not temporal.last_route_contested()
+    db = planner.CostDatabase()
+    planner.drain_phase_observations(db)
+    assert db.stage_cost("phase:temporal.route_aggregate",
+                         "rowwise") is None
+
+
+def test_tmg405_contradiction_advisory(tmp_path, monkeypatch):
+    """An explicit aggregateColumnar knob that contradicts the measured
+    tier surfaces as a TMG405 warning from the runner's plan step, and
+    the measured hint is installed for the run."""
+    from transmogrifai_tpu import lint, planner, temporal
+    from transmogrifai_tpu.runner import OpParams, OpWorkflowRunner
+
+    db_path = tmp_path / "cache" / "tmog_cost_db.json"
+    db = planner.CostDatabase(path=str(db_path))
+    db.record_stage("phase:temporal.route_aggregate", "columnar", 2.0,
+                    1_000)
+    db.record_stage("phase:temporal.route_aggregate", "rowwise", 0.2,
+                    1_000)
+    db.save()
+    assert planner.aggregate_route_tier(db) == "rowwise"
+
+    from transmogrifai_tpu import FeatureBuilder, Workflow
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import (
+        BinaryClassificationModelSelector)
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    vec = transmogrify([fx])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()],
+        splitter=None, seed=5)
+    pred = label.transform_with(sel, vec)
+    wf = Workflow().set_result_features(pred)
+    runner = OpWorkflowRunner(workflow=wf)
+    params = OpParams(custom_params={
+        "compileCacheDir": str(tmp_path / "cache"),
+        "aggregateColumnar": True})
+    emitted = []
+    monkeypatch.setattr(lint, "emit_findings",
+                        lambda fs: emitted.extend(fs))
+    prev_hint = temporal.aggregate_tier_hint()
+    try:
+        plan = runner._plan_step(params, workflow=wf)
+        assert plan is not None
+        assert temporal.aggregate_tier_hint() == "rowwise"
+        assert any(f.rule == "TMG405" for f in emitted)
+        assert plan.to_json()["tiers"]["aggregate"] == "rowwise"
+    finally:
+        temporal.set_aggregate_tier_hint(prev_hint)
+
+
+# ---------------------------------------------------------------------------
+# satellite: TMG312 self-lint fixtures
+# ---------------------------------------------------------------------------
+
+
+def _load_tmoglint():
+    import importlib.util
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "tmoglint", os.path.join(repo, "tools", "tmoglint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tmg312_ungated_pallas_call_flagged_and_allowlisted():
+    tm = _load_tmoglint()
+    bad = ("from jax.experimental import pallas as pl\n"
+           "out = pl.pallas_call(kern, out_shape=s)(x)\n")
+    assert [f.rule for f in tm.lint_source(bad, "models/foo.py")] \
+        == ["TMG312"]
+    bad2 = ("import jax.experimental.pallas as pl\n"
+            "out = pl.pallas_call(kern, out_shape=s)(x)\n")
+    assert [f.rule for f in tm.lint_source(bad2, "scoring.py")] \
+        == ["TMG312"]
+    from_import = ("from jax.experimental.pallas import pallas_call\n"
+                   "out = pallas_call(kern, out_shape=s)(x)\n")
+    assert [f.rule for f in tm.lint_source(from_import, "x.py")] \
+        == ["TMG312"]
+    dotted = ("import jax.experimental.pallas\n"
+              "out = jax.experimental.pallas.pallas_call(k, out_shape=s)"
+              "(x)\n")
+    assert [f.rule for f in tm.lint_source(dotted, "x.py")] == ["TMG312"]
+    home = ("from jax.experimental import pallas as pl\n"
+            "out = pl.pallas_call(kern, out_shape=s)(x)\n")
+    assert tm.lint_source(home, "models/_pallas_hist.py") == []
+    allowed = ("from jax.experimental import pallas as pl\n"
+               "out = pl.pallas_call(k, out_shape=s)(x)"
+               "  # lint: pallas — probe-gated at the callsite\n")
+    assert tm.lint_source(allowed, "models/foo.py") == []
+    tests_ok = ("from jax.experimental import pallas as pl\n"
+                "out = pl.pallas_call(kern, out_shape=s)(x)\n")
+    assert tm.lint_source(tests_ok, "tests/test_foo.py") == []
+
+
+def test_tmg312_and_tmg405_in_rules_catalog():
+    from transmogrifai_tpu import lint
+    assert lint.RULES["TMG312"][0] == "error"
+    assert lint.RULES["TMG405"][0] == "warning"
+
+
+def test_tree_kernel_stats_shape():
+    st = ph.tree_kernel_stats()
+    for k in ("cumhist_traces", "sparse01_traces", "split_scan_traces",
+              "sharded_hist_traces", "kernel_disables", "gate",
+              "sparse01", "split_scan"):
+        assert k in st

@@ -64,6 +64,13 @@ here as rules (the TMG3xx family of the catalog in
   Only calls attributable to numpy (``import numpy as np`` aliases /
   ``from numpy import argsort``) are checked; ``jnp`` is exempt (jax
   sorts are stable by construction).
+* **TMG312** — ``pl.pallas_call(...)`` appears only in
+  ``models/_pallas_hist.py`` (the tree-engine rule: every kernel lives
+  behind that module's one-time compile probe and
+  ``with_pallas_fallback`` retrace-onto-XLA discipline — a kernel
+  elsewhere has NO fallback, so a Mosaic rejection at production shapes
+  fails an hours-long fit instead of degrading). Tests are exempt; a
+  deliberately un-gated kernel carries ``# lint: pallas — reason``.
 
 Runs as a CLI over one or more paths (default: the ``transmogrifai_tpu``
 package next to this script) and as a tier-1 pytest
@@ -91,7 +98,7 @@ from transmogrifai_tpu.lint import Finding, Severity, enforce  # noqa: E402
 __all__ = ["lint_source", "lint_file", "lint_paths", "main",
            "ALLOW_WALLCLOCK", "ALLOW_BROAD_EXCEPT", "ALLOW_EXPLICIT_MESH",
            "ALLOW_THREAD", "ALLOW_UNBOUNDED_QUEUE", "ALLOW_POPEN",
-           "ALLOW_THREAD_LOOP", "ALLOW_SORT"]
+           "ALLOW_THREAD_LOOP", "ALLOW_SORT", "ALLOW_PALLAS"]
 
 #: suppression markers, checked on the finding's own source line
 ALLOW_WALLCLOCK = "lint: wall-clock"
@@ -102,6 +109,11 @@ ALLOW_UNBOUNDED_QUEUE = "lint: unbounded-queue"
 ALLOW_POPEN = "lint: popen"
 ALLOW_THREAD_LOOP = "lint: thread-loop"
 ALLOW_SORT = "lint: sort"
+ALLOW_PALLAS = "lint: pallas"
+
+#: the ONE module sanctioned to host pl.pallas_call sites (TMG312): its
+#: probe/fallback gate is what makes a Mosaic rejection survivable
+PALLAS_HOME = "_pallas_hist.py"
 
 
 def _fault_sites() -> frozenset:
@@ -136,6 +148,8 @@ class _Visitor(ast.NodeVisitor):
         self.popen_funcs: Set[str] = set()       # from subprocess import Popen
         self.numpy_modules: Set[str] = set()
         self.np_sort_funcs: Dict[str, str] = {}  # from numpy import argsort
+        self.pallas_modules: Set[str] = set()
+        self.pallas_call_funcs: Set[str] = set()
         self.with_contexts: Set[int] = set()
         #: TMG310 bookkeeping: names used as Thread(target=...) and the
         #: module's function defs by name (methods included; resolved in
@@ -147,6 +161,11 @@ class _Visitor(ast.NodeVisitor):
         parts = os.path.normpath(path).split(os.sep)
         self.mesh_exempt = ("parallel" in parts or "tests" in parts
                             or os.path.basename(path).startswith("test_"))
+        #: _pallas_hist.py owns kernel construction (its probe/fallback
+        #: gate is the rule's point); tests may build throwaway kernels
+        self.pallas_exempt = (os.path.basename(path) == PALLAS_HOME
+                              or "tests" in parts
+                              or os.path.basename(path).startswith("test_"))
 
     # -- helpers -----------------------------------------------------------
     def _marked(self, lineno: int, marker: str) -> bool:
@@ -180,6 +199,11 @@ class _Visitor(ast.NodeVisitor):
                 self.subprocess_modules.add(local)
             if alias.name == "numpy":
                 self.numpy_modules.add(local)
+            if alias.name == "jax.experimental.pallas" and alias.asname:
+                # no-asname dotted imports bind only "jax" locally; the
+                # call form jax.experimental.pallas.pallas_call(...) is
+                # matched as a dotted chain in _is_pallas_call instead
+                self.pallas_modules.add(local)
         self.generic_visit(node)
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
@@ -209,6 +233,10 @@ class _Visitor(ast.NodeVisitor):
             if mod == "numpy" and alias.name in ("argsort",
                                                  "searchsorted"):
                 self.np_sort_funcs[local] = alias.name
+            if mod == "jax.experimental" and alias.name == "pallas":
+                self.pallas_modules.add(local)
+            if mod.endswith("pallas") and alias.name == "pallas_call":
+                self.pallas_call_funcs.add(local)
         self.generic_visit(node)
 
     # -- function defs: TMG310 target resolution ---------------------------
@@ -302,6 +330,28 @@ class _Visitor(ast.NodeVisitor):
                 and f.value.id in self.subprocess_modules:
             return True
         return isinstance(f, ast.Name) and f.id in self.popen_funcs
+
+    @staticmethod
+    def _dotted(node) -> Optional[str]:
+        """'a.b.c' for a Name/Attribute chain, else None."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+
+    def _is_pallas_call(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "pallas_call":
+            if isinstance(f.value, ast.Name) \
+                    and f.value.id in self.pallas_modules:
+                return True
+            # the unaliased dotted form: jax.experimental.pallas.pallas_call
+            return self._dotted(f.value) == "jax.experimental.pallas"
+        return isinstance(f, ast.Name) and f.id in self.pallas_call_funcs
 
     def _np_sort_kind(self, node: ast.Call) -> Optional[str]:
         """\"argsort\"/\"searchsorted\" when the call is attributable to
@@ -430,6 +480,17 @@ class _Visitor(ast.NodeVisitor):
                     "fills; a supervisor must own its workers' "
                     "streams (or mark a deliberate inherit "
                     f"'# {ALLOW_POPEN} — <reason>')")
+        elif self._is_pallas_call(node) and not self.pallas_exempt \
+                and not self._marked(node.lineno, ALLOW_PALLAS):
+            self._add(
+                "TMG312", node.lineno,
+                "pl.pallas_call() outside models/_pallas_hist.py — "
+                "kernels live behind that module's probe/fallback gate "
+                "(pallas_histograms_enabled / with_pallas_fallback): a "
+                "kernel elsewhere has no retrace-onto-XLA fallback, so "
+                "a Mosaic rejection at production shapes fails the fit "
+                "instead of degrading; move it (or mark a deliberately "
+                f"un-gated kernel '# {ALLOW_PALLAS} — <reason>')")
         else:
             sort_kind = self._np_sort_kind(node)
             if sort_kind is not None \

@@ -162,6 +162,13 @@ RULES: Dict[str, Tuple[str, str]] = {
                "silently change under unstable sort ties, and an "
                "implicit side= hides which boundary a temporal window "
                "includes (allow: '# lint: sort — reason')"),
+    "TMG312": (Severity.ERROR,
+               "pl.pallas_call() outside models/_pallas_hist.py — every "
+               "kernel must live behind that module's probe/fallback "
+               "gate (pallas_histograms_enabled / with_pallas_fallback) "
+               "or a Mosaic rejection at production shapes fails the "
+               "run instead of retracing onto the XLA path (allow: "
+               "'# lint: pallas — reason')"),
     # -- TMG5xx: serving / AOT-bank advisories (aot.py, serving.py,
     #    server.py) — degradation notices, never crash paths ---------------
     "TMG501": (Severity.WARNING,
@@ -223,6 +230,10 @@ RULES: Dict[str, Tuple[str, str]] = {
     "TMG404": (Severity.WARNING,
                "cost database unreadable (corrupt/truncated JSON) — "
                "static fallback estimates are in force"),
+    "TMG405": (Severity.WARNING,
+               "explicit aggregateColumnar route contradicts the cost "
+               "database's measured columnar-vs-rowwise aggregation "
+               "tier — the knob wins, the measurement says otherwise"),
 }
 
 

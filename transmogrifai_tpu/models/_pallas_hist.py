@@ -54,8 +54,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["cumhist", "route_level", "pallas_histograms_enabled",
-           "ROW_ALIGN"]
+__all__ = ["cumhist", "route_level", "split_scan", "split_scan_ok",
+           "pallas_histograms_enabled", "sparse01_enabled",
+           "split_scan_enabled", "tree_kernel_stats", "ROW_ALIGN"]
 
 import threading as _threading
 
@@ -63,6 +64,54 @@ _PROBE: Optional[bool] = None
 #: created at import — a lazy check-then-assign could hand two racing
 #: threads two different locks, defeating the double-compile guard
 _PROBE_LOCK = _threading.Lock()
+
+#: always-on tree-kernel tallies (the ``fitstats_stats`` discipline):
+#: TRACE-time routing decisions — how many times each kernel family was
+#: staged into a compiled program, how many histogram builds went through
+#: the mesh-sharded shard_map wrapper, and whether the gate ever flipped
+#: off mid-process. Stamped on every runner metrics doc and every bench
+#: doc under ``trees`` (docs/observability.md).
+_TK_LOCK = _threading.Lock()
+_TK = {"cumhist_traces": 0, "sparse01_traces": 0, "split_scan_traces": 0,
+       "route_traces": 0, "predict_traces": 0, "sharded_hist_traces": 0,
+       "sharded_route_traces": 0, "kernel_disables": 0}
+
+
+def _tk_tally(key: str, n: int = 1) -> None:
+    with _TK_LOCK:
+        _TK[key] += n
+
+
+def tree_kernel_stats() -> dict:
+    """Snapshot of the tree-engine kernel tallies plus the effective
+    gate states — always on, cheap, stamped on every bench/runner doc."""
+    env = os.environ.get("TMOG_PALLAS", "").strip()
+    gate = {"1": "forced_on", "0": "forced_off"}.get(
+        env, "on" if _PROBE else ("off" if _PROBE is False else "unprobed"))
+    with _TK_LOCK:
+        out = dict(_TK)
+    out["gate"] = gate
+    out["sparse01"] = sparse01_enabled()
+    out["split_scan"] = split_scan_enabled()
+    return out
+
+
+def sparse01_enabled() -> bool:
+    """Gate for the sparsity-aware 2-bin histogram kernel (the
+    wide-sparse path): indicator blocks stream the [F, n] bin matrix
+    itself instead of a 2×-wider dense ``bin ≤ t`` operand, computing the
+    zero-bin column as (per-slot total − nonzero side). Counts stay
+    exact; float-weighted channels pick up subtraction-order rounding
+    (the TMOG_SIBLING trade). ``TMOG_SPARSE01=0`` disables."""
+    return os.environ.get("TMOG_SPARSE01", "1") != "0"
+
+
+def split_scan_enabled() -> bool:
+    """Gate for the fused split-scan kernel (cumulative-histogram →
+    per-slot best (feature, threshold) in one VMEM pass). Rides the same
+    probe/fallback as ``cumhist`` — ``TMOG_SPLIT_SCAN=0`` keeps the
+    histogram kernel while the scan stays on the XLA path."""
+    return os.environ.get("TMOG_SPLIT_SCAN", "1") != "0"
 
 
 def warm_probe_async() -> None:
@@ -167,6 +216,45 @@ def _kernel_prebc(bc_ref, pack_ref, o_ref, *, n_nodes, n_chan, mm_dtype):
             preferred_element_type=o_ref.dtype)
 
 
+def _kernel_sparse01(xbt_ref, pack_ref, o_ref, *, n_nodes, n_chan,
+                     mm_dtype):
+    """Sparsity-aware 2-bin histogram (the wide-sparse path): for an
+    indicator block the ``bin ≤ t`` operand is redundant — the t=0 (zero
+    side) column is (per-slot total − nonzero side) and the t=1 column IS
+    the per-slot total, so the kernel streams the [Fc, bnl] 0/1 bin
+    matrix itself (half the generic kernel's [2·Fc, bnl] indicator
+    traffic) and runs ONE dot per channel instead of a 2×-wider one.
+    High-cardinality OneHot/text-hash matrices are mostly zero and
+    mostly one-bin (PAPER.md §L2), which is exactly this block shape.
+
+    Output layout matches ``_kernel`` at B=2: [C·A, 2·Fc] with (t, f)
+    t-major columns — cols [:Fc] the cumulative zero-bin, [Fc:] totals.
+    """
+    rb = pl.program_id(1)
+
+    @pl.when(rb == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    Fc, bnl = xbt_ref.shape
+    A = n_nodes
+    node = pack_ref[0, :].astype(jnp.int32)
+    ohT = (node[None, :] ==
+           lax.broadcasted_iota(jnp.int32, (A, bnl), 0)
+           ).astype(jnp.float32).astype(o_ref.dtype)        # [A, bnl]
+    xT = xbt_ref[:].astype(jnp.float32).astype(mm_dtype)    # [Fc, bnl] 0/1
+    for c in range(n_chan):
+        ohcT = (ohT * pack_ref[1 + c, :][None, :]).astype(mm_dtype)
+        nz = lax.dot_general(
+            ohcT, xT, (((1,), (1,)), ((), ())),
+            preferred_element_type=o_ref.dtype)             # [A, Fc]
+        tot = jnp.sum(ohcT.astype(o_ref.dtype), axis=1,
+                      keepdims=True)                        # [A, 1]
+        o_ref[c * A:(c + 1) * A, 0:Fc] += tot - nz
+        o_ref[c * A:(c + 1) * A, Fc:2 * Fc] += jnp.broadcast_to(
+            tot, (A, Fc))
+
+
 def make_bc(XbT: jnp.ndarray, n_bins: int, dtype) -> jnp.ndarray:
     """[F, n] bins → [B·F, n] lower-triangular bin indicator (sublane
     i = t·F + f ⇒ bin[f] ≤ t), the precomputed operand for
@@ -193,7 +281,8 @@ def bc_cache_ok(n: int, F: int, n_bins: int,
 def cumhist(stats: jnp.ndarray, node: jnp.ndarray, XbT: jnp.ndarray,
             n_nodes: int, n_bins: int, *, block_lanes: int = ROW_ALIGN,
             max_sub: int = 1024, interpret: Optional[bool] = None,
-            bc: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+            bc: Optional[jnp.ndarray] = None,
+            sparse01: bool = False) -> jnp.ndarray:
     """[n, C] stats + [n] node slots + [F, n] TRANSPOSED bins →
     [A, C, B, F] cumulative histograms (idle rows: node == n_nodes →
     zero). Drop-in replacement for the XLA matmul path in
@@ -201,7 +290,11 @@ def cumhist(stats: jnp.ndarray, node: jnp.ndarray, XbT: jnp.ndarray,
 
     Per-row operands enter as a [1+C, n] f32 pack and the bin matrix
     feature-major — both lane-compact (see ROW_ALIGN). Callers at scale
-    pre-pad rows (device_prep); unaligned small-n calls pad here."""
+    pre-pad rows (device_prep); unaligned small-n calls pad here.
+
+    ``sparse01`` — the block is a 2-bin indicator block whose bin values
+    are all in {0, 1}: route through :func:`_kernel_sparse01` (half the
+    operand traffic, one dot per channel; ``bc`` is ignored)."""
     F, n = XbT.shape
     C = stats.shape[1]
     if interpret is None:
@@ -214,6 +307,37 @@ def cumhist(stats: jnp.ndarray, node: jnp.ndarray, XbT: jnp.ndarray,
         [_pad_lanes(node[None, :].astype(stats.dtype), n_pad, n_nodes),
          _pad_lanes(stats.T, n_pad, 0)])                   # [1+C, n_pad]
     mm_dtype = jnp.bfloat16 if stats.dtype == jnp.float32 else stats.dtype
+    if sparse01:
+        if n_bins != 2:
+            raise ValueError(
+                f"cumhist(sparse01=True) needs a 2-bin block, got "
+                f"n_bins={n_bins}")
+        _tk_tally("sparse01_traces")
+        XbT = _pad_lanes(XbT, n_pad, 0)     # pad bins 0 → zero side; the
+        if F_pad != F:                      # pack's zero stats keep pads
+            XbT = jnp.concatenate(          # out of every histogram
+                [XbT, jnp.zeros((F_pad - F, n_pad), XbT.dtype)])
+        kern = functools.partial(_kernel_sparse01, n_nodes=n_nodes,
+                                 n_chan=C, mm_dtype=mm_dtype)
+        nfb = F_pad // Fc
+        out = pl.pallas_call(
+            kern,
+            grid=(nfb, n_pad // bnl),                      # rows fastest
+            in_specs=[
+                pl.BlockSpec((Fc, bnl), lambda fb, rb: (fb, rb)),
+                pl.BlockSpec((1 + C, bnl), lambda fb, rb: (0, rb)),
+            ],
+            out_specs=pl.BlockSpec((C * n_nodes, 2 * Fc),
+                                   lambda fb, rb: (0, fb)),
+            out_shape=jax.ShapeDtypeStruct((C * n_nodes, nfb * 2 * Fc),
+                                           stats.dtype),
+            interpret=interpret,
+        )(XbT, pack)
+        out = out.reshape(C, n_nodes, nfb, 2, Fc)
+        out = out.transpose(1, 0, 3, 2, 4).reshape(
+            n_nodes, C, 2, F_pad)
+        return out[..., :F]
+    _tk_tally("cumhist_traces")
     if bc is not None and F_pad == F:
         # precomputed-indicator path (see _kernel_prebc / make_bc)
         bc = _pad_lanes(bc, n_pad, 0)
@@ -327,6 +451,7 @@ def route_level(XbT: jnp.ndarray, slot: jnp.ndarray, g: jnp.ndarray,
     """(slot, g) → (slot', g') for one tree level over [F, n] transposed
     bins (see ``_route_kernel``). slot/g values stay exact in f32 (< 2^24:
     slots ≤ 128, g < 2^maxdepth)."""
+    _tk_tally("route_traces")
     F, n = XbT.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -365,6 +490,184 @@ def route_level(XbT: jnp.ndarray, slot: jnp.ndarray, g: jnp.ndarray,
         interpret=interpret,
     )(XbT, pack, tab)
     return (out[0, :n].astype(jnp.int32), out[1, :n].astype(jnp.int32))
+
+
+#: masked-out candidate score — must sort below every real score (the
+#: criteria are sums of squares / squared-over-positive terms, all ≥ 0)
+SPLIT_NEG = -1e30
+#: "no candidate yet" flat index for the cross-block merge; real flat
+#: indices are gated < 2^24 (exact in f32) by split_scan_ok
+_SPLIT_IDX_BIG = float(1 << 25)
+
+
+def _scan_kernel(cumT_ref, mask_ref, pars_ref, o_ref, *, kind, n_bins,
+                 n_chan, Fc, F_total):
+    """Fused split scan over one feature block of the cumulative
+    histogram (see :func:`split_scan` for the contract).
+
+    The XLA alternative materializes ~6 [A, B−1, F] HBM tensors per
+    level (criterion score, instance/hessian masks, the _NEG-masked flat
+    matrix, argmax companions) and runs as a serialized chain of small
+    elementwise ops — the residual `%while` body cost once the histogram
+    itself is a kernel. Here the whole chain (score → masks → argmax
+    with first-occurrence tie-break → winner validity) runs on VPU tiles
+    with only the [C·B, A, Fc] histogram block streamed in and an [A, 8]
+    pack out.
+
+    Layout: slots in sublanes, features in lanes — [A, Fc] tiles per
+    (channel, bin), the bin loop statically unrolled (B ≤ 32). Grid =
+    feature blocks; the output pack is revisited and merged with the
+    (score desc, flat idx asc) tie rule, which reproduces
+    ``jnp.argmax``'s first-occurrence semantics over the t-major flat
+    candidate axis exactly.
+    """
+    fb = pl.program_id(0)
+    B, C = n_bins, n_chan
+    A = mask_ref.shape[0]
+    dt = o_ref.dtype
+    neg = jnp.asarray(SPLIT_NEG, dt)
+    big = jnp.asarray(_SPLIT_IDX_BIG, dt)
+    eps = jnp.asarray(1e-12, dt)        # _treefit._EPS
+    pmin = pars_ref[0, 0:1]             # min_instances      [1]
+    pmcw = pars_ref[0, 1:2]             # min_child_weight   [1]
+    plam = pars_ref[0, 2:3]             # xgb lambda         [1]
+
+    def ch(c, t):                       # [A, Fc] channel/bin tile
+        return cumT_ref[c * B + t]
+
+    @pl.when(fb == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+        o_ref[:, 0:1] = jnp.full((A, 1), neg, dt)
+        o_ref[:, 1:2] = jnp.full((A, 1), big, dt)
+
+    fio = lax.broadcasted_iota(jnp.int32, (A, Fc), 1) + fb * Fc
+    mask_ok = mask_ref[:] > 0.5
+    best_s = jnp.full((A, Fc), neg, dt)
+    best_i = jnp.zeros((A, Fc), dt)
+    best_ok = jnp.zeros((A, Fc), dt)
+    for t in range(B - 1):
+        if kind == "variance":
+            # VarianceCriterion.score: channels (w, w·y, …, count)
+            wL, wT = ch(0, t), ch(0, B - 1)
+            sL, sT = ch(1, t), ch(1, B - 1)
+            sR, wR = sT - sL, wT - wL
+            sb = sL * sL / jnp.maximum(wL, eps) \
+                + sR * sR / jnp.maximum(wR, eps)
+        elif kind == "gini":
+            # GiniCriterion.score: channels (class weights …, count)
+            wL = jnp.zeros((A, Fc), dt)
+            wR = jnp.zeros((A, Fc), dt)
+            l2 = jnp.zeros((A, Fc), dt)
+            r2 = jnp.zeros((A, Fc), dt)
+            for k in range(C - 1):
+                lk, tk = ch(k, t), ch(k, B - 1)
+                rk = tk - lk
+                wL, wR = wL + lk, wR + rk
+                l2, r2 = l2 + lk * lk, r2 + rk * rk
+            sb = l2 / jnp.maximum(wL, eps) + r2 / jnp.maximum(wR, eps)
+        else:                           # "xgb": channels (g, h, count)
+            gL, gT = ch(0, t), ch(0, B - 1)
+            hL, hT = ch(1, t), ch(1, B - 1)
+            gR, hR = gT - gL, hT - hL
+            sb = gL * gL / (hL + plam + eps) \
+                + gR * gR / (hR + plam + eps)
+        lc, tc = ch(C - 1, t), ch(C - 1, B - 1)
+        okb = (lc >= pmin) & (tc - lc >= pmin) & mask_ok
+        if kind == "xgb":
+            hL, hT = ch(1, t), ch(1, B - 1)
+            okb = okb & (hL >= pmcw) & (hT - hL >= pmcw)
+        okf = okb.astype(dt)
+        sb_m = jnp.where(okb, sb, neg)
+        flat = (jnp.asarray(t * F_total, dt)
+                + fio.astype(dt))       # global t-major candidate index
+        better = sb_m > best_s          # strict: earlier t wins ties
+        best_i = jnp.where(better, flat, best_i)
+        best_ok = jnp.where(better, okf, best_ok)
+        best_s = jnp.where(better, sb_m, best_s)
+    m = jnp.max(best_s, axis=1, keepdims=True)             # [A, 1]
+    idx = jnp.min(jnp.where(best_s == m, best_i, big), axis=1,
+                  keepdims=True)
+    sel = (best_i == idx) & (best_s == m)
+    vld = jnp.max(jnp.where(sel, best_ok, jnp.zeros_like(best_ok)),
+                  axis=1, keepdims=True)
+    prev_s = o_ref[:, 0:1]
+    prev_i = o_ref[:, 1:2]
+    prev_v = o_ref[:, 2:3]
+    take = (m > prev_s) | ((m == prev_s) & (idx < prev_i))
+    o_ref[:, 0:1] = jnp.where(take, m, prev_s)
+    o_ref[:, 1:2] = jnp.where(take, idx, prev_i)
+    o_ref[:, 2:3] = jnp.where(take, vld, prev_v)
+
+
+#: candidate indices travel in f32 lanes — exact only below 2^24
+SPLIT_SCAN_MAX_CANDIDATES = 1 << 24
+
+
+def split_scan_ok(n_nodes: int, n_bins: int, n_feat: int) -> bool:
+    """Gate for the fused split-scan kernel on one histogram block."""
+    return (split_scan_enabled()
+            and (n_bins - 1) * n_feat < SPLIT_SCAN_MAX_CANDIDATES
+            and n_nodes <= 1024)
+
+
+def split_scan(cum: jnp.ndarray, kind: str, min_instances, *,
+               lam: float = 0.0, min_child_weight=None,
+               mask: Optional[jnp.ndarray] = None,
+               interpret: Optional[bool] = None):
+    """Fused cumulative-sum→gain→argmax over one histogram block:
+    [A, C, B, F] cumulative histograms → per-slot
+    ``(best_score [A], best_flat_idx [A] int32, valid [A] bool)`` where
+    the flat candidate axis is the t-major ``t·F + f`` order the XLA
+    path's ``reshape(A, -1)`` + ``argmax`` walks, ``best_score`` is the
+    criterion's monotone surrogate (``crit.score``) masked to ``_NEG``
+    exactly as the XLA path masks it, and ``valid`` is the winner's
+    min-instances/hessian/feature-mask admissibility.
+
+    ``kind`` ∈ {"variance", "gini", "xgb"} selects the inlined criterion
+    (channel layouts per ``_treefit``'s criteria classes).
+    ``min_instances`` / ``min_child_weight`` may be traced scalars (grid
+    hyperparameters); ``lam`` is static. ``mask`` [A, F] (0/1) carries
+    the feature/per-node candidate masks; None means all-allowed."""
+    A, C, B, F = cum.shape
+    _tk_tally("split_scan_traces")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    dt = cum.dtype
+    # slots→sublanes, features→lanes: [C·B, A, F]
+    cumT = cum.transpose(1, 2, 0, 3).reshape(C * B, A, F)
+    itemsize = jnp.dtype(dt).itemsize
+    Fc = int(max(1, min(F, (4 << 20) // max(C * B * A * itemsize, 1))))
+    F_pad = _round_up(F, Fc)
+    if mask is None:
+        mask = jnp.ones((A, F), dt)
+    else:
+        mask = mask.astype(dt)
+    if F_pad != F:                      # padded features masked out
+        cumT = jnp.concatenate(
+            [cumT, jnp.zeros((C * B, A, F_pad - F), dt)], axis=2)
+        mask = jnp.concatenate(
+            [mask, jnp.zeros((A, F_pad - F), dt)], axis=1)
+    mcw = (jnp.asarray(0.0, dt) if min_child_weight is None
+           else jnp.asarray(min_child_weight, dt))
+    pars = jnp.stack([jnp.asarray(min_instances, dt), mcw,
+                      jnp.asarray(lam, dt),
+                      jnp.zeros((), dt)]).reshape(1, 4)
+    kern = functools.partial(_scan_kernel, kind=kind, n_bins=B,
+                             n_chan=C, Fc=Fc, F_total=F)
+    out = pl.pallas_call(
+        kern,
+        grid=(F_pad // Fc,),
+        in_specs=[
+            pl.BlockSpec((C * B, A, Fc), lambda fb: (0, 0, fb)),
+            pl.BlockSpec((A, Fc), lambda fb: (0, fb)),
+            pl.BlockSpec((1, 4), lambda fb: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((A, 8), lambda fb: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((A, 8), dt),
+        interpret=interpret,
+    )(cumT, mask, pars)
+    return out[:, 0], out[:, 1].astype(jnp.int32), out[:, 2] > 0.5
 
 
 def _predict_kernel(xt_ref, feat_ref, thr_ref, leaf_ref, o_ref, *,
@@ -441,6 +744,7 @@ def predict_trees(X, feat, thr, leaf_w, max_depth: int, *,
     """[n, F] raw rows through [T, 2^D−1] stacked trees → [n, K] summed
     (tree-weight-scaled) leaf values. See ``_predict_kernel``; callers
     gate on ``predict_kernel_ok``."""
+    _tk_tally("predict_traces")
     n, F = X.shape
     T, NN = feat.shape
     K = leaf_w.shape[-1]
@@ -523,6 +827,7 @@ def disable_pallas_histograms(exc: BaseException) -> bool:
     logger.warning(msg)
     warnings.warn(msg)
     _PROBE = False
+    _tk_tally("kernel_disables")
     return True
 
 

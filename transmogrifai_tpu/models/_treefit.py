@@ -33,6 +33,7 @@ Tree layout: level-order arrays ``feat``/``thr`` of length 2^D − 1 and
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Callable, Optional, Tuple
 
@@ -46,6 +47,100 @@ _NEG = -1e30
 
 
 # ---------------------------------------------------------------------------
+# Mesh threading (the histogram-allreduce analog: Rabit → psum)
+# ---------------------------------------------------------------------------
+
+#: the (data, grid) mesh the tree engine's kernel dispatches shard over —
+#: a module global (not thread-local) because the CV engine TRACES its
+#: fused programs on ThreadPoolExecutor workers while the scope is held
+#: by the dispatching thread. Consumers read it at trace time only.
+_TREE_MESH = [None]
+
+
+@contextlib.contextmanager
+def tree_mesh_scope(mesh):
+    """Install ``mesh`` as the tree engine's sharding substrate for the
+    duration of the block (trace-time: every ``grow_tree`` traced inside
+    consults it). The degenerate 1-device mesh — and ``None``/``False``
+    — resolve to "no sharding", so the single-device trace is EXACTLY
+    the pre-mesh program (the PR 6 discipline). Re-entrant; the previous
+    scope is restored on exit. Two concurrent validates installing
+    DIFFERENT meshes would race — the runner serializes runs, and the
+    compiled-executable caches key on the mesh topology anyway."""
+    from ..parallel.mesh import mesh_if_multi
+    prev = _TREE_MESH[0]
+    _TREE_MESH[0] = mesh_if_multi(mesh)
+    try:
+        yield
+    finally:
+        _TREE_MESH[0] = prev
+
+
+def active_tree_mesh():
+    """The mesh installed by :func:`tree_mesh_scope`, or None (already
+    ``mesh_if_multi``-normalized: never a 1-device mesh)."""
+    return _TREE_MESH[0]
+
+
+def _sharded_cumhist(mesh, stats, node, XbT, n_nodes, n_bins, *,
+                     bc=None, sparse01=False):
+    """Data-parallel histogram build over the mesh ``data`` axis: each
+    shard streams ITS rows through the Pallas ``cumhist`` kernel and the
+    per-shard partial histograms merge with one ``psum`` — histograms
+    are monoids, so the merged result equals the single-device pass
+    (exactly, for the integer count channels; weighted channels see the
+    same f32 partial-sum algebra GSPMD gives the XLA matmul path). This
+    is the xgboost4j/Rabit histogram allreduce as a collective the
+    compiler schedules over ICI (_treefit module docstring, PAPER.md
+    §L0/L4), and the reason tree fits scale with the mesh instead of
+    replicating the kernel's operands to every chip (GSPMD cannot
+    partition a custom call it cannot see into)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ._pallas_hist import _tk_tally, cumhist
+    _tk_tally("sharded_hist_traces")
+    in_specs = [P("data", None), P("data"), P(None, "data")]
+    args = [stats, node, XbT]
+    if bc is not None:
+        in_specs.append(P(None, "data"))
+        args.append(bc)
+
+    def body(st, nd, xb, *rest):
+        h = cumhist(st, nd, xb, n_nodes, n_bins,
+                    bc=(rest[0] if rest else None), sparse01=sparse01)
+        return lax.psum(h, "data")
+
+    return shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                     out_specs=P(None, None, None, None),
+                     check_rep=False)(*args)
+
+
+def _sharded_route_level(mesh, XbT, slot, g, f_idx, t_idx, lchild,
+                         rchild, do_split, A_parent, A_child):
+    """Row-sharded level routing: the per-row (slot, g) update streams
+    each shard's rows through the Pallas ``route_level`` kernel; the
+    split tables (tiny, post-psum ⇒ replicated) broadcast. Outputs stay
+    row-sharded — routing state never leaves its shard."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ._pallas_hist import _tk_tally, route_level
+    _tk_tally("sharded_route_traces")
+
+    def body(xb, sl, gg, fi, ti, lc, rc, ds):
+        return route_level(xb, sl, gg, fi, ti, lc, rc, ds,
+                           A_parent, A_child)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, "data"), P("data"), P("data"),
+                  P(), P(), P(), P(), P()),
+        out_specs=(P("data"), P("data")), check_rep=False,
+    )(XbT, slot, g, f_idx, t_idx, lchild, rchild, do_split)
+
+
+# ---------------------------------------------------------------------------
 # Binning
 # ---------------------------------------------------------------------------
 
@@ -55,15 +150,31 @@ _NEG = -1e30
 QUANTILE_SAMPLE_ROWS = 262_144
 
 
+#: fixed key for the quantile-sketch row permutation: the subsample must
+#: be deterministic per row count (compiled-executable reuse) but must
+#: not depend on row ORDER
+_QUANTILE_SEED = 0x51EED
+
+
 def quantile_bin_edges(X: jnp.ndarray, n_bins: int) -> jnp.ndarray:
     """Per-feature interior quantile edges → [F, n_bins - 1].
 
-    Edges come from a strided row subsample beyond QUANTILE_SAMPLE_ROWS
-    (deterministic, jit-static stride)."""
+    Beyond QUANTILE_SAMPLE_ROWS the sketch uses a SEEDED-PERMUTATION
+    strided subsample (deterministic, jit-static shape): the previous
+    raw ``X[::stride]`` slice made the sketch a function of row order —
+    time-sorted or class-clustered inputs (every event-log reader emits
+    key-grouped rows) systematically over- or under-sampled parts of
+    the distribution, so the same column produced different edges
+    sorted vs shuffled. A fixed-key permutation of row indices draws
+    the same-size sample uniformly whatever the order."""
     n = X.shape[0]
     stride = max(1, -(-n // QUANTILE_SAMPLE_ROWS))
     qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
-    return jnp.quantile(X[::stride], qs, axis=0).T
+    if stride == 1:
+        return jnp.quantile(X, qs, axis=0).T
+    idx = jax.random.permutation(
+        jax.random.PRNGKey(_QUANTILE_SEED), n)[:-(-n // stride)]
+    return jnp.quantile(X[idx], qs, axis=0).T
 
 
 def binarize(X: jnp.ndarray, edges: jnp.ndarray) -> jnp.ndarray:
@@ -108,6 +219,12 @@ class VarianceCriterion:
     so argmax(gain) = argmax(sL²/wL + sR²/wR) within a node.
     """
 
+    #: inlined form in the fused split-scan kernel (_pallas_hist)
+    kernel_kind = "variance"
+
+    def kernel_params(self):
+        return 0.0, None            # (static lam, traced mcw)
+
     def score(self, cum):
         sL = cum[:, 1, :-1, :]
         wL = cum[:, 0, :-1, :]
@@ -144,6 +261,11 @@ class GiniCriterion:
          = imp(P) − 1 + [Σc lc²/wL + Σc rc²/wR]/W,
     so argmax(gain) = argmax(Σ lc²/wL + Σ rc²/wR) within a node.
     """
+
+    kernel_kind = "gini"
+
+    def kernel_params(self):
+        return 0.0, None
 
     def score(self, cum):
         cls_l = cum[:, :-1, :-1, :]                   # [A, K, B-1, F]
@@ -182,9 +304,16 @@ class XGBCriterion:
     """XGBoost gain: ½(G_L²/(H_L+λ) + G_R²/(H_R+λ) − G²/(H+λ)).
     Channels: (g, h, count). min_child_weight masks on hessian mass."""
 
+    kernel_kind = "xgb"
+
     def __init__(self, lam, min_child_weight):
         self.lam = lam
         self.min_child_weight = min_child_weight
+
+    def kernel_params(self):
+        # lam is a static family constant; min_child_weight is a traced
+        # grid hyperparameter — the kernel takes it as an operand
+        return float(self.lam), self.min_child_weight
 
     def score(self, cum):
         gL = cum[:, 0, :-1, :]
@@ -322,7 +451,8 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
     at scale). Either Xb or XbT must be given; the other orientation is
     derived only when the active path needs it.
     """
-    from ._pallas_hist import cumhist, route_level
+    from ._pallas_hist import (cumhist, route_level, split_scan,
+                               split_scan_ok)
     if prepared is None:
         prepared = prepare_blocks(Xb, XbT, edges, n_bins, col_blocks,
                                   stats.dtype)
@@ -342,6 +472,28 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
     mmd = jnp.bfloat16 if stats.dtype == jnp.float32 else stats.dtype
     total_nodes = (1 << D) - 1
     n_leaves = 1 << D
+    # mesh-sharded kernel dispatch (the tentpole): only the kernel path
+    # needs the explicit shard_map — GSPMD already partitions the XLA
+    # matmul path's contraction over a sharded batch axis, but a Pallas
+    # custom call is opaque to it, so without this the 8-device mesh ran
+    # every histogram replicated/single-device. Rows must split evenly
+    # (device_prep pads to ROW_ALIGN × data under a tree-mesh scope).
+    tmesh = active_tree_mesh() if use_pallas else None
+    if tmesh is not None and n % int(tmesh.shape["data"]) != 0:
+        tmesh = None
+    # fused split-scan kernel: one VMEM pass per (level, block) replaces
+    # the serialized XLA score/mask/argmax chain; any block outside the
+    # kernel's envelope keeps the whole level on the XLA selection path
+    # (the two paths must pick candidates over the SAME flat axis)
+    use_scan = use_pallas and all(
+        split_scan_ok(cap, nb, len(cols))
+        for cols, nb, _tf, _xb, _bc, _sp in blocks)
+
+    def block_hist(st, nd, xb, a, nb, bc, sp):
+        if tmesh is not None:
+            return _sharded_cumhist(tmesh, st, nd, xb, a, nb, bc=bc,
+                                    sparse01=sp)
+        return cumhist(st, nd, xb, a, nb, bc=bc, sparse01=sp)
 
     def level(d, A, A_next, slot, g, gpos, alive, feat, thr, gain, leafS,
               prev=None):
@@ -376,17 +528,19 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
             node_mask = None
         # per-block cumulative histograms over slots; idle (slot == A) → 0.
         # Candidate axis = concat of every block's (bins−1)·F_b pairs.
-        flats, oks, cums = [], [], []
+        flats, oks, cums, parts = [], [], [], []
+        off_b = 0
         if prev is not None:
             half = A // 2
             # left children live in the EVEN slots by construction
             # (lchild = 2·inv); everything else → dead sentinel
             node_even = jnp.where((slot < A) & (slot % 2 == 0),
                                   slot // 2, half)
-        for bi, (cols, nb, _thr_fn, Xblk, bc) in enumerate(blocks):
+        for bi, (cols, nb, _thr_fn, Xblk, bc, sp) in enumerate(blocks):
             if prev is not None:
                 if use_pallas:
-                    ev = cumhist(stats, node_even, Xblk, half, nb, bc=bc)
+                    ev = block_hist(stats, node_even, Xblk, half, nb,
+                                    bc, sp)
                 else:
                     ev = _level_cumhist(stats, node_even, Xblk, half, nb)
                 parent = prev[0][bi][prev[1]]          # [half, C, nb, Fb]
@@ -394,28 +548,69 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
                     (A,) + ev.shape[1:])               # interleave 2i/2i+1
             elif use_pallas:
                 # fused VMEM kernel over the transposed block [Fb, n]
-                cumb = cumhist(stats, slot, Xblk, A, nb, bc=bc)
+                cumb = block_hist(stats, slot, Xblk, A, nb, bc, sp)
             else:
                 cumb = _level_cumhist(stats, slot, Xblk, A, nb)
             # [A, C, nb, Fb]
-            sb = crit.score(cumb)                     # [A, nb-1, Fb]
-            lcb = cumb[:, -1, :-1, :]
-            tcb = cumb[:, -1, -1:, :]
-            okb = (lcb >= min_instances) & (tcb - lcb >= min_instances)
-            extra = crit.extra_ok(cumb)
-            if extra is not None:
-                okb = okb & extra
-            if feat_mask is not None:
-                okb = okb & feat_mask[jnp.asarray(cols)][None, None, :]
-            if node_mask is not None:
-                okb = okb & node_mask[:, jnp.asarray(cols)][:, None, :]
-            flats.append(jnp.where(okb, sb, _NEG).reshape(A, -1))
-            oks.append(okb.reshape(A, -1))
+            if use_scan:
+                # fused split scan: score+masks+argmax in one kernel
+                # pass; the feature/per-node masks combine into ONE
+                # [A, Fb] operand (tiny — the [A, B-1, Fb] expansion
+                # happens in VMEM, not HBM)
+                fb_n = len(cols)
+                mask_af = None
+                if feat_mask is not None:
+                    mask_af = jnp.broadcast_to(
+                        feat_mask[jnp.asarray(cols)][None, :],
+                        (A, fb_n)).astype(stats.dtype)
+                if node_mask is not None:
+                    nm = node_mask[:, jnp.asarray(cols)].astype(
+                        stats.dtype)
+                    mask_af = nm if mask_af is None else mask_af * nm
+                lam_s, mcw = crit.kernel_params()
+                sc_b, ix_b, ok_b = split_scan(
+                    cumb, crit.kernel_kind, min_instances, lam=lam_s,
+                    min_child_weight=mcw, mask=mask_af)
+                parts.append((off_b, sc_b, ix_b, ok_b))
+            else:
+                sb = crit.score(cumb)                 # [A, nb-1, Fb]
+                lcb = cumb[:, -1, :-1, :]
+                tcb = cumb[:, -1, -1:, :]
+                okb = (lcb >= min_instances) \
+                    & (tcb - lcb >= min_instances)
+                extra = crit.extra_ok(cumb)
+                if extra is not None:
+                    okb = okb & extra
+                if feat_mask is not None:
+                    okb = okb & feat_mask[jnp.asarray(cols)][None, None, :]
+                if node_mask is not None:
+                    okb = okb & node_mask[:, jnp.asarray(cols)][:, None, :]
+                flats.append(jnp.where(okb, sb, _NEG).reshape(A, -1))
+                oks.append(okb.reshape(A, -1))
             cums.append(cumb)
-        flat = jnp.concatenate(flats, axis=1) if len(flats) > 1 else flats[0]
-        ok_flat = jnp.concatenate(oks, axis=1) if len(oks) > 1 else oks[0]
-        best = jnp.argmax(flat, axis=1)
-        valid = jnp.take_along_axis(ok_flat, best[:, None], axis=1)[:, 0]
+            off_b += (nb - 1) * len(cols)
+        if use_scan:
+            # merge per-block winners on the SAME flat candidate axis the
+            # XLA concat+argmax walks: score desc, global flat idx asc
+            # (argmax's first-occurrence tie rule)
+            _o0, bs, bi0, bv = parts[0][0], parts[0][1], parts[0][2], \
+                parts[0][3]
+            best = _o0 + bi0
+            valid = bv
+            for o_k, s_k, i_k, v_k in parts[1:]:
+                gi = o_k + i_k
+                take = (s_k > bs) | ((s_k == bs) & (gi < best))
+                best = jnp.where(take, gi, best)
+                valid = jnp.where(take, v_k, valid)
+                bs = jnp.where(take, s_k, bs)
+        else:
+            flat = jnp.concatenate(flats, axis=1) if len(flats) > 1 \
+                else flats[0]
+            ok_flat = jnp.concatenate(oks, axis=1) if len(oks) > 1 \
+                else oks[0]
+            best = jnp.argmax(flat, axis=1)
+            valid = jnp.take_along_axis(ok_flat, best[:, None],
+                                        axis=1)[:, 0]
         # decode the winning candidate per block; exact reference gain is
         # evaluated only at the winner ([A, C] stats)
         f_idx = jnp.zeros((A,), jnp.int32)
@@ -423,7 +618,7 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
         thr_v = jnp.zeros((A,), edges.dtype)
         lstats = jnp.zeros((A, C), stats.dtype)
         off = 0
-        for (cols, nb, thr_fn, _Xblk, _bc), cumb in zip(blocks, cums):
+        for (cols, nb, thr_fn, _Xblk, _bc, _sp), cumb in zip(blocks, cums):
             fb_n = len(cols)
             size = (nb - 1) * fb_n
             inb = (best >= off) & (best < off + size)
@@ -457,9 +652,18 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
 
         if use_pallas:
             # single streamed VMEM pass (see _pallas_hist._route_kernel);
-            # the XLA alternative below materializes ~3 [n, A] tensors
-            slot2, g2 = route_level(XbT_full, slot, g, f_idx, t_idx,
-                                    lchild, rchild, do_split, A, A_next)
+            # the XLA alternative below materializes ~3 [n, A] tensors.
+            # Under a tree mesh each shard routes ITS rows (split tables
+            # are replicated post-psum) — routing state never crosses
+            # shards.
+            if tmesh is not None:
+                slot2, g2 = _sharded_route_level(
+                    tmesh, XbT_full, slot, g, f_idx, t_idx, lchild,
+                    rchild, do_split, A, A_next)
+            else:
+                slot2, g2 = route_level(XbT_full, slot, g, f_idx, t_idx,
+                                        lchild, rchild, do_split, A,
+                                        A_next)
         else:
             # gather-free sample routing: per-sample table lookups run on
             # the TPU scalar core; instead select each sample's split
@@ -702,14 +906,22 @@ def prepare_bins(X, n_bins, binary_mask=None):
 def prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, stats_dtype,
                    max_depth: Optional[int] = None):
     """(use_pallas, full matrix in the active orientation, blocks) —
-    each block is (cols, bins, thr_fn, block matrix, bc|None).
+    each block is (cols, bins, thr_fn, block matrix, bc|None, sparse01).
 
     Called ONCE per fit, OUTSIDE the tree/round scans: the precomputed
     bin indicator ``bc`` ([B·Fb, n] — see _pallas_hist.make_bc) is a
     multi-GB fit-invariant and must not rely on XLA hoisting it out of a
-    while body."""
+    while body.
+
+    2-bin indicator blocks on the kernel path take the sparsity-aware
+    ``sparse01`` kernel instead (the wide-sparse path): their bin matrix
+    IS the bin indicator, so no ``bc`` is materialized at all — at
+    Titanic-like 470-of-498 indicator columns that is most of the
+    would-be indicator bytes, and at a wide text-hash matrix nearly all
+    of them. ``TMOG_SPARSE01=0`` reverts to the dense indicator."""
     from ._pallas_hist import (bc_cache_ok, make_bc,
-                               pallas_histograms_enabled)
+                               pallas_histograms_enabled,
+                               sparse01_enabled)
     use_pallas = pallas_histograms_enabled()
     if use_pallas and max_depth is not None and max_depth > 24:
         # route_level carries the per-sample leaf path g in f32 lanes —
@@ -726,19 +938,25 @@ def prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, stats_dtype,
         B = n_bins
         col_blocks = [(np.arange(F), B, lambda fl, tl: edges[fl, tl])]
     bc_dt = jnp.bfloat16 if stats_dtype == jnp.float32 else stats_dtype
+    sp01 = use_pallas and sparse01_enabled()
     blocks = []
     for cols, nb, thr_fn in col_blocks:
         cols = np.asarray(cols)
+        # make_col_blocks only emits nb == 2 for binary_mask columns,
+        # whose bins are {0, 1} by construction (compute_bins re-bins
+        # them to (x > 0.5)) — the sparse kernel's contract
+        sparse = sp01 and nb == 2
         if use_pallas:
             blk = Xmat[cols, :]
             bc = (make_bc(blk, nb, bc_dt)
-                  if bc_cache_ok(n, len(cols), nb,
-                                 itemsize=jnp.dtype(bc_dt).itemsize)
+                  if not sparse and bc_cache_ok(
+                      n, len(cols), nb,
+                      itemsize=jnp.dtype(bc_dt).itemsize)
                   else None)
         else:
             blk = Xmat[:, cols]
             bc = None
-        blocks.append((cols, nb, thr_fn, blk, bc))
+        blocks.append((cols, nb, thr_fn, blk, bc, sparse))
     return use_pallas, Xmat, blocks
 
 

@@ -284,15 +284,21 @@ class ModelSelector(PredictorEstimator):
             # Mosaic fallback as the sweep — the refit compiles a fresh
             # width-1 program the sweep's shapes never exercised, and a
             # kernel rejection here must not kill the run after the
-            # sweep succeeded.
+            # sweep succeeded. The refit shards over the SAME mesh as
+            # the sweep (tree_mesh_scope → shard_map partial histograms
+            # + psum) — the final fit is the biggest single tree fit of
+            # the run and must not fall back to one device.
             from ._pallas_hist import with_pallas_fallback
+            from ._treefit import tree_mesh_scope
 
             def _refit():
                 params, Xarg = single.fit_prepared(
                     Xd, jnp.asarray(yk), jnp.asarray(w))
                 return (params, single.predict_batch(params, Xarg,
                                                      on_train=True))
-            params, (pred_d, _raw_d, prob_d) = with_pallas_fallback(_refit)
+            with tree_mesh_scope(self.mesh):
+                params, (pred_d, _raw_d, prob_d) = \
+                    with_pallas_fallback(_refit)
         else:
             grid = single.stack_grid()
             params = jax.jit(lambda X, y, w: single.fit_batch(

@@ -229,9 +229,17 @@ class _TreeFamilyBase(ModelFamily):
         # program must key this family's executable cache entries
         import os
 
-        from ._pallas_hist import pallas_histograms_enabled
+        from ._pallas_hist import (pallas_histograms_enabled,
+                                   sparse01_enabled, split_scan_enabled)
+        from ._treefit import active_tree_mesh
+        tm = active_tree_mesh()
         return (("__pallas__", pallas_histograms_enabled()),
-                ("__sibling__", _sibling_on()))
+                ("__sibling__", _sibling_on()),
+                ("__sparse01__", sparse01_enabled()),
+                ("__split_scan__", split_scan_enabled()),
+                ("__tree_mesh__", None if tm is None else
+                 (int(tm.shape.get("data", 1)),
+                  int(tm.shape.get("grid", 1)))))
 
     def _cache_bytes_per_row(self) -> int:
         """Per-row bytes of fit-time prediction caches an in-flight
@@ -315,11 +323,28 @@ class _TreeFamilyBase(ModelFamily):
         import weakref
 
         from ._pallas_hist import ROW_ALIGN, pallas_histograms_enabled
+        from ._treefit import active_tree_mesh
         bm = self.binary_mask
         pallas_on = pallas_histograms_enabled()
+        # under a tree-mesh scope the padded row count must ALSO split
+        # evenly over the mesh data axis (shard_map's even-sharding
+        # requirement — the pad_rows discipline applied to the binned
+        # matrix). ROW_ALIGN already divides by every power-of-two data
+        # axis; the multiply covers odd device counts ONLY. Padding to
+        # ROW_ALIGN×d always would leave each shard's block perfectly
+        # lane-aligned (saving the kernels a small per-level re-pad of
+        # the shard remainder), but it would also change the padded
+        # length — and the bootstrap uniforms are drawn at the PADDED
+        # shape, so the sharded sweep would stop being bit-identical to
+        # the single-device sweep. Parity wins; the remainder re-pad is
+        # one [F, <ROW_ALIGN] zero concat per level-block.
+        tm = active_tree_mesh()
+        dshards = int(tm.shape["data"]) if tm is not None else 1
+        align = (ROW_ALIGN if ROW_ALIGN % dshards == 0
+                 else ROW_ALIGN * dshards)
         mkey = None if bm is None else np.asarray(bm, bool).tobytes()
         key = (id(Xd), tuple(Xd.shape), str(Xd.dtype), self.n_bins, mkey,
-               pallas_on)
+               pallas_on, align)
         hit = _PREP_CACHE.get(key)
         if hit is not None and hit[0]() is not None:
             return hit[1]
@@ -331,19 +356,20 @@ class _TreeFamilyBase(ModelFamily):
             # kernel path: TRANSPOSED feature-major bins (lane-compact —
             # a [n, 20] i32 matrix is 6.4× larger physically than its
             # [20, n] transpose under TPU (8,128) tiling), rows padded to
-            # ROW_ALIGN once so the kernels never re-pad per level. Pad
-            # rows carry zero weights downstream, so they never reach a
-            # histogram; edges come from the real rows above.
+            # ROW_ALIGN (× the mesh data axis when it does not divide)
+            # once so the kernels never re-pad per level. Pad rows carry
+            # zero weights downstream, so they never reach a histogram;
+            # edges come from the real rows above.
             XbT = Xb.T
             n = XbT.shape[1]
-            n_pad = -(-n // ROW_ALIGN) * ROW_ALIGN
+            n_pad = -(-n // align) * align
             if n_pad != n:
                 XbT = jnp.concatenate(
                     [XbT, jnp.zeros((XbT.shape[0], n_pad - n),
                                     XbT.dtype)], axis=1)
             return {"XbT": XbT, "edges": edges}
 
-        fkey = (self.n_bins, mkey, pallas_on)
+        fkey = (self.n_bins, mkey, pallas_on, align)
         fn = _BIN_FNS.get(fkey)
         if fn is None:
             fn = jax.jit(bins_padded)
@@ -699,6 +725,13 @@ class _TreeEstimatorBase(PredictorEstimator):
     family_cls = RandomForestFamily
     task = "classification"
 
+    #: (data, grid) mesh this estimator's fit shards over — None
+    #: resolves to the process-default mesh, ``False`` forces the
+    #: unsharded path; ``Workflow._resolve_mesh`` assigns it like it
+    #: assigns ModelSelector meshes, so standalone tree fits scale with
+    #: the mesh too, not just the CV fold grid
+    mesh = None
+
     def _family(self, n_classes: int) -> _TreeFamilyBase:
         raise NotImplementedError
 
@@ -710,10 +743,20 @@ class _TreeEstimatorBase(PredictorEstimator):
         fam.binary_mask = detect_binary_columns(X)
         Xd = jnp.asarray(X, jnp.float32)
         from ._pallas_hist import with_pallas_fallback
-        params, _ = with_pallas_fallback(
-            lambda: fam.fit_prepared(
-                Xd, jnp.asarray(y, jnp.float32),
-                jnp.ones((X.shape[0],), jnp.float32)))
+        from ._treefit import tree_mesh_scope
+        from ..parallel.mesh import process_default_mesh
+        # a workflow-managed assignment (_mesh_auto) wins even when it
+        # resolved to None (mesh=False forces unsharded); only a stage
+        # nobody ever assigned resolves the process default itself
+        if self.mesh is None and not getattr(self, "_mesh_auto", False):
+            mesh = process_default_mesh()
+        else:
+            mesh = self.mesh
+        with tree_mesh_scope(mesh):
+            params, _ = with_pallas_fallback(
+                lambda: fam.fit_prepared(
+                    Xd, jnp.asarray(y, jnp.float32),
+                    jnp.ones((X.shape[0],), jnp.float32)))
         single = jax.tree_util.tree_map(lambda a: np.asarray(a)[0], params)
         return fam.realize(single, fam.grid[0])
 

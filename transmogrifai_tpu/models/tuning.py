@@ -584,11 +584,18 @@ class _ValidatorBase:
         dispatch the full grid unchunked.
         """
         from ._pallas_hist import with_pallas_fallback
+        from ._treefit import tree_mesh_scope
         snaps = _snapshot_grid_chunks(families)
 
         def attempt():
             _restore_grid_chunks(snaps)
-            return self._validate_impl(families, X, y, base_weights, mesh)
+            # the tree engine's kernel dispatches shard over this mesh
+            # (shard_map partial histograms + psum); the scope spans
+            # binning, compile AND dispatch so every traced program
+            # agrees on the row padding and the sharded kernels
+            with tree_mesh_scope(mesh):
+                return self._validate_impl(families, X, y, base_weights,
+                                           mesh)
         return with_pallas_fallback(attempt)
 
     def _validate_impl(self, families, X, y, base_weights=None, mesh=None):
@@ -925,11 +932,14 @@ class _ValidatorBase:
         Ref: ``OpCrossValidation.scala:89-116`` (per-fold dagCopy).
         """
         from ._pallas_hist import with_pallas_fallback
+        from ._treefit import tree_mesh_scope
         snaps = _snapshot_grid_chunks(families)
 
         def attempt():
             _restore_grid_chunks(snaps)
-            return self._validate_per_fold_impl(families, fold_data, mesh)
+            with tree_mesh_scope(mesh):
+                return self._validate_per_fold_impl(families, fold_data,
+                                                    mesh)
         return with_pallas_fallback(attempt)
 
     def _validate_per_fold_impl(self, families, fold_data, mesh=None):

@@ -383,6 +383,7 @@ class ExecutionPlan:
                  engine_tier: Optional[str] = None,
                  fitstats_tier: Optional[str] = None,
                  transform_tier: Optional[str] = None,
+                 aggregate_tier: Optional[str] = None,
                  link_mbps: float = 0.0, link_source: str = "prior",
                  tier_findings: Optional[List[Any]] = None,
                  db_finding: Optional[Any] = None):
@@ -399,6 +400,9 @@ class ExecutionPlan:
         self.engine_tier = engine_tier
         self.fitstats_tier = fitstats_tier
         self.transform_tier = transform_tier
+        #: measured columnar-vs-rowwise route for temporal aggregation
+        #: (the readers consult it via temporal.set_aggregate_tier_hint)
+        self.aggregate_tier = aggregate_tier
         self.link_mbps = link_mbps
         self.link_source = link_source
         self._tier_findings = tier_findings or []
@@ -433,7 +437,8 @@ class ExecutionPlan:
                      "source": self.link_source},
             "tiers": {"engine": self.engine_tier,
                       "fitstats": self.fitstats_tier,
-                      "transform": self.transform_tier},
+                      "transform": self.transform_tier,
+                      "aggregate": self.aggregate_tier},
             "stages": [e.to_json() for e in self.entries],
             "prunedColumns": pruned,
             "cse": self.cse,
@@ -905,6 +910,7 @@ def plan_model(model, cost_db: Optional[CostDatabase] = None,
         cse_suppressed=suppressed, engine_tier=engine_tier,
         fitstats_tier=_phase_tier(cost_db, "fitstats"),
         transform_tier=_phase_tier(cost_db, "transform"),
+        aggregate_tier=aggregate_route_tier(cost_db),
         link_mbps=link_mbps, link_source=link_source,
         tier_findings=tier_findings,
         db_finding=cost_db.finding() if cost_db is not None else None)
@@ -948,6 +954,7 @@ def plan_workflow(workflow, cost_db: Optional[CostDatabase] = None
         entries, engine_tier=None,
         fitstats_tier=_phase_tier(cost_db, "fitstats"),
         transform_tier=_phase_tier(cost_db, "transform"),
+        aggregate_tier=aggregate_route_tier(cost_db),
         link_mbps=link_mbps, link_source=link_source,
         db_finding=cost_db.finding() if cost_db is not None else None)
     _record_tallies(plan)
@@ -969,6 +976,25 @@ def _phase_tier(db: Optional[CostDatabase],
     if h is None or d is None:
         return None
     return "device" if d <= h else "host"
+
+
+def aggregate_route_tier(db: Optional[CostDatabase]) -> Optional[str]:
+    """Measured columnar-vs-rowwise tier for the temporal aggregation
+    route (ROADMAP item 4 leftover): the readers report
+    ``phase:temporal.route_aggregate`` observations with tiers
+    ``columnar`` / ``rowwise`` (``temporal.route_aggregate`` /
+    ``tally_rowwise`` → :func:`observe_phase` → the drained cost db).
+    Both tiers must have been measured to emit a hint — the runner
+    installs it via ``temporal.set_aggregate_tier_hint`` so the
+    ``"auto"`` route defers to evidence; None keeps the structural
+    auto-route (columnar when the source is columnar) in charge."""
+    if db is None:
+        return None
+    c = db.stage_cost("phase:temporal.route_aggregate", "columnar")
+    r = db.stage_cost("phase:temporal.route_aggregate", "rowwise")
+    if c is None or r is None:
+        return None
+    return "columnar" if c <= r else "rowwise"
 
 
 def _record_tallies(plan: ExecutionPlan) -> None:

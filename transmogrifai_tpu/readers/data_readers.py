@@ -167,13 +167,26 @@ class AggregateReader(DataReader):
         return self.cutoff.timestamp_ms
 
     def generate_store(self, raw_features: Sequence[Feature]) -> ColumnStore:
+        import time as _time
+
         from .. import temporal
         records = self.read_records()
         store = temporal.route_aggregate(self, records, raw_features)
         if store is not None:
             return store
-        temporal.tally_rowwise(len(records))
-        return self._rowwise_store(records, raw_features)
+        # timed so the planner's cost db learns the rowwise half of the
+        # columnar-vs-rowwise tier decision — but ONLY when the route
+        # just declined a REAL columnar option (row-list sources,
+        # forced-off mode and structurally unroutable extractors never
+        # had one; their timings would poison the pooled per-tier
+        # s/krow the auto-route hint compares)
+        contested = temporal.last_route_contested()
+        t0 = _time.perf_counter()
+        store = self._rowwise_store(records, raw_features)
+        temporal.tally_rowwise(
+            len(records),
+            seconds=(_time.perf_counter() - t0) if contested else None)
+        return store
 
     def _rowwise_store(self, records, raw_features: Sequence[Feature]
                        ) -> ColumnStore:

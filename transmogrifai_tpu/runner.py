@@ -322,7 +322,30 @@ class OpWorkflowRunner:
             if model is not None:
                 model.attach_plan(None)
             return None
-        findings = lint._apply_suppress(list(plan.findings()), suppress)
+        findings = list(plan.findings())
+        # measured columnar-vs-rowwise aggregation route (the cost db's
+        # phase:temporal.route_aggregate observations): install the hint
+        # the readers' auto-route consults for THIS run (the run-scoped
+        # set_run_defaults restore clears it). An explicit
+        # aggregateColumnar knob always wins — a contradiction between
+        # the knob and the measurement surfaces as a TMG405 advisory.
+        agg_tier = planner.aggregate_route_tier(db)
+        if agg_tier is not None:
+            from . import temporal as _temporal
+            _temporal.set_aggregate_tier_hint(agg_tier)
+            forced = _bool_custom_param(params, "aggregateColumnar",
+                                        allow_auto=True)
+            if (forced is True and agg_tier == "rowwise") \
+                    or (forced is False and agg_tier == "columnar"):
+                findings.append(lint.Finding(
+                    "TMG405",
+                    f"aggregateColumnar={str(bool(forced)).lower()} is "
+                    f"pinned but the cost database measured the "
+                    f"{agg_tier} tier faster "
+                    "(phase:temporal.route_aggregate) — the knob wins; "
+                    "drop it to let the auto-route follow the "
+                    "measurement"))
+        findings = lint._apply_suppress(findings, suppress)
         lint.emit_findings(findings)
         for f in findings:
             (logger.warning if f.severity == "warning"
@@ -548,6 +571,13 @@ class OpWorkflowRunner:
                     # touch the temporal tier
                     result.metrics["temporal"] = \
                         _temporal.temporal_stats()
+                    # tree-engine kernel tallies ride on every doc too:
+                    # per-kernel trace counts, mesh-sharded histogram
+                    # builds, gate state and fallback flips
+                    # (models/_pallas_hist.py, docs/performance.md
+                    # "Tree training engine")
+                    from .models import _pallas_hist as _ph
+                    result.metrics["trees"] = _ph.tree_kernel_stats()
                     if collector is not None:
                         result.metrics["telemetry"] = collector.summary()
                         result.metrics["telemetryMetrics"] = \

@@ -83,7 +83,8 @@ __all__ = [
     "join_aggregate_directory",
     "check_temporal",
     "set_run_defaults", "columnar_mode", "join_partitions",
-    "join_table_max_rows",
+    "join_table_max_rows", "set_aggregate_tier_hint",
+    "aggregate_tier_hint", "last_route_contested",
     "temporal_stats", "reset_temporal_stats",
     "DEFAULT_JOIN_PARTITIONS", "DEFAULT_JOIN_TABLE_MAX_ROWS",
 ]
@@ -112,21 +113,25 @@ class TemporalError(ValueError):
 
 _RUN_LOCK = threading.Lock()
 _RUN: Dict[str, Any] = {"columnar": None, "join_partitions": None,
-                        "join_table_max_rows": None}
+                        "join_table_max_rows": None,
+                        "aggregate_hint": None}
 
 
 def set_run_defaults(columnar: Any = None,
                      join_partitions: Optional[int] = None,
-                     join_table_max_rows: Optional[int] = None
+                     join_table_max_rows: Optional[int] = None,
+                     aggregate_hint: Optional[str] = None
                      ) -> Dict[str, Any]:
     """Install run-scoped temporal defaults (the runner's
     ``aggregateColumnar`` / ``joinPartitions`` / ``joinTableMaxRows``
-    knobs); returns the PREVIOUS dict so the runner can restore it in
-    its finally block. ``None`` means "module default"."""
+    knobs, plus the planner's measured aggregation-tier hint); returns
+    the PREVIOUS dict so the runner can restore it in its finally
+    block. ``None`` means "module default"."""
     with _RUN_LOCK:
         prev = dict(_RUN)
         _RUN.update(columnar=columnar, join_partitions=join_partitions,
-                    join_table_max_rows=join_table_max_rows)
+                    join_table_max_rows=join_table_max_rows,
+                    aggregate_hint=aggregate_hint)
     return prev
 
 
@@ -141,6 +146,44 @@ def columnar_mode() -> Any:
     if v is None or v == "auto":
         return "auto"
     return bool(v)
+
+
+def set_aggregate_tier_hint(hint: Optional[str]) -> Optional[str]:
+    """Install the planner's MEASURED columnar-vs-rowwise aggregation
+    tier (``"columnar"`` / ``"rowwise"`` / None = no evidence): the
+    runner computes it from the cost database's
+    ``phase:temporal.route_aggregate`` observations and installs it
+    run-scoped (restored in its finally). Returns the previous hint.
+    The hint steers the ``"auto"`` route ONLY — an explicit
+    ``aggregateColumnar`` knob always wins (contradictions surface as a
+    TMG405 advisory instead)."""
+    with _RUN_LOCK:
+        prev = _RUN["aggregate_hint"]
+        _RUN["aggregate_hint"] = hint
+    return prev
+
+
+def aggregate_tier_hint() -> Optional[str]:
+    return _RUN["aggregate_hint"]
+
+
+#: every Nth auto-routed aggregate under a "rowwise" hint still runs
+#: the columnar engine (the breaker's half-open idea): without the
+#: probe the hint is a one-way ratchet — once the db says rowwise the
+#: columnar tier is never re-measured, so a decision made on one
+#: unrepresentative workload (tiny folds where columnar's fixed setup
+#: dominates) could never flip back as rowwise observations keep
+#: refreshing and columnar's s/krow freezes forever
+HINT_PROBE_EVERY = 16
+_HINT_COUNT = [0]
+
+
+def _hint_stand_down() -> bool:
+    """True when a "rowwise" hint should actually suppress the columnar
+    route for THIS pass (every HINT_PROBE_EVERY-th pass probes)."""
+    with _RUN_LOCK:
+        _HINT_COUNT[0] += 1
+        return _HINT_COUNT[0] % HINT_PROBE_EVERY != 0
 
 
 def join_partitions(explicit: Optional[int] = None) -> int:
@@ -161,6 +204,7 @@ _TALLY_LOCK = threading.Lock()
 _TALLY: Dict[str, int] = {
     "columnar_aggregates": 0, "rowwise_aggregates": 0,
     "parallel_aggregates": 0, "columnar_fallbacks": 0,
+    "hint_fallbacks": 0,
     "aggregate_rows": 0, "aggregate_keys": 0,
     "joins": 0, "columnar_joins": 0, "join_rows": 0,
     "join_matched": 0, "join_unmatched": 0, "join_spilled_rows": 0,
@@ -644,6 +688,7 @@ def route_aggregate(reader, records, raw_features):
     (``temporal.aggregate`` fault site included) trips the
     ``temporal.columnar`` breaker and degrades row-wise — once the tier
     is known-bad the failing pass is not re-paid per read."""
+    _ROUTE_STATE.contested = False
     mode = columnar_mode()
     if mode is False:
         return None
@@ -654,10 +699,25 @@ def route_aggregate(reader, records, raw_features):
                 "aggregateColumnar=true but the source yields %s — "
                 "row-wise fold serves", type(records).__name__)
         return None
+    # a columnar batch with the engine available: from here on the
+    # tier decision is real, whichever path serves
+    _ROUTE_STATE.contested = True
+    if mode == "auto" and aggregate_tier_hint() == "rowwise" \
+            and _hint_stand_down():
+        # the cost database measured the row-wise fold faster for this
+        # workload shape (planner.aggregate_route_tier): the auto-route
+        # defers to the measurement; an explicit aggregateColumnar=true
+        # still forces columnar (the knob wins, TMG405 says so). Every
+        # HINT_PROBE_EVERY-th pass still runs columnar so the
+        # measurement stays live and the tier can flip back.
+        _tally("hint_fallbacks")
+        return None
     br = resilience.breaker("temporal.columnar")
     if not br.allow():
         _tally("columnar_fallbacks")
         return None
+    import time as _time
+    t0 = _time.perf_counter()
     try:
         resilience.inject("temporal.aggregate",
                           reader=type(reader).__name__,
@@ -670,7 +730,11 @@ def route_aggregate(reader, records, raw_features):
         # unroutable reader interleaved with a failing one would keep
         # resetting the failure count (and a half-open probe handed to
         # an unroutable pass would falsely close the breaker; an
-        # unreported probe re-arms after the reset timeout by design)
+        # unreported probe re-arms after the reset timeout by design).
+        # Also NOT a contested tier decision: the caller's row-wise
+        # timing must not feed the cost db's rowwise slot — this reader
+        # never had a columnar option, whatever its record type.
+        _ROUTE_STATE.contested = False
         if mode is True:
             _tally("columnar_fallbacks")
         return None
@@ -684,14 +748,47 @@ def route_aggregate(reader, records, raw_features):
     br.record_success()
     _tally("columnar_aggregates")
     telemetry.counter("temporal.columnar_aggregates").inc()
+    # feed the planner's cost database: the measured columnar tier cost
+    # rides the SAME observe_phase → drain pipeline the fitstats/
+    # transform tiers use, keyed phase:temporal.route_aggregate with
+    # tier "columnar" (planner.aggregate_route_tier reads it back)
+    from . import planner
+    planner.observe_phase("temporal.route_aggregate", "columnar",
+                          _time.perf_counter() - t0, len(records))
     return store
 
 
-def tally_rowwise(n_rows: int) -> None:
+#: per-thread disposition of the LAST route_aggregate call (readers may
+#: run concurrently on pipeline workers): ``contested`` is True only
+#: when the columnar tier was a REAL option for that pass — rowwise
+#: timings from passes with no columnar alternative (row-list sources,
+#: forced-off mode, structurally unroutable extractors) must not reach
+#: the cost database, or they poison the pooled per-tier s/krow the
+#: auto-route hint compares (observe_phase's contract: report only
+#: where the tier decision is contested)
+_ROUTE_STATE = threading.local()
+
+
+def last_route_contested() -> bool:
+    """Whether this thread's last :func:`route_aggregate` call was a
+    genuine columnar-vs-rowwise tier decision — the gate readers apply
+    before feeding a row-wise fold timing to the cost database."""
+    return bool(getattr(_ROUTE_STATE, "contested", False))
+
+
+def tally_rowwise(n_rows: int, seconds: Optional[float] = None) -> None:
     """Count one row-wise aggregation pass (the fallback/legacy path),
-    so the columnar-vs-rowwise split shows in every stamped doc."""
+    so the columnar-vs-rowwise split shows in every stamped doc.
+    ``seconds`` (when the caller timed the fold AND the pass was a
+    contested tier decision — see :func:`columnar_candidate`) feeds the
+    planner's cost database as the ``rowwise`` half of the
+    ``phase:temporal.route_aggregate`` tier decision."""
     _tally("rowwise_aggregates")
     _tally("aggregate_rows", n_rows)
+    if seconds is not None:
+        from . import planner
+        planner.observe_phase("temporal.route_aggregate", "rowwise",
+                              seconds, n_rows)
 
 
 # ---------------------------------------------------------------------------

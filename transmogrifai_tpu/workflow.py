@@ -641,14 +641,38 @@ class Workflow:
         # per-workflow set): a selector one workflow auto-assigned must
         # stay workflow-managed when another workflow (or a retrain)
         # resolves a different mesh — only an explicit construction-time
-        # mesh= is never overwritten
+        # mesh= is never overwritten. Tree estimator stages take the
+        # mesh too: the sharded histogram build (shard_map + psum) makes
+        # EVERY RF/GBT/XGB fit scale with devices, not just the CV fold
+        # grid.
+        from .models.trees import _TreeEstimatorBase
         for layer in dag:
             for stage in layer:
-                if isinstance(stage, ModelSelector) \
+                if isinstance(stage, (ModelSelector, _TreeEstimatorBase)) \
                         and (stage.mesh is None
                              or getattr(stage, "_mesh_auto", False)):
                     stage.mesh = active
                     stage._mesh_auto = True
+        # overlap the one-time Pallas kernel compile probe with the
+        # phases between here and the first tree-family sweep (raw-store
+        # prep, fitstats, vectorizers): only bench.py did this before —
+        # a production Train paid the ~10-15 s probe compile inline
+        # inside its first sweep
+        self._warm_tree_probe(dag)
+
+    @staticmethod
+    def _warm_tree_probe(dag: StagesDAG) -> None:
+        from .models.selector import ModelSelector
+        from .models.trees import _TreeEstimatorBase, _TreeFamilyBase
+        has_trees = any(
+            isinstance(stage, _TreeEstimatorBase)
+            or (isinstance(stage, ModelSelector)
+                and any(isinstance(f, _TreeFamilyBase)
+                        for f in stage.families))
+            for layer in dag for stage in layer)
+        if has_trees:
+            from .models._pallas_hist import warm_probe_async
+            warm_probe_async()
 
     def _fit_dag(self, dag: StagesDAG, train: ColumnStore,
                  test: Optional[ColumnStore],
