@@ -1,0 +1,766 @@
+"""Cross-process observability tests (PR 15): distributed tracing,
+the live /metrics plane, latency decomposition and the MFU block.
+
+The tentpole contract: one request, one trace — a traceparent minted at
+the fleet router survives the HTTP hop into the worker, the worker's
+request span rides into the micro-batcher, the batch span LINKS its
+member request span ids, and a retrain subprocess joins the triggering
+window's trace via TMOG_TRACE_PARENT; every process writes an atomic
+trace shard and `trace merge` stitches them into one clock-aligned
+Perfetto file. The /metrics plane: every scrape is VALID Prometheus
+0.0.4 text (asserted by this module's own independent parser — not the
+runtime's), histogram buckets are monotonically cumulative with
++Inf == _count even under concurrent observe() hammering (the
+torn-scrape fix), and the router's aggregate equals the sum of its
+workers' scrapes. Satellites: the TMG313 metric-name self-lint rule
+and the executed-FLOP device-cost (mfu) block."""
+import http.client
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (FeatureBuilder, Workflow, serving,
+                               telemetry)
+from transmogrifai_tpu import server as server_mod
+from transmogrifai_tpu.models import (BinaryClassificationModelSelector,
+                                      LogisticRegressionFamily)
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+
+BUCKET_CAP = 64
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# the test suite's OWN minimal Prometheus 0.0.4 text parser — independent
+# of telemetry.parse_prometheus on purpose: the runtime must not grade
+# its own homework
+# ---------------------------------------------------------------------------
+
+
+def parse_prom(text: str):
+    """{family: {"type": t, "samples": {(name, labels): float}}};
+    raises on anything that is not valid exposition text."""
+    fams = {}
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _h, _t, fam, kind = line.split()
+            assert kind in ("counter", "gauge", "histogram", "untyped")
+            fams[fam] = {"type": kind, "samples": {}}
+            continue
+        if line.startswith("#"):
+            continue
+        if "{" in line:
+            name = line[:line.index("{")]
+            labels = line[line.index("{"):line.index("}") + 1]
+            value = line[line.index("}") + 1:].strip()
+        else:
+            name, value = line.rsplit(None, 1)
+            labels = ""
+        v = float(value)          # non-numeric -> ValueError
+        fam = name
+        for suf in ("_bucket", "_sum", "_count"):
+            base = name[:-len(suf)] if name.endswith(suf) else None
+            if base and fams.get(base, {}).get("type") == "histogram":
+                fam = base
+                break
+        fams.setdefault(fam, {"type": "untyped", "samples": {}})
+        fams[fam]["samples"][(name, labels)] = v
+    return fams
+
+
+def assert_histograms_valid(fams):
+    """Every histogram family: per-le counts monotonically cumulative,
+    +Inf bucket == _count."""
+    for fam, doc in fams.items():
+        if doc["type"] != "histogram":
+            continue
+        buckets = []
+        inf = total = None
+        for (name, labels), v in doc["samples"].items():
+            if name == fam + "_bucket":
+                le = labels.split('le="')[1].split('"')[0]
+                if le == "+Inf":
+                    inf = v
+                else:
+                    buckets.append((float(le), v))
+            elif name == fam + "_count":
+                total = v
+        buckets.sort()
+        prev = 0.0
+        for le, v in buckets:
+            assert v >= prev, (fam, le, v, prev)
+            prev = v
+        assert inf is not None and total is not None, fam
+        assert inf == total, (fam, inf, total)
+        if buckets:
+            assert buckets[-1][1] <= inf, (fam, buckets[-1], inf)
+
+
+# ---------------------------------------------------------------------------
+# trace context primitives
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip_and_malformed():
+    ctx = telemetry.mint_trace()
+    tp = telemetry.format_traceparent(*ctx)
+    assert telemetry.parse_traceparent(tp) == ctx
+    for bad in (None, "", "zz", "00-short-short-01",
+                "00-" + "g" * 32 + "-" + "0" * 16 + "-01"):
+        assert telemetry.parse_traceparent(bad) is None
+    # ids are well-formed hex of the W3C widths
+    assert len(ctx[0]) == 32 and len(ctx[1]) == 16
+    int(ctx[0], 16), int(ctx[1], 16)
+    # and unique across mints
+    assert telemetry.mint_trace()[0] != ctx[0]
+
+
+def test_span_trace_identity_and_nesting():
+    telemetry.enable()
+    ctx = telemetry.mint_trace()
+    with telemetry.trace_scope(telemetry.format_traceparent(*ctx)):
+        with telemetry.span("outer") as outer:
+            assert outer.trace_id == ctx[0]
+            assert outer.parent_id == ctx[1]
+            with telemetry.span("inner") as inner:
+                assert inner.trace_id == ctx[0]
+                assert inner.parent_id == outer.span_id
+    # outside any scope spans stay untraced (no id args recorded)
+    with telemetry.span("plain") as sp:
+        assert sp.trace_id is None
+    evs = {e["name"]: e for e in telemetry.trace_events()
+           if e.get("ph") == "X"}
+    assert evs["outer"]["args"]["trace_id"] == ctx[0]
+    assert evs["inner"]["args"]["parent_span_id"] \
+        == evs["outer"]["args"]["span_id"]
+    assert "trace_id" not in evs["plain"]["args"]
+
+
+def test_trace_scope_none_is_noop_and_disabled_span_has_no_ids():
+    with telemetry.trace_scope(None):
+        assert telemetry.current_trace() is None
+    sp = telemetry.span("x")          # disabled -> null span
+    assert sp.trace_id is None and sp.span_id is None
+
+
+def test_trace_shard_write_merge_and_clock_alignment(tmp_path):
+    telemetry.enable()
+    with telemetry.trace_scope(telemetry.mint_trace()):
+        with telemetry.span("a"):
+            pass
+    d = str(tmp_path / "shards")
+    p = telemetry.write_trace_shard(d, role="worker")
+    assert p and os.path.exists(p)
+    # a second process's shard, hand-crafted with a LATER clock epoch:
+    # the merger must shift its events right by the offset
+    with open(p) as fh:
+        mine = json.load(fh)
+    other = {"role": "router", "pid": mine["pid"] + 1,
+             "epochUnixS": mine["epochUnixS"] + 2.0,
+             "traceEvents": [{"name": "r", "ph": "X", "pid": 0,
+                              "tid": 0, "ts": 10.0, "dur": 5.0,
+                              "args": {}}]}
+    with open(os.path.join(d, "shard-router-9.trace.json"), "w") as fh:
+        json.dump(other, fh)
+    merged = telemetry.merge_trace_shards(d)
+    assert merged["mergedShards"] == 2
+    rows = {e["args"]["name"] for e in merged["traceEvents"]
+            if e["name"] == "process_name"}
+    assert f"worker-{mine['pid']}" in rows
+    assert f"router-{mine['pid'] + 1}" in rows
+    r_ev = [e for e in merged["traceEvents"] if e["name"] == "r"][0]
+    assert math.isclose(r_ev["ts"], 10.0 + 2e6, rel_tol=1e-9)
+    assert r_ev["pid"] == mine["pid"] + 1
+    # a torn shard is skipped with a note, never fatal
+    with open(os.path.join(d, "shard-torn-1.trace.json"), "w") as fh:
+        fh.write("{not json")
+    merged2 = telemetry.merge_trace_shards(d)
+    assert merged2["mergedShards"] == 2
+    assert merged2["mergeErrors"]
+    # merging INTO the shard directory is idempotent: a re-run must not
+    # ingest the previous merge's own output as a shard (it has no
+    # epoch anchor and would both duplicate every span and destroy the
+    # clock alignment)
+    telemetry.write_merged_trace(
+        d, os.path.join(d, "merged.trace.json"))
+    merged3 = telemetry.merge_trace_shards(d)
+    assert merged3["mergedShards"] == 2
+    n_spans = sum(1 for e in merged3["traceEvents"]
+                  if e.get("ph") == "X")
+    assert n_spans == sum(1 for e in merged2["traceEvents"]
+                          if e.get("ph") == "X")
+
+
+def test_shard_write_skips_when_nothing_recorded(tmp_path):
+    assert telemetry.write_trace_shard(str(tmp_path)) is None
+
+
+def test_env_traceparent_joins_subprocess_spans(tmp_path):
+    """The retrain-inheritance mechanism: a fresh interpreter launched
+    with TMOG_TRACE_PARENT + TMOG_TRACE_ROLE records spans on the
+    PARENT's trace id and names its shard row after its role."""
+    ctx = telemetry.mint_trace()
+    tp = telemetry.format_traceparent(*ctx)
+    d = str(tmp_path / "shards")
+    env = dict(os.environ)
+    env[telemetry.TRACE_ENV] = tp
+    env[telemetry.TRACE_ROLE_ENV] = "retrain"
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    code = (
+        "from transmogrifai_tpu import telemetry\n"
+        "telemetry.enable()\n"
+        "with telemetry.span('child:work'):\n"
+        "    pass\n"
+        f"print(telemetry.write_trace_shard({d!r}))\n")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    merged = telemetry.merge_trace_shards(d)
+    ev = [e for e in merged["traceEvents"]
+          if e.get("name") == "child:work"][0]
+    assert ev["args"]["trace_id"] == ctx[0]
+    assert ev["args"]["parent_span_id"] == ctx[1]
+    rows = [e["args"]["name"] for e in merged["traceEvents"]
+            if e["name"] == "process_name"]
+    assert any(r.startswith("retrain-") for r in rows), rows
+
+
+def test_retrain_job_records_and_inherits_traceparent(tmp_path):
+    from transmogrifai_tpu import lifecycle
+    from transmogrifai_tpu.continual import RetrainController
+
+    reg = lifecycle.ModelRegistry(str(tmp_path / "reg"))
+    c = RetrainController("m", reg, [sys.executable, "-c", "pass"],
+                          job_dir=str(tmp_path / "jobs"),
+                          trace_dir=str(tmp_path / "shards"))
+    ctx = telemetry.mint_trace()
+    with telemetry.trace_scope(ctx):
+        job = c._new_job({"reason": "test"})
+    assert telemetry.parse_traceparent(job["traceparent"]) == ctx
+    env = c._spawn_env(job, None)
+    assert env[telemetry.TRACE_ENV] == job["traceparent"]
+    assert env[telemetry.TRACE_ROLE_ENV] == "retrain"
+    assert env["TMOG_TRACE_DIR"] == str(tmp_path / "shards")
+    # untraced trigger mints a root rather than riding untraced
+    job2 = c._new_job(None)
+    assert telemetry.parse_traceparent(job2["traceparent"]) is not None
+
+
+# ---------------------------------------------------------------------------
+# torn-scrape fix: hammer the histogram while scraping
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_scrape_hammer_never_tears():
+    telemetry.enable()
+    h = telemetry.histogram("hammer.seconds")
+    stop = threading.Event()
+    rng = np.random.default_rng(7)
+    values = rng.exponential(0.05, 4096).tolist()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            h.observe(values[i % len(values)])
+            i += 1
+
+    threads = [threading.Thread(target=hammer, name=f"hammer-{i}",
+                                daemon=True) for i in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(300):
+            fams = parse_prom(telemetry.render_prometheus())
+            assert_histograms_valid(fams)
+            doc = telemetry.metrics_json()["hammer.seconds"]
+            # the JSON snapshot obeys the same invariant
+            buckets = sorted((float(k), v)
+                             for k, v in doc["buckets"].items())
+            prev = 0
+            for _le, v in buckets:
+                assert v >= prev
+                prev = v
+            assert buckets[-1][1] <= doc["count"]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+
+def test_histogram_bucket_semantics_exact():
+    telemetry.enable()
+    h = telemetry.histogram("exact.seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 1.0, 5.0, 100.0):
+        h.observe(v)
+    counts, total, count = h.snapshot()
+    # v <= le semantics: 0.1 holds {0.05, 0.1}; 1.0 adds {0.5, 1.0};
+    # 10.0 adds {5.0}; 100.0 only reaches +Inf (== count)
+    assert counts == (2, 4, 5)
+    assert count == 6
+    assert abs(total - 106.65) < 1e-9
+    assert h.bucket_counts() == {0.1: 2, 1.0: 4, 10.0: 5}
+
+
+# ---------------------------------------------------------------------------
+# exposition aggregation (the router's /metrics plane)
+# ---------------------------------------------------------------------------
+
+
+def test_render_prometheus_sum_equals_per_worker_sums():
+    telemetry.enable()
+    telemetry.counter("w.requests").inc(3)
+    telemetry.histogram("w.lat", buckets=(0.1, 1.0)).observe(0.05)
+    text1 = telemetry.render_prometheus()
+    telemetry.counter("w.requests").inc(2)
+    telemetry.histogram("w.lat", buckets=(0.1, 1.0)).observe(0.5)
+    text2 = telemetry.render_prometheus()
+    merged = telemetry.render_prometheus_sum([text1, text2])
+    fams = parse_prom(merged)
+    assert_histograms_valid(fams)
+    f1, f2 = parse_prom(text1), parse_prom(text2)
+    for fam, doc in fams.items():
+        for key, v in doc["samples"].items():
+            expect = (f1.get(fam, {}).get("samples", {}).get(key, 0)
+                      + f2.get(fam, {}).get("samples", {}).get(key, 0))
+            assert v == expect, (fam, key, v, expect)
+
+
+def test_parse_prometheus_rejects_garbage():
+    with pytest.raises(ValueError):
+        telemetry.parse_prometheus("what even is this line\n")
+    with pytest.raises(ValueError):
+        telemetry.parse_prometheus("x{le=\"0.1\"} notanumber\n")
+
+
+# ---------------------------------------------------------------------------
+# MFU / device-cost block
+# ---------------------------------------------------------------------------
+
+
+def test_device_cost_ledger_and_block_shape():
+    telemetry.reset_device_cost()
+    telemetry.record_device_work("scoring", flops=2e9, seconds=0.01)
+    telemetry.record_device_work("scoring", flops=2e9, seconds=0.01)
+    telemetry.record_device_work("tuning", flops=5e9)   # untimed
+    st = telemetry.device_cost_stats()
+    assert st["phases"]["scoring"]["dispatches"] == 2
+    assert st["phases"]["scoring"]["flops"] == 4e9
+    assert st["phases"]["tuning"]["achieved_tflops"] is None
+    assert st["device_flops"] == 9e9
+    # the rate pairs TIMED flops with timed seconds only: 4e9 / 0.02
+    assert abs(st["achieved_tflops"] - 0.2) < 1e-6
+    for k in ("device_kind", "devices", "mfu_bf16_pct", "mfu_f32_pct"):
+        assert k in st
+    telemetry.reset_device_cost()
+
+
+def test_scoring_engine_feeds_device_cost(rng):
+    telemetry.reset_device_cost()
+    n = 256
+    y = rng.integers(0, 2, n).astype(float)
+    x = rng.normal(size=n) + y
+    records = [{"label": float(y[i]), "x": float(x[i])}
+               for i in range(n)]
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x").from_column().as_predictor()
+    vec = transmogrify([f1])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()],
+        splitter=None, seed=5)
+    pred = label.transform_with(sel, vec)
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    eng = model.scoring_engine(gate_bandwidth=False, mesh=False)
+    assert eng is not None
+    eng.score_store(records, use_cache=False)   # compile dispatch
+    before = telemetry.device_cost_stats()["phases"].get(
+        "scoring", {"dispatches": 0})["dispatches"]
+    eng.score_store(records, use_cache=False)   # warm dispatch
+    st = telemetry.device_cost_stats()["phases"]["scoring"]
+    assert st["dispatches"] > before
+    assert st["flops"] > 0 and st["seconds"] > 0
+
+
+def test_runner_metrics_doc_stamps_mfu(rng, tmp_path):
+    from transmogrifai_tpu.runner import (OpParams, OpWorkflowRunner,
+                                          RunType)
+    n = 120
+    y = rng.integers(0, 2, n).astype(float)
+    x = rng.normal(size=n) + y
+    records = [{"label": float(y[i]), "x": float(x[i])}
+               for i in range(n)]
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x").from_column().as_predictor()
+    vec = transmogrify([f1])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()],
+        splitter=None, seed=6)
+    pred = label.transform_with(sel, vec)
+    wf = Workflow().set_input_records(records).set_result_features(pred)
+    params = OpParams(model_location=str(tmp_path / "model"))
+    res = OpWorkflowRunner(wf).run(RunType.TRAIN, params)
+    assert "mfu" in res.metrics
+    blk = res.metrics["mfu"]
+    assert "phases" in blk and "device_flops" in blk
+    assert blk["device_flops"] > 0          # the CV sweep dispatched
+
+
+def test_runner_trace_dir_writes_shard(rng, tmp_path):
+    from transmogrifai_tpu.runner import (OpParams, OpWorkflowRunner,
+                                          RunType)
+    n = 120
+    y = rng.integers(0, 2, n).astype(float)
+    x = rng.normal(size=n) + y
+    records = [{"label": float(y[i]), "x": float(x[i])}
+               for i in range(n)]
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x").from_column().as_predictor()
+    vec = transmogrify([f1])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()],
+        splitter=None, seed=7)
+    pred = label.transform_with(sel, vec)
+    wf = Workflow().set_input_records(records).set_result_features(pred)
+    d = str(tmp_path / "shards")
+    params = OpParams(model_location=str(tmp_path / "model"),
+                      custom_params={"traceDir": d, "validate": False,
+                                     "plan": False})
+    OpWorkflowRunner(wf).run(RunType.TRAIN, params)
+    shards = [f for f in os.listdir(d) if f.endswith(".trace.json")]
+    assert len(shards) == 1 and "run-train" in shards[0]
+    # run-scoped: recording turned back off afterwards
+    assert not telemetry.enabled()
+
+
+# ---------------------------------------------------------------------------
+# worker surface: /metrics, decomposition, batch span links
+# ---------------------------------------------------------------------------
+
+
+def _train_tiny(seed, n=160):
+    rng = np.random.default_rng(seed)
+    y = np.asarray([i % 2 for i in range(n)], float)
+    rng.shuffle(y)
+    records = [{"label": float(y[i]),
+                "x1": float(rng.normal() + y[i]),
+                "x2": float(rng.normal())} for i in range(n)]
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x1").from_column().as_predictor()
+    f2 = FeatureBuilder.Real("x2").from_column().as_predictor()
+    vec = transmogrify([f1, f2])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()],
+        splitter=None, seed=seed)
+    pred = label.transform_with(sel, vec)
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    return model, records
+
+
+@pytest.fixture(scope="module")
+def tiny_server():
+    model, records = _train_tiny(31)
+    srv = server_mod.ModelServer(batch_deadline_s=0.001)
+    srv.register("m", model=model)
+    httpd = server_mod.serve_http(srv, port=0)
+    yield srv, httpd.server_address[1], records
+    httpd.shutdown()
+    srv.shutdown(drain=True)
+    model._engine_breaker().reset()
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, r.getheader("Content-Type"), r.read()
+    finally:
+        conn.close()
+
+
+def _post_score(port, name, records, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    try:
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        conn.request("POST", f"/v1/models/{name}:score",
+                     json.dumps({"records": records}), hdrs)
+        r = conn.getresponse()
+        return (r.status, dict(r.getheaders()),
+                json.loads(r.read() or b"{}"))
+    finally:
+        conn.close()
+
+
+def test_worker_metrics_endpoint_scrapes_valid(tiny_server):
+    srv, port, records = tiny_server
+    # valid even with telemetry OFF (always-on server_* gauges ride)
+    status, ctype, body = _get(port, "/metrics")
+    assert status == 200 and "text/plain" in ctype
+    fams = parse_prom(body.decode())
+    assert "server_tally_requests" in fams
+    telemetry.enable()
+    _post_score(port, "m", records[:3])
+    status, _c, body = _get(port, "/metrics")
+    fams = parse_prom(body.decode())
+    assert_histograms_valid(fams)
+    assert any(f.startswith("server_queue_wait_seconds") for f in fams)
+    assert any(f.startswith("server_device_dispatch_seconds")
+               for f in fams)
+
+
+def test_request_trace_header_adopted_echoed_and_linked(tiny_server):
+    srv, port, records = tiny_server
+    telemetry.enable()
+    ctx = telemetry.mint_trace()
+    tp = telemetry.format_traceparent(*ctx)
+    status, headers, doc = _post_score(port, "m", records[:2],
+                                       {telemetry.TRACE_HEADER: tp})
+    assert status == 200, doc
+    assert headers.get(telemetry.TRACE_HEADER) == tp
+    evs = [e for e in telemetry.trace_events() if e.get("ph") == "X"]
+    req = [e for e in evs if e["name"] == "server:request"
+           and e["args"].get("trace_id") == ctx[0]]
+    assert req, "request span must adopt the header's trace id"
+    disp = [e for e in evs if e["name"] == "server:dispatch"
+            and e["args"].get("trace_id") == ctx[0]]
+    assert disp, "batch span must share the trace id"
+    assert req[0]["args"]["span_id"] in disp[0]["args"]["links"]
+
+
+def test_latency_decomposition_in_stats(tiny_server):
+    srv, port, records = tiny_server
+    for _ in range(3):
+        srv.score("m", records[:4], timeout_s=120)
+    st = srv.stats()["models"]["m"]
+    lat = st["latency"]
+    for ph in ("e2e", "queueWait", "coalesceHold", "deviceDispatch",
+               "scatter"):
+        assert ph in lat
+        assert lat[ph], f"{ph} reservoir must have recorded"
+        assert set(lat[ph]) == {"p50_ms", "p95_ms", "p99_ms"}
+    # phases are bounded by the end-to-end number they decompose
+    assert lat["queueWait"]["p50_ms"] <= lat["e2e"]["p99_ms"]
+    assert lat["deviceDispatch"]["p50_ms"] <= lat["e2e"]["p99_ms"]
+
+
+# ---------------------------------------------------------------------------
+# TMG313 self-lint fixtures
+# ---------------------------------------------------------------------------
+
+
+def _load_tmoglint():
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "tmoglint", os.path.join(repo, "tools", "tmoglint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tmg313_dynamic_metric_name_flagged_and_allowlisted():
+    tm = _load_tmoglint()
+    bad = ("from transmogrifai_tpu import telemetry\n"
+           "telemetry.counter(f'x.{k}').inc()\n")
+    assert [f.rule for f in tm.lint_source(bad, "pkg/mod.py")] \
+        == ["TMG313"]
+    from_import = ("from transmogrifai_tpu.telemetry import histogram\n"
+                   "histogram(name_var).observe(1)\n")
+    assert [f.rule for f in tm.lint_source(from_import, "pkg/mod.py")] \
+        == ["TMG313"]
+    clean = ("from transmogrifai_tpu import telemetry\n"
+             "telemetry.gauge('x.depth').set(1)\n")
+    assert tm.lint_source(clean, "pkg/mod.py") == []
+    marked = ("from transmogrifai_tpu import telemetry\n"
+              "telemetry.counter(f'x.{k}').inc()"
+              "  # lint: metric-name — fixed tally catalog\n")
+    assert tm.lint_source(marked, "pkg/mod.py") == []
+    home = ("import telemetry\n"
+            "telemetry.counter(n).inc()\n")
+    assert tm.lint_source(home, "transmogrifai_tpu/telemetry.py") == []
+    tests_ok = ("from transmogrifai_tpu import telemetry\n"
+                "telemetry.counter(nm).inc()\n")
+    assert tm.lint_source(tests_ok, "tests/test_x.py") == []
+
+
+def test_tmg313_in_rules_catalog():
+    from transmogrifai_tpu import lint
+    assert lint.RULES["TMG313"][0] == "error"
+
+
+# ---------------------------------------------------------------------------
+# CLI: gen/check knobs + trace merge
+# ---------------------------------------------------------------------------
+
+
+def test_cli_trace_merge(tmp_path, capsys):
+    from transmogrifai_tpu.cli import run_trace
+    telemetry.enable()
+    with telemetry.trace_scope(telemetry.mint_trace()):
+        with telemetry.span("cli:span"):
+            pass
+    d = str(tmp_path / "shards")
+    telemetry.write_trace_shard(d, role="worker")
+    out_path = str(tmp_path / "merged.json")
+    assert run_trace("merge", d, out=out_path) == 0
+    with open(out_path) as fh:
+        doc = json.load(fh)
+    assert doc["mergedShards"] == 1
+    assert any(e.get("name") == "cli:span" for e in doc["traceEvents"])
+    assert run_trace("merge", str(tmp_path / "empty")) == 1
+    assert run_trace("resolve", d) == 1
+
+
+def test_cli_gen_emits_and_check_validates_observability_knobs(tmp_path):
+    from transmogrifai_tpu.cli import generate_project, run_check
+    csv = tmp_path / "d.csv"
+    csv.write_text("label,x\n1,0.5\n0,0.2\n1,0.9\n0,0.1\n")
+    out = generate_project(str(csv), "label", str(tmp_path / "proj"))
+    params = json.loads(open(out["params.json"]).read())
+    assert params["customParams"]["serveMetrics"] is None
+    assert params["customParams"]["traceDir"] is None
+    bad = dict(params)
+    bad["customParams"] = dict(params["customParams"],
+                               serveMetrics="nope", traceDir=7)
+    bad_path = tmp_path / "bad.json"
+    bad_path.write_text(json.dumps(bad))
+    assert run_check(str(bad_path)) == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet acceptance: one request, one trace, across real processes
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced_fleet(tmp_path_factory):
+    from transmogrifai_tpu import resilience
+    from transmogrifai_tpu.fleet import FleetSupervisor, serve_fleet_http
+    from transmogrifai_tpu.lifecycle import ModelRegistry
+
+    reg_dir = str(tmp_path_factory.mktemp("registry"))
+    reg = ModelRegistry(reg_dir)
+    model, records = _train_tiny(41)
+    mdir = str(tmp_path_factory.mktemp("model"))
+    edir = str(tmp_path_factory.mktemp("export"))
+    model.save(mdir, overwrite=True)
+    serving.export_scoring_fn(model, edir, records[:8],
+                              bucket_cap=BUCKET_CAP)
+    reg.register("churn", mdir, bank_dir=edir, promote=True)
+    trace_dir = str(tmp_path_factory.mktemp("traces"))
+    params = tmp_path_factory.mktemp("params") / "params.json"
+    params.write_text(json.dumps({"customParams": {
+        "registryDir": reg_dir, "serveBucketCap": BUCKET_CAP,
+        "serveBatchDeadlineMs": 1.0, "traceDir": trace_dir}}))
+    backoff = resilience.RetryPolicy(max_attempts=8, base_delay_s=0.05,
+                                     max_delay_s=0.5, jitter=0.1, seed=3)
+    sup = FleetSupervisor(str(params), workers=2, respawn_max=6,
+                          probe_interval_s=0.1, backoff=backoff)
+    sup.start()
+    sup.wait_ready(timeout_s=240)
+    httpd = serve_fleet_http(sup, port=0, retry_budget=2,
+                             forward_timeout_s=120.0)
+    yield sup, httpd, httpd.server_address[1], records, trace_dir
+    httpd.shutdown()
+    sup.stop(drain=True)
+    model._engine_breaker().reset()
+
+
+def test_fleet_router_metrics_aggregates_worker_scrapes(traced_fleet):
+    # runs BEFORE the acceptance test below, which drains the fleet
+    sup, httpd, port, records, trace_dir = traced_fleet
+    # traffic so the workers have non-zero tallies
+    for i in range(3):
+        status, _h, _doc = _post_score(port, "churn",
+                                       records[i:i + 2])
+        assert status == 200
+    status, ctype, body = _get(port, "/metrics")
+    assert status == 200 and "text/plain" in ctype
+    fams = parse_prom(body.decode())
+    assert_histograms_valid(fams)
+    assert fams["fleet_metrics_workers"]["samples"][
+        ("fleet_metrics_workers", "")] == 2.0
+    # router sums equal the per-worker sums, fetched directly
+    worker_totals = 0.0
+    for h in sup.ready_workers():
+        st, _c, wbody = _get(h.port, "/metrics")
+        assert st == 200
+        wfams = parse_prom(wbody.decode())
+        assert_histograms_valid(wfams)
+        worker_totals += wfams["server_tally_requests"]["samples"][
+            ("server_tally_requests", "")]
+    agg = fams["server_tally_requests"]["samples"][
+        ("server_tally_requests", "")]
+    assert agg == worker_totals
+    assert worker_totals >= 3
+
+
+def test_fleet_trace_acceptance_one_request_one_trace(traced_fleet):
+    """The PR's acceptance bar: one scored request through a live
+    2-worker fleet with tracing on yields, after trace merge, a single
+    Perfetto file where the router's route span, the worker's request
+    span and the micro-batcher's dispatch span share ONE trace id, with
+    the batch span linking the request's span id — across real
+    processes."""
+    sup, httpd, port, records, trace_dir = traced_fleet
+    # warm the serving path first so the traced request is steady-state
+    status, _h, _doc = _post_score(port, "churn", records[:2])
+    assert status == 200
+    telemetry.enable()
+    telemetry.set_trace_role("router")
+    ctx = telemetry.mint_trace()
+    tp = telemetry.format_traceparent(*ctx)
+    status, _h, doc = _post_score(port, "churn", records[:3],
+                                  {telemetry.TRACE_HEADER: tp})
+    assert status == 200, doc
+    assert doc["rows"] == 3
+    # drain the fleet: each worker's serve process writes its shard on
+    # SIGTERM (cli.run_serve), the router (this process) writes its own
+    sup.stop(drain=True)
+    telemetry.write_trace_shard(trace_dir)
+    telemetry.set_trace_role("proc")
+    merged = telemetry.write_merged_trace(
+        trace_dir, os.path.join(trace_dir, "merged.trace.json"))
+    assert merged["mergedShards"] >= 2, "router + >=1 worker shard"
+    spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"
+             and isinstance(e.get("args"), dict)
+             and e["args"].get("trace_id") == ctx[0]]
+    by_name = {}
+    for e in spans:
+        by_name.setdefault(e["name"], []).append(e)
+    assert "fleet:route" in by_name, sorted(by_name)
+    assert "server:request" in by_name, sorted(by_name)
+    assert "server:dispatch" in by_name, sorted(by_name)
+    route = by_name["fleet:route"][0]
+    req = by_name["server:request"][0]
+    disp = by_name["server:dispatch"][0]
+    # the route span ran in THIS process, the request/dispatch spans in
+    # a worker process — one trace, multiple pids
+    assert route["pid"] != req["pid"]
+    assert req["pid"] == disp["pid"]
+    assert req["args"]["span_id"] in disp["args"]["links"]
+    # every span of the trace agrees on the id the router minted
+    assert {e["args"]["trace_id"] for e in spans} == {ctx[0]}

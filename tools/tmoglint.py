@@ -71,6 +71,16 @@ here as rules (the TMG3xx family of the catalog in
   elsewhere has NO fallback, so a Mosaic rejection at production shapes
   fails an hours-long fit instead of degrading). Tests are exempt; a
   deliberately un-gated kernel carries ``# lint: pallas — reason``.
+* **TMG313** — ``telemetry.counter/gauge/histogram(...)`` must pass a
+  LITERAL metric name outside ``telemetry.py`` (the observability-plane
+  rule: a dynamic name is unbounded registry AND ``/metrics``
+  exposition cardinality — every distinct runtime value becomes a new
+  instrument held for the process lifetime and a new family in every
+  scrape; a per-entity name interpolated from unbounded input can eat
+  the heap and flood the scrape surface). Tests are exempt; a
+  deliberately dynamic name whose domain is provably bounded (a fixed
+  tally catalog, the registered tenant roster) carries
+  ``# lint: metric-name — reason``.
 
 Runs as a CLI over one or more paths (default: the ``transmogrifai_tpu``
 package next to this script) and as a tier-1 pytest
@@ -98,7 +108,8 @@ from transmogrifai_tpu.lint import Finding, Severity, enforce  # noqa: E402
 __all__ = ["lint_source", "lint_file", "lint_paths", "main",
            "ALLOW_WALLCLOCK", "ALLOW_BROAD_EXCEPT", "ALLOW_EXPLICIT_MESH",
            "ALLOW_THREAD", "ALLOW_UNBOUNDED_QUEUE", "ALLOW_POPEN",
-           "ALLOW_THREAD_LOOP", "ALLOW_SORT", "ALLOW_PALLAS"]
+           "ALLOW_THREAD_LOOP", "ALLOW_SORT", "ALLOW_PALLAS",
+           "ALLOW_METRIC_NAME"]
 
 #: suppression markers, checked on the finding's own source line
 ALLOW_WALLCLOCK = "lint: wall-clock"
@@ -110,6 +121,11 @@ ALLOW_POPEN = "lint: popen"
 ALLOW_THREAD_LOOP = "lint: thread-loop"
 ALLOW_SORT = "lint: sort"
 ALLOW_PALLAS = "lint: pallas"
+ALLOW_METRIC_NAME = "lint: metric-name"
+
+#: the ONE module sanctioned to build instrument names dynamically
+#: (TMG313): the registry itself owns cardinality
+METRICS_HOME = "telemetry.py"
 
 #: the ONE module sanctioned to host pl.pallas_call sites (TMG312): its
 #: probe/fallback gate is what makes a Mosaic rejection survivable
@@ -150,6 +166,7 @@ class _Visitor(ast.NodeVisitor):
         self.np_sort_funcs: Dict[str, str] = {}  # from numpy import argsort
         self.pallas_modules: Set[str] = set()
         self.pallas_call_funcs: Set[str] = set()
+        self.instrument_funcs: Dict[str, str] = {}  # from telemetry import counter
         self.with_contexts: Set[int] = set()
         #: TMG310 bookkeeping: names used as Thread(target=...) and the
         #: module's function defs by name (methods included; resolved in
@@ -164,6 +181,11 @@ class _Visitor(ast.NodeVisitor):
         #: _pallas_hist.py owns kernel construction (its probe/fallback
         #: gate is the rule's point); tests may build throwaway kernels
         self.pallas_exempt = (os.path.basename(path) == PALLAS_HOME
+                              or "tests" in parts
+                              or os.path.basename(path).startswith("test_"))
+        #: telemetry.py owns the registry (its factories RECEIVE the
+        #: names); tests may build throwaway instruments — TMG313
+        self.metric_exempt = (os.path.basename(path) == METRICS_HOME
                               or "tests" in parts
                               or os.path.basename(path).startswith("test_"))
 
@@ -237,6 +259,9 @@ class _Visitor(ast.NodeVisitor):
                 self.pallas_modules.add(local)
             if mod.endswith("pallas") and alias.name == "pallas_call":
                 self.pallas_call_funcs.add(local)
+            if mod.endswith("telemetry") and alias.name in (
+                    "counter", "gauge", "histogram"):
+                self.instrument_funcs[local] = alias.name
         self.generic_visit(node)
 
     # -- function defs: TMG310 target resolution ---------------------------
@@ -352,6 +377,20 @@ class _Visitor(ast.NodeVisitor):
             # the unaliased dotted form: jax.experimental.pallas.pallas_call
             return self._dotted(f.value) == "jax.experimental.pallas"
         return isinstance(f, ast.Name) and f.id in self.pallas_call_funcs
+
+    def _instrument_kind(self, node: ast.Call) -> Optional[str]:
+        """\"counter\"/\"gauge\"/\"histogram\" when the call is
+        attributable to the telemetry module (module alias or
+        from-import), else None."""
+        f = node.func
+        if isinstance(f, ast.Attribute) \
+                and f.attr in ("counter", "gauge", "histogram") \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in self.telemetry_modules:
+            return f.attr
+        if isinstance(f, ast.Name):
+            return self.instrument_funcs.get(f.id)
+        return None
 
     def _np_sort_kind(self, node: ast.Call) -> Optional[str]:
         """\"argsort\"/\"searchsorted\" when the call is attributable to
@@ -491,6 +530,26 @@ class _Visitor(ast.NodeVisitor):
                 "a Mosaic rejection at production shapes fails the fit "
                 "instead of degrading; move it (or mark a deliberately "
                 f"un-gated kernel '# {ALLOW_PALLAS} — <reason>')")
+        elif self._instrument_kind(node) is not None \
+                and not self.metric_exempt \
+                and not self._marked(node.lineno, ALLOW_METRIC_NAME):
+            inst_kind = self._instrument_kind(node)
+            name_arg = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    name_arg = kw.value
+            if not (isinstance(name_arg, ast.Constant)
+                    and isinstance(name_arg.value, str)):
+                self._add(
+                    "TMG313", node.lineno,
+                    f"telemetry.{inst_kind}() with a non-literal metric "
+                    "name outside telemetry.py — a dynamic name is "
+                    "unbounded registry/exposition cardinality (every "
+                    "distinct runtime value is a new instrument held "
+                    "for the process lifetime and a new /metrics "
+                    "family); use a literal name, or mark a "
+                    "deliberately dynamic-but-BOUNDED name "
+                    f"'# {ALLOW_METRIC_NAME} — <reason>'")
         else:
             sort_kind = self._np_sort_kind(node)
             if sort_kind is not None \

@@ -159,7 +159,7 @@ def reset_continual_stats() -> None:
 def _tally(key: str, n: int = 1) -> None:
     with _TALLY_LOCK:
         _TALLY[key] += n
-    telemetry.counter(f"continual.{key}").inc(n)
+    telemetry.counter(f"continual.{key}").inc(n)  # lint: metric-name — keys are the fixed continual_stats tally catalog
 
 
 class ContinualError(Exception):
@@ -285,7 +285,8 @@ class RetrainController:
                  promote_windows: Optional[int] = None,
                  holdout_metric: str = "AuPR",
                  holdout_tolerance: float = 0.0,
-                 spawn_env: Optional[Dict[str, str]] = None):
+                 spawn_env: Optional[Dict[str, str]] = None,
+                 trace_dir: Optional[str] = None):
         if registry is None:
             raise ContinualError("RetrainController needs a registry")
         cmd = validate_retrain_cmd(retrain_cmd)
@@ -312,6 +313,11 @@ class RetrainController:
         self.holdout_metric = str(holdout_metric)
         self.holdout_tolerance = float(holdout_tolerance)
         self.spawn_env = dict(spawn_env) if spawn_env else None
+        #: shared trace-shard directory (customParams.traceDir): the
+        #: retrain subprocess inherits it (TMOG_TRACE_DIR) so its
+        #: runner writes a shard into the SAME merge set as the fleet
+        #: (docs/observability.md "Distributed tracing")
+        self.trace_dir = str(trace_dir) if trace_dir else None
         os.makedirs(os.path.join(self.job_dir, JOBS_DIR), exist_ok=True)
         self._lock = threading.Lock()
         self._streak = 0
@@ -446,10 +452,16 @@ class RetrainController:
         now = time.time()   # lint: wall-clock
         job_id = f"job-{int(now * 1000):013d}-{os.getpid()}"
         out_dir = os.path.join(self.job_dir, JOBS_DIR, job_id + ".out")
+        # the triggering window's trace context (or a fresh root when
+        # the trigger ran untraced): persisted in the record so the
+        # retrain SUBPROCESS joins the same trace via TMOG_TRACE_PARENT
+        # — and so replay()/recover() keep the original identity
+        ctx = telemetry.current_trace() or telemetry.mint_trace()
         return {"jobId": job_id, "model": self.name, "state": PENDING,
                 "trigger": trigger, "cmd": list(self.retrain_cmd),
                 "outDir": out_dir,
                 "log": self._job_path(job_id)[:-5] + ".log",
+                "traceparent": telemetry.format_traceparent(*ctx),
                 "createdAt": now, "controllerPid": os.getpid(),
                 "pid": None, "exitCode": None, "version": None,
                 "error": None, "replayable": False}
@@ -488,6 +500,15 @@ class RetrainController:
         env["TMOG_RETRAIN_STABLE"] = stable_dir or ""
         env["TMOG_RETRAIN_TRIGGER"] = job["outDir"] + ".trigger.json"
         env["TMOG_RETRAIN_HEARTBEAT"] = job["outDir"] + ".heartbeat"
+        # trace inheritance: the trainer's spans join the triggering
+        # window's trace, its merged-trace row is named "retrain", and
+        # (when the fleet shares a shard directory) its shard lands in
+        # the same trace merge set
+        if job.get("traceparent"):
+            env[telemetry.TRACE_ENV] = job["traceparent"]
+            env[telemetry.TRACE_ROLE_ENV] = "retrain"
+        if self.trace_dir:
+            env["TMOG_TRACE_DIR"] = self.trace_dir
         return env
 
     def _run_job(self, job: Dict[str, Any]) -> None:
@@ -507,7 +528,14 @@ class RetrainController:
         import fcntl
         try:
             try:
-                self._execute_job(job)
+                # the controller's own spans ride the job's trace: one
+                # trace covers drift window → controller → trainer
+                # subprocess → register/deploy
+                with telemetry.trace_scope(job.get("traceparent")):
+                    with telemetry.span("continual:job",
+                                        model=self.name,
+                                        job=job["jobId"]):
+                        self._execute_job(job)
             except Exception as e:  # lint: broad-except — the job thread is a never-raises boundary; any failure feeds the storm controls
                 logger.exception("continual: job %s failed",
                                  job["jobId"])

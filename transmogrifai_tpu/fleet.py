@@ -151,7 +151,7 @@ def reset_fleet_stats() -> None:
 def _tally(key: str, n: int = 1) -> None:
     with _TALLY_LOCK:
         _TALLY[key] += n
-    telemetry.counter(f"fleet.{key}").inc(n)
+    telemetry.counter(f"fleet.{key}").inc(n)  # lint: metric-name — keys are the fixed fleet_stats tally catalog
 
 
 class FleetError(Exception):
@@ -596,11 +596,14 @@ def _rendezvous(key: bytes, workers: List[WorkerHandle]
 
 
 def _forward(h: WorkerHandle, method: str, path: str,
-             body: Optional[bytes], timeout_s: float
+             body: Optional[bytes], timeout_s: float,
+             headers: Optional[Dict[str, str]] = None
              ) -> Tuple[int, bytes]:
     """One forward attempt to one worker; raises OSError on transport
     failure (the failover trigger). ``fleet.forward`` fires first so
-    chaos plans can fail forwards deterministically."""
+    chaos plans can fail forwards deterministically. ``headers``
+    overlay the defaults — the router's minted ``X-Tmog-Trace`` rides
+    here (docs/observability.md "Distributed tracing")."""
     resilience.inject("fleet.forward", worker=h.wid, path=path)
     if h.port is None:
         # mid-respawn: the new process has not reported its port yet
@@ -608,9 +611,11 @@ def _forward(h: WorkerHandle, method: str, path: str,
     conn = http.client.HTTPConnection("127.0.0.1", h.port,
                                       timeout=timeout_s)
     try:
-        conn.request(method, path, body,
-                     {"Content-Type": "application/json"}
-                     if body is not None else {})
+        hdrs = ({"Content-Type": "application/json"}
+                if body is not None else {})
+        if headers:
+            hdrs.update(headers)
+        conn.request(method, path, body, hdrs)
         resp = conn.getresponse()
         return resp.status, resp.read()
     finally:
@@ -668,8 +673,23 @@ def serve_fleet_http(supervisor: FleetSupervisor,
             did not apply it, and a blind sibling retry would
             double-apply the pointer mutation. A worker-ANSWERED
             429/503 means the request was refused before it was
-            applied, so the sibling retry stays safe either way."""
+            applied, so the sibling retry stays safe either way.
+
+            The router is the fleet's trace entry point: every routed
+            request carries an ``X-Tmog-Trace`` traceparent — the
+            client's if it sent one, MINTED here otherwise — so the
+            router span, the worker's request span and the
+            micro-batcher's batch span all share one trace id
+            (docs/observability.md "Distributed tracing"). A failover
+            retry reuses the same traceparent: one request, one trace,
+            however many workers it visited."""
             _tally("routed_requests")
+            trace_hdr = self.headers.get(telemetry.TRACE_HEADER)
+            ctx = telemetry.parse_traceparent(trace_hdr)
+            if ctx is None:
+                ctx = telemetry.mint_trace()
+                trace_hdr = telemetry.format_traceparent(*ctx)
+            fwd_headers = {telemetry.TRACE_HEADER: trace_hdr}
             candidates = _rendezvous(key, supervisor.ready_workers())
             if not candidates:
                 _tally("shed_503")
@@ -692,8 +712,14 @@ def serve_fleet_http(supervisor: FleetSupervisor,
                     _tally("failovers")
                 try:
                     _tally("forwards")
-                    status, payload = _forward(h, method, self.path,
-                                               body, forward_timeout_s)
+                    with telemetry.trace_scope(ctx):
+                        with telemetry.span(
+                                "fleet:route", worker=h.wid,
+                                path=self.path, attempt=attempts):
+                            status, payload = _forward(
+                                h, method, self.path, body,
+                                forward_timeout_s,
+                                headers=fwd_headers)
                 except OSError as e:
                     h.breaker.record_failure()
                     logger.warning("fleet: forward to worker %d "
@@ -762,7 +788,47 @@ def serve_fleet_http(supervisor: FleetSupervisor,
             doc["aggregate"] = agg
             return doc
 
+        def _metrics(self) -> None:
+            """The router's live Prometheus plane: its OWN registry
+            (fleet.* counters) plus every READY worker's ``/metrics``
+            scrape, merged by SUMMING samples with the same name+labels
+            and re-rendering (`telemetry.render_prometheus_sum`) — the
+            fleet-wide scrape surface ``/stats`` never was. Unreachable
+            workers are skipped (scrape-time liveness is the probe
+            loop's job, not the scraper's); the worker count that
+            actually answered rides in ``fleet_metrics_workers``."""
+            docs = [telemetry.parse_prometheus(
+                telemetry.render_prometheus())]
+            answered = 0
+            for h in supervisor.ready_workers():
+                try:
+                    status, payload = _forward(h, "GET", "/metrics",
+                                               None, forward_timeout_s)
+                    if status != 200:
+                        continue
+                    # one parse per worker: it both validates (a bad
+                    # scrape is skipped, not summed) and feeds the
+                    # merge directly
+                    docs.append(telemetry.parse_prometheus(
+                        payload.decode("utf-8", "replace")))
+                    answered += 1
+                except (OSError, ValueError) as e:
+                    logger.warning("fleet: /metrics scrape of worker "
+                                   "%d failed: %r", h.wid, e)
+            body = telemetry.merge_parsed_prometheus(docs)
+            body += (f"# TYPE fleet_metrics_workers gauge\n"
+                     f"fleet_metrics_workers {answered}\n")
+            raw = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
         def do_GET(self):
+            if self.path == "/metrics":
+                return self._metrics()
             if self.path == "/healthz":
                 return self._send(200, {
                     "status": "ok",

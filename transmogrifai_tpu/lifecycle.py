@@ -116,7 +116,7 @@ def tally(key: str, n: int = 1) -> None:
     this table so every lifecycle fact lands in ONE stamped block)."""
     with _TALLY_LOCK:
         _TALLY[key] += n
-    telemetry.counter(f"lifecycle.{key}").inc(n)
+    telemetry.counter(f"lifecycle.{key}").inc(n)  # lint: metric-name — keys are the fixed lifecycle_stats tally catalog
 
 
 # ---------------------------------------------------------------------------
@@ -719,9 +719,9 @@ class DriftSentinel:
                            window_rows=int(rows))
         if telemetry.enabled():
             for fname, info in feats.items():
-                telemetry.gauge(f"drift.js_divergence.{fname}").set(
+                telemetry.gauge(f"drift.js_divergence.{fname}").set(  # lint: metric-name — bounded by the model's persisted feature set
                     info["js"])
-                telemetry.gauge(f"drift.fill_rate_delta.{fname}").set(
+                telemetry.gauge(f"drift.fill_rate_delta.{fname}").set(  # lint: metric-name — bounded by the model's persisted feature set
                     info["fillDelta"])
 
     # -- stats -------------------------------------------------------------

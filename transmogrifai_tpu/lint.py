@@ -169,6 +169,14 @@ RULES: Dict[str, Tuple[str, str]] = {
                "or a Mosaic rejection at production shapes fails the "
                "run instead of retracing onto the XLA path (allow: "
                "'# lint: pallas — reason')"),
+    "TMG313": (Severity.ERROR,
+               "telemetry.counter/gauge/histogram() with a non-literal "
+               "metric name outside telemetry.py — a dynamic name is "
+               "unbounded registry and /metrics exposition cardinality "
+               "(every distinct name is a new instrument held forever "
+               "and a new scrape family); use a literal name, or mark "
+               "a deliberately dynamic-but-bounded name "
+               "'# lint: metric-name — reason')"),
     # -- TMG5xx: serving / AOT-bank advisories (aot.py, serving.py,
     #    server.py) — degradation notices, never crash paths ---------------
     "TMG501": (Severity.WARNING,
@@ -321,7 +329,7 @@ def emit_findings(findings: Sequence[Finding]) -> None:
     names = {Severity.ERROR: "lint.errors", Severity.WARNING:
              "lint.warnings", Severity.INFO: "lint.info"}
     for f in findings:
-        telemetry.counter(names[f.severity]).inc()
+        telemetry.counter(names[f.severity]).inc()  # lint: metric-name — three fixed severity names
         telemetry.emit("lint", rule=f.rule, severity=f.severity,
                        message=f.message, stage=f.stage,
                        feature=f.feature, location=f.location)

@@ -521,12 +521,18 @@ def _count_dispatch(exe, extra_flops: float = 0.0) -> None:
     """Accumulate one dispatch's FLOPs: XLA cost analysis of the
     executable PLUS ``extra_flops`` — the analytic estimate of work
     inside Pallas custom calls, which cost analysis cannot see (without
-    it the round-4 kernel migration made the MFU numerator collapse)."""
+    it the round-4 kernel migration made the MFU numerator collapse).
+    Mirrors into the process-wide device-cost ledger
+    (``telemetry.record_device_work``) under the ``tuning`` phase —
+    FLOPs only, no per-dispatch timing here (the sweep executables run
+    under thread-pool overlap, so a wall timer would double-count)."""
     f = _EXE_FLOPS.get(id(exe))
     if f is None:
         _register_exe_flops(exe)
         f = _EXE_FLOPS[id(exe)]
     DEVICE_FLOPS["total"] += f + extra_flops
+    from .. import telemetry
+    telemetry.record_device_work("tuning", flops=f + extra_flops)
 
 
 def _pallas_on() -> bool:

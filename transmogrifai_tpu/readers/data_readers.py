@@ -563,7 +563,7 @@ def stream_score(model, batches: Iterable[Sequence[Mapping[str, Any]]],
         use_overlap = ok and (overlap is True
                               or len(first) >= SCORING_MIN_ROWS)
     # routing evidence: which streaming mode actually served the batches
-    telemetry.counter("stream.overlapped_streams" if use_overlap
+    telemetry.counter("stream.overlapped_streams" if use_overlap  # lint: metric-name — one of two literal names
                       else "stream.plain_streams").inc()
     if use_overlap:
         from ..scoring import stream_score_overlapped

@@ -382,6 +382,14 @@ class OpWorkflowRunner:
             logger.exception("cost-db recording failed; the pre-fit "
                              "plan stamp stands")
 
+    @staticmethod
+    def _shard_role(run_type: str) -> str:
+        """This run's row name in merged traces: an explicit
+        TMOG_TRACE_ROLE (the retrain controller sets ``retrain``) wins;
+        the default names the run type."""
+        role = telemetry.trace_role()
+        return role if role != "proc" else f"run-{run_type.lower()}"
+
     # -- metrics sink ------------------------------------------------------
     @staticmethod
     def _write_metrics(location: Optional[str], doc: Dict[str, Any],
@@ -420,7 +428,20 @@ class OpWorkflowRunner:
         # runs of a long-lived process that never asked (a user-level
         # telemetry.enable() before the run stays in force, untouched)
         run_scoped = False
-        if params.telemetry_requested() and not telemetry.enabled():
+        # cross-process trace shards (docs/observability.md
+        # "Distributed tracing"): customParams.traceDir — or the
+        # TMOG_TRACE_DIR a supervising process (fleet worker, retrain
+        # controller) handed down — asks this run to record spans and
+        # drop one atomic shard into the shared merge directory; the
+        # TMOG_TRACE_PARENT env (if any) joins its spans to the
+        # originating trace automatically (telemetry.current_trace).
+        trace_dir = params.custom_params.get("traceDir") \
+            or os.environ.get("TMOG_TRACE_DIR")
+        if trace_dir is not None and not isinstance(trace_dir, str):
+            raise ValueError("customParams.traceDir must be a path "
+                             f"string, got {trace_dir!r}")
+        if (params.telemetry_requested() or trace_dir) \
+                and not telemetry.enabled():
             telemetry.enable()
             run_scoped = True
         # persistent XLA compile cache (OpParams.customParams
@@ -578,6 +599,14 @@ class OpWorkflowRunner:
                     # "Tree training engine")
                     from .models import _pallas_hist as _ph
                     result.metrics["trees"] = _ph.tree_kernel_stats()
+                    # executed-FLOP device cost attribution rides on
+                    # every doc too: per-phase flops/seconds and the
+                    # derived achieved-TFLOP/s + MFU percentages
+                    # (None off-TPU) — the instrumentation half of the
+                    # "confirm the MFU jump on hardware" stretch
+                    # (telemetry.device_cost_stats, docs/observability
+                    # .md "MFU")
+                    result.metrics["mfu"] = telemetry.device_cost_stats()
                     if collector is not None:
                         result.metrics["telemetry"] = collector.summary()
                         result.metrics["telemetryMetrics"] = \
@@ -587,12 +616,21 @@ class OpWorkflowRunner:
                                         fmt=params.metrics_format)
                     if params.trace_location:
                         telemetry.write_trace(params.trace_location)
-                elif params.trace_location:
+                    if trace_dir:
+                        telemetry.write_trace_shard(
+                            str(trace_dir), role=self._shard_role(
+                                run_type))
+                elif params.trace_location or trace_dir:
                     # a crashed run is the run you most want the trace
                     # of: flush the spans recorded up to the failure
                     # (best-effort — never mask the run's exception)
                     try:
-                        telemetry.write_trace(params.trace_location)
+                        if params.trace_location:
+                            telemetry.write_trace(params.trace_location)
+                        if trace_dir:
+                            telemetry.write_trace_shard(
+                                str(trace_dir), role=self._shard_role(
+                                    run_type))
                     except Exception:  # lint: broad-except — best-effort crash trace, never mask the run error
                         logger.exception("trace write failed")
             finally:

@@ -305,6 +305,13 @@ class ScoringEngine:
         self._programs: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._compile_count = 0
         self._lock = threading.Lock()
+        #: id(program) -> executed FLOPs per dispatch, for the MFU
+        #: block (telemetry.record_device_work): XLA cost analysis when
+        #: the program exposes it (AOT-banked executables), else the
+        #: analytic lower bound from the fused plan. id() reuse is
+        #: harmless — a new program re-registers before any dispatch
+        #: (the models/tuning.DEVICE_FLOPS discipline, generalized).
+        self._prog_flops: Dict[int, float] = {}
         #: host_prepare amortization: repeat calls on the SAME ColumnStore
         #: (score → evaluate, warm benchmark reps) skip the whole host
         #: half. Weakref-validated identity keys — a dead or different
@@ -823,7 +830,9 @@ class ScoringEngine:
         load) is assertable. Subject to the same LRU cap as JIT
         programs."""
         with self._lock:
-            self._programs.pop(key, None)
+            old = self._programs.pop(key, None)
+            if old is not None:
+                self._prog_flops.pop(id(old), None)
             self._programs[key] = fn
             with _CACHE_STATS_LOCK:
                 _CACHE_STATS["preloads"] += 1
@@ -838,9 +847,13 @@ class ScoringEngine:
 
     def _evict_over_cap_locked(self) -> None:
         """LRU trim (caller holds ``self._lock``); evictions are tallied
-        so a bank-evicted program is visible in bench docs."""
+        so a bank-evicted program is visible in bench docs. The evicted
+        program's FLOP-cache entry goes with it — a GC'd program's id()
+        can be reused by a NEW program, which would otherwise inherit
+        the dead program's per-dispatch FLOPs into the mfu block."""
         while len(self._programs) > PROGRAM_CACHE_CAP:
-            self._programs.popitem(last=False)
+            _key, fn = self._programs.popitem(last=False)
+            self._prog_flops.pop(id(fn), None)
             with _CACHE_STATS_LOCK:
                 _CACHE_STATS["evictions"] += 1
             telemetry.counter("scoring.cache_evictions").inc()
@@ -869,6 +882,54 @@ class ScoringEngine:
             telemetry.counter("scoring.compile_count").inc()
             self._evict_over_cap_locked()
         return fn
+
+    # -- executed-FLOP attribution (the MFU block) -------------------------
+    def _analytic_flops(self, bucket: int) -> float:
+        """Documented LOWER BOUND on one dispatch's FLOPs from the
+        fused plan's static widths: the scale and predict arithmetic is
+        counted (2 flops per element for (x−mean)/std, a ×2-output
+        matvec for the head), vectorizer internals and nonlinearities
+        are not — erring low is the same stance as the Pallas analytic
+        estimate (docs/performance.md "MFU")."""
+        w: Dict[str, Optional[int]] = {}
+        per_row = 0.0
+        for it in self._plan:
+            if it.kind == "vec":
+                w[it.out] = it.model.vector_metadata().size
+                per_row += 2.0 * (w[it.out] or 0)
+            elif it.kind == "combine":
+                w[it.out] = sum(w.get(nm) or 0 for nm in it.ins)
+            elif it.kind == "select":
+                w[it.out] = len(it.model.keep_indices)
+            elif it.kind == "scale":
+                w[it.out] = w.get(it.ins[0]) or 0
+                per_row += 2.0 * (w[it.out] or 0)
+            elif it.kind == "predict":
+                per_row += 4.0 * (w.get(it.ins[0]) or 0)
+        return per_row * max(int(bucket), 1)
+
+    def _program_flops(self, fn, bucket: int) -> float:
+        """Per-dispatch FLOPs for one cached program: XLA cost analysis
+        when the program exposes it (deserialized AOT executables),
+        else the analytic plan bound — cached by id(fn), the
+        models/tuning._register_exe_flops discipline."""
+        f = self._prog_flops.get(id(fn))
+        if f is None:
+            f = 0.0
+            try:
+                ca = fn.cost_analysis()
+                d = ca[0] if isinstance(ca, (list, tuple)) else ca
+                f = float(d.get("flops", 0.0))
+            except Exception:  # lint: broad-except — cost analysis is best-effort (backend/program-kind dependent)
+                f = 0.0
+            if f <= 0.0:
+                f = self._analytic_flops(bucket)
+            if len(self._prog_flops) > 4 * PROGRAM_CACHE_CAP:
+                # stale id()s of LRU-evicted programs: a few floats,
+                # but never unbounded in a long-lived server
+                self._prog_flops.clear()
+            self._prog_flops[id(fn)] = f
+        return f
 
     # -- output wiring -----------------------------------------------------
     def _out_names(self, results_only: bool) -> List[str]:
@@ -1040,7 +1101,16 @@ class ScoringEngine:
                 with telemetry.span("score:bucket", rows=n, bucket=bucket,
                                     compiled=was_compile, staged=True,
                                     data_shards=chunk.shards):
+                    t_d0 = time.perf_counter()
                     outs = jax.device_get(chunk.fn(prepared, uploads))
+                    if not was_compile:
+                        # warm dispatches only: a compile riding the
+                        # first call must not pollute the MFU
+                        # denominator (docs/observability.md "MFU")
+                        telemetry.record_device_work(
+                            "scoring",
+                            flops=self._program_flops(chunk.fn, bucket),
+                            seconds=time.perf_counter() - t_d0)
             elif out_names:
                 mesh = self._chunk_mesh(bucket)
                 before = self._compile_count
@@ -1057,7 +1127,14 @@ class ScoringEngine:
                                     compiled=was_compile,
                                     data_shards=(mesh.shape["data"]
                                                  if mesh is not None else 1)):
+                    t_d0 = time.perf_counter()
                     outs = jax.device_get(fn(prepared, uploads))  # one pull
+                    if not was_compile:
+                        # warm dispatches only (see the staged branch)
+                        telemetry.record_device_work(
+                            "scoring",
+                            flops=self._program_flops(fn, bucket),
+                            seconds=time.perf_counter() - t_d0)
             else:
                 outs = {}
             store = host_store

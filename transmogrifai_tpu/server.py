@@ -84,6 +84,19 @@ DEFAULT_MAX_MODELS = 4
 #: per-model latency reservoir for exact p50/p95/p99 in stats
 _LATENCY_WINDOW = 4096
 
+#: per-request latency-decomposition phases (docs/observability.md
+#: "Latency decomposition"): time in the bounded queue before first
+#: worker pickup, time held open for co-riding requests, the device
+#: (or host-fallback) dispatch itself, and result scatter-back
+_LATENCY_PHASES = ("queueWait", "coalesceHold", "deviceDispatch",
+                   "scatter")
+
+#: telemetry histogram suffix per phase (server.<suffix>.<model>)
+_PHASE_METRIC = {"queueWait": "queue_wait_seconds",
+                 "coalesceHold": "coalesce_hold_seconds",
+                 "deviceDispatch": "device_dispatch_seconds",
+                 "scatter": "scatter_seconds"}
+
 #: default request fraction a canary rollout routes to the candidate
 DEFAULT_CANARY_FRACTION = 0.1
 
@@ -285,13 +298,27 @@ class _Rollout:
 
 
 class _Request:
-    __slots__ = ("records", "future", "t_enqueued", "rows")
+    __slots__ = ("records", "future", "t_enqueued", "rows", "trace",
+                 "t_dequeued", "t_dispatch0", "t_dispatch1",
+                 "dispatch_s")
 
-    def __init__(self, records: List[Dict[str, Any]]):
+    def __init__(self, records: List[Dict[str, Any]],
+                 trace: Optional[tuple] = None):
         self.records = list(records)
         self.rows = len(self.records)
         self.future: "Future[RequestResult]" = Future()
         self.t_enqueued = time.perf_counter()
+        #: (trace_id, span_id) of the request span that enqueued this —
+        #: the micro-batcher links it from the batch span
+        #: (docs/observability.md "Distributed tracing")
+        self.trace = trace
+        #: latency-decomposition marks (docs/observability.md "Latency
+        #: decomposition"): first worker pickup, dispatch start/end and
+        #: this request's share of device-dispatch time
+        self.t_dequeued: Optional[float] = None
+        self.t_dispatch0: Optional[float] = None
+        self.t_dispatch1: Optional[float] = None
+        self.dispatch_s: Optional[float] = None
 
 
 _SENTINEL = object()
@@ -326,6 +353,18 @@ class _ModelEntry:
         self.lock = threading.Lock()       # guards load/unload
         self.worker: Optional[threading.Thread] = None
         self.latencies: "deque[float]" = deque(maxlen=_LATENCY_WINDOW)
+        #: per-phase latency reservoirs — the end-to-end number above,
+        #: decomposed: where did this request's milliseconds go?
+        #: (docs/observability.md "Latency decomposition")
+        self.decomp: Dict[str, "deque[float]"] = {
+            ph: deque(maxlen=_LATENCY_WINDOW) for ph in _LATENCY_PHASES}
+        #: per-tenant telemetry metric names, formatted ONCE (the
+        #: completion path observes several per request)
+        self.metric_names = {
+            "request": f"server.request_seconds.{name}",
+            "queue": f"server.queue_depth.{name}",
+            **{ph: f"server.{_PHASE_METRIC[ph]}.{name}"
+               for ph in _LATENCY_PHASES}}
         self.requests = 0
         self.failures = 0
         self.rows = 0
@@ -333,13 +372,17 @@ class _ModelEntry:
         self.bank_hit_batches = 0
         self.loads = 0
 
+    @staticmethod
+    def _pct(values) -> Dict[str, float]:
+        lat = np.asarray(values, dtype=np.float64)
+        if not lat.size:
+            return {}
+        return {"p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
+                "p95_ms": round(float(np.percentile(lat, 95)) * 1e3, 3),
+                "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3)}
+
     def stats(self) -> Dict[str, Any]:
-        lat = np.asarray(self.latencies, dtype=np.float64)
-        pct = {}
-        if lat.size:
-            pct = {"p50_ms": round(float(np.percentile(lat, 50)) * 1e3, 3),
-                   "p95_ms": round(float(np.percentile(lat, 95)) * 1e3, 3),
-                   "p99_ms": round(float(np.percentile(lat, 99)) * 1e3, 3)}
+        pct = self._pct(self.latencies)
         rollout = self.rollout
         sentinel = self.sentinel
         return {"loaded": self.model is not None, "pinned": self.pinned,
@@ -352,6 +395,9 @@ class _ModelEntry:
                 "viaRegistry": self.via_registry,
                 "rollout": rollout.status() if rollout else None,
                 "drift": sentinel.stats() if sentinel else None,
+                "latency": {"e2e": pct,
+                            **{ph: self._pct(self.decomp[ph])
+                               for ph in _LATENCY_PHASES}},
                 **pct}
 
 
@@ -611,11 +657,14 @@ class ModelServer:
                 telemetry.counter("server.model_evictions").inc()
 
     # -- request entry -----------------------------------------------------
-    def submit(self, name: str, records: List[Dict[str, Any]]):
+    def submit(self, name: str, records: List[Dict[str, Any]],
+               trace: Optional[tuple] = None):
         """Enqueue a scoring request; returns a
         ``concurrent.futures.Future[RequestResult]``. Raises
         :class:`ModelNotFound` / :class:`ServerBusy` /
-        :class:`ServerClosed` synchronously (admission control)."""
+        :class:`ServerClosed` synchronously (admission control).
+        ``trace`` is the submitting request span's (trace_id, span_id)
+        — the coalesced batch span links it."""
         with self._lock:
             if self._closed:
                 raise ServerClosed("server is shut down")
@@ -625,7 +674,7 @@ class ModelServer:
         if entry is None:
             raise ModelNotFound(f"no model {name!r} registered "
                                 f"(have: {self.models()})")
-        req = _Request(records)
+        req = _Request(records, trace=trace)
         try:
             entry.queue.put_nowait(req)
         except queue.Full:
@@ -635,7 +684,7 @@ class ModelServer:
                 f"model {name!r} queue is full ({self.max_queue} "
                 "pending) — back off and retry") from None
         if telemetry.enabled():
-            telemetry.gauge(f"server.queue_depth.{name}").set(
+            telemetry.gauge(f"server.queue_depth.{name}").set(  # lint: metric-name — per-tenant gauge, bounded by the registered roster
                 entry.queue.qsize())
         return req.future
 
@@ -653,9 +702,10 @@ class ModelServer:
             item = entry.queue.get()
             if item is _SENTINEL:
                 break
+            item.t_dequeued = time.perf_counter()
             batch: List[_Request] = [item]
             rows = item.rows
-            deadline = time.perf_counter() + self.batch_deadline_s
+            deadline = item.t_dequeued + self.batch_deadline_s
             # dynamic micro-batching: hold the dispatch open until the
             # deadline (or the bucket cap) for co-riding requests
             while rows < cap:
@@ -669,6 +719,7 @@ class ModelServer:
                 if nxt is _SENTINEL:
                     stop = True        # drain this batch, then exit
                     break
+                nxt.t_dequeued = time.perf_counter()
                 batch.append(nxt)
                 rows += nxt.rows
             self._dispatch(entry, batch)
@@ -681,6 +732,7 @@ class ModelServer:
             except queue.Empty:
                 break
             if item is not _SENTINEL:
+                item.t_dequeued = time.perf_counter()
                 leftovers.append(item)
         if leftovers:
             self._dispatch(entry, leftovers)
@@ -783,7 +835,14 @@ class ModelServer:
         cap = eng.bucket_cap if eng is not None \
             else (self.bucket_cap or DEFAULT_BUCKET_CAP)
         bucket = bucket_for(n, int(cap)) if n else 0
+        # trace stitching (docs/observability.md "Distributed
+        # tracing"): the batch span adopts the FIRST traced member's
+        # trace id and links every member request's span id — one batch
+        # span referencing the request spans it coalesced
+        member_traces = [req.trace for req in batch if req.trace]
         t0 = time.perf_counter()
+        for req in batch:
+            req.t_dispatch0 = t0
         store = None
         engine_tier = False
         brk = model._engine_breaker()
@@ -791,10 +850,29 @@ class ModelServer:
             try:
                 resilience.inject("server.dispatch", model=entry.name,
                                   rows=n, requests=len(batch))
-                with telemetry.span("server:dispatch", model=entry.name,
-                                    rows=n, requests=len(batch),
-                                    bucket=bucket):
-                    store = eng.score_store(records, use_cache=False)
+                # the decomposition rides in the trace too: the span's
+                # own duration IS device-dispatch; queue-wait and
+                # coalesce-hold (worst member) stamp as args — computed
+                # only while recording, the hot path pays nothing off
+                span_kw: Dict[str, Any] = {}
+                if telemetry.enabled():
+                    span_kw["queue_wait_s"] = round(max(
+                        (req.t_dequeued - req.t_enqueued
+                         for req in batch
+                         if req.t_dequeued is not None),
+                        default=0.0), 6)
+                    span_kw["coalesce_hold_s"] = round(max(
+                        (t0 - req.t_dequeued for req in batch
+                         if req.t_dequeued is not None),
+                        default=0.0), 6)
+                with telemetry.trace_scope(
+                        member_traces[0] if member_traces else None):
+                    with telemetry.span(
+                            "server:dispatch", model=entry.name,
+                            rows=n, requests=len(batch), bucket=bucket,
+                            links=[t[1] for t in member_traces],
+                            **span_kw):
+                        store = eng.score_store(records, use_cache=False)
                 brk.record_success()
                 engine_tier = True
             except Exception:  # lint: broad-except — breaker-governed device-tier fallback (per-request host retry follows)
@@ -807,6 +885,10 @@ class ModelServer:
         self._account_batch(entry, n, len(batch),
                             engine_tier and bucket in bank_buckets)
         if store is not None:
+            t1 = time.perf_counter()
+            for req in batch:
+                req.t_dispatch1 = t1
+                req.dispatch_s = disp_s
             self._scatter_store(entry, batch, store, bucket, engine_tier)
             return store, bucket, disp_s
         for req in batch:
@@ -816,9 +898,12 @@ class ModelServer:
             # (a solo retry IS a dispatch), so chaos plans can poison
             # individual requests deterministically
             try:
+                req.t_dispatch0 = time.perf_counter()
                 resilience.inject("server.dispatch", model=entry.name,
                                   rows=req.rows, requests=1)
                 sub = model.score(req.records, engine=False)
+                req.t_dispatch1 = time.perf_counter()
+                req.dispatch_s = req.t_dispatch1 - req.t_dispatch0
             except Exception as e:  # lint: broad-except — both tiers rejected: the request is poison, quarantined not fatal
                 resilience.quarantine(
                     "server.dispatch", repr(e), kind="batches",
@@ -911,13 +996,20 @@ class ModelServer:
             rollout.win_failures += 1
             return False
         bucket = bucket_for(n, int(eng.bucket_cap))
+        member_traces = [req.trace for req in batch if req.trace]
+        t0 = time.perf_counter()
+        for req in batch:
+            req.t_dispatch0 = t0
         try:
             resilience.inject("server.dispatch", model=entry.name,
                               rows=n, requests=len(batch), canary=True)
-            with telemetry.span("server:canary_dispatch",
-                                model=entry.name, rows=n,
-                                version=rollout.version, bucket=bucket):
-                store = eng.score_store(records, use_cache=False)
+            with telemetry.trace_scope(
+                    member_traces[0] if member_traces else None):
+                with telemetry.span(
+                        "server:canary_dispatch", model=entry.name,
+                        rows=n, version=rollout.version, bucket=bucket,
+                        links=[t[1] for t in member_traces]):
+                    store = eng.score_store(records, use_cache=False)
             brk.record_success()
         except Exception:  # lint: broad-except — a failing candidate is rollout evidence; its requests re-dispatch on the stable tier
             brk.record_failure()
@@ -927,6 +1019,10 @@ class ModelServer:
                 "re-dispatches on the stable tier", entry.name,
                 rollout.version)
             return False
+        disp_s = time.perf_counter() - t0
+        for req in batch:
+            req.t_dispatch1 = t0 + disp_s
+            req.dispatch_s = disp_s
         self._account_batch(entry, n, len(batch),
                             bucket in rollout.bank_buckets)
         self._scatter_store(entry, batch, store, bucket, True,
@@ -1250,7 +1346,10 @@ class ModelServer:
                     records = records + nxt[1]
                 sentinel = entry.sentinel
                 if sentinel is not None:
-                    sentinel.observe(records)
+                    with telemetry.span("server:drift_observe",
+                                        model=entry.name,
+                                        rows=len(records)):
+                        sentinel.observe(records)
             except Exception:  # lint: broad-except — drift observation must never take down its thread (satellite: catch-and-tally, keep serving)
                 lifecycle.tally("sentinel_errors")
                 logger.exception("server: drift observation failed "
@@ -1301,22 +1400,50 @@ class ModelServer:
         _tally("slo_met" if met else "slo_missed")
         return met
 
+    def _observe_decomp(self, entry: _ModelEntry, req: _Request,
+                        now: float) -> None:
+        """Fold one completed request's latency decomposition into the
+        per-model reservoirs (always on — ``/stats``) and the per-model
+        telemetry histograms (``/metrics``): queue-wait → coalesce-hold
+        → device-dispatch → scatter. Requests that skipped a phase
+        (host fallback, drain path) record what they measured and skip
+        the rest — a partial decomposition must never invent time."""
+        phases: Dict[str, float] = {}
+        if req.t_dequeued is not None:
+            phases["queueWait"] = max(req.t_dequeued - req.t_enqueued,
+                                      0.0)
+            if req.t_dispatch0 is not None:
+                phases["coalesceHold"] = max(
+                    req.t_dispatch0 - req.t_dequeued, 0.0)
+        if req.dispatch_s is not None:
+            phases["deviceDispatch"] = req.dispatch_s
+        if req.t_dispatch1 is not None:
+            phases["scatter"] = max(now - req.t_dispatch1, 0.0)
+        on = telemetry.enabled()
+        for ph, v in phases.items():
+            entry.decomp[ph].append(v)
+            if on:
+                telemetry.histogram(  # lint: metric-name — per-tenant decomposition, bounded by the registered roster
+                    entry.metric_names[ph]).observe(v)
+
     def _complete(self, entry: _ModelEntry, req: _Request, store,
                   bucket: int, coalesced: int, engine_tier: bool,
                   canary: bool = False,
                   rollout: Optional[_Rollout] = None) -> None:
-        seconds = time.perf_counter() - req.t_enqueued
+        now = time.perf_counter()
+        seconds = now - req.t_enqueued
         entry.requests += 1
         entry.rows += req.rows
         entry.latencies.append(seconds)
+        self._observe_decomp(entry, req, now)
         _tally("requests")
         telemetry.counter("server.requests").inc()
         telemetry.counter("server.rows_scored").inc(req.rows)
         if telemetry.enabled():
-            telemetry.histogram(
-                f"server.request_seconds.{entry.name}").observe(seconds)
-            telemetry.gauge(f"server.queue_depth.{entry.name}").set(
-                entry.queue.qsize())
+            telemetry.histogram(  # lint: metric-name — per-tenant latency, bounded by the registered roster
+                entry.metric_names["request"]).observe(seconds)
+            telemetry.gauge(  # lint: metric-name — per-tenant gauge, bounded by the registered roster
+                entry.metric_names["queue"]).set(entry.queue.qsize())
         slo_met = self._slo(seconds)
         if rollout is not None and slo_met is False:
             # candidate traffic missing the SLO is a rollback trigger
@@ -1466,6 +1593,11 @@ def _store_rows(store) -> List[Dict[str, Any]]:
             for i in range(store.n_rows)]
 
 
+#: sentinel: the HTTP handler's future timed out (the 504 path) — a
+#: marker object so the trace scope can close before the 504 logic runs
+_TIMED_OUT = object()
+
+
 def serve_http(server: ModelServer, host: str = "127.0.0.1",
                port: int = 8000, request_timeout_s: float = 30.0):
     """Start the stdlib HTTP front end on a daemon thread; returns the
@@ -1477,15 +1609,44 @@ def serve_http(server: ModelServer, host: str = "127.0.0.1",
         def log_message(self, fmt, *args):   # route through logging
             logger.debug("http: " + fmt, *args)
 
-        def _send(self, code: int, doc: Dict[str, Any]) -> None:
+        def _send(self, code: int, doc: Dict[str, Any],
+                  headers: Optional[Dict[str, str]] = None) -> None:
             body = json.dumps(doc, default=str).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_text(self, code: int, body: bytes,
+                       content_type: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):
+            if self.path == "/metrics":
+                # the live Prometheus scrape surface (/stats never
+                # was): the telemetry registry in text exposition plus
+                # the always-on server tallies as server_tally_*
+                # gauges, so a scrape is useful even with telemetry
+                # off. The tally prefix is DISTINCT from the
+                # telemetry counters' server_* namespace on purpose:
+                # `server.requests` sanitizes to `server_requests`,
+                # and a family emitted twice with conflicting types
+                # is invalid exposition a real Prometheus rejects
+                # (docs/observability.md "The /metrics plane")
+                extra = {f"server_tally_{k}": float(v)
+                         for k, v in server_stats().items()
+                         if isinstance(v, int)
+                         and not isinstance(v, bool)}
+                body = telemetry.render_prometheus(extra=extra).encode()
+                return self._send_text(
+                    200, body, "text/plain; version=0.0.4")
             if self.path == "/healthz":
                 # liveness flips 503 the INSTANT shutdown begins — a
                 # supervisor/router must stop routing to a draining
@@ -1549,10 +1710,33 @@ def serve_http(server: ModelServer, host: str = "127.0.0.1",
                     return self._send(400, {
                         "error": "body must be {\"records\": [..]} with "
                                  "at least one record"})
-                fut = server.submit(name, records)
-                try:
-                    res = fut.result(timeout=request_timeout_s)
-                except FuturesTimeout:
+                # trace adoption (docs/observability.md "Distributed
+                # tracing"): a router-minted X-Tmog-Trace header joins
+                # this worker's spans to the fleet-wide trace; with
+                # telemetry on and no header, the worker is the entry
+                # point and mints its own. The request span's identity
+                # rides into the micro-batcher via submit(trace=) so
+                # the batch span can link it, and echoes back to the
+                # client in the response header.
+                ctx = telemetry.parse_traceparent(
+                    self.headers.get(telemetry.TRACE_HEADER))
+                if ctx is None and telemetry.enabled():
+                    ctx = telemetry.mint_trace()
+                trace_hdr = (telemetry.format_traceparent(*ctx)
+                             if ctx else None)
+                with telemetry.trace_scope(ctx):
+                    with telemetry.span("server:request", model=name,
+                                        rows=len(records)) as rsp:
+                        fut = server.submit(
+                            name, records,
+                            trace=((rsp.trace_id, rsp.span_id)
+                                   if rsp.span_id else ctx))
+                        try:
+                            res = fut.result(
+                                timeout=request_timeout_s)
+                        except FuturesTimeout:
+                            res = _TIMED_OUT
+                if res is _TIMED_OUT:
                     # answer 504, and account for the in-flight future
                     # either way: a successful cancel means the worker
                     # will skip it (set_running_or_notify_cancel), an
@@ -1591,7 +1775,9 @@ def serve_http(server: ModelServer, host: str = "127.0.0.1",
                 "latencyMs": round(res.seconds * 1e3, 3),
                 "engineTier": res.engine_tier,
                 "canary": res.canary,
-                "outputs": _store_rows(res.store)})
+                "outputs": _store_rows(res.store)},
+                headers=({telemetry.TRACE_HEADER: trace_hdr}
+                         if trace_hdr else None))
 
     httpd = ThreadingHTTPServer((host, port), Handler)
     httpd.daemon_threads = True

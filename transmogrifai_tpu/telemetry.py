@@ -50,6 +50,7 @@ the runner's metrics sink).
 """
 from __future__ import annotations
 
+import bisect
 import json
 import logging
 import os
@@ -69,6 +70,17 @@ __all__ = [
     "RunListener", "CollectingRunListener",
     "add_listener", "remove_listener", "listeners", "emit",
     "compile_clock_s", "probe_device_roundtrip_mbps",
+    # cross-process tracing (docs/observability.md "Distributed tracing")
+    "TRACE_HEADER", "TRACE_ENV", "mint_trace", "parse_traceparent",
+    "format_traceparent", "current_trace", "trace_scope",
+    "set_trace_role", "trace_role",
+    "write_trace_shard", "merge_trace_shards", "write_merged_trace",
+    # Prometheus exposition helpers (the /metrics plane)
+    "parse_prometheus", "render_prometheus_sum",
+    "merge_parsed_prometheus",
+    # executed-FLOP attribution (the MFU block)
+    "record_device_work", "device_cost_stats", "reset_device_cost",
+    "telemetry_stats",
 ]
 
 # ---------------------------------------------------------------------------
@@ -85,6 +97,12 @@ _EPOCH = time.perf_counter()
 _PID = os.getpid()
 
 _LOCK = threading.RLock()
+
+#: dedicated event-buffer lock: span exits (every traced hot path, on
+#: every thread) append here, so sharing the registry RLock with every
+#: counter inc and histogram observe measurably convoys the serving
+#: workers (trace_overhead bench) — the buffer gets its own lock
+_EVENTS_LOCK = threading.Lock()
 
 #: recorded Chrome trace events (dicts, ph "X" for spans + "M" metadata)
 _EVENTS: List[Dict[str, Any]] = []
@@ -126,11 +144,12 @@ def reset(keep_listeners: bool = False) -> None:
     server rotating its trace files, or the runner's run-scoped teardown,
     which keeps user-registered listeners alive)."""
     with _LOCK:
-        _EVENTS.clear()
-        _DROPPED_EVENTS[0] = 0
-        # forget track assignments so live threads re-announce their
-        # thread_name metadata in the NEXT trace file too
-        _TRACKS.clear()
+        with _EVENTS_LOCK:
+            _EVENTS.clear()
+            _DROPPED_EVENTS[0] = 0
+            # forget track assignments so live threads re-announce
+            # their thread_name metadata in the NEXT trace file too
+            _TRACKS.clear()
         _REGISTRY.clear()
         if not keep_listeners:
             del _LISTENERS[:]
@@ -145,7 +164,7 @@ def _track_id() -> int:
     ident = threading.get_ident()
     tid = _TRACKS.get(ident)
     if tid is None:
-        with _LOCK:
+        with _EVENTS_LOCK:
             tid = _TRACKS.setdefault(ident, len(_TRACKS))
             _EVENTS.append({
                 "name": "thread_name", "ph": "M", "pid": _PID, "tid": tid,
@@ -164,6 +183,8 @@ class _NullSpan:
     """Shared no-op context manager returned while telemetry is off."""
 
     __slots__ = ()
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
 
     def __enter__(self):
         return self
@@ -176,14 +197,27 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "attrs", "_t0")
+    __slots__ = ("name", "attrs", "_t0", "trace_id", "span_id",
+                 "parent_id")
 
     def __init__(self, name: str, attrs: Dict[str, Any]):
         self.name = name
         self.attrs = attrs
         self._t0 = 0.0
+        #: W3C-style identity, populated on __enter__ when a trace
+        #: context is active on this thread (docs/observability.md
+        #: "Distributed tracing"); None otherwise — zero cost for
+        #: in-process-only tracing
+        self.trace_id: Optional[str] = None
+        self.span_id: Optional[str] = None
+        self.parent_id: Optional[str] = None
 
     def __enter__(self) -> "_Span":
+        ctx = current_trace()
+        if ctx is not None:
+            self.trace_id, self.parent_id = ctx
+            self.span_id = _new_span_id()
+            _trace_stack().append((self.trace_id, self.span_id))
         _span_stack().append(self.name)
         self._t0 = time.perf_counter()
         return self
@@ -193,8 +227,18 @@ class _Span:
         stack = _span_stack()
         if stack and stack[-1] == self.name:
             stack.pop()
+        if self.span_id is not None:
+            tstack = _trace_stack()
+            if tstack and tstack[-1][1] == self.span_id:
+                tstack.pop()
+            # attrs is span-owned (built fresh from **attrs) — mutate
+            # in place, no defensive copy on the hot path
+            self.attrs["trace_id"] = self.trace_id
+            self.attrs["span_id"] = self.span_id
+            if self.parent_id:
+                self.attrs["parent_span_id"] = self.parent_id
         tid = _track_id()
-        with _LOCK:
+        with _EVENTS_LOCK:
             if len(_EVENTS) >= _MAX_EVENTS:
                 _DROPPED_EVENTS[0] += 1
                 return False
@@ -212,7 +256,12 @@ def span(name: str, **attrs: Any):
     Spans nest (the per-thread stack tracks the current path) and land on
     the calling thread's own track in the exported trace, so concurrent
     work — the streaming scorer's prep worker, CV threads — renders as
-    parallel lanes in Perfetto."""
+    parallel lanes in Perfetto. Under an active :func:`trace_scope` the
+    span additionally carries ``trace_id`` / ``span_id`` /
+    ``parent_span_id`` args, so cross-process traces stitch in the
+    merged file; pass ``links=[span_id, ...]`` as a plain attr to
+    reference other spans (the micro-batcher links its member request
+    spans this way)."""
     if not _ENABLED:
         return _NULL_SPAN
     return _Span(name, attrs)
@@ -225,7 +274,7 @@ def current_span_stack() -> Tuple[str, ...]:
 
 def trace_events() -> List[Dict[str, Any]]:
     """Copy of the recorded Chrome trace events."""
-    with _LOCK:
+    with _EVENTS_LOCK:
         return list(_EVENTS)
 
 
@@ -258,6 +307,285 @@ def _is_coordinator() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# distributed tracing — W3C-traceparent-style context + trace shards
+# ---------------------------------------------------------------------------
+
+#: HTTP header carrying the trace context between fleet processes
+#: (router → worker), W3C-traceparent-shaped:
+#: ``00-<32 hex trace id>-<16 hex span id>-01``
+TRACE_HEADER = "X-Tmog-Trace"
+
+#: env var carrying the trace context into subprocesses (the continual
+#: tier's retrain jobs inherit the triggering window's trace this way)
+TRACE_ENV = "TMOG_TRACE_PARENT"
+
+#: env var naming this process's role in merged traces (router / worker
+#: / retrain / ...) — one Perfetto process row per (role, pid)
+TRACE_ROLE_ENV = "TMOG_TRACE_ROLE"
+
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+
+_TRACE_ROLE = [os.environ.get(TRACE_ROLE_ENV, "proc")]
+
+#: always-on tracing tallies (never cleared by reset() — the
+#: engine_cache_stats discipline; see telemetry_stats())
+_TRACE_TALLY_LOCK = threading.Lock()
+_TRACE_TALLY = {"traces_minted": 0, "traces_adopted": 0,
+                "shards_written": 0, "shards_merged": 0}
+
+
+def _trace_tally(key: str, n: int = 1) -> None:
+    with _TRACE_TALLY_LOCK:
+        _TRACE_TALLY[key] += n
+
+
+def _id_rng():
+    """Per-thread PRNG for trace/span ids, seeded ONCE from the OS
+    entropy pool (+ pid + thread id, so forked workers and sibling
+    threads can never share a stream). Ids need uniqueness, not
+    cryptographic strength — and ``os.urandom`` is a syscall per call
+    (measured ~200µs on containerized kernels), which at one trace id
+    + two span ids per routed request would, alone, blow the
+    trace_overhead bench's 5% gate."""
+    r = getattr(_TLS, "id_rng", None)
+    if r is None:
+        import random
+        seed = (int.from_bytes(os.urandom(16), "big")
+                ^ (os.getpid() << 64) ^ threading.get_ident())
+        r = _TLS.id_rng = random.Random(seed)
+    return r
+
+
+def _new_trace_id() -> str:
+    return f"{_id_rng().getrandbits(128):032x}"
+
+
+def _new_span_id() -> str:
+    return f"{_id_rng().getrandbits(64):016x}"
+
+
+def _trace_stack() -> List[Tuple[str, str]]:
+    """Per-thread stack of (trace_id, span_id) for the OPEN traced
+    spans — the innermost entry is the parent of the next child."""
+    st = getattr(_TLS, "trace_stack", None)
+    if st is None:
+        st = _TLS.trace_stack = []
+    return st
+
+
+def mint_trace() -> Tuple[str, str]:
+    """A fresh (trace_id, span_id) root context — the fleet router (or
+    any other entry point) mints one per request and propagates it via
+    :data:`TRACE_HEADER` / :data:`TRACE_ENV`."""
+    _trace_tally("traces_minted")
+    return _new_trace_id(), _new_span_id()
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """``00-<trace_id>-<span_id>-01`` (W3C traceparent shape)."""
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(value: Optional[str]
+                      ) -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) from a traceparent string; None when the
+    value is missing or malformed — a corrupt header must never fail a
+    request, it just starts an unlinked trace."""
+    if not value:
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if m is None:
+        return None
+    return m.group(1), m.group(2)
+
+
+def _env_trace() -> Optional[Tuple[str, str]]:
+    """The process-level parent context inherited via TMOG_TRACE_PARENT
+    (retrain subprocesses join the triggering window's trace this
+    way). Parsed lazily and cached — the env cannot change under us."""
+    cached = getattr(_env_trace, "_cached", False)
+    if cached is False:
+        ctx = parse_traceparent(os.environ.get(TRACE_ENV))
+        if ctx is not None:
+            _trace_tally("traces_adopted")
+        _env_trace._cached = ctx          # type: ignore[attr-defined]
+        return ctx
+    return cached
+
+
+def current_trace() -> Optional[Tuple[str, str]]:
+    """The calling thread's active (trace_id, parent_span_id): the
+    innermost open traced span, else the thread's trace_scope context,
+    else the process-level TMOG_TRACE_PARENT. None = untraced."""
+    st = _trace_stack()
+    if st:
+        return st[-1]
+    ctx = getattr(_TLS, "trace_ctx", None)
+    if ctx is not None:
+        return ctx
+    return _env_trace()
+
+
+class _TraceScope:
+    __slots__ = ("ctx", "_prev")
+
+    def __init__(self, ctx: Optional[Tuple[str, str]]):
+        self.ctx = ctx
+        self._prev: Any = None
+
+    def __enter__(self) -> Optional[Tuple[str, str]]:
+        self._prev = getattr(_TLS, "trace_ctx", None)
+        if self.ctx is not None:
+            _TLS.trace_ctx = self.ctx
+        return self.ctx
+
+    def __exit__(self, *exc) -> bool:
+        if self.ctx is not None:
+            _TLS.trace_ctx = self._prev
+        return False
+
+
+def trace_scope(ctx):
+    """Install a (trace_id, span_id) parent context — or a traceparent
+    string, parsed tolerantly — for the calling thread's spans. A None
+    context is a no-op scope, so call sites never need to branch::
+
+        with telemetry.trace_scope(request_header):
+            with telemetry.span("server:request") as sp:
+                ...   # sp.trace_id / sp.span_id carry the identity
+    """
+    if isinstance(ctx, str):
+        ctx = parse_traceparent(ctx)
+    return _TraceScope(ctx)
+
+
+def set_trace_role(role: str) -> None:
+    """Name this process's row in merged traces (router / worker /
+    retrain / runner...)."""
+    _TRACE_ROLE[0] = str(role)
+
+
+def trace_role() -> str:
+    return _TRACE_ROLE[0]
+
+
+def write_trace_shard(dir_path: str,
+                      role: Optional[str] = None) -> Optional[str]:
+    """Write THIS process's recorded events as one atomic trace shard
+    under ``dir_path`` (``shard-<role>-<pid>.trace.json``), for
+    ``python -m transmogrifai_tpu trace merge`` to stitch into one
+    Perfetto file. Every fleet process writes its own shard — pid+role
+    naming means no cross-process write races, so the multi-host
+    one-writer rule deliberately does NOT apply here. The shard records
+    ``epochUnixS`` — the wall-clock instant of this process's monotonic
+    trace epoch — so the merger can align clocks across processes.
+    Returns the shard path (None when nothing was recorded)."""
+    events = trace_events()
+    if not events:
+        return None
+    role = role or trace_role()
+    os.makedirs(dir_path, exist_ok=True)
+    # wall-clock anchor of the monotonic epoch: merge-time alignment
+    # needs ONE cross-process time base, and the wall clock is the only
+    # one the processes share (a small NTP skew shifts a whole process
+    # row, never a duration — durations stay perf_counter-true)
+    epoch_unix = time.time() - (time.perf_counter() - _EPOCH)  # lint: wall-clock — cross-process clock-offset anchor, not a duration
+    doc = {"role": role, "pid": _PID,
+           "epochUnixS": round(epoch_unix, 6),
+           "traceEvents": events}
+    if _DROPPED_EVENTS[0]:
+        doc["droppedEvents"] = _DROPPED_EVENTS[0]
+    safe_role = re.sub(r"[^A-Za-z0-9_.-]", "_", role)
+    path = os.path.join(dir_path, f"shard-{safe_role}-{_PID}.trace.json")
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, path)
+    _trace_tally("shards_written")
+    return path
+
+
+def merge_trace_shards(dir_path: str) -> Dict[str, Any]:
+    """Stitch every ``shard-*.trace.json`` shard under ``dir_path``
+    into one Chrome trace-event document with clock-offset alignment
+    and a per-process row layout: each shard's events keep their own
+    pid, get a ``process_name`` metadata row (``<role>-<pid>``), and
+    their timestamps shift onto a common axis anchored at the earliest
+    shard's epoch. Only the ``shard-`` prefix ``write_trace_shard``
+    produces is ingested — a previous merge's own output
+    (``merged.trace.json``) in the same directory must never be
+    re-ingested as a shard (it has no epoch anchor and would shift the
+    whole axis). Unreadable shards are skipped with a note in
+    ``mergeErrors`` — a torn shard must never lose the rest of the
+    fleet's trace."""
+    shards: List[Dict[str, Any]] = []
+    errors: List[str] = []
+    try:
+        names = sorted(os.listdir(dir_path))
+    except OSError as e:
+        raise ValueError(f"trace merge: cannot list {dir_path!r}: {e}")
+    for fn in names:
+        if not (fn.startswith("shard-") and fn.endswith(".trace.json")):
+            continue
+        p = os.path.join(dir_path, fn)
+        try:
+            with open(p) as fh:
+                doc = json.load(fh)
+            doc["traceEvents"]  # shape check
+        except (OSError, ValueError, KeyError) as e:
+            errors.append(f"{fn}: {e!r}")
+            continue
+        shards.append(doc)
+    if not shards:
+        raise ValueError(
+            f"trace merge: no readable shard-*.trace.json shards in "
+            f"{dir_path!r}" + (f" ({errors})" if errors else ""))
+    t0 = min(float(s.get("epochUnixS", 0.0)) for s in shards)
+    out_events: List[Dict[str, Any]] = []
+    dropped = 0
+    for sort_idx, s in enumerate(shards):
+        pid = int(s.get("pid", sort_idx))
+        role = str(s.get("role", "proc"))
+        off_us = (float(s.get("epochUnixS", 0.0)) - t0) * 1e6
+        out_events.append({"name": "process_name", "ph": "M",
+                           "pid": pid, "tid": 0,
+                           "args": {"name": f"{role}-{pid}"}})
+        out_events.append({"name": "process_sort_index", "ph": "M",
+                           "pid": pid, "tid": 0,
+                           "args": {"sort_index": sort_idx}})
+        dropped += int(s.get("droppedEvents", 0))
+        for ev in s["traceEvents"]:
+            ev = dict(ev)
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = round(float(ev["ts"]) + off_us, 3)
+            out_events.append(ev)
+    doc = {"traceEvents": out_events, "displayTimeUnit": "ms",
+           "mergedShards": len(shards)}
+    if dropped:
+        doc["droppedEvents"] = dropped
+    if errors:
+        doc["mergeErrors"] = errors
+    _trace_tally("shards_merged", len(shards))
+    return doc
+
+
+def write_merged_trace(dir_path: str, out_path: str) -> Dict[str, Any]:
+    """:func:`merge_trace_shards` + atomic write of the merged Perfetto
+    file; returns the merged document."""
+    doc = merge_trace_shards(dir_path)
+    d = os.path.dirname(out_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{out_path}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+    os.replace(tmp, out_path)
+    return doc
+
+
+# ---------------------------------------------------------------------------
 # metrics registry
 # ---------------------------------------------------------------------------
 
@@ -269,17 +597,21 @@ _REGISTRY: "OrderedDict[str, Any]" = OrderedDict()
 
 
 class Counter:
-    """Monotonic counter."""
+    """Monotonic counter. Each instrument carries its OWN lock — the
+    serving workers inc dozens of counters per request, and funnelling
+    them all through the module registry lock convoys the hot path
+    (trace_overhead bench)."""
 
-    __slots__ = ("name", "_v")
+    __slots__ = ("name", "_v", "_lock")
     kind = "counter"
 
     def __init__(self, name: str):
         self.name = name
         self._v = 0.0
+        self._lock = threading.Lock()
 
     def inc(self, n: float = 1.0) -> None:
-        with _LOCK:
+        with self._lock:
             self._v += n
 
     @property
@@ -293,18 +625,19 @@ class Counter:
 class Gauge:
     """Last-write-wins instantaneous value."""
 
-    __slots__ = ("name", "_v")
+    __slots__ = ("name", "_v", "_lock")
     kind = "gauge"
 
     def __init__(self, name: str):
         self.name = name
         self._v = 0.0
+        self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
         self._v = float(v)
 
     def inc(self, n: float = 1.0) -> None:
-        with _LOCK:
+        with self._lock:
             self._v += n
 
     def dec(self, n: float = 1.0) -> None:
@@ -320,27 +653,35 @@ class Gauge:
 
 class Histogram:
     """Cumulative-bucket histogram (Prometheus semantics: each bucket
-    counts observations ``<= le``; ``+Inf`` equals ``count``)."""
+    counts observations ``<= le``; ``+Inf`` equals ``count``).
 
-    __slots__ = ("name", "buckets", "_counts", "_sum", "_count")
+    Internally the counts are per-BIN (non-cumulative, with one
+    overflow bin past the last bound) so ``observe()`` is one bisect
+    plus one increment under the instrument's own lock, not an O(#
+    buckets) cumulative walk under the registry lock; the cumulative
+    view is materialized at scrape time (:meth:`snapshot`)."""
+
+    __slots__ = ("name", "buckets", "_bins", "_sum", "_count", "_lock")
     kind = "histogram"
 
     def __init__(self, name: str,
                  buckets: Sequence[float] = DEFAULT_BUCKETS):
         self.name = name
         self.buckets = tuple(sorted(buckets))
-        self._counts = [0] * len(self.buckets)
+        self._bins = [0] * (len(self.buckets) + 1)   # +1 = overflow
         self._sum = 0.0
         self._count = 0
+        self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
         v = float(v)
-        with _LOCK:
+        # first bound >= v (Prometheus: a bucket counts v <= le);
+        # index len(buckets) is the overflow bin (only +Inf holds it)
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
             self._sum += v
             self._count += 1
-            for i, le in enumerate(self.buckets):
-                if v <= le:
-                    self._counts[i] += 1
+            self._bins[i] += 1
 
     @property
     def count(self) -> int:
@@ -352,12 +693,31 @@ class Histogram:
 
     def bucket_counts(self) -> Dict[float, int]:
         """Cumulative count per upper bound (``le``)."""
-        return dict(zip(self.buckets, self._counts))
+        counts, _total, _count = self.snapshot()
+        return dict(zip(self.buckets, counts))
+
+    def snapshot(self) -> Tuple[Tuple[int, ...], float, int]:
+        """(cumulative bucket counts, sum, count) captured atomically
+        under the instrument lock — the ONE read path scrapes may use.
+        Reading the fields unlocked while ``observe()`` mutates them
+        can tear: a scrape could emit a ``_count`` inconsistent with
+        its cumulative buckets (``+Inf`` must equal ``_count`` in
+        valid Prometheus exposition)."""
+        with self._lock:
+            bins = list(self._bins)
+            total, count = self._sum, self._count
+        cum: List[int] = []
+        running = 0
+        for c in bins[:-1]:
+            running += c
+            cum.append(running)
+        return tuple(cum), total, count
 
     def to_json(self) -> Dict[str, Any]:
-        return {"count": self._count, "sum": self._sum,
+        counts, total, count = self.snapshot()
+        return {"count": count, "sum": total,
                 "buckets": {str(le): c for le, c
-                            in zip(self.buckets, self._counts)}}
+                            in zip(self.buckets, counts)}}
 
 
 class _NullInstrument:
@@ -441,7 +801,11 @@ def _prom_value(v: float) -> str:
 
 def render_prometheus(extra: Optional[Dict[str, float]] = None) -> str:
     """Registry in Prometheus text exposition format (0.0.4). ``extra``
-    appends scalar gauges (the runner folds its run doc numerics in)."""
+    appends scalar gauges (the runner folds its run doc numerics in).
+
+    Histograms are snapshotted atomically (:meth:`Histogram.snapshot`)
+    so a scrape racing ``observe()`` can never emit a ``_count``
+    inconsistent with its cumulative buckets — the torn-scrape fix."""
     lines: List[str] = []
     with _LOCK:
         items = list(_REGISTRY.items())
@@ -449,12 +813,12 @@ def render_prometheus(extra: Optional[Dict[str, float]] = None) -> str:
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} {inst.kind}")
         if isinstance(inst, Histogram):
-            cum_pairs = zip(inst.buckets, inst._counts)
-            for le, c in cum_pairs:
+            counts, total, count = inst.snapshot()
+            for le, c in zip(inst.buckets, counts):
                 lines.append(f'{pn}_bucket{{le="{_prom_value(le)}"}} {c}')
-            lines.append(f'{pn}_bucket{{le="+Inf"}} {inst.count}')
-            lines.append(f"{pn}_sum {_prom_value(inst.sum)}")
-            lines.append(f"{pn}_count {inst.count}")
+            lines.append(f'{pn}_bucket{{le="+Inf"}} {count}')
+            lines.append(f"{pn}_sum {_prom_value(total)}")
+            lines.append(f"{pn}_count {count}")
         else:
             lines.append(f"{pn} {_prom_value(inst.value)}")
     for name, v in (extra or {}).items():
@@ -486,6 +850,218 @@ def write_metrics(path: str, fmt: str = "json",
             fh.write(render_prometheus(extra))
     os.replace(tmp, path)
     return True
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition aggregation (the fleet router's /metrics plane)
+# ---------------------------------------------------------------------------
+
+_PROM_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)\s*$")
+
+
+def parse_prometheus(text: str) -> "OrderedDict[str, Dict[str, Any]]":
+    """Minimal Prometheus 0.0.4 text parser:
+    ``{family: {"type": kind, "samples": OrderedDict[(name, labels)
+    -> float]}}``. Sample keys keep their full name (``_bucket`` /
+    ``_sum`` / ``_count`` suffixes included) and raw label string, so a
+    re-render round-trips. Raises ValueError on a malformed line — the
+    router must not silently sum garbage."""
+    fams: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                raise ValueError(f"line {lineno}: malformed TYPE: "
+                                 f"{line!r}")
+            fams.setdefault(parts[2], {"type": parts[3],
+                                       "samples": OrderedDict()})
+            continue
+        if line.startswith("#"):
+            continue                     # HELP / comments
+        m = _PROM_SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: malformed sample: "
+                             f"{line!r}")
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        fam = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[:-len(suffix)] if name.endswith(suffix) else None
+            if base and fams.get(base, {}).get("type") == "histogram":
+                fam = base
+                break
+        fams.setdefault(fam, {"type": "untyped",
+                              "samples": OrderedDict()})
+        try:
+            v = float(value)
+        except ValueError:
+            raise ValueError(f"line {lineno}: non-numeric value "
+                             f"{value!r} for {name!r}")
+        fams[fam]["samples"][(name, labels)] = v
+    return fams
+
+
+def merge_parsed_prometheus(
+        docs: Sequence["OrderedDict[str, Dict[str, Any]]"]) -> str:
+    """Merge already-parsed expositions (:func:`parse_prometheus`
+    output) by SUMMING samples with the same (name, labels) and
+    re-rendering — the fleet router's ``/metrics`` aggregation, split
+    from the parse so the router's per-worker validation pass is also
+    the only parse. Correct for counters and histograms (the workers
+    share one bucket ladder by construction, so per-``le`` sums stay
+    cumulative); gauges sum too, which is the right fleet semantic
+    for the gauges this registry exposes (queue depths, in-flight
+    depths) — documented in docs/observability.md."""
+    merged: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+    for parsed in docs:
+        for fam, doc in parsed.items():
+            tgt = merged.setdefault(
+                fam, {"type": doc["type"], "samples": OrderedDict()})
+            if tgt["type"] == "untyped":
+                tgt["type"] = doc["type"]
+            for key, v in doc["samples"].items():
+                tgt["samples"][key] = tgt["samples"].get(key, 0.0) + v
+    lines: List[str] = []
+    for fam, doc in merged.items():
+        lines.append(f"# TYPE {fam} {doc['type']}")
+        for (name, labels), v in doc["samples"].items():
+            lines.append(f"{name}{labels} {_prom_value(v)}")
+    return "\n".join(lines) + "\n"
+
+
+def render_prometheus_sum(texts: Sequence[str]) -> str:
+    """:func:`merge_parsed_prometheus` over raw exposition texts."""
+    return merge_parsed_prometheus([parse_prometheus(t)
+                                    for t in texts])
+
+
+# ---------------------------------------------------------------------------
+# executed-FLOP device cost attribution (the MFU block)
+# ---------------------------------------------------------------------------
+
+#: per-chip peak FLOP/s by device kind substring — the v5e numbers the
+#: bench has always assumed (public spec; f32 runs through the same MXU
+#: at ~1/4 rate). Unknown platforms (CPU containers) report achieved
+#: FLOP/s with mfu percentages None rather than inventing a peak.
+PEAK_FLOPS = {"v5e": {"bf16": 197e12, "f32": 49e12},
+              "v5p": {"bf16": 459e12, "f32": 115e12},
+              "v4": {"bf16": 275e12, "f32": 69e12}}
+
+_DEVICE_COST_LOCK = threading.Lock()
+#: phase -> {"flops", "seconds", "dispatches"} — fed by the scoring
+#: engine, the fitstats device fold and the tuning/tree sweep
+#: executables (models/tuning.DEVICE_FLOPS generalized); always on,
+#: like every other tally the bench stamps
+_DEVICE_COST: Dict[str, Dict[str, float]] = {}
+
+
+def record_device_work(phase: str, flops: float = 0.0,
+                       seconds: float = 0.0,
+                       dispatches: int = 1) -> None:
+    """Account one device dispatch's executed FLOPs (XLA cost analysis
+    where available, documented analytic lower bound otherwise) and its
+    measured device-side seconds under ``phase`` (scoring / fitstats /
+    tuning / ...). Always on — the tallies are a few float adds."""
+    with _DEVICE_COST_LOCK:
+        d = _DEVICE_COST.setdefault(
+            phase, {"flops": 0.0, "seconds": 0.0, "dispatches": 0.0})
+        d["flops"] += float(flops)
+        d["seconds"] += float(seconds)
+        d["dispatches"] += int(dispatches)
+
+
+def reset_device_cost() -> None:
+    with _DEVICE_COST_LOCK:
+        _DEVICE_COST.clear()
+
+
+def _peak_flops_for(device_kind: str) -> Optional[Dict[str, float]]:
+    env = os.environ.get("TMOG_PEAK_FLOPS")
+    if env:
+        try:
+            return {"bf16": float(env), "f32": float(env)}
+        except ValueError:
+            logger.warning("TMOG_PEAK_FLOPS=%r is not a number; "
+                           "ignoring", env)
+    kind = device_kind.lower()
+    for sub, peaks in PEAK_FLOPS.items():
+        if sub in kind:
+            return peaks
+    return None
+
+
+def device_cost_stats() -> Dict[str, Any]:
+    """The ``mfu`` / device-utilization block stamped on every runner
+    metrics doc and bench doc: per-phase executed FLOPs, measured
+    device seconds and dispatch counts, plus derived achieved TFLOP/s
+    and MFU percentages against the platform peak (None off-TPU —
+    an unknown peak must not fabricate a utilization). ``seconds`` is
+    device-dispatch wall (host-side timer around dispatch+pull), so the
+    per-phase ``achieved_tflops`` is a dispatch-window utilization;
+    phases that only track FLOPs (the CV sweep) report seconds 0 and
+    no rate."""
+    with _DEVICE_COST_LOCK:
+        phases = {k: dict(v) for k, v in _DEVICE_COST.items()}
+    total_flops = sum(d["flops"] for d in phases.values())
+    total_s = sum(d["seconds"] for d in phases.values())
+    try:
+        import jax
+        device_kind = jax.devices()[0].device_kind
+        n_dev = jax.device_count()
+    except Exception:  # lint: broad-except — no jax runtime: the block still stamps, with no platform peak
+        device_kind, n_dev = "unknown", 1
+    peaks = _peak_flops_for(device_kind)
+    out: Dict[str, Any] = {
+        "device_kind": device_kind,
+        "devices": n_dev,
+        "device_flops": total_flops,
+        "device_seconds": round(total_s, 6),
+        "phases": {
+            k: {"flops": d["flops"],
+                "seconds": round(d["seconds"], 6),
+                "dispatches": int(d["dispatches"]),
+                "achieved_tflops": (round(d["flops"] / d["seconds"]
+                                          / 1e12, 4)
+                                    if d["seconds"] > 0 else None)}
+            for k, d in sorted(phases.items())},
+    }
+    # the rate pairs TIMED flops with TIMED seconds only: a phase that
+    # tracks FLOPs without dispatch timing (the CV sweep) must not
+    # inflate the utilization of the phases that measured both
+    timed_flops = sum(d["flops"] for d in phases.values()
+                      if d["seconds"] > 0)
+    rate = timed_flops / total_s if total_s > 0 else None
+    out["achieved_tflops"] = (round(rate / 1e12, 4)
+                              if rate is not None else None)
+    if peaks and rate is not None:
+        peak_total = {k: v * n_dev for k, v in peaks.items()}
+        out["mfu_bf16_pct"] = round(100.0 * rate
+                                    / peak_total["bf16"], 3)
+        out["mfu_f32_pct"] = round(100.0 * rate / peak_total["f32"], 3)
+    else:
+        out["mfu_bf16_pct"] = None
+        out["mfu_f32_pct"] = None
+    return out
+
+
+def telemetry_stats() -> Dict[str, Any]:
+    """Always-on telemetry-plane tallies (the ``engine_cache_stats``
+    discipline — stamped on every bench doc): whether recording is on,
+    how many events/metrics are held, event overflow drops, and the
+    cross-process tracing traffic (traces minted/adopted, shards
+    written/merged)."""
+    with _EVENTS_LOCK:
+        n_events = len(_EVENTS)
+    with _LOCK:
+        n_metrics = len(_REGISTRY)
+    with _TRACE_TALLY_LOCK:
+        trace = dict(_TRACE_TALLY)
+    return {"enabled": _ENABLED, "events": n_events,
+            "dropped_events": _DROPPED_EVENTS[0],
+            "metrics": n_metrics, "role": trace_role(), **trace}
 
 
 # ---------------------------------------------------------------------------
