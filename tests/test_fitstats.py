@@ -486,3 +486,119 @@ def test_mesh_constructions_tally_stays_flat(store):
     plan.run(store, device=True)
     plan.run(store, device=True)
     assert fitstats.fitstats_stats()["mesh_constructions"] == c0
+
+
+# ---------------------------------------------------------------------------
+# PR 16 tentpole (a): out-of-core streaming fold — bit-parity with the
+# materialized device pass
+# ---------------------------------------------------------------------------
+
+
+def _batch_stores(store, names, sizes):
+    """Slice `store` into consecutive batch ColumnStores of the given
+    sizes (the shape a DirectoryStreamReader's decoded batches take)."""
+    out, off = [], 0
+    for m in sizes:
+        idx = np.arange(off, off + m)
+        out.append(ColumnStore({nm: store[nm].take(idx) for nm in names},
+                               m))
+        off += m
+    assert off == store.n_rows
+    return out
+
+
+def _materialized_states(store, names, mesh):
+    so = {}
+    fitstats._device_moment_bundles(
+        store, {nm: {"mean": [()]} for nm in names}, mesh=mesh,
+        states_out=so)
+    return so
+
+
+@pytest.mark.parametrize("mesh", [False, None])
+def test_streaming_fold_bit_identical_to_materialized(store, mesh):
+    """StreamingMomentFold over reader-shaped batches == the
+    materialized ``_device_moment_bundles`` pass over the same rows,
+    bit for bit — sharded (process-default mesh) and unsharded."""
+    names = ["x0", "x1", "x2"]
+    want = _materialized_states(store, names, mesh)
+
+    fold = fitstats.StreamingMomentFold(names, mesh=mesh)
+    for b in _batch_stores(store, names, [150, 150, 100]):
+        fold.update(b)
+    got = fold.finalize()
+
+    assert fold.n_rows == store.n_rows
+    assert sorted(got) == sorted(want)
+    for nm in names:
+        g, w = got[nm], want[nm]
+        assert (g.count, g.mean, g.m2, g.min, g.max) \
+            == (w.count, w.mean, w.m2, w.min, w.max), nm
+
+
+def test_streaming_fold_multi_chunk_and_batch_invariant(store,
+                                                        monkeypatch):
+    """Batch boundaries never leak into the result: any re-batching of
+    the stream Chan-combines to the same partials — including when the
+    stream spans MULTIPLE fixed-shape chunks (chunk floor shrunk so 400
+    rows cut into 128-row interior chunks + a padded tail, on both the
+    streamed and materialized paths)."""
+    monkeypatch.setattr(fitstats, "FITSTATS_CHUNK_ROWS", 128)
+    names = ["x0", "x2"]
+    want = _materialized_states(store, names, False)
+
+    for sizes in ([400], [128, 128, 128, 16], [37] * 10 + [30],
+                  [1] * 5 + [395]):
+        fold = fitstats.StreamingMomentFold(names, mesh=False)
+        for b in _batch_stores(store, names, sizes):
+            fold.update(b)
+        got = fold.finalize()
+        for nm in names:
+            g, w = got[nm], want[nm]
+            assert (g.count, g.mean, g.m2, g.min, g.max) \
+                == (w.count, w.mean, w.m2, w.min, w.max), (nm, sizes)
+
+
+def test_streamed_stats_injected_into_fused_pass(store):
+    """A workflow-carried full-stream SufficientStats overrides the
+    (subsample) store's own numbers in the fused pass: the moment stats
+    a stage fits against reflect ALL streamed rows."""
+    full = _materialized_states(store, ["x1"], False)["x1"]
+    fake = fitstats.SufficientStats(full.count * 2, full.mean + 1.0,
+                                    full.m2, full.min - 5.0,
+                                    full.max + 5.0)
+    plan = LayerStatsPlan([StatRequest("mean", "x1"),
+                           StatRequest("count", "x1"),
+                           StatRequest("min", "x1")], n_stages=1)
+    stats = plan.run(store, device=True, stream_state={"x1": fake})
+    assert stats.value("mean", "x1") == fake.finalize("mean")
+    assert stats.value("count", "x1") == int(fake.count)
+    assert stats.value("min", "x1") == fake.min
+
+
+def test_streamed_fit_bit_identical_per_stage_family(store):
+    """Per opted-in moment-family estimator: fitting from the
+    streaming fold's full-stream states == fitting from the
+    materialized device pass, bit for bit — the ISSUE 16 acceptance
+    contract at the stage level, not just the fold level."""
+    from transmogrifai_tpu.models import _treefit  # noqa: F401 (env parity)
+
+    cases = []
+    for st in (FillMissingWithMean(), ScalarNormalizer(),
+               OpScalarStandardScaler()):
+        st.set_input(_feat("x1"))
+        cases.append(st)
+
+    streamed = fitstats.StreamingMomentFold(["x1"], mesh=False)
+    for b in _batch_stores(store, ["x1"], [123, 277]):
+        streamed.update(b)
+    states = streamed.finalize()
+
+    for stage in cases:
+        reqs = list(stage.stat_requests(store))
+        plan = LayerStatsPlan(reqs, n_stages=1)
+        mat = stage.fit(store, stats=plan.run(store, device=True,
+                                              mesh=False))
+        stream = stage.fit(store, stats=plan.run(
+            store, device=True, mesh=False, stream_state=states))
+        _assert_state_identical(mat, stream)

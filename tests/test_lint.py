@@ -341,6 +341,37 @@ def test_tmg205_mesh_unsafe_row_dimension():
                 if f.rule == "TMG205"]
 
 
+def test_tmg206_vmem_envelope_warning(monkeypatch):
+    """A stage whose extrapolated device-resident working set exceeds
+    the (shrunk, for the test) VMEM envelope warns — and names the
+    featureShards knob — while feature sharding stays disengaged."""
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    out = _MeshSafeVec().set_input(fx).get_output()
+    model = WorkflowModel(result_features=[out], fitted_stages={})
+    monkeypatch.setattr(lint, "VMEM_ENVELOPE_BYTES", 64)
+    findings = lint.preflight_device(model)
+    f = next(f for f in findings if f.rule == "TMG206")
+    assert f.severity == Severity.WARNING and f.stage is not None
+    assert "featureShards" in f.message and "VMEM" in f.message
+
+
+def test_tmg206_silent_when_sharding_engaged_or_under_envelope(
+        monkeypatch):
+    from transmogrifai_tpu.models import _treefit
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    out = _MeshSafeVec().set_input(fx).get_output()
+    model = WorkflowModel(result_features=[out], fitted_stages={})
+    # under the default 16 MiB envelope the tiny fixture is silent
+    assert not [f for f in lint.preflight_device(model)
+                if f.rule == "TMG206"]
+    # over the envelope but with feature sharding requested: silent —
+    # the per-chip working set shrinks 1/G, which is the remediation
+    monkeypatch.setattr(lint, "VMEM_ENVELOPE_BYTES", 64)
+    with _treefit.feature_shards_scope(2):
+        assert not [f for f in lint.preflight_device(model)
+                    if f.rule == "TMG206"]
+
+
 def test_tmg204_host_stage_without_static_form_halts_with_info():
     fx = FeatureBuilder.Real("x").from_column().as_predictor()
 

@@ -181,6 +181,70 @@ def test_kill_switch_forces_serial_directory_stream(monkeypatch,
 
 
 # ---------------------------------------------------------------------------
+# SeededRowSample — the out-of-core bounded subsample (PR 16)
+# ---------------------------------------------------------------------------
+
+
+def _drain_sample(batches, k=64, seed=7):
+    from transmogrifai_tpu.pipeline import SeededRowSample
+    s = SeededRowSample(k, seed=seed)
+    for batch in batches:
+        loc = s.offer(len(batch))
+        s.keep([batch[int(i)] for i in loc])
+    return s.result(), s.total_rows
+
+
+def test_seeded_row_sample_batch_boundary_invariant():
+    """A row's keep/drop fate is a pure function of its GLOBAL stream
+    index and the seed — re-batching the same stream (one batch, odd
+    chunks, row-at-a-time) must select the identical rows in the
+    identical order."""
+    rows = [{"i": i} for i in range(1000)]
+    ref, n_ref = _drain_sample([rows])
+    assert n_ref == 1000 and len(ref) == 64
+    for size in (100, 37, 1):
+        got, n = _drain_sample(
+            [rows[i:i + size] for i in range(0, len(rows), size)])
+        assert n == 1000
+        assert got == ref
+
+
+def test_seeded_row_sample_deterministic_across_stream_workers(
+        tmp_path):
+    """The quantile-sketch subsample drawn from a parallel-decoded
+    directory stream at workers 1/2/4 equals the one drawn from the
+    materialized (read_records) order — the out-of-core fit's
+    determinism contract."""
+    from transmogrifai_tpu.readers.avro import write_avro_records
+    from transmogrifai_tpu.readers.streaming import DirectoryStreamReader
+
+    for s in range(6):
+        write_avro_records(
+            str(tmp_path / f"part-{s}.avro"),
+            [{"v": float(s * 100 + i)} for i in range(100)])
+
+    ref, n_ref = _drain_sample(
+        [DirectoryStreamReader(str(tmp_path), settle_s=0.0)
+         .read_records()])
+    assert n_ref == 600
+    for workers in (1, 2, 4):
+        r = DirectoryStreamReader(str(tmp_path), settle_s=0.0)
+        got, n = _drain_sample(r.stream(passes=1, workers=workers))
+        assert n == 600
+        assert [dict(x) for x in got] == [dict(x) for x in ref]
+
+
+def test_seeded_row_sample_small_stream_is_identity():
+    """n <= k: the sample IS the stream, in order — the degenerate
+    path that makes small streamed fits exactly equal materialized."""
+    rows = [{"i": i} for i in range(40)]
+    got, n = _drain_sample([rows[:25], rows[25:]], k=64)
+    assert n == 40 and got == rows
+    with pytest.raises(ValueError):
+        _drain_sample([rows], k=0)
+
+
+# ---------------------------------------------------------------------------
 # BufferPool — pinned-buffer reuse
 # ---------------------------------------------------------------------------
 

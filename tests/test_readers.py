@@ -315,6 +315,179 @@ def test_directory_stream_reader_error_paths(tmp_path, caplog):
             list(r2.stream(max_batches=5, timeout_s=1.0))
 
 
+def test_directory_stream_reader_multi_pass(tmp_path):
+    """``stream(passes=N)`` (PR 16): N bounded full scans of the
+    directory — :meth:`rescan` runs between them, so multi-pass
+    out-of-core training re-reads the same files from the same reader
+    instead of reconstructing it; the stream ENDS after pass N instead
+    of idle-waiting. Serial and parallel consumers agree."""
+    from transmogrifai_tpu.readers import DirectoryStreamReader
+    from transmogrifai_tpu.readers.avro import write_avro_records
+
+    d = tmp_path / "in"
+    d.mkdir()
+    for i in range(3):
+        write_avro_records(str(d / f"p{i}.avro"),
+                           [{"v": float(i * 10 + j)} for j in range(4)])
+
+    r = DirectoryStreamReader(str(d), settle_s=0.0)
+    one = [[dict(x) for x in b] for b in r.stream(passes=1)]
+    assert [b[0]["v"] for b in one] == [0.0, 10.0, 20.0]
+
+    # explicit rescan re-offers exactly the delivered files
+    assert r.rescan() == 3
+    again = [[dict(x) for x in b] for b in r.stream(passes=1)]
+    assert again == one
+
+    # passes=2 on a fresh reader = the same two scans, one stream call
+    r2 = DirectoryStreamReader(str(d), settle_s=0.0)
+    two = [[dict(x) for x in b] for b in r2.stream(passes=2)]
+    assert two == one + one
+
+    # parallel decode keeps the per-pass order and the pass boundary
+    r3 = DirectoryStreamReader(str(d), settle_s=0.0)
+    par = [[dict(x) for x in b] for b in r3.stream(passes=2, workers=2)]
+    assert par == two
+
+    with pytest.raises(ValueError, match="passes"):
+        list(DirectoryStreamReader(str(d), settle_s=0.0).stream(passes=0))
+
+
+def test_multi_pass_quarantine_counted_once(tmp_path, caplog):
+    """A poison file is quarantined (and counted) exactly ONCE across
+    passes — rescan re-offers only DELIVERED files — and
+    ``new_files_only`` pre-seeded files stay suppressed after rescan
+    (they were never delivered either)."""
+    import logging
+
+    from transmogrifai_tpu import resilience
+    from transmogrifai_tpu.readers import DirectoryStreamReader
+    from transmogrifai_tpu.readers.avro import write_avro_records
+
+    d = tmp_path / "in"
+    d.mkdir()
+    (d / "bad.avro").write_bytes(b"not an avro container")
+    write_avro_records(str(d / "good.avro"), [{"v": 1.0}])
+
+    before = resilience.resilience_stats()["quarantined_files"]
+    r = DirectoryStreamReader(str(d), settle_s=0.0)
+    with caplog.at_level(logging.WARNING):
+        batches = [[dict(x) for x in b] for b in r.stream(passes=3)]
+    assert batches == [[{"v": 1.0}]] * 3
+    assert (resilience.resilience_stats()["quarantined_files"]
+            == before + 1)
+
+    # pre-seeded (new_files_only) files stay invisible across rescans
+    r2 = DirectoryStreamReader(str(d), new_files_only=True, settle_s=0.0)
+    assert list(r2.stream(passes=2)) == []
+    write_avro_records(str(d / "later.avro"), [{"v": 2.0}])
+    got = [[dict(x) for x in b] for b in r2.stream(passes=2)]
+    assert got == [[{"v": 2.0}]] * 2
+
+
+def test_stream_fit_train_matches_materialized(tmp_path):
+    """PR 16 tentpole (a): a streamed train over a directory whose rows
+    fit the sample budget is BIT-IDENTICAL to materializing — same
+    fitted stage states, same scores — because the bounded subsample is
+    then the whole stream in order and the host fitstats tier computes
+    the exact same expressions."""
+    import numpy as np
+
+    from transmogrifai_tpu import FeatureBuilder, Workflow
+    from transmogrifai_tpu import workflow as wfmod
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.readers import DirectoryStreamReader
+    from transmogrifai_tpu.readers.avro import write_avro_records
+
+    rng = np.random.default_rng(16)
+    d = tmp_path / "in"
+    d.mkdir()
+    recs = [{"x0": float(rng.normal()), "x1": float(rng.normal() * 10)}
+            for _ in range(240)]
+    for i in range(3):
+        write_avro_records(str(d / f"p{i}.avro"), recs[i * 80:(i + 1) * 80])
+
+    def fit(stream):
+        feats = [FeatureBuilder.Real(nm).from_column().as_predictor()
+                 for nm in ("x0", "x1")]
+        vec = transmogrify(feats)
+        wf = Workflow().set_result_features(vec)
+        wf.set_reader(DirectoryStreamReader(str(d), settle_s=0.0))
+        prev = wfmod.set_stream_fit(stream=stream, passes=2,
+                                    sample_rows=100_000)
+        try:
+            model = wf.train()
+        finally:
+            wfmod.set_stream_fit(**prev)
+        return wf, model
+
+    wf_m, mat = fit(stream=False)
+    wf_s, st = fit(stream=True)
+    assert wf_m._stream_state is None
+    # 240 rows is below the fusion floor: the tiny-stream path behaves
+    # exactly like materializing (no injected stream state either)
+    assert wf_s._stream_state is None
+    assert st.train_rows == mat.train_rows == len(recs)
+    # each fit() builds its own graph (fresh uids) — compare the fitted
+    # states positionally, in fit order
+    assert len(mat.fitted_stages) == len(st.fitted_stages) > 0
+    for fm, fs in zip(mat.fitted_stages.values(),
+                      st.fitted_stages.values()):
+        assert repr(sorted(fm.get_model_state().items())) \
+            == repr(sorted(fs.get_model_state().items()))
+    sm, ss = mat.score(recs), st.score(recs)
+    # result column names carry the graph's uids too: positional again
+    for nm_a, nm_b in zip(sm.names(), ss.names()):
+        a, b = sm[nm_a], ss[nm_b]
+        if hasattr(a, "values"):
+            np.testing.assert_array_equal(a.values, b.values)
+
+
+def test_stream_fit_bounded_sample_and_auto_mode(tmp_path):
+    """The sample budget BOUNDS the materialized working set: a stream
+    past the budget trains on exactly ``sample_rows`` rows. And the
+    tri-state auto mode streams for directory readers by default but
+    defers to a planner ``materialize`` ingest hint."""
+    import numpy as np
+
+    from transmogrifai_tpu import FeatureBuilder, Workflow
+    from transmogrifai_tpu import workflow as wfmod
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.readers import DirectoryStreamReader
+    from transmogrifai_tpu.readers.avro import write_avro_records
+
+    d = tmp_path / "in"
+    d.mkdir()
+    write_avro_records(str(d / "p0.avro"),
+                       [{"x0": float(i)} for i in range(500)])
+
+    vec = transmogrify([FeatureBuilder.Real("x0").from_column()
+                        .as_predictor()])
+    wf = Workflow().set_result_features(vec)
+    wf.set_reader(DirectoryStreamReader(str(d), settle_s=0.0))
+    prev = wfmod.set_stream_fit(stream=True, passes=2, sample_rows=64)
+    try:
+        model = wf.train()
+    finally:
+        wfmod.set_stream_fit(**prev)
+    assert model.train_rows == 64
+
+    # auto mode: directory reader => stream, unless the measured ingest
+    # hint says materializing is cheaper; a declared RSS cap outranks it
+    prev = wfmod.set_stream_fit(stream=None, ingest_hint=None)
+    try:
+        assert wf._use_stream_fit() is True
+        wfmod.set_stream_fit(ingest_hint="materialize")
+        assert wf._use_stream_fit() is False
+        wfmod.set_stream_fit(rss_cap_mb=256)
+        assert wf._use_stream_fit() is True
+    finally:
+        wfmod.set_stream_fit(**prev)
+    wf2 = Workflow().set_result_features(vec).set_input_records(
+        [{"x0": 1.0}])
+    assert wf2._use_stream_fit() is False
+
+
 def _write_mixed_batch_dir(d, n_files=12, rows=7):
     """A directory of alternating avro/csv micro-batch files with
     distinct per-file payloads (order mistakes can't cancel out)."""

@@ -327,3 +327,45 @@ def test_op_app_mesh_devices_flag(rng, tmp_path):
          "--model-location", str(tmp_path / "m"), "--quiet"])
     assert captured["params"].custom_params["meshDevices"] == 4
     assert out.metrics["mesh"]["devices"] == 4
+
+
+def test_runner_stream_fit_knobs_validated_and_scoped(rng, tmp_path):
+    """PR 16 satellite: customParams.streamFit/streamFitPasses/rssCapMb/
+    featureShards install run-scoped (the process knobs are restored
+    after the run), malformed values name their key before any data is
+    read, and a streamFit=true run off a directory reader takes the
+    streamed ingest end to end."""
+    from transmogrifai_tpu import workflow as wfmod
+    from transmogrifai_tpu.models import _treefit
+    from transmogrifai_tpu.readers import DirectoryStreamReader
+    from transmogrifai_tpu.readers.avro import write_avro_records
+
+    records = _records(rng)
+    wf, label, pred, _sel = _flow()
+    runner = OpWorkflowRunner(wf, training_reader=_ListReader(records))
+    for key, bad in (("streamFitPasses", 0), ("rssCapMb", 0),
+                     ("featureShards", 0), ("streamFit", "yes")):
+        with pytest.raises(ValueError, match=key):
+            runner.run(RunType.TRAIN, OpParams(custom_params={key: bad}))
+
+    d = tmp_path / "train"
+    d.mkdir()
+    for i in range(2):
+        write_avro_records(str(d / f"p{i}.avro"),
+                           records[i * 100:(i + 1) * 100])
+    wf2, label2, pred2, _sel2 = _flow()
+    runner2 = OpWorkflowRunner(
+        wf2, training_reader=DirectoryStreamReader(str(d),
+                                                   settle_s=0.0))
+    before = (wfmod.STREAM_FIT, wfmod.STREAM_FIT_PASSES,
+              wfmod.STREAM_RSS_CAP_MB, wfmod._INGEST_TIER_HINT,
+              _treefit.active_feature_shards())
+    out = runner2.run(RunType.TRAIN, OpParams(
+        model_location=str(tmp_path / "m"),
+        custom_params={"streamFit": True, "streamFitPasses": 2,
+                       "rssCapMb": 4096, "featureShards": 1}))
+    assert os.path.exists(os.path.join(out.model_location, "model.json"))
+    # run-scoped: every knob is back afterwards
+    assert (wfmod.STREAM_FIT, wfmod.STREAM_FIT_PASSES,
+            wfmod.STREAM_RSS_CAP_MB, wfmod._INGEST_TIER_HINT,
+            _treefit.active_feature_shards()) == before

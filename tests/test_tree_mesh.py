@@ -212,6 +212,99 @@ def test_tree_estimator_fit_enters_mesh_scope(rng, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# PR 16 tentpole (b): feature-axis sharding over the mesh grid axis
+# ---------------------------------------------------------------------------
+
+
+@multi_device
+def test_feature_sharded_fit_bit_identical(rng, monkeypatch):
+    """Columns sharded over the mesh ``grid`` axis — each shard runs the
+    kernel histogram + fused split-scan over its own feature block, the
+    cross-shard winner merges by the kernel's own (score desc, idx asc)
+    rule — must reproduce the single-shard forest BIT for bit, under
+    both level drivers. The scan driver doubles as the regression test
+    for the RNG shield (``_rng_replicated``): without it, GSPMD's
+    backward sharding propagation into the non-partitionable threefry
+    changes the bootstrap/feature-mask draws under a grid>1 mesh and
+    the trees silently diverge."""
+    from transmogrifai_tpu.parallel.mesh import feature_shard_mesh
+
+    monkeypatch.setenv("TMOG_PALLAS", "1")
+    X, y, w, bmask = _tree_data(rng, n=256, F=24, n_bin_cols=6)
+    mesh = feature_shard_mesh(2)
+    assert int(mesh.shape["grid"]) == 2
+
+    solo = _fit(X, y, w, bmask)
+    before = ph.tree_kernel_stats()
+    with TF.tree_mesh_scope(mesh), TF.feature_shards_scope(2):
+        sharded = _fit(X, y, w, bmask)
+    after = ph.tree_kernel_stats()
+    assert after["feature_shard_traces"] > before["feature_shard_traces"]
+    for k in ("feat", "thr", "leaf", "train_node"):
+        np.testing.assert_array_equal(np.asarray(solo[k]),
+                                      np.asarray(sharded[k]))
+
+    # unrolled driver (static depth, sibling subtraction) sharded too
+    pre = TF.prepare_bins(X, 8, bmask)
+    prebinned = (pre[0], pre[1], pre[2], False)
+    solo_u = _fit(None, y, w, bmask, prebinned=prebinned, unroll=True)
+    with TF.tree_mesh_scope(mesh), TF.feature_shards_scope(2):
+        shard_u = _fit(None, y, w, bmask, prebinned=prebinned,
+                       unroll=True)
+    for k in ("feat", "thr", "leaf"):
+        np.testing.assert_array_equal(np.asarray(solo_u[k]),
+                                      np.asarray(shard_u[k]))
+
+
+@multi_device
+def test_feature_shards_degenerate_paths(rng, monkeypatch):
+    """featureShards=1 (the default) under a grid mesh, and
+    featureShards>1 WITHOUT a grid mesh, must both resolve to the exact
+    current code path — zero feature-shard traces, identical trees."""
+    from transmogrifai_tpu.parallel.mesh import feature_shard_mesh
+
+    monkeypatch.setenv("TMOG_PALLAS", "1")
+    X, y, w, bmask = _tree_data(rng)
+    solo = _fit(X, y, w, bmask)
+
+    t0 = ph.tree_kernel_stats()["feature_shard_traces"]
+    # grid mesh but shards off (the default _FEATURE_SHARDS == 1)
+    with TF.tree_mesh_scope(feature_shard_mesh(2)):
+        a = _fit(X, y, w, bmask)
+    # shards requested but the mesh has no grid axis to carry them
+    with TF.tree_mesh_scope(make_mesh()), TF.feature_shards_scope(2):
+        b = _fit(X, y, w, bmask)
+    assert ph.tree_kernel_stats()["feature_shard_traces"] == t0
+    for k in ("feat", "thr", "leaf", "train_node"):
+        np.testing.assert_array_equal(np.asarray(solo[k]),
+                                      np.asarray(a[k]))
+        np.testing.assert_array_equal(np.asarray(solo[k]),
+                                      np.asarray(b[k]))
+
+
+def test_feature_shard_knob_validation():
+    with pytest.raises(ValueError):
+        TF.set_feature_shards(0)
+    prev = TF.set_feature_shards(3)
+    try:
+        assert TF.active_feature_shards() == 3
+    finally:
+        TF.set_feature_shards(prev)
+    assert TF.active_feature_shards() == prev
+
+
+@multi_device
+def test_feature_shard_mesh_shape():
+    """feature_shard_mesh(G) slices the SAME device pool into
+    data × grid — total devices unchanged, grid axis exactly G."""
+    from transmogrifai_tpu.parallel.mesh import feature_shard_mesh
+    mesh = feature_shard_mesh(2)
+    assert int(mesh.shape["grid"]) == 2
+    assert (int(mesh.shape["data"]) * int(mesh.shape["grid"])
+            == jax.device_count())
+
+
+# ---------------------------------------------------------------------------
 # satellite: order-robust quantile sketch
 # ---------------------------------------------------------------------------
 

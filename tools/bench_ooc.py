@@ -1,0 +1,103 @@
+"""Out-of-core training leg for bench.py's ``out_of_core`` config.
+
+Runs ONE leg per interpreter (``ru_maxrss`` is a per-process high-water
+mark that never resets, so honest peak-RSS accounting needs a fresh
+process per leg) and prints a single JSON line:
+
+    python tools/bench_ooc.py <data_dir> <holdout.avro> \
+        stream|materialize <cap_mb> <sample_rows>
+
+``stream`` forces the streamed ingest (``streamFit`` on, two directory
+passes, ``sample_rows`` bounded working set) and — when ``cap_mb`` > 0 —
+first arms a HARD heap ceiling via ``resource.setrlimit(RLIMIT_DATA)``:
+on Linux >= 4.7 the data limit covers private anonymous mmaps too, so
+any allocation past the cap raises MemoryError and kills the leg. A
+streamed fit that secretly materialized the event log could not survive
+the cap. The cap is armed AFTER backend init and the warm-up jit (the
+interpreter + compiler baseline is environment, not workload) and
+BEFORE the first byte of the event log is read.
+
+``materialize`` forces the in-memory path on the same directory with no
+cap: its peak RSS is the denominator proving the event log exceeds the
+budget, and its holdout metric is the parity reference.
+"""
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    data_dir, holdout_fp, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+    cap_mb = float(sys.argv[4])
+    sample_rows = int(sys.argv[5])
+
+    import jax
+    # host-memory property under test — pin the portable backend (and
+    # beat any axon sitecustomize platform pin, per tools/bench_cpu.py)
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), os.pardir))
+    import jax.numpy as jnp
+    import numpy as np
+
+    from transmogrifai_tpu import FeatureBuilder, Workflow, telemetry
+    from transmogrifai_tpu import workflow as wfmod
+    from transmogrifai_tpu.columns import PredictionColumn
+    from transmogrifai_tpu.evaluators import metrics as M
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import (
+        BinaryClassificationModelSelector)
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.readers.avro import read_avro_records
+    from transmogrifai_tpu.readers.streaming import DirectoryStreamReader
+
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = [FeatureBuilder.Real(f"x{j}").from_column().as_predictor()
+             for j in range(6)]
+    vec = transmogrify(feats)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()],
+        splitter=None, seed=16)
+    pred = label.transform_with(selector, vec)
+
+    # warm the backend before arming the cap: one tiny dispatch forces
+    # the CPU client + compiler arenas into the baseline
+    _ = jax.jit(lambda a: a + 1)(jnp.zeros((8,), jnp.float32))
+
+    if cap_mb > 0:
+        import resource
+        cap = int(cap_mb) << 20
+        resource.setrlimit(resource.RLIMIT_DATA, (cap, cap))
+
+    wfmod.set_stream_fit(stream=(mode == "stream"), passes=2,
+                         sample_rows=sample_rows,
+                         rss_cap_mb=(cap_mb if cap_mb > 0 else None))
+    wf = Workflow().set_result_features(pred)
+    wf.set_reader(DirectoryStreamReader(data_dir, pattern="*.avro",
+                                        settle_s=0.0))
+    t0 = time.perf_counter()
+    model = wf.train()
+    train_s = time.perf_counter() - t0
+
+    ho = read_avro_records(holdout_fp)
+    y = np.array([r["label"] for r in ho], dtype=np.float64)
+    store = model.score(ho)
+    pcol = next(store[nm] for nm in store.names()
+                if isinstance(store[nm], PredictionColumn))
+    m = M.binary_metrics(y, pcol.prediction, pcol.probability[:, 1])
+
+    print(json.dumps({
+        "mode": mode, "cap_mb": cap_mb,
+        "rows_trained": model.train_rows,
+        "sample_rows": sample_rows,
+        "stream_stat_columns": len(getattr(wf, "_stream_state", None)
+                                   or ()),
+        "train_s": round(train_s, 2),
+        "holdout_AuPR": round(float(m["AuPR"]), 4),
+        "peak_rss_mb": telemetry.peak_rss_mb(),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

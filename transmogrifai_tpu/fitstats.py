@@ -73,6 +73,7 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "StatRequest", "StatResults", "LayerStatsPlan", "SufficientStats",
+    "StreamingMomentFold",
     "FITSTATS_ENABLED", "FITSTATS_MIN_STAGES", "FITSTATS_CHUNK_ROWS",
     "fitstats_stats", "reset_fitstats_stats", "program_cache_stats",
     "collect_column_state", "sufficient_stats_to_json",
@@ -104,7 +105,7 @@ _MOMENT_KINDS = frozenset(
 _TALLY_LOCK = threading.Lock()
 _TALLY = {"layers_fused": 0, "passes_saved": 0, "bytes_scanned": 0,
           "host_passes": 0, "device_passes": 0, "programs_compiled": 0,
-          "warm_state_merges": 0}
+          "warm_state_merges": 0, "stream_chunks": 0}
 
 
 def fitstats_stats() -> Dict[str, int]:
@@ -694,6 +695,246 @@ def _device_moment_bundles(store, col_kinds: Dict[str, Dict[str, List[Tuple]]],
 
 
 # ---------------------------------------------------------------------------
+# streaming execution — the out-of-core twin of the device fold
+# ---------------------------------------------------------------------------
+
+
+class StreamingMomentFold:
+    """Accumulate the device moment fold over row batches as they stream
+    off a directory reader — no materialized store, host memory bounded
+    at one staging chunk.
+
+    Bit-parity with :func:`_device_moment_bundles` by construction: the
+    incoming batches re-buffer into the EXACT fixed-shape chunks the
+    materialized fold would cut the concatenated rows into —
+    ``FITSTATS_CHUNK_ROWS`` rows once the stream exceeds one chunk, else
+    the single padded ``_chunk_rows(n)`` chunk — each chunk runs the
+    SAME jitted ``_moment_program`` (shared cache key) and the per-chunk
+    partials Chan-combine in the same stream order, so ``finalize()``
+    returns per-column :class:`SufficientStats` whose finalized values
+    are bit-identical to a materialized device pass over the same rows.
+    The fold is device-tier only (the out-of-core regime is far above
+    the fusion row floor); a device failure raises to the caller, whose
+    fallback is materializing.
+
+    Usage: construct with the tracked column names, call
+    ``update(batch_store)`` per streamed batch (a ColumnStore with those
+    columns), then ``finalize()`` once the stream is drained.
+    """
+
+    def __init__(self, columns: Sequence[str], mesh=None):
+        import jax
+
+        self.columns = sorted(columns)
+        self._k = len(self.columns)
+        f64 = jax.config.jax_enable_x64
+        self._dtype = np.float64 if f64 else np.float32
+        self._mesh = mesh
+        self._parts: List[Tuple] = []
+        self._pending = None
+        self._fill = 0
+        self._n = 0
+        self._flushed = 0
+        self._fold_s = 0.0
+        self._prog_key = None
+        self._prog_was_cached = True
+        self._cc0 = None
+        self._V = None
+        self._B = None
+        self._taken: List[np.ndarray] = []
+
+    @property
+    def n_rows(self) -> int:
+        return self._n
+
+    def _ensure_buffers(self) -> None:
+        if self._V is None:
+            pool = _stage_pool()
+            self._V = pool.take((FITSTATS_CHUNK_ROWS, self._k),
+                                self._dtype)
+            self._B = pool.take((FITSTATS_CHUNK_ROWS, self._k), bool)
+            self._taken = [self._V, self._B]
+
+    def _sharding(self, chunk: int):
+        if _MESH_OFF or self._mesh is False:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .parallel.mesh import mesh_if_multi, process_default_mesh
+        mesh = mesh_if_multi(self._mesh if self._mesh is not None
+                             else process_default_mesh())
+        if mesh is not None and chunk % mesh.shape["data"] == 0:
+            return NamedSharding(mesh, P("data", None))
+        return None
+
+    def _program(self, chunk: int):
+        from . import telemetry
+        key = (chunk, self._k, str(self._dtype))
+        if self._prog_key is None:
+            self._prog_key = key
+            self._prog_was_cached = key in _PROGRAM_CACHE
+            self._cc0 = telemetry.compile_clock_s()
+        return _moment_program(*key)
+
+    def _place_flush(self, chunk: int):
+        """Upload the staging buffers as one chunk (multi-chunk path:
+        plain/sharded device_put — contents never repeat within the
+        stream, so the content-keyed cache would be pure overhead) and
+        hand fresh staging buffers to the accumulator."""
+        import time as _time
+
+        import jax
+        t0 = _time.perf_counter()
+        v, b, taken = self._V, self._B, self._taken
+        sharding = self._sharding(chunk)
+        if sharding is not None:
+            vd = jax.device_put(v, sharding)
+            bd = jax.device_put(b, sharding)
+        else:
+            vd = jax.device_put(v)
+            bd = jax.device_put(b)
+        prog = self._program(chunk)
+        placed = (prog(vd, bd), taken)
+        self._fold_s += _time.perf_counter() - t0
+        self._V = self._B = None
+        self._taken = []
+        self._fill = 0
+        return placed
+
+    def _pull(self, placed) -> None:
+        import time as _time
+
+        import jax
+        t0 = _time.perf_counter()
+        out, taken = placed
+        self._parts.append(jax.device_get(out))
+        self._fold_s += _time.perf_counter() - t0
+        pool = _stage_pool()
+        for buf in taken:
+            pool.give(buf)
+
+    def _flush_full(self) -> None:
+        # double-buffered (the materialized fold's discipline): chunk
+        # i+1's upload is issued before chunk i's result is pulled;
+        # TMOG_PIPELINE=0 serializes
+        from .pipeline import PIPELINE_ENABLED as _pipe_on
+        placed = self._place_flush(FITSTATS_CHUNK_ROWS)
+        self._flushed += 1
+        if not _pipe_on:
+            self._pull(placed)
+            return
+        if self._pending is not None:
+            self._pull(self._pending)
+        self._pending = placed
+
+    def update(self, store) -> None:
+        """Fold one streamed batch (a ColumnStore carrying the tracked
+        columns) into the running chunked state."""
+        if not self.columns:
+            return
+        m = store.n_rows
+        if m == 0:
+            return
+        Vb = np.empty((m, self._k), self._dtype)
+        Bb = np.empty((m, self._k), bool)
+        for j, nm in enumerate(self.columns):
+            col = store[nm]
+            Bb[:, j] = col.mask
+            Vb[:, j] = np.where(col.mask,
+                                col.values.astype(np.float64), 0.0)
+        off = 0
+        while off < m:
+            if self._fill == FITSTATS_CHUNK_ROWS:
+                # full AND more rows exist: only now is this a full
+                # interior chunk (a stream of exactly one chunk must
+                # stay the padded single-chunk program)
+                self._flush_full()
+            self._ensure_buffers()
+            take = min(FITSTATS_CHUNK_ROWS - self._fill, m - off)
+            self._V[self._fill:self._fill + take] = Vb[off:off + take]
+            self._B[self._fill:self._fill + take] = Bb[off:off + take]
+            self._fill += take
+            off += take
+        self._n += m
+
+    def finalize(self) -> Dict[str, SufficientStats]:
+        """Drain the fold and return each column's full-stream
+        :class:`SufficientStats` (the Chan-merged fold partials —
+        exactly what the materialized device pass reports via
+        ``states_out``)."""
+        import jax
+
+        from . import telemetry
+        if not self.columns:
+            return {}
+        if self._flushed == 0:
+            # single (padded) chunk: mirror the materialized one_chunk
+            # path — fresh pad arrays + the content-keyed upload cache
+            # (pool buffers would alias into the cache and corrupt it)
+            import time as _time
+            t0 = _time.perf_counter()
+            chunk = _chunk_rows(self._n)
+            vp = np.zeros((chunk, self._k), self._dtype)
+            bp = np.zeros((chunk, self._k), bool)
+            if self._V is not None:
+                vp[:self._fill] = self._V[:self._fill]
+                bp[:self._fill] = self._B[:self._fill]
+                pool = _stage_pool()
+                for buf in self._taken:
+                    pool.give(buf)
+                self._V = self._B = None
+                self._taken = []
+            sharding = self._sharding(chunk)
+            if sharding is not None:
+                vd = jax.device_put(vp, sharding)
+                bd = jax.device_put(bp, sharding)
+            else:
+                from .models.base import device_put_f32
+                vd = device_put_f32(vp)
+                bd = device_put_f32(bp)
+            prog = self._program(chunk)
+            self._parts.append(jax.device_get(prog(vd, bd)))
+            self._fold_s += _time.perf_counter() - t0
+        else:
+            chunk = FITSTATS_CHUNK_ROWS
+            if self._V is not None:
+                # pad the tail chunk in place (pool staging, like the
+                # materialized multi-chunk tail)
+                self._V[self._fill:] = 0
+                self._B[self._fill:] = False
+                placed = self._place_flush(chunk)
+                if self._pending is not None:
+                    self._pull(self._pending)
+                    self._pending = None
+                self._pull(placed)
+            if self._pending is not None:
+                self._pull(self._pending)
+                self._pending = None
+        _tally("device_passes")
+        _tally("stream_chunks", len(self._parts))
+        _tally("bytes_scanned",
+               int(self._n) * self._k
+               * (np.dtype(self._dtype).itemsize + 1))
+        compiled_in_window = (not self._prog_was_cached
+                              or (self._cc0 is not None
+                                  and telemetry.compile_clock_s()
+                                  > self._cc0))
+        if not compiled_in_window:
+            telemetry.record_device_work(
+                "fitstats",
+                flops=10.0 * chunk * self._k * max(len(self._parts), 1),
+                seconds=self._fold_s)
+        with telemetry.span("fit:psum_merge", chunks=len(self._parts),
+                            columns=self._k, sharded=False,
+                            streamed=True):
+            cnt, mean, m2, mn, mx = _chan_combine(self._parts)
+        return {nm: SufficientStats(float(cnt[j]), float(mean[j]),
+                                    float(m2[j]), float(mn[j]),
+                                    float(mx[j]))
+                for j, nm in enumerate(self.columns)}
+
+
+# ---------------------------------------------------------------------------
 # the layer plan
 # ---------------------------------------------------------------------------
 
@@ -790,7 +1031,8 @@ class LayerStatsPlan:
     def run(self, store, device: Optional[bool] = None,
             mesh=None, tier_hint: Optional[str] = None,
             state_out: Optional[Dict[str, SufficientStats]] = None,
-            warm_state: Optional[Mapping[str, SufficientStats]] = None
+            warm_state: Optional[Mapping[str, SufficientStats]] = None,
+            stream_state: Optional[Mapping[str, SufficientStats]] = None
             ) -> StatResults:
         """Execute every request in one pass; ``device`` overrides the
         bandwidth/row gate (tests pin it either way), ``tier_hint``
@@ -811,7 +1053,17 @@ class LayerStatsPlan:
         the refit covers [old window + fresh slice] without rescanning
         the old window. Columns without a warm entry stay fresh-only;
         non-moment kinds (quantiles, top-K, sanity) are not mergeable
-        and always compute over the fresh store."""
+        and always compute over the fresh store.
+
+        The out-of-core seam: ``stream_state`` maps columns to
+        full-stream :class:`SufficientStats` a
+        :class:`StreamingMomentFold` produced over the un-materialized
+        data. A moment request whose column is covered finalizes from
+        the STREAMED state — bit-identical to a materialized device
+        pass over the full stream — and the (bounded subsample) store
+        is never scanned for it; uncovered columns and non-moment kinds
+        compute from ``store`` as usual. ``warm_state`` composes:
+        streamed states Chan-merge with warm entries like fresh ones."""
         from . import telemetry
 
         import time
@@ -826,6 +1078,15 @@ class LayerStatsPlan:
                     .setdefault(r.kind, []).append(tuple(r.params))
             else:
                 other.append(r)
+
+        # out-of-core seam: moment columns the streaming fold already
+        # covered finalize from the streamed full-data state — the
+        # (subsample) store is never scanned for them
+        stream_cols: Dict[str, Dict[str, List[Tuple]]] = {}
+        if stream_state:
+            stream_cols = {nm: moment_cols.pop(nm)
+                           for nm in list(moment_cols)
+                           if nm in stream_state}
 
         # moment_cols first: _gate_device's breaker allow() may consume
         # the open breaker's single half-open probe, so it must only be
@@ -842,8 +1103,9 @@ class LayerStatsPlan:
         states: Dict[str, SufficientStats] = {}
         want_state = state_out is not None or warm_state is not None
 
-        if moment_cols:
-            if use_device:
+        if moment_cols or stream_cols:
+            bundles: Dict[str, Dict[Tuple, Any]] = {}
+            if moment_cols and use_device:
                 # device tier behind its fault site + breaker: a failed
                 # device pass degrades to the host tier WITHIN this pass
                 # (the fused scan still happens — failure costs the
@@ -871,11 +1133,16 @@ class LayerStatsPlan:
                     # toward the very tier that is failing)
                     t_run = time.perf_counter()
                     c_run = telemetry._COMPILE_CLOCK["s"]
-            if not use_device:
+            if moment_cols and not use_device:
                 bundles = {nm: _host_moment_bundle(
                     store[nm], kinds,
                     state_out=states if want_state else None, name=nm)
                     for nm, kinds in moment_cols.items()}
+            for nm in stream_cols:
+                # the streamed state IS this column's sufficient stats:
+                # it persists with the model and warm-merges like a
+                # fresh-slice state
+                states[nm] = stream_state[nm]
             merged = self._warm_merge(states, warm_state)
             for r in self.requests:
                 if r.kind in _MOMENT_KINDS:
@@ -883,6 +1150,9 @@ class LayerStatsPlan:
                     if r.column in merged:
                         # warm start: the value reflects [old + fresh]
                         values[r.key()] = merged[r.column].finalize(
+                            r.kind, tuple(r.params))
+                    elif r.column in stream_cols:
+                        values[r.key()] = stream_state[r.column].finalize(
                             r.kind, tuple(r.params))
                     else:
                         values[r.key()] = \

@@ -47,6 +47,7 @@ suppression syntax.
 from __future__ import annotations
 
 import logging
+import os
 from dataclasses import dataclass, field
 from typing import (Any, Dict, Iterable, List, Optional, Sequence, Set,
                     Tuple)
@@ -114,6 +115,11 @@ RULES: Dict[str, Tuple[str, str]] = {
                "mesh-unsafe stage: device_compute row dimension does not "
                "track the input batch, so zero-weight pad_rows cannot pad "
                "it to the mesh's data axis"),
+    "TMG206": (Severity.WARNING,
+               "device-resident working set exceeds the per-chip VMEM "
+               "envelope and feature-axis sharding is not engaged "
+               "(customParams.featureShards + meshGridSize shrink the "
+               "per-chip kernel working set 1/G)"),
     # -- TMG3xx: repo rules (tools/tmoglint.py AST self-lint) --------------
     "TMG301": (Severity.ERROR,
                "time.time() used for a duration — monotonic timing must "
@@ -662,6 +668,17 @@ def _prepared_signature(prepared: Dict[str, Any], n: int):
     return tuple(sig)
 
 
+# TMG206 — per-chip VMEM envelope the device-resident working set of a
+# single stage is held against. 16 MiB is the common per-core budget on
+# current TPU generations; override with TMOG_VMEM_BYTES for other parts
+# (or to exercise the rule in tests with a tiny envelope). The working
+# set is extrapolated from the pre-flight probe to TMOG_VMEM_PROBE_ROWS
+# rows so the estimate reflects a production batch, not the 8-row probe.
+VMEM_ENVELOPE_BYTES = int(os.environ.get("TMOG_VMEM_BYTES",
+                                         16 * 1024 * 1024))
+VMEM_PROBE_ROWS = int(os.environ.get("TMOG_VMEM_PROBE_ROWS", 8192))
+
+
 def preflight_device(model, n_rows: int = 8) -> List[Finding]:
     """TMG2xx: propagate shapes/dtypes through every layer's device
     computes via ``jax.eval_shape`` — no dataset, no device dispatch.
@@ -807,6 +824,36 @@ def preflight_device(model, n_rows: int = 8) -> List[Finding]:
                         "promotes to float64: under x32 this silently "
                         "downcasts (and on TPU f64 is emulated) — the "
                         "pipeline dtype is f32", stage=m.uid))
+                # TMG206 — VMEM envelope: extrapolate the stage's prepared
+                # blocks (its device-resident inputs) from the probe batch
+                # to VMEM_PROBE_ROWS rows. Row dims scale; constant dims
+                # (vocab tables, bin edges) count as-is. Advisory only —
+                # the estimate ignores intermediates and XLA's own layout,
+                # so it flags order-of-magnitude overruns, not near-misses.
+                try:
+                    from .models._treefit import active_feature_shards
+                    resident = 0
+                    for v in prep.values():
+                        a = np.asarray(v)
+                        nb = int(a.dtype.itemsize)
+                        for d in a.shape:
+                            nb *= (VMEM_PROBE_ROWS if d == n_rows
+                                   else int(d))
+                        resident += nb
+                    if (resident > VMEM_ENVELOPE_BYTES
+                            and active_feature_shards() <= 1):
+                        findings.append(Finding(
+                            "TMG206", f"{_stage_label(m)} device-resident "
+                            f"working set ~{resident / 2**20:.1f} MiB at "
+                            f"{VMEM_PROBE_ROWS} rows exceeds the "
+                            f"{VMEM_ENVELOPE_BYTES / 2**20:.0f} MiB VMEM "
+                            "envelope with feature sharding off: set "
+                            "customParams.featureShards (with a grid "
+                            "mesh) to shard columns 1/G per chip, or "
+                            "customParams.streamFit to bound the host "
+                            "working set", stage=m.uid))
+                except Exception:  # lint: broad-except — the envelope estimate is advisory, never kills pre-flight
+                    pass
                 store = store.with_column(
                     m.output_name,
                     VectorColumn(OPVector,

@@ -74,7 +74,8 @@ _PROBE_LOCK = _threading.Lock()
 _TK_LOCK = _threading.Lock()
 _TK = {"cumhist_traces": 0, "sparse01_traces": 0, "split_scan_traces": 0,
        "route_traces": 0, "predict_traces": 0, "sharded_hist_traces": 0,
-       "sharded_route_traces": 0, "kernel_disables": 0}
+       "sharded_route_traces": 0, "feature_shard_traces": 0,
+       "kernel_disables": 0}
 
 
 def _tk_tally(key: str, n: int = 1) -> None:

@@ -56,6 +56,40 @@ _NEG = -1e30
 #: by the dispatching thread. Consumers read it at trace time only.
 _TREE_MESH = [None]
 
+#: requested feature-axis shard count (1 = off). Like ``_TREE_MESH`` a
+#: module global read at trace time: the runner installs it run-scoped
+#: (``customParams.featureShards``) and restores in ``finally``; it only
+#: ENGAGES when the active tree mesh's ``grid`` axis matches it exactly
+#: (see ``_feature_shard_count``), so a stale value over the wrong mesh
+#: fails open to the current path instead of mis-sharding.
+_FEATURE_SHARDS = [1]
+
+
+def set_feature_shards(n: int) -> int:
+    """Install the requested feature-axis shard count (1 = off);
+    returns the previous value for ``finally``-restore."""
+    n = int(n)
+    if n < 1:
+        raise ValueError(f"feature shards must be >= 1, got {n}")
+    prev = _FEATURE_SHARDS[0]
+    _FEATURE_SHARDS[0] = n
+    return prev
+
+
+@contextlib.contextmanager
+def feature_shards_scope(n: int):
+    """Scoped :func:`set_feature_shards` (tests, bench legs)."""
+    prev = set_feature_shards(n)
+    try:
+        yield
+    finally:
+        _FEATURE_SHARDS[0] = prev
+
+
+def active_feature_shards() -> int:
+    """The requested feature-axis shard count (1 = off)."""
+    return _FEATURE_SHARDS[0]
+
 
 @contextlib.contextmanager
 def tree_mesh_scope(mesh):
@@ -80,6 +114,35 @@ def active_tree_mesh():
     """The mesh installed by :func:`tree_mesh_scope`, or None (already
     ``mesh_if_multi``-normalized: never a 1-device mesh)."""
     return _TREE_MESH[0]
+
+
+def _rng_replicated(draw, *keys):
+    """Evaluate the RNG ``draw(*keys)`` pinned against GSPMD partitioning.
+
+    Over a mesh with a real ``grid`` axis, GSPMD's backward sharding
+    propagation can push a grid-sharded layout from a downstream
+    ``shard_map`` into the threefry computation itself — and with the
+    non-partitionable threefry (``jax_threefry_partitionable=False``,
+    this JAX version's default) a sharded evaluation CHANGES the drawn
+    values, not just their layout. Bootstrap weights and per-node
+    feature masks then silently differ between the sharded and solo
+    programs. A shard_map body is compiled per device verbatim, so
+    wrapping the draw in a fully-replicated shard_map makes every
+    device evaluate the identical unsharded draw: the stream matches
+    the meshless program bit-for-bit. With no mesh (or a grid axis of
+    1, where nothing can mis-shard) the draw runs untouched — the
+    exact pre-shard jaxpr."""
+    mesh = active_tree_mesh()
+    if mesh is None or int(mesh.shape.get("grid", 1)) <= 1:
+        return draw(*keys)
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    in_specs = tuple(P(*([None] * jnp.ndim(k))) for k in keys)
+    out = jax.eval_shape(draw, *keys)
+    out_specs = jax.tree_util.tree_map(
+        lambda a: P(*([None] * len(a.shape))), out)
+    return shard_map(draw, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)(*keys)
 
 
 def _sharded_cumhist(mesh, stats, node, XbT, n_nodes, n_bins, *,
@@ -140,6 +203,118 @@ def _sharded_route_level(mesh, XbT, slot, g, f_idx, t_idx, lchild,
     )(XbT, slot, g, f_idx, t_idx, lchild, rchild, do_split)
 
 
+def _feature_sharded_split(mesh, stats, node, XblkS, A, nb, *, kind,
+                           min_instances, lam, mcw, mask_afS, bcS=None,
+                           sparse01=False, half=None, prevS=None,
+                           rank=None):
+    """Feature-axis-sharded histogram + fused split scan for ONE block
+    (the VMEM half of the tentpole): the block's columns are pre-split
+    into ``G = mesh.shape['grid']`` contiguous sub-blocks (zero-padded
+    to equal width ``Flg``, pads masked out), and each grid shard runs
+    the EXISTING Pallas ``cumhist`` + ``split_scan`` over its own
+    [Flg, n] slice — per-chip kernel working set shrinks 1/G, which is
+    what lets matrices wider than one chip's VMEM envelope train at all.
+    Rows still shard over ``data`` (partial histograms psum-merge
+    exactly as :func:`_sharded_cumhist`).
+
+    Returns per-shard local winners stacked on a leading grid axis —
+    ``(score [G, A], local flat idx [G, A], valid [G, A], winner left
+    stats [G, A, C], histogram [G, A, C, nb, Flg] still grid-sharded
+    for next-level sibling subtraction, node totals [A, C])`` — and the
+    caller merges them by the same ``(score desc, global idx asc)`` rule
+    the per-block merge already uses, so the cross-shard merge is one
+    tiny allgather of [G, A] scalars, not a histogram exchange.
+
+    Bit-parity with the single-shard pass holds by construction: each
+    feature's histogram lane and candidate score depend only on that
+    feature (identical kernel math at any block width), and contiguous
+    column chunks keep the t-major global candidate order — real
+    candidates rank identically, pad candidates carry the masked
+    sentinel score and can only "win" when no valid split exists (where
+    the winner's identity is dead downstream).
+
+    ``prevS``/``rank``/``half`` engage the sibling-subtraction variant:
+    ``node`` is then the even-slot map at ``half`` parent slots and the
+    previous level's grid-sharded histogram is gathered at ``rank``
+    per shard. Node totals replicate via a psum-selected shard-0
+    feature-0 lane — the exact lane the unsharded path reads."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ._pallas_hist import _tk_tally, cumhist, split_scan
+    _tk_tally("feature_shard_traces")
+    C = stats.shape[1]
+    Flg = int(XblkS.shape[1])
+    use_prev = prevS is not None
+    in_specs = [P("data", None), P("data"), P("grid", None, "data"),
+                P("grid", None, None), P()]
+    args = [stats, node, XblkS, mask_afS, jnp.asarray(min_instances)]
+    if bcS is not None:
+        in_specs.append(P("grid", None, "data"))
+        args.append(bcS)
+    if mcw is not None:
+        in_specs.append(P())
+        args.append(jnp.asarray(mcw))
+    if use_prev:
+        in_specs.extend([P("grid", None, None, None, None), P()])
+        args.extend([prevS, rank])
+
+    def body(st, nd, xbS, mafS, mi, *rest):
+        ri = 0
+        bcl = None
+        if bcS is not None:
+            bcl = rest[ri][0]
+            ri += 1
+        mcw_l = None
+        if mcw is not None:
+            mcw_l = rest[ri]
+            ri += 1
+        if use_prev:
+            ev = lax.psum(cumhist(st, nd, xbS[0], half, nb, bc=bcl,
+                                  sparse01=sparse01), "data")
+            parent = rest[ri][0][rest[ri + 1]]     # [half, C, nb, Flg]
+            cumb = jnp.stack([ev, parent - ev], axis=1).reshape(
+                (A,) + ev.shape[1:])               # interleave 2i/2i+1
+        else:
+            cumb = lax.psum(cumhist(st, nd, xbS[0], A, nb, bc=bcl,
+                                    sparse01=sparse01), "data")
+        sc, ix, ok = split_scan(cumb, kind, mi, lam=lam,
+                                min_child_weight=mcw_l, mask=mafS[0])
+        size = (nb - 1) * Flg
+        lb = jnp.take_along_axis(
+            cumb[:, :, :-1, :].reshape(A, C, size),
+            jnp.clip(ix, 0, max(size - 1, 0))[:, None, None],
+            axis=2)[:, :, 0]                       # [A, C] local winner
+        tst = lax.psum(
+            jnp.where(lax.axis_index("grid") == 0, cumb[:, :, -1, 0],
+                      jnp.zeros((A, C), cumb.dtype)), "grid")
+        return (sc[None], ix[None], ok[None], lb[None], cumb[None], tst)
+
+    return shard_map(
+        body, mesh=mesh, in_specs=tuple(in_specs),
+        out_specs=(P("grid", None), P("grid", None), P("grid", None),
+                   P("grid", None, None),
+                   P("grid", None, None, None, None), P(None, None)),
+        check_rep=False)(*args)
+
+
+def _fs_block_mask(cols, G, Flg, A, feat_mask, node_mask, dtype):
+    """[G, A, Flg] candidate mask for one feature-sharded block: the
+    existing feature/per-node masks over the block's real columns, zero
+    over the width pad (contiguous chunks: global feature s·Flg + f)."""
+    fb_n = len(cols)
+    m = jnp.ones((A, fb_n), dtype)
+    if feat_mask is not None:
+        m = m * jnp.broadcast_to(
+            feat_mask[jnp.asarray(cols)][None, :], (A, fb_n)).astype(dtype)
+    if node_mask is not None:
+        m = m * node_mask[:, jnp.asarray(cols)].astype(dtype)
+    pad = G * Flg - fb_n
+    if pad:
+        m = jnp.concatenate([m, jnp.zeros((A, pad), dtype)], axis=1)
+    return m.reshape(A, G, Flg).transpose(1, 0, 2)
+
+
 # ---------------------------------------------------------------------------
 # Binning
 # ---------------------------------------------------------------------------
@@ -172,8 +347,9 @@ def quantile_bin_edges(X: jnp.ndarray, n_bins: int) -> jnp.ndarray:
     qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
     if stride == 1:
         return jnp.quantile(X, qs, axis=0).T
-    idx = jax.random.permutation(
-        jax.random.PRNGKey(_QUANTILE_SEED), n)[:-(-n // stride)]
+    idx = _rng_replicated(
+        lambda k: jax.random.permutation(k, n),
+        jax.random.PRNGKey(_QUANTILE_SEED))[:-(-n // stride)]
     return jnp.quantile(X[idx], qs, axis=0).T
 
 
@@ -456,7 +632,11 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
     if prepared is None:
         prepared = prepare_blocks(Xb, XbT, edges, n_bins, col_blocks,
                                   stats.dtype)
-    use_pallas, Xmat_full, blocks = prepared
+    if len(prepared) == 4:
+        use_pallas, Xmat_full, blocks, fs_G = prepared
+    else:           # pre-feature-shard 3-tuple (external callers)
+        use_pallas, Xmat_full, blocks = prepared
+        fs_G = 0
     if use_pallas:
         XbT_full = Xmat_full
         F, n = XbT_full.shape
@@ -484,10 +664,21 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
     # fused split-scan kernel: one VMEM pass per (level, block) replaces
     # the serialized XLA score/mask/argmax chain; any block outside the
     # kernel's envelope keeps the whole level on the XLA selection path
-    # (the two paths must pick candidates over the SAME flat axis)
+    # (the two paths must pick candidates over the SAME flat axis).
+    # Feature-sharded blocks check the PER-SHARD width — fitting the
+    # scan kernel's envelope at 1/G width is the point of sharding.
     use_scan = use_pallas and all(
-        split_scan_ok(cap, nb, len(cols))
-        for cols, nb, _tf, _xb, _bc, _sp in blocks)
+        split_scan_ok(cap, nb, (blk.shape[1] if fs_G else len(cols)))
+        for cols, nb, _tf, blk, _bc, _sp in blocks)
+    if fs_G:
+        # prepare_blocks engaged sharding under the same mesh scope and
+        # row count, so the mesh gate above cannot have dropped it; the
+        # scan envelope was pre-checked at n_nodes=1024.
+        if tmesh is None or not use_scan:
+            raise ValueError(
+                "featureShards: prepared blocks are grid-stacked but the "
+                "sharded level body cannot engage (cap "
+                f"{cap} > 1024, or mesh/rows changed since prepare)")
 
     def block_hist(st, nd, xb, a, nb, bc, sp):
         if tmesh is not None:
@@ -521,119 +712,193 @@ def grow_tree(Xb: jnp.ndarray, edges: jnp.ndarray, stats: jnp.ndarray,
             # slot, re-drawn every level (slot identity changes per level,
             # so (level, slot) ≡ node)
             ku = jax.random.fold_in(node_feat_key, d)
-            u = jax.random.uniform(ku, (A, F))
+            u = _rng_replicated(
+                lambda k: jax.random.uniform(k, (A, F)), ku)
             kth = jnp.sort(u, axis=1)[:, node_feat_k - 1][:, None]
             node_mask = u <= kth                       # [A, F]
         else:
             node_mask = None
-        # per-block cumulative histograms over slots; idle (slot == A) → 0.
-        # Candidate axis = concat of every block's (bins−1)·F_b pairs.
-        flats, oks, cums, parts = [], [], [], []
-        off_b = 0
         if prev is not None:
             half = A // 2
             # left children live in the EVEN slots by construction
             # (lchild = 2·inv); everything else → dead sentinel
             node_even = jnp.where((slot < A) & (slot % 2 == 0),
                                   slot // 2, half)
-        for bi, (cols, nb, _thr_fn, Xblk, bc, sp) in enumerate(blocks):
-            if prev is not None:
-                if use_pallas:
-                    ev = block_hist(stats, node_even, Xblk, half, nb,
-                                    bc, sp)
-                else:
-                    ev = _level_cumhist(stats, node_even, Xblk, half, nb)
-                parent = prev[0][bi][prev[1]]          # [half, C, nb, Fb]
-                cumb = jnp.stack([ev, parent - ev], axis=1).reshape(
-                    (A,) + ev.shape[1:])               # interleave 2i/2i+1
-            elif use_pallas:
-                # fused VMEM kernel over the transposed block [Fb, n]
-                cumb = block_hist(stats, slot, Xblk, A, nb, bc, sp)
-            else:
-                cumb = _level_cumhist(stats, slot, Xblk, A, nb)
-            # [A, C, nb, Fb]
-            if use_scan:
-                # fused split scan: score+masks+argmax in one kernel
-                # pass; the feature/per-node masks combine into ONE
-                # [A, Fb] operand (tiny — the [A, B-1, Fb] expansion
-                # happens in VMEM, not HBM)
-                fb_n = len(cols)
-                mask_af = None
-                if feat_mask is not None:
-                    mask_af = jnp.broadcast_to(
-                        feat_mask[jnp.asarray(cols)][None, :],
-                        (A, fb_n)).astype(stats.dtype)
-                if node_mask is not None:
-                    nm = node_mask[:, jnp.asarray(cols)].astype(
-                        stats.dtype)
-                    mask_af = nm if mask_af is None else mask_af * nm
-                lam_s, mcw = crit.kernel_params()
-                sc_b, ix_b, ok_b = split_scan(
-                    cumb, crit.kernel_kind, min_instances, lam=lam_s,
-                    min_child_weight=mcw, mask=mask_af)
-                parts.append((off_b, sc_b, ix_b, ok_b))
-            else:
-                sb = crit.score(cumb)                 # [A, nb-1, Fb]
-                lcb = cumb[:, -1, :-1, :]
-                tcb = cumb[:, -1, -1:, :]
-                okb = (lcb >= min_instances) \
-                    & (tcb - lcb >= min_instances)
-                extra = crit.extra_ok(cumb)
-                if extra is not None:
-                    okb = okb & extra
-                if feat_mask is not None:
-                    okb = okb & feat_mask[jnp.asarray(cols)][None, None, :]
-                if node_mask is not None:
-                    okb = okb & node_mask[:, jnp.asarray(cols)][:, None, :]
-                flats.append(jnp.where(okb, sb, _NEG).reshape(A, -1))
-                oks.append(okb.reshape(A, -1))
-            cums.append(cumb)
-            off_b += (nb - 1) * len(cols)
-        if use_scan:
-            # merge per-block winners on the SAME flat candidate axis the
-            # XLA concat+argmax walks: score desc, global flat idx asc
-            # (argmax's first-occurrence tie rule)
-            _o0, bs, bi0, bv = parts[0][0], parts[0][1], parts[0][2], \
-                parts[0][3]
-            best = _o0 + bi0
-            valid = bv
-            for o_k, s_k, i_k, v_k in parts[1:]:
-                gi = o_k + i_k
-                take = (s_k > bs) | ((s_k == bs) & (gi < best))
-                best = jnp.where(take, gi, best)
+        if fs_G:
+            # feature-axis-sharded level: every block's histogram + fused
+            # split scan runs per grid shard over its own column slice
+            # (_feature_sharded_split); the (blocks × shards) local
+            # winners merge below by the SAME (score desc, global idx
+            # asc) rule the per-block merge uses. Candidate indices live
+            # in the G·Flg-padded t-major flat space — contiguous column
+            # chunks keep real candidates in the unsharded relative
+            # order, and pads carry the masked sentinel score.
+            lam_s, mcw = crit.kernel_params()
+            parts, cums = [], []
+            tstats = None
+            off_b = 0
+            for bi, (cols, nb, _thr_fn, XblkS, bcS, sp) in \
+                    enumerate(blocks):
+                Flg = XblkS.shape[1]
+                mask_afS = _fs_block_mask(cols, fs_G, Flg, A, feat_mask,
+                                          node_mask, stats.dtype)
+                kw = (dict(half=half, prevS=prev[0][bi], rank=prev[1])
+                      if prev is not None else {})
+                sc, ix, ok, lb, hist, tst = _feature_sharded_split(
+                    tmesh, stats,
+                    node_even if prev is not None else slot,
+                    XblkS, A, nb, kind=crit.kernel_kind,
+                    min_instances=min_instances, lam=lam_s, mcw=mcw,
+                    mask_afS=mask_afS, bcS=bcS, sparse01=sp, **kw)
+                # local t-major idx t·Flg + f → global padded-flat idx
+                gi = (off_b + (ix // Flg) * (fs_G * Flg)
+                      + jnp.arange(fs_G, dtype=jnp.int32)[:, None] * Flg
+                      + ix % Flg)
+                parts.extend((sc[s], gi[s], ok[s], lb[s])
+                             for s in range(fs_G))
+                cums.append(hist)
+                if bi == 0:
+                    tstats = tst
+                off_b += (nb - 1) * (fs_G * Flg)
+            bs, best, valid, lstats = parts[0][0], parts[0][1], \
+                parts[0][2], parts[0][3]
+            for s_k, gi_k, v_k, lb_k in parts[1:]:
+                take = (s_k > bs) | ((s_k == bs) & (gi_k < best))
+                best = jnp.where(take, gi_k, best)
                 valid = jnp.where(take, v_k, valid)
+                lstats = jnp.where(take[:, None], lb_k, lstats)
                 bs = jnp.where(take, s_k, bs)
+            f_idx = jnp.zeros((A,), jnp.int32)
+            t_idx = jnp.zeros((A,), jnp.int32)
+            thr_v = jnp.zeros((A,), edges.dtype)
+            off = 0
+            for cols, nb, thr_fn, XblkS, _bc, _sp in blocks:
+                Fb_pad = fs_G * XblkS.shape[1]
+                size = (nb - 1) * Fb_pad
+                inb = (best >= off) & (best < off + size)
+                local = jnp.clip(best - off, 0, max(size - 1, 0))
+                # pad-candidate feature indices clamp to the last real
+                # column — reachable only when NO candidate is valid,
+                # where do_split kills every downstream use
+                fb = jnp.minimum((local % Fb_pad).astype(jnp.int32),
+                                 len(cols) - 1)
+                tb = (local // Fb_pad).astype(jnp.int32)
+                f_idx = jnp.where(inb, jnp.asarray(cols, jnp.int32)[fb],
+                                  f_idx)
+                t_idx = jnp.where(inb, tb, t_idx)
+                thr_v = jnp.where(inb, thr_fn(jnp.asarray(cols)[fb], tb),
+                                  thr_v)
+                off += size
         else:
-            flat = jnp.concatenate(flats, axis=1) if len(flats) > 1 \
-                else flats[0]
-            ok_flat = jnp.concatenate(oks, axis=1) if len(oks) > 1 \
-                else oks[0]
-            best = jnp.argmax(flat, axis=1)
-            valid = jnp.take_along_axis(ok_flat, best[:, None],
-                                        axis=1)[:, 0]
-        # decode the winning candidate per block; exact reference gain is
-        # evaluated only at the winner ([A, C] stats)
-        f_idx = jnp.zeros((A,), jnp.int32)
-        t_idx = jnp.zeros((A,), jnp.int32)
-        thr_v = jnp.zeros((A,), edges.dtype)
-        lstats = jnp.zeros((A, C), stats.dtype)
-        off = 0
-        for (cols, nb, thr_fn, _Xblk, _bc, _sp), cumb in zip(blocks, cums):
-            fb_n = len(cols)
-            size = (nb - 1) * fb_n
-            inb = (best >= off) & (best < off + size)
-            local = jnp.clip(best - off, 0, max(size - 1, 0))
-            fb = (local % fb_n).astype(jnp.int32)
-            tb = (local // fb_n).astype(jnp.int32)
-            f_idx = jnp.where(inb, jnp.asarray(cols, jnp.int32)[fb], f_idx)
-            t_idx = jnp.where(inb, tb, t_idx)
-            thr_v = jnp.where(inb, thr_fn(jnp.asarray(cols)[fb], tb), thr_v)
-            lb = jnp.take_along_axis(
-                cumb[:, :, :-1, :].reshape(A, C, size),
-                local[:, None, None], axis=2)[:, :, 0]
-            lstats = jnp.where(inb[:, None], lb, lstats)
-            off += size
-        tstats = cums[0][:, :, -1, 0]
+            # per-block cumulative histograms over slots; idle
+            # (slot == A) → 0. Candidate axis = concat of every block's
+            # (bins−1)·F_b pairs.
+            flats, oks, cums, parts = [], [], [], []
+            off_b = 0
+            for bi, (cols, nb, _thr_fn, Xblk, bc, sp) in enumerate(blocks):
+                if prev is not None:
+                    if use_pallas:
+                        ev = block_hist(stats, node_even, Xblk, half, nb,
+                                        bc, sp)
+                    else:
+                        ev = _level_cumhist(stats, node_even, Xblk, half,
+                                            nb)
+                    parent = prev[0][bi][prev[1]]      # [half, C, nb, Fb]
+                    cumb = jnp.stack([ev, parent - ev], axis=1).reshape(
+                        (A,) + ev.shape[1:])           # interleave 2i/2i+1
+                elif use_pallas:
+                    # fused VMEM kernel over the transposed block [Fb, n]
+                    cumb = block_hist(stats, slot, Xblk, A, nb, bc, sp)
+                else:
+                    cumb = _level_cumhist(stats, slot, Xblk, A, nb)
+                # [A, C, nb, Fb]
+                if use_scan:
+                    # fused split scan: score+masks+argmax in one kernel
+                    # pass; the feature/per-node masks combine into ONE
+                    # [A, Fb] operand (tiny — the [A, B-1, Fb] expansion
+                    # happens in VMEM, not HBM)
+                    fb_n = len(cols)
+                    mask_af = None
+                    if feat_mask is not None:
+                        mask_af = jnp.broadcast_to(
+                            feat_mask[jnp.asarray(cols)][None, :],
+                            (A, fb_n)).astype(stats.dtype)
+                    if node_mask is not None:
+                        nm = node_mask[:, jnp.asarray(cols)].astype(
+                            stats.dtype)
+                        mask_af = nm if mask_af is None else mask_af * nm
+                    lam_s, mcw = crit.kernel_params()
+                    sc_b, ix_b, ok_b = split_scan(
+                        cumb, crit.kernel_kind, min_instances, lam=lam_s,
+                        min_child_weight=mcw, mask=mask_af)
+                    parts.append((off_b, sc_b, ix_b, ok_b))
+                else:
+                    sb = crit.score(cumb)             # [A, nb-1, Fb]
+                    lcb = cumb[:, -1, :-1, :]
+                    tcb = cumb[:, -1, -1:, :]
+                    okb = (lcb >= min_instances) \
+                        & (tcb - lcb >= min_instances)
+                    extra = crit.extra_ok(cumb)
+                    if extra is not None:
+                        okb = okb & extra
+                    if feat_mask is not None:
+                        okb = okb \
+                            & feat_mask[jnp.asarray(cols)][None, None, :]
+                    if node_mask is not None:
+                        okb = okb \
+                            & node_mask[:, jnp.asarray(cols)][:, None, :]
+                    flats.append(jnp.where(okb, sb, _NEG).reshape(A, -1))
+                    oks.append(okb.reshape(A, -1))
+                cums.append(cumb)
+                off_b += (nb - 1) * len(cols)
+            if use_scan:
+                # merge per-block winners on the SAME flat candidate axis
+                # the XLA concat+argmax walks: score desc, global flat
+                # idx asc (argmax's first-occurrence tie rule)
+                _o0, bs, bi0, bv = parts[0][0], parts[0][1], \
+                    parts[0][2], parts[0][3]
+                best = _o0 + bi0
+                valid = bv
+                for o_k, s_k, i_k, v_k in parts[1:]:
+                    gi = o_k + i_k
+                    take = (s_k > bs) | ((s_k == bs) & (gi < best))
+                    best = jnp.where(take, gi, best)
+                    valid = jnp.where(take, v_k, valid)
+                    bs = jnp.where(take, s_k, bs)
+            else:
+                flat = jnp.concatenate(flats, axis=1) if len(flats) > 1 \
+                    else flats[0]
+                ok_flat = jnp.concatenate(oks, axis=1) if len(oks) > 1 \
+                    else oks[0]
+                best = jnp.argmax(flat, axis=1)
+                valid = jnp.take_along_axis(ok_flat, best[:, None],
+                                            axis=1)[:, 0]
+            # decode the winning candidate per block; exact reference
+            # gain is evaluated only at the winner ([A, C] stats)
+            f_idx = jnp.zeros((A,), jnp.int32)
+            t_idx = jnp.zeros((A,), jnp.int32)
+            thr_v = jnp.zeros((A,), edges.dtype)
+            lstats = jnp.zeros((A, C), stats.dtype)
+            off = 0
+            for (cols, nb, thr_fn, _Xblk, _bc, _sp), cumb in zip(blocks,
+                                                                 cums):
+                fb_n = len(cols)
+                size = (nb - 1) * fb_n
+                inb = (best >= off) & (best < off + size)
+                local = jnp.clip(best - off, 0, max(size - 1, 0))
+                fb = (local % fb_n).astype(jnp.int32)
+                tb = (local // fb_n).astype(jnp.int32)
+                f_idx = jnp.where(inb, jnp.asarray(cols, jnp.int32)[fb],
+                                  f_idx)
+                t_idx = jnp.where(inb, tb, t_idx)
+                thr_v = jnp.where(inb, thr_fn(jnp.asarray(cols)[fb], tb),
+                                  thr_v)
+                lb = jnp.take_along_axis(
+                    cumb[:, :, :-1, :].reshape(A, C, size),
+                    local[:, None, None], axis=2)[:, :, 0]
+                lstats = jnp.where(inb[:, None], lb, lstats)
+                off += size
+            tstats = cums[0][:, :, -1, 0]
         best_gain = crit.gain(lstats, tstats)
         do_split = alive & valid \
             & (best_gain >= jnp.maximum(min_info_gain, 1e-10))
@@ -843,7 +1108,8 @@ def poisson_bootstrap_weights(key, rate, n: int, dtype,
         jnp.float32)
     r = jnp.maximum(jnp.asarray(rate, jnp.float32), 1e-9)
     cdf = jnp.cumsum(jnp.power(r, ks) * jnp.exp(-r) / fact)
-    u = jax.random.uniform(key, (n,), jnp.float32)
+    u = _rng_replicated(
+        lambda k: jax.random.uniform(k, (n,), jnp.float32), key)
     w = jnp.zeros((n,), jnp.float32)
     for i in range(k_max):
         w = w + (u > cdf[i]).astype(jnp.float32)
@@ -855,7 +1121,8 @@ def _feature_masks(key, n_trees: int, n_feat: int, k: int) -> jnp.ndarray:
     'auto' — per-tree rather than Spark's per-node, same spirit)."""
     if k >= n_feat:
         return jnp.ones((n_trees, n_feat), bool)
-    u = jax.random.uniform(key, (n_trees, n_feat))
+    u = _rng_replicated(
+        lambda kk: jax.random.uniform(kk, (n_trees, n_feat)), key)
     kth = jnp.sort(u, axis=1)[:, k - 1][:, None]
     return u <= kth
 
@@ -903,10 +1170,42 @@ def prepare_bins(X, n_bins, binary_mask=None):
     return Xb, edges, make_col_blocks(edges, n_bins, binary_mask)
 
 
+def _feature_shard_count(use_pallas: bool, n: int, col_blocks) -> int:
+    """Effective feature-axis shard count G for this fit, or 0 (off).
+
+    Engages ONLY when every condition of the sharded trace holds —
+    kernel path on, ``featureShards`` requested (> 1), the active tree
+    mesh's ``grid`` axis sized EXACTLY to the request, rows dividing the
+    ``data`` axis (the same even-sharding check ``grow_tree`` applies),
+    and every block's per-shard candidate width inside the fused
+    split-scan envelope (the sharded level body runs the scan kernel
+    per shard). Anything else fails open to the current path — the
+    degenerate ``featureShards=1`` / ``grid=1`` resolve to the exact
+    pre-shard program."""
+    from ._pallas_hist import split_scan_enabled, split_scan_ok
+    req = int(_FEATURE_SHARDS[0])
+    if not use_pallas or req <= 1 or not split_scan_enabled():
+        return 0
+    tmesh = active_tree_mesh()
+    if tmesh is None or int(tmesh.shape.get("grid", 1)) != req:
+        return 0
+    if n % int(tmesh.shape["data"]) != 0:
+        return 0
+    for cols, nb, _tf in col_blocks:
+        if not split_scan_ok(1024, nb, -(-len(cols) // req)):
+            return 0
+    return req
+
+
 def prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, stats_dtype,
                    max_depth: Optional[int] = None):
-    """(use_pallas, full matrix in the active orientation, blocks) —
-    each block is (cols, bins, thr_fn, block matrix, bc|None, sparse01).
+    """(use_pallas, full matrix in the active orientation, blocks,
+    feature-shard count G | 0) — each block is (cols, bins, thr_fn,
+    block matrix, bc|None, sparse01). Under an engaged feature-shard
+    scope (``_feature_shard_count``) the block matrix is instead the
+    grid-stacked [G, Flg, n] sub-block tensor (columns zero-padded to
+    G·Flg) and ``bc`` the per-shard [G, bins·Flg, n] indicator stack —
+    the operands :func:`_feature_sharded_split` shards over the mesh.
 
     Called ONCE per fit, OUTSIDE the tree/round scans: the precomputed
     bin indicator ``bc`` ([B·Fb, n] — see _pallas_hist.make_bc) is a
@@ -939,6 +1238,7 @@ def prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, stats_dtype,
         col_blocks = [(np.arange(F), B, lambda fl, tl: edges[fl, tl])]
     bc_dt = jnp.bfloat16 if stats_dtype == jnp.float32 else stats_dtype
     sp01 = use_pallas and sparse01_enabled()
+    fs_G = _feature_shard_count(use_pallas, n, col_blocks)
     blocks = []
     for cols, nb, thr_fn in col_blocks:
         cols = np.asarray(cols)
@@ -946,7 +1246,20 @@ def prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, stats_dtype,
         # whose bins are {0, 1} by construction (compute_bins re-bins
         # them to (x > 0.5)) — the sparse kernel's contract
         sparse = sp01 and nb == 2
-        if use_pallas:
+        if fs_G:
+            Flg = -(-len(cols) // fs_G)
+            blk = Xmat[cols, :]
+            pad = fs_G * Flg - len(cols)
+            if pad:
+                blk = jnp.concatenate(
+                    [blk, jnp.zeros((pad, n), blk.dtype)], axis=0)
+            blk = blk.reshape(fs_G, Flg, n)
+            bc = (jnp.stack([make_bc(blk[s], nb, bc_dt)
+                             for s in range(fs_G)])
+                  if not sparse and bc_cache_ok(
+                      n, Flg, nb, itemsize=jnp.dtype(bc_dt).itemsize)
+                  else None)
+        elif use_pallas:
             blk = Xmat[cols, :]
             bc = (make_bc(blk, nb, bc_dt)
                   if not sparse and bc_cache_ok(
@@ -957,7 +1270,7 @@ def prepare_blocks(Xb, XbT, edges, n_bins, col_blocks, stats_dtype,
             blk = Xmat[:, cols]
             bc = None
         blocks.append((cols, nb, thr_fn, blk, bc, sparse))
-    return use_pallas, Xmat, blocks
+    return use_pallas, Xmat, blocks, fs_G
 
 
 def _resolve_prebinned(X, y, w, n_bins, binary_mask, prebinned):

@@ -231,7 +231,7 @@ class _TreeFamilyBase(ModelFamily):
 
         from ._pallas_hist import (pallas_histograms_enabled,
                                    sparse01_enabled, split_scan_enabled)
-        from ._treefit import active_tree_mesh
+        from ._treefit import active_feature_shards, active_tree_mesh
         tm = active_tree_mesh()
         return (("__pallas__", pallas_histograms_enabled()),
                 ("__sibling__", _sibling_on()),
@@ -239,7 +239,8 @@ class _TreeFamilyBase(ModelFamily):
                 ("__split_scan__", split_scan_enabled()),
                 ("__tree_mesh__", None if tm is None else
                  (int(tm.shape.get("data", 1)),
-                  int(tm.shape.get("grid", 1)))))
+                  int(tm.shape.get("grid", 1)))),
+                ("__feature_shards__", active_feature_shards()))
 
     def _cache_bytes_per_row(self) -> int:
         """Per-row bytes of fit-time prediction caches an in-flight

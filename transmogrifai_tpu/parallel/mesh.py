@@ -37,7 +37,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["make_mesh", "data_sharding", "shard_cv_inputs", "pad_rows",
            "process_default_mesh", "set_process_mesh", "mesh_if_multi",
-           "mesh_topology", "mesh_constructions", "mesh_enabled"]
+           "mesh_topology", "mesh_constructions", "mesh_enabled",
+           "feature_shard_mesh"]
 
 #: master switch for the mainline mesh promotion (``TMOG_MESH=0`` keeps
 #: every consumer on the pre-mesh single-device path)
@@ -106,6 +107,19 @@ def make_mesh(n_devices: Optional[int] = None, grid_size: int = 1,
     mesh_devs = np.asarray(devs).reshape(data_axis, grid_axis)
     _CONSTRUCTIONS[0] += 1
     return Mesh(mesh_devs, axis_names=("data", "grid"))
+
+
+def feature_shard_mesh(n_shards: int,
+                       devices: Optional[Sequence] = None) -> Mesh:
+    """(data × grid) mesh with a ``grid`` axis of EXACTLY ``n_shards`` —
+    the substrate the tree engine's feature-axis sharding requires (the
+    ``featureShards`` knob only engages when the active tree mesh's grid
+    axis matches the request, see ``models._treefit``). Rows keep
+    whatever devices remain on the ``data`` axis, so the histogram psum
+    and the column sharding compose on one mesh. Raises like
+    :func:`make_mesh` when ``n_shards`` does not divide the device
+    count — a silent fallback here would quietly train unsharded."""
+    return make_mesh(devices=devices, grid_axis=int(n_shards))
 
 
 def process_default_mesh() -> Mesh:

@@ -55,7 +55,7 @@ import threading
 import time
 from collections import deque
 from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
-                    Tuple, TypeVar)
+                    Sequence, Tuple, TypeVar)
 
 import numpy as np
 
@@ -66,7 +66,7 @@ logger = logging.getLogger(__name__)
 __all__ = [
     "DEFAULT_WORKERS", "MIN_PREFETCH", "DEFAULT_MAX_PREFETCH",
     "resolve_workers", "concrete_batch", "map_ordered",
-    "BufferPool", "PrefetchAutotuner",
+    "BufferPool", "PrefetchAutotuner", "SeededRowSample",
     "probe_sustained_mbps",
     "pipeline_stats", "reset_pipeline_stats",
 ]
@@ -553,3 +553,91 @@ def record_stream(n_batches: int, workers: int,
         starvations=tuner.starvations if tuner is not None else 0,
         buffer_reuses=pool.reuses if pool is not None else 0,
         buffer_allocs=pool.allocs if pool is not None else 0)
+
+
+# ---------------------------------------------------------------------------
+# deterministic bounded row subsample (out-of-core training)
+# ---------------------------------------------------------------------------
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer over uint64 — a bijection, so
+    distinct row indices always get distinct priorities."""
+    x = (x + np.uint64(0x9E3779B97F4A7C15))
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+class SeededRowSample:
+    """Deterministic bounded row subsample over a stream of batches —
+    the out-of-core stand-in for the quantile sketch's seeded
+    permutation (models/_treefit.quantile_bin_edges): keep the ``k``
+    rows whose seeded hash priority is smallest.
+
+    Each row's priority is a pure function of its GLOBAL index in the
+    concatenated stream and the seed — independent of batch boundaries,
+    worker counts and whether the data was streamed or materialized
+    (``map_ordered`` delivers batches in submission order, so the
+    global index is stable). The working set is bounded at ~2k buffered
+    rows; ``result()`` returns the selected rows in global-row order,
+    so for n <= k the sample IS the stream, in order.
+
+    Protocol per batch: ``loc = offer(len(batch))`` gives the LOCAL
+    indices of candidate rows (priority under the current running
+    cutoff); the caller gathers those rows and hands them to
+    ``keep(rows)`` in the same order.
+    """
+
+    def __init__(self, k: int, seed: int = 0x51EED):
+        if k < 1:
+            raise ValueError("sample size k must be >= 1")
+        self.k = int(k)
+        self.seed = int(seed)
+        self._n = 0
+        self._cut: Optional[int] = None
+        self._buf: List[Tuple[int, int, Any]] = []
+        self._pending: Tuple[np.ndarray, np.ndarray] = (
+            np.empty(0, np.uint64), np.empty(0, np.uint64))
+
+    @property
+    def total_rows(self) -> int:
+        return self._n
+
+    def offer(self, n_rows: int) -> np.ndarray:
+        """Local candidate indices for the next ``n_rows`` rows."""
+        n_rows = int(n_rows)
+        g0 = self._n
+        self._n += n_rows
+        gidx = np.arange(g0, self._n, dtype=np.uint64)
+        pri = _splitmix64(
+            gidx + np.uint64((self.seed * 0x9E3779B97F4A7C15)
+                             & 0xFFFFFFFFFFFFFFFF))
+        if self._cut is not None:
+            loc = np.nonzero(pri <= np.uint64(self._cut))[0]
+        else:
+            loc = np.arange(n_rows)
+        self._pending = (pri[loc], gidx[loc])
+        return loc
+
+    def keep(self, rows: Sequence[Any]) -> None:
+        """Buffer the rows matching the last ``offer``'s candidates."""
+        pri, gidx = self._pending
+        self._pending = (np.empty(0, np.uint64), np.empty(0, np.uint64))
+        self._buf.extend(zip(pri.tolist(), gidx.tolist(), rows))
+        if len(self._buf) > 2 * self.k:
+            self._compact()
+
+    def _compact(self) -> None:
+        # keep the k smallest (priority, index) pairs; the kth becomes
+        # the pruning cutoff for future offers
+        self._buf.sort(key=lambda t: (t[0], t[1]))
+        del self._buf[self.k:]
+        if len(self._buf) >= self.k:
+            self._cut = self._buf[-1][0]
+
+    def result(self) -> List[Any]:
+        """The selected rows, in global-row (stream) order."""
+        self._compact()
+        return [row for _, _, row in
+                sorted(self._buf, key=lambda t: t[1])]

@@ -997,6 +997,25 @@ def aggregate_route_tier(db: Optional[CostDatabase]) -> Optional[str]:
     return "columnar" if c <= r else "rowwise"
 
 
+def ingest_route_tier(db: Optional[CostDatabase]) -> Optional[str]:
+    """Measured stream-vs-materialize tier for the workflow's raw-store
+    ingest (the out-of-core seam): ``Workflow.train`` reports
+    ``phase:workflow.ingest`` observations with tiers ``stream`` /
+    ``materialize`` whenever a directory reader feeds a train at
+    contested row counts. Both tiers must have been measured to emit a
+    hint — the runner installs it via ``workflow.set_stream_fit`` so
+    the ``streamFit=null`` auto mode defers to evidence; None keeps the
+    structural auto-engage (stream when the source is a directory
+    reader) in charge."""
+    if db is None:
+        return None
+    s = db.stage_cost("phase:workflow.ingest", "stream")
+    m = db.stage_cost("phase:workflow.ingest", "materialize")
+    if s is None or m is None:
+        return None
+    return "stream" if s <= m else "materialize"
+
+
 def _record_tallies(plan: ExecutionPlan) -> None:
     c = plan.counts()
     _tally("plans_built")

@@ -59,6 +59,10 @@ class DirectoryStreamReader:
         #: Python decoder, kept for the bench's serial baseline leg.
         self.columnar = columnar
         self._seen: set = set()
+        #: files successfully read AND delivered — the rescan unit.
+        #: Quarantined / no-reader files live only in ``_seen`` so a
+        #: rescan never re-offers (and never re-quarantines) them.
+        self._delivered: set = set()
         #: interruptible idle wait: ``stop()`` wakes a sleeping
         #: ``stream()`` immediately instead of blocking shutdown a full
         #: poll interval
@@ -71,6 +75,19 @@ class DirectoryStreamReader:
         Event wait, so shutdown never blocks a full ``poll_interval_s``.
         The next ``stream()`` call on this reader starts fresh."""
         self._stop.set()
+
+    def rescan(self) -> int:
+        """Re-offer every file this reader has successfully DELIVERED, so
+        a multi-pass consumer (out-of-core training) re-reads the same
+        directory without reconstructing the reader. Returns the number
+        of files re-offered. Quarantined and no-reader files stay seen —
+        a bad file is quarantined (and counted) exactly once, never once
+        per pass — and ``new_files_only`` pre-seeded files stay
+        suppressed (they were never delivered)."""
+        n = len(self._delivered)
+        self._seen -= self._delivered
+        self._delivered.clear()
+        return n
 
     # -- format routing ----------------------------------------------------
     def _read_file(self, fp: str) -> List[Dict[str, Any]]:
@@ -134,8 +151,21 @@ class DirectoryStreamReader:
                 self._consume_error(fp, e)
                 continue
             self._seen.add(fp)
+            self._delivered.add(fp)
             return recs
         return None
+
+    def _unseen_visible(self) -> bool:
+        """Any file visible right now that this pass has not consumed?
+        Settle state is ignored on purpose: an unseen-but-unsettled file
+        means the pass is NOT drained yet (the caller idle-waits and
+        re-polls). Plain snapshot — this runs once per drained poll, so
+        it skips the retry/telemetry wrapping of the hot poll path."""
+        try:
+            snap = self._snapshot()
+        except OSError:
+            return False
+        return any(fp not in self._seen for fp in snap)
 
     def _retried_poll(self) -> List[str]:
         """One retried directory listing + the backlog gauge."""
@@ -210,7 +240,8 @@ class DirectoryStreamReader:
 
     def stream(self, max_batches: Optional[int] = None,
                timeout_s: Optional[float] = None,
-               workers: Optional[int] = None
+               workers: Optional[int] = None,
+               passes: Optional[int] = None
                ) -> Iterator[List[Dict[str, Any]]]:
         """Yield per-file record batches as files appear.
 
@@ -224,8 +255,20 @@ class DirectoryStreamReader:
         batches arrive in sorted-file order, bit-identical to the
         serial decode, and the ``stream.read_file``/``avro.decode``/
         ``csv.decode`` fault sites + READER_RETRY + poison-file
-        quarantine run inside the workers unchanged."""
+        quarantine run inside the workers unchanged.
+
+        ``passes`` = N bounds the stream to N full scans of the
+        directory (:meth:`rescan` runs between them): when a poll finds
+        NOTHING unseen — not even a still-settling file — the pass is
+        drained; the stream ends after pass N instead of idle-waiting
+        for new arrivals. ``max_batches`` counts across all passes, and
+        ``stop()``/``timeout_s`` keep their meaning. None (default) is
+        the single-pass tail-forever behavior, unchanged."""
         self._stop.clear()
+        if passes is not None:
+            passes = int(passes)
+            if passes < 1:
+                raise ValueError("passes must be >= 1")
         if workers is not None:
             # an explicit count still rides the TMOG_PIPELINE=0 kill
             # switch (resolve_workers forces 1 — the incident lever is
@@ -234,10 +277,11 @@ class DirectoryStreamReader:
             workers = pipeline.resolve_workers(int(workers))
         if workers is not None and workers > 1:
             yield from self._stream_parallel(workers, max_batches,
-                                             timeout_s)
+                                             timeout_s, passes)
             return
         t0 = time.perf_counter()
         n = 0
+        pass_no = 1
         while True:
             if self._stop.is_set():
                 return
@@ -249,12 +293,19 @@ class DirectoryStreamReader:
                     if max_batches is not None and n >= max_batches:
                         return
                 continue            # drain without sleeping
+            if passes is not None and not self._unseen_visible():
+                pass_no += 1        # directory drained: pass ends
+                if pass_no > passes:
+                    return
+                self.rescan()
+                continue
             if not self._idle_wait(t0, timeout_s):
                 return
 
     def _stream_parallel(self, workers: int,
                          max_batches: Optional[int],
-                         timeout_s: Optional[float]
+                         timeout_s: Optional[float],
+                         passes: Optional[int] = None
                          ) -> Iterator[List[Dict[str, Any]]]:
         """Parallel-decode poll loop: each poll's settled unseen files
         fan out over the worker pool; the reorder buffer hands results
@@ -268,6 +319,7 @@ class DirectoryStreamReader:
 
         t0 = time.perf_counter()
         n = 0
+        pass_no = 1
         ex = None
         try:
             while True:
@@ -292,6 +344,7 @@ class DirectoryStreamReader:
                             self._consume_error(fp, exc)
                             continue
                         self._seen.add(fp)
+                        self._delivered.add(fp)
                         if recs:
                             yield recs
                             n += 1
@@ -301,6 +354,14 @@ class DirectoryStreamReader:
                         if self._stop.is_set():
                             return
                     continue        # productive poll: re-poll immediately
+                if passes is not None \
+                        and not any(fp not in self._seen
+                                    for fp in snapshot):
+                    pass_no += 1    # directory drained: pass ends
+                    if pass_no > passes:
+                        return
+                    self.rescan()
+                    continue
                 if not self._idle_wait(t0, timeout_s):
                     return
         finally:

@@ -345,6 +345,28 @@ class OpWorkflowRunner:
                     "(phase:temporal.route_aggregate) — the knob wins; "
                     "drop it to let the auto-route follow the "
                     "measurement"))
+        # measured stream-vs-materialize ingest route (the cost db's
+        # phase:workflow.ingest observations): install the hint the
+        # ``streamFit: null`` auto mode consults for THIS run (the
+        # runner's run-scoped set_stream_fit restore clears it). An
+        # explicit streamFit knob always wins — a contradiction between
+        # the knob and the measurement surfaces as a TMG405 advisory,
+        # exactly like the aggregate route above.
+        ingest_tier = planner.ingest_route_tier(db)
+        if ingest_tier is not None:
+            from . import workflow as _workflow
+            _workflow.set_stream_fit(ingest_hint=ingest_tier)
+            forced_sf = _bool_custom_param(params, "streamFit",
+                                           allow_auto=True)
+            if (forced_sf is True and ingest_tier == "materialize") \
+                    or (forced_sf is False and ingest_tier == "stream"):
+                findings.append(lint.Finding(
+                    "TMG405",
+                    f"streamFit={str(bool(forced_sf)).lower()} is "
+                    f"pinned but the cost database measured the "
+                    f"{ingest_tier} ingest tier faster "
+                    "(phase:workflow.ingest) — the knob wins; drop it "
+                    "to let the auto-route follow the measurement"))
         findings = lint._apply_suppress(findings, suppress)
         lint.emit_findings(findings)
         for f in findings:
@@ -489,11 +511,33 @@ class OpWorkflowRunner:
                 params, "joinPartitions", int, minimum=1),
             join_table_max_rows=_numeric_custom_param(
                 params, "joinTableMaxRows", int, minimum=1))
+        # run-scoped out-of-core knobs (docs/performance.md "Out-of-core
+        # training"): streamFit tri-state forces/forbids the multi-pass
+        # streaming ingest (auto = stream when the source is a directory
+        # reader, deferring to the planner's measured ingest tier),
+        # streamFitPasses bounds the directory re-scan budget, rssCapMb
+        # is the advisory host-memory budget (auto mode streams when a
+        # cap is declared), featureShards shards tree-fit columns over
+        # the mesh grid axis. Validated up front like every knob above.
+        from . import workflow as _workflow
+        from .models import _treefit as _treefit
+        stream_knobs = dict(
+            stream=_bool_custom_param(params, "streamFit",
+                                      allow_auto=True),
+            passes=_numeric_custom_param(params, "streamFitPasses", int,
+                                         minimum=1),
+            rss_cap_mb=_numeric_custom_param(params, "rssCapMb", float,
+                                             minimum=1))
+        feature_shards = _numeric_custom_param(params, "featureShards",
+                                               int, minimum=1)
         qloc = (params.quarantine_location
                 or params.custom_params.get("quarantineLocation"))
         prev_sink = (resilience.set_quarantine(str(qloc)) if qloc
                      else None)
         prev_temporal = _temporal.set_run_defaults(**temporal_knobs)
+        prev_stream = _workflow.set_stream_fit(**stream_knobs)
+        prev_shards = (_treefit.set_feature_shards(feature_shards)
+                       if feature_shards is not None else None)
         # one collecting listener per run (OpSparkListener analog): its
         # AppMetrics summary rides in the metrics doc/sink below
         collector = None
@@ -528,6 +572,9 @@ class OpWorkflowRunner:
             if qloc:
                 resilience.set_quarantine(prev_sink)
             _temporal.set_run_defaults(**prev_temporal)
+            _workflow.set_stream_fit(**prev_stream)
+            if prev_shards is not None:
+                _treefit.set_feature_shards(prev_shards)
             try:
                 if ok:
                     # compile-cache presence rides in every metrics doc
@@ -607,6 +654,11 @@ class OpWorkflowRunner:
                     # (telemetry.device_cost_stats, docs/observability
                     # .md "MFU")
                     result.metrics["mfu"] = telemetry.device_cost_stats()
+                    # peak RSS (self + reaped children) rides on every
+                    # doc too — the out-of-core streaming tier's memory
+                    # evidence (telemetry.peak_rss_mb, docs/performance
+                    # .md "Out-of-core training")
+                    result.metrics["peak_rss_mb"] = telemetry.peak_rss_mb()
                     if collector is not None:
                         result.metrics["telemetry"] = collector.summary()
                         result.metrics["telemetryMetrics"] = \

@@ -69,7 +69,7 @@ __all__ = [
     "write_metrics",
     "RunListener", "CollectingRunListener",
     "add_listener", "remove_listener", "listeners", "emit",
-    "compile_clock_s", "probe_device_roundtrip_mbps",
+    "compile_clock_s", "probe_device_roundtrip_mbps", "peak_rss_mb",
     # cross-process tracing (docs/observability.md "Distributed tracing")
     "TRACE_HEADER", "TRACE_ENV", "mint_trace", "parse_traceparent",
     "format_traceparent", "current_trace", "trace_scope",
@@ -1493,6 +1493,26 @@ def _ensure_compile_listener() -> None:
 def compile_clock_s() -> float:
     """Cumulative XLA trace+lower+compile seconds in this process."""
     return _COMPILE_CLOCK["s"]
+
+
+def peak_rss_mb():
+    """Peak resident-set size of this process AND its reaped children, in
+    MB (None where the ``resource`` module is unavailable — Windows).
+
+    ``ru_maxrss`` is the high-water mark, so this is the number the
+    out-of-core streaming tier is judged by: a streamed fit whose peak
+    stays bounded while the materialized fit's grows with the dataset is
+    the whole point (docs/performance.md "Out-of-core training").
+    ``RUSAGE_CHILDREN`` folds in subprocess workers (bench subprocesses,
+    fleet children) — the max of the two is reported, since RSS peaks of
+    different processes at different times do not add."""
+    try:
+        import resource
+    except ImportError:
+        return None
+    kb = max(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss,
+             resource.getrusage(resource.RUSAGE_CHILDREN).ru_maxrss)
+    return round(kb / 1024.0, 1)
 
 
 # ---------------------------------------------------------------------------

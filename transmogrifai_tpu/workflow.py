@@ -92,6 +92,66 @@ FUSE_MIN_ROWS = 20_000
 #: clear this easily; remote tunnels do not.
 FUSE_MIN_BANDWIDTH_MBPS = 500.0
 
+#: out-of-core streaming fit (run-scoped knobs — the runner installs
+#: them via :func:`set_stream_fit` and restores in finally, the PR 13
+#: discipline). ``STREAM_FIT`` is tri-state: None auto-engages when the
+#: input is a directory stream reader (deferring to the planner's
+#: measured stream-vs-materialize hint when one exists), True forces
+#: streaming, False forces the materialized path.
+STREAM_FIT: Optional[bool] = None
+
+#: directory passes the streamed ingest makes: 1 folds fit statistics
+#: and gathers the bounded subsample in ONE pass; 2 dedicates pass 1 to
+#: the fitstats fold and pass 2 to the subsample gather (lower staging
+#: pressure; identical results — the subsample is order-deterministic)
+STREAM_FIT_PASSES = 2
+
+#: bounded working set of the streamed fit: the seeded-permutation
+#: subsample row budget (the quantile sketch's QUANTILE_SAMPLE_ROWS —
+#: trees, quantiles and top-K stats see at most this many rows)
+STREAM_SAMPLE_ROWS = int(os.environ.get("TMOG_STREAM_SAMPLE_ROWS",
+                                        262_144))
+
+#: the planner's measured ingest tier ("stream"/"materialize"/None) —
+#: consulted only by the ``STREAM_FIT is None`` auto mode
+_INGEST_TIER_HINT: Optional[str] = None
+
+#: advisory host-memory budget (``customParams.rssCapMb``): a declared
+#: cap makes the ``STREAM_FIT is None`` auto mode stream for directory
+#: readers even against a "materialize is cheaper" tier hint — the hint
+#: optimizes time, the cap protects the heap. Observability only
+#: otherwise (bench's out_of_core config enforces it with setrlimit).
+STREAM_RSS_CAP_MB: Optional[float] = None
+
+_KEEP = object()
+
+
+def set_stream_fit(stream=_KEEP, passes=_KEEP, sample_rows=_KEEP,
+                   ingest_hint=_KEEP, rss_cap_mb=_KEEP) -> Dict[str, Any]:
+    """Install run-scoped out-of-core knobs; returns the previous
+    values (same keyword names) so the caller can restore them in a
+    finally block — the runner's run-scoped discipline."""
+    global STREAM_FIT, STREAM_FIT_PASSES, STREAM_SAMPLE_ROWS, \
+        _INGEST_TIER_HINT, STREAM_RSS_CAP_MB
+    prev: Dict[str, Any] = {
+        "stream": STREAM_FIT, "passes": STREAM_FIT_PASSES,
+        "sample_rows": STREAM_SAMPLE_ROWS,
+        "ingest_hint": _INGEST_TIER_HINT,
+        "rss_cap_mb": STREAM_RSS_CAP_MB}
+    if stream is not _KEEP:
+        STREAM_FIT = None if stream is None else bool(stream)
+    if passes is not _KEEP and passes is not None:
+        STREAM_FIT_PASSES = max(1, int(passes))
+    if sample_rows is not _KEEP and sample_rows is not None:
+        STREAM_SAMPLE_ROWS = max(1, int(sample_rows))
+    if ingest_hint is not _KEEP:
+        _INGEST_TIER_HINT = ingest_hint
+    if rss_cap_mb is not _KEEP:
+        STREAM_RSS_CAP_MB = (None if rss_cap_mb is None
+                             else float(rss_cap_mb))
+    return prev
+
+
 _DEVICE_BW_MBPS: Optional[float] = None
 
 #: the cold single-shot round-trip measurement (the number that used to
@@ -476,6 +536,10 @@ class Workflow:
         self._warm_matched = 0
         data = self._input_data
         store = None
+        #: full-stream SufficientStats per raw column when the streamed
+        #: ingest ran (injected into every fused stats pass); None on
+        #: the materialized path — the exact current code path
+        self._stream_state = None
         if data is None and self._reader is not None:
             if getattr(self._reader, "is_aggregating", False):
                 # event-grouped readers OWN raw-store generation: the
@@ -484,8 +548,14 @@ class Workflow:
                 # read_records would hand us raw EVENTS, one row per
                 # event instead of one per key
                 store = self._reader.generate_store(raw_features)
+            elif self._use_stream_fit():
+                store = self._stream_raw_store(raw_features)
             else:
+                t_ing = time.perf_counter()
                 data = self._reader.read_records()
+                self._observe_ingest("materialize",
+                                     time.perf_counter() - t_ing,
+                                     len(data))
         if store is None:
             if data is None:
                 raise WorkflowError(
@@ -603,6 +673,116 @@ class Workflow:
                     "resuming fit from %s: %d fitted stage(s) warm-start",
                     resume_from, len(partial.fitted_stages))
         return self.train()
+
+    # -- out-of-core ingest (streamFit) ------------------------------------
+    def _use_stream_fit(self) -> bool:
+        """Engage the streaming ingest? Explicit ``STREAM_FIT`` wins;
+        auto (None) engages for directory stream readers unless the
+        planner's measured ingest tier says materializing is cheaper."""
+        from .readers.streaming import DirectoryStreamReader
+        if not isinstance(self._reader, DirectoryStreamReader):
+            return False
+        if STREAM_FIT is not None:
+            return bool(STREAM_FIT)
+        if STREAM_RSS_CAP_MB is not None:
+            # a declared memory budget outranks the time-optimizing
+            # tier hint: streaming is the bounded-working-set route
+            return True
+        return _INGEST_TIER_HINT != "materialize"
+
+    def _observe_ingest(self, tier: str, seconds: float,
+                        rows: int) -> None:
+        """Feed the planner's stream-vs-materialize cost observation —
+        only for directory readers (the contested route) at row counts
+        where the tier decision matters (the fitstats discipline)."""
+        from .readers.streaming import DirectoryStreamReader
+        if not isinstance(self._reader, DirectoryStreamReader):
+            return
+        if rows >= FUSE_MIN_ROWS:
+            from . import planner
+            planner.observe_phase("workflow.ingest", tier, seconds, rows)
+
+    def _stream_raw_store(self, raw_features) -> ColumnStore:
+        """Out-of-core ingest: fold full-stream fit statistics and
+        gather the seeded bounded row subsample from the directory
+        reader's columnar batches — the full store is NEVER
+        materialized; host memory is bounded at one staging chunk plus
+        ``STREAM_SAMPLE_ROWS`` buffered rows.
+
+        Returns the subsample ColumnStore the rest of the fit runs on
+        (for streams within the sample budget it is the whole stream,
+        in order — identical to materializing). Side effect:
+        ``self._stream_state`` carries each numeric raw column's
+        full-stream :class:`~transmogrifai_tpu.fitstats.SufficientStats`
+        (bit-identical to a materialized device fitstats pass), which
+        every fused stats pass injects so moment stats reflect ALL
+        rows, not the subsample. ``STREAM_FIT_PASSES`` >= 2 dedicates
+        pass 1 to the fold and pass 2 (a reader ``rescan``) to the
+        subsample gather; results are pass-count-invariant."""
+        from . import fitstats, pipeline
+        reader = self._reader
+        passes = max(1, int(STREAM_FIT_PASSES))
+        two_pass = passes >= 2
+        sample = pipeline.SeededRowSample(STREAM_SAMPLE_ROWS)
+        fold: Optional[fitstats.StreamingMomentFold] = None
+        mesh = False if self.mesh is False else self.mesh
+        t0 = time.perf_counter()
+        n_batches = 0
+
+        def batch_store(batch) -> ColumnStore:
+            return _generate_raw_store(batch, raw_features)
+
+        def fold_batch(bstore: ColumnStore) -> None:
+            nonlocal fold
+            if fold is None:
+                numeric = [nm for nm in bstore.names()
+                           if isinstance(getattr(bstore[nm], "values",
+                                                 None), np.ndarray)
+                           and (np.issubdtype(bstore[nm].values.dtype,
+                                              np.number)
+                                or bstore[nm].values.dtype == bool)]
+                fold = fitstats.StreamingMomentFold(numeric, mesh=mesh)
+            fold.update(bstore)
+
+        def sample_batch(batch) -> None:
+            loc = sample.offer(len(batch))
+            sample.keep([batch[int(i)] for i in loc])
+
+        with telemetry.span("workflow:stream_ingest",
+                            passes=passes):
+            for batch in reader.stream(passes=1):
+                n_batches += 1
+                fold_batch(batch_store(batch))
+                if not two_pass:
+                    sample_batch(batch)
+            if two_pass:
+                reader.rescan()
+                for batch in reader.stream(passes=1):
+                    sample_batch(batch)
+
+        records = sample.result()
+        n_total = sample.total_rows
+        store = _generate_raw_store(records, raw_features)
+        self._observe_ingest("stream", time.perf_counter() - t0,
+                             n_total)
+        if fold is not None and n_total >= FUSE_MIN_ROWS:
+            self._stream_state = fold.finalize()
+        else:
+            # tiny streams: the subsample IS the data and the host
+            # fitstats tier is bit-exact — behave exactly like the
+            # materialized path
+            self._stream_state = None
+        logger.info(
+            "train: streamed ingest %d row(s) in %d batch(es) "
+            "(%d pass(es)); subsample %d row(s), %d streamed stat "
+            "column(s)", n_total, n_batches, passes, store.n_rows,
+            len(self._stream_state or ()))
+        telemetry.emit("stream_ingest", rows=n_total,
+                       batches=n_batches, passes=passes,
+                       sample_rows=store.n_rows,
+                       stream_stat_columns=len(self._stream_state
+                                               or ()))
+        return store
 
     def _resolve_mesh(self, dag: StagesDAG) -> None:
         """Resolve the mesh every heavy phase of this fit runs on and
@@ -788,7 +968,8 @@ class Workflow:
                           else getattr(self, "_active_mesh", None)),
                     tier_hint=(self._exec_plan.fitstats_tier
                                if self._exec_plan is not None else None),
-                    state_out=state_out, warm_state=warm)
+                    state_out=state_out, warm_state=warm,
+                    stream_state=getattr(self, "_stream_state", None))
             for col, st in state_out.items():
                 self._fit_state[f"{li}:{col}"] = st
             telemetry.emit("stats_pass", layer=li,
