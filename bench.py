@@ -243,6 +243,15 @@ class Bench:
             except Exception:
                 self.doc.setdefault("telemetry", None)
                 self.doc.setdefault("mfu", None)
+            # workload flight-recorder tallies (records enqueued/written/
+            # dropped, payload policy, rotations, merge/replay/parity
+            # counters) ride on EVERY doc too — the capture-and-replay
+            # tier's evidence (workload.py, docs/observability.md)
+            try:
+                from transmogrifai_tpu import workload
+                self.doc["workload"] = workload.workload_stats()
+            except Exception:
+                self.doc.setdefault("workload", None)
             # peak RSS (self + reaped children) rides on EVERY doc —
             # the out-of-core tier's memory evidence: streamed fits must
             # show a bounded high-water mark where materialized fits
@@ -1467,6 +1476,328 @@ def _trace_overhead() -> dict:
             "pass": bool(overhead < 0.05)}
 
 
+def _workload_replay() -> dict:
+    """Workload capture-and-replay benchmark (workload.py /
+    docs/observability.md "Workload capture & replay" +
+    "Critical-path analysis"), four phases over two 1-worker fleets
+    serving the same registry — one booted with the flight recorder
+    (``workloadDir``), one without, both with the tracing plane on so
+    the pairing isolates the RECORDER's marginal cost:
+
+    1. **Record** — pump the recording fleet with the router-side
+       recorder installed too, then merge the per-process shards into
+       one arrival-ordered workload (router+worker records combined).
+    2. **Overhead** — ONE in-process `serve_http` instance, recorder
+       toggled per leg in ALTERNATING order; overhead is the median
+       paired ratio of MEDIAN per-request latency (the
+       `trace_overhead` discipline — the recorder's request-path
+       cost is one bounded-queue put, so the gate hunts a sub-1%
+       signal; pairing two fleet instances instead bakes in
+       cross-instance asymmetry that swamps it). Pass: median < 5%.
+    3. **Replay** — re-drive the merged workload open-loop at 1x
+       (recorded arrival offsets) against the OTHER fleet; score
+       parity must hold everywhere outputs were recorded, and the
+       replayed per-phase p50s must agree with the recorded ones
+       within tolerance (phase stats are arrival-process-dependent,
+       so agreement proves the recording reproduces the workload).
+    4. **Analyze** — a clean traced window, then the critical-path
+       analyzer over the merged trace shards: >= 95% of every
+       request's e2e attributed to named phases, self-diff clean, and
+       a perturbed baseline must trip the regression verdict."""
+    import http.client
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from transmogrifai_tpu import (ColumnStore, FeatureBuilder, Workflow,
+                                   column_from_values, lifecycle,
+                                   serving, telemetry)
+    from transmogrifai_tpu import fleet as fleet_mod
+    from transmogrifai_tpu import resilience
+    from transmogrifai_tpu import workload as workload_mod
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import \
+        BinaryClassificationModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import feature_types as ft
+
+    cap = int(os.environ.get("BENCH_TRACE_BUCKET_CAP", 1024))
+    train_rows = 20_000
+    n_feats = 6
+    rng = np.random.default_rng(29)
+    y = rng.integers(0, 2, train_rows).astype(float)
+    xs = {f"x{j}": rng.normal(size=train_rows) + (0.3 * j) * y
+          for j in range(n_feats)}
+    cols = {"label": column_from_values(ft.RealNN, y)}
+    for k, v in xs.items():
+        cols[k] = column_from_values(ft.Real, list(v))
+    store = ColumnStore(cols, train_rows)
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    feats = [FeatureBuilder.Real(f"x{j}").from_column().as_predictor()
+             for j in range(n_feats)]
+    vec = transmogrify(feats)
+    selector = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily(
+            grid=[{"regParam": 0.01, "elasticNetParam": 0.0}])],
+        splitter=None, seed=29)
+    pred = label.transform_with(selector, vec)
+    model = (Workflow().set_input_store(store)
+             .set_result_features(pred).train())
+    model._engine_breaker().reset()
+    records = [{"label": float(y[i]),
+                **{f"x{j}": float(xs[f"x{j}"][i])
+                   for j in range(n_feats)}}
+               for i in range(4096)]
+
+    work = tempfile.mkdtemp(prefix="tmog_workload_bench_")
+    mdir = os.path.join(work, "model")
+    edir = os.path.join(work, "export")
+    model.save(mdir)
+    serving.export_scoring_fn(model, edir, records[:8], bucket_cap=cap)
+    registry = lifecycle.ModelRegistry(os.path.join(work, "registry"))
+    registry.register("bench", mdir, bank_dir=edir, promote=True)
+    wdir = os.path.join(work, "workload")
+    trace_dirs = {n: os.path.join(work, f"traces_{n}")
+                  for n in ("recorder_off", "recorder_on")}
+    base = {"registryDir": os.path.join(work, "registry"),
+            "serveBucketCap": cap, "serveBatchDeadlineMs": 0.0,
+            "serveMetrics": True}
+    params = {}
+    for leg_name, extra in (
+            ("recorder_off", {}),
+            ("recorder_on", {"workloadDir": wdir,
+                             "workloadMaxMb": 8.0,
+                             "workloadPayloads": True})):
+        p = os.path.join(work, f"params_{leg_name}.json")
+        with open(p, "w") as fh:
+            json.dump({"customParams": {
+                **base, "traceDir": trace_dirs[leg_name],
+                **extra}}, fh)
+        params[leg_name] = p
+
+    record_s = float(os.environ.get("BENCH_WORKLOAD_RECORD_SECONDS", 6.0))
+    duration_s = float(os.environ.get("BENCH_WORKLOAD_SECONDS", 5.0))
+    reps = int(os.environ.get("BENCH_WORKLOAD_REPS", 7))
+    batch = 64
+    backoff = resilience.RetryPolicy(max_attempts=8, base_delay_s=0.05,
+                                     max_delay_s=0.5, jitter=0.1,
+                                     seed=11)
+    bodies = [records[lo:lo + batch]
+              for lo in range(0, len(records) - batch, batch)]
+    raw_bodies = [json.dumps({"records": b}).encode() for b in bodies]
+
+    sups = {}
+    routers = {}
+    ports = {}
+    for leg_name in ("recorder_off", "recorder_on"):
+        sup = fleet_mod.FleetSupervisor(params[leg_name], workers=1,
+                                        respawn_max=4,
+                                        probe_interval_s=0.1,
+                                        backoff=backoff)
+        sup.start()
+        sup.wait_ready(timeout_s=240)
+        httpd = fleet_mod.serve_fleet_http(sup, port=0, retry_budget=1,
+                                           forward_timeout_s=120.0)
+        sups[leg_name] = sup
+        routers[leg_name] = httpd
+        ports[leg_name] = httpd.server_address[1]
+
+    def pump(leg_name: str, seconds: float,
+             pace_s: float = 0.0) -> dict:
+        # pace_s > 0 leaves idle gaps between requests: a recording
+        # made at ~100% utilization cannot replay at 1x without the
+        # queue exploding (any service-time jitter accumulates), so
+        # the RECORD pass runs paced while the overhead legs stay
+        # closed-loop for maximum sensitivity
+        port = ports[leg_name]
+        reqs = 0
+        lats: list = []
+        t_end = time.perf_counter() + seconds
+        i = 0
+        while time.perf_counter() < t_end:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=120)
+            t_req = time.perf_counter()
+            try:
+                conn.request("POST", "/v1/models/bench:score",
+                             raw_bodies[i % len(raw_bodies)],
+                             {"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                assert resp.status == 200, resp.status
+            finally:
+                conn.close()
+            lats.append(time.perf_counter() - t_req)
+            i += 1
+            reqs += 1
+            if pace_s:
+                time.sleep(pace_s)
+        return {"requests": reqs,
+                "p50_ms": round(float(np.median(lats)) * 1e3, 4)}
+
+    def leg(leg_name: str, seconds: float,
+            pace_s: float = 0.0) -> dict:
+        # the recording fleet's ROUTER lives in this process: its
+        # recorder is installed only during recorder-on legs so the
+        # off legs pay zero recorder cost (legs never overlap)
+        if leg_name == "recorder_on":
+            workload_mod.start_recorder(wdir, role="router")
+        try:
+            return pump(leg_name, seconds, pace_s=pace_s)
+        finally:
+            workload_mod.stop_recorder()
+
+    was_enabled = telemetry.enabled()
+    telemetry.enable()   # tracing plane ON for both legs — constant
+    out: dict = {"duration_s_per_leg": duration_s, "reps": reps}
+    try:
+        # -- phase 1: record ------------------------------------------------
+        rec_leg = leg("recorder_on", record_s, pace_s=0.01)
+        merged = workload_mod.merge_workload_shards(wdir)
+        recorded = workload_mod.summarize_workload(merged)
+        out["recorded"] = {
+            "requests": merged["requests"],
+            "shards": merged["mergedShards"],
+            "tornRecordsSkipped": merged["tornRecordsSkipped"],
+            "combinedSources": sorted(
+                {s for r in merged["records"]
+                 for s in r.get("sources", ())}),
+            "phases": recorded["models"].get("bench", {}).get("phases"),
+        }
+
+        # -- phase 2: recorder overhead on ONE server instance -------------
+        # the drift_canary pairing discipline: same instance, same
+        # stream, recorder toggled per leg with ALTERNATING order — a
+        # two-fleet pairing bakes in cross-instance asymmetry (worker
+        # process placement, allocator state) that dwarfs a
+        # microsecond-scale recorder signal. serve_http runs the SAME
+        # handler + zero-copy record path the fleet workers run.
+        from transmogrifai_tpu import server as server_mod
+        srv_local = server_mod.ModelServer(bucket_cap=cap,
+                                           batch_deadline_s=0.0)
+        srv_local.register("bench", model_dir=mdir, bank_dir=edir)
+        httpd_local = server_mod.serve_http(srv_local, port=0)
+        ports["local"] = httpd_local.server_address[1]
+        odir = os.path.join(work, "workload_overhead")
+
+        def leg_local(recording: bool, seconds: float) -> dict:
+            if recording:
+                workload_mod.start_recorder(odir, role="overhead")
+            try:
+                return pump("local", seconds)
+            finally:
+                workload_mod.stop_recorder()
+
+        try:
+            pump("local", min(duration_s, 3.0))     # warm off-clock
+            legs = {n: {"rep_p50_ms": []}
+                    for n in ("recorder_off", "recorder_on")}
+            ratios = []
+            for rep in range(reps):
+                if rep % 2 == 0:
+                    off = leg_local(False, duration_s)
+                    on = leg_local(True, duration_s)
+                else:
+                    on = leg_local(True, duration_s)
+                    off = leg_local(False, duration_s)
+                legs["recorder_off"]["rep_p50_ms"].append(off["p50_ms"])
+                legs["recorder_on"]["rep_p50_ms"].append(on["p50_ms"])
+                ratios.append(on["p50_ms"] / max(off["p50_ms"], 1e-9)
+                              - 1.0)
+        finally:
+            httpd_local.shutdown()
+            srv_local.shutdown(drain=True)
+        for n in legs:
+            legs[n]["p50_ms"] = min(legs[n]["rep_p50_ms"])
+        overhead = float(np.median(ratios))
+        out["legs"] = legs
+        out["paired_overheads"] = [round(r, 4) for r in ratios]
+        out["recorder_overhead"] = round(overhead, 4)
+
+        # -- phase 3: replay at 1x against the OTHER fleet ------------------
+        replayed = workload_mod.replay_workload(
+            merged, f"127.0.0.1:{ports['recorder_off']}", speed=1.0,
+            timeout_s=60.0)
+        rec_phases = (recorded["models"].get("bench", {})
+                      .get("phases") or {})
+        rep_phases = (replayed["models"].get("bench", {})
+                      .get("phases") or {})
+        agreement = {}
+        agree_ok = True
+        for ph in sorted(set(rec_phases) & set(rep_phases)):
+            a, b = rec_phases[ph]["p50Ms"], rep_phases[ph]["p50Ms"]
+            tol = max(0.5 * a, 10.0)   # ms: arrival-dependent phases
+            ok = abs(b - a) <= tol
+            agree_ok = agree_ok and ok
+            agreement[ph] = {"recorded_p50_ms": a, "replayed_p50_ms": b,
+                             "tol_ms": round(tol, 3), "ok": ok}
+        out["replay"] = {
+            "sent": replayed["sent"], "failed": replayed["failed"],
+            "skipped_no_payload": replayed["skippedNoPayload"],
+            "late_sends": replayed["lateSends"],
+            "parity_checked": replayed["parityChecked"],
+            "parity_failures": replayed["parityFailures"],
+            "parity_max_abs_delta": replayed["parityMaxAbsDelta"],
+            "phase_agreement": agreement,
+        }
+        parity_ok = (replayed["parityChecked"] > 0
+                     and replayed["parityFailures"] == 0)
+
+        # -- phase 4: critical-path analysis on a clean traced window ------
+        telemetry.reset(keep_listeners=True)
+        telemetry.enable()
+        pump("recorder_on", 2.0)
+    finally:
+        for httpd in routers.values():
+            httpd.shutdown()
+        for sup in sups.values():
+            sup.stop(drain=True)     # workers write their trace shards
+        router_events = telemetry.trace_events()
+        telemetry.reset(keep_listeners=True)
+        if was_enabled:
+            telemetry.enable()
+        else:
+            telemetry.disable()
+    # hand-write the router's shard (its events were captured above,
+    # before the reset restored ambient telemetry state)
+    os.makedirs(trace_dirs["recorder_on"], exist_ok=True)
+    with open(os.path.join(trace_dirs["recorder_on"],
+                           "shard-router-0.trace.json"), "w") as fh:
+        json.dump({"role": "router", "pid": 0,
+                   "epochUnixS": time.time()
+                   - time.perf_counter() + telemetry._EPOCH,
+                   "traceEvents": router_events}, fh)
+    analysis = workload_mod.analyze_trace(trace_dirs["recorder_on"],
+                                          top_k=3)
+    self_diff = workload_mod.diff_analyses(analysis, analysis)
+    # a baseline whose p99s were all HALVED must trip the watchdog
+    perturbed = json.loads(json.dumps(analysis))
+    for ph in perturbed["phases"].values():
+        ph["p99Ms"] = ph["p99Ms"] / 2.0
+    trip_diff = workload_mod.diff_analyses(analysis, perturbed)
+    coverage_ok = bool(analysis["requests"] > 0
+                       and analysis["coverage"]["min"] >= 0.95)
+    out["analysis"] = {
+        "requests": analysis["requests"],
+        "coverage": analysis["coverage"],
+        "phase_shares": {n: p["share"]
+                         for n, p in analysis["phases"].items()},
+        "slowest_path": [s["name"] for s in
+                         (analysis["slowest"][0]["path"]
+                          if analysis["slowest"] else [])],
+        "self_diff_ok": self_diff["ok"],
+        "perturbed_baseline_regressions": trip_diff["regressions"],
+    }
+    shutil.rmtree(work, ignore_errors=True)
+    out["record_leg_requests"] = rec_leg["requests"]
+    out["workload_stats"] = workload_mod.workload_stats()
+    out["pass"] = bool(overhead < 0.05 and parity_ok and agree_ok
+                       and coverage_ok and self_diff["ok"]
+                       and trip_diff["regressions"] > 0)
+    return out
+
+
 def _drift_canary() -> dict:
     """Model lifecycle benchmark (registry + drift sentinel + canary
     rollout, lifecycle.py / docs/lifecycle.md):
@@ -2668,6 +2999,26 @@ def main() -> None:
         except Exception as e:
             _log(f"[bench] trace_overhead failed: {e!r}")
             configs["trace_overhead"] = {"error": repr(e)[:400]}
+    bench.emit()
+
+    # 4b2c. Workload capture & replay (the flight-recorder gate):
+    #      record a fleet run, merge the shards, replay at 1x against a
+    #      second fleet — score parity + per-phase agreement — with the
+    #      recorder's overhead paired-measured < 5% and the critical-
+    #      path analyzer attributing >= 95% of every request's e2e.
+    #      Budget-gated: boots two 1-worker fleets.
+    if bench.remaining() < 240:
+        configs["workload_replay"] = {
+            "status": "skipped_budget",
+            "remaining_budget_s": round(bench.remaining(), 1)}
+        _log(f"[bench] workload_replay skipped: remaining "
+             f"{bench.remaining():.0f}s < 240s")
+    else:
+        try:
+            configs["workload_replay"] = _workload_replay()
+        except Exception as e:
+            _log(f"[bench] workload_replay failed: {e!r}")
+            configs["workload_replay"] = {"error": repr(e)[:400]}
     bench.emit()
 
     # 4b3. Model lifecycle (the registry + drift sentinel + canary

@@ -184,13 +184,13 @@ def test_planned_scores_bit_identical_with_cse_and_pruning(rng, fast_link):
             assert np.array_equal(vb, np.asarray(tp[cn].values)), cn
 
 
-def test_pruning_parity_on_titanic_example(fast_link):
+def _titanic_pruning_parity(families=None):
     sys.path.insert(0, os.path.join(_REPO, "examples"))
     try:
         from titanic import run as run_titanic
     finally:
         sys.path.pop(0)
-    out = run_titanic(num_folds=2, seed=42)
+    out = run_titanic(num_folds=2, families=families, seed=42)
     model = out["model"]
     plan = planner.plan_model(model)
     # the sanity checker prunes bad features on titanic → dead columns
@@ -207,6 +207,20 @@ def test_pruning_parity_on_titanic_example(fast_link):
     nm = [f.name for f in model.result_features][0]
     assert np.array_equal(base[nm].prediction, planned[nm].prediction)
     assert np.array_equal(base[nm].probability, planned[nm].probability)
+
+
+def test_pruning_parity_on_titanic_small_grid(fast_link):
+    # tier-1 variant: ONE logistic-regression grid point keeps the CV
+    # sweep tiny while still exercising sanity-check pruning + planned
+    # scoring parity on the real example end to end
+    _titanic_pruning_parity(families=[LogisticRegressionFamily(
+        grid=[{"regParam": 0.01, "elasticNetParam": 0.0}])])
+
+
+@pytest.mark.slow
+def test_pruning_parity_on_titanic_example(fast_link):
+    # full default model-selector sweep (every family, full grids)
+    _titanic_pruning_parity()
 
 
 # ---------------------------------------------------------------------------

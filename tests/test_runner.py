@@ -306,6 +306,9 @@ def test_runner_metrics_doc_always_stamps_mesh(rng, tmp_path):
     out = runner.run(RunType.TRAIN, params)
     topo = out.metrics["mesh"]
     assert topo["devices"] == 8 and topo["platform"] == "cpu"
+    # the always-on flight-recorder tallies ride the same doc
+    wl = out.metrics["workload"]
+    assert wl["recording"] is False and "records_written" in wl
     out2 = runner.run(RunType.SCORE, params)
     assert out2.metrics["mesh"]["devices"] == 8
 

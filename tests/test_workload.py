@@ -1,0 +1,598 @@
+"""Workload flight-recorder / replay / critical-path tests (PR 17,
+workload.py + its server/fleet/CLI integration).
+
+The tentpole contract: every request accepted by a serving process
+appends ONE compact JSONL record — off the request path, through a
+bounded queue into a single named writer thread, size-rotated,
+torn-tolerant — and `workload merge` stitches the per-process shards
+into one arrival-ordered workload that `workload replay` re-drives
+open-loop against a live server with score parity asserted wherever
+payloads were recorded. `trace analyze` reconstructs per-request
+critical paths from merged traces (parent-child self-time plus
+batch-span link donations) and `diff_analyses` is the thresholded
+regression watchdog over two analyses. Chaos satellite: a fresh
+interpreter SIGKILLed mid-write tears at most the final line, which
+merge skips and tallies — never a crash.
+"""
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder, Workflow, telemetry
+from transmogrifai_tpu import server as server_mod
+from transmogrifai_tpu import workload as workload_mod
+from transmogrifai_tpu.models import (BinaryClassificationModelSelector,
+                                      LogisticRegressionFamily)
+from transmogrifai_tpu.ops.transmogrifier import transmogrify
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_workload():
+    workload_mod.stop_recorder()
+    workload_mod.reset_workload_stats()
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    workload_mod.stop_recorder()
+    workload_mod.reset_workload_stats()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _read_lines(path):
+    with open(path, "rb") as fh:
+        return [json.loads(ln) for ln in fh.read().splitlines() if ln]
+
+
+# ---------------------------------------------------------------------------
+# recorder: shard format, zero-copy splice, caps, rotation, drops
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_shard_header_and_record(tmp_path):
+    d = str(tmp_path / "wl")
+    rec = workload_mod.start_recorder(d, role="worker")
+    assert workload_mod.recording_enabled()
+    ok = workload_mod.record_request(
+        "m", 2, records=[{"x": 1.0}, {"x": 2.0}],
+        outputs=[{"p": 0.5}, {"p": 0.7}], trace_id="t1",
+        outcome={"status": 200, "ok": True},
+        phases={"e2e": 0.005, "queueWait": 0.001})
+    assert ok
+    shard = rec.shard_path
+    workload_mod.stop_recorder()        # drains the queue — a barrier
+    assert not workload_mod.recording_enabled()
+    lines = _read_lines(shard)
+    hdr, req = lines[0], lines[1]
+    assert hdr["kind"] == "header"
+    assert hdr["version"] == workload_mod.WORKLOAD_VERSION
+    assert hdr["role"] == "worker" and hdr["pid"] == os.getpid()
+    assert hdr["epochUnixS"] > 0
+    assert req["kind"] == "request" and req["model"] == "m"
+    assert req["rows"] == 2 and req["traceId"] == "t1"
+    assert req["payload"] == [{"x": 1.0}, {"x": 2.0}]
+    assert req["outputs"] == [{"p": 0.5}, {"p": 0.7}]
+    assert req["phases"]["e2e"] == 0.005
+    assert req["tOffsetS"] >= 0
+    st = workload_mod.workload_stats()
+    assert st["records_enqueued"] == 1 and st["records_written"] == 1
+    assert st["payloads_recorded"] == 1 and st["records_dropped"] == 0
+    assert st["recording"] is False and st["drop_rate"] == 0.0
+
+
+def test_recorder_zero_copy_splice_and_merge_normalizes(tmp_path):
+    # pre-serialized request/response bodies are spliced VERBATIM into
+    # the line (the serving handler already paid the serialization);
+    # merge unwraps them back into the payload/outputs/phases schema
+    d = str(tmp_path / "wl")
+    rec = workload_mod.start_recorder(d, role="worker")
+    raw_req = b'{"records":[{"x":1.5}],"junk":true}'
+    raw_resp = (b'{"model":"m","outputs":[{"p":0.25}],'
+                b'"phases":{"e2e":0.002,"queueWait":0.0003}}')
+    assert workload_mod.record_request(
+        "m", 1, payload_json=raw_req, response_json=raw_resp,
+        trace_id="tz", outcome={"status": 200, "ok": True})
+    shard = rec.shard_path
+    workload_mod.stop_recorder()
+    with open(shard, "rb") as fh:
+        blob = fh.read()
+    assert raw_req in blob and raw_resp in blob   # byte-verbatim splice
+    req = _read_lines(shard)[1]
+    assert req["request"]["records"] == [{"x": 1.5}]
+    merged = workload_mod.merge_workload_shards(d)
+    r = merged["records"][0]
+    assert "request" not in r and "response" not in r
+    assert r["payload"] == [{"x": 1.5}]
+    assert r["outputs"] == [{"p": 0.25}]
+    assert r["phases"]["queueWait"] == 0.0003
+
+
+def test_payload_cap_digests_and_payloads_off(tmp_path):
+    d = str(tmp_path / "wl")
+    rec = workload_mod.start_recorder(d, role="worker")
+    big = [{"x": float(i)} for i in range(20_000)]   # > 64 KiB as JSON
+    assert workload_mod.record_request("m", len(big), records=big)
+    shard = rec.shard_path
+    workload_mod.stop_recorder()
+    req = _read_lines(shard)[1]
+    assert "payload" not in req
+    dig = req["payloadDigest"]
+    assert dig["rows"] == len(big) and dig["bytes"] > 65536
+    assert len(dig["sha256"]) == 16
+    assert workload_mod.workload_stats()["payloads_digested"] == 1
+
+    # payload capture disabled: even a tiny payload degrades to digest
+    d2 = str(tmp_path / "wl2")
+    rec2 = workload_mod.start_recorder(d2, role="worker",
+                                       payloads=False)
+    assert workload_mod.record_request("m", 1, records=[{"x": 1.0}])
+    shard2 = rec2.shard_path
+    workload_mod.stop_recorder()
+    req2 = _read_lines(shard2)[1]
+    assert "payload" not in req2 and "payloadDigest" in req2
+
+
+def test_recorder_size_rotation(tmp_path):
+    d = str(tmp_path / "wl")
+    # max_mb below the 4 KiB floor: the floor keeps segments meaningful
+    rec = workload_mod.start_recorder(d, role="worker", max_mb=0.001)
+    assert rec.max_bytes == 4096
+    payload = [{"x": 1.0, "y": 2.0}] * 4
+    for i in range(100):
+        assert workload_mod.record_request("m", 4, records=payload,
+                                           trace_id=f"t{i:04d}")
+    workload_mod.stop_recorder()
+    shards = sorted(os.listdir(d))
+    assert len(shards) >= 2                       # rotated segments
+    assert any(".workload.000.jsonl" in s for s in shards)
+    assert workload_mod.workload_stats()["rotations"] >= 1
+    merged = workload_mod.merge_workload_shards(d)  # reads ALL segments
+    assert merged["requests"] == 100
+    assert merged["mergedShards"] == len(shards)
+
+
+def test_recorder_queue_full_drops_never_blocks(tmp_path):
+    rec = workload_mod.WorkloadRecorder(str(tmp_path / "wl"),
+                                        role="worker", queue_depth=1)
+    # stop the writer thread out-of-band so the queue genuinely fills
+    rec._queue.put(None)
+    rec._thread.join(timeout=10)
+    assert not rec._thread.is_alive()
+    assert rec.record({"kind": "request", "model": "m", "rows": 1})
+    t0 = time.perf_counter()
+    assert not rec.record({"kind": "request", "model": "m", "rows": 1})
+    assert time.perf_counter() - t0 < 0.5         # dropped, not blocked
+    st = workload_mod.workload_stats()
+    assert st["records_dropped"] == 1 and st["drop_rate"] == 0.5
+    rec._closed = True
+
+
+# ---------------------------------------------------------------------------
+# merge: clock alignment, router+worker combine, torn tolerance
+# ---------------------------------------------------------------------------
+
+
+def _write_shard(path, role, pid, epoch, records, torn_tail=None):
+    with open(path, "wb") as fh:
+        fh.write(json.dumps({"kind": "header", "version": 1,
+                             "role": role, "pid": pid, "segment": 0,
+                             "epochUnixS": epoch}).encode() + b"\n")
+        for r in records:
+            fh.write(json.dumps({"kind": "request", **r},
+                                separators=(",", ":")).encode() + b"\n")
+        if torn_tail is not None:
+            fh.write(torn_tail)                   # no terminator
+
+
+def test_merge_clock_alignment_and_router_worker_combine(tmp_path):
+    d = str(tmp_path / "wl")
+    os.makedirs(d)
+    # worker anchored 100 s BEFORE the router: absolute arrival is
+    # anchor + offset, so the worker's offsets are 100 s larger
+    _write_shard(os.path.join(d, "shard-router-1.workload.jsonl"),
+                 "router", 1, 1000.0, [
+        {"tOffsetS": 4.0, "model": "m", "rows": 2, "traceId": "tt",
+         "outcome": {"status": 200, "ok": True},
+         "phases": {"e2e": 0.006},
+         "route": {"worker": 0, "failovers": 0}}])
+    _write_shard(os.path.join(d, "shard-worker-2.workload.jsonl"),
+                 "worker", 2, 900.0, [
+        {"tOffsetS": 90.0, "model": "m", "rows": 1},   # abs 990: first
+        {"tOffsetS": 104.1, "model": "m", "rows": 2, "traceId": "tt",
+         "payload": [{"x": 1.0}, {"x": 2.0}],
+         "outputs": [{"p": 0.1}, {"p": 0.9}],
+         "phases": {"e2e": 0.005, "queueWait": 0.001}}])
+    merged = workload_mod.merge_workload_shards(d)
+    assert merged["mergedShards"] == 2
+    assert merged["tornRecordsSkipped"] == 0
+    assert merged["requests"] == 2                # tt folded into one
+    first, second = merged["records"]
+    assert first["tS"] == 0.0 and "traceId" not in first
+    assert second["traceId"] == "tt"
+    # rebased on the earliest arrival, clock offsets aligned:
+    # router 1000+4.0 vs worker 900+104.1 → the worker record is the
+    # earlier instant of the SAME request and keeps the timeline
+    assert second["tS"] == pytest.approx(14.0, abs=1e-6)
+    assert second["sources"] == ["router", "worker"]
+    assert second["route"]["worker"] == 0
+    assert second["payload"] == [{"x": 1.0}, {"x": 2.0}]
+    # the router's e2e (client-visible) wins; worker sub-phases ride
+    assert second["phases"]["e2e"] == 0.006
+    assert second["phases"]["queueWait"] == 0.001
+    assert second["outcome"]["ok"] is True
+
+
+def test_merge_torn_tail_and_unreadable_shard(tmp_path):
+    d = str(tmp_path / "wl")
+    os.makedirs(d)
+    _write_shard(os.path.join(d, "shard-worker-1.workload.jsonl"),
+                 "worker", 1, 100.0,
+                 [{"tOffsetS": 1.0, "model": "m", "rows": 1}],
+                 torn_tail=b'{"kind":"request","model":"m","ro')
+    with open(os.path.join(d, "shard-worker-2.workload.jsonl"),
+              "wb") as fh:
+        fh.write(b"not json at all\n")            # header unreadable
+    merged = workload_mod.merge_workload_shards(d)
+    assert merged["requests"] == 1
+    assert merged["tornRecordsSkipped"] == 1
+    assert len(merged["mergeErrors"]) == 1
+    assert "shard-worker-2" in merged["mergeErrors"][0]
+    st = workload_mod.workload_stats()
+    assert st["torn_records_skipped"] == 1 and st["merge_errors"] == 1
+    with pytest.raises(ValueError):
+        workload_mod.merge_workload_shards(str(tmp_path / "empty"))
+
+
+def test_summarize_workload_percentiles_and_failures():
+    doc = {"records": [
+        {"tS": 0.0, "model": "m", "rows": 4,
+         "phases": {"e2e": 0.010}},
+        {"tS": 0.4, "model": "m", "rows": 4,
+         "phases": {"e2e": 0.020}},
+        {"tS": 0.5, "model": "m", "rows": 4,
+         "phases": {"e2e": 0.030}},
+        {"tS": 1.0, "model": "m", "rows": 2,
+         "outcome": {"status": 503, "ok": False}}]}
+    s = workload_mod.summarize_workload(doc)
+    assert s["requests"] == 4 and s["durationS"] == 1.0
+    m = s["models"]["m"]
+    assert m["rows"] == 14 and m["failed"] == 1
+    assert m["phases"]["e2e"]["n"] == 3
+    assert m["phases"]["e2e"]["p50Ms"] == 20.0    # nearest-rank
+    assert m["phases"]["e2e"]["p99Ms"] == 30.0
+
+
+# ---------------------------------------------------------------------------
+# replay: live round-trip with score parity, skips, speed
+# ---------------------------------------------------------------------------
+
+
+def _train_tiny(seed, n=160):
+    rng = np.random.default_rng(seed)
+    y = np.asarray([i % 2 for i in range(n)], float)
+    rng.shuffle(y)
+    records = [{"label": float(y[i]),
+                "x1": float(rng.normal() + y[i]),
+                "x2": float(rng.normal())} for i in range(n)]
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x1").from_column().as_predictor()
+    f2 = FeatureBuilder.Real("x2").from_column().as_predictor()
+    vec = transmogrify([f1, f2])
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()],
+        splitter=None, seed=seed)
+    pred = label.transform_with(sel, vec)
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    return model, records
+
+
+@pytest.fixture(scope="module")
+def tiny_server():
+    model, records = _train_tiny(47)
+    srv = server_mod.ModelServer(batch_deadline_s=0.0)
+    srv.register("m", model=model)
+    httpd = server_mod.serve_http(srv, port=0)
+    yield srv, httpd.server_address[1], records
+    httpd.shutdown()
+    srv.shutdown(drain=True)
+    model._engine_breaker().reset()
+
+
+def _post_score(port, name, records):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    try:
+        conn.request("POST", f"/v1/models/{name}:score",
+                     json.dumps({"records": records}),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        return r.status, json.loads(r.read() or b"{}")
+    finally:
+        conn.close()
+
+
+def test_record_and_replay_live_score_parity(tiny_server, tmp_path):
+    srv, port, records = tiny_server
+    d = str(tmp_path / "wl")
+    workload_mod.start_recorder(d, role="worker")
+    for lo in range(0, 12, 3):
+        status, doc = _post_score(port, "m", records[lo:lo + 3])
+        assert status == 200
+        assert "phases" in doc and "e2e" in doc["phases"]
+    workload_mod.stop_recorder()
+    merged = workload_mod.merge_workload_shards(d)
+    assert merged["requests"] == 4
+    r0 = merged["records"][0]
+    assert r0["payload"] == records[0:3]          # zero-copy unwrapped
+    assert len(r0["outputs"]) == 3
+    assert r0["outcome"] == {"status": 200, "ok": True}
+    out = workload_mod.replay_workload(
+        merged, f"127.0.0.1:{port}", speed=100.0, timeout_s=60.0)
+    assert out["sent"] == 4 and out["failed"] == 0
+    assert out["skippedNoPayload"] == 0
+    assert out["parityChecked"] == 4 and out["parityFailures"] == 0
+    assert out["parityMaxAbsDelta"] <= 1e-4
+    ph = out["models"]["m"]["phases"]
+    assert "e2e" in ph and "queueWait" in ph      # decomposed summary
+    st = workload_mod.workload_stats()
+    assert st["replayed_requests"] == 4 and st["parity_checked"] == 4
+
+
+def test_replay_skips_digested_and_failed_records(tiny_server, tmp_path):
+    srv, port, records = tiny_server
+    doc = {"records": [
+        {"tS": 0.0, "model": "m", "rows": 2, "payload": records[:2]},
+        {"tS": 0.001, "model": "m", "rows": 2,
+         "payloadDigest": {"rows": 2, "bytes": 99, "sha256": "ab"}},
+        {"tS": 0.002, "model": "m", "rows": 2, "payload": records[2:4],
+         "outcome": {"status": 504, "ok": False}}]}
+    out = workload_mod.replay_workload(doc, f"http://127.0.0.1:{port}",
+                                       speed=10.0, timeout_s=60.0)
+    assert out["requests"] == 2                   # failed one filtered
+    assert out["sent"] == 1                       # digest unreplayable
+    assert out["skippedNoPayload"] == 1
+    assert out["parityChecked"] == 0              # outputs not recorded
+    stats = workload_mod.workload_stats()
+    assert stats["replay_skipped_no_payload"] == 1
+    with pytest.raises(ValueError):
+        workload_mod.replay_workload(doc, f"127.0.0.1:{port}", speed=0)
+
+
+# ---------------------------------------------------------------------------
+# critical-path analyzer + regression watchdog
+# ---------------------------------------------------------------------------
+
+
+def _span(name, trace, sid, t0_us, dur_us, parent=None, links=()):
+    return {"ph": "X", "name": name, "ts": t0_us, "dur": dur_us,
+            "args": {"trace_id": trace, "span_id": sid,
+                     "parent_span_id": parent, "links": list(links)}}
+
+
+def _synthetic_trace():
+    return {"traceEvents": [
+        # T1: request root + child; a foreign-trace batch span links
+        # the root and donates its overlap under its own name
+        _span("server:request", "T1", "r1", 0, 10_000),
+        _span("score:prepare", "T1", "c1", 1_000, 2_000, parent="r1"),
+        _span("server:dispatch", "T2", "b1", 4_000, 4_000,
+              links=["r1"]),
+        # T3: the batch span is ALSO a same-trace child of the request
+        # it links — ordinary parent-child accounting must apply ONCE
+        _span("server:request", "T3", "r3", 0, 8_000),
+        _span("server:dispatch", "T3", "b3", 2_000, 6_000,
+              parent="r3", links=["r3"]),
+    ]}
+
+
+def test_analyze_trace_links_self_time_and_coverage():
+    a = workload_mod.analyze_trace(_synthetic_trace(), top_k=5)
+    assert a["requests"] == 2
+    assert a["skippedTraces"] == 1                # T2 has no root
+    assert a["coverage"]["min"] == 1.0 and a["coverage"]["mean"] == 1.0
+    by_req = {r["traceId"]: r for r in a["slowest"]}
+    t1 = by_req["T1"]["attributionMs"]
+    # 10 ms e2e = 4 self + 2 child + 4 donated by the linked batch
+    assert t1 == {"score:prepare": 2.0, "server:dispatch": 4.0,
+                  "server:request": 4.0}
+    t3 = by_req["T3"]["attributionMs"]
+    # same-trace child link: NO double deduction — 2 self + 6 child
+    assert t3 == {"server:dispatch": 6.0, "server:request": 2.0}
+    assert a["e2e"]["p99Ms"] == 10.0
+    assert a["phases"]["server:dispatch"]["n"] == 2
+    # the slowest request's path crosses the coalescing boundary into
+    # the linked batch span
+    assert a["slowest"][0]["traceId"] == "T1"
+    names = [p["name"] for p in a["slowest"][0]["path"]]
+    assert names == ["server:request", "score:prepare",
+                     "server:dispatch"]
+
+
+def test_diff_analyses_regression_watchdog():
+    cur = {"e2e": {"p99Ms": 10.0},
+           "phases": {"a": {"p99Ms": 20.0}, "b": {"p99Ms": 0.2},
+                      "new": {"p99Ms": 1.0}}}
+    base = {"e2e": {"p99Ms": 10.0},
+            "phases": {"a": {"p99Ms": 10.0}, "b": {"p99Ms": 0.1},
+                       "gone": {"p99Ms": 5.0}}}
+    diff = workload_mod.diff_analyses(cur, base)
+    verdicts = {v["phase"]: v["verdict"] for v in diff["verdicts"]}
+    assert verdicts["e2e"] == "ok"
+    assert verdicts["a"] == "regressed"           # +100%, +10 ms
+    assert verdicts["b"] == "ok"                  # +100% but < abs floor
+    assert verdicts["new"] == "added"
+    assert verdicts["gone"] == "removed"
+    assert diff["regressions"] == 1 and diff["ok"] is False
+    assert workload_mod.diff_analyses(cur, cur)["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# CLI: workload merge/replay, trace analyze, gen/check knobs
+# ---------------------------------------------------------------------------
+
+
+def test_cli_workload_merge_and_strict(tmp_path, capsys):
+    from transmogrifai_tpu.cli import main as cli_main
+    d = str(tmp_path / "wl")
+    os.makedirs(d)
+    _write_shard(os.path.join(d, "shard-worker-1.workload.jsonl"),
+                 "worker", 1, 100.0,
+                 [{"tOffsetS": 1.0, "model": "m", "rows": 1,
+                   "payload": [{"x": 1.0}]}])
+    with open(os.path.join(d, "shard-worker-2.workload.jsonl"),
+              "wb") as fh:
+        fh.write(b"garbage\n")
+    assert cli_main(["workload", "merge", d]) == 0
+    err = capsys.readouterr().err
+    assert "skipped" in err and "shard-worker-2" in err
+    assert os.path.exists(os.path.join(d, "merged.workload.json"))
+    # --strict makes a merge that skipped shards a non-zero exit
+    assert cli_main(["workload", "merge", d, "--strict"]) == 1
+    assert cli_main(["workload", "merge",
+                     str(tmp_path / "missing")]) == 1
+
+
+def test_cli_workload_replay_live(tiny_server, tmp_path, capsys):
+    from transmogrifai_tpu.cli import main as cli_main
+    srv, port, records = tiny_server
+    d = str(tmp_path / "wl")
+    workload_mod.start_recorder(d, role="worker")
+    for lo in (0, 4):
+        status, _doc = _post_score(port, "m", records[lo:lo + 4])
+        assert status == 200
+    workload_mod.stop_recorder()
+    merged_path = str(tmp_path / "merged.workload.json")
+    assert cli_main(["workload", "merge", d, "-o", merged_path]) == 0
+    summary_path = str(tmp_path / "replay.json")
+    assert cli_main(["workload", "replay", merged_path,
+                     "--url", f"http://127.0.0.1:{port}",
+                     "--speed", "100", "-o", summary_path]) == 0
+    out = capsys.readouterr().out
+    assert "2/2 request(s) re-driven" in out
+    assert "parity: 2 checked, 0 failure(s)" in out
+    with open(summary_path) as fh:
+        doc = json.load(fh)
+    assert doc["replayed"]["parityChecked"] == 2
+    assert doc["recorded"]["models"]["m"]["requests"] == 2
+    # replay without --url is an argument error, not a crash
+    assert cli_main(["workload", "replay", merged_path]) == 1
+
+
+def test_cli_trace_merge_surfaces_torn_shards_and_strict(tmp_path,
+                                                         capsys):
+    from transmogrifai_tpu.cli import run_trace
+    telemetry.enable()
+    with telemetry.trace_scope(telemetry.mint_trace()):
+        with telemetry.span("wl:span"):
+            pass
+    d = str(tmp_path / "shards")
+    telemetry.write_trace_shard(d, role="worker")
+    with open(os.path.join(d, "shard-worker-99999.trace.json"),
+              "w") as fh:
+        fh.write('{"torn": tr')                   # unreadable shard
+    assert run_trace("merge", d) == 0             # non-strict: warns
+    err = capsys.readouterr().err
+    assert "skipped" in err and "shard-worker-99999" in err
+    assert run_trace("merge", d, strict=True) == 1
+    assert "failing (--strict)" in capsys.readouterr().err
+
+
+def test_cli_trace_analyze_and_baseline_watchdog(tmp_path, capsys):
+    from transmogrifai_tpu.cli import run_trace
+    trace_path = str(tmp_path / "merged.trace.json")
+    with open(trace_path, "w") as fh:
+        json.dump(_synthetic_trace(), fh)
+    analysis_path = str(tmp_path / "analysis.json")
+    assert run_trace("analyze", trace_path, out=analysis_path,
+                     top_k=2) == 0
+    out = capsys.readouterr().out
+    assert "2 request trace(s)" in out and "coverage min 1.0" in out
+    with open(analysis_path) as fh:
+        analysis = json.load(fh)
+    # self-baseline: clean; halved baseline p99s: regressions, exit 1
+    assert run_trace("analyze", trace_path,
+                     baseline=analysis_path) == 0
+    assert "no regressions" in capsys.readouterr().out
+    for ph in analysis["phases"].values():
+        ph["p99Ms"] = ph["p99Ms"] / 2.0
+    analysis["e2e"]["p99Ms"] = analysis["e2e"]["p99Ms"] / 2.0
+    perturbed_path = str(tmp_path / "baseline.json")
+    with open(perturbed_path, "w") as fh:
+        json.dump(analysis, fh)
+    assert run_trace("analyze", trace_path,
+                     baseline=perturbed_path) == 1
+    assert "regression(s)" in capsys.readouterr().err
+    assert run_trace("analyze", str(tmp_path / "nope.json")) == 1
+
+
+def test_cli_gen_emits_and_check_validates_workload_knobs(tmp_path):
+    from transmogrifai_tpu.cli import generate_project, run_check
+    csv = tmp_path / "d.csv"
+    csv.write_text("label,x\n1,0.5\n0,0.2\n1,0.9\n0,0.1\n")
+    out = generate_project(str(csv), "label", str(tmp_path / "proj"))
+    params = json.loads(open(out["params.json"]).read())
+    for knob in ("workloadDir", "workloadMaxMb", "workloadPayloads"):
+        assert knob in params["customParams"]
+        assert params["customParams"][knob] is None
+    for bad_knobs in ({"workloadDir": 7},
+                      {"workloadMaxMb": "big"},
+                      {"workloadMaxMb": -1.0},
+                      {"workloadPayloads": "yes"}):
+        bad = dict(params)
+        bad["customParams"] = dict(params["customParams"], **bad_knobs)
+        bad_path = tmp_path / "bad.json"
+        bad_path.write_text(json.dumps(bad))
+        assert run_check(str(bad_path)) == 1, bad_knobs
+
+
+# ---------------------------------------------------------------------------
+# chaos satellite: SIGKILL mid-write tears ONE line, merge survives
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_sigkill_mid_write_tears_one_line_merge_survives(tmp_path):
+    d = str(tmp_path / "wl")
+    child = textwrap.dedent("""
+        import json, os, signal, sys, time
+        from transmogrifai_tpu import workload
+        d = sys.argv[1]
+        rec = workload.start_recorder(d, role="worker")
+        for i in range(5):
+            workload.record_request("m", 1, records=[{"x": float(i)}],
+                                    trace_id=f"t{i}")
+        for _ in range(200):               # wait for the writer thread
+            if workload.workload_stats()["records_written"] == 5:
+                break
+            time.sleep(0.05)
+        else:
+            sys.exit(3)
+        # die mid-line: append a torn record with NO terminator, then
+        # SIGKILL ourselves — no atexit, no flush, no drain
+        with open(rec.shard_path, "ab") as fh:
+            fh.write(b'{"kind":"request","model":"m","ro')
+            fh.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+    """)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", child, d],
+                          cwd=_REPO, env=env, capture_output=True,
+                          timeout=240)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr.decode()
+    merged = workload_mod.merge_workload_shards(d)
+    assert merged["requests"] == 5                # good lines survive
+    assert merged["tornRecordsSkipped"] == 1      # torn tail tallied
+    assert "mergeErrors" not in merged
+    # and the CLI path reports it without failing (non-strict)
+    from transmogrifai_tpu.cli import run_workload
+    assert run_workload("merge", d) == 0

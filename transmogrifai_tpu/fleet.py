@@ -70,7 +70,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from . import resilience, telemetry
+from . import resilience, telemetry, workload
 
 logger = logging.getLogger(__name__)
 
@@ -666,7 +666,9 @@ def serve_fleet_http(supervisor: FleetSupervisor,
         # -- routed forward with failover ----------------------------------
         def _route(self, method: str, key: bytes,
                    body: Optional[bytes],
-                   idempotent: bool = True) -> None:
+                   idempotent: bool = True,
+                   wl_model: Optional[str] = None,
+                   wl_records: Optional[list] = None) -> None:
             """``idempotent=False`` (deploy/rollback — they MUTATE the
             shared registry) never retries a transport failure: an
             OSError after the request was sent cannot prove the worker
@@ -682,18 +684,46 @@ def serve_fleet_http(supervisor: FleetSupervisor,
             micro-batcher's batch span all share one trace id
             (docs/observability.md "Distributed tracing"). A failover
             retry reuses the same traceparent: one request, one trace,
-            however many workers it visited."""
+            however many workers it visited.
+
+            With the workload flight recorder installed
+            (``customParams.workloadDir``), every routed :score
+            request (``wl_model`` set by ``do_POST``) leaves one
+            record carrying the ROUTING DECISION — owning worker,
+            attempt count, failover count — and the client-visible
+            outcome; the worker's own record (same trace id)
+            contributes the payload and phase decomposition, and
+            ``workload merge`` combines the two."""
             _tally("routed_requests")
+            wl_t0 = time.perf_counter()
             trace_hdr = self.headers.get(telemetry.TRACE_HEADER)
             ctx = telemetry.parse_traceparent(trace_hdr)
             if ctx is None:
                 ctx = telemetry.mint_trace()
                 trace_hdr = telemetry.format_traceparent(*ctx)
             fwd_headers = {telemetry.TRACE_HEADER: trace_hdr}
+
+            def _wl_record(status: int, worker: Optional[int],
+                           attempts: int) -> None:
+                if wl_model is None or not workload.recording_enabled():
+                    return
+                # no payload here: the worker's record (same trace id)
+                # carries it via zero-copy splice, and merge folds the
+                # two — the router's writer never serializes bodies
+                workload.record_request(
+                    model=wl_model,
+                    rows=len(wl_records or ()),
+                    trace_id=ctx[0],
+                    t_arrival=wl_t0,
+                    outcome={"status": status, "ok": status == 200},
+                    phases={"e2e": time.perf_counter() - wl_t0},
+                    route={"worker": worker, "attempts": attempts,
+                           "failovers": max(attempts - 1, 0)})
             candidates = _rendezvous(key, supervisor.ready_workers())
             if not candidates:
                 _tally("shed_503")
                 _tally("routed_failed")
+                _wl_record(503, None, 0)
                 return self._send(503, {
                     "error": "no ready worker (fleet empty or all "
                              "draining)"})
@@ -743,10 +773,12 @@ def serve_fleet_http(supervisor: FleetSupervisor,
                     last = (status, payload)
                     continue
                 h.breaker.record_success()
+                _wl_record(status, h.wid, attempts)
                 return self._send(status, None, raw=payload)
             status = last[0] if last else 503
             _tally("routed_failed")
             _tally("shed_429" if status == 429 else "shed_503")
+            _wl_record(status, None, attempts)
             self._send(status, None,
                        raw=last[1] if last else json.dumps(
                            {"error": "fleet saturated"}).encode())
@@ -859,7 +891,10 @@ def serve_fleet_http(supervisor: FleetSupervisor,
                     records = None
                 key = _route_key(name, records
                                  if isinstance(records, list) else [])
-                return self._route("POST", key, body)
+                return self._route(
+                    "POST", key, body, wl_model=name,
+                    wl_records=(records if isinstance(records, list)
+                                else None))
             # non-score POSTs (deploy/rollback) MUTATE the shared
             # registry: any ready worker serves them, but a transport
             # failure is NOT retried (idempotent=False above)

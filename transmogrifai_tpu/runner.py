@@ -654,6 +654,15 @@ class OpWorkflowRunner:
                     # (telemetry.device_cost_stats, docs/observability
                     # .md "MFU")
                     result.metrics["mfu"] = telemetry.device_cost_stats()
+                    # workload flight-recorder tallies ride on every
+                    # doc too: records written/dropped, payload
+                    # capture-vs-digest split, shard rotations, replay
+                    # and score-parity outcomes (workload.py,
+                    # docs/observability.md "Workload capture &
+                    # replay") — zeros on runs that never record
+                    from . import workload as _workload
+                    result.metrics["workload"] = \
+                        _workload.workload_stats()
                     # peak RSS (self + reaped children) rides on every
                     # doc too — the out-of-core streaming tier's memory
                     # evidence (telemetry.peak_rss_mb, docs/performance

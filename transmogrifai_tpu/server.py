@@ -59,7 +59,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from . import aot, lifecycle, resilience, telemetry
+from . import aot, lifecycle, resilience, telemetry, workload
 from .lifecycle import RegistryError
 
 logger = logging.getLogger(__name__)
@@ -221,6 +221,11 @@ class RequestResult:
     seconds: float              # queue-to-completion latency
     engine_tier: bool           # True = compiled engine, False = host
     canary: bool = False        # True = scored by a canary candidate
+    #: this request's per-phase latency decomposition (queueWait /
+    #: coalesceHold / deviceDispatch / scatter seconds — partial when a
+    #: phase was skipped); the HTTP front end surfaces it in the
+    #: response body and the workload flight recorder persists it
+    decomp: Optional[Dict[str, float]] = None
 
 
 class _Rollout:
@@ -1401,13 +1406,15 @@ class ModelServer:
         return met
 
     def _observe_decomp(self, entry: _ModelEntry, req: _Request,
-                        now: float) -> None:
+                        now: float) -> Dict[str, float]:
         """Fold one completed request's latency decomposition into the
         per-model reservoirs (always on — ``/stats``) and the per-model
         telemetry histograms (``/metrics``): queue-wait → coalesce-hold
         → device-dispatch → scatter. Requests that skipped a phase
         (host fallback, drain path) record what they measured and skip
-        the rest — a partial decomposition must never invent time."""
+        the rest — a partial decomposition must never invent time.
+        Returns the phases it measured so the completed
+        :class:`RequestResult` can carry its own decomposition."""
         phases: Dict[str, float] = {}
         if req.t_dequeued is not None:
             phases["queueWait"] = max(req.t_dequeued - req.t_enqueued,
@@ -1425,6 +1432,7 @@ class ModelServer:
             if on:
                 telemetry.histogram(  # lint: metric-name — per-tenant decomposition, bounded by the registered roster
                     entry.metric_names[ph]).observe(v)
+        return phases
 
     def _complete(self, entry: _ModelEntry, req: _Request, store,
                   bucket: int, coalesced: int, engine_tier: bool,
@@ -1435,7 +1443,7 @@ class ModelServer:
         entry.requests += 1
         entry.rows += req.rows
         entry.latencies.append(seconds)
-        self._observe_decomp(entry, req, now)
+        decomp = self._observe_decomp(entry, req, now)
         _tally("requests")
         telemetry.counter("server.requests").inc()
         telemetry.counter("server.rows_scored").inc(req.rows)
@@ -1454,7 +1462,7 @@ class ModelServer:
         req.future.set_result(RequestResult(
             store=store, rows=req.rows, bucket=bucket,
             coalesced=coalesced, seconds=seconds,
-            engine_tier=engine_tier, canary=canary))
+            engine_tier=engine_tier, canary=canary, decomp=decomp))
 
     # -- stats / shutdown --------------------------------------------------
     @property
@@ -1609,9 +1617,11 @@ def serve_http(server: ModelServer, host: str = "127.0.0.1",
         def log_message(self, fmt, *args):   # route through logging
             logger.debug("http: " + fmt, *args)
 
-        def _send(self, code: int, doc: Dict[str, Any],
-                  headers: Optional[Dict[str, str]] = None) -> None:
-            body = json.dumps(doc, default=str).encode()
+        def _send(self, code: int, doc: Optional[Dict[str, Any]],
+                  headers: Optional[Dict[str, str]] = None,
+                  raw: Optional[bytes] = None) -> None:
+            body = (raw if raw is not None
+                    else json.dumps(doc, default=str).encode())
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
@@ -1682,6 +1692,26 @@ def serve_http(server: ModelServer, host: str = "127.0.0.1",
             path = self.path
             if not path.startswith("/v1/models/"):
                 return self._send(404, {"error": f"no route {path!r}"})
+            # workload flight recorder (workload.py): every accepted
+            # :score request leaves one JSONL record — arrival offset,
+            # payload, trace id, outcome, phase decomposition — via a
+            # bounded queue + writer thread, a no-op when no recorder
+            # is installed. Failure outcomes record too (a replay must
+            # see the 4xx/5xx mix, not just the successes).
+            wl_t0 = time.perf_counter()
+            wl_rows = [0]
+            wl_trace: List[Optional[str]] = [None]
+
+            def _wl_fail(code: int, exc: BaseException) -> None:
+                if (path.endswith(":score")
+                        and workload.recording_enabled()):
+                    workload.record_request(
+                        model=path[len("/v1/models/"):-len(":score")],
+                        rows=wl_rows[0], trace_id=wl_trace[0],
+                        t_arrival=wl_t0,
+                        outcome={"status": code, "ok": False,
+                                 "error": type(exc).__name__},
+                        phases={"e2e": time.perf_counter() - wl_t0})
             try:
                 if path.endswith(":deploy"):
                     name = path[len("/v1/models/"):-len(":deploy")]
@@ -1704,7 +1734,12 @@ def serve_http(server: ModelServer, host: str = "127.0.0.1",
                 if not path.endswith(":score"):
                     return self._send(404, {"error": f"no route {path!r}"})
                 name = path[len("/v1/models/"):-len(":score")]
-                doc = self._body()
+                # the raw body is kept past the parse: the flight
+                # recorder captures it as pre-serialized bytes (zero
+                # re-serialization on the writer thread)
+                length = int(self.headers.get("Content-Length", 0))
+                raw_body = self.rfile.read(length) or b"{}"
+                doc = json.loads(raw_body)
                 records = doc.get("records")
                 if not isinstance(records, list) or not records:
                     return self._send(400, {
@@ -1718,12 +1753,14 @@ def serve_http(server: ModelServer, host: str = "127.0.0.1",
                 # rides into the micro-batcher via submit(trace=) so
                 # the batch span can link it, and echoes back to the
                 # client in the response header.
+                wl_rows[0] = len(records)
                 ctx = telemetry.parse_traceparent(
                     self.headers.get(telemetry.TRACE_HEADER))
                 if ctx is None and telemetry.enabled():
                     ctx = telemetry.mint_trace()
                 trace_hdr = (telemetry.format_traceparent(*ctx)
                              if ctx else None)
+                wl_trace[0] = ctx[0] if ctx else None
                 with telemetry.trace_scope(ctx):
                     with telemetry.span("server:request", model=name,
                                         rows=len(records)) as rsp:
@@ -1751,33 +1788,71 @@ def serve_http(server: ModelServer, host: str = "127.0.0.1",
                             if not f.cancelled():
                                 f.exception()
                         fut.add_done_callback(_late)
+                    if workload.recording_enabled():
+                        workload.record_request(
+                            model=name, rows=len(records),
+                            payload_json=raw_body,
+                            trace_id=wl_trace[0],
+                            t_arrival=wl_t0,
+                            outcome={"status": 504, "ok": False,
+                                     "error": "timeout"},
+                            phases={"e2e": time.perf_counter()
+                                    - wl_t0})
                     return self._send(504, {
                         "error": f"timed out after "
                                  f"{request_timeout_s:g}s",
                         "model": name, "rows": len(records)})
             except ModelNotFound as e:
+                _wl_fail(404, e)
                 return self._send(404, {"error": str(e)})
             except (RolloutError, RegistryError, TypeError,
                     ValueError) as e:
+                _wl_fail(400, e)
                 if isinstance(e, json.JSONDecodeError):
                     return self._send(400,
                                       {"error": f"bad JSON body: {e}"})
                 return self._send(400, {"error": str(e)})
             except ServerBusy as e:
+                _wl_fail(429, e)
                 return self._send(429, {"error": str(e)})
             except ServerClosed as e:
+                _wl_fail(503, e)
                 return self._send(503, {"error": str(e)})
             except Exception as e:  # lint: broad-except — HTTP boundary: a poison request answers 500, the server lives
+                _wl_fail(500, e)
                 return self._send(500, {"error": repr(e)})
-            return self._send(200, {
+            # the response body carries this request's phase
+            # decomposition — the replay harness reads it to emit the
+            # paired per-phase summary, and it rides the router's raw
+            # payload passthrough unchanged (docs/observability.md
+            # "Workload capture & replay")
+            phases = {k: round(v, 6)
+                      for k, v in (res.decomp or {}).items()}
+            phases["e2e"] = round(res.seconds, 6)
+            outputs = _store_rows(res.store)
+            resp_body = json.dumps({
                 "model": name, "rows": res.rows, "bucket": res.bucket,
                 "coalesced": res.coalesced,
                 "latencyMs": round(res.seconds * 1e3, 3),
                 "engineTier": res.engine_tier,
                 "canary": res.canary,
-                "outputs": _store_rows(res.store)},
-                headers=({telemetry.TRACE_HEADER: trace_hdr}
-                         if trace_hdr else None))
+                "phases": phases,
+                "outputs": outputs}, default=str).encode()
+            if workload.recording_enabled():
+                # zero-copy capture: both bodies were serialized on
+                # this request anyway (by the client and by the line
+                # above) — the recorder splices the bytes, so the
+                # marginal cost is one bounded-queue put
+                workload.record_request(
+                    model=name, rows=res.rows,
+                    payload_json=raw_body, response_json=resp_body,
+                    trace_id=wl_trace[0], t_arrival=wl_t0,
+                    outcome={"status": 200, "ok": True},
+                    phases=phases)
+            return self._send(200, None, raw=resp_body,
+                              headers=({telemetry.TRACE_HEADER:
+                                        trace_hdr}
+                                       if trace_hdr else None))
 
     httpd = ThreadingHTTPServer((host, port), Handler)
     httpd.daemon_threads = True
