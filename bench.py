@@ -252,6 +252,14 @@ class Bench:
                 self.doc["workload"] = workload.workload_stats()
             except Exception:
                 self.doc.setdefault("workload", None)
+            # offline-autotuner tallies (searches, replay legs, parity
+            # rejections, incumbent improvements) ride on EVERY doc —
+            # the self-tuning tier's evidence (tuner.py, docs/tuning.md)
+            try:
+                from transmogrifai_tpu import tuner
+                self.doc["tuner"] = tuner.tuner_stats()
+            except Exception:
+                self.doc.setdefault("tuner", None)
             # peak RSS (self + reaped children) rides on EVERY doc —
             # the out-of-core tier's memory evidence: streamed fits must
             # show a bounded high-water mark where materialized fits
@@ -1798,6 +1806,190 @@ def _workload_replay() -> dict:
     return out
 
 
+def _autotune() -> dict:
+    """Self-tuning runtime benchmark (tuner.py + the server's online
+    deadline controller, docs/tuning.md), two phases:
+
+    1. **Offline tune** — record a paced workload against a
+       default-config server, then run the coordinate-descent
+       autotuner over ``serveBatchDeadlineMs`` + ``pipelineWorkers``
+       under a small budget:
+       per candidate the tuner boots a fresh server and re-drives the
+       recording through the replay harness. Pass: the emitted config
+       never loses to the baseline and EVERY ranked leg held score
+       parity (the tuner's hard gate, asserted here from the report).
+    2. **Online adaptation** — the same model served with
+       ``adaptDeadline`` on, driven through a shifted arrival process
+       (paced-sparse then closed-loop bursts) past several adaptation
+       windows. Pass: the controller closed windows, any adapted
+       deadline stayed inside the registry's declared tune bounds,
+       and every request was answered (zero failures).
+    """
+    import http.client
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from transmogrifai_tpu import FeatureBuilder, Workflow, config
+    from transmogrifai_tpu import server as server_mod
+    from transmogrifai_tpu import tuner as tuner_mod
+    from transmogrifai_tpu import workload as workload_mod
+    from transmogrifai_tpu.cli import build_server_from_params
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import \
+        BinaryClassificationModelSelector
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.runner import OpParams
+
+    rng = np.random.default_rng(31)
+    rows = 2000
+    y = rng.integers(0, 2, rows).astype(float)
+    records = [{"label": float(y[i]),
+                "x1": float(rng.normal() + 0.8 * y[i]),
+                "x2": float(rng.normal())} for i in range(rows)]
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x1").from_column().as_predictor()
+    f2 = FeatureBuilder.Real("x2").from_column().as_predictor()
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily(
+            grid=[{"regParam": 0.01, "elasticNetParam": 0.0}])],
+        splitter=None, seed=31)
+    pred = label.transform_with(sel, transmogrify([f1, f2]))
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    model._engine_breaker().reset()
+
+    work = tempfile.mkdtemp(prefix="tmog_autotune_bench_")
+    out: dict = {}
+    try:
+        mdir = os.path.join(work, "model")
+        model.save(mdir)
+        pf = os.path.join(work, "params.json")
+        with open(pf, "w") as fh:
+            json.dump({"modelLocation": mdir,
+                       "customParams": {"serveBatchDeadlineMs": 2.0,
+                                        "serveBucketCap": 256}}, fh)
+
+        def pump(port: int, n: int, batch: int = 16,
+                 pace_s: float = 0.0) -> int:
+            sent = 0
+            for i in range(n):
+                lo = (i * batch) % (rows - batch)
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                try:
+                    conn.request(
+                        "POST", "/v1/models/default:score",
+                        json.dumps({"records": records[lo:lo + batch]}),
+                        {"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    assert resp.status == 200, resp.status
+                finally:
+                    conn.close()
+                sent += 1
+                if pace_s:
+                    time.sleep(pace_s)
+            return sent
+
+        # -- phase 1: record, then tune offline ----------------------
+        srv = build_server_from_params(OpParams.from_file(pf))
+        httpd = server_mod.serve_http(srv, port=0)
+        wdir = os.path.join(work, "workload")
+        workload_mod.start_recorder(wdir, role="bench-tune")
+        try:
+            pump(httpd.server_address[1],
+                 int(os.environ.get("BENCH_TUNE_RECORD_REQUESTS", 48)),
+                 pace_s=0.005)
+        finally:
+            workload_mod.stop_recorder()
+            httpd.shutdown()
+            srv.shutdown(drain=True)
+            for e in srv._entries.values():
+                if e.model is not None:
+                    e.model._engine_breaker().reset()
+        merged = workload_mod.merge_workload_shards(wdir)
+        budget_s = float(os.environ.get("BENCH_TUNE_BUDGET_S", 60.0))
+        tuned = tuner_mod.tune(pf, merged, objective="p99",
+                               budget_s=budget_s,
+                               knobs=["serveBatchDeadlineMs",
+                                      "pipelineWorkers"],
+                               speed=20.0)
+        rep = tuned["report"]
+        ranked = [l for l in rep["legs"] if l.get("rejected") is None]
+        tune_parity_ok = all(l["parityFailures"] == 0 for l in ranked)
+        tune_ok = bool(rep["winnerScore"] <= rep["baselineScore"]
+                       and tune_parity_ok and len(ranked) >= 2
+                       and not config.check_custom_params(
+                           tuned["tunedParams"]["customParams"]))
+        out["tune"] = {
+            "objective": rep["objective"],
+            "baseline_p99_ms": rep["baselineScore"],
+            "winner_p99_ms": rep["winnerScore"],
+            "improvement": rep["improvement"],
+            "winner": rep["winner"],
+            "legs_ranked": len(ranked),
+            "legs_total": len(rep["legs"]),
+            "budget_expired": rep["budgetExpired"],
+            "parity_ok": tune_parity_ok,
+        }
+
+        # -- phase 2: online deadline adaptation ---------------------
+        with open(pf, "w") as fh:
+            json.dump({"modelLocation": mdir,
+                       "customParams": {"serveBatchDeadlineMs": 2.0,
+                                        "serveBucketCap": 256,
+                                        "adaptDeadline": True}}, fh)
+        srv = build_server_from_params(OpParams.from_file(pf))
+        httpd = server_mod.serve_http(srv, port=0)
+        before = {k: v for k, v in server_mod.server_stats().items()
+                  if k.startswith("deadline_")}
+        try:
+            # shifted arrival process across several adaptation
+            # windows: paced-sparse first (coalesce hold dominates the
+            # split), then closed-loop bursts (queue wait grows)
+            n_win = server_mod.ADAPT_WINDOW_REQUESTS
+            pump(httpd.server_address[1], 2 * n_win + 8, batch=8,
+                 pace_s=0.004)
+            pump(httpd.server_address[1], 2 * n_win + 8, batch=8)
+            entry = srv._entries["default"]
+            lo_ms, hi_ms = config.knob_bounds("serveBatchDeadlineMs")
+            adapted_ms = (None if entry.deadline_s is None
+                          else entry.deadline_s * 1e3)
+            in_bounds = (adapted_ms is None
+                         or lo_ms <= adapted_ms <= hi_ms)
+            failures = entry.failures
+        finally:
+            httpd.shutdown()
+            srv.shutdown(drain=True)
+            for e in srv._entries.values():
+                if e.model is not None:
+                    e.model._engine_breaker().reset()
+        after = {k: v for k, v in server_mod.server_stats().items()
+                 if k.startswith("deadline_")}
+        delta = {k: after[k] - before.get(k, 0) for k in after}
+        adapt_ok = bool(delta["deadline_adapt_windows"] > 0
+                        and in_bounds and failures == 0)
+        out["adaptation"] = {
+            "windows": delta["deadline_adapt_windows"],
+            "increases": delta["deadline_increases"],
+            "decreases": delta["deadline_decreases"],
+            "holds": delta["deadline_holds"],
+            "clamped": delta["deadline_clamped"],
+            "advisories": delta["deadline_advisories"],
+            "adapted_deadline_ms": (None if adapted_ms is None
+                                    else round(adapted_ms, 4)),
+            "bounds_ms": [lo_ms, hi_ms],
+            "in_bounds": in_bounds,
+            "failed_requests": failures,
+        }
+        out["pass"] = bool(tune_ok and adapt_ok)
+        return out
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def _drift_canary() -> dict:
     """Model lifecycle benchmark (registry + drift sentinel + canary
     rollout, lifecycle.py / docs/lifecycle.md):
@@ -3019,6 +3211,27 @@ def main() -> None:
         except Exception as e:
             _log(f"[bench] workload_replay failed: {e!r}")
             configs["workload_replay"] = {"error": repr(e)[:400]}
+    bench.emit()
+
+    # 4b2d. Self-tuning runtime (the declared-knob autotuner gate):
+    #      record a workload, coordinate-descent tune two knobs offline
+    #      (tuned config must not lose to
+    #      the default, parity on every ranked leg), then drive the
+    #      online deadline controller through a shifted arrival process
+    #      (windows close, bounds hold, zero failures).
+    #      Budget-gated: boots a server per tuner candidate.
+    if bench.remaining() < 180:
+        configs["autotune"] = {
+            "status": "skipped_budget",
+            "remaining_budget_s": round(bench.remaining(), 1)}
+        _log(f"[bench] autotune skipped: remaining "
+             f"{bench.remaining():.0f}s < 180s")
+    else:
+        try:
+            configs["autotune"] = _autotune()
+        except Exception as e:
+            _log(f"[bench] autotune failed: {e!r}")
+            configs["autotune"] = {"error": repr(e)[:400]}
     bench.emit()
 
     # 4b3. Model lifecycle (the registry + drift sentinel + canary

@@ -1,0 +1,190 @@
+"""Declared-config registry tests (PR 18 tentpole a, config.py).
+
+The contract: every customParams knob the runtime reads is DECLARED
+once — name, type, default, bounds/choices, owner, tunability,
+validator — and `cli gen`, `cli check`, the runner accessors and the
+tuner's search space all derive from that one registry, so a knob
+cannot drift between its emitter, its validator and its reader.
+"""
+import json
+
+import pytest
+
+from transmogrifai_tpu import config
+
+
+# ---------------------------------------------------------------------------
+# registry shape
+# ---------------------------------------------------------------------------
+
+
+def test_registry_declares_the_whole_surface():
+    names = {k.name for k in config.iter_knobs()}
+    assert len(names) >= 40
+    # one knob per subsystem spot-checked: runner, pipeline, serving,
+    # continual, fleet, observability, tuning
+    for expected in ("validate", "pipelineWorkers", "serveBatchDeadlineMs",
+                     "retrainCmd", "fleetWorkers", "traceDir",
+                     "adaptDeadline", "costDb", "batchSize"):
+        assert expected in names, expected
+    for k in config.iter_knobs():
+        assert k.owner, k.name
+        assert k.doc, k.name
+        assert k.type in ("int", "float", "bool", "str", "enum",
+                          "dict", "list"), k.name
+
+
+def test_knob_lookup_and_duplicate_rejection():
+    k = config.knob("serveBatchDeadlineMs")
+    assert k.type == "float" and k.tunable
+    with pytest.raises(KeyError):
+        config.knob("noSuchKnob")
+    with pytest.raises(ValueError, match="duplicate knob"):
+        config._declare("validate", "bool", True, "runner", "dup")
+    # the failed redeclaration must not have clobbered the original
+    assert config.knob("validate").owner
+
+
+def test_tunable_knobs_carry_finite_bounds():
+    tunables = {k.name for k in config.tunable_knobs()}
+    assert "serveBatchDeadlineMs" in tunables
+    assert "pipelineWorkers" in tunables
+    assert "batchSize" in tunables
+    for k in config.tunable_knobs():
+        lo, hi = config.knob_bounds(k.name)
+        assert lo < hi, k.name
+        assert hi != float("inf"), (
+            f"{k.name}: a tunable knob needs a finite search ceiling")
+
+
+# ---------------------------------------------------------------------------
+# coercion: the TMG001 error-message contract
+# ---------------------------------------------------------------------------
+
+
+def test_coerce_numeric_error_contract():
+    assert config.coerce_numeric("8", "x", int) == 8
+    assert config.coerce_numeric(2.5, "x", float) == 2.5
+    with pytest.raises(ValueError,
+                       match=r"customParams.x must be an integer, got "):
+        config.coerce_numeric(2.5, "x", int)
+    with pytest.raises(ValueError,
+                       match=r"customParams.x must be a number, got "):
+        config.coerce_numeric("soon", "x", float)
+    with pytest.raises(ValueError,
+                       match=r"customParams.x must be >= 1, got "):
+        config.coerce_numeric(0, "x", int, minimum=1)
+    with pytest.raises(ValueError, match="must be a number"):
+        config.coerce_numeric(float("nan"), "x", float)
+
+
+def test_coerce_bool_error_contract():
+    assert config.coerce_bool(True, "x") is True
+    assert config.coerce_bool("false", "x") is False
+    assert config.coerce_bool("auto", "x", allow_auto=True) == "auto"
+    with pytest.raises(ValueError,
+                       match=r"must be a boolean \(true/false\), got "):
+        config.coerce_bool("yes", "x")
+    with pytest.raises(ValueError, match='or "auto"'):
+        config.coerce_bool("maybe", "x", allow_auto=True)
+
+
+# ---------------------------------------------------------------------------
+# check_custom_params: one finding per bad knob, validators included
+# ---------------------------------------------------------------------------
+
+
+def test_check_custom_params_one_finding_per_bad_knob():
+    errors = config.check_custom_params({
+        "retrainCooldownS": "soon",          # numeric type error
+        "retrainOnDrift": "yes",             # bool type error
+        "canaryFraction": 1.5,               # validator (0, 1]
+        "onBatchError": "explode",           # enum
+        "serveModels": "notadict",           # dict
+        "batchSize": 0,                      # minimum
+    })
+    by_key = {}
+    for key, msg in errors:
+        by_key.setdefault(key, []).append(msg)
+        assert f"customParams.{key}" in msg or key in msg, (key, msg)
+    assert sorted(by_key) == ["batchSize", "canaryFraction",
+                              "onBatchError", "retrainCooldownS",
+                              "retrainOnDrift", "serveModels"]
+    # ONE finding per knob: a type error must not also fire the
+    # validator (test_continual counts TMG001s exactly)
+    assert all(len(v) == 1 for v in by_key.values()), by_key
+
+
+def test_check_custom_params_accepts_valid_and_unknown():
+    assert config.check_custom_params({}) == []
+    assert config.check_custom_params({
+        "batchSize": 512, "overlap": "auto", "failOn": "warning",
+        "lintSuppress": "TMG301",            # bare string allowed
+        "retrainCmd": ["python", "retrain.py"],
+        "serveBatchDeadlineMs": 0,
+        "someFutureKnob": object()}) == []   # undeclared: not checked
+
+
+def test_check_custom_params_string_retrain_cmd_reaches_validator():
+    # a bare-string retrainCmd passes the list type gate so the
+    # continual validator owns the (single) finding
+    errors = config.check_custom_params({"retrainCmd": "not-a-list"})
+    assert len(errors) == 1 and errors[0][0] == "retrainCmd"
+
+
+# ---------------------------------------------------------------------------
+# gen emission + effective config
+# ---------------------------------------------------------------------------
+
+
+def test_default_custom_params_covers_scaffold_but_not_expert_knobs():
+    cp = config.default_custom_params()
+    for key in ("validate", "plan", "costDb", "registryDir",
+                "driftWindow", "traceDir", "workloadDir"):
+        assert key in cp, key
+    # expert/serving knobs stay out of the scaffold (the gen'd file is
+    # a starting point, not the full surface)
+    for key in ("serveBatchDeadlineMs", "adaptDeadline", "batchSize"):
+        assert key not in cp, key
+    json.dumps(cp)                            # emission must be JSON
+
+
+def test_effective_config_resolves_and_stamps_invalid():
+    eff = config.effective_config({"batchSize": 512,
+                                   "retrainCooldownS": "soon"})
+    assert eff["batchSize"] == 512
+    assert eff["validate"] is True            # default resolved
+    assert eff["retrainCooldownS"] == {"invalid": "'soon'"}
+    json.dumps(eff)
+
+
+# ---------------------------------------------------------------------------
+# round-trip: gen -> check clean (the satellite regression)
+# ---------------------------------------------------------------------------
+
+
+def test_registry_round_trip_gen_then_check_clean(tmp_path, capsys):
+    from transmogrifai_tpu.cli import generate_project, run_check
+    csv = tmp_path / "data.csv"
+    csv.write_text("label,x\n1,0.5\n0,0.1\n1,0.9\n0,0.2\n")
+    files = generate_project(str(csv), "label", str(tmp_path / "proj"))
+    params = json.load(open(files["params.json"]))
+    # every emitted knob is a declared one with its declared default
+    for key, val in params["customParams"].items():
+        assert config.knob(key).default == val, key
+    assert run_check(files["params.json"]) == 0
+    out = capsys.readouterr().out
+    assert "TMG001" not in out
+
+
+def test_check_catches_every_declared_knob_not_just_scaffold(tmp_path,
+                                                             capsys):
+    # a knob OUTSIDE the gen scaffold still validates through the same
+    # registry path — the pre-registry code had per-knob ad-hoc checks
+    # that silently missed new knobs
+    p = tmp_path / "params.json"
+    p.write_text(json.dumps({"customParams": {"adaptDeadline": "yes"}}))
+    from transmogrifai_tpu.cli import run_check
+    assert run_check(str(p)) == 1
+    out = capsys.readouterr().out
+    assert "TMG001" in out and "adaptDeadline" in out

@@ -9,7 +9,11 @@ importing heavy modules:
    renamed or removed subcommand must fail CI, not a reader;
 2. every always-on `*_stats()` family that `bench.py` stamps onto its
    result docs has a catalog row in docs/observability.md — bench
-   evidence nobody can look up is not evidence.
+   evidence nobody can look up is not evidence;
+3. the declared knob registry (config.py) and the consolidated knob
+   table in docs/tuning.md name exactly the same knobs — a knob
+   declared but undocumented (or documented but undeclared) fails CI
+   (PR 18).
 """
 import glob
 import os
@@ -67,3 +71,25 @@ def test_bench_stamped_stats_families_have_catalog_rows():
         f"bench.py stamps these always-on stats families but "
         f"docs/observability.md has no catalog row naming them: "
         f"{missing}")
+
+
+def test_registry_knobs_match_docs_knob_table():
+    cfg_src = _read(os.path.join(_REPO, "transmogrifai_tpu",
+                                 "config.py"))
+    declared = set(re.findall(r'_declare\(\s*\n?\s*"(\w+)"', cfg_src))
+    assert len(declared) >= 40, (
+        f"knob declarations not found by the pattern — did the "
+        f"_declare idiom change? matched: {sorted(declared)}")
+    doc = _read(os.path.join(_REPO, "docs", "tuning.md"))
+    m = re.search(r"<!-- KNOB TABLE START -->(.*?)<!-- KNOB TABLE"
+                  r" END -->", doc, re.S)
+    assert m, "docs/tuning.md lost its KNOB TABLE markers"
+    documented = set(re.findall(r"^\|\s*`(\w+)`", m.group(1), re.M))
+    undocumented = sorted(declared - documented)
+    undeclared = sorted(documented - declared)
+    assert not undocumented, (
+        f"config.py declares knobs missing from the docs/tuning.md "
+        f"table: {undocumented}")
+    assert not undeclared, (
+        f"docs/tuning.md documents knobs config.py does not declare: "
+        f"{undeclared}")

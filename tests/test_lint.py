@@ -762,6 +762,48 @@ def test_tmg310_thread_loop_must_catch():
     assert tm.lint_source(allowed_def) == []
 
 
+def test_tmg314_raw_custom_params_reads():
+    tm = _load_tmoglint()
+    # subscript read + .get() read both flagged, whatever the receiver
+    bad_sub = "v = params.custom_params['batchSize']\n"
+    assert [f.rule for f in tm.lint_source(
+        bad_sub, "transmogrifai_tpu/mod.py")] == ["TMG314"]
+    bad_get = "v = params.custom_params.get('batchSize', 1024)\n"
+    assert [f.rule for f in tm.lint_source(
+        bad_get, "transmogrifai_tpu/mod.py")] == ["TMG314"]
+    bad_name = "v = customParams.get('plan')\n"
+    assert [f.rule for f in tm.lint_source(
+        bad_name, "transmogrifai_tpu/mod.py")] == ["TMG314"]
+    # WRITES are legitimate assembly (the CLI builds params dicts)
+    write = "params.custom_params['costDb'] = path\n"
+    assert tm.lint_source(write, "transmogrifai_tpu/mod.py") == []
+    delete = "del params.custom_params['costDb']\n"
+    assert tm.lint_source(delete, "transmogrifai_tpu/mod.py") == []
+    # the marker sanctions a deliberate passthrough — on the read's
+    # first line or (wrapped call) its last
+    marked = ("v = params.custom_params.get('costDb')"
+              "  # lint: knob — path passthrough\n")
+    assert tm.lint_source(marked, "transmogrifai_tpu/mod.py") == []
+    wrapped = ("v = params.custom_params.get(  # lint: knob — wrapped\n"
+               "    'costDb')\n")
+    assert tm.lint_source(wrapped, "transmogrifai_tpu/mod.py") == []
+    # config.py owns the surface; tests poke raw dicts freely
+    home = "v = custom_params.get('plan')\n"
+    assert tm.lint_source(home, "transmogrifai_tpu/config.py") == []
+    assert tm.lint_source(bad_get, "tests/test_x.py") == []
+    # an unrelated mapping is out of scope
+    other = "v = options.get('batchSize')\n"
+    assert tm.lint_source(other, "transmogrifai_tpu/mod.py") == []
+
+
+def test_tmg314_in_rules_catalog():
+    from transmogrifai_tpu import lint
+    assert "TMG314" in lint.RULES
+    assert lint.RULES["TMG314"][0] == lint.Severity.ERROR
+    assert "TMG406" in lint.RULES
+    assert lint.RULES["TMG406"][0] == lint.Severity.WARNING
+
+
 def test_repo_is_clean_under_self_lint():
     """The meta-test: the package itself reports zero findings — the
     project invariants PRs 1-4 introduced by convention are now CI

@@ -1,0 +1,494 @@
+"""Self-tuning runtime tests (PR 18): the offline trace-replay
+autotuner (tuner.py) and the online batch-deadline AIMD controller
+(server.py `_adapt_deadline`), plus the score-run cost-db drain the
+tuner's priors feed on.
+
+The offline search is tested against a DETERMINISTIC fake replay leg
+(monkeypatched `_boot_and_replay`) so the coordinate-descent
+mechanics — parity gating, bounds clamping, incumbent replacement,
+byte-stable reporting — are asserted exactly; the live
+boot-replay-score loop is exercised end-to-end by the slow-marked
+round-trip test and the `autotune` bench config.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import config
+from transmogrifai_tpu import server as server_mod
+from transmogrifai_tpu import tuner as tuner_mod
+from transmogrifai_tpu.server import ModelServer, _ModelEntry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tuner_stats():
+    tuner_mod.reset_tuner_stats()
+    yield
+
+
+# ---------------------------------------------------------------------------
+# objective + probe mechanics
+# ---------------------------------------------------------------------------
+
+
+def _fake_replay(p99_ms, rows=64, duration_s=1.0, parity_failures=0,
+                 failed=0):
+    return {"sent": 8, "failed": failed, "lateSends": 0,
+            "skippedNoPayload": 0, "truncated": 0, "requests": 8,
+            "parityChecked": 8, "parityFailures": parity_failures,
+            "parityMaxAbsDelta": 0.0, "durationS": duration_s,
+            "client": {"e2e": {"n": 8, "p50Ms": p99_ms / 2,
+                               "p95Ms": p99_ms, "p99Ms": p99_ms}},
+            "models": {"m": {"rows": rows}}}
+
+
+def test_objective_score_p99_and_throughput():
+    r = _fake_replay(12.5, rows=100, duration_s=2.0)
+    assert tuner_mod._objective_score(r, "p99") == 12.5
+    # throughput negated so the search minimizes uniformly
+    assert tuner_mod._objective_score(r, "throughput") == -50.0
+    assert tuner_mod._objective_score({"client": {}, "models": {}},
+                                      "p99") is None
+
+
+def test_probe_values_stay_inside_declared_bounds():
+    k = config.knob("serveBatchDeadlineMs")
+    lo, hi = config.knob_bounds("serveBatchDeadlineMs")
+    for cur in (0.0, 2.0, 49.0, hi):
+        for v in tuner_mod._probe_values(k, cur):
+            assert lo <= v <= hi, (cur, v)
+    kw = config.knob("pipelineWorkers")
+    wlo, whi = config.knob_bounds("pipelineWorkers")
+    for v in tuner_mod._probe_values(kw, 2):
+        assert isinstance(v, int) and wlo <= v <= whi, v
+
+
+def _workload_file(tmp_path, n=4):
+    doc = {"records": [
+        {"tS": i * 0.01, "model": "m", "rows": 2,
+         "payload": [{"x": 1.0}, {"x": 2.0}]} for i in range(n)]}
+    p = tmp_path / "wl.json"
+    p.write_text(json.dumps(doc))
+    return str(p), doc
+
+
+def _params_file(tmp_path, **custom):
+    p = tmp_path / "params.json"
+    p.write_text(json.dumps({"customParams": custom}))
+    return str(p)
+
+
+# ---------------------------------------------------------------------------
+# the parity GATE: broken numerics are rejected, never ranked
+# ---------------------------------------------------------------------------
+
+
+def test_tune_refuses_parity_broken_baseline(tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        tuner_mod, "_boot_and_replay",
+        lambda *a, **kw: _fake_replay(5.0, parity_failures=1))
+    _wl_path, doc = _workload_file(tmp_path)
+    with pytest.raises(tuner_mod.TunerError, match="baseline"):
+        tuner_mod.tune(_params_file(tmp_path), doc,
+                       knobs=["serveBatchDeadlineMs"], budget_s=5.0)
+    assert tuner_mod.tuner_stats()["candidates_rejected_parity"] == 1
+
+
+def test_tune_rejects_parity_breaking_candidate_not_ranked(
+        tmp_path, monkeypatch):
+    def fake(params_doc, workload_doc, **kw):
+        dl = (params_doc.get("customParams") or {}).get(
+            "serveBatchDeadlineMs", 8.0)
+        if dl is not None and float(dl) < 1.0:
+            # "fastest" leg by far — but it broke the numerics
+            return _fake_replay(0.1, parity_failures=3)
+        return _fake_replay(10.0 + float(dl))
+    monkeypatch.setattr(tuner_mod, "_boot_and_replay", fake)
+    _wl, doc = _workload_file(tmp_path)
+    out = tuner_mod.tune(
+        _params_file(tmp_path, serveBatchDeadlineMs=8.0), doc,
+        knobs=["serveBatchDeadlineMs"], budget_s=30.0)
+    rep = out["report"]
+    winner_dl = rep["winner"].get("serveBatchDeadlineMs")
+    assert winner_dl is None or winner_dl >= 1.0
+    rejected = [leg for leg in rep["legs"]
+                if leg.get("rejected") == "score parity"]
+    assert rejected, "the parity-breaking legs must be visible"
+    # none of the rejected configs became the winner despite their
+    # "fastest" measured score
+    for leg in rejected:
+        assert leg["values"] != rep["winner"]
+    assert tuner_mod.tuner_stats()["candidates_rejected_parity"] >= 1
+
+
+def test_tune_descends_to_better_deadline_and_report_is_byte_stable(
+        tmp_path, monkeypatch):
+    def fake(params_doc, workload_doc, **kw):
+        dl = (params_doc.get("customParams") or {}).get(
+            "serveBatchDeadlineMs", 8.0)
+        # deterministic objective valley at the declared lower bound
+        return _fake_replay(5.0 + float(dl))
+    monkeypatch.setattr(tuner_mod, "_boot_and_replay", fake)
+    _wl, doc = _workload_file(tmp_path)
+    pf = _params_file(tmp_path, serveBatchDeadlineMs=8.0)
+    out1 = tuner_mod.tune(pf, doc, knobs=["serveBatchDeadlineMs"],
+                          budget_s=30.0)
+    out2 = tuner_mod.tune(pf, doc, knobs=["serveBatchDeadlineMs"],
+                          budget_s=30.0)
+    rep = out1["report"]
+    assert rep["winner"]["serveBatchDeadlineMs"] == 0.0
+    assert rep["winnerScore"] < rep["baselineScore"]
+    assert out1["tunedParams"]["customParams"][
+        "serveBatchDeadlineMs"] == 0.0
+    # the untouched knobs of the params file survive the overlay
+    assert config.check_custom_params(
+        out1["tunedParams"]["customParams"]) == []
+    # byte-stable: identical measurements -> identical report bytes
+    assert json.dumps(out1["report"], sort_keys=True) == \
+        json.dumps(out2["report"], sort_keys=True)
+    assert rep["digest"].startswith("blake2b:")
+    st = tuner_mod.tuner_stats()
+    assert st["searches"] == 2 and st["candidates_improved"] >= 2
+    assert st["legs_replayed"] == rep["legsMeasured"] * 2
+
+
+def test_tune_keeps_baseline_when_nothing_beats_it(tmp_path,
+                                                   monkeypatch):
+    monkeypatch.setattr(tuner_mod, "_boot_and_replay",
+                        lambda *a, **kw: _fake_replay(10.0))
+    _wl, doc = _workload_file(tmp_path)
+    out = tuner_mod.tune(_params_file(tmp_path, serveBatchDeadlineMs=2),
+                         doc, knobs=["serveBatchDeadlineMs"],
+                         budget_s=30.0)
+    assert out["report"]["winner"] == {}
+    assert out["tunedParams"]["customParams"][
+        "serveBatchDeadlineMs"] == 2
+
+
+def test_tune_validates_inputs(tmp_path):
+    _wl, doc = _workload_file(tmp_path)
+    with pytest.raises(tuner_mod.TunerError, match="objective"):
+        tuner_mod.tune(_params_file(tmp_path), doc, objective="p42")
+    with pytest.raises(tuner_mod.TunerError, match="not tunable"):
+        tuner_mod.tune(_params_file(tmp_path), doc,
+                       knobs=["validate"])
+    bad = _params_file(tmp_path, serveBatchDeadlineMs="soon")
+    with pytest.raises(tuner_mod.TunerError, match="baseline params"):
+        tuner_mod.tune(bad, doc)
+
+
+def test_run_tune_writes_validated_tuned_params_and_report(
+        tmp_path, monkeypatch, capsys):
+    def fake(params_doc, workload_doc, **kw):
+        dl = (params_doc.get("customParams") or {}).get(
+            "serveBatchDeadlineMs", 4.0)
+        return _fake_replay(5.0 + float(dl))
+    monkeypatch.setattr(tuner_mod, "_boot_and_replay", fake)
+    wl_path, _doc = _workload_file(tmp_path)
+    pf = _params_file(tmp_path, serveBatchDeadlineMs=4.0)
+    rc = tuner_mod.run_tune(pf, wl_path, budget_s=30.0,
+                            knobs="serveBatchDeadlineMs")
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "tuned params ->" in out and "report ->" in out
+    tuned_path = os.path.splitext(pf)[0] + ".tuned.json"
+    tuned = json.load(open(tuned_path))
+    assert config.check_custom_params(tuned["customParams"]) == []
+    rep = json.load(open(os.path.splitext(tuned_path)[0]
+                         + ".tuning-report.json"))
+    assert rep["legsMeasured"] == len(rep["legs"])
+    assert rep["searchedKnobs"] == ["serveBatchDeadlineMs"]
+    assert rep["bounds"]["serveBatchDeadlineMs"] == [0.0, 50.0]
+
+
+def test_run_tune_missing_workload_is_exit_1(tmp_path, capsys):
+    rc = tuner_mod.run_tune(_params_file(tmp_path),
+                            str(tmp_path / "nope.json"))
+    assert rc == 1
+    assert "cannot load workload" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# online adaptation: the bounded AIMD controller
+# ---------------------------------------------------------------------------
+
+
+def _entry_with_window(srv, qw_s, ch_s, n=None):
+    n = n or server_mod.ADAPT_WINDOW_REQUESTS
+    entry = _ModelEntry("t", None, None, None, srv.max_queue)
+    entry.requests = n
+    for _ in range(n):
+        entry.decomp["queueWait"].append(qw_s)
+        entry.decomp["coalesceHold"].append(ch_s)
+    return entry
+
+
+def test_adapt_decreases_when_queue_wait_dominates():
+    srv = ModelServer(batch_deadline_s=0.004, adapt_deadline=True)
+    try:
+        entry = _entry_with_window(srv, qw_s=0.010, ch_s=0.001)
+        srv._adapt_deadline(entry)
+        assert entry.deadline_s == pytest.approx(
+            0.004 * server_mod.ADAPT_MD_FACTOR)
+        assert entry.adapt_decreases == 1
+        # hysteresis: the same window does not re-fire
+        srv._adapt_deadline(entry)
+        assert entry.adapt_decreases == 1
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_adapt_increases_when_coalesce_hold_dominates():
+    srv = ModelServer(batch_deadline_s=0.004, adapt_deadline=True)
+    try:
+        entry = _entry_with_window(srv, qw_s=0.0001, ch_s=0.004)
+        srv._adapt_deadline(entry)
+        assert entry.deadline_s == pytest.approx(
+            0.004 + server_mod.ADAPT_STEP_S)
+        assert entry.adapt_increases == 1
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_adapt_never_leaves_registry_bounds():
+    lo, hi = config.knob_bounds("serveBatchDeadlineMs")
+    srv = ModelServer(batch_deadline_s=hi / 1e3, adapt_deadline=True)
+    try:
+        # increase pressure at the ceiling: clamped, no move
+        entry = _entry_with_window(srv, qw_s=0.0001, ch_s=0.02)
+        srv._adapt_deadline(entry)
+        assert entry.deadline_s is None or entry.deadline_s <= hi / 1e3
+        assert entry.adapt_clamped == 1
+        # decrease pressure at the floor: clamped at lo, never below
+        srv2 = ModelServer(batch_deadline_s=lo / 1e3 if lo else 0.0,
+                           adapt_deadline=True)
+        try:
+            e2 = _entry_with_window(srv2, qw_s=0.02, ch_s=0.0001)
+            srv2._adapt_deadline(e2)
+            assert e2.deadline_s is None or e2.deadline_s >= lo / 1e3
+        finally:
+            srv2.shutdown(drain=True)
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_adapt_holds_inside_deadband_and_below_window():
+    srv = ModelServer(batch_deadline_s=0.004, adapt_deadline=True)
+    try:
+        # balanced medians: hold
+        entry = _entry_with_window(srv, qw_s=0.002, ch_s=0.002)
+        before = server_mod.server_stats()["deadline_holds"]
+        srv._adapt_deadline(entry)
+        assert entry.deadline_s is None
+        assert server_mod.server_stats()["deadline_holds"] == before + 1
+        # an incomplete window: no evaluation at all
+        e2 = _entry_with_window(
+            srv, 0.02, 0.0001,
+            n=server_mod.ADAPT_WINDOW_REQUESTS - 1)
+        srv._adapt_deadline(e2)
+        assert e2.deadline_s is None
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_adapt_advisory_tmg406_fires_once_on_contradiction():
+    from transmogrifai_tpu import lint
+    srv = ModelServer(batch_deadline_s=0.008, adapt_deadline=True)
+    try:
+        entry = _entry_with_window(srv, qw_s=0.05, ch_s=0.0001)
+        before = server_mod.server_stats()["deadline_advisories"]
+        # two MD windows: 8ms -> 4ms -> 2ms (<= 8/2 trips the advisory)
+        srv._adapt_deadline(entry)
+        entry.requests += server_mod.ADAPT_WINDOW_REQUESTS
+        for _ in range(server_mod.ADAPT_WINDOW_REQUESTS):
+            entry.decomp["queueWait"].append(0.05)
+            entry.decomp["coalesceHold"].append(0.0001)
+        srv._adapt_deadline(entry)
+        assert entry.deadline_advised is True
+        assert server_mod.server_stats()["deadline_advisories"] == \
+            before + 1
+        # converged far from config, advisory fired exactly once
+        entry.requests += server_mod.ADAPT_WINDOW_REQUESTS
+        for _ in range(server_mod.ADAPT_WINDOW_REQUESTS):
+            entry.decomp["queueWait"].append(0.05)
+            entry.decomp["coalesceHold"].append(0.0001)
+        srv._adapt_deadline(entry)
+        assert server_mod.server_stats()["deadline_advisories"] == \
+            before + 1
+    finally:
+        srv.shutdown(drain=True)
+
+
+def test_adapt_disabled_is_bit_inert(monkeypatch):
+    srv = ModelServer(batch_deadline_s=0.004)   # default: off
+    try:
+        assert srv.adapt_deadline is False
+        assert srv.stats()["adaptDeadline"] is False
+        entry = _entry_with_window(srv, qw_s=0.05, ch_s=0.0001)
+        # the worker loop only calls the controller when enabled; even
+        # a direct call must leave per-entry state None-untouched only
+        # via the enable flag — assert the OFF wiring:
+        assert entry.deadline_s is None
+        assert entry.stats()["adaptiveDeadlineMs"] is None
+    finally:
+        srv.shutdown(drain=True)
+    # kill switch: TMOG_ADAPT=0 forces the constructor flag off
+    monkeypatch.setenv("TMOG_ADAPT", "0")
+    srv2 = ModelServer(batch_deadline_s=0.004, adapt_deadline=True)
+    try:
+        assert srv2.adapt_deadline is False
+    finally:
+        srv2.shutdown(drain=True)
+
+
+def test_server_stats_expose_adaptation_counters():
+    st = server_mod.server_stats()
+    for key in ("deadline_adapt_windows", "deadline_increases",
+                "deadline_decreases", "deadline_holds",
+                "deadline_clamped", "deadline_advisories"):
+        assert key in st, key
+    from transmogrifai_tpu import fleet as fleet_mod
+    fst = fleet_mod.fleet_stats()
+    for key in ("worker_deadline_increases", "worker_deadline_decreases",
+                "worker_deadline_clamped", "worker_deadline_advisories"):
+        assert key in fst, key
+
+
+# ---------------------------------------------------------------------------
+# satellite: score-type runs drain phase observations into the cost db
+# ---------------------------------------------------------------------------
+
+
+def test_score_run_grows_cost_db(rng, tmp_path):
+    from transmogrifai_tpu import FeatureBuilder, Workflow, planner
+    from transmogrifai_tpu.models.linear import LogisticRegressionFamily
+    from transmogrifai_tpu.models.selector import (
+        BinaryClassificationModelSelector)
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.runner import (OpParams, OpWorkflowRunner,
+                                          RunType)
+
+    y = rng.integers(0, 2, 120).astype(float)
+    x = rng.normal(size=120) + y
+    records = [{"label": float(y[i]), "x": float(x[i])}
+               for i in range(120)]
+
+    class _R:
+        def read_records(self):
+            return list(records)
+
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    fx = FeatureBuilder.Real("x").from_column().as_predictor()
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()],
+        splitter=None, seed=3)
+    pred = label.transform_with(sel, transmogrify([fx]))
+    wf = Workflow().set_result_features(pred)
+    runner = OpWorkflowRunner(wf, training_reader=_R(),
+                              scoring_reader=_R())
+    db_path = str(tmp_path / "cost.json")
+    params = OpParams(model_location=str(tmp_path / "model"),
+                      write_location=str(tmp_path / "scores.csv"),
+                      custom_params={"costDb": db_path})
+    runner.run(RunType.TRAIN, params)
+    before = json.load(open(db_path))
+    n_before = sum(
+        slot.get("n", 0)
+        for tiers in before.get("stages", {}).values()
+        for slot in tiers.values() if isinstance(slot, dict))
+    # a tiny score run sits below the fusion row floor, so seed the
+    # observation buffer the way a production-sized transform would —
+    # the satellite under test is the DRAIN on the score path
+    planner.observe_phase("transform", "host", 0.5, 25_000)
+    out = runner.run(RunType.SCORE, params)
+    assert out.metrics["rowsScored"] == 120
+    after = json.load(open(db_path))
+    assert "phase:transform" in after.get("stages", {})
+    n_after = sum(
+        slot.get("n", 0)
+        for tiers in after.get("stages", {}).values()
+        for slot in tiers.values() if isinstance(slot, dict))
+    assert n_after > n_before
+    # and the run stamped its resolved config (tentpole a)
+    assert "effectiveConfig" in out.metrics
+    assert out.metrics["effectiveConfig"]["costDb"] == db_path
+
+
+# ---------------------------------------------------------------------------
+# live end-to-end (slow): record -> tune -> tuned beats/matches default
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_tune_live_round_trip(tmp_path):
+    import http.client
+
+    from transmogrifai_tpu import FeatureBuilder, Workflow
+    from transmogrifai_tpu import workload as workload_mod
+    from transmogrifai_tpu.cli import build_server_from_params
+    from transmogrifai_tpu.models import (
+        BinaryClassificationModelSelector, LogisticRegressionFamily)
+    from transmogrifai_tpu.ops.transmogrifier import transmogrify
+    from transmogrifai_tpu.runner import OpParams
+
+    rng = np.random.default_rng(7)
+    y = np.asarray([i % 2 for i in range(120)], float)
+    rng.shuffle(y)
+    records = [{"label": float(y[i]),
+                "x1": float(rng.normal() + y[i]),
+                "x2": float(rng.normal())} for i in range(120)]
+    label = FeatureBuilder.RealNN("label").from_column().as_response()
+    f1 = FeatureBuilder.Real("x1").from_column().as_predictor()
+    f2 = FeatureBuilder.Real("x2").from_column().as_predictor()
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=2, families=[LogisticRegressionFamily()],
+        splitter=None, seed=7)
+    pred = label.transform_with(sel, transmogrify([f1, f2]))
+    model = (Workflow().set_input_records(records)
+             .set_result_features(pred).train())
+    mdir = str(tmp_path / "model")
+    model.save(mdir, overwrite=True)
+    pf = str(tmp_path / "params.json")
+    with open(pf, "w") as fh:
+        json.dump({"modelLocation": mdir,
+                   "customParams": {"serveBatchDeadlineMs": 2,
+                                    "serveBucketCap": 16}}, fh)
+    params = OpParams.from_file(pf)
+    srv = build_server_from_params(params)
+    httpd = server_mod.serve_http(srv, port=0)
+    port = httpd.server_address[1]
+    wdir = str(tmp_path / "wl")
+    workload_mod.start_recorder(wdir, role="tune-test")
+    try:
+        for lo in range(0, 24, 3):
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=60)
+            conn.request("POST", "/v1/models/default:score",
+                         json.dumps({"records": records[lo:lo + 3]}),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            assert r.status == 200
+            r.read()
+            conn.close()
+    finally:
+        workload_mod.stop_recorder()
+        httpd.shutdown()
+        srv.shutdown(drain=True)
+        for e in srv._entries.values():
+            if e.model is not None:
+                e.model._engine_breaker().reset()
+    rc = tuner_mod.run_tune(pf, wdir, budget_s=60.0,
+                            knobs="serveBatchDeadlineMs", speed=50.0)
+    assert rc == 0
+    rep = json.load(open(str(tmp_path / "params.tuned.tuning-report"
+                                        ".json")))
+    # the gate the tuner enforces by construction: the emitted config
+    # never loses to the baseline, and EVERY ranked leg held parity
+    assert rep["winnerScore"] <= rep["baselineScore"]
+    for leg in rep["legs"]:
+        if leg.get("rejected") is None:
+            assert leg["parityFailures"] == 0
+    tuned = json.load(open(str(tmp_path / "params.tuned.json")))
+    assert config.check_custom_params(tuned["customParams"]) == []

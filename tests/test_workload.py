@@ -368,6 +368,33 @@ def test_replay_skips_digested_and_failed_records(tiny_server, tmp_path):
         workload_mod.replay_workload(doc, f"127.0.0.1:{port}", speed=0)
 
 
+def test_replay_truncation_by_duration_and_count(tiny_server):
+    srv, port, records = tiny_server
+    doc = {"records": [
+        {"tS": 0.0, "model": "m", "rows": 2, "payload": records[:2]},
+        {"tS": 0.01, "model": "m", "rows": 2, "payload": records[2:4]},
+        {"tS": 60.0, "model": "m", "rows": 2, "payload": records[4:6]}]}
+    # --duration-s: arrival offsets are scaled by speed BEFORE the cut,
+    # so a 60 s tail at 100x lands at 0.6 s and a 0.5 s window drops it
+    out = workload_mod.replay_workload(doc, f"127.0.0.1:{port}",
+                                       speed=100.0, timeout_s=60.0,
+                                       duration_s=0.5)
+    assert out["sent"] == 2 and out["truncated"] == 1
+    # --max-requests keeps the arrival-ordered head
+    out = workload_mod.replay_workload(doc, f"127.0.0.1:{port}",
+                                       speed=100.0, timeout_s=60.0,
+                                       max_requests=1)
+    assert out["sent"] == 1 and out["truncated"] == 2
+    assert workload_mod.workload_stats()["replay_truncated"] == 3
+    # both truncations compose; invalid values name themselves
+    with pytest.raises(ValueError, match="duration_s"):
+        workload_mod.replay_workload(doc, f"127.0.0.1:{port}",
+                                     duration_s=0)
+    with pytest.raises(ValueError, match="max_requests"):
+        workload_mod.replay_workload(doc, f"127.0.0.1:{port}",
+                                     max_requests=0)
+
+
 # ---------------------------------------------------------------------------
 # critical-path analyzer + regression watchdog
 # ---------------------------------------------------------------------------

@@ -81,6 +81,17 @@ here as rules (the TMG3xx family of the catalog in
   deliberately dynamic name whose domain is provably bounded (a fixed
   tally catalog, the registered tenant roster) carries
   ``# lint: metric-name — reason``.
+* **TMG314** — raw ``customParams`` READS (a Load-context subscript or
+  ``.get()`` on a receiver named/ending ``custom_params``/
+  ``customParams``) appear only in ``config.py`` (the PR-18 declared-
+  config rule: the knob registry owns types, bounds and error wording —
+  a raw read elsewhere bypasses validation, drifts from the declared
+  default, and is invisible to ``cli check``/the tuner's search space;
+  route through ``config.numeric_param``/``bool_param``/
+  ``string_param`` or the runner wrappers). Writes are exempt (the CLI
+  legitimately ASSEMBLES customParams dicts); tests are exempt; a
+  deliberate raw passthrough (a path/dict handed verbatim to its owner)
+  carries ``# lint: knob — reason``.
 
 Runs as a CLI over one or more paths (default: the ``transmogrifai_tpu``
 package next to this script) and as a tier-1 pytest
@@ -109,7 +120,7 @@ __all__ = ["lint_source", "lint_file", "lint_paths", "main",
            "ALLOW_WALLCLOCK", "ALLOW_BROAD_EXCEPT", "ALLOW_EXPLICIT_MESH",
            "ALLOW_THREAD", "ALLOW_UNBOUNDED_QUEUE", "ALLOW_POPEN",
            "ALLOW_THREAD_LOOP", "ALLOW_SORT", "ALLOW_PALLAS",
-           "ALLOW_METRIC_NAME"]
+           "ALLOW_METRIC_NAME", "ALLOW_KNOB"]
 
 #: suppression markers, checked on the finding's own source line
 ALLOW_WALLCLOCK = "lint: wall-clock"
@@ -122,10 +133,15 @@ ALLOW_THREAD_LOOP = "lint: thread-loop"
 ALLOW_SORT = "lint: sort"
 ALLOW_PALLAS = "lint: pallas"
 ALLOW_METRIC_NAME = "lint: metric-name"
+ALLOW_KNOB = "lint: knob"
 
 #: the ONE module sanctioned to build instrument names dynamically
 #: (TMG313): the registry itself owns cardinality
 METRICS_HOME = "telemetry.py"
+
+#: the ONE module sanctioned to read customParams raw (TMG314): the
+#: knob registry owns types, bounds, defaults and error wording
+CONFIG_HOME = "config.py"
 
 #: the ONE module sanctioned to host pl.pallas_call sites (TMG312): its
 #: probe/fallback gate is what makes a Mosaic rejection survivable
@@ -188,6 +204,12 @@ class _Visitor(ast.NodeVisitor):
         self.metric_exempt = (os.path.basename(path) == METRICS_HOME
                               or "tests" in parts
                               or os.path.basename(path).startswith("test_"))
+        #: config.py owns raw customParams access (its registry
+        #: accessors ARE the sanctioned read path); tests may poke raw
+        #: dicts freely — TMG314
+        self.knob_exempt = (os.path.basename(path) == CONFIG_HOME
+                            or "tests" in parts
+                            or os.path.basename(path).startswith("test_"))
 
     # -- helpers -----------------------------------------------------------
     def _marked(self, lineno: int, marker: str) -> bool:
@@ -407,7 +429,62 @@ class _Visitor(ast.NodeVisitor):
             return self.np_sort_funcs.get(f.id)
         return None
 
+    # -- TMG314: raw customParams reads outside config.py ------------------
+    @staticmethod
+    def _is_knob_receiver(expr) -> bool:
+        """True when ``expr`` names a customParams mapping: a bare
+        ``custom_params``/``customParams`` Name or any Attribute chain
+        ending in one (``params.custom_params``, ``self.customParams``)."""
+        name = None
+        if isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Attribute):
+            name = expr.attr
+        if name is None:
+            return False
+        return name.endswith("custom_params") or name.endswith(
+            "customParams")
+
+    def _knob_marked(self, node) -> bool:
+        """The ``# lint: knob`` marker may sit on the read's FIRST or
+        LAST physical line (a wrapped ``.get(...)`` continuation puts
+        the comment after the closing paren, a line below where the
+        expression starts)."""
+        return self._marked(node.lineno, ALLOW_KNOB) or self._marked(
+            getattr(node, "end_lineno", node.lineno) or node.lineno,
+            ALLOW_KNOB)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        # Load-context only: the CLI legitimately ASSEMBLES customParams
+        # dicts (Store/Del writes stay clean); reads must route through
+        # the registry accessors
+        if isinstance(node.ctx, ast.Load) \
+                and self._is_knob_receiver(node.value) \
+                and not self.knob_exempt and not self._knob_marked(node):
+            self._add(
+                "TMG314", node.lineno,
+                "raw customParams subscript read outside config.py — "
+                "the knob registry owns types, bounds, defaults and "
+                "error wording; route through config.numeric_param/"
+                "bool_param/string_param (or the runner wrappers), or "
+                "mark a deliberate passthrough "
+                f"'# {ALLOW_KNOB} — <reason>'")
+        self.generic_visit(node)
+
     def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "get" \
+                and self._is_knob_receiver(f.value) \
+                and not self.knob_exempt and not self._knob_marked(node):
+            self._add(
+                "TMG314", node.lineno,
+                "raw customParams .get() outside config.py — the knob "
+                "registry owns types, bounds, defaults and error "
+                "wording (a raw .get() silently drifts from the "
+                "declared default and skips validation); route through "
+                "config.numeric_param/bool_param/string_param (or the "
+                "runner wrappers), or mark a deliberate passthrough "
+                f"'# {ALLOW_KNOB} — <reason>'")
         if self._is_thread(node):
             # TMG310: remember the target's name whatever the TMG307
             # outcome — `target=self._loop` and `target=loop` both

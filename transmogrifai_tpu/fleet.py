@@ -127,7 +127,9 @@ _TALLY = {"workers_spawned": 0, "workers_respawned": 0,
           "forwards": 0, "failovers": 0, "breaker_routed_around": 0,
           "shed_429": 0, "shed_503": 0,
           "probe_failures": 0, "rolling_restarts": 0,
-          "drained_restarts": 0}
+          "drained_restarts": 0,
+          "worker_deadline_increases": 0, "worker_deadline_decreases": 0,
+          "worker_deadline_clamped": 0, "worker_deadline_advisories": 0}
 
 
 def fleet_stats() -> Dict[str, Any]:
@@ -152,6 +154,23 @@ def _tally(key: str, n: int = 1) -> None:
     with _TALLY_LOCK:
         _TALLY[key] += n
     telemetry.counter(f"fleet.{key}").inc(n)  # lint: metric-name — keys are the fixed fleet_stats tally catalog
+
+
+def _note_worker_deadline_counters(agg: Dict[str, Any]) -> None:
+    """Mirror the latest fleet-wide online-adaptation totals the
+    router's ``/stats`` aggregation summed out of its workers into the
+    always-on tallies (PR 18): each key holds the HIGHEST total seen,
+    so ``fleet_stats()`` reports the controllers' fleet-wide activity
+    even after a worker respawn resets its own counters."""
+    with _TALLY_LOCK:
+        for src, dst in (("deadline_increases", "worker_deadline_increases"),
+                         ("deadline_decreases", "worker_deadline_decreases"),
+                         ("deadline_clamped", "worker_deadline_clamped"),
+                         ("deadline_advisories",
+                          "worker_deadline_advisories")):
+            v = agg.get(src)
+            if isinstance(v, int) and v > _TALLY[dst]:
+                _TALLY[dst] = v
 
 
 class FleetError(Exception):
@@ -817,6 +836,7 @@ def serve_fleet_http(supervisor: FleetSupervisor,
             if tracked:
                 agg["slo_attainment"] = round(
                     agg.get("slo_met", 0) / tracked, 4)
+            _note_worker_deadline_counters(agg)
             doc["aggregate"] = agg
             return doc
 

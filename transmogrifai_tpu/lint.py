@@ -183,6 +183,14 @@ RULES: Dict[str, Tuple[str, str]] = {
                "and a new scrape family); use a literal name, or mark "
                "a deliberately dynamic-but-bounded name "
                "'# lint: metric-name — reason')"),
+    "TMG314": (Severity.ERROR,
+               "raw customParams read (subscript or .get()) outside "
+               "config.py's registry accessors — a knob consumed off "
+               "the declared surface is invisible to `cli check` "
+               "validation, the effectiveConfig stamp and the tuner's "
+               "search space; route through config.py (numeric_param/"
+               "bool_param/string_param) or the runner wrappers, or "
+               "mark a deliberate passthrough '# lint: knob — reason'"),
     # -- TMG5xx: serving / AOT-bank advisories (aot.py, serving.py,
     #    server.py) — degradation notices, never crash paths ---------------
     "TMG501": (Severity.WARNING,
@@ -248,6 +256,12 @@ RULES: Dict[str, Tuple[str, str]] = {
                "explicit aggregateColumnar route contradicts the cost "
                "database's measured columnar-vs-rowwise aggregation "
                "tier — the knob wins, the measurement says otherwise"),
+    "TMG406": (Severity.WARNING,
+               "live serving telemetry contradicts the tuned config: "
+               "the online deadline controller converged a tenant's "
+               "batch_deadline_s far from the params file's "
+               "serveBatchDeadlineMs — re-run the offline tuner "
+               "against a fresh recording (docs/tuning.md)"),
 }
 
 

@@ -22,7 +22,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
-from . import resilience, telemetry
+from . import config, resilience, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -33,61 +33,46 @@ __all__ = ["OpParams", "RunType", "RunnerResult", "OpWorkflowRunner",
 def _numeric_custom_param(params: "OpParams", key: str, cast=float,
                           default: Any = None,
                           minimum: Optional[float] = None) -> Any:
-    """Validated numeric ``customParams`` lookup: a malformed value
+    """Validated numeric ``customParams`` lookup — now a registry
+    lookup over :mod:`~transmogrifai_tpu.config` (PR 18): the declared
+    cast/minimum win when ``key`` is a registered knob, so validation
+    can never drift from the one declared surface. A malformed value
     raises a ``ValueError`` NAMING the key instead of an uncaught
     ``float(ts)`` traceback deep in the run. ``None``/absent returns
     ``default`` (an explicit JSON ``null`` means "use the default", same
     as omitting the key); ``cast=int`` additionally rejects silent float
-    truncation (``maxBatches: 2.5`` is a config error, not 2)."""
-    raw = params.custom_params.get(key)
+    truncation (``maxBatches: 2.5`` is a config error, not 2). The
+    legacy ``cast``/``minimum`` args remain for unregistered keys."""
+    raw = params.custom_params.get(key)  # lint: knob — the registry wrapper itself
     if raw is None:
         return default
-    kind = "an integer" if cast is int else "a number"
     try:
-        if isinstance(raw, bool):
-            raise TypeError
-        v = cast(raw)
-        if cast is int and float(raw) != v:
-            raise TypeError
-        import math
-        if not math.isfinite(v):
-            # NaN slips past any `v < minimum` comparison and an
-            # inf/nan timeoutS would hang the stream's exit test forever
-            raise TypeError
-    except (TypeError, ValueError, OverflowError):
-        # OverflowError: int(1e400) — JSON happily parses huge floats
-        raise ValueError(
-            f"customParams.{key} must be {kind}, got {raw!r}") from None
-    if minimum is not None and v < minimum:
-        raise ValueError(
-            f"customParams.{key} must be >= {minimum:g}, got {raw!r}")
-    return v
+        k = config.knob(key)
+    except KeyError:
+        return config.coerce_numeric(raw, key, cast, minimum=minimum)
+    return config.coerce_numeric(raw, key,
+                                 int if k.type == "int" else float,
+                                 minimum=k.minimum)
 
 
 def _bool_custom_param(params: "OpParams", key: str, default: Any = None,
                        allow_auto: bool = False) -> Any:
-    """Validated boolean ``customParams`` lookup — the machinery the
-    hand-rolled ``overlap`` string→bool parsing used to bypass: a JSON
-    ``true``/``false``, the strings ``"true"``/``"false"`` (config
-    files written by shell templating), and — with ``allow_auto`` —
-    the tri-state ``"auto"``. Anything else raises a ``ValueError``
-    NAMING the key, so ``cli check`` reports it as TMG001 and a typo'd
-    ``overlap: "yes"`` can no longer silently mean "auto"."""
-    raw = params.custom_params.get(key)
+    """Validated boolean ``customParams`` lookup — a registry lookup
+    over :mod:`~transmogrifai_tpu.config` (the declared tri-state wins
+    for registered knobs): a JSON ``true``/``false``, the strings
+    ``"true"``/``"false"`` (config files written by shell templating),
+    and — when the declaration allows — the tri-state ``"auto"``.
+    Anything else raises a ``ValueError`` NAMING the key, so ``cli
+    check`` reports it as TMG001 and a typo'd ``overlap: "yes"`` can no
+    longer silently mean "auto"."""
+    raw = params.custom_params.get(key)  # lint: knob — the registry wrapper itself
     if raw is None:
         return default
-    if isinstance(raw, bool):
-        return raw
-    if isinstance(raw, str):
-        s = raw.strip().lower()
-        if s in ("true", "false"):
-            return s == "true"
-        if allow_auto and s == "auto":
-            return "auto"
-    kinds = "a boolean (true/false)"
-    if allow_auto:
-        kinds += ' or "auto"'
-    raise ValueError(f"customParams.{key} must be {kinds}, got {raw!r}")
+    try:
+        k = config.knob(key)
+    except KeyError:
+        return config.coerce_bool(raw, key, allow_auto=allow_auto)
+    return config.coerce_bool(raw, key, allow_auto=k.allow_auto)
 
 
 @dataclass
@@ -148,7 +133,7 @@ class OpParams:
         Prometheus metrics, or ``customParams.telemetry``)."""
         return bool(self.trace_location
                     or self.metrics_format == "prometheus"
-                    or self.custom_params.get("telemetry"))
+                    or self.custom_params.get("telemetry"))  # lint: knob — truthiness gate
 
     def apply_to_workflow(self, workflow) -> None:
         """Reflectively push stage params into the workflow's DAG stages
@@ -237,12 +222,12 @@ class OpWorkflowRunner:
         Findings mirror into telemetry (``lint.*`` counters, ``on_lint``)
         and the returned summary rides in the run's metrics doc."""
         from . import lint
-        validate = params.custom_params.get("validate", True)
+        validate = params.custom_params.get("validate", True)  # lint: knob — gate read before registry accessors exist in this frame
         if validate in (False, 0) or str(validate).lower() == "false":
             return None
-        fail_on = str(params.custom_params.get("failOn", "error")).lower()
-        suppress = params.custom_params.get("lintSuppress", ())
-        device = params.custom_params.get("validateDevice", True)
+        fail_on = str(params.custom_params.get("failOn", "error")).lower()  # lint: knob — enum read, shape-checked by cli check
+        suppress = params.custom_params.get("lintSuppress", ())  # lint: knob — list passthrough
+        device = params.custom_params.get("validateDevice", True)  # lint: knob — tri-state legacy truthiness
         device = not (device in (False, 0)
                       or str(device).lower() == "false")
         with telemetry.span("run:preflight"):
@@ -279,11 +264,11 @@ class OpWorkflowRunner:
         persistent compile cache (``compileCacheDir``), else None —
         an in-memory db whose static estimates still produce a plan."""
         from . import planner
-        db = params.custom_params.get("costDb")
+        db = params.custom_params.get("costDb")  # lint: knob — path passthrough
         if db:
             return str(db)
         return planner.default_cost_db_path(
-            params.custom_params.get("compileCacheDir"))
+            params.custom_params.get("compileCacheDir"))  # lint: knob — path passthrough
 
     def _plan_step(self, params: "OpParams", workflow=None, model=None):
         """Build the cost-based ExecutionPlan BEFORE any reader I/O and
@@ -296,15 +281,15 @@ class OpWorkflowRunner:
         ``lintSuppress`` machinery as the pre-flight rules, and the
         plan's JSON form rides in the metrics doc under ``plan``."""
         from . import lint, planner
-        enabled = params.custom_params.get("plan", True)
+        enabled = params.custom_params.get("plan", True)  # lint: knob — gate read, legacy truthiness contract
         if enabled in (False, 0) or str(enabled).lower() == "false":
             # a reused workflow must not silently follow a PREVIOUS
             # run's plan while this run stamps plan: null
             if workflow is not None:
                 workflow.set_plan(None)
             return None
-        fail_on = str(params.custom_params.get("failOn", "error")).lower()
-        suppress = params.custom_params.get("lintSuppress", ())
+        fail_on = str(params.custom_params.get("failOn", "error")).lower()  # lint: knob — enum read, shape-checked by cli check
+        suppress = params.custom_params.get("lintSuppress", ())  # lint: knob — list passthrough
         db = planner.CostDatabase.load(self._cost_db_path(params))
         try:
             with telemetry.span("run:plan"):
@@ -404,6 +389,27 @@ class OpWorkflowRunner:
             logger.exception("cost-db recording failed; the pre-fit "
                              "plan stamp stands")
 
+    def _record_score_costs(self) -> None:
+        """After a score-type run: fold the buffered per-phase
+        observations (scoring transforms, pipeline ingest, temporal
+        aggregation) into the cost database and persist it — the
+        serving-path priors the offline tuner seeds its search from.
+        Train-only draining left the db blind to exactly the phases
+        tuning cares about (docs/tuning.md)."""
+        from . import planner
+        db = getattr(self, "_plan_db", None)
+        # a corrupt db keeps raising TMG404 until a TRAIN regenerates
+        # it — a score run saving over it would silently clear the
+        # finding (and destroy the evidence) between runs
+        if db is None or getattr(db, "corrupt", False):
+            return
+        try:
+            planner.drain_phase_observations(db)
+            db.save()
+        except Exception:  # lint: broad-except — cost recording must never fail a finished score
+            logger.exception("cost-db recording failed on the score "
+                             "path; the run's result stands")
+
     @staticmethod
     def _shard_role(run_type: str) -> str:
         """This run's row name in merged traces: an explicit
@@ -457,8 +463,8 @@ class OpWorkflowRunner:
         # drop one atomic shard into the shared merge directory; the
         # TMOG_TRACE_PARENT env (if any) joins its spans to the
         # originating trace automatically (telemetry.current_trace).
-        trace_dir = params.custom_params.get("traceDir") \
-            or os.environ.get("TMOG_TRACE_DIR")
+        trace_dir = params.custom_params.get(  # lint: knob — path read, type-checked below
+            "traceDir") or os.environ.get("TMOG_TRACE_DIR")
         if trace_dir is not None and not isinstance(trace_dir, str):
             raise ValueError("customParams.traceDir must be a path "
                              f"string, got {trace_dir!r}")
@@ -470,7 +476,7 @@ class OpWorkflowRunner:
         # .compileCacheDir / CLI --compile-cache-dir): repeat cold runs
         # reload compiled executables instead of re-paying the compile
         # clock; its presence is stamped into the metrics doc below
-        cache_dir = params.custom_params.get("compileCacheDir")
+        cache_dir = params.custom_params.get("compileCacheDir")  # lint: knob — path passthrough
         if cache_dir:
             _enable_compile_cache(str(cache_dir))
         # run-scoped mesh shape (customParams.meshDevices/meshGridSize,
@@ -531,7 +537,7 @@ class OpWorkflowRunner:
         feature_shards = _numeric_custom_param(params, "featureShards",
                                                int, minimum=1)
         qloc = (params.quarantine_location
-                or params.custom_params.get("quarantineLocation"))
+                or params.custom_params.get("quarantineLocation"))  # lint: knob — sink path, not a registry knob
         prev_sink = (resilience.set_quarantine(str(qloc)) if qloc
                      else None)
         prev_temporal = _temporal.set_run_defaults(**temporal_knobs)
@@ -581,6 +587,12 @@ class OpWorkflowRunner:
                     # (None when no persistent cache was configured)
                     result.metrics["compileCacheDir"] = (
                         str(cache_dir) if cache_dir else None)
+                    # the resolved knob surface rides in every metrics
+                    # doc (PR 18): every registry knob at its supplied-
+                    # or-default value, so a result can always answer
+                    # "what config produced this?" (config.py)
+                    result.metrics["effectiveConfig"] = \
+                        config.effective_config(params.custom_params)
                     # the mesh topology every heavy phase ran on rides in
                     # every metrics doc (PR 6: multichip is mainline —
                     # a benched number must say how many chips it used)
@@ -762,6 +774,10 @@ class OpWorkflowRunner:
             scores = model.score(data)
             if params.write_location:
                 _write_store_csv(scores, params.write_location)
+            # serving-path costs feed the persisted db too (PR 18): the
+            # tuner's priors must see Score-phase observations, not just
+            # the post-Train drain
+            self._record_score_costs()
             metrics = {"rowsScored": scores.n_rows,
                        "appSeconds": round(time.perf_counter() - t0, 3)}
             return RunnerResult(run_type, metrics=metrics, scores=scores)
@@ -850,7 +866,7 @@ class OpWorkflowRunner:
                 # quarantine; without one their records would land
                 # nowhere, so the run fails loudly instead.
                 # customParams.onBatchError overrides.
-                on_error = params.custom_params.get("onBatchError")
+                on_error = params.custom_params.get("onBatchError")  # lint: knob — enum passthrough, resilience.resolve_on_error validates
                 rows = 0
                 n_batches = 0
                 q_before = resilience.resilience_stats()
@@ -878,6 +894,7 @@ class OpWorkflowRunner:
             finally:
                 if restore_columnar is not None:
                     reader.columnar = restore_columnar
+            self._record_score_costs()
             q_after = resilience.resilience_stats()
             pipe_after = _pipeline_stats()
             pipe_streams = (pipe_after["streams"]

@@ -81,6 +81,25 @@ DEFAULT_MAX_QUEUE = 256
 #: loaded models held before the LRU evicts
 DEFAULT_MAX_MODELS = 4
 
+#: online deadline adaptation (PR 18, docs/tuning.md "Online
+#: adaptation"): hysteresis window — completed requests a tenant must
+#: accumulate between controller decisions, so one noisy request can
+#: never flap the deadline
+ADAPT_WINDOW_REQUESTS = 64
+
+#: AIMD shape: additive-increase step (seconds) toward more
+#: coalescing, multiplicative-decrease factor when queue-wait
+#: dominates, and the dead-band ratio between the two phase medians
+#: inside which the controller holds still
+ADAPT_STEP_S = 0.0005
+ADAPT_MD_FACTOR = 0.5
+ADAPT_DEADBAND = 1.25
+
+#: converged-deadline-vs-configured ratio past which the controller
+#: raises the TMG406 advisory: live telemetry contradicts the tuned
+#: params file (re-run `python -m transmogrifai_tpu tune`)
+ADAPT_ADVISORY_RATIO = 2.0
+
 #: per-model latency reservoir for exact p50/p95/p99 in stats
 _LATENCY_WINDOW = 4096
 
@@ -148,7 +167,10 @@ _TALLY = {"requests": 0, "requests_failed": 0, "rows": 0, "batches": 0,
           "coalesced_requests": 0, "bank_hit_batches": 0, "rejected": 0,
           "quarantined_requests": 0, "model_loads": 0, "model_evictions": 0,
           "bank_loads": 0, "slo_met": 0, "slo_missed": 0,
-          "requests_timed_out": 0, "timed_out_completions": 0}
+          "requests_timed_out": 0, "timed_out_completions": 0,
+          "deadline_adapt_windows": 0, "deadline_increases": 0,
+          "deadline_decreases": 0, "deadline_holds": 0,
+          "deadline_clamped": 0, "deadline_advisories": 0}
 
 
 def server_stats() -> Dict[str, Any]:
@@ -376,6 +398,18 @@ class _ModelEntry:
         self.batches = 0
         self.bank_hit_batches = 0
         self.loads = 0
+        #: online deadline adaptation (PR 18): the tenant's effective
+        #: micro-batching hold. None = adaptation never touched it and
+        #: the worker reads the server-wide ``batch_deadline_s``
+        #: directly — the disabled path is bit-inert by construction.
+        #: Only the tenant's own worker thread writes it, and only
+        #: BETWEEN dispatches (never mid-request).
+        self.deadline_s: Optional[float] = None
+        self.adapt_seen = 0          # requests consumed by past windows
+        self.adapt_increases = 0
+        self.adapt_decreases = 0
+        self.adapt_clamped = 0
+        self.deadline_advised = False
 
     @staticmethod
     def _pct(values) -> Dict[str, float]:
@@ -400,6 +434,13 @@ class _ModelEntry:
                 "viaRegistry": self.via_registry,
                 "rollout": rollout.status() if rollout else None,
                 "drift": sentinel.stats() if sentinel else None,
+                "adaptiveDeadlineMs": (
+                    None if self.deadline_s is None
+                    else round(self.deadline_s * 1e3, 4)),
+                "deadlineAdaptations": {
+                    "increases": self.adapt_increases,
+                    "decreases": self.adapt_decreases,
+                    "clamped": self.adapt_clamped},
                 "latency": {"e2e": pct,
                             **{ph: self._pct(self.decomp[ph])
                                for ph in _LATENCY_PHASES}},
@@ -427,13 +468,28 @@ class ModelServer:
                  drift_js_threshold: float = lifecycle.DEFAULT_JS_THRESHOLD,
                  drift_fill_delta: float =
                  lifecycle.DEFAULT_FILL_DELTA_THRESHOLD,
-                 canary_fraction: float = DEFAULT_CANARY_FRACTION):
+                 canary_fraction: float = DEFAULT_CANARY_FRACTION,
+                 adapt_deadline: bool = False):
         if max_models < 1:
             raise ValueError(f"max_models must be >= 1, got {max_models}")
         self.max_models = int(max_models)
         self.capacity_bytes = (None if capacity_bytes is None
                                else int(capacity_bytes))
         self.batch_deadline_s = max(float(batch_deadline_s), 0.0)
+        #: online deadline adaptation (PR 18, docs/tuning.md): a
+        #: bounded AIMD controller nudges each tenant's micro-batching
+        #: hold against its measured queue-wait/coalesce-hold split,
+        #: BETWEEN dispatches only, clamped to the registry-declared
+        #: serveBatchDeadlineMs tuning bounds. TMOG_ADAPT=0 is the
+        #: process-wide kill switch; disabled (the default) the worker
+        #: reads ``batch_deadline_s`` exactly as before — bit-inert.
+        import os as _os
+        if _os.environ.get("TMOG_ADAPT", "").strip() == "0":
+            adapt_deadline = False
+        self.adapt_deadline = bool(adapt_deadline)
+        from . import config as _config
+        lo, hi = _config.knob_bounds("serveBatchDeadlineMs")
+        self._adapt_bounds_s = (max(lo, 0.0) / 1e3, hi / 1e3)
         self.max_queue = int(max_queue)
         self.slo_ms = None if slo_ms is None else float(slo_ms)
         self.bucket_cap = bucket_cap
@@ -710,7 +766,13 @@ class ModelServer:
             item.t_dequeued = time.perf_counter()
             batch: List[_Request] = [item]
             rows = item.rows
-            deadline = item.t_dequeued + self.batch_deadline_s
+            # the effective hold: the tenant's adapted deadline once
+            # the controller has moved it, else the configured one —
+            # read ONCE per batch, so an adaptation between dispatches
+            # can never change a batch already being coalesced
+            deadline = item.t_dequeued + (
+                entry.deadline_s if entry.deadline_s is not None
+                else self.batch_deadline_s)
             # dynamic micro-batching: hold the dispatch open until the
             # deadline (or the bucket cap) for co-riding requests
             while rows < cap:
@@ -728,6 +790,10 @@ class ModelServer:
                 batch.append(nxt)
                 rows += nxt.rows
             self._dispatch(entry, batch)
+            if self.adapt_deadline:
+                # between dispatches, never mid-request: the next
+                # batch reads whatever the controller decided here
+                self._adapt_deadline(entry)
         # drain anything still queued after the sentinel (shutdown
         # promises no request is dropped)
         leftovers: List[_Request] = []
@@ -1405,6 +1471,93 @@ class ModelServer:
         _tally("slo_met" if met else "slo_missed")
         return met
 
+    # -- online deadline adaptation (PR 18, docs/tuning.md) ----------------
+    def _adapt_deadline(self, entry: _ModelEntry) -> None:
+        """Bounded AIMD controller over one tenant's micro-batching
+        hold, driven by the measured queue-wait/coalesce-hold split
+        (:meth:`_observe_decomp`'s reservoirs). Runs on the tenant's
+        own worker thread BETWEEN dispatches; state machine:
+
+        * **hold** until a full hysteresis window of
+          ``ADAPT_WINDOW_REQUESTS`` new completed requests has
+          accumulated, and whenever the two phase medians sit inside
+          the ``ADAPT_DEADBAND`` ratio of each other;
+        * **multiplicative decrease** (``* ADAPT_MD_FACTOR``) when
+          queue-wait dominates — holding the batch open is starving
+          the queue, drain it faster;
+        * **additive increase** (``+ ADAPT_STEP_S``) when
+          coalesce-hold dominates — the queue keeps up, harvest more
+          coalescing per dispatch.
+
+        Every move clamps to the registry-declared
+        ``serveBatchDeadlineMs`` tuning bounds (config.knob_bounds) —
+        the controller can NEVER leave the declared space. When the
+        converged deadline contradicts the configured one by more than
+        ``ADAPT_ADVISORY_RATIO`` the tenant raises a one-shot TMG406
+        advisory: the tuned params file disagrees with live telemetry,
+        re-run the offline tuner."""
+        if entry.requests - entry.adapt_seen < ADAPT_WINDOW_REQUESTS:
+            return
+        entry.adapt_seen = entry.requests
+        _tally("deadline_adapt_windows")
+        window = ADAPT_WINDOW_REQUESTS
+        qw = list(entry.decomp["queueWait"])[-window:]
+        ch = list(entry.decomp["coalesceHold"])[-window:]
+        if not qw or not ch:
+            _tally("deadline_holds")
+            return
+        qw_med = float(np.median(np.asarray(qw, dtype=np.float64)))
+        ch_med = float(np.median(np.asarray(ch, dtype=np.float64)))
+        cur = (entry.deadline_s if entry.deadline_s is not None
+               else self.batch_deadline_s)
+        eps = 1e-9
+        if qw_med > ch_med * ADAPT_DEADBAND and qw_med > eps:
+            nxt = cur * ADAPT_MD_FACTOR
+            direction = "decrease"
+        elif ch_med > qw_med * ADAPT_DEADBAND:
+            nxt = cur + ADAPT_STEP_S
+            direction = "increase"
+        else:
+            _tally("deadline_holds")
+            return
+        lo, hi = self._adapt_bounds_s
+        clamped = min(max(nxt, lo), hi)
+        if clamped != nxt:
+            entry.adapt_clamped += 1
+            _tally("deadline_clamped")
+        if clamped == cur:
+            _tally("deadline_holds")
+            return
+        entry.deadline_s = clamped
+        if direction == "increase":
+            entry.adapt_increases += 1
+            _tally("deadline_increases")
+        else:
+            entry.adapt_decreases += 1
+            _tally("deadline_decreases")
+        telemetry.emit("deadline_adapt", model=entry.name,
+                       direction=direction,
+                       deadline_ms=clamped * 1e3,
+                       queue_wait_med_s=qw_med,
+                       coalesce_hold_med_s=ch_med)
+        base = self.batch_deadline_s
+        if not entry.deadline_advised and base > 0 and (
+                clamped >= base * ADAPT_ADVISORY_RATIO
+                or clamped <= base / ADAPT_ADVISORY_RATIO):
+            entry.deadline_advised = True
+            _tally("deadline_advisories")
+            from . import lint
+            finding = lint.Finding(
+                "TMG406",
+                f"model {entry.name!r}: the online controller "
+                f"converged batch_deadline_s to {clamped * 1e3:.3f} ms "
+                f"but the params file configured "
+                f"{base * 1e3:.3f} ms — live telemetry contradicts the "
+                f"tuned config; re-run `python -m transmogrifai_tpu "
+                f"tune` against a fresh recording")
+            lint.emit_findings([finding])
+            logger.warning("serve: %s", finding.format())
+
     def _observe_decomp(self, entry: _ModelEntry, req: _Request,
                         now: float) -> Dict[str, float]:
         """Fold one completed request's latency decomposition into the
@@ -1513,6 +1666,11 @@ class ModelServer:
                 "sloMs": self.slo_ms,
                 "driftWindow": self.drift_window,
                 "batchDeadlineMs": self.batch_deadline_s * 1e3,
+                "adaptDeadline": self.adapt_deadline,
+                "adaptBoundsMs": [
+                    round(self._adapt_bounds_s[0] * 1e3, 4),
+                    (None if self._adapt_bounds_s[1] == float("inf")
+                     else round(self._adapt_bounds_s[1] * 1e3, 4))],
                 "models": {name: e.stats() for name, e in entries}}
 
     def shutdown(self, drain: bool = True,

@@ -95,7 +95,8 @@ _TALLY = {"records_enqueued": 0, "records_written": 0,
           "merge_errors": 0, "torn_records_skipped": 0,
           "replayed_requests": 0, "replay_skipped_no_payload": 0,
           "replay_failures": 0, "parity_checked": 0,
-          "parity_failures": 0}
+          "parity_failures": 0, "replay_truncated": 0,
+          "replay_late_sends": 0}
 
 
 def _tally(key: str, n: int = 1) -> None:
@@ -656,7 +657,9 @@ def _post_score(host: str, port: int, model: str, payload: Any,
 
 def replay_workload(doc: Dict[str, Any], url: str, speed: float = 1.0,
                     timeout_s: float = 30.0, parity_tol: float = 1e-4,
-                    max_in_flight: int = 32) -> Dict[str, Any]:
+                    max_in_flight: int = 32,
+                    duration_s: Optional[float] = None,
+                    max_requests: Optional[int] = None) -> Dict[str, Any]:
     """Re-drive a merged workload open-loop against ``url`` (a serve
     worker or fleet router base URL). Each recorded request fires at
     ``t0 + tS / speed`` regardless of earlier completions — the
@@ -664,8 +667,12 @@ def replay_workload(doc: Dict[str, Any], url: str, speed: float = 1.0,
     recorded payload (digested over the size cap, or captured with
     ``workloadPayloads=false``) cannot be re-driven and are tallied as
     skipped. Where recorded ``outputs`` exist, the replayed response is
-    compared numerically within ``parity_tol`` (score parity). Returns
-    the same decomposed-latency summary shape as
+    compared numerically within ``parity_tol`` (score parity).
+    ``duration_s``/``max_requests`` truncate the replay — only records
+    whose scaled send time falls inside the window (and the first N of
+    those) fire — so a tuner candidate leg can bound its cost without
+    editing the recording (truncated records tally ``truncated``, not
+    skipped). Returns the same decomposed-latency summary shape as
     :func:`summarize_workload`, computed from the replayed responses'
     ``phases`` blocks, so recording and replay diff phase-for-phase."""
     parsed = urllib.parse.urlsplit(url if "//" in url
@@ -679,6 +686,21 @@ def replay_workload(doc: Dict[str, Any], url: str, speed: float = 1.0,
     runnable = [r for r in todo if isinstance(r.get("payload"), list)]
     skipped = len(todo) - len(runnable)
     _tally("replay_skipped_no_payload", skipped)
+    n_before_cut = len(runnable)
+    if duration_s is not None:
+        if float(duration_s) <= 0:
+            raise ValueError(
+                f"duration_s must be > 0, got {duration_s}")
+        runnable = [r for r in runnable
+                    if float(r.get("tS", 0.0)) / speed
+                    <= float(duration_s)]
+    if max_requests is not None:
+        if int(max_requests) < 1:
+            raise ValueError(
+                f"max_requests must be >= 1, got {max_requests}")
+        runnable = runnable[:int(max_requests)]
+    truncated = n_before_cut - len(runnable)
+    _tally("replay_truncated", truncated)
 
     lock = threading.Lock()
     phase_samples: Dict[str, Dict[str, List[float]]] = {}
@@ -752,7 +774,10 @@ def replay_workload(doc: Dict[str, Any], url: str, speed: float = 1.0,
 
     for m, ent in models.items():
         ent["phases"] = _phase_pcts(phase_samples.get(m, {}))
+    if stats["lateSends"]:
+        _tally("replay_late_sends", stats["lateSends"])
     return {"requests": len(todo), "skippedNoPayload": skipped,
+            "truncated": truncated,
             "speed": speed, "durationS": round(wall, 3),
             "client": _phase_pcts({"e2e": client_e2e}),
             "models": models, **stats}
