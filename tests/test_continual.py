@@ -86,6 +86,24 @@ def _aupr(model_summary):
     return _metric_of(model_summary, "AuPR")
 
 
+@pytest.fixture(autouse=True)
+def _lock_order_witness():
+    """Every test in this module doubles as a race harness: the
+    TMG8xx runtime witness (utils/locks.py) records the cross-thread
+    lock acquisition order the real code paths execute and the
+    teardown asserts no inversion was observed. Record mode, not
+    raise mode — a raise inside a never-raises boundary (dispatch
+    workers, the fleet monitor) would be swallowed where an assert
+    here cannot be."""
+    from transmogrifai_tpu.utils import locks
+    locks.arm(raise_on_violation=False)
+    yield
+    violations = locks.violations()
+    locks.disarm()
+    locks.reset()
+    assert violations == [], "\n".join(violations)
+
+
 @pytest.fixture(scope="module")
 def stable(tmp_path_factory):
     """One trained stable model (missing values so fill means matter),

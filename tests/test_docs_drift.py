@@ -13,7 +13,12 @@ importing heavy modules:
 3. the declared knob registry (config.py) and the consolidated knob
    table in docs/tuning.md name exactly the same knobs — a knob
    declared but undocumented (or documented but undeclared) fails CI
-   (PR 18).
+   (PR 18);
+4. every rule id in the lint RULES catalog has a docs row in
+   docs/static-analysis.md AND at least one positive test fixture
+   somewhere under tests/ — the TMG308-was-missing bug (PR 11, a rule
+   shipped with no fixture proving it fires) is structurally
+   impossible (PR 20).
 """
 import glob
 import os
@@ -93,3 +98,36 @@ def test_registry_knobs_match_docs_knob_table():
     assert not undeclared, (
         f"docs/tuning.md documents knobs config.py does not declare: "
         f"{undeclared}")
+
+
+def test_every_lint_rule_has_docs_row_and_test_fixture():
+    """Rule-catalog drift guard: a rule with no docs row is
+    undiscoverable; a rule with no positive fixture is unproven (it
+    may never have fired even once). Checked against the RULES source
+    so a rule added to lint.py cannot merge without both."""
+    lint_src = _read(os.path.join(_REPO, "transmogrifai_tpu",
+                                  "lint.py"))
+    m = re.search(r"RULES\s*:[^=]*=\s*\{(.*?)\n\}", lint_src, re.S)
+    assert m, "lint.py lost its RULES catalog literal"
+    rules = set(re.findall(r'"(TMG\d{3})":', m.group(1)))
+    assert len(rules) >= 50, (
+        f"RULES ids not found by the pattern — did the catalog idiom "
+        f"change? matched {len(rules)}")
+    docs = _read(os.path.join(_REPO, "docs", "static-analysis.md"))
+    undocumented = sorted(r for r in rules if r not in docs)
+    assert not undocumented, (
+        f"lint.py declares rules with no docs/static-analysis.md row: "
+        f"{undocumented}")
+    tested = set()
+    for path in sorted(glob.glob(os.path.join(_REPO, "tests",
+                                              "*.py"))):
+        tested |= set(re.findall(r"TMG\d{3}", _read(path)))
+    unproven = sorted(rules - tested)
+    assert not unproven, (
+        f"rules with no test fixture anywhere under tests/ (a rule "
+        f"that has never demonstrably fired): {unproven}")
+    phantom = sorted(r for r in tested - rules
+                     if not r.startswith("TMG9"))
+    assert not phantom, (
+        f"tests reference rule ids the RULES catalog does not "
+        f"declare: {phantom}")

@@ -61,6 +61,24 @@ def _train(seed, n=160):
     return model, records, pred
 
 
+@pytest.fixture(autouse=True)
+def _lock_order_witness():
+    """Every test in this module doubles as a race harness: the
+    TMG8xx runtime witness (utils/locks.py) records the cross-thread
+    lock acquisition order the real code paths execute and the
+    teardown asserts no inversion was observed. Record mode, not
+    raise mode — a raise inside a never-raises boundary (dispatch
+    workers, the fleet monitor) would be swallowed where an assert
+    here cannot be."""
+    from transmogrifai_tpu.utils import locks
+    locks.arm(raise_on_violation=False)
+    yield
+    violations = locks.violations()
+    locks.disarm()
+    locks.reset()
+    assert violations == [], "\n".join(violations)
+
+
 @pytest.fixture(scope="module")
 def fleet_env(tmp_path_factory):
     """Two trained versions of one registry model ('churn', v1

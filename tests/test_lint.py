@@ -824,3 +824,390 @@ def test_tmoglint_cli_exit_codes(tmp_path, capsys):
     good = tmp_path / "good.py"
     good.write_text("import time\nt0 = time.perf_counter()\n")
     assert tm.main([str(good)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# TMG305 — unparseable file (the rule every other rule depends on)
+# ---------------------------------------------------------------------------
+def test_tmg305_syntax_error_is_a_finding_not_a_crash():
+    tm = _load_tmoglint()
+    fs = tm.lint_source("def f(:\n    pass\n", "transmogrifai_tpu/x.py")
+    assert [f.rule for f in fs] == ["TMG305"]
+    assert fs[0].severity == "error"
+    assert "parse" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# TMG399 — stale suppression markers (satellite: suppressions must not
+# outlive their findings)
+# ---------------------------------------------------------------------------
+def test_tmg399_stale_marker_flagged():
+    tm = _load_tmoglint()
+    stale = ("import time\n"
+             "t0 = time.perf_counter()  "
+             "# lint: wall-clock — no longer true\n")
+    fs = tm.lint_source(stale)
+    assert [f.rule for f in fs] == ["TMG399"]
+    assert "wall-clock" in fs[0].message
+
+
+def test_tmg399_live_marker_not_flagged():
+    tm = _load_tmoglint()
+    live = ("import time\n"
+            "t0 = time.time()  # lint: wall-clock — epoch needed\n")
+    assert tm.lint_source(live) == []
+
+
+def test_tmg399_wrong_marker_fires_rule_and_stale():
+    """A marker for the WRONG rule is double-wrong: the real rule still
+    fires (the marker silences nothing) AND the marker is stale."""
+    tm = _load_tmoglint()
+    wrong = ("import time\n"
+             "t0 = time.time()  # lint: broad-except — oops\n")
+    assert sorted(f.rule for f in tm.lint_source(wrong)) == [
+        "TMG301", "TMG399"]
+
+
+def test_tmg399_string_literals_are_not_markers():
+    tm = _load_tmoglint()
+    doc = 's = "escape with # lint: wall-clock — reason"\n'
+    assert tm.lint_source(doc) == []
+
+
+def test_tmg399_path_exempt_marker_is_inert_not_stale():
+    """A marker for a rule that is path-exempt in this file (e.g. the
+    explicit-mesh rule inside parallel/) silences nothing but is NOT
+    reported stale — deleting it would re-fire the rule if the file
+    ever moves."""
+    tm = _load_tmoglint()
+    src = ("from transmogrifai_tpu.parallel.mesh import make_mesh\n"
+           "m = make_mesh(n_devices=1)  # lint: explicit-mesh — bench\n")
+    assert tm.lint_source(
+        src, "transmogrifai_tpu/parallel/mesh.py") == []
+    # ... and the same marker in unexempt code is live, not stale
+    assert tm.lint_source(src, "transmogrifai_tpu/other.py") == []
+
+
+def test_tmg399_can_be_disabled():
+    tm = _load_tmoglint()
+    stale = "x = 1  # lint: wall-clock — nope\n"
+    assert [f.rule for f in tm.lint_source(stale)] == ["TMG399"]
+    assert tm.lint_source(stale, stale_markers=False) == []
+
+
+# ---------------------------------------------------------------------------
+# TMG8xx — whole-program concurrency & crash-safety pass
+# (tools/concurrency_lint.py)
+# ---------------------------------------------------------------------------
+def _load_conclint():
+    spec = importlib.util.spec_from_file_location(
+        "concurrency_lint",
+        os.path.join(_REPO, "tools", "concurrency_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_DEADLOCK_SRC = """\
+import threading
+A = threading.Lock()
+B = threading.Lock()
+def one():
+    with A:
+        with B:
+            pass
+def two():
+    with B:
+        with A:
+            pass
+"""
+
+
+def test_tmg801_deadlock_cycle_quotes_both_paths():
+    """The acceptance fixture: a seeded AB/BA deadlock is detected and
+    the finding quotes BOTH acquisition paths (file:line + source for
+    each edge), so the report is actionable without opening the file."""
+    cl = _load_conclint()
+    fs = cl.analyze_sources({"m.py": _DEADLOCK_SRC})
+    assert [f.rule for f in fs] == ["TMG801"]
+    msg = fs[0].message
+    assert "m.A -> m.B" in msg and "m.B -> m.A" in msg
+    # both paths quoted, line-accurately
+    assert "m.py:5: with A:" in msg and "m.py:6: with B:" in msg
+    assert "m.py:9: with B:" in msg and "m.py:10: with A:" in msg
+
+
+def test_tmg801_cross_function_edge_one_call_deep():
+    cl = _load_conclint()
+    src = ("import threading\n"
+           "A = threading.Lock()\n"
+           "B = threading.Lock()\n"
+           "def helper():\n"
+           "    with B:\n"
+           "        pass\n"
+           "def one():\n"
+           "    with A:\n"
+           "        helper()\n"        # A -> B via the call
+           "def two():\n"
+           "    with B:\n"
+           "        with A:\n"
+           "            pass\n")
+    fs = cl.analyze_sources({"m.py": src})
+    assert [f.rule for f in fs] == ["TMG801"]
+
+
+def test_tmg801_consistent_order_and_rlock_are_clean():
+    cl = _load_conclint()
+    ok = ("import threading\n"
+          "A = threading.Lock()\n"
+          "B = threading.Lock()\n"
+          "R = threading.RLock()\n"
+          "def one():\n"
+          "    with A:\n"
+          "        with B:\n"
+          "            pass\n"
+          "def two():\n"
+          "    with A:\n"
+          "        with B:\n"
+          "            pass\n"
+          "def reenter():\n"
+          "    with R:\n"
+          "        with R:\n"          # reentrant: not a self-deadlock
+          "            pass\n")
+    assert cl.analyze_sources({"m.py": ok}) == []
+
+
+def test_tmg801_self_deadlock_on_plain_lock():
+    cl = _load_conclint()
+    bad = ("import threading\n"
+           "A = threading.Lock()\n"
+           "def f():\n"
+           "    with A:\n"
+           "        with A:\n"
+           "            pass\n")
+    fs = cl.analyze_sources({"m.py": bad})
+    assert [f.rule for f in fs] == ["TMG801"]
+    assert "itself" in fs[0].message or "m.A" in fs[0].message
+
+
+def test_tmg801_escape_marker_clears():
+    cl = _load_conclint()
+    marked = _DEADLOCK_SRC.replace(
+        "    with A:\n        with B:",
+        "    with A:  # lint: lock-order — fixture-sanctioned\n"
+        "        with B:")
+    assert cl.analyze_sources({"m.py": marked}) == []
+
+
+_ESCAPE_SRC = """\
+import threading
+_LOCK = threading.Lock()
+_STATE = {}
+def writer():
+    while True:
+        _STATE["k"] = 1
+def safe():
+    with _LOCK:
+        _STATE["k"] = 2
+threading.Thread(target=writer, name="w", daemon=True).start()
+"""
+
+
+def test_tmg802_unlocked_shared_mutation_quotes_both_sites():
+    """The acceptance fixture: a thread-reachable lock-free mutation of
+    state whose OTHER mutation sites hold a lock — finding quotes both
+    the unlocked and the locked site plus the guarding lock."""
+    cl = _load_conclint()
+    fs = cl.analyze_sources({"m.py": _ESCAPE_SRC})
+    assert [f.rule for f in fs] == ["TMG802"]
+    msg = fs[0].message
+    assert "m._LOCK" in msg
+    assert 'm.py:6: _STATE["k"] = 1' in msg      # unlocked site
+    assert 'm.py:9: _STATE["k"] = 2' in msg      # locked site
+
+
+def test_tmg802_fully_locked_and_unreachable_are_clean():
+    cl = _load_conclint()
+    locked = _ESCAPE_SRC.replace(
+        "    while True:\n        _STATE[\"k\"] = 1",
+        "    while True:\n        with _LOCK:\n            _STATE[\"k\"] = 1")
+    assert cl.analyze_sources({"m.py": locked}) == []
+    # same mutation mix, but writer is never a Thread target
+    no_thread = _ESCAPE_SRC.replace(
+        "threading.Thread(target=writer, name=\"w\", daemon=True).start()\n",
+        "")
+    assert cl.analyze_sources({"m.py": no_thread}) == []
+
+
+def test_tmg802_escape_marker_clears():
+    cl = _load_conclint()
+    marked = _ESCAPE_SRC.replace(
+        '        _STATE["k"] = 1',
+        '        _STATE["k"] = 1  # lint: thread-escape — benign counter')
+    assert cl.analyze_sources({"m.py": marked}) == []
+
+
+def test_tmg803_blocking_calls_under_lock():
+    cl = _load_conclint()
+    bad = ("import threading, time, queue\n"
+           "_LOCK = threading.Lock()\n"
+           "_Q = queue.Queue(maxsize=8)\n"
+           "def f():\n"
+           "    with _LOCK:\n"
+           "        time.sleep(1)\n"
+           "def g():\n"
+           "    with _LOCK:\n"
+           "        x = _Q.get()\n")
+    fs = cl.analyze_sources({"m.py": bad})
+    assert [f.rule for f in fs] == ["TMG803", "TMG803"]
+    assert "time.sleep" in fs[0].message
+    ok = ("import threading, time, queue\n"
+          "_LOCK = threading.Lock()\n"
+          "_Q = queue.Queue(maxsize=8)\n"
+          "def f():\n"
+          "    time.sleep(1)\n"              # not under the lock
+          "    with _LOCK:\n"
+          "        pass\n"
+          "def g():\n"
+          "    with _LOCK:\n"
+          "        x = _Q.get(timeout=0.1)\n"   # bounded: fine
+          "    with _LOCK:\n"
+          "        y = _Q.get(block=False)\n")
+    assert cl.analyze_sources({"m.py": ok}) == []
+
+
+def test_tmg803_condition_wait_is_not_blocking():
+    """``cv.wait()`` inside ``with cv:`` RELEASES the lock — the
+    canonical condition-variable pattern must stay clean."""
+    cl = _load_conclint()
+    ok = ("import threading\n"
+          "CV = threading.Condition()\n"
+          "def f():\n"
+          "    with CV:\n"
+          "        CV.wait()\n")
+    assert cl.analyze_sources({"m.py": ok}) == []
+
+
+def test_tmg803_propagates_one_call_deep():
+    cl = _load_conclint()
+    bad = ("import threading, time\n"
+           "_LOCK = threading.Lock()\n"
+           "def slow():\n"
+           "    time.sleep(1)\n"
+           "def f():\n"
+           "    with _LOCK:\n"
+           "        slow()\n")
+    fs = cl.analyze_sources({"m.py": bad})
+    assert [f.rule for f in fs] == ["TMG803"]
+    # escape at the CALL site clears it
+    marked = bad.replace("        slow()",
+                         "        slow()  # lint: lock-blocking — bounded")
+    assert cl.analyze_sources({"m.py": marked}) == []
+
+
+def test_tmg803_flock_counts_as_a_lock():
+    cl = _load_conclint()
+    bad = ("import fcntl, os, time\n"
+           "def f(fd):\n"
+           "    fcntl.flock(fd, fcntl.LOCK_EX)\n"
+           "    time.sleep(1)\n"
+           "    fcntl.flock(fd, fcntl.LOCK_UN)\n")
+    fs = cl.analyze_sources({"m.py": bad})
+    assert [f.rule for f in fs] == ["TMG803"]
+    ok = ("import fcntl, os, time\n"
+          "def f(fd):\n"
+          "    fcntl.flock(fd, fcntl.LOCK_EX)\n"
+          "    fcntl.flock(fd, fcntl.LOCK_UN)\n"
+          "    time.sleep(1)\n")                 # after release
+    assert cl.analyze_sources({"m.py": ok}) == []
+
+
+def test_tmg804_atomic_write_discipline():
+    cl = _load_conclint()
+    torn = ("import json\n"
+            "def save(doc, path):\n"
+            "    with open(path + \"/registry.json\", \"w\") as fh:\n"
+            "        json.dump(doc, fh)\n")
+    fs = cl.analyze_sources({"m.py": torn})
+    assert [f.rule for f in fs] == ["TMG804"]
+    assert "os.replace" in fs[0].message
+    ok = ("import json, os\n"
+          "def save(doc, path):\n"
+          "    tmp = path + \"/registry.json.tmp\"\n"
+          "    with open(tmp, \"w\") as fh:\n"
+          "        json.dump(doc, fh)\n"
+          "    os.replace(tmp, path + \"/registry.json\")\n")
+    assert cl.analyze_sources({"m.py": ok}) == []
+    # a non-shared path family is not this rule's business
+    private = ("def save(doc, path):\n"
+               "    with open(path + \"/notes.txt\", \"w\") as fh:\n"
+               "        fh.write(str(doc))\n")
+    assert cl.analyze_sources({"m.py": private}) == []
+    marked = torn.replace(
+        "    with open(path + \"/registry.json\", \"w\") as fh:",
+        "    with open(path + \"/registry.json\", \"w\") as fh:"
+        "  # lint: atomic-write — single-writer bootstrap")
+    assert cl.analyze_sources({"m.py": marked}) == []
+
+
+def test_tmg805_fault_site_coverage(tmp_path):
+    cl = _load_conclint()
+    from transmogrifai_tpu import resilience
+    tests_dir = tmp_path / "tests"
+    tests_dir.mkdir()
+    # no tests at all: every site is a gap
+    fs = cl.fault_coverage_findings(str(tests_dir))
+    assert len(fs) == len(resilience.FAULT_SITES)
+    assert all(f.rule == "TMG805" for f in fs)
+    # quoting every site (however the test uses it) clears the gaps
+    body = "\n".join(f'plan.on("{s}")' for s in
+                     sorted(resilience.FAULT_SITES))
+    (tests_dir / "test_all_sites.py").write_text(body + "\n")
+    assert cl.fault_coverage_findings(str(tests_dir)) == []
+
+
+def test_tmg8xx_stale_markers_flagged():
+    cl = _load_conclint()
+    stale = ("import threading\n"
+             "x = 1  # lint: lock-order — outdated\n")
+    fs = cl.analyze_sources({"m.py": stale})
+    assert [f.rule for f in fs] == ["TMG399"]
+    assert cl.analyze_sources({"m.py": stale},
+                              stale_markers=False) == []
+
+
+def test_tmg8xx_in_rules_catalog():
+    from transmogrifai_tpu import lint
+    for rule in ("TMG399", "TMG801", "TMG802", "TMG803", "TMG804",
+                 "TMG805"):
+        assert rule in lint.RULES
+    assert lint.RULES["TMG399"][0] == lint.Severity.WARNING
+    for rule in ("TMG801", "TMG802", "TMG803", "TMG804", "TMG805"):
+        assert lint.RULES[rule][0] == lint.Severity.ERROR
+
+
+def test_repo_is_clean_under_concurrency_lint():
+    """The TMG8xx meta-test: the whole package, analyzed as one
+    program, reports zero findings — every lock nests in one global
+    order, no thread-reachable lock-free shared mutation, no blocking
+    call under a lock, no torn shared-artifact write, every fault site
+    chaos-tested, and every escape marker still earns its keep."""
+    cl = _load_conclint()
+    findings = cl.lint_paths(
+        [os.path.join(_REPO, "transmogrifai_tpu")],
+        tests_dir=os.path.join(_REPO, "tests"))
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_cli_lint_subcommand(tmp_path, capsys):
+    """``python -m transmogrifai_tpu lint`` wraps both passes with
+    ``check``-style exit codes, no tools/ path knowledge needed."""
+    from transmogrifai_tpu import cli
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nt0 = time.time()\n")
+    assert cli.main(["lint", "--no-tests-check", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "TMG301" in out and "lint:" in out
+    good = tmp_path / "good.py"
+    good.write_text("import time\nt0 = time.perf_counter()\n")
+    assert cli.main(["lint", "--no-tests-check", str(good)]) == 0

@@ -276,6 +276,52 @@ def test_stream_reader_quarantines_unreadable_file(tmp_path):
 
 
 @pytest.mark.chaos
+def test_stream_poll_retries_transient_listing_fault(tmp_path):
+    """`stream.poll` chaos: a transient directory-listing failure (a
+    network-mount blip mid-poll) rides READER_RETRY instead of killing
+    the stream — the poll retries and the batch still arrives."""
+    from transmogrifai_tpu.readers import DirectoryStreamReader
+    d = tmp_path / "in"
+    d.mkdir()
+    _write_csv(d / "a.csv", [(1, 2.0)])
+    plan = resilience.FaultPlan(seed=5).on(
+        "stream.poll", error=OSError, at=[0])        # transient: once
+    with resilience.fault_plan(plan):
+        rdr = DirectoryStreamReader(str(d), settle_s=0.0)
+        batches = rdr.poll_once()
+    assert len(batches) == 1                   # retry absorbed the fault
+    assert resilience.resilience_stats()["retries"] == 1
+
+
+@pytest.mark.chaos
+def test_csv_decode_retries_transient_fault(tmp_path):
+    """`csv.decode` chaos: a transient decode-time failure on a streamed
+    CSV retries behind READER_RETRY; a persistent one quarantines the
+    file instead of wedging the stream."""
+    from transmogrifai_tpu.readers import DirectoryStreamReader
+    d = tmp_path / "in"
+    d.mkdir()
+    _write_csv(d / "a.csv", [(1, 2.0)])
+    plan = resilience.FaultPlan(seed=7).on(
+        "csv.decode", error=OSError, at=[0])         # transient: once
+    with resilience.fault_plan(plan):
+        rdr = DirectoryStreamReader(str(d), settle_s=0.0)
+        batches = rdr.poll_once()
+    assert len(batches) == 1
+    assert resilience.resilience_stats()["retries"] == 1
+    # persistent decode failure: quarantined, not retried forever
+    d2 = tmp_path / "in2"
+    d2.mkdir()
+    _write_csv(d2 / "b.csv", [(0, 3.0)])
+    always = resilience.FaultPlan(seed=7).on(
+        "csv.decode", error=OSError, probability=1.0)
+    with resilience.fault_plan(always):
+        rdr2 = DirectoryStreamReader(str(d2), settle_s=0.0)
+        assert rdr2.poll_once() == []
+    assert resilience.resilience_stats()["quarantined_files"] == 1
+
+
+@pytest.mark.chaos
 def test_stream_reader_retries_transient_io_then_succeeds(tmp_path):
     from transmogrifai_tpu.readers import DirectoryStreamReader
     d = tmp_path / "in"

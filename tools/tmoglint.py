@@ -105,8 +105,11 @@ from __future__ import annotations
 
 import argparse
 import ast
+import io
 import os
+import re
 import sys
+import tokenize
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -117,6 +120,7 @@ if _REPO not in sys.path:                       # direct script execution
 from transmogrifai_tpu.lint import Finding, Severity, enforce  # noqa: E402
 
 __all__ = ["lint_source", "lint_file", "lint_paths", "main",
+           "MARKER_RULES",
            "ALLOW_WALLCLOCK", "ALLOW_BROAD_EXCEPT", "ALLOW_EXPLICIT_MESH",
            "ALLOW_THREAD", "ALLOW_UNBOUNDED_QUEUE", "ALLOW_POPEN",
            "ALLOW_THREAD_LOOP", "ALLOW_SORT", "ALLOW_PALLAS",
@@ -134,6 +138,29 @@ ALLOW_SORT = "lint: sort"
 ALLOW_PALLAS = "lint: pallas"
 ALLOW_METRIC_NAME = "lint: metric-name"
 ALLOW_KNOB = "lint: knob"
+
+#: marker word → the ONE rule it silences. The stale-marker pass
+#: (TMG399) flags any marker comment whose rule did not actually fire
+#: on that line — suppressions must not outlive their findings. Only
+#: THIS tool's vocabulary is checked here; the TMG8xx markers
+#: (lock-order, thread-escape, lock-blocking, atomic-write) belong to
+#: tools/concurrency_lint.py, which runs its own stale pass.
+MARKER_RULES: Dict[str, str] = {
+    "wall-clock": "TMG301",
+    "broad-except": "TMG302",
+    "explicit-mesh": "TMG306",
+    "thread": "TMG307",
+    "unbounded-queue": "TMG308",
+    "popen": "TMG309",
+    "thread-loop": "TMG310",
+    "sort": "TMG311",
+    "pallas": "TMG312",
+    "metric-name": "TMG313",
+    "knob": "TMG314",
+}
+
+#: matches the marker word in a real COMMENT token ("# lint: knob — …")
+_MARKER_RE = re.compile(r"lint:\s*([a-z][a-z-]*)")
 
 #: the ONE module sanctioned to build instrument names dynamically
 #: (TMG313): the registry itself owns cardinality
@@ -189,6 +216,9 @@ class _Visitor(ast.NodeVisitor):
         #: a post-pass so definition order never matters)
         self.thread_targets: Set[str] = set()
         self.func_defs: Dict[str, ast.AST] = {}
+        #: TMG399 bookkeeping: line → rules a marker on that line
+        #: actually silenced during this walk
+        self.used_markers: Dict[int, Set[str]] = {}
         #: parallel/ owns mesh construction, tests may build explicit
         #: topologies — TMG306 exempts both by path
         parts = os.path.normpath(path).split(os.sep)
@@ -222,6 +252,20 @@ class _Visitor(ast.NodeVisitor):
         self.findings.append(Finding(
             rule, message, severity=severity or "",
             location=f"{self.path}:{lineno}"))
+
+    def _suppressible(self, rule: str, marker: str, lineno: int,
+                      message: str,
+                      lines: Optional[Sequence[int]] = None,
+                      severity: Optional[str] = None) -> None:
+        """Emit ``rule`` at ``lineno`` unless a ``marker`` on one of
+        ``lines`` (default: the finding line) silences it. A silencing
+        marker is recorded as USED so the stale-marker pass (TMG399)
+        can flag the ones that no longer silence anything."""
+        for ln in (lines or (lineno,)):
+            if self._marked(ln, marker):
+                self.used_markers.setdefault(ln, set()).add(rule)
+                return
+        self._add(rule, lineno, message, severity)
 
     # -- imports -----------------------------------------------------------
     def visit_Import(self, node: ast.Import) -> None:
@@ -311,10 +355,9 @@ class _Visitor(ast.NodeVisitor):
                      in t.elts]
         elif t is not None:
             names = [getattr(t, "id", getattr(t, "attr", ""))]
-        if any(n in ("Exception", "BaseException") for n in names) \
-                and not self._marked(node.lineno, ALLOW_BROAD_EXCEPT):
-            self._add(
-                "TMG302", node.lineno,
+        if any(n in ("Exception", "BaseException") for n in names):
+            self._suppressible(
+                "TMG302", ALLOW_BROAD_EXCEPT, node.lineno,
                 "broad 'except Exception' outside the allowlist — catch "
                 "the specific exceptions or mark the line "
                 f"'# {ALLOW_BROAD_EXCEPT} — <reason>' if this is a "
@@ -445,14 +488,14 @@ class _Visitor(ast.NodeVisitor):
         return name.endswith("custom_params") or name.endswith(
             "customParams")
 
-    def _knob_marked(self, node) -> bool:
+    @staticmethod
+    def _knob_lines(node) -> Tuple[int, int]:
         """The ``# lint: knob`` marker may sit on the read's FIRST or
         LAST physical line (a wrapped ``.get(...)`` continuation puts
         the comment after the closing paren, a line below where the
         expression starts)."""
-        return self._marked(node.lineno, ALLOW_KNOB) or self._marked(
-            getattr(node, "end_lineno", node.lineno) or node.lineno,
-            ALLOW_KNOB)
+        return (node.lineno,
+                getattr(node, "end_lineno", node.lineno) or node.lineno)
 
     def visit_Subscript(self, node: ast.Subscript) -> None:
         # Load-context only: the CLI legitimately ASSEMBLES customParams
@@ -460,31 +503,33 @@ class _Visitor(ast.NodeVisitor):
         # the registry accessors
         if isinstance(node.ctx, ast.Load) \
                 and self._is_knob_receiver(node.value) \
-                and not self.knob_exempt and not self._knob_marked(node):
-            self._add(
-                "TMG314", node.lineno,
+                and not self.knob_exempt:
+            self._suppressible(
+                "TMG314", ALLOW_KNOB, node.lineno,
                 "raw customParams subscript read outside config.py — "
                 "the knob registry owns types, bounds, defaults and "
                 "error wording; route through config.numeric_param/"
                 "bool_param/string_param (or the runner wrappers), or "
                 "mark a deliberate passthrough "
-                f"'# {ALLOW_KNOB} — <reason>'")
+                f"'# {ALLOW_KNOB} — <reason>'",
+                lines=self._knob_lines(node))
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
         f = node.func
         if isinstance(f, ast.Attribute) and f.attr == "get" \
                 and self._is_knob_receiver(f.value) \
-                and not self.knob_exempt and not self._knob_marked(node):
-            self._add(
-                "TMG314", node.lineno,
+                and not self.knob_exempt:
+            self._suppressible(
+                "TMG314", ALLOW_KNOB, node.lineno,
                 "raw customParams .get() outside config.py — the knob "
                 "registry owns types, bounds, defaults and error "
                 "wording (a raw .get() silently drifts from the "
                 "declared default and skips validation); route through "
                 "config.numeric_param/bool_param/string_param (or the "
                 "runner wrappers), or mark a deliberate passthrough "
-                f"'# {ALLOW_KNOB} — <reason>'")
+                f"'# {ALLOW_KNOB} — <reason>'",
+                lines=self._knob_lines(node))
         if self._is_thread(node):
             # TMG310: remember the target's name whatever the TMG307
             # outcome — `target=self._loop` and `target=loop` both
@@ -496,10 +541,9 @@ class _Visitor(ast.NodeVisitor):
                         self.thread_targets.add(v.id)
                     elif isinstance(v, ast.Attribute):
                         self.thread_targets.add(v.attr)
-        if self._is_time_time(node) \
-                and not self._marked(node.lineno, ALLOW_WALLCLOCK):
-            self._add(
-                "TMG301", node.lineno,
+        if self._is_time_time(node):
+            self._suppressible(
+                "TMG301", ALLOW_WALLCLOCK, node.lineno,
                 "time.time() — durations must use time.perf_counter() "
                 "(NTP steps corrupt wall-clock deltas); true wall-clock "
                 "uses (mtime comparisons, sink timestamps) carry "
@@ -528,31 +572,28 @@ class _Visitor(ast.NodeVisitor):
                 "span only records on __exit__, so an unpaired call "
                 "never lands in the trace and corrupts the per-thread "
                 "span stack")
-        elif self._is_make_mesh(node) and not self.mesh_exempt \
-                and not self._marked(node.lineno, ALLOW_EXPLICIT_MESH):
-            self._add(
-                "TMG306", node.lineno,
+        elif self._is_make_mesh(node) and not self.mesh_exempt:
+            self._suppressible(
+                "TMG306", ALLOW_EXPLICIT_MESH, node.lineno,
                 "direct make_mesh() outside parallel/ — runtime code "
                 "shares the ONE process mesh via process_default_mesh()"
                 "/set_process_mesh (a throwaway mesh per pass is the "
                 "mesh_constructions regression); mark a deliberate "
                 f"explicit topology '# {ALLOW_EXPLICIT_MESH} — <reason>'")
-        elif self._is_thread(node) \
-                and not self._marked(node.lineno, ALLOW_THREAD):
+        elif self._is_thread(node):
             kws = {kw.arg for kw in node.keywords}
             missing = [f"{k}=" for k in ("name", "daemon")
                        if k not in kws]
             if missing:
-                self._add(
-                    "TMG307", node.lineno,
+                self._suppressible(
+                    "TMG307", ALLOW_THREAD, node.lineno,
                     f"threading.Thread() without explicit "
                     f"{' and '.join(missing)} — telemetry trace tracks "
                     "are keyed by thread name and implicit daemonness "
                     "hides shutdown semantics; pass name= and daemon= "
                     "(or mark a deliberate default "
                     f"'# {ALLOW_THREAD} — <reason>')")
-        elif self._is_queue(node) \
-                and not self._marked(node.lineno, ALLOW_UNBOUNDED_QUEUE):
+        elif self._is_queue(node):
             size = None
             for kw in node.keywords:
                 if kw.arg == "maxsize":
@@ -568,8 +609,8 @@ class _Visitor(ast.NodeVisitor):
                     and isinstance(size.op, ast.USub)
                     and isinstance(size.operand, ast.Constant))
             if size is None or literal_unbounded:
-                self._add(
-                    "TMG308", node.lineno,
+                self._suppressible(
+                    "TMG308", ALLOW_UNBOUNDED_QUEUE, node.lineno,
                     "queue.Queue() without an explicit positive "
                     "maxsize= (maxsize<=0 means UNBOUNDED) — an "
                     "unbounded queue between pipeline stages hides "
@@ -577,8 +618,7 @@ class _Visitor(ast.NodeVisitor):
                     "eat the heap instead of slowing down); pass "
                     "maxsize= (or mark a deliberate unbounded queue "
                     f"'# {ALLOW_UNBOUNDED_QUEUE} — <reason>')")
-        elif self._is_popen(node) \
-                and not self._marked(node.lineno, ALLOW_POPEN):
+        elif self._is_popen(node):
             kws = {kw.arg for kw in node.keywords}
             # a **kwargs splat may well carry stdout/stderr — the
             # static check cannot see inside it, so don't false-ERROR a
@@ -586,8 +626,8 @@ class _Visitor(ast.NodeVisitor):
             missing = [] if None in kws else \
                 [f"{k}=" for k in ("stdout", "stderr") if k not in kws]
             if missing:
-                self._add(
-                    "TMG309", node.lineno,
+                self._suppressible(
+                    "TMG309", ALLOW_POPEN, node.lineno,
                     f"subprocess.Popen() without explicit "
                     f"{' and '.join(missing)} — an inherited stdout "
                     "ties a long-lived child's output to whatever "
@@ -596,10 +636,9 @@ class _Visitor(ast.NodeVisitor):
                     "fills; a supervisor must own its workers' "
                     "streams (or mark a deliberate inherit "
                     f"'# {ALLOW_POPEN} — <reason>')")
-        elif self._is_pallas_call(node) and not self.pallas_exempt \
-                and not self._marked(node.lineno, ALLOW_PALLAS):
-            self._add(
-                "TMG312", node.lineno,
+        elif self._is_pallas_call(node) and not self.pallas_exempt:
+            self._suppressible(
+                "TMG312", ALLOW_PALLAS, node.lineno,
                 "pl.pallas_call() outside models/_pallas_hist.py — "
                 "kernels live behind that module's probe/fallback gate "
                 "(pallas_histograms_enabled / with_pallas_fallback): a "
@@ -608,8 +647,7 @@ class _Visitor(ast.NodeVisitor):
                 "instead of degrading; move it (or mark a deliberately "
                 f"un-gated kernel '# {ALLOW_PALLAS} — <reason>')")
         elif self._instrument_kind(node) is not None \
-                and not self.metric_exempt \
-                and not self._marked(node.lineno, ALLOW_METRIC_NAME):
+                and not self.metric_exempt:
             inst_kind = self._instrument_kind(node)
             name_arg = node.args[0] if node.args else None
             for kw in node.keywords:
@@ -617,8 +655,8 @@ class _Visitor(ast.NodeVisitor):
                     name_arg = kw.value
             if not (isinstance(name_arg, ast.Constant)
                     and isinstance(name_arg.value, str)):
-                self._add(
-                    "TMG313", node.lineno,
+                self._suppressible(
+                    "TMG313", ALLOW_METRIC_NAME, node.lineno,
                     f"telemetry.{inst_kind}() with a non-literal metric "
                     "name outside telemetry.py — a dynamic name is "
                     "unbounded registry/exposition cardinality (every "
@@ -629,13 +667,12 @@ class _Visitor(ast.NodeVisitor):
                     f"'# {ALLOW_METRIC_NAME} — <reason>'")
         else:
             sort_kind = self._np_sort_kind(node)
-            if sort_kind is not None \
-                    and not self._marked(node.lineno, ALLOW_SORT):
+            if sort_kind is not None:
                 need = "kind" if sort_kind == "argsort" else "side"
                 kws = {kw.arg for kw in node.keywords}
                 if need not in kws and None not in kws:
-                    self._add(
-                        "TMG311", node.lineno,
+                    self._suppressible(
+                        "TMG311", ALLOW_SORT, node.lineno,
                         f"np.{sort_kind}() without explicit {need}= — "
                         "order-dependent monoid folds (float sums, "
                         "concat, first/last) silently change value "
@@ -657,28 +694,71 @@ def _check_thread_loops(v: _Visitor) -> None:
         fn = v.func_defs.get(name)
         if fn is None:
             continue                # library callable (serve_forever, …)
-        if v._marked(fn.lineno, ALLOW_THREAD_LOOP):
-            continue
         for node in ast.walk(fn):
             if not isinstance(node, ast.While):
                 continue
-            if v._marked(node.lineno, ALLOW_THREAD_LOOP):
-                continue
             if any(isinstance(x, ast.Try) for x in ast.walk(node)):
                 continue
-            v._add(
-                "TMG310", node.lineno,
+            v._suppressible(
+                "TMG310", ALLOW_THREAD_LOOP, node.lineno,
                 f"'while' loop in thread target {name!r} has no "
                 "try/except anywhere in its body — an uncaught "
                 "exception kills the thread SILENTLY and the subsystem "
                 "it drives keeps 'running' with nobody home; "
                 "catch-and-tally in the loop body (or mark a "
                 "deliberately bare loop "
-                f"'# {ALLOW_THREAD_LOOP} — <reason>')")
+                f"'# {ALLOW_THREAD_LOOP} — <reason>')",
+                lines=(node.lineno, fn.lineno))
 
 
-def lint_source(src: str, path: str = "<string>") -> List[Finding]:
-    """Lint one module's source text; returns TMG3xx findings."""
+def _stale_marker_findings(src: str, path: str,
+                           v: _Visitor) -> List[Finding]:
+    """TMG399: every real COMMENT carrying a ``lint: <marker>`` from
+    THIS tool's vocabulary must have silenced its rule on that line
+    during the walk — a leftover marker is camouflage for the next
+    real finding there. Rules path-exempt in this file (TMG306/312/
+    313/314 homes, tests) are skipped: their markers are inert, not
+    stale. Marker text inside string literals never counts (the
+    catalog and fixtures SPELL markers without placing them)."""
+    exempt: Set[str] = set()
+    if v.mesh_exempt:
+        exempt.add("TMG306")
+    if v.pallas_exempt:
+        exempt.add("TMG312")
+    if v.metric_exempt:
+        exempt.add("TMG313")
+    if v.knob_exempt:
+        exempt.add("TMG314")
+    findings: List[Finding] = []
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return findings                 # parse-adjacent breakage → TMG305's job
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _MARKER_RE.search(tok.string)
+        if m is None:
+            continue
+        rule = MARKER_RULES.get(m.group(1))
+        if rule is None or rule in exempt:
+            continue                    # foreign vocabulary (TMG8xx) / inert
+        lineno = tok.start[0]
+        if rule in v.used_markers.get(lineno, ()):
+            continue
+        findings.append(Finding(
+            "TMG399",
+            f"stale suppression: 'lint: {m.group(1)}' silences {rule} "
+            "but nothing on this line triggers that rule anymore — "
+            "delete the marker (or fix it if it names the wrong rule)",
+            location=f"{path}:{lineno}"))
+    return findings
+
+
+def lint_source(src: str, path: str = "<string>",
+                stale_markers: bool = True) -> List[Finding]:
+    """Lint one module's source text; returns TMG3xx findings (plus
+    TMG399 stale-suppression warnings unless ``stale_markers=False``)."""
     try:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
@@ -687,27 +767,33 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
     v = _Visitor(path, src.splitlines())
     v.visit(tree)
     _check_thread_loops(v)
-    return sorted(v.findings, key=lambda f: f.location or "")
+    findings = v.findings
+    if stale_markers:
+        findings = findings + _stale_marker_findings(src, path, v)
+    return sorted(findings, key=lambda f: f.location or "")
 
 
-def lint_file(path: str) -> List[Finding]:
+def lint_file(path: str, stale_markers: bool = True) -> List[Finding]:
     with open(path, encoding="utf-8") as fh:
-        return lint_source(fh.read(), path)
+        return lint_source(fh.read(), path, stale_markers=stale_markers)
 
 
-def lint_paths(paths: Sequence[str]) -> List[Finding]:
+def lint_paths(paths: Sequence[str],
+               stale_markers: bool = True) -> List[Finding]:
     """Lint every ``.py`` file under the given files/directories
     (``__pycache__`` skipped), findings sorted by location."""
     findings: List[Finding] = []
     for p in paths:
         if os.path.isfile(p):
-            findings.extend(lint_file(p))
+            findings.extend(lint_file(p, stale_markers=stale_markers))
             continue
         for root, dirs, files in os.walk(p):
             dirs[:] = sorted(d for d in dirs if d != "__pycache__")
             for fn in sorted(files):
                 if fn.endswith(".py"):
-                    findings.extend(lint_file(os.path.join(root, fn)))
+                    findings.extend(lint_file(
+                        os.path.join(root, fn),
+                        stale_markers=stale_markers))
     return findings
 
 
@@ -723,8 +809,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     default="error",
                     help="exit non-zero when findings reach this "
                          "severity (default: error)")
+    ap.add_argument("--no-stale-markers", action="store_true",
+                    help="skip the TMG399 stale-suppression pass")
     args = ap.parse_args(argv)
-    findings = lint_paths(args.paths)
+    findings = lint_paths(args.paths,
+                          stale_markers=not args.no_stale_markers)
     for f in findings:
         print(f.format())
     counts: Dict[str, int] = {}

@@ -73,6 +73,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from . import resilience, telemetry
+from .utils import locks
 
 logger = logging.getLogger(__name__)
 
@@ -319,7 +320,7 @@ class RetrainController:
         #: (docs/observability.md "Distributed tracing")
         self.trace_dir = str(trace_dir) if trace_dir else None
         os.makedirs(os.path.join(self.job_dir, JOBS_DIR), exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = locks.witness_lock("continual.RetrainController._lock")
         self._streak = 0
         self._failures = 0
         self._disarmed = False
@@ -480,6 +481,7 @@ class RetrainController:
         except OSError:
             os.close(fd)
             return None
+        locks.witness_acquire("continual.active_slot.flock")
         return fd
 
     def _spawn_env(self, job: Dict[str, Any],
@@ -546,6 +548,7 @@ class RetrainController:
                 self._cooldown_until = max(
                     self._cooldown_until,
                     time.monotonic() + self.cooldown_s)
+            locks.witness_release("continual.active_slot.flock")
             try:
                 fcntl.flock(slot, fcntl.LOCK_UN)
             except OSError:

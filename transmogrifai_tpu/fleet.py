@@ -71,6 +71,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import resilience, telemetry, workload
+from .utils import locks
 
 logger = logging.getLogger(__name__)
 
@@ -277,7 +278,8 @@ class FleetSupervisor:
             for i in range(self.n_workers)]
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
-        self._lock = threading.Lock()        # guards spawn/quiesce
+        # guards spawn/quiesce; order-witnessed under chaos tests
+        self._lock = locks.witness_lock("fleet.FleetSupervisor._lock")
         #: workers the router must not send to (rolling restart quiesce)
         self._quiesced: set = set()
 
@@ -373,7 +375,7 @@ class FleetSupervisor:
         h.state = DEAD
         h.ready_since = None
         _tally("worker_crashes")
-        h.restarts += 1
+        h.restarts += 1  # lint: thread-escape — every caller holds FleetSupervisor._lock across _note_crash
         if h.restarts > self.respawn_max:
             h.state = FAILED
             _tally("workers_gave_up")
@@ -465,7 +467,8 @@ class FleetSupervisor:
             logger.info("fleet: worker %d healthy for %.1fs — "
                         "consecutive-crash budget reset", h.wid,
                         now - h.ready_since)
-            h.restarts = 0
+            with self._lock:   # restart_worker writes restarts under it
+                h.restarts = 0
 
     def _monitor_loop(self) -> None:
         while not self._stop.is_set():
@@ -488,7 +491,7 @@ class FleetSupervisor:
                     if not quiesced and h.state == DEAD \
                             and time.monotonic() >= h.next_spawn_at:
                         try:
-                            self._spawn(h)
+                            self._spawn(h)  # lint: lock-blocking — the DEAD check and handle flip must be atomic with the spawn; probes never take _lock, so the stall is bounded by fork/exec
                         except Exception as e:  # lint: broad-except — a failed respawn re-enters the backoff schedule, the monitor survives
                             logger.exception(
                                 "fleet: respawn of worker %d failed",
@@ -527,7 +530,7 @@ class FleetSupervisor:
                 h.last_exit = h.proc.returncode
             with self._lock:
                 h.restarts = 0          # deliberate restart, not a crash
-                self._spawn(h)
+                self._spawn(h)  # lint: lock-blocking — quiesce/spawn must flip atomically or the monitor would respawn the same worker concurrently
             deadline = time.monotonic() + ready_timeout_s
             while time.monotonic() < deadline:
                 if h.state == READY:

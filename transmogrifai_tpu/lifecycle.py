@@ -51,6 +51,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import resilience, telemetry
+from .utils import locks
 
 logger = logging.getLogger(__name__)
 
@@ -227,7 +228,7 @@ class ModelRegistry:
     def __init__(self, root: str):
         self.root = str(root)
         os.makedirs(self.root, exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = locks.witness_lock("lifecycle.ModelRegistry._lock")
 
     # -- paths / io --------------------------------------------------------
     def _mdir(self, name: str, create: bool = False) -> str:
@@ -310,9 +311,11 @@ class ModelRegistry:
                         raise RegistryError(
                             f"pointer lock for {name!r} held elsewhere "
                             f"for > {timeout_s:g}s ({path})")
-                    time.sleep(0.01)
+                    time.sleep(0.01)  # lint: lock-blocking — backoff after a FAILED flock attempt; nothing is held here (the analyzer scopes flocks to the whole function)
+            locks.witness_acquire("lifecycle.pointer.flock")
             yield
         finally:
+            locks.witness_release("lifecycle.pointer.flock")
             try:
                 fcntl.flock(fd, fcntl.LOCK_UN)
             except OSError:
@@ -412,7 +415,7 @@ class ModelRegistry:
         Writers serialize across processes via the pointer lock file —
         ``previous`` is computed from the pointer read, so a lost update
         would leave the loser's version recorded in neither field."""
-        with self._lock, self._pointer_mutation(name):
+        with self._pointer_mutation(name), self._lock:
             self.record(name, version)          # must exist
             ptr = self._pointer_doc(name)
             if ptr.get("current") == str(version):
@@ -434,7 +437,7 @@ class ModelRegistry:
         before the last promote). Same atomic pointer discipline; the
         rolled-back-from version stays registered (and becomes the new
         ``previous``, so rollback is its own undo)."""
-        with self._lock, self._pointer_mutation(name):
+        with self._pointer_mutation(name), self._lock:
             ptr = self._pointer_doc(name)
             prev = ptr.get("previous")
             if prev is None:
@@ -514,7 +517,7 @@ class DriftSentinel:
                 self._summaries[(d.name, d.key)] = Summary(
                     min=float(d.summary_info[0]),
                     max=float(d.summary_info[1]))
-        self._lock = threading.Lock()
+        self._lock = locks.witness_lock("lifecycle.DriftSentinel._lock")
         self._pending: Dict[Tuple[str, Optional[str]], Any] = {}
         self._pending_rows = 0
         #: window subscribers: fn(findings, report) called after EVERY

@@ -191,6 +191,12 @@ RULES: Dict[str, Tuple[str, str]] = {
                "search space; route through config.py (numeric_param/"
                "bool_param/string_param) or the runner wrappers, or "
                "mark a deliberate passthrough '# lint: knob — reason'"),
+    "TMG399": (Severity.WARNING,
+               "stale suppression: a '# lint: <marker>' escape sits on "
+               "a line that no longer triggers the rule it silences — "
+               "an outdated marker is camouflage for the NEXT real "
+               "finding on that line; delete it (or fix the marker if "
+               "it silences the wrong rule)"),
     # -- TMG5xx: serving / AOT-bank advisories (aot.py, serving.py,
     #    server.py) — degradation notices, never crash paths ---------------
     "TMG501": (Severity.WARNING,
@@ -262,6 +268,42 @@ RULES: Dict[str, Tuple[str, str]] = {
                "batch_deadline_s far from the params file's "
                "serveBatchDeadlineMs — re-run the offline tuner "
                "against a fresh recording (docs/tuning.md)"),
+    # -- TMG8xx: whole-program concurrency & crash-safety rules
+    #    (tools/concurrency_lint.py — cross-module lock-order graph,
+    #    thread-escape and held-lock analysis; the runtime analog is
+    #    the utils.locks lock-order witness) ------------------------------
+    "TMG801": (Severity.ERROR,
+               "lock-order cycle: two lock acquisition paths take the "
+               "same locks in opposite orders — two threads on those "
+               "paths deadlock; both acquisition paths are quoted in "
+               "the finding (allow: '# lint: lock-order — reason')"),
+    "TMG802": (Severity.ERROR,
+               "thread-escape: shared state (module global / shared "
+               "object attribute) is mutated lock-free from a function "
+               "reachable as a threading.Thread target while its other "
+               "mutation sites hold a guarding lock — a torn or lost "
+               "update under the right interleaving (allow: "
+               "'# lint: thread-escape — reason')"),
+    "TMG803": (Severity.ERROR,
+               "blocking call while holding a lock: queue get/put "
+               "without block=False/timeout, .join()/.wait(), "
+               "subprocess, socket/HTTP, or time.sleep inside a lock "
+               "body — every other thread needing that lock stalls "
+               "behind I/O it cannot see (allow: "
+               "'# lint: lock-blocking — reason')"),
+    "TMG804": (Severity.ERROR,
+               "non-atomic write to a shared artifact: open(path, 'w')/"
+               "json.dump into a registry record, CURRENT pointer, cost "
+               "db, trace/workload shard or AOT manifest without the "
+               "tmp + os.replace pattern — a crash mid-write leaves a "
+               "torn file that every reader then trusts (allow: "
+               "'# lint: atomic-write — reason')"),
+    "TMG805": (Severity.ERROR,
+               "fault-site coverage gap: a site registered in "
+               "resilience.FAULT_SITES is exercised by no test "
+               "(no inject-site string match under tests/) — an "
+               "untested fault site is a recovery path that has never "
+               "once run"),
 }
 
 

@@ -60,6 +60,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from . import aot, lifecycle, resilience, telemetry, workload
+from .utils import locks
 from .lifecycle import RegistryError
 
 logger = logging.getLogger(__name__)
@@ -377,7 +378,8 @@ class _ModelEntry:
         self.rollout: Optional["_Rollout"] = None
         self.weight_bytes = 0
         self.queue: "queue.Queue[Any]" = queue.Queue(maxsize=max_queue)
-        self.lock = threading.Lock()       # guards load/unload
+        # guards load/unload; order-witnessed under chaos tests
+        self.lock = locks.witness_lock("server._ModelEntry.lock")
         self.worker: Optional[threading.Thread] = None
         self.latencies: "deque[float]" = deque(maxlen=_LATENCY_WINDOW)
         #: per-phase latency reservoirs — the end-to-end number above,
@@ -504,7 +506,7 @@ class ModelServer:
         self.canary_fraction = float(canary_fraction)
         #: LRU order: oldest first; touched on every submit
         self._entries: "OrderedDict[str, _ModelEntry]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = locks.witness_lock("server.ModelServer._lock")
         self._closed = False
         #: per-tenant drift-window subscribers (continual.py's retrain
         #: controller): re-attached every time a tenant's sentinel is
@@ -1163,7 +1165,7 @@ class ModelServer:
         sentinel = entry.sentinel
         drift_now = sentinel.advisories if sentinel is not None else 0
         new_drift = drift_now - rollout.drift_seen
-        rollout.drift_seen = drift_now
+        rollout.drift_seen = drift_now  # lint: thread-escape — rollout counters are confined to the entry's single dispatch worker; deploy() initializes a NOT-yet-published rollout under entry.lock
         clean = ((new_drift == 0 or not rollout.drift_gate)
                  and rollout.win_parity_mismatch == 0)
         rollout.windows += 1

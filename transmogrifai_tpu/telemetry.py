@@ -57,6 +57,8 @@ import os
 import re
 import threading
 import time
+
+from .utils import locks
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -96,13 +98,13 @@ _EPOCH = time.perf_counter()
 
 _PID = os.getpid()
 
-_LOCK = threading.RLock()
+_LOCK = locks.witness_lock("telemetry._LOCK", reentrant=True)
 
 #: dedicated event-buffer lock: span exits (every traced hot path, on
 #: every thread) append here, so sharing the registry RLock with every
 #: counter inc and histogram observe measurably convoys the serving
 #: workers (trace_overhead bench) — the buffer gets its own lock
-_EVENTS_LOCK = threading.Lock()
+_EVENTS_LOCK = locks.witness_lock("telemetry._EVENTS_LOCK")
 
 #: recorded Chrome trace events (dicts, ph "X" for spans + "M" metadata)
 _EVENTS: List[Dict[str, Any]] = []
@@ -330,7 +332,7 @@ _TRACE_ROLE = [os.environ.get(TRACE_ROLE_ENV, "proc")]
 
 #: always-on tracing tallies (never cleared by reset() — the
 #: engine_cache_stats discipline; see telemetry_stats())
-_TRACE_TALLY_LOCK = threading.Lock()
+_TRACE_TALLY_LOCK = locks.witness_lock("telemetry._TRACE_TALLY_LOCK")
 _TRACE_TALLY = {"traces_minted": 0, "traces_adopted": 0,
                 "shards_written": 0, "shards_merged": 0}
 
@@ -950,7 +952,7 @@ PEAK_FLOPS = {"v5e": {"bf16": 197e12, "f32": 49e12},
               "v5p": {"bf16": 459e12, "f32": 115e12},
               "v4": {"bf16": 275e12, "f32": 69e12}}
 
-_DEVICE_COST_LOCK = threading.Lock()
+_DEVICE_COST_LOCK = locks.witness_lock("telemetry._DEVICE_COST_LOCK")
 #: phase -> {"flops", "seconds", "dispatches"} — fed by the scoring
 #: engine, the fitstats device fold and the tuning/tree sweep
 #: executables (models/tuning.DEVICE_FLOPS generalized); always on,
@@ -1463,7 +1465,7 @@ _COMPILE_LISTENER_ON = [False]
 #: must never exceed 1 per process, telemetry on OR off (the disabled
 #: path registers nothing extra; the enabled path reuses the same one)
 _COMPILE_LISTENER_REGISTRATIONS = [0]
-_COMPILE_CLOCK_LOCK = threading.Lock()
+_COMPILE_CLOCK_LOCK = locks.witness_lock("telemetry._COMPILE_CLOCK_LOCK")
 
 
 def _ensure_compile_listener() -> None:

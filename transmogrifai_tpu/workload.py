@@ -51,6 +51,7 @@ import urllib.parse
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import telemetry
+from .utils import locks
 
 logger = logging.getLogger(__name__)
 
@@ -307,7 +308,7 @@ class WorkloadRecorder:
 
 
 _RECORDER: Optional[WorkloadRecorder] = None
-_RECORDER_LOCK = threading.Lock()
+_RECORDER_LOCK = locks.witness_lock("workload._RECORDER_LOCK")
 
 
 def start_recorder(dir_path: str, role: Optional[str] = None,
@@ -702,7 +703,7 @@ def replay_workload(doc: Dict[str, Any], url: str, speed: float = 1.0,
     truncated = n_before_cut - len(runnable)
     _tally("replay_truncated", truncated)
 
-    lock = threading.Lock()
+    lock = locks.witness_lock("workload.replay.lock")
     phase_samples: Dict[str, Dict[str, List[float]]] = {}
     client_e2e: List[float] = []
     models: Dict[str, Dict[str, Any]] = {}
